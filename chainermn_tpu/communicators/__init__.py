@@ -1,0 +1,68 @@
+"""Communicator factory.
+
+Reference: ``chainermn/communicators/__init__.py`` (dagger)
+``create_communicator(name, mpi_comm, allreduce_grad_dtype)`` with the
+string registry ``'naive' | 'flat' | 'hierarchical' | 'two_dimensional' |
+'single_node' | 'non_cuda_aware' | 'pure_nccl'`` (SURVEY.md section 2.1).
+
+All historical names resolve to TPU-native communicators; names that only
+differed in GPU transport details (flat buffers, CUDA-awareness) are aliases,
+since XLA owns those concerns on TPU. The new primary name is ``'xla'``
+(BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.communicators.xla_communicator import (
+    HierarchicalCommunicator,
+    NaiveCommunicator,
+    SingleNodeCommunicator,
+    XlaCommunicator,
+)
+
+_REGISTRY = {
+    # TPU-native primary
+    "xla": XlaCommunicator,
+    # reference-parity names
+    "naive": NaiveCommunicator,
+    "flat": XlaCommunicator,            # flat fused buffer == what XLA emits
+    "pure_nccl": XlaCommunicator,       # all-ranks single collective == psum
+    "hierarchical": HierarchicalCommunicator,
+    "two_dimensional": HierarchicalCommunicator,  # 2-level ring == XLA's own
+    "non_cuda_aware": HierarchicalCommunicator,   # host staging is moot on TPU
+    "single_node": SingleNodeCommunicator,
+}
+
+
+def create_communicator(
+    communicator_name: str = "xla", **kwargs
+) -> CommunicatorBase:
+    """Create a communicator by registry name.
+
+    Args:
+      communicator_name: one of ``xla, naive, flat, hierarchical,
+        two_dimensional, single_node, non_cuda_aware, pure_nccl``.
+      **kwargs: ``mesh=`` (pre-built :class:`jax.sharding.Mesh`),
+        ``devices=``, ``axis_name=``, and ``allreduce_grad_dtype=``
+        (e.g. ``'bfloat16'`` — the TPU analog of the reference's fp16
+        compressed allreduce).
+    """
+    try:
+        cls = _REGISTRY[communicator_name]
+    except KeyError:
+        raise ValueError(
+            f"unknown communicator {communicator_name!r}; "
+            f"available: {sorted(_REGISTRY)}"
+        ) from None
+    return cls(**kwargs)
+
+
+__all__ = [
+    "create_communicator",
+    "CommunicatorBase",
+    "XlaCommunicator",
+    "NaiveCommunicator",
+    "HierarchicalCommunicator",
+    "SingleNodeCommunicator",
+]

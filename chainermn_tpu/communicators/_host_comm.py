@@ -1,0 +1,128 @@
+"""Host-plane (process-level) object collectives.
+
+TPU-native replacement for the reference's pickled-object MPI collectives
+(``MpiCommunicatorBase.send_obj/recv_obj/bcast_obj/gather_obj/allreduce_obj``
+in ``mpi_communicator_base.py`` (dagger), SURVEY.md section 2.1). There, every
+communicator inherited object transport from mpi4py. On TPU there is no MPI:
+object collectives ride DCN through ``jax.experimental.multihost_utils``
+(which rendezvouses through the JAX distributed runtime), with objects
+pickled into padded uint8 arrays (the reference pickled into MPI byte
+messages with a ``_MessageType`` header; same idea, different transport).
+
+A native C++ TCP backend (chainermn_tpu/native) can replace this transport
+for point-to-point sends; the collective API stays identical.
+
+Single-process (the common TPU-slice-per-process and all test cases) is a
+fast path with no communication at all.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+
+def _is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def _obj_to_padded(obj: Any, pad_to: int | None = None) -> np.ndarray:
+    """Pickle ``obj`` into a uint8 vector ``[8-byte length | payload | pad]``.
+
+    The length header plays the role of the reference's ``_MessageType``
+    preamble (shape/dtype descriptor sent via ``send_obj`` before the
+    payload, ``mpi_communicator_base.py`` (dagger)).
+    """
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    header = np.frombuffer(np.uint64(payload.size).tobytes(), dtype=np.uint8)
+    buf = np.concatenate([header, payload])
+    if pad_to is not None:
+        if pad_to < buf.size:
+            raise ValueError("pad_to smaller than pickled object")
+        buf = np.pad(buf, (0, pad_to - buf.size))
+    return buf
+
+
+def _padded_to_obj(buf: np.ndarray) -> Any:
+    size = int(np.frombuffer(bytes(buf[:8]), dtype=np.uint64)[0])
+    return pickle.loads(bytes(buf[8 : 8 + size]))
+
+
+class HostComm:
+    """Process-level collectives. ``rank``/``size`` are process index/count —
+    the host-plane analog of the reference's MPI world."""
+
+    def __init__(self) -> None:
+        self.rank = jax.process_index()
+        self.size = jax.process_count()
+
+    # -- collectives -------------------------------------------------------
+
+    def barrier(self, tag: str = "barrier") -> None:
+        if not _is_multiprocess():
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        if not _is_multiprocess():
+            return obj
+        from jax.experimental import multihost_utils
+
+        # Round 1: agree on buffer size (max over processes).
+        local = _obj_to_padded(obj) if self.rank == root else np.zeros(8, np.uint8)
+        sizes = multihost_utils.process_allgather(np.int64(local.size))
+        pad = int(np.max(sizes))
+        buf = _obj_to_padded(obj, pad) if self.rank == root else np.zeros(pad, np.uint8)
+        out = multihost_utils.broadcast_one_to_all(buf, is_source=(self.rank == root))
+        return _padded_to_obj(np.asarray(out))
+
+    def allgather_obj(self, obj: Any) -> list[Any]:
+        if not _is_multiprocess():
+            return [obj]
+        from jax.experimental import multihost_utils
+
+        local = _obj_to_padded(obj)
+        sizes = multihost_utils.process_allgather(np.int64(local.size))
+        pad = int(np.max(sizes))
+        stacked = multihost_utils.process_allgather(_obj_to_padded(obj, pad))
+        return [_padded_to_obj(np.asarray(row)) for row in stacked]
+
+    def gather_obj(self, obj: Any, root: int = 0) -> list[Any] | None:
+        """Gather to ``root``; non-root processes get ``None`` (MPI parity)."""
+        everyone = self.allgather_obj(obj)
+        return everyone if self.rank == root else None
+
+    def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
+        if not _is_multiprocess():
+            assert objs is not None
+            return objs[0]
+        objs = self.bcast_obj(objs, root)
+        return objs[self.rank]
+
+    def allreduce_obj(self, obj: Any, op: Callable[[Any, Any], Any] | None = None) -> Any:
+        """Reduce python objects across processes.
+
+        Default op mirrors the reference's multi-node evaluator usage
+        (``chainermn/evaluators.py`` (dagger)): element-wise sum of numeric
+        values / dicts of numerics.
+        """
+        items = self.allgather_obj(obj)
+        if op is None:
+            op = _default_sum
+        out = items[0]
+        for it in items[1:]:
+            out = op(out, it)
+        return out
+
+
+def _default_sum(a: Any, b: Any) -> Any:
+    if isinstance(a, dict):
+        return {k: _default_sum(a[k], b[k]) for k in a}
+    if isinstance(a, (list, tuple)):
+        return type(a)(_default_sum(x, y) for x, y in zip(a, b))
+    return a + b

@@ -1,0 +1,317 @@
+"""``CommunicatorBase`` — the heart of the framework.
+
+Mirrors the reference's ``chainermn/communicators/communicator_base.py``
+(dagger) API surface (SURVEY.md section 2.1): ``rank / size / intra_rank /
+inter_rank / inter_size``, array collectives, ``*_obj`` object collectives,
+and the model-level ``bcast_data`` / ``allreduce_grad`` pair — but the
+execution model is TPU-native SPMD:
+
+- The *device plane* is a ``jax.sharding.Mesh``. A "rank" of the reference
+  (one MPI process per GPU) corresponds to one mesh slot. Eager array
+  collectives take a **stacked** array whose leading axis enumerates per-rank
+  contributions (shape ``[size, ...]``), shard it over the mesh, and run one
+  jitted XLA collective — semantically identical to "every rank passes its
+  local array", with the stacking making the SPMD single-controller model
+  explicit. Inside a jitted train step, use the named-axis forms
+  (:mod:`chainermn_tpu.parallel.collectives` or ``comm.axis_name`` with
+  ``jax.lax.psum``) instead; that is the hot path.
+
+- The *host plane* is the set of JAX processes; ``*_obj`` collectives ride
+  :mod:`chainermn_tpu.communicators._host_comm` (multihost_utils / native
+  backend) the way the reference's rode mpi4py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.communicators._host_comm import HostComm
+from chainermn_tpu.parallel import collectives
+from chainermn_tpu.parallel.mesh import MeshTopology
+
+PyTree = Any
+
+
+class CommunicatorBase:
+    """Base communicator over a device mesh.
+
+    Subclasses pick the mesh construction (all-devices flat, hierarchical
+    (inter, intra) factorisation, CPU-only, ...) the way the reference's
+    subclasses picked NCCL/MPI compositions.
+    """
+
+    #: name used by :func:`chainermn_tpu.create_communicator`
+    name: str = "base"
+
+    def __init__(self, mesh: Mesh, *, allreduce_grad_dtype=None) -> None:
+        self.mesh = mesh
+        self.topology = MeshTopology(mesh)
+        self.host = HostComm()
+        #: dtype for compressed gradient allreduce
+        #: (reference: ``allreduce_grad_dtype='float16'`` on
+        #: ``PureNcclCommunicator`` (dagger); bf16 is the TPU-native choice).
+        self.allreduce_grad_dtype = (
+            jnp.dtype(allreduce_grad_dtype) if allreduce_grad_dtype else None
+        )
+        self._flat_axes = tuple(mesh.axis_names)
+        self._flat_spec = P(self._flat_axes)
+
+    # ------------------------------------------------------------------
+    # Topology properties (reference: communicator_base.py (dagger))
+    # ------------------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        """World size = number of mesh slots (reference: #MPI processes)."""
+        return self.topology.size
+
+    @property
+    def rank(self) -> int:
+        """Host-plane rank (process index). Inside a jitted program use
+        :func:`chainermn_tpu.parallel.collectives.axis_index` instead — in
+        SPMD one controller drives many mesh slots."""
+        return self.topology.rank
+
+    @property
+    def intra_rank(self) -> int:
+        return self.topology.intra_rank
+
+    @property
+    def intra_size(self) -> int:
+        return self.topology.intra_size
+
+    @property
+    def inter_rank(self) -> int:
+        return self.topology.inter_rank
+
+    @property
+    def inter_size(self) -> int:
+        return self.topology.inter_size
+
+    @property
+    def axis_name(self) -> str:
+        """Primary data-parallel mesh axis for gradient reduction."""
+        return self.mesh.axis_names[0]
+
+    @property
+    def grad_axes(self) -> tuple[str, ...]:
+        """All mesh axes gradients are averaged over. For a hierarchical
+        communicator this is ``('inter', 'intra')`` — XLA performs the
+        2-level reduction the reference hand-built (SURVEY.md section 2.2)."""
+        return self._flat_axes
+
+    # ------------------------------------------------------------------
+    # Eager array collectives over stacked per-rank contributions
+    # ------------------------------------------------------------------
+
+    def _shard_stacked(self, x: jax.Array) -> jax.Array:
+        x = jnp.asarray(x)
+        if x.shape[0] != self.size:
+            raise ValueError(
+                f"stacked collective input must have leading dim == size "
+                f"({self.size}), got shape {x.shape}"
+            )
+        spec = P(self._flat_axes, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+    @functools.cached_property
+    def _jitted(self):
+        """Jitted shard_map'd collective kernels, built once per communicator
+        so jax.jit's trace cache is keyed stably."""
+        mesh, axes = self.mesh, self._flat_axes
+
+        def smap(fn, out_stacked: bool):
+            def wrapper(x, *args):
+                in_spec = P(axes, *([None] * (x.ndim - 1)))
+                out_spec = in_spec if out_stacked else P(None, *([None] * (x.ndim - 1)))
+
+                def body(xs, *a):
+                    # xs: [1, ...] local shard; collapse the stack dim.
+                    return fn(xs[0], *a)[None]
+
+                return shard_map(
+                    body, mesh=mesh, in_specs=(in_spec,) + tuple(P() for _ in args),
+                    out_specs=out_spec,
+                )(x, *args)
+
+            return jax.jit(wrapper, static_argnums=())
+
+        def _reduce(op):
+            def fn(x):
+                return collectives.allreduce(x, axes, op=op)
+            return fn
+
+        return {
+            "sum": smap(_reduce("sum"), out_stacked=False),
+            "mean": smap(_reduce("mean"), out_stacked=False),
+            "max": smap(_reduce("max"), out_stacked=False),
+            "min": smap(_reduce("min"), out_stacked=False),
+        }
+
+    def allreduce(self, x: jax.Array, op: str = "sum") -> jax.Array:
+        """Eager allreduce of stacked per-rank values ``x[size, ...]`` →
+        reduced array ``[...]`` (replicated)."""
+        x = self._shard_stacked(x)
+        out = self._jitted[op](x)
+        return out[0]
+
+    def bcast(self, x: jax.Array, root: int = 0, *, stacked: bool = False) -> jax.Array:
+        """Broadcast ``x`` to a mesh-replicated value (the common
+        "replicate rank-0 data" use). With ``stacked=True``, ``x`` holds
+        per-rank contributions ``[size, ...]`` and ``x[root]`` is broadcast —
+        the eager-parity form the stacked-collective tests use. Explicit flag
+        rather than shape sniffing: a plain batch whose leading dim happens
+        to equal world size must not be silently sliced."""
+        x = jnp.asarray(x)
+        if stacked:
+            if x.ndim < 1 or x.shape[0] != self.size:
+                raise ValueError(
+                    f"stacked bcast input must have leading dim == size "
+                    f"({self.size}), got shape {x.shape}"
+                )
+            x = x[root]
+        return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+    def allgather(self, x: jax.Array) -> jax.Array:
+        """Identity on the stacked representation (every rank gets all
+        contributions), placed replicated — mirrors ``allgather`` semantics."""
+        x = jnp.asarray(x)
+        if x.shape[0] != self.size:
+            raise ValueError("allgather expects stacked [size, ...] input")
+        return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+    def alltoall(self, x: jax.Array) -> jax.Array:
+        """Eager all-to-all on ``x[size, size, ...]`` (rank i's row i is its
+        send buffer): returns the transposed exchange, matching
+        ``MPI_Alltoall`` on the stacked view."""
+        x = jnp.asarray(x)
+        if x.ndim < 2 or x.shape[0] != self.size or x.shape[1] != self.size:
+            raise ValueError("alltoall expects [size, size, ...] input")
+        return jnp.swapaxes(x, 0, 1)
+
+    def scatter(self, x: jax.Array, root: int = 0) -> jax.Array:
+        """Scatter root's ``[size, ...]`` buffer: shard i receives ``x[i]``,
+        returned as the stacked sharded array."""
+        return self._shard_stacked(jnp.asarray(x))
+
+    # ------------------------------------------------------------------
+    # Model-level operations (the reference's hot pair)
+    # ------------------------------------------------------------------
+
+    def bcast_data(self, params: PyTree, root: int = 0) -> PyTree:
+        """Replicate a parameter pytree across the mesh (and across
+        processes when multihost), so all ranks start from rank-``root``'s
+        weights — reference ``bcast_data(model)`` called on the first
+        optimizer update (``optimizers.py`` (dagger))."""
+        if self.host.size > 1:
+            from jax.experimental import multihost_utils
+
+            params = multihost_utils.broadcast_one_to_all(
+                params, is_source=(self.host.rank == root)
+            )
+        repl = NamedSharding(self.mesh, P())
+        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), repl), params)
+
+    def allreduce_grad(self, grads: PyTree, op: str = "mean") -> PyTree:
+        """Eager gradient allreduce of *stacked* per-rank grads
+        (leaves shaped ``[size, ...]``) → averaged pytree ``[...]``.
+
+        This is the eager/debugging form. The production path is in-jit:
+        ``optax``-wrapped via :func:`chainermn_tpu.create_multi_node_optimizer`
+        which lowers to ``lax.pmean(grads, comm.grad_axes)`` inside the train
+        step — XLA fuses the reference's pack → cast → ncclAllReduce → scale →
+        unpack pipeline (``pure_nccl_communicator.py`` (dagger), SURVEY.md
+        section 3.2) into its collective scheduling.
+        """
+        dtype = self.allreduce_grad_dtype
+
+        def reduce_leaf(g):
+            g = jnp.asarray(g)
+            orig = g.dtype
+            if dtype is not None and jnp.issubdtype(orig, jnp.floating):
+                g = g.astype(dtype)
+            out = self.allreduce(g, op=op)
+            return out.astype(orig)
+
+        return jax.tree.map(reduce_leaf, grads)
+
+    # ------------------------------------------------------------------
+    # Host-plane object collectives (reference: *_obj via mpi4py)
+    # ------------------------------------------------------------------
+
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        return self.host.bcast_obj(obj, root)
+
+    def gather_obj(self, obj: Any, root: int = 0):
+        return self.host.gather_obj(obj, root)
+
+    def allgather_obj(self, obj: Any) -> list[Any]:
+        return self.host.allgather_obj(obj)
+
+    def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
+        return self.host.scatter_obj(objs, root)
+
+    def allreduce_obj(self, obj: Any, op: Callable[[Any, Any], Any] | None = None) -> Any:
+        return self.host.allreduce_obj(obj, op)
+
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
+        raise NotImplementedError(
+            "point-to-point host sends need the native TCP backend "
+            "(chainermn_tpu.native) or a multi-process runtime; in-program "
+            "sends live in chainermn_tpu.functions.point_to_point"
+        )
+
+    def recv_obj(self, source: int, tag: int = 0) -> Any:
+        raise NotImplementedError(
+            "see send_obj; use chainermn_tpu.functions for device-plane p2p"
+        )
+
+    def barrier(self) -> None:
+        self.host.barrier()
+
+    # ------------------------------------------------------------------
+    # Sub-communicators (reference: ``split()`` via MPI_Comm_split)
+    # ------------------------------------------------------------------
+
+    def split(self, color: int, key: int = 0) -> "CommunicatorBase":
+        """Group *processes* by ``color`` into sub-communicators (multihost).
+        Single-process: returns self (there is nothing to split at host
+        granularity; use :meth:`sub_communicator` to subset the mesh)."""
+        if self.host.size == 1:
+            return self
+        membership = self.host.allgather_obj((color, key, self.host.rank))
+        mine = sorted(
+            [m for m in membership if m[0] == color], key=lambda m: (m[1], m[2])
+        )
+        ranks = [m[2] for m in mine]
+        devices = [
+            d for d in self.mesh.devices.flat if d.process_index in ranks
+        ]
+        sub_mesh = Mesh(
+            np.array(devices).reshape(len(devices)), (self.axis_name,)
+        )
+        return type(self)(
+            mesh=sub_mesh, allreduce_grad_dtype=self.allreduce_grad_dtype
+        )
+
+    def sub_communicator(self, device_indices: Sequence[int]) -> "CommunicatorBase":
+        """Device-plane split: a communicator over a subset of mesh slots
+        (flat indices). This is how single-controller SPMD expresses the
+        reference's ``split`` in tests."""
+        flat = list(self.mesh.devices.flat)
+        devices = [flat[i] for i in device_indices]
+        sub_mesh = Mesh(np.array(devices).reshape(len(devices)), (self.axis_name,))
+        return CommunicatorBase(sub_mesh, allreduce_grad_dtype=self.allreduce_grad_dtype)
+
+    def __repr__(self) -> str:
+        return (
+            f"<{type(self).__name__} name={self.name!r} size={self.size} "
+            f"axes={dict(self.mesh.shape)} processes={self.host.size}>"
+        )

@@ -1,0 +1,115 @@
+"""Concrete communicators — the TPU-native counterparts of the reference's
+communicator zoo (``chainermn/communicators/*.py`` (dagger), SURVEY.md
+section 2.1).
+
+On GPU the zoo existed because the composition of transports (NCCL vs MPI,
+CUDA-aware or not, intra- vs inter-node) was the user's problem. On TPU, XLA
+owns transport selection: every communicator here lowers to the same XLA
+collectives, and the subclasses differ only in *mesh topology* (flat vs
+hierarchical factorisation) and device selection. The historical names are
+kept as registry aliases so reference users find what they expect
+(``create_communicator('pure_nccl')`` still works and does the right thing).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.parallel.mesh import make_mesh
+
+
+class XlaCommunicator(CommunicatorBase):
+    """The production communicator: one flat ``('data',)`` axis over every
+    device in the pod slice; gradient allreduce lowers to a single
+    ``lax.psum`` over ICI (+DCN when multi-slice). Plays the role of
+    ``PureNcclCommunicator`` (``pure_nccl_communicator.py`` (dagger)) — the
+    communicator the reference's benchmarks name."""
+
+    name = "xla"
+
+    def __init__(
+        self,
+        *,
+        mesh: Mesh | None = None,
+        devices: Sequence[jax.Device] | None = None,
+        axis_name: str = "data",
+        allreduce_grad_dtype=None,
+    ) -> None:
+        if mesh is None:
+            mesh = make_mesh((axis_name,), devices=devices)
+        super().__init__(mesh, allreduce_grad_dtype=allreduce_grad_dtype)
+
+
+class NaiveCommunicator(XlaCommunicator):
+    """CPU-mesh communicator for tests/CI — the role of the reference's
+    ``NaiveCommunicator`` (``naive_communicator.py`` (dagger)): works with no
+    accelerator at all. Uses the host-platform XLA backend, which honours
+    ``--xla_force_host_platform_device_count`` for multi-"rank" testing
+    (SURVEY.md section 4)."""
+
+    name = "naive"
+
+    def __init__(self, **kwargs) -> None:
+        if kwargs.get("mesh") is None and kwargs.get("devices") is None:
+            kwargs["devices"] = jax.devices("cpu")
+        super().__init__(**kwargs)
+
+
+class HierarchicalCommunicator(CommunicatorBase):
+    """Two-level ``('inter', 'intra')`` mesh: ``inter`` spans processes
+    (DCN), ``intra`` spans each process's local devices (ICI). Gradient
+    reduction over both axes reproduces — declaratively — the reference's
+    intra-node-NCCL-then-inter-node-MPI pipeline
+    (``hierarchical_communicator.py`` (dagger),
+    ``two_dimensional_communicator.py`` (dagger)): XLA emits the
+    topology-aware 2-level collective itself."""
+
+    name = "hierarchical"
+
+    def __init__(
+        self,
+        *,
+        mesh: Mesh | None = None,
+        devices: Sequence[jax.Device] | None = None,
+        allreduce_grad_dtype=None,
+    ) -> None:
+        if mesh is None:
+            if devices is None:
+                devices = jax.devices()
+            devices = list(devices)
+            n_proc = jax.process_count()
+            per_proc = len(devices) // max(n_proc, 1)
+            if n_proc > 1 and per_proc * n_proc == len(devices):
+                ordered = sorted(devices, key=lambda d: (d.process_index, d.id))
+                arr = np.array(ordered).reshape(n_proc, per_proc)
+            else:
+                # Single process: degenerate inter axis (the same degeneracy
+                # the reference's single-host MPI tests exercised —
+                # ``inter_size == 1``, SURVEY.md section 4).
+                arr = np.array(devices).reshape(1, len(devices))
+            mesh = Mesh(arr, ("inter", "intra"))
+        super().__init__(mesh, allreduce_grad_dtype=allreduce_grad_dtype)
+
+    @property
+    def axis_name(self) -> str:  # primary axis for data parallelism
+        return "inter"
+
+
+class SingleNodeCommunicator(XlaCommunicator):
+    """Asserts a single process — reference ``single_node_communicator.py``
+    (dagger) asserted ``inter_size == 1`` (NCCL-only, one node)."""
+
+    name = "single_node"
+
+    def __init__(self, **kwargs) -> None:
+        if jax.process_count() != 1:
+            raise ValueError(
+                "SingleNodeCommunicator requires a single-process runtime "
+                "(reference parity: inter_size == 1)"
+            )
+        super().__init__(**kwargs)

@@ -1,0 +1,9 @@
+"""Data layer: dataset scattering and placeholders.
+
+Reference: ``chainermn/datasets/`` (dagger) (SURVEY.md sections 2.6, 3.3).
+"""
+
+from chainermn_tpu.datasets.scatter_dataset import scatter_dataset, SubDataset
+from chainermn_tpu.datasets.empty_dataset import create_empty_dataset
+
+__all__ = ["scatter_dataset", "SubDataset", "create_empty_dataset"]

@@ -1,0 +1,103 @@
+"""Dataset scattering by index arithmetic.
+
+Reference: ``chainermn/datasets/scatter_dataset.py`` (dagger) (SURVEY.md
+sections 2.6, 3.3): rank 0 permutes indices with a seed, slices into
+``comm.size`` near-equal contiguous chunks, and *pickles each rank's
+SubDataset over MPI*.
+
+TPU-native design (SURVEY.md section 3.3 "TPU mapping"): **no data moves at
+all.** Every process computes its own ``(begin, end)`` slice of the same
+seeded permutation from ``comm.rank``; only the seed needs agreement, done
+with one tiny ``bcast_obj`` when the caller doesn't fix it. The result is
+bit-identical to the reference's scatter (same permutation, same chunking)
+without serialising the dataset.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+
+class SubDataset:
+    """A view of ``dataset`` restricted to ``indices`` — the role of
+    Chainer's ``SubDataset`` that the reference scattered to each rank."""
+
+    def __init__(self, dataset: Sequence[Any], indices: np.ndarray) -> None:
+        self._dataset = dataset
+        self.indices = np.asarray(indices)
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._dataset[int(j)] for j in self.indices[i]]
+        return self._dataset[int(self.indices[i])]
+
+    def __iter__(self):
+        for j in self.indices:
+            yield self._dataset[int(j)]
+
+
+def _shard_bounds(n: int, size: int, rank: int) -> tuple[int, int]:
+    """Near-equal contiguous chunking, first ``n % size`` shards one longer —
+    the reference's balance-within-plus-minus-1 invariant (SURVEY.md
+    section 4, test_scatter_dataset)."""
+    base, rem = divmod(n, size)
+    begin = rank * base + min(rank, rem)
+    end = begin + base + (1 if rank < rem else 0)
+    return begin, end
+
+
+def scatter_dataset(
+    dataset: Sequence[Any],
+    comm: CommunicatorBase,
+    *,
+    shuffle: bool = False,
+    seed: Optional[int] = None,
+    root: int = 0,
+    force_equal_length: bool = False,
+    rank: Optional[int] = None,
+    size: Optional[int] = None,
+) -> SubDataset:
+    """Return this rank's shard of ``dataset``.
+
+    Args:
+      shuffle, seed: seeded global permutation before chunking (all ranks
+        derive the same permutation; if ``seed`` is None it is chosen on
+        ``root`` and broadcast — the only communication this function does).
+      force_equal_length: pad short shards by wrapping (keeps per-step batch
+        shapes static across ranks — on TPU this also avoids recompilation).
+      rank/size: override the sharding granularity; defaults to the host
+        plane (``comm.rank``/``comm.host.size``), since in SPMD one process
+        loads data for all its local devices and the mesh shards the batch.
+    """
+    n = len(dataset)
+    size = comm.host.size if size is None else size
+    rank = comm.rank if rank is None else rank
+
+    if shuffle:
+        if seed is None:
+            seed = int(np.random.randint(0, 2**31 - 1)) if comm.rank == root else 0
+            seed = comm.bcast_obj(seed, root)
+        order = np.random.RandomState(seed).permutation(n)
+    else:
+        order = np.arange(n)
+
+    begin, end = _shard_bounds(n, size, rank)
+    indices = order[begin:end]
+    if force_equal_length and n > 0:
+        target = -(-n // size)  # ceil
+        if len(indices) == 0:
+            # More ranks than examples: wrap around the global order so the
+            # shard still yields `target` items (static batch shapes — no
+            # rank may come up empty or collectives hang / recompile).
+            indices = order[(begin + np.arange(target)) % n]
+        elif len(indices) < target:
+            reps = -(-target // len(indices))
+            indices = np.tile(indices, reps)[:target]
+    return SubDataset(dataset, indices)

@@ -1,0 +1,21 @@
+"""Trainer extensions: evaluation, checkpointing, persistent-value sync.
+
+Reference: ``chainermn/extensions/`` (dagger) + ``chainermn/evaluators.py``
+(dagger) (SURVEY.md section 2.7).
+"""
+
+from chainermn_tpu.extensions.evaluator import create_multi_node_evaluator
+from chainermn_tpu.extensions.checkpoint import (
+    create_multi_node_checkpointer,
+    MultiNodeCheckpointer,
+)
+from chainermn_tpu.extensions.allreduce_persistent import AllreducePersistent
+from chainermn_tpu.extensions.observation_aggregator import ObservationAggregator
+
+__all__ = [
+    "create_multi_node_evaluator",
+    "create_multi_node_checkpointer",
+    "MultiNodeCheckpointer",
+    "AllreducePersistent",
+    "ObservationAggregator",
+]

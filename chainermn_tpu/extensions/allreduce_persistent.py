@@ -1,0 +1,43 @@
+"""Allreduce of persistent (non-gradient) values.
+
+Reference: ``chainermn/extensions/allreduce_persistent.py`` (dagger)
+(location approximate; SURVEY.md section 2.7): averages persistent values
+such as BatchNorm running statistics across ranks so that evaluation is
+consistent no matter which rank's copy is used.
+
+TPU-native: persistent state (e.g. flax ``batch_stats``) lives in the train
+state pytree. When batch statistics are computed under data-parallel
+``shard_map`` with :class:`~chainermn_tpu.links.MultiNodeBatchNormalization`
+they are already identical on every shard; this extension covers the plain-BN
+case and cross-process drift.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+PyTree = Any
+
+
+class AllreducePersistent:
+    """Callable extension: average a pytree of persistent values across the
+    host plane (and replicate on the mesh)."""
+
+    def __init__(self, communicator: CommunicatorBase) -> None:
+        self.comm = communicator
+
+    def __call__(self, persistent: PyTree) -> PyTree:
+        host = self.comm.host
+        if host.size > 1:
+            import numpy as np
+
+            leaves, treedef = jax.tree.flatten(persistent)
+            as_np = [np.asarray(x) for x in leaves]
+            summed = host.allreduce_obj(as_np)
+            leaves = [s / host.size for s in summed]
+            persistent = jax.tree.unflatten(treedef, leaves)
+        return self.comm.bcast_data(persistent)

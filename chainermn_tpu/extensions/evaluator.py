@@ -1,0 +1,44 @@
+"""Multi-node evaluator.
+
+Reference: ``chainermn/evaluators.py`` (dagger) (SURVEY.md section 2.7):
+wraps a Chainer Evaluator so each rank evaluates its dataset shard, then the
+observation dict is ``allreduce_obj``-ed and divided by world size —
+globally averaged metrics, identical to whole-dataset eval.
+
+TPU-native: the evaluator wraps any callable returning a metrics dict
+(values: scalars or 0-d arrays). Device-plane averaging happens inside the
+caller's jitted eval step (psum over the mesh); this wrapper adds the
+host-plane (cross-process) averaging and weighting by example count.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+
+def create_multi_node_evaluator(
+    evaluator: Callable[..., Mapping[str, Any]],
+    communicator: CommunicatorBase,
+):
+    """Wrap ``evaluator`` (any callable returning ``{name: scalar}``) so its
+    results are averaged across processes.
+
+    If the returned dict contains the key ``'n'`` (local example count), a
+    weighted average is computed; otherwise a plain mean over ranks —
+    matching the reference's divide-by-size behaviour.
+    """
+
+    def evaluate(*args, **kwargs) -> dict[str, float]:
+        local = dict(evaluator(*args, **kwargs))
+        n = float(local.pop("n", 1.0))
+        weighted = {k: float(v) * n for k, v in local.items()}
+        weighted["__n"] = n
+        total = communicator.allreduce_obj(weighted)
+        n_total = total.pop("__n")
+        return {k: v / n_total for k, v in total.items()}
+
+    return evaluate

@@ -1,0 +1,30 @@
+"""Cross-rank aggregation of training observations (metrics).
+
+Reference: upstream's ``ObservationAggregator`` extension (presence in the
+fork uncertain — SURVEY.md section 5 "Metrics / logging"): averages the
+reporter's observation dict across ranks each reporting interval so rank-0
+logs global, not local, statistics.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+
+class ObservationAggregator:
+    """Average numeric observations across processes.
+
+    Device-plane metrics inside a jitted step should use ``lax.pmean``
+    directly; this aggregator handles host-side dicts (loss running means,
+    timing counters) before rank-0 logging.
+    """
+
+    def __init__(self, communicator: CommunicatorBase) -> None:
+        self.comm = communicator
+
+    def __call__(self, observation: Mapping[str, float]) -> dict[str, float]:
+        obs = {k: float(v) for k, v in observation.items()}
+        total = self.comm.allreduce_obj(obs)
+        return {k: v / self.comm.host.size for k, v in total.items()}

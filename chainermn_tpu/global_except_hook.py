@@ -1,0 +1,66 @@
+"""Global exception hook: one crashed process kills the whole job.
+
+Reference: ``chainermn/global_except_hook.py`` (dagger) (SURVEY.md sections
+2.7, 5): installs a ``sys.excepthook`` that prints the traceback and calls
+``MPI_Abort(MPI_COMM_WORLD)`` so a single rank's Python exception tears the
+job down instead of leaving the other ranks hung inside a collective.
+
+TPU-native: the JAX distributed runtime's coordinator already propagates
+process death; the remaining gap is *prompt* teardown when Python raises
+outside any JAX call. The hook prints a rank-tagged traceback, attempts a
+clean ``jax.distributed.shutdown()``, then hard-exits so the coordinator
+declares this process dead and peers abort their pending collectives.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+_hook_installed = False
+
+
+def _global_except_hook(exctype, value, tb) -> None:
+    try:
+        rank = None
+        try:
+            import jax
+
+            rank = jax.process_index()
+            nprocs = jax.process_count()
+        except Exception:
+            nprocs = None
+        sys.stderr.write("\n*****************************************************\n")
+        if rank is not None:
+            sys.stderr.write(
+                f"chainermn_tpu: uncaught exception on process {rank}"
+                + (f"/{nprocs}" if nprocs else "")
+                + "\n"
+            )
+        traceback.print_exception(exctype, value, tb)
+        sys.stderr.write("*****************************************************\n\n")
+        sys.stderr.flush()
+        if nprocs is not None and nprocs > 1:
+            try:
+                import jax
+
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            # Hard exit: the coordinator notices the death and peers abort
+            # (the reference's MPI_Abort equivalent).
+            os._exit(1)
+    except Exception:
+        # The hook itself must never mask the original error.
+        sys.__excepthook__(exctype, value, tb)
+
+
+def _add_hook() -> None:
+    """Install the hook (idempotent). Named after the reference's private
+    installer; examples call this right after creating a communicator."""
+    global _hook_installed
+    if _hook_installed:
+        return
+    sys.excepthook = _global_except_hook
+    _hook_installed = True

@@ -1,0 +1,131 @@
+"""Multi-node iterators.
+
+Reference: ``chainermn/iterators/`` (dagger) (SURVEY.md section 2.6):
+``create_multi_node_iterator`` — a master rank iterates the real dataset and
+broadcasts each batch (input replication for model-parallel ranks); plus a
+synchronized-shuffle iterator where all ranks draw the same order.
+
+TPU-native: batches are numpy on the host until the jitted step; broadcast is
+a host-plane ``bcast_obj`` (single-process: passthrough). The synchronized
+iterator needs no communication at all — a shared seed yields the same
+permutation on every process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+
+class _BatchIterator:
+    """Minimal epoch-aware batch iterator (the role Chainer's
+    ``SerialIterator`` played under the reference's wrappers)."""
+
+    def __init__(
+        self,
+        dataset: Sequence[Any],
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ) -> None:
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self._rng = np.random.RandomState(seed)
+        self._order = self._new_order()
+        self._pos = 0
+
+    def _new_order(self) -> np.ndarray:
+        n = len(self.dataset)
+        return self._rng.permutation(n) if self.shuffle else np.arange(n)
+
+    def __iter__(self) -> Iterator[list]:
+        return self
+
+    def __next__(self) -> list:
+        n = len(self.dataset)
+        if self._pos >= n or (self.drop_last and self._pos + self.batch_size > n):
+            self.epoch += 1
+            self._order = self._new_order()
+            self._pos = 0
+            raise StopIteration
+        idx = self._order[self._pos : self._pos + self.batch_size]
+        self._pos += len(idx)
+        return [self.dataset[int(i)] for i in idx]
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+def create_multi_node_iterator(
+    dataset: Sequence[Any],
+    batch_size: int,
+    comm: CommunicatorBase,
+    *,
+    rank_master: int = 0,
+    shuffle: bool = True,
+    seed: int = 0,
+) -> Iterable[list]:
+    """Master-broadcast iterator: ``rank_master`` draws batches, every rank
+    receives identical batches (model-parallel input replication —
+    reference ``create_multi_node_iterator``)."""
+    if comm.host.size == 1:
+        return _BatchIterator(dataset, batch_size, shuffle=shuffle, seed=seed)
+    return _MasterBroadcastIterator(
+        dataset, batch_size, comm, rank_master, shuffle, seed
+    )
+
+
+class _MasterBroadcastIterator:
+    def __init__(self, dataset, batch_size, comm, rank_master, shuffle, seed):
+        self.comm = comm
+        self.rank_master = rank_master
+        self._inner = (
+            _BatchIterator(dataset, batch_size, shuffle=shuffle, seed=seed)
+            if comm.rank == rank_master
+            else None
+        )
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.comm.rank == self.rank_master:
+            try:
+                batch = next(self._inner)
+                payload = ("batch", batch)
+            except StopIteration:
+                payload = ("stop", None)
+            payload = self.comm.bcast_obj(payload, self.rank_master)
+        else:
+            payload = self.comm.bcast_obj(None, self.rank_master)
+        kind, batch = payload
+        if kind == "stop":
+            raise StopIteration
+        return batch
+
+    @property
+    def epoch(self):
+        return self._inner.epoch if self._inner is not None else None
+
+
+def create_synchronized_iterator(
+    dataset: Sequence[Any],
+    batch_size: int,
+    comm: CommunicatorBase,
+    *,
+    seed: int = 0,
+    shuffle: bool = True,
+) -> Iterable[list]:
+    """Synchronized-shuffle iterator: every rank draws the *same* order from
+    a shared seed — zero communication (the TPU-native version of the
+    reference's synchronized iterator variant)."""
+    del comm  # same seed on every process — nothing to exchange
+    return _BatchIterator(dataset, batch_size, shuffle=shuffle, seed=seed)

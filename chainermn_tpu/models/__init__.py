@@ -1,0 +1,7 @@
+"""Model zoo matching the reference's example models (SURVEY.md section 2.8):
+MNIST MLP, ImageNet family (AlexNet / GoogLeNet / ResNet-50), seq2seq LSTM —
+plus the Transformer LM the benchmark configs add (BASELINE.json)."""
+
+from chainermn_tpu.models.mlp import MLP
+
+__all__ = ["MLP"]

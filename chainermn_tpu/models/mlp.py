@@ -1,0 +1,24 @@
+"""3-layer MLP — the reference's canonical MNIST smoke-test model
+(``examples/mnist/train_mnist.py`` (dagger), SURVEY.md section 2.8)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """``n_units`` hidden x2 + ``n_out`` head, ReLU — same shape as the
+    reference's MNIST MLP."""
+
+    n_units: int = 1000
+    n_out: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        return nn.Dense(self.n_out)(x)

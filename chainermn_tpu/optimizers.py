@@ -1,0 +1,201 @@
+"""Multi-node optimizer wrappers.
+
+Reference: ``chainermn/optimizers.py`` (dagger) (SURVEY.md sections 2.3, 3.2):
+``create_multi_node_optimizer(opt, comm, double_buffering=False)`` wraps any
+Chainer optimizer so that ``update()`` broadcasts weights on the first
+iteration and allreduces gradients on every iteration;
+``_DoubleBufferingOptimizer`` overlaps the allreduce with backward on a side
+CUDA stream at the cost of one step of gradient staleness.
+
+TPU-native design: the wrapped object is an :class:`optax.GradientTransformation`
+meant to be used *inside the jitted train step*. ``allreduce_grad`` is a
+``lax.pmean`` over the communicator's mesh axes — XLA fuses the reference's
+pack / fp16-cast / ncclAllReduce / scale / unpack pipeline
+(``pure_nccl_communicator.py`` (dagger)) into its collective schedule, and its
+latency-hiding scheduler overlaps the collective with remaining backward
+computation, which is what double buffering bought on GPU. The
+``double_buffering=True`` flag is still honoured with *faithful semantics*
+(updates apply the previous step's reduced gradients, staleness 1) so
+convergence behaviour matches the reference feature; on TPU it additionally
+lets XLA start the psum of step *t* while step *t*'s weights update with
+*t-1*'s gradients.
+
+Weight broadcast on first iteration: in the functional JAX world parameters
+are created once and replicated by :meth:`CommunicatorBase.bcast_data`; call
+``optimizer.broadcast(params)`` (or rely on identical PRNG keys) instead of a
+hidden first-update hook.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+PyTree = Any
+
+
+def _pmean_if_in_axis(tree: PyTree, axis_names) -> PyTree:
+    """pmean over ``axis_names`` when tracing inside that named-axis context
+    (shard_map/pmap); identity otherwise (pjit auto-parallel mode, where XLA
+    inserts the reduction from sharding propagation, or single-device)."""
+    try:
+        return lax.pmean(tree, axis_names)
+    except NameError:
+        return tree
+
+
+def allreduce_gradients(
+    grads: PyTree,
+    comm: Optional[CommunicatorBase] = None,
+    *,
+    axis_names=None,
+    compress_dtype=None,
+) -> PyTree:
+    """In-jit gradient averaging — the hot collective of the framework.
+
+    With ``compress_dtype`` (e.g. ``jnp.bfloat16``) gradients are cast before
+    the collective and restored after: the reference's
+    ``allreduce_grad_dtype='float16'`` compressed allreduce
+    (``pure_nccl_communicator.py`` (dagger), shu65's v1.3 feature) — halves
+    bytes on ICI/DCN; master accumulation stays f32.
+    """
+    if axis_names is None:
+        if comm is None:
+            raise ValueError("pass a communicator or axis_names")
+        axis_names = comm.grad_axes
+        if compress_dtype is None:
+            compress_dtype = comm.allreduce_grad_dtype
+
+    def reduce_leaf(g):
+        if compress_dtype is not None and jnp.issubdtype(g.dtype, jnp.floating):
+            return _pmean_if_in_axis(g.astype(compress_dtype), axis_names).astype(
+                g.dtype
+            )
+        return _pmean_if_in_axis(g, axis_names)
+
+    return jax.tree.map(reduce_leaf, grads)
+
+
+def allreduce_grads_transform(
+    comm: CommunicatorBase, *, compress_dtype=None
+) -> optax.GradientTransformation:
+    """Standalone optax transform performing the gradient allreduce; compose
+    it manually as ``optax.chain(allreduce_grads_transform(comm), inner)`` if
+    you don't want the full wrapper."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        return (
+            allreduce_gradients(updates, comm, compress_dtype=compress_dtype),
+            state,
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class _DoubleBufferState(NamedTuple):
+    inner: Any
+    #: gradients reduced at step t-1, applied at step t (staleness 1)
+    communicated_grads: PyTree
+    step: jax.Array
+
+
+class MultiNodeOptimizer:
+    """optax-compatible wrapper: ``init``/``update`` plus communicator-aware
+    gradient reduction. Duck-types :class:`optax.GradientTransformation`.
+
+    Reference behaviours preserved (``optimizers.py`` (dagger)):
+      - every update averages gradients across all ranks before applying;
+      - ``double_buffering=True`` applies the *previous* iteration's averaged
+        gradients (staleness-1) — tested for exactly that semantic;
+      - attribute delegation: unknown attributes forward to the wrapped
+        optimizer (the reference delegated via ``__getattr__``).
+    """
+
+    def __init__(
+        self,
+        actual_optimizer: optax.GradientTransformation,
+        communicator: CommunicatorBase,
+        *,
+        double_buffering: bool = False,
+        compress_dtype=None,
+    ) -> None:
+        self.actual_optimizer = actual_optimizer
+        self.communicator = communicator
+        self.double_buffering = double_buffering
+        self.compress_dtype = (
+            compress_dtype
+            if compress_dtype is not None
+            else communicator.allreduce_grad_dtype
+        )
+
+    # -- optax protocol ----------------------------------------------------
+
+    def init(self, params: PyTree):
+        inner = self.actual_optimizer.init(params)
+        if not self.double_buffering:
+            return inner
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return _DoubleBufferState(
+            inner=inner, communicated_grads=zeros, step=jnp.zeros((), jnp.int32)
+        )
+
+    def update(self, grads: PyTree, state, params: PyTree | None = None):
+        reduced = allreduce_gradients(
+            grads, self.communicator, compress_dtype=self.compress_dtype
+        )
+        if not self.double_buffering:
+            return self.actual_optimizer.update(reduced, state, params)
+
+        # Apply last step's reduced grads; bank this step's. XLA is free to
+        # overlap the psum producing `reduced` with the inner-optimizer math
+        # consuming `state.communicated_grads` — the dependency graph is
+        # exactly the reference's two-buffer/side-stream overlap.
+        updates, inner = self.actual_optimizer.update(
+            state.communicated_grads, state.inner, params
+        )
+        new_state = _DoubleBufferState(
+            inner=inner, communicated_grads=reduced, step=state.step + 1
+        )
+        return updates, new_state
+
+    # -- reference-parity conveniences ------------------------------------
+
+    def broadcast(self, params: PyTree, root: int = 0) -> PyTree:
+        """The reference's first-update ``bcast_data(model)``, made explicit."""
+        return self.communicator.bcast_data(params, root)
+
+    def __getattr__(self, item):
+        # Guard against re-entry during unpickling/copy, when __dict__ is
+        # not yet populated and 'actual_optimizer' itself is being looked up.
+        if item.startswith("__") or "actual_optimizer" not in self.__dict__:
+            raise AttributeError(item)
+        return getattr(self.actual_optimizer, item)
+
+
+def create_multi_node_optimizer(
+    actual_optimizer: optax.GradientTransformation,
+    communicator: CommunicatorBase,
+    *,
+    double_buffering: bool = False,
+    allreduce_grad_dtype=None,
+) -> MultiNodeOptimizer:
+    """Factory mirroring the reference signature
+    (``create_multi_node_optimizer(opt, comm, double_buffering)``,
+    ``optimizers.py`` (dagger))."""
+    return MultiNodeOptimizer(
+        actual_optimizer,
+        communicator,
+        double_buffering=double_buffering,
+        compress_dtype=allreduce_grad_dtype,
+    )

@@ -1,0 +1,20 @@
+"""Mesh topology and in-program collective primitives.
+
+This package is the TPU-native replacement for the reference's L0-L2 layers
+(native NCCL binding + ``_communication_utility.py`` (dagger) +
+``_memory_utility.py`` (dagger), see SURVEY.md section 1): instead of
+bootstrapping NCCL rings over MPI and packing gradients into flat device
+buffers by hand, we build a ``jax.sharding.Mesh`` over the pod slice and let
+XLA lower named-axis collectives onto ICI/DCN. Flat-buffer packing is
+deliberately absent — XLA fuses the pack/cast/scale/unpack pipeline that the
+reference implemented manually (SURVEY.md section 3.2 TPU mapping).
+"""
+
+from chainermn_tpu.parallel.mesh import (
+    MeshTopology,
+    make_mesh,
+    best_mesh_shape,
+)
+from chainermn_tpu.parallel import collectives
+
+__all__ = ["MeshTopology", "make_mesh", "best_mesh_shape", "collectives"]

@@ -1,0 +1,156 @@
+"""In-program (jit-traceable) named-axis collectives.
+
+TPU-native replacement for the hot paths of the reference's communicator
+implementations (``pure_nccl_communicator.py`` (dagger),
+``mpi_communicator_base.py`` (dagger) — SURVEY.md section 2.1): every function
+here is meant to be called *inside* ``jax.jit`` within a ``shard_map`` (or
+``pmap``-style) named-axis context, and lowers to a single XLA collective that
+rides ICI/DCN. Sum/mean/max reductions map to what ``ncclAllReduce`` did;
+``bcast``/``gather``/``scatter`` are built from ``psum``/``all_gather``/
+``axis_index`` with the same root semantics the MPI versions had.
+
+All of these are differentiable: JAX already knows the transposes of
+``psum``/``all_gather``/``ppermute``/``all_to_all``, which is exactly the
+collective/transpose pairing the reference hand-implemented as Chainer
+Functions (``functions/collective_communication.py`` (dagger), SURVEY.md
+section 2.4). The user-facing differentiable wrappers live in
+:mod:`chainermn_tpu.functions`; this module is the primitive layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def axis_index(axis_name: str):
+    """This shard's index along ``axis_name`` (the in-program rank)."""
+    return lax.axis_index(axis_name)
+
+
+def axis_size_of(axis_name: str) -> int:
+    """Static size of ``axis_name`` (the in-program world size)."""
+    return lax.axis_size(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Reductions (the reference's allreduce family)
+# ---------------------------------------------------------------------------
+
+def allreduce(x: PyTree, axis_name: str, op: str = "sum") -> PyTree:
+    """Allreduce over a mesh axis. ``op`` in {'sum', 'mean', 'max', 'min'}.
+
+    Replaces ``MpiCommunicatorBase.allreduce`` / ``ncclAllReduce``
+    (``pure_nccl_communicator.py`` (dagger)).
+    """
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduction op: {op!r}")
+
+
+def reduce_scatter(x: jax.Array, axis_name: str, *, scatter_dimension: int = 0,
+                   tiled: bool = True) -> jax.Array:
+    """psum_scatter: the building block of the reference's two-dimensional
+    communicator (intra ``ncclReduceScatter``, ``two_dimensional_communicator.py``
+    (dagger))."""
+    return lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+    )
+
+
+# ---------------------------------------------------------------------------
+# Rooted collectives
+# ---------------------------------------------------------------------------
+
+def bcast(x: PyTree, axis_name: str, root: int = 0) -> PyTree:
+    """Broadcast ``root``'s value of ``x`` to every shard along ``axis_name``.
+
+    Implemented as mask-then-psum — one XLA collective, no host round-trip
+    (vs the reference's ``MPI_Bcast`` / ``ncclBcast``).
+    """
+    idx = lax.axis_index(axis_name)
+    take = (idx == root)
+
+    def _mask(leaf):
+        return jnp.where(take, leaf, jnp.zeros_like(leaf))
+
+    return lax.psum(jax.tree.map(_mask, x), axis_name)
+
+
+def gather(x: jax.Array, axis_name: str, root: int = 0,
+           *, axis: int = 0, tiled: bool = False) -> jax.Array:
+    """Gather shards to ``root``. SPMD has no true single-rank ownership, so
+    every shard materialises the gathered value but only ``root``'s copy is
+    meaningful (others receive zeros, keeping the transpose well-defined).
+
+    Mirrors ``MpiCommunicatorBase.gather`` semantics at the program level.
+    """
+    full = lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    idx = lax.axis_index(axis_name)
+    return jnp.where(idx == root, full, jnp.zeros_like(full))
+
+
+def allgather(x: jax.Array, axis_name: str, *, axis: int = 0,
+              tiled: bool = False) -> jax.Array:
+    """``ncclAllGather`` equivalent (``mpi_communicator_base.py`` (dagger))."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def scatter(x: jax.Array, axis_name: str, root: int = 0,
+            *, axis: int = 0) -> jax.Array:
+    """Scatter ``root``'s leading-``axis`` slices across the axis group.
+
+    Every shard holds the full input (SPMD); shard ``i`` keeps slice ``i`` of
+    *root's* copy. Broadcast-from-root first so non-root inputs are ignored,
+    matching MPI_Scatter semantics.
+    """
+    x = bcast(x, axis_name, root)
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    if x.shape[axis] % n != 0:
+        raise ValueError(
+            f"scatter: dimension {axis} of size {x.shape[axis]} not divisible "
+            f"by axis {axis_name!r} size {n}"
+        )
+    chunk = x.shape[axis] // n
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Permutation / all-to-all (model- and sequence-parallel plumbing)
+# ---------------------------------------------------------------------------
+
+def ppermute(x: PyTree, axis_name: str, perm) -> PyTree:
+    """Point-to-point pairwise sends: the substrate for differentiable
+    send/recv (``functions/point_to_point_communication.py`` (dagger) maps
+    here, see chainermn_tpu.functions.point_to_point)."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def alltoall(x: jax.Array, axis_name: str, *, split_axis: int = 0,
+             concat_axis: int = 0, tiled: bool = True) -> jax.Array:
+    """``MPI_Alltoall`` equivalent; also the Ulysses sequence-parallel
+    head<->sequence reshard primitive (SURVEY.md section 5)."""
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=tiled,
+    )
+
+
+def shift(x: PyTree, axis_name: str, offset: int = 1) -> PyTree:
+    """Rotate values around the axis ring by ``offset`` (ring-attention KV
+    rotation step). Positive offset sends shard i's value to shard i+offset."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)

@@ -1,0 +1,149 @@
+"""Device-mesh construction and pod-slice topology discovery.
+
+TPU-native replacement for the reference's rank-topology bootstrap
+(``chainermn/communicators/_communication_utility.py`` (dagger):
+``init_ranks`` / ``init_intra_mpi_comm`` / ``init_inter_mpi_comm`` /
+``init_nccl_comm``, SURVEY.md section 2.1). There, intra/inter-node rank
+discovery ran ``MPI_Comm_split_type(SHARED)`` and NCCL rings were initialised
+by broadcasting a unique id over MPI. Here the JAX runtime already knows the
+slice topology: ``jax.devices()`` carries coords, ``jax.process_index()``
+plays the role of the MPI rank, and collective routing over ICI vs DCN is
+decided by XLA from the mesh axes. ``intra``/``inter`` axes of the reference's
+hierarchical communicators map onto a factorised ``(dcn, ici)`` mesh
+(SURVEY.md section 5, "Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def best_mesh_shape(n: int, ndims: int = 2) -> tuple[int, ...]:
+    """Factor ``n`` devices into an ``ndims``-dim near-square mesh shape.
+
+    Prefers the most balanced factorisation with the larger factor first,
+    e.g. 8 -> (4, 2), 16 -> (4, 4), 6 -> (3, 2), primes -> (n, 1).
+    """
+    if ndims == 1:
+        return (n,)
+    if ndims != 2:
+        raise NotImplementedError("only 1- or 2-dim auto shapes supported")
+    best = (n, 1)
+    for a in range(2, int(math.isqrt(n)) + 1):
+        if n % a == 0:
+            best = (n // a, a)
+    return best
+
+
+def _device_array(devices: Sequence[jax.Device], shape: tuple[int, ...]) -> np.ndarray:
+    """Arrange devices into ``shape``, ICI-topology-aware when possible.
+
+    ``mesh_utils.create_device_mesh`` understands TPU coords and lays the mesh
+    out so that neighbouring mesh indices are ICI neighbours; it refuses
+    non-TPU platforms' odd shapes sometimes, so fall back to a plain reshape
+    (fine for CPU test meshes — there is no topology to exploit).
+    """
+    devices = list(devices)
+    try:
+        return mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError, NotImplementedError):
+        return np.array(devices).reshape(shape)
+
+
+def make_mesh(
+    axis_names: Sequence[str] = ("data",),
+    shape: Sequence[int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Create a :class:`jax.sharding.Mesh` over ``devices``.
+
+    Args:
+      axis_names: mesh axis names, e.g. ``('data',)`` or ``('data', 'model')``.
+      shape: per-axis sizes; if ``None``, all devices go on the first axis and
+        remaining axes get size 1 (or a balanced 2-d factorisation if exactly
+        two axes are requested with no shape).
+      devices: device list; defaults to ``jax.devices()``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    axis_names = tuple(axis_names)
+    if shape is None:
+        if len(axis_names) == 1:
+            shape = (n,)
+        else:
+            shape = best_mesh_shape(n, 2) + (1,) * (len(axis_names) - 2)
+    shape = tuple(shape)
+    if math.prod(shape) != n:
+        raise ValueError(
+            f"mesh shape {shape} does not cover {n} devices; "
+            f"pass an explicit `devices` list or fix `shape`"
+        )
+    return Mesh(_device_array(devices, shape), axis_names)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTopology:
+    """Rank-topology view of a mesh, mirroring the reference communicator's
+    ``rank/size/intra_rank/inter_rank/inter_size`` surface
+    (``communicator_base.py`` (dagger) properties, SURVEY.md section 2.1).
+
+    On TPU the "node" boundary of the reference (NVLink island / MPI host)
+    maps to the *process* boundary: devices local to this process are the
+    intra group (ICI-attached, addressable without DCN), processes are the
+    inter group. For a single-process CPU/test mesh every device is intra.
+    """
+
+    mesh: Mesh
+
+    @property
+    def size(self) -> int:
+        """Total number of devices in the mesh (the reference's world size —
+        one process per GPU there, one mesh slot per chip here)."""
+        return self.mesh.devices.size
+
+    @property
+    def rank(self) -> int:
+        """Host-plane rank: ``jax.process_index()``."""
+        return jax.process_index()
+
+    @property
+    def inter_size(self) -> int:
+        """Number of processes (the reference's number of nodes)."""
+        return jax.process_count()
+
+    @property
+    def inter_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def intra_size(self) -> int:
+        """Devices managed by this process (the reference's GPUs per node)."""
+        return jax.local_device_count()
+
+    @property
+    def intra_rank(self) -> int:
+        """Index of this process's slot within its node group.
+
+        The reference's intra_rank distinguishes processes sharing a host;
+        with one process per host (the JAX norm) this is always 0. When
+        multiple processes share a host (multi-process CPU testing), fall
+        back to position among local processes — approximated as 0 because
+        JAX does not expose a host-local process index.
+        """
+        return 0
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    def axis_size(self, axis_name: str) -> int:
+        return self.mesh.shape[axis_name]

@@ -1,0 +1,13 @@
+"""Training integration: jitted SPMD train-step builder and a minimal trainer.
+
+The reference embedded into Chainer's Trainer/Updater (SURVEY.md section 3.2);
+this framework owns its loop. The heart is :func:`make_train_step`: ONE jitted
+function per iteration — forward, backward, gradient psum over the mesh, and
+the optimizer update — which is the TPU mapping of the reference's whole
+``_MultiNodeOptimizer.update`` hot path.
+"""
+
+from chainermn_tpu.training.train_step import TrainState, make_train_step, make_eval_step
+from chainermn_tpu.training.trainer import Trainer
+
+__all__ = ["TrainState", "make_train_step", "make_eval_step", "Trainer"]

@@ -1,0 +1,188 @@
+"""The jitted SPMD train step.
+
+TPU mapping of the reference's hot loop (SURVEY.md section 3.2): where
+ChainerMN ran eager backward, then packed gradients into a flat buffer,
+``ncclAllReduce``-d it, scaled and unpacked (``pure_nccl_communicator.py``
+(dagger)), here the *entire iteration* — forward, backward, gradient pmean
+over the mesh, optimizer update — is one ``jax.jit`` program: XLA fuses the
+packing/scaling away and overlaps the collective with remaining backward
+compute (its latency-hiding scheduler provides what double buffering bought
+on GPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.optimizers import MultiNodeOptimizer, allreduce_gradients
+
+PyTree = Any
+
+
+def _arity(fn: Callable) -> int:
+    """Number of positional parameters ``fn`` accepts (inf if *args)."""
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return 2
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            n += 1
+        elif p.kind == p.VAR_POSITIONAL:
+            return 99
+    return n
+
+
+class TrainState(NamedTuple):
+    """Replicated training state. ``model_state`` carries non-gradient
+    collections (e.g. BatchNorm running stats — the values the reference's
+    ``AllreducePersistent`` synchronized)."""
+
+    params: PyTree
+    opt_state: Any
+    step: jax.Array
+    model_state: PyTree = ()
+
+
+def create_train_state(
+    params: PyTree,
+    optimizer,
+    comm: Optional[CommunicatorBase] = None,
+    *,
+    model_state: PyTree = (),
+) -> TrainState:
+    """Initialise (and replicate, when a communicator is given) the state —
+    the explicit version of the reference's first-update ``bcast_data``."""
+    if comm is not None:
+        params = comm.bcast_data(params)
+        if jax.tree.leaves(model_state):
+            model_state = comm.bcast_data(model_state)
+    return TrainState(
+        params=params,
+        opt_state=optimizer.init(params),
+        step=jnp.zeros((), jnp.int32),
+        model_state=model_state,
+    )
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer,
+    comm: CommunicatorBase,
+    *,
+    axis_name: Optional[str] = None,
+    batch_spec: P | None = None,
+    donate: bool = True,
+):
+    """Build the jitted data-parallel train step.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch, model_state) -> (loss, (metrics_dict,
+        new_model_state))`` or ``loss_fn(params, batch) -> loss``. The loss
+        must be the *local-batch mean*; cross-shard averaging is applied by
+        the step (gradient pmean — the reference's ``allreduce_grad``).
+      optimizer: a :class:`MultiNodeOptimizer` (does its own reduction,
+        honouring compression/double-buffering) or any plain optax transform
+        (the step then reduces gradients itself).
+      batch_spec: PartitionSpec for every batch leaf; defaults to sharding
+        the leading dim over the communicator's grad axes.
+
+    Returns:
+      ``step(state, batch) -> (state, metrics)``, jitted over ``comm.mesh``.
+    """
+    mesh = comm.mesh
+    axes = axis_name if axis_name is not None else comm.grad_axes
+    if batch_spec is None:
+        batch_spec = P(axes)
+    reduce_in_step = not isinstance(optimizer, MultiNodeOptimizer)
+
+    takes_model_state = _arity(loss_fn) >= 3
+
+    def _loss_with_aux(params, batch, model_state):
+        if takes_model_state:
+            out = loss_fn(params, batch, model_state)
+        else:
+            out = loss_fn(params, batch)
+        if isinstance(out, tuple):
+            loss, aux = out
+            if isinstance(aux, tuple) and len(aux) == 2:
+                metrics, new_model_state = aux
+            else:
+                metrics, new_model_state = aux, model_state
+        else:
+            loss, metrics, new_model_state = out, {}, model_state
+        return loss, (metrics, new_model_state)
+
+    def local_step(state: TrainState, batch):
+        grad_fn = jax.value_and_grad(_loss_with_aux, has_aux=True)
+        (loss, (metrics, model_state)), grads = grad_fn(
+            state.params, batch, state.model_state
+        )
+        if reduce_in_step:
+            grads = allreduce_gradients(grads, comm)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **metrics}
+        metrics = lax.pmean(metrics, axes)
+        # model_state (e.g. BN stats) must not drift across shards:
+        model_state = lax.pmean(model_state, axes)
+        new_state = TrainState(
+            params=params,
+            opt_state=opt_state,
+            step=state.step + 1,
+            model_state=model_state,
+        )
+        return new_state, metrics
+
+    sharded = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), batch_spec),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    metric_fn: Callable,
+    comm: CommunicatorBase,
+    *,
+    batch_spec: P | None = None,
+):
+    """Jitted eval step: ``metric_fn(params, batch, model_state) -> dict`` of
+    local-batch-mean metrics, pmean-ed over the mesh (device plane of the
+    reference's multi-node evaluator)."""
+    mesh = comm.mesh
+    axes = comm.grad_axes
+    if batch_spec is None:
+        batch_spec = P(axes)
+
+    takes_model_state = _arity(metric_fn) >= 3
+
+    def local(params, batch, model_state):
+        if takes_model_state:
+            metrics = metric_fn(params, batch, model_state)
+        else:
+            metrics = metric_fn(params, batch)
+        return lax.pmean(metrics, axes)
+
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), batch_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

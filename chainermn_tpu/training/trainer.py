@@ -1,0 +1,111 @@
+"""Minimal trainer loop with rank-0 reporting and extension triggers.
+
+The reference rode Chainer's ``Trainer``/``Updater``/``Extension`` machinery
+(external to it); a standalone framework needs its own loop. Reporting
+follows the reference's observability pattern exactly (SURVEY.md section 5):
+**gate reporter output on rank 0** (``comm.rank == 0`` in every example
+(dagger)), aggregate metrics across processes before logging.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import numpy as np
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+PyTree = Any
+
+
+def default_collate(batch: list) -> Any:
+    """list of examples -> stacked numpy pytree. Examples may be tuples
+    (``(x, y)``), dicts, or plain arrays."""
+    first = batch[0]
+    if isinstance(first, tuple):
+        return tuple(np.stack([b[i] for b in batch]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: np.stack([b[k] for b in batch]) for k in first}
+    return np.stack(batch)
+
+
+class Trainer:
+    """Drive ``step_fn`` over an iterator with periodic extensions.
+
+    Extensions are callables ``ext(trainer) -> None`` registered with an
+    iteration interval — the shape of Chainer's extension protocol, enough
+    to host the multi-node evaluator and checkpointer (SURVEY.md section 2.7).
+    """
+
+    def __init__(
+        self,
+        step_fn: Callable,
+        state: Any,
+        train_iter: Iterable,
+        comm: CommunicatorBase,
+        *,
+        collate: Callable = default_collate,
+        log_interval: int = 100,
+        out=sys.stdout,
+    ) -> None:
+        self.step_fn = step_fn
+        self.state = state
+        self.train_iter = train_iter
+        self.comm = comm
+        self.collate = collate
+        self.log_interval = log_interval
+        self.out = out
+        self.iteration = 0
+        self.observation: dict[str, float] = {}
+        self._extensions: list[tuple[int, Callable]] = []
+
+    def extend(self, extension: Callable, *, interval: int = 1) -> None:
+        self._extensions.append((interval, extension))
+
+    # ------------------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        if self.comm.rank == 0:
+            print(msg, file=self.out, flush=True)
+
+    def run(self, max_iterations: int) -> Any:
+        t0 = time.perf_counter()
+        it = iter(self.train_iter)
+        fresh_epoch = True
+        while self.iteration < max_iterations:
+            try:
+                batch = next(it)
+                fresh_epoch = False
+            except StopIteration:
+                if fresh_epoch:
+                    raise RuntimeError(
+                        "train iterator yielded no batches in a full epoch "
+                        "(dataset shard smaller than batch size with "
+                        "drop_last?) — aborting instead of spinning"
+                    )
+                it = iter(self.train_iter)
+                fresh_epoch = True
+                continue
+            self.state, metrics = self.step_fn(self.state, self.collate(batch))
+            self.iteration += 1
+
+            if self.iteration % self.log_interval == 0 or self.iteration == max_iterations:
+                host_metrics = {
+                    k: float(jax.device_get(v)) for k, v in metrics.items()
+                }
+                self.observation = host_metrics
+                dt = time.perf_counter() - t0
+                rate = self.iteration / dt
+                pretty = " ".join(f"{k}={v:.4f}" for k, v in host_metrics.items())
+                self._log(
+                    f"iter {self.iteration}/{max_iterations} {pretty} "
+                    f"({rate:.1f} it/s)"
+                )
+
+            for interval, ext in self._extensions:
+                if self.iteration % interval == 0:
+                    ext(self)
+        return self.state

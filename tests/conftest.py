@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+The reference simulated "multi-node" with N MPI processes on one host
+(``mpiexec -n 2 pytest ...``, SURVEY.md section 4). The TPU-native analog is
+a single process with N virtual host-platform devices: set
+``--xla_force_host_platform_device_count=8`` *before* JAX initialises, and
+build meshes from ``jax.devices('cpu')`` (NaiveCommunicator does this) so
+tests are hermetic on any machine, TPU present or not.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "conftest must set device count before jax import"
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def comm():
+    """The canonical 8-slot test communicator (CPU mesh)."""
+    from chainermn_tpu import create_communicator
+
+    return create_communicator("naive")

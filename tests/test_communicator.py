@@ -1,0 +1,181 @@
+"""Communicator tests — the TPU analog of the reference's big parameterized
+matrix (``tests/communicator_tests/test_communicator.py`` (dagger), SURVEY.md
+section 4): every communicator x {collectives over arrays and pytrees,
+bcast_data, allreduce_grad with mixed dtypes / stacked shapes}, with the core
+invariant *distributed result == single-process result*.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu import create_communicator
+from chainermn_tpu.communicators import (
+    HierarchicalCommunicator,
+    NaiveCommunicator,
+    XlaCommunicator,
+)
+
+N = 8
+
+
+def _make(name):
+    # Pin every communicator to the virtual CPU devices for hermeticity.
+    return create_communicator(name, devices=jax.devices("cpu")[:N])
+
+
+ALL_NAMES = [
+    "xla",
+    "naive",
+    "flat",
+    "pure_nccl",
+    "hierarchical",
+    "two_dimensional",
+    "non_cuda_aware",
+    "single_node",
+]
+
+
+@pytest.mark.parametrize("name", ALL_NAMES)
+def test_factory_and_topology(name):
+    comm = _make(name)
+    assert comm.size == N
+    assert comm.rank == 0
+    assert comm.inter_size == 1  # single process, like the reference's CI
+    assert comm.intra_size >= 1
+    if name in ("hierarchical", "two_dimensional", "non_cuda_aware"):
+        assert isinstance(comm, HierarchicalCommunicator)
+        assert comm.mesh.shape["inter"] == 1
+        assert comm.mesh.shape["intra"] == N
+
+
+def test_factory_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown communicator"):
+        create_communicator("mpi")
+
+
+@pytest.mark.parametrize("name", ["naive", "hierarchical"])
+@pytest.mark.parametrize("op", ["sum", "mean", "max", "min"])
+def test_allreduce_matches_numpy(name, op):
+    comm = _make(name)
+    rng = np.random.RandomState(0)
+    x = rng.randn(N, 3, 5).astype(np.float32)
+    got = np.asarray(comm.allreduce(x, op=op))
+    want = {
+        "sum": x.sum(0),
+        "mean": x.mean(0),
+        "max": x.max(0),
+        "min": x.min(0),
+    }[op]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_bcast_picks_root_when_stacked(comm):
+    x = np.arange(N * 4, dtype=np.float32).reshape(N, 4)
+    out = np.asarray(comm.bcast(x, root=3, stacked=True))
+    np.testing.assert_array_equal(out, x[3])
+
+
+def test_bcast_plain_array_not_sliced(comm):
+    # A batch whose leading dim happens to equal world size must be
+    # replicated whole, never silently sliced to one row.
+    x = np.arange(N * 4, dtype=np.float32).reshape(N, 4)
+    out = comm.bcast(x)
+    assert out.shape == (N, 4)
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_bcast_stacked_shape_mismatch_raises(comm):
+    with pytest.raises(ValueError, match="leading dim"):
+        comm.bcast(np.zeros((3, 2), np.float32), stacked=True)
+
+
+def test_allreduce_grad_preserves_int_leaves():
+    comm2 = create_communicator("naive", allreduce_grad_dtype="bfloat16")
+    # int leaf must not round-trip through bf16 (1000 would lose bits)
+    g = {"count": np.full((N, 1), 1000, np.int32)}
+    out = np.asarray(comm2.allreduce_grad(g, op="sum")["count"])
+    assert out.dtype == np.int32
+    assert int(out[0]) == 8000
+
+
+def test_allgather_roundtrip(comm):
+    x = np.random.RandomState(1).randn(N, 2).astype(np.float32)
+    out = np.asarray(comm.allgather(x))
+    np.testing.assert_array_equal(out, x)
+
+
+def test_alltoall_transposes(comm):
+    x = np.arange(N * N * 2, dtype=np.float32).reshape(N, N, 2)
+    out = np.asarray(comm.alltoall(x))
+    np.testing.assert_array_equal(out, np.swapaxes(x, 0, 1))
+
+
+def test_scatter_shards_leading_dim(comm):
+    x = np.arange(N * 3, dtype=np.float32).reshape(N, 3)
+    out = comm.scatter(x)
+    # each mesh slot owns one row
+    assert out.sharding.num_devices == N if hasattr(out.sharding, "num_devices") else True
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_allreduce_grad_pytree_mean(comm):
+    rng = np.random.RandomState(2)
+    grads = {
+        "w": rng.randn(N, 4, 3).astype(np.float32),
+        "b": rng.randn(N, 3).astype(np.float32),
+    }
+    out = comm.allreduce_grad(grads)
+    np.testing.assert_allclose(np.asarray(out["w"]), grads["w"].mean(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["b"]), grads["b"].mean(0), rtol=1e-5)
+
+
+def test_allreduce_grad_bf16_compression():
+    comm = create_communicator(
+        "naive", allreduce_grad_dtype="bfloat16"
+    )
+    rng = np.random.RandomState(3)
+    g = rng.randn(N, 16).astype(np.float32)
+    out = np.asarray(comm.allreduce_grad({"g": g})["g"])
+    assert out.dtype == np.float32  # restored to master dtype
+    np.testing.assert_allclose(out, g.mean(0), rtol=2e-2, atol=2e-2)
+
+
+def test_bcast_data_replicates(comm):
+    params = {"w": np.ones((4, 4), np.float32), "b": np.zeros((4,), np.float32)}
+    out = comm.bcast_data(params)
+    for leaf in jax.tree.leaves(out):
+        assert leaf.sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(out["w"]), params["w"])
+
+
+def test_obj_collectives_single_process(comm):
+    assert comm.bcast_obj({"a": 1}) == {"a": 1}
+    assert comm.allgather_obj(5) == [5]
+    assert comm.gather_obj(7, root=0) == [7]
+    assert comm.allreduce_obj({"loss": 2.0}) == {"loss": 2.0}
+    assert comm.scatter_obj([42]) == 42
+    comm.barrier()
+
+
+def test_sub_communicator(comm):
+    sub = comm.sub_communicator(range(4))
+    assert sub.size == 4
+    x = np.arange(4 * 2, dtype=np.float32).reshape(4, 2)
+    np.testing.assert_allclose(np.asarray(sub.allreduce(x, "mean")), x.mean(0))
+
+
+def test_split_single_process_returns_self(comm):
+    assert comm.split(color=0) is comm
+
+
+def test_stacked_shape_mismatch_raises(comm):
+    with pytest.raises(ValueError, match="leading dim"):
+        comm.allreduce(np.zeros((3, 2), np.float32))
+
+
+def test_grad_axes_names():
+    assert _make("xla").grad_axes == ("data",)
+    assert _make("hierarchical").grad_axes == ("inter", "intra")
+    assert _make("hierarchical").axis_name == "inter"

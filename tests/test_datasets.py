@@ -1,0 +1,122 @@
+"""Data-layer tests — analog of ``tests/dataset_tests/test_scatter_dataset.py``
+(dagger) (SURVEY.md section 4): union of shards == original set, balance
+within +-1, same shuffle given same seed; empty dataset; iterators.
+"""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu import (
+    create_communicator,
+    create_empty_dataset,
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+    scatter_dataset,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+@pytest.mark.parametrize("n", [100, 101, 7, 8])
+@pytest.mark.parametrize("size", [8, 3])
+def test_scatter_union_and_balance(comm, n, size):
+    data = list(range(n))
+    shards = [
+        scatter_dataset(data, comm, rank=r, size=size) for r in range(size)
+    ]
+    lengths = [len(s) for s in shards]
+    assert max(lengths) - min(lengths) <= 1
+    union = sorted(x for s in shards for x in s)
+    assert union == data
+
+
+def test_scatter_shuffle_deterministic(comm):
+    data = list(range(50))
+    a = scatter_dataset(data, comm, shuffle=True, seed=42, rank=2, size=8)
+    b = scatter_dataset(data, comm, shuffle=True, seed=42, rank=2, size=8)
+    assert list(a) == list(b)
+    c = scatter_dataset(data, comm, shuffle=True, seed=43, rank=2, size=8)
+    assert list(a) != list(c)  # overwhelmingly likely
+
+
+def test_scatter_shuffle_partitions(comm):
+    data = list(range(64))
+    shards = [
+        scatter_dataset(data, comm, shuffle=True, seed=7, rank=r, size=8)
+        for r in range(8)
+    ]
+    union = sorted(x for s in shards for x in s)
+    assert union == data
+
+
+def test_scatter_force_equal_length(comm):
+    data = list(range(10))
+    shards = [
+        scatter_dataset(data, comm, rank=r, size=4, force_equal_length=True)
+        for r in range(4)
+    ]
+    assert all(len(s) == 3 for s in shards)
+    # every original element still appears somewhere
+    union = set(x for s in shards for x in s)
+    assert union == set(data)
+
+
+def test_scatter_force_equal_length_more_ranks_than_data(comm):
+    data = list(range(2))
+    shards = [
+        scatter_dataset(data, comm, rank=r, size=4, force_equal_length=True)
+        for r in range(4)
+    ]
+    assert [len(s) for s in shards] == [1, 1, 1, 1]  # no empty shard
+
+
+def test_subdataset_indexing(comm):
+    data = [10 * i for i in range(20)]
+    s = scatter_dataset(data, comm, rank=0, size=2)
+    assert s[0] == 0 and s[1] == 10
+    assert s[0:3] == [0, 10, 20]
+    assert len(s) == 10
+
+
+def test_empty_dataset():
+    base = list(range(17))
+    e = create_empty_dataset(base)
+    assert len(e) == 17
+    assert e[0] is None and e[16] is None
+    assert all(x is None for x in e)
+    with pytest.raises(IndexError):
+        e[17]
+    assert e[2:5] == [None, None, None]
+
+
+def test_multi_node_iterator_single_process(comm):
+    data = list(range(32))
+    it = create_multi_node_iterator(data, 8, comm, shuffle=False)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0] == [0, 1, 2, 3, 4, 5, 6, 7]
+    # second epoch restarts
+    batches2 = list(it)
+    assert len(batches2) == 4
+
+
+def test_synchronized_iterator_same_order(comm):
+    data = list(range(40))
+    a = list(create_synchronized_iterator(data, 10, comm, seed=5))
+    b = list(create_synchronized_iterator(data, 10, comm, seed=5))
+    assert a == b
+    assert len(a) == 4
+
+
+def test_iterator_epoch_counting(comm):
+    data = list(range(10))
+    it = create_multi_node_iterator(data, 4, comm, shuffle=True, seed=1)
+    for _ in it:
+        pass
+    assert it.epoch == 1
+    for _ in it:
+        pass
+    assert it.epoch == 2

@@ -1,0 +1,109 @@
+"""Extension tests — analogs of ``tests/extension_tests/test_checkpoint.py``
+(dagger) and the evaluator tests (SURVEY.md section 4): save/GC/resume
+round-trip; evaluator averages metrics; persistent-value allreduce.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu import (
+    create_communicator,
+    create_multi_node_checkpointer,
+    create_multi_node_evaluator,
+)
+from chainermn_tpu.extensions import AllreducePersistent, ObservationAggregator
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+def test_evaluator_passthrough_single_process(comm):
+    ev = create_multi_node_evaluator(lambda: {"acc": 0.5, "loss": 2.0}, comm)
+    out = ev()
+    assert out == {"acc": 0.5, "loss": 2.0}
+
+
+def test_evaluator_weighted_by_n(comm):
+    ev = create_multi_node_evaluator(lambda: {"acc": 0.25, "n": 4}, comm)
+    assert ev() == {"acc": 0.25}
+
+
+def test_checkpointer_roundtrip(tmp_path, comm):
+    ckpt = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+    state = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.int32(7)}
+    ckpt.save(state, iteration=100)
+
+    template = {"w": jnp.zeros((2, 3)), "step": jnp.int32(0)}
+    restored, it = ckpt.maybe_load(template)
+    assert it == 100
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(6.0).reshape(2, 3))
+    assert int(restored["step"]) == 7
+
+
+def test_checkpointer_no_snapshot_returns_template(tmp_path, comm):
+    ckpt = create_multi_node_checkpointer("fresh", comm, path=str(tmp_path))
+    template = {"x": jnp.zeros(3)}
+    restored, it = ckpt.maybe_load(template)
+    assert it is None
+    assert restored is template
+
+
+def test_checkpointer_gc_keeps_newest(tmp_path, comm):
+    ckpt = create_multi_node_checkpointer("gc", comm, path=str(tmp_path), keep=2)
+    state = {"x": jnp.zeros(2)}
+    for it in [1, 2, 3, 4, 5]:
+        ckpt.save(state, iteration=it)
+    files = sorted(os.listdir(tmp_path))
+    assert files == ["snapshot_gc_0_4.npz", "snapshot_gc_0_5.npz"]
+    _, it = ckpt.maybe_load(state)
+    assert it == 5
+
+
+def test_checkpointer_resumes_max_common(tmp_path, comm):
+    ckpt = create_multi_node_checkpointer("agree", comm, path=str(tmp_path), keep=10)
+    state = {"x": jnp.ones(2)}
+    ckpt.save(state, 10)
+    ckpt.save(state, 20)
+    _, it = ckpt.maybe_load(state)
+    assert it == 20  # newest common (single process: newest local)
+
+
+def test_checkpointer_cleanup(tmp_path, comm):
+    ckpt = create_multi_node_checkpointer("clean", comm, path=str(tmp_path))
+    ckpt.save({"x": jnp.zeros(1)}, 1)
+    ckpt.cleanup()
+    assert os.listdir(tmp_path) == []
+
+
+def test_allreduce_persistent_replicates(comm):
+    ext = AllreducePersistent(comm)
+    stats = {"mean": np.ones((4,), np.float32), "var": np.full((4,), 2.0, np.float32)}
+    out = ext(stats)
+    np.testing.assert_allclose(np.asarray(out["mean"]), stats["mean"])
+    assert out["mean"].sharding.is_fully_replicated
+
+
+def test_observation_aggregator(comm):
+    agg = ObservationAggregator(comm)
+    assert agg({"loss": 1.5}) == {"loss": 1.5}
+
+
+def test_global_except_hook_installs():
+    import sys
+
+    from chainermn_tpu import global_except_hook
+
+    old = sys.excepthook
+    try:
+        global_except_hook._add_hook()
+        assert sys.excepthook is global_except_hook._global_except_hook
+        global_except_hook._add_hook()  # idempotent
+        assert sys.excepthook is global_except_hook._global_except_hook
+    finally:
+        sys.excepthook = old
+        global_except_hook._hook_installed = False

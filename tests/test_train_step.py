@@ -1,0 +1,158 @@
+"""Train-step/trainer tests: the core reference invariant — distributed
+training result == single-process result on the concatenated batch
+(SURVEY.md section 4, "Key invariant tested everywhere")."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from chainermn_tpu import create_communicator, create_multi_node_optimizer
+from chainermn_tpu.training import Trainer, make_eval_step, make_train_step
+from chainermn_tpu.training.train_step import create_train_state
+from chainermn_tpu.training.trainer import default_collate
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+def _linreg_loss(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    loss = jnp.mean((pred - y) ** 2)
+    return loss, {"mse": loss}
+
+
+def _data(n=64, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.randn(n).astype(np.float32)
+    return x, y
+
+
+def test_distributed_step_equals_single_device(comm):
+    x, y = _data()
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    state = create_train_state(params, opt, comm)
+    step = make_train_step(_linreg_loss, opt, comm)
+
+    new_state, metrics = step(state, (x, y))
+
+    # single-device reference on the full batch
+    ref_opt = optax.sgd(0.1)
+    (loss, _), grads = jax.value_and_grad(_linreg_loss, has_aux=True)(
+        params, (jnp.asarray(x), jnp.asarray(y))
+    )
+    upd, _ = ref_opt.update(grads, ref_opt.init(params), params)
+    ref_params = optax.apply_updates(params, upd)
+
+    np.testing.assert_allclose(
+        np.asarray(new_state.params["w"]), np.asarray(ref_params["w"]), rtol=1e-4
+    )
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss), rtol=1e-4)
+    assert int(new_state.step) == 1
+
+
+def test_multi_step_convergence(comm):
+    x, y = _data(n=256)
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    opt = create_multi_node_optimizer(optax.adam(0.05), comm)
+    state = create_train_state(params, opt, comm)
+    step = make_train_step(_linreg_loss, opt, comm)
+    for _ in range(100):
+        state, metrics = step(state, (x, y))
+    assert float(metrics["loss"]) < 1e-2
+
+
+def test_eval_step_matches_full_batch(comm):
+    x, y = _data()
+    params = {"w": jnp.ones(4), "b": jnp.zeros(())}
+
+    def metric_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"] + params["b"]
+        return {"mse": jnp.mean((pred - y) ** 2)}
+
+    ev = make_eval_step(metric_fn, comm)
+    out = ev(params, (x, y), ())
+    want = float(np.mean((x @ np.ones(4) - y) ** 2))
+    np.testing.assert_allclose(float(out["mse"]), want, rtol=1e-5)
+
+
+def test_trainer_runs_and_logs(comm):
+    x, y = _data(n=128)
+    data = [(x[i], y[i]) for i in range(len(x))]
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    state = create_train_state(params, opt, comm)
+    step = make_train_step(_linreg_loss, opt, comm)
+
+    class _Iter:
+        def __iter__(self):
+            for i in range(0, 128, 32):
+                yield data[i : i + 32]
+
+    buf = io.StringIO()
+    calls = []
+    trainer = Trainer(step, state, _Iter(), comm, log_interval=2, out=buf)
+    trainer.extend(lambda tr: calls.append(tr.iteration), interval=3)
+    final = trainer.run(6)
+    assert int(final.step) == 6
+    assert calls == [3, 6]
+    logged = buf.getvalue()
+    assert "iter 2/6" in logged and "loss=" in logged
+
+
+def test_trainer_raises_on_empty_epoch(comm):
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    state = create_train_state(params, opt, comm)
+    step = make_train_step(_linreg_loss, opt, comm)
+
+    class _Empty:
+        def __iter__(self):
+            return iter([])
+
+    trainer = Trainer(step, state, _Empty(), comm, out=io.StringIO())
+    with pytest.raises(RuntimeError, match="no batches"):
+        trainer.run(5)
+
+
+def test_optimizer_survives_pickle_roundtrip(comm):
+    import pickle
+
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    # __getattr__ must not recurse during copy/pickle protocol probing
+    import copy
+
+    c = copy.copy(opt)
+    assert c.actual_optimizer is opt.actual_optimizer
+    with pytest.raises(AttributeError):
+        opt.__getstate_nonexistent__
+
+
+def test_default_collate():
+    batch = [(np.zeros(3), np.int32(1)), (np.ones(3), np.int32(2))]
+    x, y = default_collate(batch)
+    assert x.shape == (2, 3) and y.shape == (2,)
+    d = default_collate([{"a": np.zeros(2)}, {"a": np.ones(2)}])
+    assert d["a"].shape == (2, 2)
+    arr = default_collate([np.zeros(4), np.zeros(4)])
+    assert arr.shape == (2, 4)
+
+
+def test_mnist_example_runs():
+    import examples.mnist.train_mnist as ex
+
+    final = ex.main(["--communicator", "naive", "--iterations", "20",
+                     "--batchsize", "64"])
+    assert "val_acc" in final and final["val_acc"] > 0.3
