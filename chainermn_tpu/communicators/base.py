@@ -177,6 +177,14 @@ class CommunicatorBase:
                     f"({self.size}), got shape {x.shape}"
                 )
             x = x[root]
+        if self.host.size > 1:
+            # Cross-process agreement: every process must end up with the
+            # *root process's* value, not its own local one.
+            from jax.experimental import multihost_utils
+
+            x = multihost_utils.broadcast_one_to_all(
+                x, is_source=(self.host.rank == root)
+            )
         return jax.device_put(x, NamedSharding(self.mesh, P()))
 
     def allgather(self, x: jax.Array) -> jax.Array:
@@ -198,8 +206,16 @@ class CommunicatorBase:
 
     def scatter(self, x: jax.Array, root: int = 0) -> jax.Array:
         """Scatter root's ``[size, ...]`` buffer: shard i receives ``x[i]``,
-        returned as the stacked sharded array."""
-        return self._shard_stacked(jnp.asarray(x))
+        returned as the stacked sharded array. Multihost: the root process's
+        buffer is broadcast first so every process shards the same data."""
+        x = jnp.asarray(x)
+        if self.host.size > 1:
+            from jax.experimental import multihost_utils
+
+            x = multihost_utils.broadcast_one_to_all(
+                x, is_source=(self.host.rank == root)
+            )
+        return self._shard_stacked(x)
 
     # ------------------------------------------------------------------
     # Model-level operations (the reference's hot pair)
@@ -281,24 +297,23 @@ class CommunicatorBase:
     # ------------------------------------------------------------------
 
     def split(self, color: int, key: int = 0) -> "CommunicatorBase":
-        """Group *processes* by ``color`` into sub-communicators (multihost).
-        Single-process: returns self (there is nothing to split at host
-        granularity; use :meth:`sub_communicator` to subset the mesh)."""
+        """Group *processes* by ``color`` into sub-communicators (reference:
+        ``split()`` via ``MPI_Comm_split``). Single-process: returns self
+        (there is nothing to split at host granularity; use
+        :meth:`sub_communicator` to subset the mesh).
+
+        Multihost subgroup communicators are not yet supported: every
+        host-plane collective here rides globally-collective
+        ``multihost_utils`` calls, so two color groups issuing independent
+        operations would deadlock. Subgroup host collectives arrive with the
+        native TCP backend (``chainermn_tpu.native``)."""
+        del key
         if self.host.size == 1:
             return self
-        membership = self.host.allgather_obj((color, key, self.host.rank))
-        mine = sorted(
-            [m for m in membership if m[0] == color], key=lambda m: (m[1], m[2])
-        )
-        ranks = [m[2] for m in mine]
-        devices = [
-            d for d in self.mesh.devices.flat if d.process_index in ranks
-        ]
-        sub_mesh = Mesh(
-            np.array(devices).reshape(len(devices)), (self.axis_name,)
-        )
-        return type(self)(
-            mesh=sub_mesh, allreduce_grad_dtype=self.allreduce_grad_dtype
+        raise NotImplementedError(
+            "multihost split() needs per-group host collectives "
+            "(chainermn_tpu.native); device-plane subsets are available via "
+            "sub_communicator()"
         )
 
     def sub_communicator(self, device_indices: Sequence[int]) -> "CommunicatorBase":

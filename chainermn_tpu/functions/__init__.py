@@ -1,0 +1,34 @@
+"""Differentiable cross-rank communication functions.
+
+Reference: ``chainermn/functions/`` (dagger) (SURVEY.md section 2.4) — the
+layer that lets the autograd graph span ranks, enabling model/pipeline
+parallelism.
+"""
+
+from chainermn_tpu.functions.point_to_point import (
+    send_recv,
+    send,
+    recv,
+    pseudo_connect,
+)
+from chainermn_tpu.functions.collective import (
+    allgather,
+    alltoall,
+    bcast,
+    gather,
+    scatter,
+    allreduce,
+)
+
+__all__ = [
+    "send_recv",
+    "send",
+    "recv",
+    "pseudo_connect",
+    "allgather",
+    "alltoall",
+    "bcast",
+    "gather",
+    "scatter",
+    "allreduce",
+]

@@ -1,0 +1,65 @@
+"""Differentiable collective communication functions.
+
+Reference: ``chainermn/functions/collective_communication.py`` (dagger)
+(SURVEY.md section 2.4): Chainer Functions pairing each collective with its
+transpose — allgather/bwd:alltoall-sum, alltoall/bwd:alltoall, bcast/bwd:
+gather+sum-on-root, gather/bwd:scatter, scatter/bwd:gather.
+
+TPU-native: each is a thin wrapper over the named-axis primitives in
+:mod:`chainermn_tpu.parallel.collectives`; JAX's AD already knows the
+transpose of every XLA collective, so the reference's hand-written backward
+pairs hold here *by construction* (and are asserted in
+``tests/test_functions.py`` numerically).
+
+All functions must be called inside a ``shard_map``/named-axis context over
+``axis_name``. They accept either an axis name or a communicator.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.parallel import collectives as C
+
+
+def _axis(comm_or_axis: Union[str, CommunicatorBase]) -> str:
+    if isinstance(comm_or_axis, str):
+        return comm_or_axis
+    return comm_or_axis.axis_name
+
+
+def allgather(x, comm_or_axis, *, axis: int = 0, tiled: bool = False):
+    """Differentiable allgather (backward: reduce-scatter of cotangents —
+    the reference's alltoall-sum)."""
+    return C.allgather(x, _axis(comm_or_axis), axis=axis, tiled=tiled)
+
+
+def alltoall(x, comm_or_axis, *, split_axis: int = 0, concat_axis: int = 0,
+             tiled: bool = True):
+    """Differentiable all-to-all (self-transpose under AD)."""
+    return C.alltoall(
+        x, _axis(comm_or_axis), split_axis=split_axis,
+        concat_axis=concat_axis, tiled=tiled,
+    )
+
+
+def bcast(x, comm_or_axis, root: int = 0):
+    """Differentiable broadcast from ``root`` (backward: cotangents sum onto
+    root — the reference's gather+sum)."""
+    return C.bcast(x, _axis(comm_or_axis), root=root)
+
+
+def gather(x, comm_or_axis, root: int = 0, *, axis: int = 0):
+    """Differentiable gather to ``root`` (backward: scatter)."""
+    return C.gather(x, _axis(comm_or_axis), root=root, axis=axis)
+
+
+def scatter(x, comm_or_axis, root: int = 0, *, axis: int = 0):
+    """Differentiable scatter from ``root`` (backward: gather)."""
+    return C.scatter(x, _axis(comm_or_axis), root=root, axis=axis)
+
+
+def allreduce(x, comm_or_axis, *, op: str = "sum"):
+    """Differentiable allreduce (psum's transpose is psum)."""
+    return C.allreduce(x, _axis(comm_or_axis), op=op)

@@ -1,0 +1,94 @@
+"""Differentiable point-to-point communication.
+
+Reference: ``chainermn/functions/point_to_point_communication.py`` (dagger)
+(SURVEY.md sections 2.4, 3.4): Chainer ``Send``/``Recv`` Functions whose
+backward passes are each other (``Send.backward`` receives the upstream
+gradient over MPI; ``Recv.backward`` sends it), plus *delegate variables* and
+``pseudo_connect`` imposing a total order on transfers so bidirectional
+graphs cannot deadlock MPI.
+
+TPU-native: inside a ``shard_map`` over a stage/model axis, a matched
+send+recv pair is ONE ``lax.ppermute`` — XLA compiles and schedules the
+transfer, and its transpose (the backward) is the inverse permutation,
+automatically. Two whole classes of reference machinery therefore vanish:
+  * deadlock ordering (XLA schedules all collectives in one program — the
+    hazard the delegate-variable discipline existed for);
+  * explicit backward implementations (ppermute is linear; AD transposes it).
+``pseudo_connect`` survives as a graph-shaping helper: grafting a delegate
+onto real variables so a stage with no local loss still contributes its
+communication edges to the backward program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def send_recv(x: PyTree, src: int, dst: int, axis_name: str) -> PyTree:
+    """Transfer ``x`` from shard ``src`` to shard ``dst`` along ``axis_name``.
+
+    Every shard participates (SPMD); the return value is ``src``'s ``x`` on
+    shard ``dst`` and **zeros elsewhere**. Differentiable: the cotangent
+    flows from ``dst`` back to ``src`` — exactly the reference's
+    ``Send.backward == recv`` / ``Recv.backward == send`` duality, for free.
+    """
+    return lax.ppermute(x, axis_name, [(src, dst)])
+
+
+def send(x: PyTree, dst: int, axis_name: str, *, src: Optional[int] = None):
+    """Reference-shaped ``send``: returns a zero-size *delegate* tying the
+    transfer into the caller's graph (thread it into a later
+    :func:`pseudo_connect` or ``recv`` just like the reference's delegate
+    variables — here it shapes the autodiff graph rather than preventing
+    MPI deadlock).
+
+    ``src`` is required: SPMD traces ONE program for every shard, so there is
+    no implicit "my rank" at trace time — the (src, dst) pair must be static.
+    (The reference inferred src from the calling process's MPI rank; that
+    notion does not exist under a single controller.)
+    """
+    if src is None:
+        raise ValueError(
+            "SPMD send needs the static source index: send(x, dst, axis, src=i) "
+            "(one program runs on every shard; there is no implicit 'my rank' "
+            "at trace time)"
+        )
+    received = send_recv(x, src, dst, axis_name)
+    delegate = jax.tree.map(lambda r: jnp.sum(r) * 0.0, received)
+    return received, delegate
+
+
+def recv(received: PyTree, *, delegate: Optional[PyTree] = None) -> PyTree:
+    """Reference-shaped ``recv``: unwraps a transfer produced by
+    :func:`send`/:func:`send_recv`, optionally grafting a ``delegate`` from a
+    previous transfer (the reference's ``recv(..., delegate_variable=phi)``
+    ordering idiom)."""
+    if delegate is not None:
+        received = pseudo_connect(delegate, received)
+    return received
+
+
+def pseudo_connect(delegate: PyTree, actual: PyTree) -> PyTree:
+    """Graft ``delegate``'s graph edges onto ``actual``.
+
+    Reference (``pseudo_connect`` (dagger)): ensures backward on a rank whose
+    loss does not depend on a transfer still executes that transfer's
+    backward, and in order. Here: adds a zero term built from ``delegate`` to
+    every leaf of ``actual`` so autodiff keeps the delegate's communication
+    edges in the backward program (value is unchanged).
+    """
+    zeros = [jnp.sum(leaf) * 0.0 for leaf in jax.tree.leaves(delegate)]
+    if not zeros:
+        return actual
+    z = sum(zeros)
+
+    def graft(a):
+        return a + z.astype(a.dtype)
+
+    return jax.tree.map(graft, actual)

@@ -1,0 +1,9 @@
+"""Model-parallel composition links.
+
+Reference: ``chainermn/links/`` (dagger) (SURVEY.md section 2.5).
+"""
+
+from chainermn_tpu.links.multi_node_chain_list import MultiNodeChainList
+from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
+
+__all__ = ["MultiNodeChainList", "MultiNodeBatchNormalization"]

@@ -1,0 +1,46 @@
+"""Synchronized (multi-node) batch normalization.
+
+Reference: ``chainermn/links/batch_normalization.py`` (dagger) (SURVEY.md
+sections 2.2, 2.5): ``MultiNodeBatchNormalization`` allreduces the batch
+mean/variance across ranks inside forward so statistics reflect the *global*
+batch; a ``communication_backend`` argument picked MPI vs NCCL.
+
+TPU-native: batch statistics are ``lax.pmean``-ed over the data-parallel mesh
+axis inside the jitted step — one fused collective on the (sum, sumsq) pair,
+no backend selection needed. Implemented on flax's BatchNorm, whose ``axis_name``
+machinery performs exactly this psum; the subclass exists to (a) give the
+reference's name/shape to the API, (b) default the axis from a communicator,
+and (c) document the invariant tested in ``tests/test_links.py``: sync-BN
+over shards == plain BN over the concatenated batch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+
+class MultiNodeBatchNormalization(nn.BatchNorm):
+    """``nn.BatchNorm`` whose batch statistics are averaged over the
+    data-parallel mesh axis (``axis_name``).
+
+    Use inside a ``shard_map``-based train step::
+
+        MultiNodeBatchNormalization(use_running_average=not train,
+                                    axis_name='data')(x)
+
+    or derive the axis from a communicator with :meth:`for_communicator`.
+    """
+
+    @classmethod
+    def for_communicator(
+        cls, comm: CommunicatorBase, *, use_running_average: bool, **kwargs
+    ) -> "MultiNodeBatchNormalization":
+        axes = comm.grad_axes
+        axis_name = axes if len(axes) > 1 else axes[0]
+        return cls(
+            use_running_average=use_running_average, axis_name=axis_name, **kwargs
+        )

@@ -1,0 +1,241 @@
+"""Cross-rank model composition.
+
+Reference: ``chainermn/links/multi_node_chain_list.py`` (dagger) (SURVEY.md
+sections 2.5, 3.4): ``MultiNodeChainList(comm).add_link(chain, rank_in,
+rank_out)`` registers components on ranks; ``__call__`` walks them in
+registration order — participating ranks run their chain and ``send`` the
+output to ``rank_out`` (a list means multicast), ranks expecting input
+``recv`` from ``rank_in`` (a list means merge); delegate variables keep the
+cross-rank backward connected and ordered.
+
+TPU-native execution model: the whole multi-stage model is ONE program under
+``shard_map`` over a ``'stage'`` mesh axis. Per component:
+
+  * the transfer is an unconditional ``lax.ppermute`` executed by *all*
+    shards (collectives may not hide inside divergent control flow — the
+    SPMD analog of the reference's deadlock-ordering rule, enforced here by
+    construction);
+  * the compute is a ``lax.cond`` on ``axis_index == rank``: the owning
+    shard runs the chain, others produce zeros of the same (statically
+    inferred) shape. At runtime each shard executes only its branch — the
+    compute really is distributed, like the reference's per-rank processes.
+
+Because one traced program contains every stage, XLA schedules transfers and
+compute together; the delegate-variable ordering discipline of the reference
+is unnecessary (and cycles are structurally impossible: a component may only
+consume wires produced by earlier components — checked at trace time).
+
+Chains must be *local* computations (no collectives inside — same as the
+reference, where a chain was ordinary single-rank Chainer code).
+
+Training discipline: compute the loss inside the shard_map (psum the terminal
+logits so the scalar is genuinely replicated) but differentiate the whole
+sharded function from *outside* — ``jax.grad(shard_map(...))``. Taking the
+gradient per-shard of a replicated loss multiplies stage cotangents by the
+axis size (each shard re-derives the same cotangent and the psum transpose
+sums them); see ``examples/mnist/train_mnist_model_parallel.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+PyTree = Any
+Ranks = Union[int, Sequence[int], None]
+
+
+def _as_list(r: Ranks) -> list[int]:
+    if r is None:
+        return []
+    if isinstance(r, int):
+        return [r]
+    return list(r)
+
+
+class _Component:
+    def __init__(self, fn, init_fn, rank, rank_in, rank_out, name):
+        self.fn = fn
+        self.init_fn = init_fn
+        self.rank = rank
+        self.rank_in = _as_list(rank_in)
+        self.rank_out = _as_list(rank_out)
+        self.name = name
+
+
+class MultiNodeChainList:
+    """Registry of ``(chain, rank, rank_in, rank_out)`` components executed
+    as one SPMD program over a stage axis.
+
+    ``add_link(fn, rank, rank_in=None, rank_out=None, init_fn=None)``:
+      - ``fn(params, x)`` — the chain; ``x`` is the local input (for the
+        entry component) or the received activation (tuple when ``rank_in``
+        is a list — a merge);
+      - ``rank`` — which stage-axis index owns the compute (the reference
+        inferred this from the MPI rank running the code; SPMD needs it
+        explicit);
+      - ``rank_in`` / ``rank_out`` — where activations come from / go to,
+        matching the reference's signature;
+      - ``init_fn(rng, x) -> params`` — optional, enables ``init()``.
+
+    The final component (``rank_out=None``) yields the model output on its
+    owning shard (zeros elsewhere; reduce or fetch as needed).
+    """
+
+    def __init__(self, comm: CommunicatorBase, *, axis_name: str = "stage") -> None:
+        self.comm = comm
+        self.axis_name = axis_name
+        self.components: list[_Component] = []
+
+    def add_link(
+        self,
+        fn: Callable[[PyTree, Any], Any],
+        *,
+        rank: int,
+        rank_in: Ranks = None,
+        rank_out: Ranks = None,
+        init_fn: Optional[Callable] = None,
+        name: Optional[str] = None,
+    ) -> "MultiNodeChainList":
+        self.components.append(
+            _Component(fn, init_fn, rank, rank_in, rank_out,
+                       name or f"component_{len(self.components)}")
+        )
+        return self
+
+    # ------------------------------------------------------------------
+
+    def _forward_local(self, params_list: Sequence[PyTree], x: Any):
+        """Per-shard body. Must run inside shard_map over ``axis_name``."""
+        ax = self.axis_name
+        n = lax.axis_size(ax)
+        max_rank = max(
+            [c.rank for c in self.components]
+            + [r for c in self.components for r in c.rank_in + c.rank_out]
+        )
+        if max_rank >= n:
+            raise ValueError(
+                f"model uses stage rank {max_rank} but mesh axis {ax!r} has "
+                f"only {n} slot(s) — run with a mesh of >= {max_rank + 1} "
+                f"devices on that axis"
+            )
+        idx = lax.axis_index(ax)
+        wires: dict[tuple[int, int], Any] = {}
+        output = None
+
+        for ci, comp in enumerate(self.components):
+            params = params_list[ci]
+            # ---- assemble input (zeros on non-owner shards is fine: the
+            # owner is the only shard whose branch consumes it) ----
+            if comp.rank_in:
+                received = []
+                for src in comp.rank_in:
+                    key = (src, comp.rank)
+                    if key not in wires:
+                        raise ValueError(
+                            f"{comp.name} on stage {comp.rank} expects input "
+                            f"from stage {src}, but no earlier component sent "
+                            f"one (forward references/cycles are rejected — "
+                            f"reference parity: cycle detection)"
+                        )
+                    received.append(wires.pop(key))
+                inp = received[0] if len(received) == 1 else tuple(received)
+            else:
+                inp = x
+
+            # ---- compute under cond: only the owner executes the chain ----
+            out_shape = jax.eval_shape(comp.fn, params, inp)
+            zeros = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), out_shape
+            )
+            out = lax.cond(
+                idx == comp.rank,
+                lambda p, v: comp.fn(p, v),
+                lambda p, v: zeros,
+                params, inp,
+            )
+
+            # ---- transfer: unconditional collectives (one ppermute per
+            # destination — ppermute sources must be unique, so a multicast
+            # is a sequence of pairwise sends, like the reference's
+            # send-to-list loop) ----
+            if comp.rank_out:
+                for dst in comp.rank_out:
+                    key = (comp.rank, dst)
+                    if key in wires:
+                        raise ValueError(
+                            f"{comp.name} sends stage {comp.rank} -> {dst}, "
+                            f"but an earlier unconsumed transfer on that "
+                            f"edge exists — insert the consumer between "
+                            f"them (transfers on one edge are ordered, "
+                            f"reference parity: delegate-variable ordering)"
+                        )
+                    wires[key] = lax.ppermute(out, ax, [(comp.rank, dst)])
+            else:
+                output = out
+
+        if output is None:
+            raise ValueError("no terminal component (one needs rank_out=None)")
+        return output
+
+    def apply(self, params_list: Sequence[PyTree], x: Any):
+        """Call inside an existing shard_map context over ``axis_name``."""
+        return self._forward_local(params_list, x)
+
+    def build(self, *, in_spec: P = P(), replicate_output: bool = True):
+        """A jitted whole-model forward over the communicator's mesh: input
+        replicated (or sharded per ``in_spec``). The terminal activation is
+        non-zero only on its owning shard; with ``replicate_output`` it is
+        psum-broadcast to every shard (all other shards contribute zeros)."""
+        mesh = self.comm.mesh
+        ax = self.axis_name
+
+        def body(p, v):
+            out = self._forward_local(p, v)
+            if replicate_output:
+                out = jax.tree.map(lambda o: lax.psum(o, ax), out)
+            return out
+
+        def fwd(params_list, x):
+            return shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(), in_spec),
+                out_specs=P(None) if replicate_output else P(ax),
+                check_vma=False,
+            )(params_list, x)
+
+        return jax.jit(fwd)
+
+    # ------------------------------------------------------------------
+
+    def init(self, rng: jax.Array, x: Any) -> list[PyTree]:
+        """Host-side parameter init: walks components in order, propagating
+        activation shapes (via the chains themselves on dummy zeros), calling
+        each ``init_fn``. All shards/processes derive identical params from
+        the same rng — the functional form of the reference's first-update
+        ``bcast_data``."""
+        rngs = jax.random.split(rng, len(self.components))
+        params_list: list[PyTree] = []
+        acts: dict[tuple[int, int], Any] = {}
+        for ci, comp in enumerate(self.components):
+            if comp.init_fn is None:
+                raise ValueError(f"{comp.name} registered without init_fn")
+            if comp.rank_in:
+                received = [acts[(s, comp.rank)] for s in comp.rank_in]
+                inp = received[0] if len(received) == 1 else tuple(received)
+            else:
+                inp = x
+            params = comp.init_fn(rngs[ci], inp)
+            params_list.append(params)
+            out = jax.eval_shape(comp.fn, params, inp)
+            dummy = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out)
+            for dst in comp.rank_out:
+                acts[(comp.rank, dst)] = dummy
+        return params_list

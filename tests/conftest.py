@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Default eager/jit computations to the CPU backend: reference values in
+# tests must use the same arithmetic as the CPU-mesh distributed versions
+# (the real TPU's default bf16 matmul precision would otherwise skew
+# eager-computed expectations by ~1e-3).
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
 
 @pytest.fixture(scope="session")
 def cpu_devices():

@@ -1,0 +1,254 @@
+"""Cross-rank function tests — analog of
+``tests/function_tests/test_point_to_point_communication.py`` (dagger) and
+``test_collective_communication.py`` (dagger) (SURVEY.md section 4): forward
+values AND numerical gradient checks across ranks (backward of send is recv,
+each collective pairs with its transpose).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu import create_communicator
+from chainermn_tpu.functions import (
+    allgather,
+    allreduce,
+    alltoall,
+    bcast,
+    gather,
+    pseudo_connect,
+    recv,
+    scatter,
+    send,
+    send_recv,
+)
+
+N = 8
+AX = "data"
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+def _smap(comm, fn, *xs, in_spec=None, out_spec=None):
+    """Run fn per-shard over stacked inputs [N, ...]."""
+    in_spec = in_spec or P(AX)
+    out_spec = out_spec or P(AX)
+
+    def body(*locals_):
+        squeezed = [l[0] for l in locals_]
+        return fn(*squeezed)[None]
+
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=comm.mesh,
+            in_specs=tuple(in_spec for _ in xs),
+            out_specs=out_spec,
+            check_vma=False,
+        )
+    )(*xs)
+
+
+def _grad_smap(comm, scalar_fn, x):
+    """Gradient of sum-over-shards scalar_fn wrt stacked x."""
+
+    def body(xl):
+        def lf(xs):
+            return scalar_fn(xs[0])
+
+        val, g = jax.value_and_grad(lf)(xl)
+        return jax.lax.psum(val, AX)[None], g
+
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=comm.mesh,
+            in_specs=P(AX),
+            out_specs=(P(AX), P(AX)),
+            check_vma=False,
+        )
+    )(x)
+
+
+# ---------------------------------------------------------------------------
+# point to point
+# ---------------------------------------------------------------------------
+
+
+def test_send_recv_forward(comm):
+    x = np.arange(N, dtype=np.float32).reshape(N, 1) + 1  # shard i holds i+1
+    out = np.asarray(_smap(comm, lambda v: send_recv(v, 2, 5, AX), x))
+    want = np.zeros((N, 1), np.float32)
+    want[5] = 3.0  # shard 5 received shard 2's value
+    np.testing.assert_array_equal(out, want)
+
+
+def test_send_recv_backward_flows_dst_to_src(comm):
+    x = jnp.ones((N, 1), jnp.float32)
+
+    def scalar(v):
+        y = send_recv(v, 2, 5, AX)
+        return jnp.sum(y * 7.0)  # loss lives on shard 5
+
+    _, g = _grad_smap(comm, scalar, x)
+    g = np.asarray(g)
+    want = np.zeros((N, 1), np.float32)
+    want[2] = 7.0  # cotangent returned to the sender
+    np.testing.assert_array_equal(g, want)
+
+
+def test_send_returns_delegate_and_recv_unwraps(comm):
+    x = np.arange(N, dtype=np.float32).reshape(N, 1)
+
+    def fn(v):
+        received, delegate = send(v, dst=4, axis_name=AX, src=1)
+        return recv(received, delegate=delegate)
+
+    out = np.asarray(_smap(comm, fn, x))
+    want = np.zeros((N, 1), np.float32)
+    want[4] = 1.0
+    np.testing.assert_array_equal(out, want)
+
+
+def test_send_requires_static_src(comm):
+    with pytest.raises(ValueError, match="static source"):
+        send(jnp.zeros(3), dst=1, axis_name=AX)
+
+
+def test_pseudo_connect_preserves_value_and_keeps_edge(comm):
+    x = jnp.full((N, 2), 3.0)
+
+    def scalar(v):
+        transferred = send_recv(v * 2.0, 0, 1, AX)
+        delegate = jnp.sum(transferred) * 0.0
+        grafted = pseudo_connect(delegate, v)
+        return jnp.sum(grafted)
+
+    val, g = _grad_smap(comm, scalar, x)
+    # value unchanged by grafting: sum over all shards of v
+    assert float(np.asarray(val)[0]) == pytest.approx(3.0 * 2 * N)
+    g = np.asarray(g)
+    # direct edge: dL/dv = 1 everywhere; delegate edge contributes zero
+    np.testing.assert_allclose(g, np.ones((N, 2)), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# collectives: forward values
+# ---------------------------------------------------------------------------
+
+
+def test_allgather_forward(comm):
+    x = np.random.RandomState(0).randn(N, 3).astype(np.float32)
+    out = np.asarray(
+        _smap(comm, lambda v: allgather(v, AX)[None].squeeze(0), x,
+              out_spec=P(AX, None))
+    )
+    # every shard sees the full stack
+    for i in range(N):
+        np.testing.assert_allclose(out[i], x, rtol=1e-6)
+
+
+def test_alltoall_forward(comm):
+    x = np.arange(N * N, dtype=np.float32).reshape(N, N, 1)
+    out = np.asarray(_smap(comm, lambda v: alltoall(v, AX), x))
+    np.testing.assert_array_equal(out.squeeze(-1), x.squeeze(-1).T)
+
+
+def test_bcast_forward(comm):
+    x = np.arange(N, dtype=np.float32).reshape(N, 1) + 10
+    out = np.asarray(_smap(comm, lambda v: bcast(v, AX, root=6), x))
+    np.testing.assert_array_equal(out, np.full((N, 1), 16.0))
+
+
+def test_gather_forward_root_only(comm):
+    x = np.arange(N, dtype=np.float32).reshape(N, 1)
+    out = np.asarray(
+        _smap(comm, lambda v: gather(v, AX, root=3), x, out_spec=P(AX, None))
+    )
+    np.testing.assert_array_equal(out[3], x)  # root has everything
+    assert (out[[i for i in range(N) if i != 3]] == 0).all()
+
+
+def test_scatter_forward(comm):
+    x = np.tile(np.arange(N, dtype=np.float32).reshape(1, N, 1), (N, 1, 1))
+    out = np.asarray(_smap(comm, lambda v: scatter(v, AX, root=0), x))
+    np.testing.assert_array_equal(out.squeeze(-1).squeeze(-1), np.arange(N))
+
+
+def test_allreduce_forward(comm):
+    x = np.ones((N, 4), np.float32)
+    out = np.asarray(_smap(comm, lambda v: allreduce(v, AX), x))
+    np.testing.assert_array_equal(out, np.full((N, 4), float(N)))
+
+
+# ---------------------------------------------------------------------------
+# collectives: gradients match the dense single-device equivalent
+# ---------------------------------------------------------------------------
+
+
+def test_allgather_gradient_matches_dense(comm):
+    rng = np.random.RandomState(1)
+    x = rng.randn(N, 3).astype(np.float32)
+    w = rng.randn(N, 3).astype(np.float32)
+    wj = jnp.asarray(w)
+
+    def scalar(v):
+        full = allgather(v, AX)  # [N, 3]
+        return jnp.sum(full * wj) / N  # same loss on every shard
+
+    _, g = _grad_smap(comm, scalar, jnp.asarray(x))
+    # dense reference: loss = sum(x * w) computed on every of N shards / N
+    # summed over shards -> grad = w
+    np.testing.assert_allclose(np.asarray(g), w, rtol=1e-5)
+
+
+def test_alltoall_gradient_is_transpose(comm):
+    rng = np.random.RandomState(2)
+    x = rng.randn(N, N).astype(np.float32)
+    w = rng.randn(N, N).astype(np.float32)
+    wj = jnp.asarray(w)
+
+    def scalar_builder(i_mat):
+        def scalar(v):
+            out = alltoall(v[:, None], AX).squeeze(-1)  # row i of transpose
+            idx = jax.lax.axis_index(AX)
+            return jnp.sum(out * jax.lax.dynamic_index_in_dim(i_mat, idx, 0, keepdims=False))
+        return scalar
+
+    _, g = _grad_smap(comm, scalar_builder(wj), jnp.asarray(x))
+    # loss = sum_{ij} xT[i,j] * w[i,j] = sum_{ij} x[j,i] w[i,j] -> dx = wT
+    np.testing.assert_allclose(np.asarray(g), w.T, rtol=1e-5)
+
+
+def test_bcast_gradient_sums_on_root(comm):
+    x = jnp.ones((N, 2), jnp.float32)
+
+    def scalar(v):
+        y = bcast(v, AX, root=1)
+        return jnp.sum(y * 3.0)
+
+    _, g = _grad_smap(comm, scalar, x)
+    g = np.asarray(g)
+    want = np.zeros((N, 2), np.float32)
+    want[1] = 3.0 * N  # cotangents from every shard sum onto the root
+    np.testing.assert_allclose(g, want, rtol=1e-6)
+
+
+def test_gather_scatter_roundtrip_gradient(comm):
+    rng = np.random.RandomState(3)
+    x = rng.randn(N, 1).astype(np.float32)
+
+    def scalar(v):
+        full = gather(v, AX, root=0)          # [N,1] on root, zeros elsewhere
+        back = scatter(full, AX, root=0)      # redistribute root's buffer
+        return jnp.sum(back * 2.0)
+
+    val, g = _grad_smap(comm, scalar, jnp.asarray(x))
+    assert float(np.asarray(val)[0]) == pytest.approx(2.0 * x.sum(), rel=1e-5)
+    np.testing.assert_allclose(np.asarray(g), np.full((N, 1), 2.0), rtol=1e-5)

@@ -1,0 +1,233 @@
+"""Link tests — analogs of ``tests/link_tests/test_multi_node_chain_list.py``
+(dagger) and ``test_batch_normalization.py`` (dagger) (SURVEY.md section 4):
+cross-rank model graphs (chains, branches, merges, cycle rejection) equal the
+single-device composition; sync-BN equals single-process BN on the
+concatenated batch.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu import create_communicator
+from chainermn_tpu.links import MultiNodeBatchNormalization, MultiNodeChainList
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+# ---------------------------------------------------------------------------
+# MultiNodeChainList
+# ---------------------------------------------------------------------------
+
+
+def _dense_fn(w_key):
+    def fn(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    def init(rng, x):
+        k1, k2 = jax.random.split(jax.random.fold_in(rng, hash(w_key) % 1000))
+        d_in = x.shape[-1]
+        return {
+            "w": jax.random.normal(k1, (d_in, 4)) * 0.5,
+            "b": jax.random.normal(k2, (4,)) * 0.1,
+        }
+
+    return fn, init
+
+
+def test_two_stage_chain_equals_sequential(comm):
+    fn1, init1 = _dense_fn("a")
+    fn2, init2 = _dense_fn("b")
+
+    model = MultiNodeChainList(comm, axis_name="data")
+    model.add_link(fn1, rank=0, rank_out=1, init_fn=init1)
+    model.add_link(fn2, rank=1, rank_in=0, init_fn=init2)
+
+    x = jax.random.normal(jax.random.key(0), (5, 3))
+    params = model.init(jax.random.key(1), x)
+    fwd = model.build()
+    out = fwd(params, x)
+
+    ref = fn2(params[1], fn1(params[0], x))
+    # output lives on stage 1's shard; stacked out_spec P(None) keeps the
+    # terminal value replicated-summed... we asked out_specs=P(None): each
+    # shard returns its local value; only stage 1's is nonzero.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_three_stage_pipeline(comm):
+    fns = [_dense_fn(k) for k in "abc"]
+    model = MultiNodeChainList(comm, axis_name="data")
+    model.add_link(fns[0][0], rank=0, rank_out=1, init_fn=fns[0][1])
+    model.add_link(fns[1][0], rank=1, rank_in=0, rank_out=2, init_fn=fns[1][1])
+    model.add_link(fns[2][0], rank=2, rank_in=1, init_fn=fns[2][1])
+
+    x = jax.random.normal(jax.random.key(2), (4, 3))
+    params = model.init(jax.random.key(3), x)
+    out = model.build()(params, x)
+    ref = fns[2][0](params[2], fns[1][0](params[1], fns[0][0](params[0], x)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_branch_and_merge(comm):
+    """Stage 0 multicasts to 1 and 2; stage 3 merges both — the reference's
+    branching/merging graphs."""
+    f0, i0 = _dense_fn("root")
+    f1, i1 = _dense_fn("left")
+    f2, i2 = _dense_fn("right")
+
+    def merge_fn(params, xs):
+        a, b = xs
+        return a + b @ params["w"]
+
+    def merge_init(rng, xs):
+        return {"w": jnp.eye(4)}
+
+    model = MultiNodeChainList(comm, axis_name="data")
+    model.add_link(f0, rank=0, rank_out=[1, 2], init_fn=i0)
+    model.add_link(f1, rank=1, rank_in=0, rank_out=3, init_fn=i1)
+    model.add_link(f2, rank=2, rank_in=0, rank_out=3, init_fn=i2)
+    model.add_link(merge_fn, rank=3, rank_in=[1, 2], init_fn=merge_init)
+
+    x = jax.random.normal(jax.random.key(4), (2, 3))
+    params = model.init(jax.random.key(5), x)
+    out = model.build()(params, x)
+
+    h = f0(params[0], x)
+    ref = merge_fn(params[3], (f1(params[1], h), f2(params[2], h)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_forward_reference_rejected(comm):
+    fn, init = _dense_fn("x")
+    model = MultiNodeChainList(comm, axis_name="data")
+    model.add_link(fn, rank=0, rank_in=1, init_fn=init)  # from a later stage
+    model.add_link(fn, rank=1, rank_in=None, rank_out=0, init_fn=init)
+    x = jnp.zeros((2, 3))
+    with pytest.raises(ValueError, match="no earlier component"):
+        model.build()(([{"w": jnp.zeros((3, 4)), "b": jnp.zeros(4)}] * 2), x)
+
+
+def test_no_terminal_component_rejected(comm):
+    fn, init = _dense_fn("x")
+    model = MultiNodeChainList(comm, axis_name="data")
+    model.add_link(fn, rank=0, rank_out=1, init_fn=init)
+    with pytest.raises(ValueError, match="terminal"):
+        model.build()([{"w": jnp.zeros((3, 4)), "b": jnp.zeros(4)}], jnp.zeros((2, 3)))
+
+
+def test_chain_gradients_flow_across_stages(comm):
+    """Backward crosses the stage boundary (Send.backward==Recv duality)."""
+    fn1, init1 = _dense_fn("g1")
+    fn2, init2 = _dense_fn("g2")
+    model = MultiNodeChainList(comm, axis_name="data")
+    model.add_link(fn1, rank=0, rank_out=1, init_fn=init1)
+    model.add_link(fn2, rank=1, rank_in=0, init_fn=init2)
+
+    x = jax.random.normal(jax.random.key(6), (3, 3))
+    params = model.init(jax.random.key(7), x)
+    mesh = comm.mesh
+
+    @jax.jit
+    def loss_dist(params):
+        def body(p, v):
+            out = model.apply(p, v)
+            # terminal output is on stage 1; sum over shards collapses zeros
+            return jax.lax.psum(jnp.sum(out**2), "data")
+
+        return shard_map(
+            body, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False,
+        )(params, x)
+
+    def loss_ref(params):
+        return jnp.sum(fn2(params[1], fn1(params[0], x)) ** 2)
+
+    g_dist = jax.grad(loss_dist)(params)
+    g_ref = jax.grad(loss_ref)(params)
+    for gd, gr in zip(jax.tree.leaves(g_dist), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(gd), np.asarray(gr), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# MultiNodeBatchNormalization
+# ---------------------------------------------------------------------------
+
+
+def test_sync_bn_equals_big_batch_bn(comm):
+    """The reference's headline BN invariant: sync-BN over N shards ==
+    single-process BN over the concatenated batch."""
+    feat = 6
+    per_shard = 4
+    rng = np.random.RandomState(0)
+    x = rng.randn(N * per_shard, feat).astype(np.float32) * 3 + 1
+
+    sync_bn = MultiNodeBatchNormalization(
+        use_running_average=False, axis_name="data", momentum=0.9
+    )
+    plain_bn = nn.BatchNorm(use_running_average=False, momentum=0.9)
+
+    variables = plain_bn.init(jax.random.key(0), x)
+
+    # distributed: each shard normalizes its slice with synced stats
+    mesh = comm.mesh
+
+    @jax.jit
+    def dist(x):
+        def body(xl):
+            y, _ = sync_bn.apply(
+                variables, xl, mutable=["batch_stats"]
+            )
+            return y
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False,
+        )(x)
+
+    y_dist = np.asarray(dist(x))
+    y_ref, _ = plain_bn.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(y_dist, np.asarray(y_ref), rtol=1e-4, atol=1e-5)
+
+
+def test_sync_bn_running_stats_match_global(comm):
+    feat = 3
+    rng = np.random.RandomState(1)
+    x = rng.randn(N * 2, feat).astype(np.float32) * 2 - 1
+
+    sync_bn = MultiNodeBatchNormalization(
+        use_running_average=False, axis_name="data", momentum=0.0
+    )
+    variables = sync_bn.init(jax.random.key(0), x[:2])
+    mesh = comm.mesh
+
+    @jax.jit
+    def dist(x):
+        def body(xl):
+            _, upd = sync_bn.apply(variables, xl, mutable=["batch_stats"])
+            return upd["batch_stats"]["mean"], upd["batch_stats"]["var"]
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=(P(None), P(None)),
+            check_vma=False,
+        )(x)
+
+    mean, var = dist(x)
+    np.testing.assert_allclose(np.asarray(mean), x.mean(0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), x.var(0), rtol=1e-3, atol=1e-4)
+
+
+def test_for_communicator_uses_grad_axes(comm):
+    bn = MultiNodeBatchNormalization.for_communicator(
+        comm, use_running_average=False
+    )
+    assert bn.axis_name == "data"

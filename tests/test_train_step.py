@@ -41,19 +41,22 @@ def _data(n=64, d=4, seed=0):
 def test_distributed_step_equals_single_device(comm):
     x, y = _data()
     params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
-    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
-    state = create_train_state(params, opt, comm)
-    step = make_train_step(_linreg_loss, opt, comm)
-
-    new_state, metrics = step(state, (x, y))
-
-    # single-device reference on the full batch
+    # single-device reference on the full batch (computed BEFORE the
+    # distributed step: make_train_step donates its state, which may alias
+    # these param buffers)
     ref_opt = optax.sgd(0.1)
     (loss, _), grads = jax.value_and_grad(_linreg_loss, has_aux=True)(
         params, (jnp.asarray(x), jnp.asarray(y))
     )
     upd, _ = ref_opt.update(grads, ref_opt.init(params), params)
-    ref_params = optax.apply_updates(params, upd)
+    ref_params = jax.device_get(optax.apply_updates(params, upd))
+    loss = float(loss)
+
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    state = create_train_state(params, opt, comm)
+    step = make_train_step(_linreg_loss, opt, comm)
+
+    new_state, metrics = step(state, (x, y))
 
     np.testing.assert_allclose(
         np.asarray(new_state.params["w"]), np.asarray(ref_params["w"]), rtol=1e-4
@@ -148,6 +151,13 @@ def test_default_collate():
     assert d["a"].shape == (2, 2)
     arr = default_collate([np.zeros(4), np.zeros(4)])
     assert arr.shape == (2, 4)
+
+
+def test_mnist_model_parallel_example_runs():
+    import examples.mnist.train_mnist_model_parallel as ex
+
+    acc = ex.main(["--iterations", "60", "--batchsize", "64", "--n-units", "64"])
+    assert acc > 0.9  # synthetic blobs are easy; must actually learn
 
 
 def test_mnist_example_runs():
