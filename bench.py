@@ -1,0 +1,109 @@
+"""Benchmark driver: ResNet-50 data-parallel training throughput.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}``.
+
+The benchmark is the reference's headline workload (ResNet-50 ImageNet,
+``examples/imagenet`` (dagger), SURVEY.md section 6): one fully-jitted SPMD
+train step — forward, backward, bf16-compressed gradient allreduce over the
+mesh, SGD update — on synthetic 224x224 data, i.e. the same measurement the
+reference's images/sec numbers report (data pipeline excluded).
+
+Baseline: ``BASELINE.json`` has ``"published": {}`` (the reference repo's own
+numbers were unreadable — empty mount), so ``vs_baseline`` is computed against
+the best documented ChainerMN-era per-accelerator throughput: the 15-minute
+ImageNet run (Akiba, Suzuki & Fukuda, arXiv:1711.04325 — 90 epochs, 1024
+P100s) ~= 125 images/sec/P100. UNVERIFIED external figure; see BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 125.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from chainermn_tpu import create_communicator, create_multi_node_optimizer
+    from chainermn_tpu.models import ResNet50, ResNet18
+    from chainermn_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    devices = jax.devices()
+    on_accel = devices[0].platform != "cpu"
+    comm = create_communicator("xla")
+
+    if on_accel:
+        model = ResNet50(num_classes=1000)
+        per_device_batch, hw, steps, warmup = 64, 224, 20, 3
+        metric = "resnet50_images_per_sec"
+    else:
+        # CPU fallback so the bench always emits a line (tiny proxy model).
+        model = ResNet18(num_classes=100, compute_dtype=jnp.float32)
+        per_device_batch, hw, steps, warmup = 8, 32, 5, 1
+        metric = "resnet18_cpu_proxy_images_per_sec"
+
+    batch = per_device_batch * comm.size
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (batch, hw, hw, 3), jnp.float32)
+    y = jax.random.randint(rng, (batch,), 0, 10)
+
+    variables = jax.jit(lambda k, xb: model.init(k, xb, train=True))(
+        jax.random.PRNGKey(42), x[:2]
+    )
+
+    def loss_fn(params, batch_, model_state):
+        xb, yb = batch_
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": model_state},
+            xb,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+        return loss, ({}, mutated["batch_stats"])
+
+    optimizer = create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm, allreduce_grad_dtype=jnp.bfloat16
+    )
+    state = create_train_state(
+        variables["params"], optimizer, comm,
+        model_state=variables["batch_stats"],
+    )
+    step = make_train_step(loss_fn, optimizer, comm)
+
+    for _ in range(warmup):
+        state, metrics = step(state, (x, y))
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, (x, y))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / dt
+    per_device = images_per_sec / comm.size
+    vs_baseline = per_device / BASELINE_IMG_PER_SEC_PER_DEVICE
+
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(images_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

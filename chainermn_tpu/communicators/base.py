@@ -162,6 +162,12 @@ class CommunicatorBase:
         out = self._jitted[op](x)
         return out[0]
 
+    def _root_process(self, root: int) -> int:
+        """Process index owning mesh slot ``root`` — roots are *mesh-slot*
+        ranks (the reference's MPI ranks), not process indices; on a
+        multi-process runtime the two differ."""
+        return list(self.mesh.devices.flat)[root].process_index
+
     def bcast(self, x: jax.Array, root: int = 0, *, stacked: bool = False) -> jax.Array:
         """Broadcast ``x`` to a mesh-replicated value (the common
         "replicate rank-0 data" use). With ``stacked=True``, ``x`` holds
@@ -183,7 +189,7 @@ class CommunicatorBase:
             from jax.experimental import multihost_utils
 
             x = multihost_utils.broadcast_one_to_all(
-                x, is_source=(self.host.rank == root)
+                x, is_source=(self.host.rank == self._root_process(root))
             )
         return jax.device_put(x, NamedSharding(self.mesh, P()))
 
@@ -213,7 +219,7 @@ class CommunicatorBase:
             from jax.experimental import multihost_utils
 
             x = multihost_utils.broadcast_one_to_all(
-                x, is_source=(self.host.rank == root)
+                x, is_source=(self.host.rank == self._root_process(root))
             )
         return self._shard_stacked(x)
 
@@ -230,7 +236,7 @@ class CommunicatorBase:
             from jax.experimental import multihost_utils
 
             params = multihost_utils.broadcast_one_to_all(
-                params, is_source=(self.host.rank == root)
+                params, is_source=(self.host.rank == self._root_process(root))
             )
         repl = NamedSharding(self.mesh, P())
         return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), repl), params)

@@ -228,6 +228,15 @@ class MultiNodeChainList:
             if comp.init_fn is None:
                 raise ValueError(f"{comp.name} registered without init_fn")
             if comp.rank_in:
+                for s in comp.rank_in:
+                    if (s, comp.rank) not in acts:
+                        raise ValueError(
+                            f"{comp.name} (rank {comp.rank}) expects an input "
+                            f"from rank {s}, but no earlier component sent "
+                            f"one — components must be registered in "
+                            f"dependency order (reference parity: "
+                            f"MultiNodeChainList rejects forward references)"
+                        )
                 received = [acts[(s, comp.rank)] for s in comp.rank_in]
                 inp = received[0] if len(received) == 1 else tuple(received)
             else:

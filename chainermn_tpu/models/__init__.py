@@ -3,5 +3,21 @@ MNIST MLP, ImageNet family (AlexNet / GoogLeNet / ResNet-50), seq2seq LSTM —
 plus the Transformer LM the benchmark configs add (BASELINE.json)."""
 
 from chainermn_tpu.models.mlp import MLP
+from chainermn_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
 
-__all__ = ["MLP"]
+__all__ = [
+    "MLP",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+]

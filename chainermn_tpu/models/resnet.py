@@ -1,0 +1,148 @@
+"""ResNet family — the framework's flagship/benchmark model.
+
+Reference: ``examples/imagenet/models/resnet50.py`` (dagger) (SURVEY.md
+section 2.8) — ResNet-50 was ChainerMN's headline benchmark workload (the
+``BASELINE.json`` north star: scaling efficiency of ResNet-50 ImageNet on a
+TPU pod slice).
+
+TPU-first design decisions:
+  - **bf16 compute, f32 state**: convolutions run in ``bfloat16`` so they tile
+    onto the MXU at full rate; parameters, BatchNorm statistics and the final
+    logits stay ``float32`` (master-weight discipline — the TPU analogue of
+    the reference's fp16 compressed-allreduce story keeping f32 masters).
+  - **Static NHWC shapes** end to end; no data-dependent control flow, so the
+    whole network is one fusible XLA program.
+  - **Sync BatchNorm by construction**: pass ``bn_axis_name='data'`` (or use
+    :meth:`~chainermn_tpu.links.MultiNodeBatchNormalization.for_communicator`)
+    and the BN statistics are ``psum``-ed over the data-parallel mesh axis —
+    the reference needed a dedicated ``MultiNodeBatchNormalization`` link for
+    this (``links/batch_normalization.py`` (dagger)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck residual block (ResNet-50/101/152)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale: residual branch starts as identity,
+        # required for large-batch training (the regime the reference's
+        # 32K-batch ImageNet runs lived in)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides, name="conv_proj")(
+                residual
+            )
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet over NHWC inputs.
+
+    Args:
+      stage_sizes: blocks per stage, e.g. ``(3, 4, 6, 3)`` for ResNet-50.
+      block_cls: :class:`BottleneckBlock` or :class:`BasicBlock`.
+      num_classes: classifier width.
+      compute_dtype: dtype for conv/matmul compute (``bfloat16`` for the MXU).
+      bn_axis_name: mesh axis (or axes tuple) to synchronize BatchNorm
+        statistics over; ``None`` = local BN (single-device semantics).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: Callable
+    num_classes: int = 1000
+    num_filters: int = 64
+    compute_dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[Any] = None
+    bn_momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.compute_dtype, param_dtype=jnp.float32
+        )
+        norm = partial(
+            MultiNodeBatchNormalization,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=1e-5,
+            dtype=self.compute_dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+
+        x = x.astype(self.compute_dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    self.num_filters * 2**i,
+                    conv=conv,
+                    norm=norm,
+                    strides=strides,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock)
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3), block_cls=BottleneckBlock)

@@ -121,6 +121,7 @@ def main(argv=None):
 
     items = list(train)
     rng = np.random.RandomState(1)
+    acc = jnp.zeros(())
     for it in range(args.iterations):
         idx = rng.randint(0, len(items), size=args.batchsize)
         x = np.stack([items[i][0] for i in idx])

@@ -1,0 +1,133 @@
+"""Model-zoo tests: shapes, dtypes, and distributed-vs-single-device
+equivalence for the flagship ResNet (SURVEY.md section 4's key invariant)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from chainermn_tpu.models import MLP, ResNet18, ResNet50
+
+
+class TestResNetForward:
+    def test_resnet18_shapes(self):
+        model = ResNet18(num_classes=10, compute_dtype=jnp.float32)
+        x = jnp.ones((2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        logits = model.apply(variables, x, train=False)
+        assert logits.shape == (2, 10)
+        assert logits.dtype == jnp.float32
+
+    def test_resnet50_param_count(self):
+        """ResNet-50/ImageNet has the canonical ~25.5M parameters."""
+        model = ResNet50(num_classes=1000)
+        x = jnp.ones((1, 224, 224, 3))
+        variables = jax.eval_shape(
+            lambda: ResNet50(num_classes=1000).init(
+                jax.random.PRNGKey(0), x, train=False
+            )
+        )
+        n = sum(np.prod(l.shape) for l in jax.tree.leaves(variables["params"]))
+        assert 25.4e6 < n < 25.7e6, n
+
+    def test_bf16_compute_f32_params(self):
+        model = ResNet18(num_classes=10)  # default compute_dtype=bf16
+        x = jnp.ones((2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        for leaf in jax.tree.leaves(variables["params"]):
+            assert leaf.dtype == jnp.float32
+        logits = model.apply(variables, x, train=False)
+        assert logits.dtype == jnp.float32
+
+    def test_train_mode_updates_batch_stats(self):
+        model = ResNet18(num_classes=10, compute_dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        _, mutated = model.apply(
+            variables, x, train=True, mutable=["batch_stats"]
+        )
+        old = jax.tree.leaves(variables["batch_stats"])
+        new = jax.tree.leaves(mutated["batch_stats"])
+        assert any(
+            not np.allclose(o, m) for o, m in zip(old, new)
+        ), "batch stats should move in train mode"
+
+
+class TestResNetDistributed:
+    def test_sync_bn_train_step_matches_single_device(self, comm):
+        """Data-parallel ResNet step over the 8-way CPU mesh == the same step
+        on one device with the full batch (sync-BN makes BN stats global)."""
+        from chainermn_tpu.training.train_step import (
+            create_train_state,
+            make_train_step,
+        )
+
+        batch = 16
+        x = jax.random.normal(jax.random.PRNGKey(0), (batch, 16, 16, 3))
+        y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 10)
+
+        def build(axis_name):
+            model = ResNet18(
+                num_classes=10,
+                compute_dtype=jnp.float32,
+                bn_axis_name=axis_name,
+            )
+            variables = model.init(
+                jax.random.PRNGKey(42), x[:2], train=True
+            )
+            return model, variables
+
+        # --- distributed: 8-shard mesh, sync-BN over 'data'
+        model_d, vars_d = build(comm.grad_axes[0] if len(comm.grad_axes) == 1
+                                else comm.grad_axes)
+
+        def loss_fn(params, batch_, model_state):
+            xb, yb = batch_
+            logits, mutated = model_d.apply(
+                {"params": params, "batch_stats": model_state},
+                xb,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb
+            ).mean()
+            return loss, ({}, mutated["batch_stats"])
+
+        opt = optax.sgd(0.1)
+
+        # --- single device reference first (the distributed step donates and
+        # consumes its input buffers): full batch, local BN
+        model_s, _ = build(None)
+
+        def loss_s(params, model_state):
+            logits, mutated = model_s.apply(
+                {"params": params, "batch_stats": model_state},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+            return loss, mutated["batch_stats"]
+
+        grads, _ = jax.grad(loss_s, has_aux=True)(
+            vars_d["params"], vars_d["batch_stats"]
+        )
+        updates, _ = opt.update(grads, opt.init(vars_d["params"]))
+        expected_params = optax.apply_updates(vars_d["params"], updates)
+
+        # --- distributed step
+        state = create_train_state(
+            vars_d["params"], opt, model_state=vars_d["batch_stats"]
+        )
+        step = make_train_step(loss_fn, opt, comm)
+        new_state, metrics = step(state, (x, y))
+
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+            new_state.params,
+            expected_params,
+        )
