@@ -54,6 +54,15 @@ def main() -> None:
     rng = jax.random.PRNGKey(0)
     x = jax.random.normal(rng, (batch, hw, hw, 3), jnp.float32)
     y = jax.random.randint(rng, (batch,), 0, 10)
+    if jax.process_count() > 1:
+        # Each process holds the full batch locally; assemble the global
+        # sharded arrays the jitted step's in_specs expect.
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+
+        x, y = multihost_utils.host_local_array_to_global_array(
+            (x, y), comm.mesh, P()
+        )
 
     variables = jax.jit(lambda k, xb: model.init(k, xb, train=True))(
         jax.random.PRNGKey(42), x[:2]

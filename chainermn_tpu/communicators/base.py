@@ -107,6 +107,14 @@ class CommunicatorBase:
         2-level reduction the reference hand-built (SURVEY.md section 2.2)."""
         return self._flat_axes
 
+    @property
+    def bn_axis_name(self):
+        """Axis-name argument for flax-style ``axis_name`` parameters
+        (sync-BN and friends): the single axis, or the tuple when gradients
+        reduce over a factorised mesh."""
+        axes = self.grad_axes
+        return axes if len(axes) > 1 else axes[0]
+
     # ------------------------------------------------------------------
     # Eager array collectives over stacked per-rank contributions
     # ------------------------------------------------------------------
@@ -269,16 +277,18 @@ class CommunicatorBase:
     # ------------------------------------------------------------------
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
-        return self.host.bcast_obj(obj, root)
+        # Roots are mesh-slot ranks everywhere in this API; map to the owning
+        # process for the host plane (same rule as the array collectives).
+        return self.host.bcast_obj(obj, self._root_process(root))
 
     def gather_obj(self, obj: Any, root: int = 0):
-        return self.host.gather_obj(obj, root)
+        return self.host.gather_obj(obj, self._root_process(root))
 
     def allgather_obj(self, obj: Any) -> list[Any]:
         return self.host.allgather_obj(obj)
 
     def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
-        return self.host.scatter_obj(objs, root)
+        return self.host.scatter_obj(objs, self._root_process(root))
 
     def allreduce_obj(self, obj: Any, op: Callable[[Any, Any], Any] | None = None) -> Any:
         return self.host.allreduce_obj(obj, op)

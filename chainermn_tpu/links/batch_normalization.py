@@ -39,8 +39,8 @@ class MultiNodeBatchNormalization(nn.BatchNorm):
     def for_communicator(
         cls, comm: CommunicatorBase, *, use_running_average: bool, **kwargs
     ) -> "MultiNodeBatchNormalization":
-        axes = comm.grad_axes
-        axis_name = axes if len(axes) > 1 else axes[0]
         return cls(
-            use_running_average=use_running_average, axis_name=axis_name, **kwargs
+            use_running_average=use_running_average,
+            axis_name=comm.bn_axis_name,
+            **kwargs,
         )

@@ -1,0 +1,18 @@
+"""Compute ops — attention primitives and (Pallas) fused kernels.
+
+The reference had no op library (Chainer supplied the math; its only custom
+kernels were the fused cast/scale CuPy kernels on the allreduce path,
+``pure_nccl_communicator.py`` (dagger)). Here the op layer exists because the
+TPU build adds long-context capability (SURVEY.md section 5): blockwise /
+flash attention locals that the sequence-parallel layer
+(:mod:`chainermn_tpu.parallel.ring_attention`,
+:mod:`chainermn_tpu.parallel.ulysses`) composes with XLA collectives.
+"""
+
+from chainermn_tpu.ops.attention import (
+    dot_product_attention,
+    blockwise_attention,
+)
+from chainermn_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["dot_product_attention", "blockwise_attention", "flash_attention"]

@@ -1,0 +1,193 @@
+"""Pallas TPU flash-attention kernel.
+
+The hot local attention op: online-softmax accumulation entirely in VMEM, so
+the ``[Tq, Tk]`` score matrix never touches HBM — HBM traffic drops from
+O(T^2) to O(T * D), which is the difference between VPU-bound and MXU-bound
+attention on TPU. This is one of the "native" components of the build: where
+the reference's only custom kernels were fused CuPy cast/scale on the
+allreduce path (``pure_nccl_communicator.py`` (dagger), SURVEY.md section
+2.1), the TPU build's equivalent hand-written layer is Pallas (SURVEY.md
+section 2.1 native-component note).
+
+Backward: a ``jax.custom_vjp`` whose reverse pass rematerialises through the
+lax blockwise implementation (:func:`chainermn_tpu.ops.attention.
+blockwise_attention`) — flash-style recompute-in-backward, with XLA fusing
+the recomputation; numerically identical to differentiating the forward.
+
+Layout: BTHD at the API (framework convention), BHTD inside the kernel grid.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from chainermn_tpu.ops.attention import NEG_INF, blockwise_attention
+
+_LANES = 128
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale: float, causal: bool, block_q: int, block_k: int,
+            num_k_blocks: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: blocks strictly above the diagonal contribute nothing — skip
+    # their matmuls entirely (≈2x for long sequences).
+    live = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0]  # [block_q, D]
+        k = k_ref[0, 0]  # [block_k, D]
+        v = v_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+
+        if causal:
+            q_pos = iq * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ik * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]  # [block_q, 1]
+        l_prev = l_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        o_ref[0, 0] = jnp.where(
+            l > 0, acc_ref[...] / jnp.maximum(l, 1e-37), 0.0
+        ).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k:
+        raise ValueError(
+            f"flash_attention: seq lens ({Tq}, {Tk}) must be divisible by "
+            f"block sizes ({block_q}, {block_k})"
+        )
+    nq, nk = Tq // block_q, Tk // block_k
+
+    kernel = functools.partial(
+        _kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),      # acc
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+    # BTHD -> BHTD for the kernel grid
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_fwd_bhtd(
+        qt, kt, vt, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+
+    def ref(q, k, v):
+        return blockwise_attention(
+            q, k, v, block_k=block_k, causal=causal, scale=scale
+        )
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention on ``[B, T, H, D]`` inputs.
+
+    On TPU the forward runs as a Pallas VMEM kernel; elsewhere (CPU tests)
+    it runs in Pallas interpreter mode unless ``interpret=False``.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

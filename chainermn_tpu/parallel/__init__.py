@@ -17,4 +17,28 @@ from chainermn_tpu.parallel.mesh import (
 )
 from chainermn_tpu.parallel import collectives
 
-__all__ = ["MeshTopology", "make_mesh", "best_mesh_shape", "collectives"]
+
+def __getattr__(name):
+    # Lazy: ring_attention/ulysses import ops (attention locals), which must
+    # not load during communicator bootstrap.
+    if name in ("ring_attention_local", "make_ring_attention"):
+        from chainermn_tpu.parallel import ring_attention as _ra
+
+        return getattr(_ra, name)
+    if name in ("ulysses_attention_local", "make_ulysses_attention"):
+        from chainermn_tpu.parallel import ulysses as _ul
+
+        return getattr(_ul, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "MeshTopology",
+    "make_mesh",
+    "best_mesh_shape",
+    "collectives",
+    "ring_attention_local",
+    "make_ring_attention",
+    "ulysses_attention_local",
+    "make_ulysses_attention",
+]

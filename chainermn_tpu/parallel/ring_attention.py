@@ -1,0 +1,117 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+NEW capability relative to the reference (SURVEY.md section 5: ChainerMN is
+2017-era and has no sequence parallelism; its seq2seq example bucketed long
+sequences on one device). Designed as another communicator-consuming layer,
+sitting where the model-parallel functions sit in the reference's stack
+(``chainermn/functions/`` (dagger), SURVEY.md section 2.4).
+
+Mechanism: the sequence is sharded over a ``'seq'`` mesh axis. Each shard
+keeps its Q block resident and the K/V blocks *rotate around the ring* via
+``lax.ppermute`` (ICI neighbour exchange — bandwidth-optimal, no all-gather
+of the full sequence). Attention is accumulated blockwise with the online
+(flash) softmax, so per-shard memory stays ``O(T_local^2 / n)`` and the full
+``[T, T]`` score matrix never exists anywhere.
+
+Differentiability: the whole loop is ``lax.scan`` + ``ppermute``, both of
+which JAX knows how to transpose — the backward pass is automatically the
+reverse ring rotation, the same send/recv duality the reference hand-built
+in ``Send.backward``/``Recv.backward``
+(``functions/point_to_point_communication.py`` (dagger)).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from chainermn_tpu.ops.attention import (
+    NEG_INF,
+    finalize_online_softmax,
+    online_softmax_block,
+)
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention over local shards — call INSIDE ``shard_map``.
+
+    Args:
+      q/k/v: local sequence shards ``[B, T_local, H, D]``; the global
+        sequence is the concatenation over ``axis_name`` in ring order.
+      causal: apply a causal mask over *global* positions.
+
+    Returns:
+      Local output shard ``[B, T_local, H, D]`` (dtype of ``q``).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+
+    o = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, Tq), jnp.float32)
+
+    # Rotate kv by +1 each step: after step s this shard holds the block that
+    # started on shard (my - s) % n.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, s):
+        k_blk, v_blk, o, m, l = carry
+        src = (my - s) % n
+        o, m, l = online_softmax_block(
+            q, k_blk, v_blk, o, m, l,
+            causal=causal,
+            q_offset=my * Tq,
+            kv_offset=src * Tk,
+            scale=scale,
+        )
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return (k_blk, v_blk, o, m, l), None
+
+    (k, v, o, m, l), _ = lax.scan(body, (k, v, o, m, l), jnp.arange(n))
+    return finalize_online_softmax(o, l, q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    axis_name: str = "seq",
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    batch_axis: Optional[str] = None,
+):
+    """Jitted ring attention over globally (sequence-)sharded BTHD arrays.
+
+    Returns ``fn(q, k, v) -> out`` where inputs/outputs are global arrays
+    whose sequence dim is sharded over ``axis_name`` (and batch over
+    ``batch_axis`` when given). The returned fn composes under a larger
+    jitted program; use :func:`ring_attention_local` directly when already
+    inside a ``shard_map``.
+    """
+    from jax import shard_map
+
+    spec = P(batch_axis, axis_name, None, None)
+
+    def local(q, k, v):
+        return ring_attention_local(
+            q, k, v, axis_name, causal=causal, scale=scale
+        )
+
+    fn = shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(fn)

@@ -1,0 +1,97 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all_to_all head↔sequence
+reshard.
+
+NEW capability relative to the reference (SURVEY.md section 5). Where ring
+attention streams K/V around the ring, Ulysses *re-shards*: inputs arrive
+sequence-sharded, one ``all_to_all`` turns them head-sharded with the full
+sequence locally, plain (flash/blockwise) attention runs per-head, and a
+second ``all_to_all`` restores sequence sharding. Two collectives total —
+cheaper than the ring when heads >= axis size and the full sequence fits.
+
+Constraint: ``num_heads`` must be divisible by the axis size (heads are the
+resharding currency).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from chainermn_tpu.ops.attention import blockwise_attention
+
+
+def ulysses_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    attn_fn: Optional[Callable] = None,
+) -> jax.Array:
+    """Ulysses attention over local shards — call INSIDE ``shard_map``.
+
+    Args:
+      q/k/v: local sequence shards ``[B, T_local, H, D]``; global heads H
+        must be divisible by the axis size.
+      attn_fn: local attention ``fn(q, k, v, causal=..., scale=...)`` on
+        ``[B, T, H_local, D]``; defaults to blockwise (flash) attention.
+
+    Returns:
+      Local output shard ``[B, T_local, H, D]``.
+    """
+    n = lax.axis_size(axis_name)
+    H = q.shape[2]
+    if H % n != 0:
+        raise ValueError(
+            f"ulysses: num_heads {H} not divisible by axis {axis_name!r} "
+            f"size {n}"
+        )
+    if attn_fn is None:
+        attn_fn = blockwise_attention
+
+    def seq_to_heads(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    axis_name: str = "seq",
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    attn_fn: Optional[Callable] = None,
+    batch_axis: Optional[str] = None,
+):
+    """Jitted Ulysses attention over globally sequence-sharded BTHD arrays
+    (counterpart of :func:`chainermn_tpu.parallel.make_ring_attention`)."""
+    from jax import shard_map
+
+    spec = P(batch_axis, axis_name, None, None)
+
+    def local(q, k, v):
+        return ulysses_attention_local(
+            q, k, v, axis_name, causal=causal, scale=scale, attn_fn=attn_fn
+        )
+
+    fn = shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(fn)

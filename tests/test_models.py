@@ -21,12 +21,12 @@ class TestResNetForward:
 
     def test_resnet50_param_count(self):
         """ResNet-50/ImageNet has the canonical ~25.5M parameters."""
-        model = ResNet50(num_classes=1000)
-        x = jnp.ones((1, 224, 224, 3))
+        x = jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32)
         variables = jax.eval_shape(
-            lambda: ResNet50(num_classes=1000).init(
+            lambda x: ResNet50(num_classes=1000).init(
                 jax.random.PRNGKey(0), x, train=False
-            )
+            ),
+            x,
         )
         n = sum(np.prod(l.shape) for l in jax.tree.leaves(variables["params"]))
         assert 25.4e6 < n < 25.7e6, n
@@ -79,8 +79,7 @@ class TestResNetDistributed:
             return model, variables
 
         # --- distributed: 8-shard mesh, sync-BN over 'data'
-        model_d, vars_d = build(comm.grad_axes[0] if len(comm.grad_axes) == 1
-                                else comm.grad_axes)
+        model_d, vars_d = build(comm.bn_axis_name)
 
         def loss_fn(params, batch_, model_state):
             xb, yb = batch_

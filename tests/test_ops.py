@@ -1,0 +1,62 @@
+"""Op-layer tests: the Pallas flash-attention kernel (interpreter mode — the
+CPU analogue of the reference's CPU-only CI paths, SURVEY.md section 4)
+against plain attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.ops import (
+    blockwise_attention,
+    dot_product_attention,
+    flash_attention,
+)
+
+B, T, H, D = 2, 64, 4, 32
+
+
+def _qkv(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, T, H, D)) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_full(causal):
+    q, k, v = _qkv()
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=32, block_k=32, interpret=True
+    )
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_grads_match_full():
+    q, k, v = _qkv(1)
+
+    def loss_f(q, k, v):
+        return (
+            flash_attention(
+                q, k, v, causal=True, block_q=32, block_k=32, interpret=True
+            )
+            ** 2
+        ).sum()
+
+    def loss_r(q, k, v):
+        return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+        ),
+        gf,
+        gr,
+    )
+
+
+def test_flash_rejects_indivisible_blocks():
+    q, k, v = _qkv(2)
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, v, block_q=48, block_k=48, interpret=True)

@@ -1,0 +1,134 @@
+"""Sequence-parallelism tests: ring attention and Ulysses all_to_all
+attention must equal single-device full attention on the concatenated
+sequence (values AND gradients) — the reference test suite's distributed ==
+single-process invariant (SURVEY.md section 4) applied to the new
+long-context layer (section 5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.ops.attention import (
+    blockwise_attention,
+    dot_product_attention,
+)
+from chainermn_tpu.parallel.ring_attention import make_ring_attention
+from chainermn_tpu.parallel.ulysses import make_ulysses_attention
+
+B, T, H, D = 2, 32, 8, 16  # T sharded 8-ways -> T_local = 4
+
+
+def _qkv(seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, T, H, D)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+class TestLocalAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_blockwise_matches_full(self, causal):
+        q, k, v = _qkv()
+        ref = dot_product_attention(q, k, v, causal=causal)
+        blk = blockwise_attention(q, k, v, block_k=8, causal=causal)
+        np.testing.assert_allclose(blk, ref, rtol=1e-5, atol=1e-5)
+
+    def test_blockwise_grads_match_full(self):
+        q, k, v = _qkv(1)
+
+        def loss_ref(q, k, v):
+            return dot_product_attention(q, k, v, causal=True).sum()
+
+        def loss_blk(q, k, v):
+            return blockwise_attention(q, k, v, block_k=8, causal=True).sum()
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_blk = jax.grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4),
+            g_blk,
+            g_ref,
+        )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, comm, causal):
+        q, k, v = _qkv(2)
+        ref = dot_product_attention(q, k, v, causal=causal)
+
+        fn = make_ring_attention(comm.mesh, comm.axis_name, causal=causal)
+        sharding = NamedSharding(comm.mesh, P(None, comm.axis_name))
+        qs, ks, vs = (jax.device_put(t, sharding) for t in (q, k, v))
+        out = fn(qs, ks, vs)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_full_attention(self, comm):
+        q, k, v = _qkv(3)
+        fn = make_ring_attention(comm.mesh, comm.axis_name, causal=True)
+
+        def loss_ring(q, k, v):
+            return (fn(q, k, v) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), b, rtol=1e-4, atol=1e-4
+            ),
+            g_ring,
+            g_ref,
+        )
+
+    def test_bf16_inputs_f32_accumulation(self, comm):
+        q, k, v = _qkv(4, jnp.bfloat16)
+        fn = make_ring_attention(comm.mesh, comm.axis_name)
+        out = fn(q, k, v)
+        assert out.dtype == jnp.bfloat16
+        ref = dot_product_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), ref, rtol=2e-2, atol=2e-2
+        )
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, comm, causal):
+        q, k, v = _qkv(5)
+        ref = dot_product_attention(q, k, v, causal=causal)
+        fn = make_ulysses_attention(comm.mesh, comm.axis_name, causal=causal)
+        out = fn(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_full_attention(self, comm):
+        q, k, v = _qkv(6)
+        fn = make_ulysses_attention(comm.mesh, comm.axis_name, causal=True)
+
+        def loss_u(q, k, v):
+            return (fn(q, k, v) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+
+        g_u = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), b, rtol=1e-4, atol=1e-4
+            ),
+            g_u,
+            g_ref,
+        )
+
+    def test_head_divisibility_enforced(self, comm):
+        # H=6 not divisible by the 8-way axis
+        q = jnp.zeros((B, T, 6, D))
+        fn = make_ulysses_attention(comm.mesh, comm.axis_name)
+        with pytest.raises(ValueError, match="not divisible"):
+            fn(q, q, q)
