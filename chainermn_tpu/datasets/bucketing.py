@@ -1,0 +1,88 @@
+"""Length bucketing + padding for variable-length data under ``jit``.
+
+The reference leaned on define-by-run: every ragged batch just ran
+(``examples/seq2seq/seq2seq.py`` (dagger) sorted/padded ad hoc). Under XLA
+each distinct shape is a separate compilation, so the framework needs a
+*discipline*: round sequence lengths up to a small fixed set of bucket
+lengths. Compile count is then bounded by ``len(buckets)`` while padding
+waste stays bounded by the bucket spacing (SURVEY.md section 7 "hard
+parts": variable-length/dynamic shapes under jit).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+#: power-of-two-ish default ladder; dense at short lengths where MT data lives
+DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512)
+
+
+def bucket_length(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    """Smallest bucket >= n (sequences longer than the last bucket are
+    truncated to it — callers choose buckets to make this rare)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def pad_to(seq, length: int, pad_id: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad/truncate one sequence to ``length``; returns (tokens, mask)."""
+    seq = np.asarray(seq[:length], dtype=np.int32)
+    out = np.full((length,), pad_id, np.int32)
+    mask = np.zeros((length,), np.float32)
+    out[: len(seq)] = seq
+    mask[: len(seq)] = 1.0
+    return out, mask
+
+
+def bucket_batches(
+    pairs: Iterable[Tuple[Sequence[int], Sequence[int]]],
+    batch_size: int,
+    *,
+    buckets: Sequence[int] = DEFAULT_BUCKETS,
+    pad_id: int = 0,
+    drop_remainder: bool = True,
+) -> Iterable[dict]:
+    """Group (src, tgt) token-sequence pairs into padded fixed-shape batches.
+
+    Each pair is assigned the bucket of ``max(len(src), len(tgt))``; batches
+    are emitted per-bucket when full. Yields dicts with ``src``/``tgt``
+    int32 arrays ``[batch, bucket]`` and float32 ``src_mask``/``tgt_mask``.
+    Only ``len(buckets)`` distinct shapes ever reach ``jit``.
+    """
+    pools: dict[int, List[Tuple]] = {}
+    for src, tgt in pairs:
+        b = bucket_length(max(len(src), len(tgt)), buckets)
+        pools.setdefault(b, []).append((src, tgt))
+        pool = pools[b]
+        if len(pool) == batch_size:
+            yield _emit(pool, b, pad_id)
+            pools[b] = []
+    if not drop_remainder:
+        for b, pool in pools.items():
+            if pool:
+                # pad the batch dim up with repeats so the shape stays fixed
+                while len(pool) < batch_size:
+                    pool.append(pool[-1])
+                yield _emit(pool, b, pad_id)
+
+
+def _emit(pool, bucket: int, pad_id: int) -> dict:
+    srcs, tgts, sms, tms = [], [], [], []
+    for s, t in pool:
+        ps, ms = pad_to(s, bucket, pad_id)
+        pt, mt = pad_to(t, bucket, pad_id)
+        srcs.append(ps)
+        tgts.append(pt)
+        sms.append(ms)
+        tms.append(mt)
+    return {
+        "src": np.stack(srcs),
+        "tgt": np.stack(tgts),
+        "src_mask": np.stack(sms),
+        "tgt_mask": np.stack(tms),
+        "bucket": bucket,
+    }

@@ -3,6 +3,8 @@ MNIST MLP, ImageNet family (AlexNet / GoogLeNet / ResNet-50), seq2seq LSTM —
 plus the Transformer LM the benchmark configs add (BASELINE.json)."""
 
 from chainermn_tpu.models.mlp import MLP
+from chainermn_tpu.models.seq2seq import Seq2Seq, seq2seq_loss
+from chainermn_tpu.models.transformer import TransformerLM, lm_loss
 from chainermn_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -14,6 +16,10 @@ from chainermn_tpu.models.resnet import (
 
 __all__ = [
     "MLP",
+    "Seq2Seq",
+    "seq2seq_loss",
+    "TransformerLM",
+    "lm_loss",
     "ResNet",
     "ResNet18",
     "ResNet34",

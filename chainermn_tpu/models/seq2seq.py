@@ -1,0 +1,115 @@
+"""LSTM encoder–decoder for machine translation — the reference's seq2seq
+benchmark workload (``examples/seq2seq/seq2seq.py`` (dagger), SURVEY.md
+sections 2.8, 7: "variable-length grads stress the packer").
+
+The TPU design problem the reference never faced (define-by-run handled
+ragged batches natively): under ``jit`` every shape is static, so variable
+length becomes **padding + masks + bucketing** (see
+:mod:`chainermn_tpu.datasets.bucketing` for the compile-cache-friendly
+bucketing discipline). The recurrence is ``nn.scan`` over the per-step
+stacked-cell module — one compiled loop, weights resident across steps, no
+per-step dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class _StackStep(nn.Module):
+    """One time-step through ``num_layers`` LSTM cells with mask freezing:
+    where mask == 0 (padding) carries and outputs hold their previous
+    values, so padded steps are no-ops — the static-shape answer to
+    variable-length sequences."""
+
+    hidden: int
+    num_layers: int
+
+    @nn.compact
+    def __call__(self, carry, xm):
+        x, m = xm  # x: [B, E], m: [B]
+        keep = m[:, None] > 0
+        new_carry = []
+        h = x
+        for i in range(self.num_layers):
+            cell = nn.OptimizedLSTMCell(self.hidden, name=f"lstm_{i}")
+            (c_i, h_i), out = cell(carry[i], h)
+            c_i = jnp.where(keep, c_i, carry[i][0])
+            h_i = jnp.where(keep, h_i, carry[i][1])
+            h = jnp.where(keep, out, carry[i][1])
+            new_carry.append((c_i, h_i))
+        return tuple(new_carry), h
+
+
+class _StackedLSTM(nn.Module):
+    """``num_layers`` LSTMs scanned over time: xs ``[B, T, E]``,
+    mask ``[B, T]`` → (outputs ``[B, T, H]``, final carry)."""
+
+    hidden: int
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, xs, mask, carry=None):
+        B = xs.shape[0]
+        if carry is None:
+            zeros = jnp.zeros((B, self.hidden), xs.dtype)
+            carry = tuple((zeros, zeros) for _ in range(self.num_layers))
+        scan = nn.scan(
+            _StackStep,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=1,
+            out_axes=1,
+        )(self.hidden, self.num_layers, name="step")
+        carry, outs = scan(carry, (xs, mask))
+        return outs, carry
+
+
+class Seq2Seq(nn.Module):
+    """Encoder–decoder LSTM MT model (teacher forcing).
+
+    Mirrors the reference example's shape: embed → stacked-LSTM encoder →
+    final state seeds the decoder → stacked-LSTM decoder → vocab projection.
+    """
+
+    src_vocab: int
+    tgt_vocab: int
+    embed: int = 256
+    hidden: int = 512
+    num_layers: int = 2
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        src_tokens: jax.Array,   # [B, Ts]
+        tgt_tokens: jax.Array,   # [B, Tt] (decoder input, BOS-shifted)
+        src_mask: jax.Array,     # [B, Ts]
+        tgt_mask: jax.Array,     # [B, Tt]
+    ) -> jax.Array:
+        src = nn.Embed(self.src_vocab, self.embed, name="src_emb")(src_tokens)
+        tgt = nn.Embed(self.tgt_vocab, self.embed, name="tgt_emb")(tgt_tokens)
+        src = src.astype(self.compute_dtype)
+        tgt = tgt.astype(self.compute_dtype)
+
+        _, enc_carry = _StackedLSTM(
+            self.hidden, self.num_layers, name="encoder"
+        )(src, src_mask.astype(src.dtype))
+        dec_out, _ = _StackedLSTM(
+            self.hidden, self.num_layers, name="decoder"
+        )(tgt, tgt_mask.astype(tgt.dtype), carry=enc_carry)
+        return nn.Dense(self.tgt_vocab, name="proj")(dec_out)
+
+
+def seq2seq_loss(logits, targets, tgt_mask):
+    """Masked cross-entropy over decoder outputs: ``targets`` are the
+    gold next tokens aligned with the decoder input positions."""
+    import optax
+
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    m = tgt_mask.astype(losses.dtype)
+    return (losses * m).sum() / jnp.maximum(m.sum(), 1)

@@ -1,0 +1,124 @@
+"""Transformer-base causal LM — the ``BASELINE.json`` benchmark config that
+exercises large embedding gradients and the double-buffered allreduce
+(``Transformer-base LM (new — large embedding grads, double-buffered
+allreduce)``). Not present in the reference (2017-era); shape follows the
+original Transformer-base (6 layers, d_model 512, 8 heads, d_ff 2048).
+
+TPU-first choices: bf16 compute / f32 params; pre-LN (stable without warmup
+gymnastics); pluggable attention so the same module runs single-device
+(flash/blockwise kernels, :mod:`chainermn_tpu.ops`) or sequence-parallel
+(ring/Ulysses locals from :mod:`chainermn_tpu.parallel` when applied inside
+``shard_map`` — pass ``attention_fn=lambda q,k,v,causal,scale:
+ring_attention_local(q, k, v, 'seq', causal=causal, scale=scale)``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.ops.attention import blockwise_attention
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    d_ff: int
+    compute_dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        D = x.shape[-1]
+        head_dim = D // self.num_heads
+        attn = self.attention_fn or blockwise_attention
+
+        h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
+        qkv = nn.Dense(
+            3 * D, use_bias=False,
+            dtype=self.compute_dtype, param_dtype=jnp.float32, name="qkv",
+        )(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, T = q.shape[:2]
+
+        def heads(t):
+            return t.reshape(B, T, self.num_heads, head_dim)
+
+        o = attn(heads(q), heads(k), heads(v), causal=True, scale=head_dim**-0.5)
+        o = nn.Dense(
+            D, use_bias=False,
+            dtype=self.compute_dtype, param_dtype=jnp.float32, name="proj",
+        )(o.reshape(B, T, D))
+        x = x + o
+
+        h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
+        h = nn.Dense(
+            self.d_ff, dtype=self.compute_dtype, param_dtype=jnp.float32,
+            name="ff_up",
+        )(h)
+        h = nn.gelu(h)
+        h = nn.Dense(
+            D, dtype=self.compute_dtype, param_dtype=jnp.float32, name="ff_down",
+        )(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Causal LM over integer tokens ``[B, T]`` → logits ``[B, T, vocab]``."""
+
+    vocab_size: int = 32000
+    num_layers: int = 6
+    num_heads: int = 8
+    d_model: int = 512
+    d_ff: int = 2048
+    max_len: int = 2048
+    compute_dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+    #: global position offset of the local sequence shard (sequence-parallel
+    #: runs pass ``axis_index * T_local`` so learned positions line up).
+    pos_offset: int = 0
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = True):
+        B, T = tokens.shape
+        emb = nn.Embed(
+            self.vocab_size, self.d_model, param_dtype=jnp.float32,
+            dtype=self.compute_dtype, name="tok_emb",
+        )
+        pos_emb = self.param(
+            "pos_emb",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+            jnp.float32,
+        )
+        x = emb(tokens)
+        pos = jax.lax.dynamic_slice_in_dim(pos_emb, self.pos_offset, T, axis=0)
+        x = x + pos[None].astype(self.compute_dtype)
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                num_heads=self.num_heads,
+                d_ff=self.d_ff,
+                compute_dtype=self.compute_dtype,
+                attention_fn=self.attention_fn,
+                name=f"block_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
+        logits = emb.attend(x.astype(jnp.float32))  # weight-tied output head
+        return logits
+
+
+def lm_loss(logits, tokens, mask=None):
+    """Next-token cross-entropy: predict ``tokens[:, 1:]`` from positions
+    ``[:, :-1]``; optional padding ``mask`` (same shape as tokens, 1=real)."""
+    import optax
+
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if mask is not None:
+        m = mask[:, 1:].astype(losses.dtype)
+        return (losses * m).sum() / jnp.maximum(m.sum(), 1)
+    return losses.mean()

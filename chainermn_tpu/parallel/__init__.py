@@ -29,6 +29,10 @@ def __getattr__(name):
         from chainermn_tpu.parallel import ulysses as _ul
 
         return getattr(_ul, name)
+    if name in ("pipeline_local", "make_pipeline", "stack_stage_params"):
+        from chainermn_tpu.parallel import pipeline as _pp
+
+        return getattr(_pp, name)
     raise AttributeError(name)
 
 
@@ -41,4 +45,7 @@ __all__ = [
     "make_ring_attention",
     "ulysses_attention_local",
     "make_ulysses_attention",
+    "pipeline_local",
+    "make_pipeline",
+    "stack_stage_params",
 ]

@@ -1,0 +1,160 @@
+"""Pipeline parallelism — GPipe-style micro-batching over a ``'stage'`` axis.
+
+The reference had the *pattern* but not the *engine* (SURVEY.md section 2.2):
+``MultiNodeChainList`` chained differentiable send/recv across ranks
+(``links/multi_node_chain_list.py`` (dagger)) with no micro-batching, so one
+rank computed while the others idled. This module supplies the real engine
+the TPU way: all stages live in ONE jitted SPMD program, the schedule is a
+``lax.scan`` over ``n_micro + n_stages - 1`` ticks (fill + steady state +
+drain), and stage-to-stage activation transfer is a ``ppermute`` shift that
+XLA lowers to neighbour ICI DMA.
+
+Differentiability is free: ``scan`` + ``ppermute`` both have transposes, so
+``jax.grad`` through the pipeline yields exactly the reversed-schedule
+backward pass the reference hand-encoded via ``Send.backward = recv``
+(``functions/point_to_point_communication.py`` (dagger)).
+
+Design constraints (idiomatic-TPU, deliberate):
+  - Homogeneous stages: every stage runs the same ``stage_fn`` with its own
+    slice of the stacked parameters (leading axis = stage). Embed/head
+    layers run *outside* the pipelined region — on TPU they are usually
+    data/tensor-sharded, not pipelined.
+  - During fill/drain, idle stages compute on zeros; their outputs are
+    masked out of the result. This wastes the classic GPipe bubble
+    (``(n_stages - 1) / (n_micro + n_stages - 1)``) — increase
+    ``n_micro`` to amortise, as with any GPipe schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+PyTree = Any
+
+
+def pipeline_local(
+    stage_fn: Callable,
+    stage_params: PyTree,
+    x: jax.Array,
+    axis_name: str = "stage",
+) -> jax.Array:
+    """Run the GPipe schedule over local shards — call INSIDE ``shard_map``.
+
+    Args:
+      stage_fn: ``stage_fn(params, x_microbatch) -> y_microbatch`` — one
+        pipeline stage; output shape/dtype must equal input shape/dtype
+        (stage-to-stage activations travel a homogeneous ring buffer).
+      stage_params: this stage's parameter pytree (the caller's in_spec
+        sharded the stacked params over ``axis_name`` and collapsed the
+        leading axis).
+      x: ``[n_micro, mb, ...]`` microbatched input (replicated across
+        stages; only stage 0 consumes it).
+
+    Returns:
+      ``[n_micro, mb, ...]`` — the final stage's outputs, valid on the last
+      stage and replicated to all stages for convenience (psum-broadcast).
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    n_micro = x.shape[0]
+    mb_shape = x.shape[1:]
+    total = n_micro + n - 1
+
+    # send stage i -> i+1 (last stage's output falls off the conveyor)
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # Stage 0 eats microbatch t (clamped; masked when t >= n_micro),
+        # other stages eat what arrived from the left neighbour.
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        feed = lax.dynamic_index_in_dim(x, mb_idx, keepdims=False)
+        inp = jnp.where(s == 0, feed, buf)
+        out = stage_fn(stage_params, inp)
+        # Valid iff this stage is currently working on a real microbatch:
+        # stage s works on microbatch t - s.
+        valid = jnp.logical_and(t - s >= 0, t - s < n_micro)
+        out = jnp.where(valid, out, jnp.zeros_like(out))
+        # Last stage banks its finished microbatch.
+        out_idx = jnp.clip(t - (n - 1), 0, n_micro - 1)
+        is_last = s == n - 1
+        bank = jnp.logical_and(is_last, t - (n - 1) >= 0)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(
+                bank,
+                out,
+                lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False),
+            ),
+            out_idx,
+            0,
+        )
+        buf = lax.ppermute(out, axis_name, perm)
+        return (buf, outputs), None
+
+    buf0 = jnp.zeros(mb_shape, x.dtype)
+    outputs0 = jnp.zeros((n_micro,) + mb_shape, x.dtype)
+    (_, outputs), _ = lax.scan(tick, (buf0, outputs0), jnp.arange(total))
+
+    # Replicate the last stage's result to every stage (mask + psum): the
+    # caller sees one coherent output regardless of stage placement.
+    outputs = jnp.where(s == n - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def make_pipeline(
+    stage_fn: Callable,
+    mesh: Mesh,
+    *,
+    axis_name: str = "stage",
+    n_microbatches: Optional[int] = None,
+):
+    """Build a jitted pipelined apply over stacked stage parameters.
+
+    Returns ``fn(stacked_params, x) -> y`` where ``stacked_params`` leaves
+    have leading dim ``n_stages`` (sharded over ``axis_name``) and ``x`` is
+    the full batch ``[batch, ...]``; the batch is split into
+    ``n_microbatches`` equal microbatches (default: the stage count, the
+    classic GPipe minimum for full utilisation... of the steady state).
+    """
+    from jax import shard_map
+
+    n_stages = mesh.shape[axis_name]
+    n_micro = n_microbatches or n_stages
+
+    param_spec = P(axis_name)
+    x_spec = P()  # replicated; stage 0 reads it
+
+    def local(stacked_params, x):
+        # shard_map gave us a [1, ...] slice of each stacked leaf: collapse.
+        params = jax.tree.map(lambda p: p[0], stacked_params)
+        batch = x.shape[0]
+        if batch % n_micro:
+            raise ValueError(
+                f"batch {batch} not divisible by n_microbatches {n_micro}"
+            )
+        mb = batch // n_micro
+        xm = x.reshape((n_micro, mb) + x.shape[1:])
+        ym = pipeline_local(stage_fn, params, xm, axis_name)
+        return ym.reshape((batch,) + ym.shape[2:])
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_spec, x_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def stack_stage_params(params_list) -> PyTree:
+    """Stack per-stage parameter pytrees (identical structure) along a new
+    leading axis — the layout ``make_pipeline`` expects, shardable over the
+    ``'stage'`` mesh axis."""
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *params_list)

@@ -1,0 +1,99 @@
+"""Pipeline-parallel engine tests: the GPipe schedule over the 8-way CPU
+mesh must equal sequential application of the stages on one device —
+values and gradients (SURVEY.md section 4 invariant). The reference had no
+such engine (MultiNodeChainList chained send/recv without micro-batching,
+SURVEY.md section 2.2) so these tests define the new contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.parallel.pipeline import (
+    make_pipeline,
+    pipeline_local,
+    stack_stage_params,
+)
+
+DIM = 8
+
+
+def stage_fn(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+def _params(seed, n_stages):
+    ks = jax.random.split(jax.random.PRNGKey(seed), n_stages)
+    return [
+        (
+            jax.random.normal(k, (DIM, DIM)) / jnp.sqrt(DIM),
+            jnp.zeros((DIM,)),
+        )
+        for k in ks
+    ]
+
+
+def _sequential(params_list, x):
+    for p in params_list:
+        x = stage_fn(p, x)
+    return x
+
+
+class TestPipeline:
+    @pytest.mark.parametrize("n_micro", [8, 16])
+    def test_matches_sequential(self, comm, n_micro):
+        n_stages = comm.size
+        params_list = _params(0, n_stages)
+        stacked = stack_stage_params(params_list)
+        batch = 32
+        x = jax.random.normal(jax.random.PRNGKey(1), (batch, DIM))
+
+        fn = make_pipeline(
+            stage_fn, comm.mesh, axis_name=comm.axis_name,
+            n_microbatches=n_micro,
+        )
+        out = fn(stacked, x)
+        ref = _sequential(params_list, x)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+
+    def test_grads_match_sequential(self, comm):
+        n_stages = comm.size
+        params_list = _params(2, n_stages)
+        stacked = stack_stage_params(params_list)
+        batch = 16
+        x = jax.random.normal(jax.random.PRNGKey(3), (batch, DIM))
+        y = jax.random.normal(jax.random.PRNGKey(4), (batch, DIM))
+
+        fn = make_pipeline(
+            stage_fn, comm.mesh, axis_name=comm.axis_name, n_microbatches=8
+        )
+
+        def loss_pipe(stacked):
+            return ((fn(stacked, x) - y) ** 2).mean()
+
+        def loss_seq(stacked):
+            params_list = [
+                jax.tree.map(lambda l: l[i], stacked)
+                for i in range(n_stages)
+            ]
+            return ((_sequential(params_list, x) - y) ** 2).mean()
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_seq = jax.grad(loss_seq)(stacked)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            ),
+            g_pipe,
+            g_seq,
+        )
+
+    def test_batch_divisibility_enforced(self, comm):
+        stacked = stack_stage_params(_params(5, comm.size))
+        fn = make_pipeline(
+            stage_fn, comm.mesh, axis_name=comm.axis_name, n_microbatches=7
+        )
+        x = jnp.zeros((16, DIM))
+        with pytest.raises(ValueError, match="not divisible"):
+            fn(stacked, x)
