@@ -3,6 +3,7 @@ MNIST MLP, ImageNet family (AlexNet / GoogLeNet / ResNet-50), seq2seq LSTM —
 plus the Transformer LM the benchmark configs add (BASELINE.json)."""
 
 from chainermn_tpu.models.mlp import MLP
+from chainermn_tpu.models.imagenet import AlexNet, GoogLeNet
 from chainermn_tpu.models.seq2seq import Seq2Seq, seq2seq_loss
 from chainermn_tpu.models.transformer import TransformerLM, lm_loss
 from chainermn_tpu.models.resnet import (
@@ -16,6 +17,8 @@ from chainermn_tpu.models.resnet import (
 
 __all__ = [
     "MLP",
+    "AlexNet",
+    "GoogLeNet",
     "Seq2Seq",
     "seq2seq_loss",
     "TransformerLM",

@@ -1,0 +1,150 @@
+"""AlexNet / GoogLeNet — the rest of the reference's ImageNet model family
+(``examples/imagenet/models/{alex,googlenet,googlenetbn}.py`` (dagger),
+SURVEY.md section 2.8). ResNet lives in :mod:`chainermn_tpu.models.resnet`.
+
+Same TPU conventions as ResNet: NHWC, bf16 compute / f32 params, optional
+sync-BN over a mesh axis for the BN variants.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
+
+
+class AlexNet(nn.Module):
+    """AlexNet (single-tower) — ``examples/imagenet/models/alex.py`` (dagger)."""
+
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, dtype=self.compute_dtype, param_dtype=jnp.float32
+        )
+        x = x.astype(self.compute_dtype)
+        x = nn.relu(conv(96, (11, 11), (4, 4), padding="VALID")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(256, (5, 5), padding=[(2, 2), (2, 2)])(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, (3, 3), padding=[(1, 1), (1, 1)])(x))
+        x = nn.relu(conv(384, (3, 3), padding=[(1, 1), (1, 1)])(x))
+        x = nn.relu(conv(256, (3, 3), padding=[(1, 1), (1, 1)])(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.compute_dtype,
+                             param_dtype=jnp.float32)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.compute_dtype,
+                             param_dtype=jnp.float32)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+class _Inception(nn.Module):
+    """Inception-v1 block; ``use_bn`` makes it the googlenetbn variant."""
+
+    c1: int
+    c3r: int
+    c3: int
+    c5r: int
+    c5: int
+    cp: int
+    use_bn: bool = False
+    bn_axis_name: Optional[Any] = None
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, dtype=self.compute_dtype, param_dtype=jnp.float32,
+            use_bias=not self.use_bn,
+        )
+
+        def act(h, name):
+            if self.use_bn:
+                h = MultiNodeBatchNormalization(
+                    use_running_average=not train,
+                    axis_name=self.bn_axis_name,
+                    dtype=self.compute_dtype,
+                    param_dtype=jnp.float32,
+                    name=f"bn_{name}",
+                )(h)
+            return nn.relu(h)
+
+        b1 = act(conv(self.c1, (1, 1), name="b1")(x), "b1")
+        b3 = act(conv(self.c3r, (1, 1), name="b3r")(x), "b3r")
+        b3 = act(conv(self.c3, (3, 3), padding=[(1, 1), (1, 1)], name="b3")(b3),
+                 "b3")
+        b5 = act(conv(self.c5r, (1, 1), name="b5r")(x), "b5r")
+        b5 = act(conv(self.c5, (5, 5), padding=[(2, 2), (2, 2)], name="b5")(b5),
+                 "b5")
+        bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+        bp = act(conv(self.cp, (1, 1), name="bp")(bp), "bp")
+        return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+_INCEPTION_CFG = [
+    # (c1, c3r, c3, c5r, c5, cp), with pool markers between stages
+    (64, 96, 128, 16, 32, 32),
+    (128, 128, 192, 32, 96, 64),
+    "pool",
+    (192, 96, 208, 16, 48, 64),
+    (160, 112, 224, 24, 64, 64),
+    (128, 128, 256, 24, 64, 64),
+    (112, 144, 288, 32, 64, 64),
+    (256, 160, 320, 32, 128, 128),
+    "pool",
+    (256, 160, 320, 32, 128, 128),
+    (384, 192, 384, 48, 128, 128),
+]
+
+
+class GoogLeNet(nn.Module):
+    """GoogLeNet (inception v1) — ``models/googlenet.py`` (dagger); with
+    ``use_bn=True`` it is the ``googlenetbn.py`` (dagger) variant whose BN
+    stats sync over ``bn_axis_name`` (the case the reference's
+    MultiNodeBatchNormalization existed for)."""
+
+    num_classes: int = 1000
+    use_bn: bool = False
+    bn_axis_name: Optional[Any] = None
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, dtype=self.compute_dtype, param_dtype=jnp.float32
+        )
+        x = x.astype(self.compute_dtype)
+        x = nn.relu(conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)])(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        x = nn.relu(conv(64, (1, 1))(x))
+        x = nn.relu(conv(192, (3, 3), padding=[(1, 1), (1, 1)])(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, cfg in enumerate(_INCEPTION_CFG):
+            if cfg == "pool":
+                x = nn.max_pool(
+                    x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+                )
+            else:
+                x = _Inception(
+                    *cfg,
+                    use_bn=self.use_bn,
+                    bn_axis_name=self.bn_axis_name,
+                    compute_dtype=self.compute_dtype,
+                    name=f"inc_{i}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,148 @@
+"""Distributed ImageNet training — the benchmark workload.
+
+Reference: ``examples/imagenet/train_imagenet.py`` (dagger) (SURVEY.md
+section 2.8): ``mpiexec -n N python train_imagenet.py --arch resnet50
+--communicator pure_nccl``. The BASELINE.json north star measures this
+workload's scaling efficiency.
+
+TPU-native: one process drives the mesh; the whole iteration (fwd, bwd,
+bf16-compressed gradient psum, SGD) is one jitted SPMD program.
+
+    python examples/imagenet/train_imagenet.py --arch resnet50 \
+        --communicator xla --iterations 100 [--profile /tmp/trace]
+
+Data: synthetic ImageNet-shaped samples by default (no network in this
+environment); pass ``--train-root`` with a directory of ``.npy`` pairs to
+train on real data — the training mechanics are identical.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+
+import chainermn_tpu
+from chainermn_tpu import global_except_hook
+from chainermn_tpu.models import AlexNet, GoogLeNet, ResNet50
+from chainermn_tpu.training import make_train_step
+from chainermn_tpu.training.train_step import create_train_state
+
+ARCHS = {
+    # dropout off: a per-step rng is model-specific plumbing this throughput
+    # example doesn't need
+    "alex": lambda bn_ax: AlexNet(dropout_rate=0.0),
+    "googlenet": lambda bn_ax: GoogLeNet(),
+    "googlenetbn": lambda bn_ax: GoogLeNet(use_bn=True, bn_axis_name=bn_ax),
+    "resnet50": lambda bn_ax: ResNet50(bn_axis_name=bn_ax),
+}
+
+
+def synthetic_batch(rng, batch, size):
+    x = rng.standard_normal((batch, size, size, 3), np.float32)
+    y = rng.integers(0, 1000, size=(batch,)).astype(np.int32)
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="ChainerMN-TPU example: ImageNet")
+    p.add_argument("--arch", default="resnet50", choices=sorted(ARCHS))
+    p.add_argument("--communicator", default="xla")
+    p.add_argument("--batchsize", type=int, default=64,
+                   help="per-mesh-slot batch size")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--iterations", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--double-buffering", action="store_true")
+    p.add_argument("--allreduce-grad-dtype", default="bfloat16")
+    p.add_argument("--profile", default=None,
+                   help="directory for a jax.profiler trace of iters 10-20")
+    p.add_argument("--train-root", default=None)
+    args = p.parse_args(argv)
+
+    comm = chainermn_tpu.create_communicator(
+        args.communicator,
+        allreduce_grad_dtype=args.allreduce_grad_dtype or None,
+    )
+    global_except_hook._add_hook()
+    if comm.rank == 0:
+        print(f"communicator: {comm}  arch: {args.arch}")
+
+    model = ARCHS[args.arch](comm.bn_axis_name)
+    global_batch = args.batchsize * comm.size
+    rng = np.random.default_rng(0)
+    x0, y0 = synthetic_batch(rng, global_batch, args.image_size)
+
+    variables = jax.jit(
+        lambda k, xb: model.init(k, xb, train=True)
+    )(jax.random.key(0), jnp.asarray(x0[: min(2, global_batch)]))
+    batch_stats = variables.get("batch_stats", {})
+
+    def loss_fn(params, batch, model_state):
+        xb, yb = batch
+        vars_in = {"params": params}
+        mutable = []
+        if batch_stats:
+            vars_in["batch_stats"] = model_state
+            mutable = ["batch_stats"]
+        if mutable:
+            logits, mutated = model.apply(
+                vars_in, xb, train=True, mutable=mutable
+            )
+        else:
+            logits = model.apply(vars_in, xb, train=True)
+            mutated = {"batch_stats": model_state}
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb
+        ).mean()
+        acc = (logits.argmax(-1) == yb).mean()
+        return loss, ({"accuracy": acc}, mutated.get("batch_stats", ()))
+
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(args.lr, momentum=0.9),
+        comm,
+        double_buffering=args.double_buffering,
+    )
+    state = create_train_state(
+        variables["params"], optimizer, comm, model_state=batch_stats
+    )
+    step = make_train_step(loss_fn, optimizer, comm)
+
+    t0 = time.perf_counter()
+    for it in range(args.iterations):
+        if args.profile and it == 10:
+            jax.profiler.start_trace(args.profile)
+        x, y = synthetic_batch(rng, global_batch, args.image_size)
+        state, metrics = step(state, (jnp.asarray(x), jnp.asarray(y)))
+        if args.profile and it == 20:
+            jax.block_until_ready(state.params)
+            jax.profiler.stop_trace()
+            if comm.rank == 0:
+                print(f"profile written to {args.profile}")
+        if comm.rank == 0 and (it + 1) % 10 == 0:
+            jax.block_until_ready(metrics["loss"])
+            dt = time.perf_counter() - t0
+            ips = global_batch * (it + 1) / dt
+            print(
+                f"iter {it + 1}/{args.iterations} "
+                f"loss={float(metrics['loss']):.4f} "
+                f"acc={float(metrics['accuracy']):.4f} ({ips:.1f} img/s)"
+            )
+    jax.block_until_ready(state.params)
+    if comm.rank == 0:
+        total = time.perf_counter() - t0
+        print(
+            f"done: {args.iterations} iters, "
+            f"{global_batch * args.iterations / total:.1f} images/sec"
+        )
+
+
+if __name__ == "__main__":
+    main()

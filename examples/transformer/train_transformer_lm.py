@@ -1,0 +1,205 @@
+"""Transformer-base LM training — the double-buffered-allreduce workload.
+
+BASELINE.json config: "Transformer-base LM (new — large embedding grads,
+double-buffered allreduce)". Demonstrates the v1.3-era optimizer features
+(``double_buffering=True``, ``allreduce_grad_dtype='bfloat16'`` — SURVEY.md
+section 2.3) on a modern workload, plus optional ring-attention sequence
+parallelism for long context (``--sequence-parallel``).
+
+    python examples/transformer/train_transformer_lm.py \
+        --communicator naive --iterations 40 --double-buffering
+    python examples/transformer/train_transformer_lm.py \
+        --communicator naive --sequence-parallel --seq-len 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+
+import chainermn_tpu
+from chainermn_tpu import global_except_hook
+from chainermn_tpu.models import TransformerLM, lm_loss
+from chainermn_tpu.training import make_train_step
+from chainermn_tpu.training.train_step import create_train_state
+
+VOCAB = 1024
+
+
+def synthetic_tokens(rng, batch, seqlen):
+    """Markov-ish synthetic text: next token correlates with current."""
+    x = np.zeros((batch, seqlen), np.int32)
+    x[:, 0] = rng.integers(0, VOCAB, size=batch)
+    drift = rng.integers(1, 17, size=batch)
+    for t in range(1, seqlen):
+        stay = rng.random(batch) < 0.8
+        x[:, t] = np.where(stay, (x[:, t - 1] + drift) % VOCAB,
+                           rng.integers(0, VOCAB, size=batch))
+    return x
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="ChainerMN-TPU example: Transformer LM"
+    )
+    p.add_argument("--communicator", default="naive")
+    p.add_argument("--batchsize", type=int, default=8,
+                   help="per-mesh-slot batch size (data-parallel mode)")
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--iterations", type=int, default=40)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--double-buffering", action="store_true")
+    p.add_argument("--allreduce-grad-dtype", default="bfloat16")
+    p.add_argument("--sequence-parallel", action="store_true",
+                   help="shard the sequence over the mesh (ring attention)")
+    p.add_argument("--num-layers", type=int, default=6)
+    p.add_argument("--d-model", type=int, default=512)
+    args = p.parse_args(argv)
+
+    comm = chainermn_tpu.create_communicator(
+        args.communicator,
+        allreduce_grad_dtype=args.allreduce_grad_dtype or None,
+    )
+    global_except_hook._add_hook()
+    if comm.rank == 0:
+        print(f"communicator: {comm}  sp={args.sequence_parallel}")
+
+    compute_dtype = (
+        jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    )
+    rng = np.random.default_rng(0)
+
+    if args.sequence_parallel:
+        run_sequence_parallel(args, comm, compute_dtype, rng)
+    else:
+        run_data_parallel(args, comm, compute_dtype, rng)
+
+
+def run_data_parallel(args, comm, compute_dtype, rng):
+    model = TransformerLM(
+        vocab_size=VOCAB, num_layers=args.num_layers,
+        d_model=args.d_model, d_ff=4 * args.d_model,
+        max_len=args.seq_len, compute_dtype=compute_dtype,
+    )
+    global_batch = args.batchsize * comm.size
+    tokens0 = synthetic_tokens(rng, global_batch, args.seq_len)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.asarray(tokens0[:1])
+    )["params"]
+
+    def loss_fn(params, tokens):
+        logits = model.apply({"params": params}, tokens)
+        return lm_loss(logits, tokens)
+
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adamw(args.lr), comm,
+        double_buffering=args.double_buffering,
+    )
+    state = create_train_state(params, optimizer, comm)
+    step = make_train_step(loss_fn, optimizer, comm)
+
+    t0 = time.perf_counter()
+    for it in range(args.iterations):
+        tokens = synthetic_tokens(rng, global_batch, args.seq_len)
+        state, metrics = step(state, jnp.asarray(tokens))
+        if comm.rank == 0 and (it + 1) % 10 == 0:
+            jax.block_until_ready(metrics["loss"])
+            tps = global_batch * args.seq_len * (it + 1) / (
+                time.perf_counter() - t0
+            )
+            print(
+                f"iter {it + 1}/{args.iterations} "
+                f"loss={float(metrics['loss']):.4f} ({tps:,.0f} tok/s)"
+            )
+    jax.block_until_ready(state.params)
+    if comm.rank == 0:
+        print("done (data-parallel)")
+
+
+def run_sequence_parallel(args, comm, compute_dtype, rng):
+    """Long-context mode: ONE sequence sharded over the whole mesh, ring
+    attention streaming K/V blocks over ICI."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.parallel.ring_attention import ring_attention_local
+
+    ax = comm.axis_name
+    n = comm.size
+    if args.seq_len % n:
+        raise SystemExit(f"--seq-len must be divisible by mesh size {n}")
+    t_local = args.seq_len // n
+
+    def ring_attn(q, k, v, *, causal, scale):
+        return ring_attention_local(q, k, v, ax, causal=causal, scale=scale)
+
+    model = TransformerLM(
+        vocab_size=VOCAB, num_layers=args.num_layers,
+        d_model=args.d_model, d_ff=4 * args.d_model,
+        max_len=args.seq_len, compute_dtype=compute_dtype,
+        attention_fn=ring_attn,
+    )
+    ref = TransformerLM(
+        vocab_size=VOCAB, num_layers=args.num_layers,
+        d_model=args.d_model, d_ff=4 * args.d_model,
+        max_len=args.seq_len, compute_dtype=compute_dtype,
+    )
+    batch = 2
+    tokens0 = synthetic_tokens(rng, batch, args.seq_len)
+    params = jax.jit(ref.init)(jax.random.key(0), jnp.asarray(tokens0[:1]))
+    opt = optax.adamw(args.lr)
+    opt_state = opt.init(params)
+
+    def local_step(params, opt_state, tokens):
+        idx = jax.lax.axis_index(ax)
+
+        def loss_fn(p):
+            pos = p["params"]["pos_emb"]
+            rolled = jnp.roll(pos, -idx * t_local, axis=0)
+            logits = model.apply(
+                {"params": {**p["params"], "pos_emb": rolled}}, tokens
+            )
+            return lm_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.lax.pmean(grads, ax)
+        loss = jax.lax.pmean(loss, ax)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(
+        shard_map(
+            local_step,
+            mesh=comm.mesh,
+            in_specs=(P(), P(), P(None, ax)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+
+    t0 = time.perf_counter()
+    for it in range(args.iterations):
+        tokens = synthetic_tokens(rng, batch, args.seq_len)
+        params, opt_state, loss = step(params, opt_state, jnp.asarray(tokens))
+        if comm.rank == 0 and (it + 1) % 10 == 0:
+            jax.block_until_ready(loss)
+            tps = batch * args.seq_len * (it + 1) / (time.perf_counter() - t0)
+            print(
+                f"iter {it + 1}/{args.iterations} loss={float(loss):.4f} "
+                f"({tps:,.0f} tok/s, seq {args.seq_len} over {n} shards)"
+            )
+    jax.block_until_ready(params)
+    if comm.rank == 0:
+        print("done (sequence-parallel)")
+
+
+if __name__ == "__main__":
+    main()

@@ -130,3 +130,33 @@ class TestResNetDistributed:
             new_state.params,
             expected_params,
         )
+
+
+class TestImageNetFamily:
+    def test_alexnet_shapes(self):
+        from chainermn_tpu.models import AlexNet
+
+        model = AlexNet(num_classes=10, compute_dtype=jnp.float32,
+                        dropout_rate=0.0)
+        x = jnp.ones((2, 224, 224, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        logits = model.apply(variables, x, train=False)
+        assert logits.shape == (2, 10)
+
+    def test_googlenet_shapes(self):
+        from chainermn_tpu.models import GoogLeNet
+
+        model = GoogLeNet(num_classes=10, compute_dtype=jnp.float32)
+        x = jnp.ones((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        logits = model.apply(variables, x, train=False)
+        assert logits.shape == (2, 10)
+
+    def test_googlenetbn_has_batch_stats(self):
+        from chainermn_tpu.models import GoogLeNet
+
+        model = GoogLeNet(num_classes=10, use_bn=True,
+                          compute_dtype=jnp.float32)
+        x = jnp.ones((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        assert "batch_stats" in variables
