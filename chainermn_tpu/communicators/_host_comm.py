@@ -53,15 +53,55 @@ def _padded_to_obj(buf: np.ndarray) -> Any:
 
 class HostComm:
     """Process-level collectives. ``rank``/``size`` are process index/count —
-    the host-plane analog of the reference's MPI world."""
+    the host-plane analog of the reference's MPI world.
+
+    Transport selection (the reference selected MPI flavors per
+    communicator; here it is per host-plane):
+      - ``CHAINERMN_TPU_{RANK,SIZE,COORD}`` set → the native C++ TCP mesh
+        (:mod:`chainermn_tpu.native.tcp_comm`), which also enables true
+        point-to-point ``send_obj``/``recv_obj``;
+      - otherwise multi-process JAX → ``multihost_utils`` over DCN;
+      - single process → no-op fast paths.
+    """
 
     def __init__(self) -> None:
-        self.rank = jax.process_index()
-        self.size = jax.process_count()
+        self.tcp = None
+        try:
+            from chainermn_tpu.native.tcp_comm import TcpHostComm
+
+            self.tcp = TcpHostComm.from_env()
+        except Exception:
+            self.tcp = None
+        if self.tcp is not None:
+            self.rank = self.tcp.rank
+            self.size = self.tcp.size
+        else:
+            self.rank = jax.process_index()
+            self.size = jax.process_count()
+
+    # -- point-to-point (native transport only) ----------------------------
+
+    def send_obj(self, obj: Any, dest: int) -> None:
+        if self.tcp is None:
+            raise NotImplementedError(
+                "point-to-point host sends need the native TCP backend: set "
+                "CHAINERMN_TPU_RANK/SIZE/COORD (see chainermn_tpu.native)"
+            )
+        self.tcp.send_obj(obj, dest)
+
+    def recv_obj(self, source: int) -> Any:
+        if self.tcp is None:
+            raise NotImplementedError(
+                "point-to-point host recvs need the native TCP backend: set "
+                "CHAINERMN_TPU_RANK/SIZE/COORD (see chainermn_tpu.native)"
+            )
+        return self.tcp.recv_obj(source)
 
     # -- collectives -------------------------------------------------------
 
     def barrier(self, tag: str = "barrier") -> None:
+        if self.tcp is not None:
+            return self.tcp.barrier()
         if not _is_multiprocess():
             return
         from jax.experimental import multihost_utils
@@ -69,6 +109,8 @@ class HostComm:
         multihost_utils.sync_global_devices(tag)
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        if self.tcp is not None:
+            return self.tcp.bcast_obj(obj, root)
         if not _is_multiprocess():
             return obj
         from jax.experimental import multihost_utils
@@ -82,6 +124,8 @@ class HostComm:
         return _padded_to_obj(np.asarray(out))
 
     def allgather_obj(self, obj: Any) -> list[Any]:
+        if self.tcp is not None:
+            return self.tcp.allgather_obj(obj)
         if not _is_multiprocess():
             return [obj]
         from jax.experimental import multihost_utils
@@ -98,6 +142,8 @@ class HostComm:
         return everyone if self.rank == root else None
 
     def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
+        if self.tcp is not None:
+            return self.tcp.scatter_obj(objs, root)
         if not _is_multiprocess():
             assert objs is not None
             return objs[0]

@@ -294,16 +294,20 @@ class CommunicatorBase:
         return self.host.allreduce_obj(obj, op)
 
     def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
-        raise NotImplementedError(
-            "point-to-point host sends need the native TCP backend "
-            "(chainermn_tpu.native) or a multi-process runtime; in-program "
-            "sends live in chainermn_tpu.functions.point_to_point"
-        )
+        """Point-to-point host send (reference: ``send_obj`` via MPI). Rides
+        the native TCP backend (:mod:`chainermn_tpu.native`); the channel is
+        per-pair FIFO, so ``tag`` is carried in-band and matched on receive
+        (device-plane p2p lives in :mod:`chainermn_tpu.functions`)."""
+        self.host.send_obj((tag, obj), self._root_process(dest))
 
     def recv_obj(self, source: int, tag: int = 0) -> Any:
-        raise NotImplementedError(
-            "see send_obj; use chainermn_tpu.functions for device-plane p2p"
-        )
+        got_tag, obj = self.host.recv_obj(self._root_process(source))
+        if got_tag != tag:
+            raise RuntimeError(
+                f"recv_obj tag mismatch: expected {tag}, got {got_tag} "
+                f"(per-pair channels are FIFO; interleave tags in send order)"
+            )
+        return obj
 
     def barrier(self) -> None:
         self.host.barrier()

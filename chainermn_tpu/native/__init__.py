@@ -1,0 +1,62 @@
+"""Native (C++) runtime components.
+
+The reference's native layer was its transport binding (Cython NCCL,
+``chainermn/nccl/nccl.pyx`` (dagger), plus mpi4py's C MPI — SURVEY.md
+section 2.1). The TPU build needs no hand-written *device* transport (XLA
+collectives own ICI/DCN), so the native layer lives where native still
+matters on TPU:
+
+- :mod:`chainermn_tpu.native.tcp_comm` — full-mesh TCP host-plane
+  communicator (``src/host_comm.cpp``): the MPI-replacement byte transport
+  for pickled-object collectives, point-to-point ``send_obj``/``recv_obj``,
+  and rendezvous, with rank 0 as coordinator (the role of MPI_Init + the
+  NCCL-unique-id broadcast, SURVEY.md section 3.1).
+
+The shared library is compiled on demand with ``g++`` (no build step needed
+at install time) and cached under ``native/build/``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+
+_SRC = Path(__file__).parent / "src" / "host_comm.cpp"
+_BUILD_DIR = Path(__file__).parent / "build"
+_LIB = _BUILD_DIR / "libhostcomm.so"
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def lib_path(rebuild: bool = False) -> Path:
+    """Path to the compiled host-comm library, building it if needed."""
+    if _LIB.exists() and not rebuild:
+        if _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+            return _LIB
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O2", "-shared", "-fPIC", "-Wall",
+        "-o", str(_LIB), str(_SRC),
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeBuildError(f"building {_LIB.name} failed: {e}") from e
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"building {_LIB.name} failed:\n{proc.stderr[-2000:]}"
+        )
+    return _LIB
+
+
+def available() -> bool:
+    """True when the native library is present or buildable."""
+    try:
+        lib_path()
+        return True
+    except NativeBuildError:
+        return False

@@ -1,0 +1,255 @@
+// Native host-plane communicator: full-mesh TCP point-to-point transport.
+//
+// Role in the framework (SURVEY.md section 2.1-2.2): the reference's only
+// native component was its transport binding (Cython NCCL + mpi4py's C MPI).
+// On TPU the *device* plane needs no hand-written transport (XLA collectives
+// own ICI/DCN), but the *host* plane — pickled-object collectives, dataset
+// scatter, checkpoint agreement, the things the reference ran over MPI —
+// still needs a process-to-process byte transport. This file is that
+// transport: a dependency-free TCP mesh with the same bootstrap role
+// MPI_Init + ncclCommInitRank played (rank 0 is the rendezvous, like the
+// reference's NCCL-unique-id broadcast, SURVEY.md section 3.1).
+//
+// Framing: every message is [int64 length | payload]. Ordering: one socket
+// per rank pair, so per-pair FIFO, matching MPI's per-channel ordering that
+// the reference's delegate-variable discipline relied on.
+//
+// Build: g++ -O2 -shared -fPIC (see build.py); loaded via ctypes.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Comm {
+  int rank = -1;
+  int size = 0;
+  int listen_fd = -1;
+  std::vector<int> peer;  // fd per rank; own slot = -1
+};
+
+bool send_all(int fd, const void* buf, int64_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, static_cast<size_t>(n), MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= w;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, int64_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, static_cast<size_t>(n), 0);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;  // peer closed
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+int make_listen_socket(int port, int backlog) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  if (::listen(fd, backlog) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int get_port(int fd) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) < 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+int connect_to(const char* host, int port, int retries_ms) {
+  for (int waited = 0;; waited += 50) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return -1;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    if (waited >= retries_ms) return -1;
+    ::usleep(50 * 1000);
+  }
+}
+
+struct PeerInfo {
+  char host[64];
+  int32_t port;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Bootstrap a full-mesh communicator. rank 0 listens on coord_port of
+// coord_host; everyone else rendezvouses there (the MPI_Init /
+// nccl-unique-id role). Returns an opaque handle or nullptr.
+void* hc_init(int rank, int size, const char* coord_host, int coord_port) {
+  auto* c = new Comm;
+  c->rank = rank;
+  c->size = size;
+  c->peer.assign(static_cast<size_t>(size), -1);
+  if (size == 1) return c;
+  std::vector<PeerInfo> table(static_cast<size_t>(size));
+
+  if (rank == 0) {
+    c->listen_fd = make_listen_socket(coord_port, size + 8);
+    if (c->listen_fd < 0) goto fail;
+    // Registration: collect every rank's (host, listen port).
+    std::strncpy(table[0].host, "127.0.0.1", sizeof(table[0].host));
+    table[0].port = coord_port;
+    for (int i = 1; i < size; ++i) {
+      sockaddr_in peer_addr{};
+      socklen_t len = sizeof(peer_addr);
+      int fd = ::accept(c->listen_fd,
+                        reinterpret_cast<sockaddr*>(&peer_addr), &len);
+      if (fd < 0) goto fail;
+      int32_t peer_rank, peer_port;
+      if (!recv_all(fd, &peer_rank, 4) || !recv_all(fd, &peer_port, 4))
+        goto fail;
+      if (peer_rank < 1 || peer_rank >= size || c->peer[peer_rank] != -1)
+        goto fail;
+      c->peer[peer_rank] = fd;
+      PeerInfo& info = table[static_cast<size_t>(peer_rank)];
+      ::inet_ntop(AF_INET, &peer_addr.sin_addr, info.host, sizeof(info.host));
+      info.port = peer_port;
+    }
+    // Broadcast the table; registrant connections stay as the 0<->r links.
+    for (int i = 1; i < size; ++i)
+      if (!send_all(c->peer[i], table.data(),
+                    static_cast<int64_t>(sizeof(PeerInfo)) * size))
+        goto fail;
+  } else {
+    c->listen_fd = make_listen_socket(0, size + 8);
+    if (c->listen_fd < 0) goto fail;
+    int fd0 = connect_to(coord_host, coord_port, /*retries_ms=*/30000);
+    if (fd0 < 0) goto fail;
+    int32_t my_rank = rank, my_port = get_port(c->listen_fd);
+    if (!send_all(fd0, &my_rank, 4) || !send_all(fd0, &my_port, 4)) goto fail;
+    c->peer[0] = fd0;
+    if (!recv_all(fd0, table.data(),
+                  static_cast<int64_t>(sizeof(PeerInfo)) * size))
+      goto fail;
+    // Deterministic pairing (no accept/connect deadlock): rank r initiates
+    // to ranks 1..r-1 and accepts from ranks r+1..size-1.
+    for (int j = 1; j < rank; ++j) {
+      int fd = connect_to(table[j].host, table[j].port, 30000);
+      if (fd < 0) goto fail;
+      int32_t my = rank;
+      if (!send_all(fd, &my, 4)) goto fail;
+      c->peer[j] = fd;
+    }
+    for (int j = rank + 1; j < size; ++j) {
+      int fd = ::accept(c->listen_fd, nullptr, nullptr);
+      if (fd < 0) goto fail;
+      int32_t who;
+      if (!recv_all(fd, &who, 4)) goto fail;
+      if (who <= rank || who >= size || c->peer[who] != -1) goto fail;
+      c->peer[who] = fd;
+    }
+  }
+  return c;
+
+fail:
+  for (int fd : c->peer)
+    if (fd >= 0) ::close(fd);
+  if (c->listen_fd >= 0) ::close(c->listen_fd);
+  delete c;
+  return nullptr;
+}
+
+int hc_rank(void* h) { return static_cast<Comm*>(h)->rank; }
+int hc_size(void* h) { return static_cast<Comm*>(h)->size; }
+
+// Framed send: [int64 length | payload]. Per-pair FIFO ordering.
+int hc_send(void* h, int dst, const void* buf, int64_t n) {
+  auto* c = static_cast<Comm*>(h);
+  if (dst < 0 || dst >= c->size || dst == c->rank) return -1;
+  if (!send_all(c->peer[dst], &n, 8)) return -1;
+  if (n > 0 && !send_all(c->peer[dst], buf, n)) return -1;
+  return 0;
+}
+
+// Blocking: reads the next message's length header from src (the payload
+// must then be consumed with hc_recv_body).
+int64_t hc_recv_size(void* h, int src) {
+  auto* c = static_cast<Comm*>(h);
+  if (src < 0 || src >= c->size || src == c->rank) return -1;
+  int64_t n = -1;
+  if (!recv_all(c->peer[src], &n, 8)) return -1;
+  return n;
+}
+
+int hc_recv_body(void* h, int src, void* buf, int64_t n) {
+  auto* c = static_cast<Comm*>(h);
+  if (src < 0 || src >= c->size || src == c->rank) return -1;
+  if (n > 0 && !recv_all(c->peer[src], buf, n)) return -1;
+  return 0;
+}
+
+// Dissemination barrier: log2(size) rounds of token exchange.
+int hc_barrier(void* h) {
+  auto* c = static_cast<Comm*>(h);
+  for (int dist = 1; dist < c->size; dist <<= 1) {
+    int to = (c->rank + dist) % c->size;
+    int from = (c->rank - dist % c->size + c->size) % c->size;
+    int64_t token = 0;
+    if (hc_send(h, to, nullptr, 0) != 0) return -1;
+    if (hc_recv_size(h, from) != 0) return -1;
+    (void)token;
+  }
+  return 0;
+}
+
+void hc_finalize(void* h) {
+  auto* c = static_cast<Comm*>(h);
+  for (int fd : c->peer)
+    if (fd >= 0) ::close(fd);
+  if (c->listen_fd >= 0) ::close(c->listen_fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,193 @@
+"""ctypes wrapper over the native TCP host communicator
+(``src/host_comm.cpp``) plus object collectives built on its framed
+point-to-point sends.
+
+This is the MPI stand-in for the host plane (SURVEY.md section 5
+"distributed communication backend"): pickled-object transport for dataset
+scatter, checkpoint agreement and the ``*_obj`` API, with per-pair FIFO
+ordering (the guarantee the reference's delegate-variable deadlock
+discipline was built on).
+
+Bootstrap (environment, mirroring the reference's mpiexec-provided world):
+  CHAINERMN_TPU_RANK / CHAINERMN_TPU_SIZE — this process's rank and world
+  size; CHAINERMN_TPU_COORD — ``host:port`` of rank 0's listener.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+from typing import Any, Callable, Optional, Sequence
+
+from chainermn_tpu.native import lib_path
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(str(lib_path()))
+        lib.hc_init.restype = ctypes.c_void_p
+        lib.hc_init.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.hc_rank.argtypes = [ctypes.c_void_p]
+        lib.hc_size.argtypes = [ctypes.c_void_p]
+        lib.hc_send.restype = ctypes.c_int
+        lib.hc_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.hc_recv_size.restype = ctypes.c_int64
+        lib.hc_recv_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.hc_recv_body.restype = ctypes.c_int
+        lib.hc_recv_body.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.hc_barrier.restype = ctypes.c_int
+        lib.hc_barrier.argtypes = [ctypes.c_void_p]
+        lib.hc_finalize.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class TcpHostComm:
+    """Full-mesh TCP communicator over processes.
+
+    The collective algorithms are rooted linear exchanges — object payloads
+    are small (metrics dicts, dataset indices, checkpoint manifests), so
+    simplicity beats tree algorithms here; the bulk data path is XLA's.
+    """
+
+    def __init__(self, rank: int, size: int, coord: str) -> None:
+        lib = _load()
+        host, port = coord.rsplit(":", 1)
+        self._h = lib.hc_init(rank, size, host.encode(), int(port))
+        if not self._h:
+            raise RuntimeError(
+                f"TcpHostComm bootstrap failed (rank {rank}/{size} @ {coord})"
+            )
+        self.rank = rank
+        self.size = size
+
+    @classmethod
+    def from_env(cls) -> Optional["TcpHostComm"]:
+        """Build from CHAINERMN_TPU_{RANK,SIZE,COORD}; None when unset."""
+        rank = os.environ.get("CHAINERMN_TPU_RANK")
+        size = os.environ.get("CHAINERMN_TPU_SIZE")
+        coord = os.environ.get("CHAINERMN_TPU_COORD")
+        if rank is None or size is None or coord is None:
+            return None
+        return cls(int(rank), int(size), coord)
+
+    # -- point-to-point (the reference's send_obj/recv_obj) ----------------
+
+    def send_obj(self, obj: Any, dest: int) -> None:
+        payload = pickle.dumps(obj)
+        rc = _load().hc_send(self._h, dest, payload, len(payload))
+        if rc != 0:
+            raise RuntimeError(f"send_obj to {dest} failed")
+
+    def recv_obj(self, source: int) -> Any:
+        lib = _load()
+        n = lib.hc_recv_size(self._h, source)
+        if n < 0:
+            raise RuntimeError(f"recv_obj from {source} failed")
+        buf = ctypes.create_string_buffer(int(n))
+        if lib.hc_recv_body(self._h, source, buf, n) != 0:
+            raise RuntimeError(f"recv_obj from {source} failed")
+        return pickle.loads(buf.raw[:n])
+
+    # -- collectives -------------------------------------------------------
+
+    def barrier(self) -> None:
+        if self.size == 1:
+            return
+        if _load().hc_barrier(self._h) != 0:
+            raise RuntimeError("barrier failed")
+
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        if self.size == 1:
+            return obj
+        if self.rank == root:
+            for r in range(self.size):
+                if r != root:
+                    self.send_obj(obj, r)
+            return obj
+        return self.recv_obj(root)
+
+    def gather_obj(self, obj: Any, root: int = 0):
+        if self.size == 1:
+            return [obj]
+        if self.rank == root:
+            out: list[Any] = [None] * self.size
+            out[root] = obj
+            for r in range(self.size):
+                if r != root:
+                    out[r] = self.recv_obj(r)
+            return out
+        self.send_obj(obj, root)
+        return None
+
+    def allgather_obj(self, obj: Any) -> list[Any]:
+        gathered = self.gather_obj(obj, 0)
+        return self.bcast_obj(gathered, 0)
+
+    def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
+        if self.size == 1:
+            assert objs is not None
+            return objs[0]
+        if self.rank == root:
+            assert objs is not None and len(objs) == self.size
+            for r in range(self.size):
+                if r != root:
+                    self.send_obj(objs[r], r)
+            return objs[root]
+        return self.recv_obj(root)
+
+    def alltoall_obj(self, objs: Sequence[Any]) -> list[Any]:
+        """objs[j] goes to rank j; returns what every rank sent here.
+
+        Ring schedule: round ``d`` sends to ``rank+d`` and receives from
+        ``rank-d``. TCP's kernel buffering absorbs the sends (no MPI-style
+        rendezvous), so send-then-recv cannot deadlock for the small
+        pickled payloads this host plane carries; when the two partners
+        coincide (round size/2), rank order decides who sends first."""
+        assert len(objs) == self.size
+        out: list[Any] = [None] * self.size
+        out[self.rank] = objs[self.rank]
+        for d in range(1, self.size):
+            to = (self.rank + d) % self.size
+            frm = (self.rank - d) % self.size
+            if to == frm and self.rank > to:
+                out[frm] = self.recv_obj(frm)
+                self.send_obj(objs[to], to)
+            else:
+                self.send_obj(objs[to], to)
+                out[frm] = self.recv_obj(frm)
+        return out
+
+    def allreduce_obj(
+        self, obj: Any, op: Callable[[Any, Any], Any] | None = None
+    ) -> Any:
+        items = self.allgather_obj(obj)
+        if op is None:
+            from chainermn_tpu.communicators._host_comm import _default_sum
+
+            op = _default_sum
+        out = items[0]
+        for it in items[1:]:
+            out = op(out, it)
+        return out
+
+    def finalize(self) -> None:
+        if self._h:
+            _load().hc_finalize(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort
+        try:
+            self.finalize()
+        except Exception:
+            pass

@@ -1,0 +1,49 @@
+"""Worker script for tests/test_native_comm.py: exercises every TcpHostComm
+operation across real OS processes (the true multi-process analogue of the
+reference's ``mpiexec -n N pytest`` harness, SURVEY.md section 4)."""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+from chainermn_tpu.native.tcp_comm import TcpHostComm
+
+
+def main():
+    rank, size, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    c = TcpHostComm(rank, size, coord)
+
+    assert c.bcast_obj({"x": 42} if rank == 0 else None, 0) == {"x": 42}
+
+    g = c.gather_obj(rank * 10, 0)
+    if rank == 0:
+        assert g == [i * 10 for i in range(size)], g
+    else:
+        assert g is None
+
+    assert c.allgather_obj(("r", rank)) == [("r", i) for i in range(size)]
+
+    got = c.scatter_obj(
+        [f"item{i}" for i in range(size)] if rank == 0 else None, 0
+    )
+    assert got == f"item{rank}"
+
+    out = c.alltoall_obj([(rank, j) for j in range(size)])
+    assert out == [(i, rank) for i in range(size)], out
+
+    s = c.allreduce_obj({"v": rank})
+    assert s == {"v": sum(range(size))}
+
+    # p2p ring with a large payload (exercises framing/chunked recv)
+    big = bytes(range(256)) * 4096  # 1 MiB
+    c.send_obj((rank, big), (rank + 1) % size)
+    src, payload = c.recv_obj((rank - 1) % size)
+    assert src == (rank - 1) % size and payload == big
+
+    c.barrier()
+    c.finalize()
+    print(f"WORKER_OK {rank}")
+
+
+if __name__ == "__main__":
+    main()

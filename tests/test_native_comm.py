@@ -1,0 +1,60 @@
+"""Native TCP host-communicator tests: build the C++ library and run real
+multi-process collectives on localhost — the reference tested its MPI plane
+with ``mpiexec -n 2..4`` (SURVEY.md section 4); this is the same coverage
+with OS processes + TCP instead of MPI ranks."""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = REPO / "tests" / "native_worker.py"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_native_lib_builds():
+    from chainermn_tpu.native import lib_path
+
+    assert lib_path().exists()
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_multiprocess_collectives(size):
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)  # keep workers off the axon plugin path
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(r), str(size), coord],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=str(REPO),
+        )
+        for r in range(size)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"WORKER_OK {r}" in out
