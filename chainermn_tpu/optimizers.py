@@ -77,7 +77,9 @@ def allreduce_gradients(
             return _pmean_if_in_axis(g.astype(compress_dtype), axis_names).astype(
                 g.dtype
             )
-        return _pmean_if_in_axis(g, axis_names)
+        # pmean promotes integer leaves to float; keep the leaf dtype
+        # (reference parity: allreduce_grad returned grads in-place/dtype).
+        return _pmean_if_in_axis(g, axis_names).astype(g.dtype)
 
     return jax.tree.map(reduce_leaf, grads)
 

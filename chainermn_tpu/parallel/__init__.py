@@ -33,6 +33,10 @@ def __getattr__(name):
         from chainermn_tpu.parallel import pipeline as _pp
 
         return getattr(_pp, name)
+    if name in ("zero_shard_optimizer", "zero_state_specs"):
+        from chainermn_tpu.parallel import zero as _z
+
+        return getattr(_z, name)
     raise AttributeError(name)
 
 
@@ -48,4 +52,6 @@ __all__ = [
     "pipeline_local",
     "make_pipeline",
     "stack_stage_params",
+    "zero_shard_optimizer",
+    "zero_state_specs",
 ]

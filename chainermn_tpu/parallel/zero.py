@@ -1,0 +1,137 @@
+"""ZeRO-style optimizer-state sharding over the data-parallel axis.
+
+Absent from the reference (SURVEY.md section 2.2 flags it as the natural
+TPU-era extension, hinted by PAPERS.md's automatic cross-replica sharding
+retrieval): in plain data parallelism every shard holds the FULL optimizer
+state (2x params for Adam). Here each of the ``n`` data shards owns ``1/n``
+of every parameter's state:
+
+  1. gradients are ``psum_scatter``-ed — each shard receives the *mean* of
+     its own 1/n chunk (same wire bytes as the allreduce it replaces: a
+     reduce-scatter is half an allreduce);
+  2. the inner optimizer updates only the local chunk (1/n state, 1/n
+     update FLOPs);
+  3. chunk updates are ``all_gather``-ed back (the other half of the
+     allreduce) and applied to the replicated parameters.
+
+Constraint: the inner optimizer must be *elementwise* (sgd/momentum/adam/
+adamw/rmsprop...) — anything computing cross-parameter statistics
+(global-norm clipping) would see only chunks. Compose such transforms
+outside the wrapper.
+
+Usage (inside the shard_map'd train step, like every in-jit collective):
+
+    opt = zero_shard_optimizer(optax.adamw(1e-3), axis_name='data')
+    state = opt.init(params)          # per-shard: holds 1/n of adam state
+    updates, state = opt.update(grads, state, params)
+    params = optax.apply_updates(params, updates)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+PyTree = Any
+
+
+def _chunk_rows(x: jax.Array, n: int) -> jax.Array:
+    """Flatten ``x`` and pad so it splits into ``n`` equal rows [n, c]."""
+    flat = x.reshape(-1)
+    c = -(-flat.size // n)  # ceil
+    return jnp.pad(flat, (0, n * c - flat.size)).reshape(n, c)
+
+
+def _unchunk(rows: jax.Array, shape, dtype) -> jax.Array:
+    size = 1
+    for s in shape:
+        size *= s
+    return rows.reshape(-1)[:size].reshape(shape).astype(dtype)
+
+
+def zero_state_specs(
+    inner: optax.GradientTransformation,
+    params: PyTree,
+    n: int,
+    axis_name: str,
+) -> PyTree:
+    """PartitionSpec tree for the ZeRO-sharded state of ``inner`` — the
+    shard_map ``in_specs``/``out_specs`` entry for the optimizer state.
+
+    Chunked (array) leaves concatenate over ``axis_name``; scalar leaves
+    (step counters, identical on every shard) stay replicated. Shapes come
+    from ``eval_shape`` on abstract 1/n chunks, so nothing is materialised.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    chunks = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((-(-x.size // n),), x.dtype), params
+    )
+    template = jax.eval_shape(inner.init, chunks)
+    return jax.tree.map(
+        lambda l: P(axis_name) if getattr(l, "ndim", 0) >= 1 else P(),
+        template,
+    )
+
+
+def zero_shard_optimizer(
+    inner: optax.GradientTransformation,
+    axis_name: str,
+    *,
+    compress_dtype=None,
+) -> optax.GradientTransformation:
+    """Wrap an elementwise optax transform with ZeRO-1 state sharding over
+    ``axis_name``. Must be used inside that named-axis context (shard_map).
+
+    ``compress_dtype`` casts gradients before the reduce-scatter (the
+    bf16-compressed-allreduce feature, applied to the scatter instead).
+    """
+
+    def my_chunk(tree: PyTree) -> PyTree:
+        idx = lax.axis_index(axis_name)
+        n = lax.axis_size(axis_name)
+        return jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(
+                _chunk_rows(x, n), idx, keepdims=False
+            ),
+            tree,
+        )
+
+    def init_fn(params: PyTree):
+        return inner.init(my_chunk(params))
+
+    def update_fn(grads: PyTree, state, params: Optional[PyTree] = None):
+        n = lax.axis_size(axis_name)
+
+        def rs(g):
+            rows = _chunk_rows(g, n)
+            if compress_dtype is not None and jnp.issubdtype(
+                g.dtype, jnp.floating
+            ):
+                return (
+                    lax.psum_scatter(
+                        rows.astype(compress_dtype), axis_name,
+                        scatter_dimension=0, tiled=False,
+                    ).astype(g.dtype)
+                    / n
+                )
+            return lax.psum_scatter(
+                rows, axis_name, scatter_dimension=0, tiled=False
+            ) / n
+
+        grad_chunks = jax.tree.map(rs, grads)
+        param_chunks = my_chunk(params) if params is not None else None
+        update_chunks, state = inner.update(grad_chunks, state, param_chunks)
+
+        def ag(u, g):
+            rows = lax.all_gather(u, axis_name, axis=0, tiled=False)
+            return _unchunk(rows, g.shape, g.dtype)
+
+        updates = jax.tree.map(ag, update_chunks, grads)
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)

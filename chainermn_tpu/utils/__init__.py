@@ -1,0 +1,26 @@
+"""Utilities: observability and debug checks.
+
+The reference had no tracing subsystem (SURVEY.md section 5 — users reached
+for nvprof and Chainer hooks); the TPU build ships one: ``jax.profiler``
+wrappers, the rank-0 logging gate (the pattern every reference example
+hand-coded), and the cross-host divergence check that replaces the
+collective-ordering deadlock discipline (XLA schedules collectives
+statically, so the remaining distributed hazard is *different jitted
+programs per host* — caught here, not hung on).
+"""
+
+from chainermn_tpu.utils.observability import (
+    annotate,
+    assert_same_on_all_hosts,
+    log0,
+    profile,
+    rank_zero_only,
+)
+
+__all__ = [
+    "annotate",
+    "assert_same_on_all_hosts",
+    "log0",
+    "profile",
+    "rank_zero_only",
+]

@@ -1,0 +1,89 @@
+"""Profiling, logging and cross-host consistency checks.
+
+SURVEY.md section 5 mappings:
+  - tracing/profiling: none in the reference → ``jax.profiler`` here
+    (strictly more than the reference had);
+  - metrics/observability: the reference's rank-0-gating *pattern*
+    (``if comm.rank == 0`` in every example (dagger)) → :func:`log0` /
+    :func:`rank_zero_only`;
+  - race detection: the reference prevented collective-ordering deadlock by
+    API design (delegate variables); under XLA that bug class is gone and
+    the remaining hazard is cross-host program divergence (different
+    shapes/dtypes traced on different hosts) → :func:`assert_same_on_all_hosts`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile(logdir: str, *, with_memory: bool = True):
+    """Trace everything inside the block into ``logdir`` (view with
+    TensorBoard's profile plugin / xprof)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span in the device trace — wrap hot regions to find them in
+    xprof. Usable as context manager."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def log0(comm, *args, **kwargs) -> None:
+    """``print`` gated on the lead rank (the reference examples' ubiquitous
+    ``if comm.rank == 0: print(...)``)."""
+    if comm is None or comm.rank == 0:
+        print(*args, **kwargs)
+
+
+def rank_zero_only(comm) -> Callable:
+    """Decorator: run the function on rank 0 only (reporter extensions,
+    snapshot writers); other ranks get ``None``."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if comm is None or comm.rank == 0:
+                return fn(*args, **kwargs)
+            return None
+
+        return wrapper
+
+    return deco
+
+
+def assert_same_on_all_hosts(value: Any, name: str = "value") -> None:
+    """Debug-mode agreement check: every JAX process must hold an equal
+    ``value`` (shape tuple, program fingerprint, resume step, batch spec).
+
+    Divergence across hosts produces *different* compiled programs and a
+    silent hang at the next collective; this turns that hang into an
+    immediate error. No-op in single-process runtimes.
+    """
+    if jax.process_count() == 1:
+        return
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    if isinstance(value, (int, float, bool)):
+        arr = np.asarray([value], dtype=np.float64)
+        multihost_utils.assert_equal(arr, f"chainermn_tpu:{name}")
+        return
+    # Generic objects: compare a stable hash.
+    import hashlib
+    import pickle
+
+    digest = hashlib.sha256(
+        pickle.dumps(value, protocol=4)
+    ).digest()[:8]
+    arr = np.frombuffer(digest, dtype=np.int64).copy()
+    multihost_utils.assert_equal(arr, f"chainermn_tpu:{name}")

@@ -1,0 +1,122 @@
+"""Odd-gradient-shape stress tests — the role of BASELINE.json's
+"Faster-RCNN (stress hierarchical communicator, odd grad shapes)" config
+and the reference's mixed-dtype/empty-grad communicator tests
+(``tests/communicator_tests/test_communicator.py`` (dagger), SURVEY.md
+section 4): gradient reduction and the ZeRO scatter must survive scalars,
+odd prime dims, empty leaves and mixed dtypes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.optimizers import allreduce_gradients
+
+
+def _odd_tree():
+    k = jax.random.PRNGKey(0)
+    ks = jax.random.split(k, 4)
+    return {
+        "scalar": jnp.float32(3.5),
+        "vec1": jnp.ones((1,)),
+        "prime": jax.random.normal(ks[0], (3, 5, 7)),
+        "empty": jnp.zeros((0, 4)),
+        "big_odd": jax.random.normal(ks[1], (127, 33)),
+        "bf16": jax.random.normal(ks[2], (11, 13)).astype(jnp.bfloat16),
+        "int_buffer": jnp.arange(7, dtype=jnp.int32),  # non-float leaf
+    }
+
+
+@pytest.mark.parametrize("compress", [None, jnp.bfloat16])
+def test_allreduce_grad_odd_shapes(comm, compress):
+    tree = _odd_tree()
+    ax = comm.axis_name
+
+    def local(tree):
+        return allreduce_gradients(tree, comm, compress_dtype=compress)
+
+    out = jax.jit(
+        shard_map(
+            local, mesh=comm.mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+    )(tree)
+    # Identical input on every shard => pmean is identity (up to cast).
+    for name in tree:
+        assert out[name].dtype == tree[name].dtype, name
+        assert out[name].shape == tree[name].shape, name
+        tol = 1e-2 if (compress or tree[name].dtype == jnp.bfloat16) else 1e-6
+        if tree[name].size:
+            np.testing.assert_allclose(
+                np.asarray(out[name], np.float64),
+                np.asarray(tree[name], np.float64),
+                rtol=tol, atol=tol,
+            )
+
+
+def test_zero_sharding_odd_shapes(comm):
+    """ZeRO chunking pads odd sizes; round-trip must preserve values."""
+    from chainermn_tpu.parallel.zero import (
+        zero_shard_optimizer,
+        zero_state_specs,
+    )
+
+    params = {
+        "scalar": jnp.float32(1.0),
+        "prime": jax.random.normal(jax.random.PRNGKey(1), (3, 5, 7)),
+        "vec1": jnp.ones((1,)),
+    }
+    ax = comm.axis_name
+    inner = optax.sgd(0.5)
+    zopt = zero_shard_optimizer(inner, ax)
+    st_spec = zero_state_specs(inner, params, comm.size, ax)
+
+    def local(params):
+        state = zopt.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        updates, _ = zopt.update(grads, state, params)
+        return updates
+
+    updates = jax.jit(
+        shard_map(
+            local, mesh=comm.mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+    )(params)
+    # sgd(0.5) on all-ones grads => every update == -0.5 exactly.
+    for name, u in updates.items():
+        np.testing.assert_allclose(np.asarray(u), -0.5, rtol=1e-6)
+        assert u.shape == params[name].shape
+
+
+def test_train_step_odd_param_shapes(comm):
+    """Full train step with a model whose params include odd shapes."""
+    from chainermn_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    def apply(params, x):
+        return x @ params["w"] + params["b"] + params["scale"]
+
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (13, 3)),
+        "b": jnp.zeros((3,)),
+        "scale": jnp.float32(0.0),  # scalar param
+    }
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 13))
+    y = jax.random.normal(jax.random.PRNGKey(2), (16, 3))
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return ((apply(params, xb) - yb) ** 2).mean()
+
+    opt = optax.sgd(0.1)
+    state = create_train_state(params, opt)
+    step = make_train_step(loss_fn, opt, comm)
+    new_state, metrics = step(state, (x, y))
+    assert np.isfinite(float(metrics["loss"]))
+    assert new_state.params["scale"].shape == ()
