@@ -37,6 +37,10 @@ def __getattr__(name):
         from chainermn_tpu.parallel import zero as _z
 
         return getattr(_z, name)
+    if name in ("moe_layer_local", "top1_route", "make_expert_params"):
+        from chainermn_tpu.parallel import moe as _m
+
+        return getattr(_m, name)
     raise AttributeError(name)
 
 
@@ -54,4 +58,7 @@ __all__ = [
     "stack_stage_params",
     "zero_shard_optimizer",
     "zero_state_specs",
+    "moe_layer_local",
+    "top1_route",
+    "make_expert_params",
 ]

@@ -1,0 +1,106 @@
+"""Expert parallelism (MoE) — all_to_all token routing over an ``'expert'``
+mesh axis.
+
+Absent from the reference (SURVEY.md section 2.2 lists EP as the optional
+TPU-era extension). Mechanism: each shard hosts one (or more) experts; a
+top-1 router scores tokens, tokens travel to their expert's shard via
+``all_to_all``, the expert MLP runs, and a second ``all_to_all`` returns
+outputs — the same two-collective shape as Ulysses sequence parallelism,
+with capacity-bounded dispatch making every shape static for XLA.
+
+Capacity discipline (the TPU answer to ragged routing): each expert
+processes at most ``capacity = ceil(tokens/experts * capacity_factor)``
+tokens per shard; overflow tokens are dropped (standard Switch-style
+routing) and their outputs fall back to zero — callers add the residual
+path so dropped tokens pass through unchanged.
+
+Differentiable end to end: routing uses straight-through softmax gating
+(gradient flows through the gate probability), and ``all_to_all`` has an
+exact transpose.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def top1_route(
+    logits: jax.Array,  # [tokens, n_experts]
+    capacity: int,
+):
+    """Switch-style top-1 routing with capacity.
+
+    Returns:
+      dispatch: ``[tokens, n_experts, capacity]`` one-hot dispatch mask.
+      combine:  same shape, dispatch * gate probability (for the return
+        trip, carries the gradient to the router).
+    """
+    n_experts = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [tokens]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [tokens, experts]
+    pos = pos.max(axis=-1)  # [tokens]
+    keep = pos < capacity
+
+    dispatch = (
+        jax.nn.one_hot(expert, n_experts, dtype=logits.dtype)[:, :, None]
+        * jax.nn.one_hot(pos, capacity, dtype=logits.dtype)[:, None, :]
+    )
+    dispatch = dispatch * keep[:, None, None].astype(logits.dtype)
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_layer_local(
+    x: jax.Array,              # [tokens_local, d_model]
+    router_w: jax.Array,       # [d_model, n_experts_global]
+    expert_fn: Callable,       # expert_fn(params, x[capacity, d]) -> same
+    expert_params: PyTree,     # THIS shard's expert params
+    axis_name: str = "expert",
+    *,
+    capacity_factor: float = 1.25,
+) -> jax.Array:
+    """One MoE layer inside ``shard_map``: one expert per shard along
+    ``axis_name``; tokens ride two ``all_to_all``s.
+
+    Returns the combined expert outputs for the local tokens (zeros for
+    dropped tokens — add the residual outside).
+    """
+    n = lax.axis_size(axis_name)
+    tokens, d = x.shape
+    capacity = int(tokens / n * capacity_factor) or 1
+
+    logits = x @ router_w  # [tokens, n]
+    dispatch, combine = top1_route(logits, capacity)
+
+    # Gather each expert's queue locally: [n, capacity, d]
+    queues = jnp.einsum("td,tec->ecd", x, dispatch)
+    # Exchange: shard i sends queue row e to shard e, receives its own
+    # expert's queue from every shard -> [n(senders), capacity, d]
+    recv = lax.all_to_all(queues, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    # Run THIS shard's expert on all n*capacity tokens at once (MXU-batched)
+    out = expert_fn(expert_params, recv.reshape(n * capacity, d))
+    out = out.reshape(n, capacity, d)
+    # Return trip + weighted combine back into token order
+    back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    return jnp.einsum("ecd,tec->td", back, combine)
+
+
+def make_expert_params(init_fn: Callable, rng: jax.Array, n_experts: int):
+    """Stack ``n_experts`` independently-initialised expert param trees
+    along a leading axis (shard over the ``'expert'`` mesh axis)."""
+    rngs = jax.random.split(rng, n_experts)
+    trees = [init_fn(r) for r in rngs]
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *trees)
