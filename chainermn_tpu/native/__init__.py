@@ -22,41 +22,48 @@ import os
 import subprocess
 from pathlib import Path
 
-_SRC = Path(__file__).parent / "src" / "host_comm.cpp"
+_SRC_DIR = Path(__file__).parent / "src"
 _BUILD_DIR = Path(__file__).parent / "build"
-_LIB = _BUILD_DIR / "libhostcomm.so"
+
+#: component name -> (source file, extra compile flags)
+_COMPONENTS = {
+    "host_comm": ("host_comm.cpp", []),
+    "data_loader": ("data_loader.cpp", ["-pthread"]),
+}
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def lib_path(rebuild: bool = False) -> Path:
-    """Path to the compiled host-comm library, building it if needed."""
-    if _LIB.exists() and not rebuild:
-        if _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
-            return _LIB
+def lib_path(name: str = "host_comm", rebuild: bool = False) -> Path:
+    """Path to a compiled native component, building it on demand."""
+    src_name, flags = _COMPONENTS[name]
+    src = _SRC_DIR / src_name
+    lib = _BUILD_DIR / f"lib{name.replace('_', '')}.so"
+    if lib.exists() and not rebuild and lib.stat().st_mtime >= src.stat().st_mtime:
+        return lib
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     cmd = [
         os.environ.get("CXX", "g++"),
-        "-O2", "-shared", "-fPIC", "-Wall",
-        "-o", str(_LIB), str(_SRC),
+        "-O2", "-shared", "-fPIC", "-Wall", *flags,
+        "-o", str(lib), str(src),
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
-        raise NativeBuildError(f"building {_LIB.name} failed: {e}") from e
+        raise NativeBuildError(f"building {lib.name} failed: {e}") from e
     if proc.returncode != 0:
         raise NativeBuildError(
-            f"building {_LIB.name} failed:\n{proc.stderr[-2000:]}"
+            f"building {lib.name} failed:\n{proc.stderr[-2000:]}"
         )
-    return _LIB
+    return lib
 
 
-def available() -> bool:
-    """True when the native library is present or buildable."""
+def available(name: str = "host_comm") -> bool:
+    """True when the native component is present or buildable."""
     try:
-        lib_path()
+        lib_path(name)
         return True
     except NativeBuildError:
         return False

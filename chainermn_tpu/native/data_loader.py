@@ -1,0 +1,154 @@
+"""Python wrapper for the native threaded data loader
+(``src/data_loader.cpp``): fixed-record binary datasets -> shuffled,
+prefetched numpy batches, assembled by C++ worker threads off the GIL.
+
+The TPU-native answer to the reference's MultiprocessIterator usage
+(``examples/imagenet/train_imagenet.py`` (dagger), SURVEY.md section 2.8):
+same prefetch-ahead-of-device behaviour, no fork (the SPMD controller must
+stay single-process), no pickling per batch.
+
+Record layout: a record is the concatenation of the fields' bytes in order
+(C-contiguous), e.g. ``[image u8 64*64*3 | label i32]``. Use
+:func:`write_fixed_records` to produce files from numpy arrays.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from chainermn_tpu.native import lib_path
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(str(lib_path("data_loader")))
+        lib.dl_open.restype = ctypes.c_void_p
+        lib.dl_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.dl_num_records.restype = ctypes.c_int64
+        lib.dl_num_records.argtypes = [ctypes.c_void_p]
+        lib.dl_batches_per_epoch.restype = ctypes.c_int64
+        lib.dl_batches_per_epoch.argtypes = [ctypes.c_void_p]
+        lib.dl_next.restype = ctypes.c_int64
+        lib.dl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.dl_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+Field = Tuple[str, np.dtype, Tuple[int, ...]]
+
+
+def write_fixed_records(path: str, *arrays: np.ndarray) -> None:
+    """Interleave ``arrays`` (same leading dim) into a fixed-record file:
+    record i = concat of each array's row i bytes."""
+    n = arrays[0].shape[0]
+    assert all(a.shape[0] == n for a in arrays)
+    # One bulk write: interleave per-record field bytes in numpy.
+    rows = [
+        np.ascontiguousarray(a).reshape(n, -1).view(np.uint8)
+        for a in arrays
+    ]
+    np.concatenate(rows, axis=1).tofile(path)
+
+
+class NativeDataLoader:
+    """Iterate shuffled prefetched batches from a fixed-record file.
+
+    Args:
+      fields: ``(name, dtype, shape)`` per record field, in file order.
+      shard: ``(begin, end)`` record range for this process (the dataset
+        scatter, SURVEY.md section 3.3); ``None`` = whole file.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        fields: Sequence[Field],
+        batch_size: int,
+        *,
+        threads: int = 2,
+        prefetch: int = 4,
+        seed: int = 0,
+        shuffle: bool = True,
+        shard: Optional[Tuple[int, int]] = None,
+    ) -> None:
+        self.fields = [
+            (name, np.dtype(dt), tuple(shape)) for name, dt, shape in fields
+        ]
+        self.record_bytes = sum(
+            int(dt.itemsize * np.prod(shape)) if shape else dt.itemsize
+            for _, dt, shape in self.fields
+        )
+        self.batch_size = batch_size
+        begin, end = shard if shard is not None else (0, 0)
+        self._h = _load().dl_open(
+            path.encode(), self.record_bytes, batch_size, threads, prefetch,
+            seed, int(shuffle), begin, end,
+        )
+        if not self._h:
+            raise RuntimeError(
+                f"dl_open failed for {path!r} (record_bytes="
+                f"{self.record_bytes}, batch={batch_size}, shard={shard}) — "
+                f"check the file size is a record multiple and the shard "
+                f"holds at least one batch"
+            )
+        self._buf = np.empty(batch_size * self.record_bytes, np.uint8)
+        self.epoch = 0
+
+    @property
+    def num_records(self) -> int:
+        return _load().dl_num_records(self._h)
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return _load().dl_batches_per_epoch(self._h)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        ep = _load().dl_next(
+            self._h, self._buf.ctypes.data_as(ctypes.c_void_p)
+        )
+        if ep == -2:
+            raise RuntimeError(
+                "native loader read failure (dataset file truncated or "
+                "unreadable)"
+            )
+        if ep < 0:
+            raise StopIteration
+        self.epoch = int(ep)
+        out = {}
+        rec = self._buf.reshape(self.batch_size, self.record_bytes)
+        off = 0
+        for name, dt, shape in self.fields:
+            nbytes = int(dt.itemsize * np.prod(shape)) if shape else dt.itemsize
+            chunk = rec[:, off : off + nbytes]
+            # .copy(): the internal buffer is reused by the next __next__;
+            # returned arrays must own their data (a single-field layout
+            # would otherwise alias self._buf).
+            arr = chunk.copy().view(dt)
+            out[name] = arr.reshape((self.batch_size,) + shape)
+            off += nbytes
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            _load().dl_close(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass

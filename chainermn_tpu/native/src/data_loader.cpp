@@ -1,0 +1,200 @@
+// Native threaded data loader: memory-mapped fixed-record dataset ->
+// shuffled, prefetched batches on a bounded queue.
+//
+// Role in the framework: the reference's ImageNet example leaned on
+// Chainer's MultiprocessIterator (worker processes decoding/batching ahead
+// of the GPU — SURVEY.md section 2.8 notes its fork-before-MPI hazards).
+// The TPU equivalent must keep one host process (the SPMD controller) and
+// still hide host-side batch assembly behind device compute: C++ worker
+// THREADS (no GIL, no fork) pread record ranges from a flat file, assemble
+// batches, and park them on a condition-variable queue the Python side pops.
+//
+// File format: raw concatenation of equal-size records (see
+// native/data_loader.py for the numpy writer). Sharding: [begin, end)
+// record range per loader — the dataset-scatter index arithmetic
+// (SURVEY.md section 3.3) applied to files.
+//
+// Build: g++ -O2 -shared -fPIC -pthread (see native/__init__.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <random>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  int64_t epoch;
+  std::vector<char> data;
+};
+
+struct Loader {
+  int fd = -1;
+  int64_t record_bytes = 0;
+  int64_t batch = 0;
+  int64_t begin = 0, end = 0;  // record shard [begin, end)
+  bool shuffle = true;
+  uint64_t seed = 0;
+  int depth = 4;
+
+  std::vector<std::thread> workers;
+  std::deque<Batch> queue;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> failed{false};
+
+  // epoch state (guarded by mu)
+  int64_t epoch = 0;
+  int64_t cursor = 0;  // next batch index within epoch
+  std::vector<int64_t> order;
+
+  int64_t n() const { return end - begin; }
+  int64_t batches_per_epoch() const { return n() / batch; }
+
+  void reshuffle() {  // call with mu held
+    order.resize(static_cast<size_t>(n()));
+    for (int64_t i = 0; i < n(); ++i) order[static_cast<size_t>(i)] = begin + i;
+    if (shuffle) {
+      std::mt19937_64 rng(seed + static_cast<uint64_t>(epoch) * 0x9E3779B97F4A7C15ULL);
+      for (int64_t i = n() - 1; i > 0; --i) {
+        int64_t j = static_cast<int64_t>(rng() % static_cast<uint64_t>(i + 1));
+        std::swap(order[static_cast<size_t>(i)], order[static_cast<size_t>(j)]);
+      }
+    }
+  }
+
+  void worker() {
+    std::vector<int64_t> ids(static_cast<size_t>(batch));
+    while (!stop.load()) {
+      int64_t my_epoch;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        if (cursor >= batches_per_epoch()) {
+          ++epoch;
+          cursor = 0;
+          reshuffle();
+        }
+        my_epoch = epoch;
+        int64_t b = cursor++;
+        for (int64_t i = 0; i < batch; ++i)
+          ids[static_cast<size_t>(i)] =
+              order[static_cast<size_t>(b * batch + i)];
+      }
+      Batch out;
+      out.epoch = my_epoch;
+      out.data.resize(static_cast<size_t>(batch * record_bytes));
+      bool ok = true;
+      for (int64_t i = 0; i < batch && ok; ++i) {
+        int64_t off = ids[static_cast<size_t>(i)] * record_bytes;
+        char* dst = out.data.data() + i * record_bytes;
+        int64_t got = 0;
+        while (got < record_bytes) {
+          ssize_t r = ::pread(fd, dst + got,
+                              static_cast<size_t>(record_bytes - got),
+                              off + got);
+          if (r <= 0) { ok = false; break; }
+          got += r;
+        }
+      }
+      if (!ok) {
+        // Unreadable record (truncated/corrupt file): fail the loader
+        // loudly — a silently shrunken epoch would break the
+        // every-record-once invariant, and retrying would spin.
+        failed.store(true);
+        stop.store(true);
+        cv_pop.notify_all();
+        cv_push.notify_all();
+        return;
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_push.wait(lk, [&] {
+        return stop.load() || static_cast<int>(queue.size()) < depth;
+      });
+      if (stop.load()) return;
+      queue.push_back(std::move(out));
+      cv_pop.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dl_open(const char* path, int64_t record_bytes, int64_t batch,
+              int threads, int prefetch_depth, uint64_t seed, int shuffle,
+              int64_t shard_begin, int64_t shard_end) {
+  auto* L = new Loader;
+  L->fd = ::open(path, O_RDONLY);
+  if (L->fd < 0) { delete L; return nullptr; }
+  struct stat st;
+  if (::fstat(L->fd, &st) != 0 || record_bytes <= 0 ||
+      st.st_size % record_bytes != 0) {
+    ::close(L->fd);
+    delete L;
+    return nullptr;
+  }
+  int64_t total = st.st_size / record_bytes;
+  L->record_bytes = record_bytes;
+  L->batch = batch;
+  L->begin = shard_begin;
+  L->end = (shard_end <= 0 || shard_end > total) ? total : shard_end;
+  L->shuffle = shuffle != 0;
+  L->seed = seed;
+  L->depth = prefetch_depth > 0 ? prefetch_depth : 4;
+  if (L->begin < 0 || L->begin >= L->end || L->batch <= 0 ||
+      L->n() < L->batch) {
+    ::close(L->fd);
+    delete L;
+    return nullptr;
+  }
+  L->reshuffle();
+  int nthreads = threads > 0 ? threads : 2;
+  for (int i = 0; i < nthreads; ++i)
+    L->workers.emplace_back([L] { L->worker(); });
+  return L;
+}
+
+int64_t dl_num_records(void* h) { return static_cast<Loader*>(h)->n(); }
+
+int64_t dl_batches_per_epoch(void* h) {
+  return static_cast<Loader*>(h)->batches_per_epoch();
+}
+
+// Blocking pop: copies batch*record_bytes into out; returns the batch's
+// epoch number, -1 after dl_close, or -2 after a read failure.
+int64_t dl_next(void* h, void* out) {
+  auto* L = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_pop.wait(lk, [&] { return L->stop.load() || !L->queue.empty(); });
+  if (L->failed.load()) return -2;
+  if (L->queue.empty()) return -1;
+  Batch b = std::move(L->queue.front());
+  L->queue.pop_front();
+  L->cv_push.notify_one();
+  lk.unlock();
+  std::memcpy(out, b.data.data(), b.data.size());
+  return b.epoch;
+}
+
+void dl_close(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  L->stop.store(true);
+  L->cv_push.notify_all();
+  L->cv_pop.notify_all();
+  for (auto& t : L->workers) t.join();
+  ::close(L->fd);
+  delete L;
+}
+
+}  // extern "C"

@@ -76,9 +76,11 @@ def moe_layer_local(
     Returns the combined expert outputs for the local tokens (zeros for
     dropped tokens — add the residual outside).
     """
+    import math
+
     n = lax.axis_size(axis_name)
     tokens, d = x.shape
-    capacity = int(tokens / n * capacity_factor) or 1
+    capacity = max(1, math.ceil(tokens / n * capacity_factor))
 
     logits = x @ router_w  # [tokens, n]
     dispatch, combine = top1_route(logits, capacity)

@@ -65,6 +65,9 @@ def main(argv=None):
     p.add_argument("--profile", default=None,
                    help="directory for a jax.profiler trace of iters 10-20")
     p.add_argument("--train-root", default=None)
+    p.add_argument("--native-loader", default=None, metavar="FILE.bin",
+                   help="fixed-record file read by the C++ threaded "
+                        "prefetch loader (chainermn_tpu.native.data_loader)")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(
@@ -78,7 +81,40 @@ def main(argv=None):
     model = ARCHS[args.arch](comm.bn_axis_name)
     global_batch = args.batchsize * comm.size
     rng = np.random.default_rng(0)
-    x0, y0 = synthetic_batch(rng, global_batch, args.image_size)
+
+    loader = None
+    if args.native_loader:
+        from chainermn_tpu.native.data_loader import NativeDataLoader
+
+        hw = args.image_size
+        # Each process reads only its own record-range shard (the dataset
+        # scatter of SURVEY.md section 3.3 applied to files) and assembles
+        # the global batch from it — sample-parallel across hosts.
+        n_proc, proc = jax.process_count(), jax.process_index()
+        import os
+
+        n_total = os.path.getsize(args.native_loader) // (hw * hw * 3 + 4)
+        per = n_total // n_proc
+        loader = NativeDataLoader(
+            args.native_loader,
+            [("image", np.uint8, (hw, hw, 3)), ("label", np.int32, ())],
+            batch_size=global_batch,
+            threads=4,
+            prefetch=4,
+            seed=proc,
+            shard=(proc * per, (proc + 1) * per) if n_proc > 1 else None,
+        )
+
+    def next_batch():
+        if loader is not None:
+            b = next(loader)
+            return (
+                b["image"].astype(np.float32) / 127.5 - 1.0,
+                b["label"],
+            )
+        return synthetic_batch(rng, global_batch, args.image_size)
+
+    x0, y0 = next_batch()
 
     variables = jax.jit(
         lambda k, xb: model.init(k, xb, train=True)
@@ -119,7 +155,7 @@ def main(argv=None):
     for it in range(args.iterations):
         if args.profile and it == 10:
             jax.profiler.start_trace(args.profile)
-        x, y = synthetic_batch(rng, global_batch, args.image_size)
+        x, y = next_batch()
         state, metrics = step(state, (jnp.asarray(x), jnp.asarray(y)))
         if args.profile and it == 20:
             jax.block_until_ready(state.params)
