@@ -1,0 +1,31 @@
+"""Shared CPU-backend environment scrub for the standalone drivers
+(``bench.py``, ``__graft_entry__.py``).
+
+Round-1 lesson (VERDICT.md): externally injected accelerator plugin shims
+register themselves via PYTHONPATH, ignore ``JAX_PLATFORMS=cpu``, and can
+hang JAX backend init when their tunnel is dead. Subprocesses that must
+only ever see the CPU backend get this environment; keeping the scrub in
+one place keeps both drivers in lockstep.
+"""
+
+from __future__ import annotations
+
+import os
+
+_PLUGIN_ENV_VARS = ("JAX_PLATFORM_NAME", "TPU_LIBRARY_PATH", "PJRT_DEVICE")
+
+
+def cpu_scrubbed_env(n_devices: int = 8, cache_dir: str | None = None) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    for k in _PLUGIN_ENV_VARS:
+        env.pop(k, None)
+    if cache_dir:
+        # Persistent compilation cache: repeat driver invocations skip the
+        # CPU-mesh XLA compiles that dominate wall time.
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    return env

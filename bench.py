@@ -1,36 +1,246 @@
 """Benchmark driver: ResNet-50 data-parallel training throughput.
 
 Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}``.
+``{"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N, ...}``
+with supplementary fields: ``mfu`` (model-FLOPs utilisation against the
+chip's bf16 peak), ``allreduce_gbps`` (the reference's second tracked
+metric, BASELINE.json / SURVEY.md section 6: achieved bytes/s of a jitted
+gradient-buffer allreduce), ``device_kind``, ``n_devices``, and ``error``
+when a fallback path was taken.
 
-The benchmark is the reference's headline workload (ResNet-50 ImageNet,
-``examples/imagenet`` (dagger), SURVEY.md section 6): one fully-jitted SPMD
-train step — forward, backward, bf16-compressed gradient allreduce over the
-mesh, SGD update — on synthetic 224x224 data, i.e. the same measurement the
-reference's images/sec numbers report (data pipeline excluded).
+The primary benchmark is the reference's headline workload (ResNet-50
+ImageNet, ``examples/imagenet`` (dagger), SURVEY.md section 6): one fully
+jitted SPMD train step — forward, backward, bf16-compressed gradient
+allreduce over the mesh, SGD update — on synthetic 224x224 data, i.e. the
+same measurement the reference's images/sec numbers report (data pipeline
+excluded).
 
-Baseline: ``BASELINE.json`` has ``"published": {}`` (the reference repo's own
-numbers were unreadable — empty mount), so ``vs_baseline`` is computed against
-the best documented ChainerMN-era per-accelerator throughput: the 15-minute
-ImageNet run (Akiba, Suzuki & Fukuda, arXiv:1711.04325 — 90 epochs, 1024
-P100s) ~= 125 images/sec/P100. UNVERIFIED external figure; see BASELINE.md.
+Robustness contract (round-1 lesson, VERDICT.md): this process never
+imports jax itself. Backend acquisition happens in bounded subprocesses —
+a TPU probe with a timeout, then the real bench; on any failure it reruns
+on a scrubbed-environment CPU backend; a JSON line is ALWAYS emitted and
+the exit code is always 0.
+
+Baseline: ``BASELINE.json`` has ``"published": {}`` (the reference repo's
+own numbers were unreadable — empty mount), so ``vs_baseline`` compares
+per-device throughput against the best documented ChainerMN-era
+per-accelerator figure: the 15-minute ImageNet run (Akiba, Suzuki & Fukuda,
+arXiv:1711.04325 — 90 epochs, 1024 P100s) ~= 125 images/sec/P100.
+UNVERIFIED external figure and different hardware — ``mfu`` is the
+hardware-honest number; see BASELINE.md.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
 
 BASELINE_IMG_PER_SEC_PER_DEVICE = 125.0
 
+# Peak bf16 FLOPs/s per chip by device_kind substring (public figures).
+_PEAK_BF16_FLOPS = {
+    "v2": 46e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,  # after the lite variants; substring order matters
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+PROBE_TIMEOUT = 120
+TOTAL_BUDGET = 1500  # seconds; never outlive the driver's patience
+
+
+def _cpu_env(n_devices: int = 8) -> dict:
+    """Environment that can only ever see the CPU backend (see
+    ``_driver_env.cpu_scrubbed_env``)."""
+    from _driver_env import cpu_scrubbed_env
+
+    return cpu_scrubbed_env(
+        n_devices, cache_dir=os.path.join(_HERE, ".jax_cache")
+    )
+
+
+def _probe_accelerator(timeout: float):
+    """Return (platform, device_kind, n_devices) or None, never raising."""
+    code = (
+        "import jax, json; ds = jax.devices(); "
+        "print(json.dumps({'platform': ds[0].platform, "
+        "'kind': ds[0].device_kind, 'n': len(ds)}))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout, cwd=_HERE,
+        )
+        if proc.returncode != 0:
+            return None
+        info = json.loads(proc.stdout.strip().splitlines()[-1])
+        if info["platform"] == "cpu":
+            return None
+        return info
+    except Exception:
+        return None
+
+
+def _last_json_line(text) -> dict | None:
+    """Parse the last JSON object line from child stdout (bytes or str)."""
+    if isinstance(text, bytes):
+        text = text.decode(errors="replace")
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _run_child(mode: str, timeout: float, env=None):
+    """Run ``bench.py --run <mode>``; return its parsed JSON line or an
+    error string."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_HERE, "bench.py"), "--run", mode],
+            env=env, cwd=_HERE, capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        # The child prints the primary JSON line BEFORE the slower
+        # supplementary benchmarks — salvage it from the partial output.
+        result = _last_json_line(e.stdout)
+        if result is not None:
+            result["bench_note"] = (
+                f"child timed out after {timeout:.0f}s; "
+                "supplementary metrics missing"
+            )
+            return result, None
+        return None, f"{mode} bench timed out after {timeout:.0f}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "")[-800:]
+        return None, f"{mode} bench rc={proc.returncode}: {tail}"
+    result = _last_json_line(proc.stdout)
+    if result is not None:
+        return result, None
+    return None, f"{mode} bench emitted no JSON line"
+
 
 def main() -> None:
+    deadline = time.monotonic() + TOTAL_BUDGET
+    errors = []
+
+    accel = _probe_accelerator(min(PROBE_TIMEOUT, deadline - time.monotonic()))
+    if accel is not None:
+        budget = min(900.0, deadline - time.monotonic() - 300)
+        result, err = _run_child("accel", budget)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(err)
+    else:
+        errors.append("accelerator probe failed (backend init dead or hung)")
+
+    budget = max(60.0, deadline - time.monotonic() - 10)
+    result, err = _run_child("cpu", budget, env=_cpu_env())
+    if result is not None:
+        result["error"] = "; ".join(errors)
+        print(json.dumps(result))
+        return
+    errors.append(err)
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec",
+                "value": 0.0,
+                "unit": "images/sec",
+                "vs_baseline": 0.0,
+                "error": "; ".join(e for e in errors if e),
+            }
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Child process: the actual measurements (jax imported only here).
+# ---------------------------------------------------------------------------
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_BF16_FLOPS.items():
+        if sub in kind:
+            return peak
+    return None
+
+
+def _bench_allreduce(comm, n_elems: int = 100_000_000):
+    """The reference's ``allreduce_grad`` GB/s microbenchmark (BASELINE.json
+    tracked metric): achieved bytes/s of a jitted psum over a flat bf16
+    gradient-sized buffer — the fused equivalent of
+    ``pure_nccl_communicator.py`` (dagger)'s pack -> ncclAllReduce path.
+
+    Matches ``allreduce_grad`` semantics: every device holds the FULL
+    ``n_elems`` gradient buffer. The buffer is made device-distinct (axis
+    index added) inside the program so XLA cannot simplify the all-reduce
+    of a replicated value into a local multiply."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = comm.mesh
+    axes = comm.grad_axes
+    axes_tuple = axes if isinstance(axes, tuple) else (axes,)
+    n = comm.size
+    dtype = jnp.bfloat16
+    buf = jnp.ones((n_elems,), dtype)
+
+    def local(x):
+        salt = sum(jax.lax.axis_index(a) for a in axes_tuple)
+        return jax.lax.psum(x + salt.astype(x.dtype), axes)
+
+    fn = jax.jit(
+        shard_map(local, mesh=mesh, in_specs=P(), out_specs=P(),
+                  check_vma=False)
+    )
+    out = fn(buf)
+    jax.block_until_ready(out)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(buf)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    nbytes = n_elems * buf.dtype.itemsize
+    # Algorithm bandwidth (bytes through the reduction per second). With
+    # n devices a ring moves 2(n-1)/n * nbytes per device; report both.
+    algbw = nbytes / dt
+    busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
+    return {
+        "allreduce_gbps": round(algbw / 1e9, 2),
+        "allreduce_busbw_gbps": round(busbw / 1e9, 2),
+        "allreduce_elems": n_elems,
+        "allreduce_dtype": "bfloat16",
+    }
+
+
+def _run_bench(mode: str) -> None:
     import jax
     import jax.numpy as jnp
     import optax
 
     from chainermn_tpu import create_communicator, create_multi_node_optimizer
-    from chainermn_tpu.models import ResNet50, ResNet18
+    from chainermn_tpu.models import ResNet18, ResNet50
     from chainermn_tpu.training.train_step import (
         create_train_state,
         make_train_step,
@@ -38,6 +248,14 @@ def main() -> None:
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
+    if mode == "accel" and not on_accel:
+        raise RuntimeError(
+            "accel bench requested but only the cpu backend is available"
+        )
+    if mode == "cpu":
+        # Parent budgeted for the tiny proxy; never run the full ResNet-50
+        # here even if an accelerator slipped through the env scrub.
+        on_accel = False
     comm = create_communicator("xla")
 
     if on_accel:
@@ -86,7 +304,20 @@ def main() -> None:
         variables["params"], optimizer, comm,
         model_state=variables["batch_stats"],
     )
-    step = make_train_step(loss_fn, optimizer, comm)
+    step = make_train_step(loss_fn, optimizer, comm, donate=False)
+
+    # AOT-compile once; reuse the executable for the timing loops and pull
+    # XLA's own FLOP count (of the per-device partitioned module) for MFU.
+    step_flops = None
+    try:
+        compiled = step.lower(state, (x, y)).compile()
+        analysis = compiled.cost_analysis()
+        if analysis:
+            a = analysis[0] if isinstance(analysis, (list, tuple)) else analysis
+            step_flops = float(a.get("flops", 0.0)) or None
+        step = compiled
+    except Exception:
+        pass
 
     for _ in range(warmup):
         state, metrics = step(state, (x, y))
@@ -102,17 +333,42 @@ def main() -> None:
     per_device = images_per_sec / comm.size
     vs_baseline = per_device / BASELINE_IMG_PER_SEC_PER_DEVICE
 
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(images_per_sec, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(vs_baseline, 3),
-            }
-        )
-    )
+    out = {
+        "metric": metric,
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(vs_baseline, 3),
+        "step_time_ms": round(dt / steps * 1e3, 2),
+        "device_kind": devices[0].device_kind,
+        "n_devices": comm.size,
+        "baseline_note": (
+            "vs_baseline compares per-device img/s to the unverified "
+            "125 img/s/P100 ChainerMN-era figure (different hardware); "
+            "mfu is the hardware-honest metric"
+        ),
+    }
+    peak = _peak_flops(devices[0].device_kind)
+    if step_flops and peak:
+        # cost_analysis() describes the per-device SPMD-partitioned module,
+        # so compare against a single chip's peak.
+        achieved = step_flops / (dt / steps)
+        out["mfu"] = round(achieved / peak, 4)
+        out["per_device_step_tflops"] = round(step_flops / 1e12, 3)
+
+    # Emit the primary number NOW — if the supplementary benchmark below
+    # stalls past the parent's budget, this line is what gets salvaged.
+    print(json.dumps(out), flush=True)
+
+    try:
+        out.update(_bench_allreduce(comm, 100_000_000 if on_accel else 10_000_000))
+    except Exception as e:  # never lose the primary number
+        out["allreduce_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--run":
+        _run_bench(sys.argv[2])
+    else:
+        main()
