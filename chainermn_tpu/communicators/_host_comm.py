@@ -18,6 +18,7 @@ fast path with no communication at all.
 
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Any, Callable, Sequence
 
@@ -27,6 +28,22 @@ import numpy as np
 
 def _is_multiprocess() -> bool:
     return jax.process_count() > 1
+
+
+def _jax_distributed_world():
+    """``(process_id, num_processes)`` of the JAX distributed runtime, or
+    ``(None, None)`` when the distributed client isn't initialised — read
+    from ``jax._src.distributed`` state so asking never triggers XLA
+    backend discovery."""
+    try:
+        from jax._src import distributed
+
+        state = distributed.global_state
+        if state.client is None:
+            return None, None
+        return state.process_id, state.num_processes
+    except Exception:
+        return None, None
 
 
 def _obj_to_padded(obj: Any, pad_to: int | None = None) -> np.ndarray:
@@ -65,16 +82,47 @@ class HostComm:
     """
 
     def __init__(self) -> None:
-        self.tcp = None
-        try:
+        env_keys = (
+            "CHAINERMN_TPU_RANK",
+            "CHAINERMN_TPU_SIZE",
+            "CHAINERMN_TPU_COORD",
+        )
+        set_keys = [k for k in env_keys if os.environ.get(k)]
+        if set_keys and len(set_keys) < len(env_keys):
+            # A partial set is a launcher bug, not a fallback condition.
+            raise RuntimeError(
+                f"native TCP backend partially configured: {set_keys} set "
+                f"but {sorted(set(env_keys) - set(set_keys))} missing"
+            )
+        if set_keys:
+            # The operator explicitly asked for the native TCP backend:
+            # bootstrap failure must PROPAGATE. A silent fallback would make
+            # every process rank 0 / size 1 and scatter/checkpoint agreement
+            # would diverge instead of erroring.
             from chainermn_tpu.native.tcp_comm import TcpHostComm
 
             self.tcp = TcpHostComm.from_env()
-        except Exception:
+        else:
             self.tcp = None
         if self.tcp is not None:
             self.rank = self.tcp.rank
             self.size = self.tcp.size
+            # Rooted object collectives translate mesh-slot roots through
+            # jax process indices; a launcher that numbers the TCP world
+            # differently would silently target the wrong process. Checked
+            # WITHOUT touching jax backend init (this path must stay usable
+            # before/without jax — distributed.global_state is populated by
+            # jax.distributed.initialize, not by backend discovery).
+            jax_pid, jax_nproc = _jax_distributed_world()
+            if jax_pid is not None and (
+                self.rank != jax_pid or self.size != jax_nproc
+            ):
+                raise RuntimeError(
+                    f"native TCP world (rank {self.rank}/{self.size}) "
+                    f"disagrees with the JAX distributed world (process "
+                    f"{jax_pid}/{jax_nproc}); the TCP host plane requires "
+                    "identical numbering and size"
+                )
         else:
             self.rank = jax.process_index()
             self.size = jax.process_count()

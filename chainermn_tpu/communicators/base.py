@@ -63,6 +63,23 @@ class CommunicatorBase:
         self._flat_axes = tuple(mesh.axis_names)
         self._flat_spec = P(self._flat_axes)
 
+    @functools.cached_property
+    def _intra(self) -> tuple[int, int]:
+        """(intra_rank, processes-on-this-host) — the reference's hostname
+        exchange (``_communication_utility.init_ranks`` (dagger), which ran
+        ``MPI_Comm_split_type(SHARED)``). Lazy so that *construction* stays
+        a local, non-collective act (safe to do asymmetrically); the first
+        ``intra_rank``/``intra_size`` access on a multi-process runtime is a
+        host-plane allgather and must happen on every process."""
+        if self.host.size == 1:
+            return 0, 1
+        import socket
+
+        me = (socket.gethostname(), self.host.rank)
+        infos = self.host.allgather_obj(me)
+        same_host = sorted(r for h, r in infos if h == me[0])
+        return same_host.index(self.host.rank), len(same_host)
+
     # ------------------------------------------------------------------
     # Topology properties (reference: communicator_base.py (dagger))
     # ------------------------------------------------------------------
@@ -81,11 +98,20 @@ class CommunicatorBase:
 
     @property
     def intra_rank(self) -> int:
-        return self.topology.intra_rank
+        """Position of this process among the processes sharing its host
+        (hostname-discovered, the reference's ``init_ranks``); 0 for a
+        single process. Multihost: first access is a host-plane collective
+        (see ``_intra``)."""
+        return self._intra[0]
 
     @property
     def intra_size(self) -> int:
-        return self.topology.intra_size
+        """Single process: devices this process drives (the mesh slots of
+        one controller). Multi-process: processes sharing this host (the
+        reference's GPUs-per-node count, one process per accelerator)."""
+        if self.host.size == 1:
+            return self.topology.intra_size
+        return self._intra[1]
 
     @property
     def inter_rank(self) -> int:

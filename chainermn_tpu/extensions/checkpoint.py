@@ -32,6 +32,22 @@ PyTree = Any
 _FNAME_RE = re.compile(r"^snapshot_(?P<name>.+)_(?P<rank>\d+)_(?P<iter>\d+)\.npz$")
 
 
+def _path_key(path) -> str:
+    """Stable string key for a tree path (root leaf → ``'<root>'``)."""
+    return jax.tree_util.keystr(path) or "<root>"
+
+
+def _path_keyed_arrays(state: PyTree) -> dict[str, np.ndarray]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    arrays: dict[str, np.ndarray] = {}
+    for path, leaf in flat:
+        key = _path_key(path)
+        if key in arrays:
+            raise ValueError(f"duplicate tree-path key {key!r}")
+        arrays[key] = np.asarray(leaf)
+    return arrays
+
+
 class MultiNodeCheckpointer:
     def __init__(
         self,
@@ -68,9 +84,13 @@ class MultiNodeCheckpointer:
     def save(self, state: PyTree, iteration: int) -> str:
         """Snapshot ``state`` (any pytree of arrays) for this process, then
         GC old local snapshots beyond ``keep`` (the reference's round-robin
-        stale-file GC)."""
-        leaves = jax.tree.leaves(state)
-        arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+        stale-file GC).
+
+        Arrays are keyed by their *tree path* (``jax.tree_util.keystr``),
+        not position: a pytree reordered between save and load restores
+        correctly by name, and a renamed/missing/extra leaf fails loudly at
+        load instead of silently mis-assigning a shape-compatible array."""
+        arrays = _path_keyed_arrays(state)
         fname = self._fname(iteration)
         tmp = fname + ".tmp.npz"
         np.savez(tmp, **arrays)
@@ -97,15 +117,36 @@ class MultiNodeCheckpointer:
             return state_template, None
         it = max(common)
         data = np.load(self._fname(it))
-        leaves, treedef = jax.tree.flatten(state_template)
-        loaded = [
-            np.asarray(data[f"leaf_{i}"]).astype(np.asarray(t).dtype)
-            for i, t in enumerate(leaves)
-        ]
-        restored = [
-            jax.numpy.asarray(x).reshape(np.shape(t))
-            for x, t in zip(loaded, leaves)
-        ]
+        flat, treedef = jax.tree_util.tree_flatten_with_path(state_template)
+        keys = [_path_key(p) for p, _ in flat]
+        saved, wanted = set(data.files), set(keys)
+        if saved != wanted and all(
+            re.fullmatch(r"leaf_\d+", k) for k in saved
+        ):
+            raise ValueError(
+                f"checkpoint {self._fname(it)} uses the legacy positional "
+                "'leaf_{i}' format (pre-tree-path snapshots); it cannot be "
+                "restored safely by name — re-save from a live state or "
+                "delete the stale snapshot files"
+            )
+        if saved != wanted:
+            raise ValueError(
+                f"checkpoint {self._fname(it)} key set does not match the "
+                f"state template: missing={sorted(wanted - saved)[:8]} "
+                f"unexpected={sorted(saved - wanted)[:8]}"
+            )
+        restored = []
+        for key, (_, t) in zip(keys, flat):
+            arr = np.asarray(data[key])
+            tshape = np.shape(t)
+            if arr.shape != tshape:
+                raise ValueError(
+                    f"checkpoint leaf {key!r} has shape {arr.shape}, "
+                    f"template expects {tshape}"
+                )
+            restored.append(
+                jax.numpy.asarray(arr.astype(np.asarray(t).dtype))
+            )
         return jax.tree.unflatten(treedef, restored), it
 
     def cleanup(self) -> None:

@@ -84,6 +84,11 @@ def create_multi_node_iterator(
 
 
 class _MasterBroadcastIterator:
+    #: every process receives the identical batch — consumers assembling
+    #: global arrays must treat it as replicated, not as a per-process
+    #: data-parallel shard (see Trainer.batch_spec).
+    replicated_batches = True
+
     def __init__(self, dataset, batch_size, comm, rank_master, shuffle, seed):
         self.comm = comm
         self.rank_master = rank_master

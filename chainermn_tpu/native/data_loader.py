@@ -68,6 +68,12 @@ class NativeDataLoader:
       fields: ``(name, dtype, shape)`` per record field, in file order.
       shard: ``(begin, end)`` record range for this process (the dataset
         scatter, SURVEY.md section 3.3); ``None`` = whole file.
+
+    Drop-last semantics: an epoch yields ``floor(n / batch)`` batches; the
+    ``n % batch`` tail records of each epoch's shuffle order are skipped
+    (static batch shapes are what keep the consuming XLA program cache-hot
+    — size your shards accordingly, or pad the record file to a multiple
+    of the batch size to see every record each epoch).
     """
 
     def __init__(

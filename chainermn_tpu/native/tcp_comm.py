@@ -150,22 +150,46 @@ class TcpHostComm:
         """objs[j] goes to rank j; returns what every rank sent here.
 
         Ring schedule: round ``d`` sends to ``rank+d`` and receives from
-        ``rank-d``. TCP's kernel buffering absorbs the sends (no MPI-style
-        rendezvous), so send-then-recv cannot deadlock for the small
-        pickled payloads this host plane carries; when the two partners
-        coincide (round size/2), rank order decides who sends first."""
+        ``rank-d``. The send runs on a helper thread while this thread
+        receives, so the collective never depends on kernel socket
+        buffering to avoid deadlock (payloads larger than the socket
+        buffer are fine; each round's send/recv touch different sockets —
+        or opposite directions of the same full-duplex socket when the
+        partners coincide at round size/2)."""
+        import threading
+
         assert len(objs) == self.size
         out: list[Any] = [None] * self.size
         out[self.rank] = objs[self.rank]
         for d in range(1, self.size):
             to = (self.rank + d) % self.size
             frm = (self.rank - d) % self.size
-            if to == frm and self.rank > to:
+            err: list[BaseException] = []
+
+            def _send():
+                try:
+                    self.send_obj(objs[to], to)
+                except BaseException as e:  # surfaced after join
+                    err.append(e)
+
+            t = threading.Thread(target=_send, daemon=True)
+            t.start()
+            try:
                 out[frm] = self.recv_obj(frm)
-                self.send_obj(objs[to], to)
-            else:
-                self.send_obj(objs[to], to)
-                out[frm] = self.recv_obj(frm)
+            except BaseException:
+                # Bounded join: if the peer is wedged, propagate the recv
+                # error rather than hanging on the stuck send forever (the
+                # daemon thread cannot block interpreter exit).
+                t.join(timeout=10.0)
+                raise
+            t.join(timeout=120.0)
+            if t.is_alive():
+                raise RuntimeError(
+                    f"alltoall_obj send to rank {to} stalled >120s "
+                    "(peer accepted the connection but stopped reading)"
+                )
+            if err:
+                raise err[0]
         return out
 
     def allreduce_obj(

@@ -32,6 +32,26 @@ def default_collate(batch: list) -> Any:
     return np.stack(batch)
 
 
+def host_local_batch_to_global(batch: Any, comm: CommunicatorBase, spec=None):
+    """Assemble each process's host-local batch into the global sharded
+    arrays a jitted step's ``in_specs`` expect. No-op on a single process.
+
+    Default ``spec`` treats local batches as this process's data-parallel
+    shard (leading dim concatenated over processes — the
+    ``scatter_dataset`` norm). Pass ``P()`` for master-broadcast iterators
+    where every process holds the identical batch.
+    """
+    if comm.host.size == 1:
+        return batch
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(comm.grad_axes) if spec is None else spec
+    return multihost_utils.host_local_array_to_global_array(
+        batch, comm.mesh, spec
+    )
+
+
 class Trainer:
     """Drive ``step_fn`` over an iterator with periodic extensions.
 
@@ -48,6 +68,7 @@ class Trainer:
         comm: CommunicatorBase,
         *,
         collate: Callable = default_collate,
+        batch_spec=None,
         log_interval: int = 100,
         out=sys.stdout,
     ) -> None:
@@ -56,6 +77,18 @@ class Trainer:
         self.train_iter = train_iter
         self.comm = comm
         self.collate = collate
+        #: PartitionSpec describing what each process's local batch IS in
+        #: the global batch (see :func:`host_local_batch_to_global`).
+        # Master-broadcast iterators deliver the IDENTICAL batch to every
+        # process; treating those as data-parallel shards would silently
+        # duplicate every example, so detect and default to replicated.
+        if batch_spec is None and getattr(
+            train_iter, "replicated_batches", False
+        ):
+            from jax.sharding import PartitionSpec
+
+            batch_spec = PartitionSpec()
+        self.batch_spec = batch_spec
         self.log_interval = log_interval
         self.out = out
         self.iteration = 0
@@ -89,7 +122,10 @@ class Trainer:
                 it = iter(self.train_iter)
                 fresh_epoch = True
                 continue
-            self.state, metrics = self.step_fn(self.state, self.collate(batch))
+            collated = host_local_batch_to_global(
+                self.collate(batch), self.comm, self.batch_spec
+            )
+            self.state, metrics = self.step_fn(self.state, collated)
             self.iteration += 1
 
             if self.iteration % self.log_interval == 0 or self.iteration == max_iterations:

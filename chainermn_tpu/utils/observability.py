@@ -22,9 +22,10 @@ import jax
 
 
 @contextlib.contextmanager
-def profile(logdir: str, *, with_memory: bool = True):
+def profile(logdir: str):
     """Trace everything inside the block into ``logdir`` (view with
-    TensorBoard's profile plugin / xprof)."""
+    TensorBoard's profile plugin / xprof). Device memory events are part of
+    the standard trace; there is no separate toggle."""
     jax.profiler.start_trace(logdir)
     try:
         yield

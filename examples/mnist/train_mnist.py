@@ -99,7 +99,7 @@ def main(argv=None):
 
     eval_step = make_eval_step(metric_fn, comm)
     evaluator = chainermn_tpu.create_multi_node_evaluator(
-        _evaluate(eval_step, test, args.batchsize), comm
+        _evaluate(eval_step, test, args.batchsize, comm), comm
     )
 
     train_iter = chainermn_tpu.create_synchronized_iterator(
@@ -121,14 +121,25 @@ def main(argv=None):
     return final
 
 
-def _evaluate(eval_step, dataset, batchsize):
-    from chainermn_tpu.training.trainer import default_collate
+def _evaluate(eval_step, dataset, batchsize, comm):
+    from chainermn_tpu.training.trainer import (
+        default_collate,
+        host_local_batch_to_global,
+    )
 
     def fn(st):
         totals, n = {}, 0
         items = list(dataset)
-        for i in range(0, len(items) - batchsize + 1, batchsize):
-            batch = default_collate(items[i : i + batchsize])
+        n_batches = max(0, (len(items) - batchsize) // batchsize + 1)
+        if comm.host.size > 1:
+            # Batch assembly below is collective: every process must run
+            # the same number of iterations even if shard sizes differ ±1.
+            n_batches = min(comm.allgather_obj(n_batches))
+        for b in range(n_batches):
+            i = b * batchsize
+            batch = host_local_batch_to_global(
+                default_collate(items[i : i + batchsize]), comm
+            )
             m = eval_step(st.params, batch, st.model_state)
             for k, v in m.items():
                 totals[k] = totals.get(k, 0.0) + float(v)

@@ -1,0 +1,90 @@
+"""True multi-process test harness (SURVEY.md section 4(b)).
+
+The reference simulated multi-node with ``mpiexec -n N pytest`` — N real MPI
+processes on one host. The TPU-native analog launches N real Python
+processes that ``jax.distributed.initialize`` against a local coordinator on
+the CPU backend (gloo cross-process collectives), so the ``host.size > 1``
+branches — multihost bcast/scatter, hierarchical process meshes, iterator
+broadcast, checkpoint agreement — execute for real instead of being dead
+code under the single-process 8-device mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_DIR = os.path.dirname(_TESTS_DIR)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_workers(
+    case: str,
+    n_procs: int = 2,
+    *,
+    local_devices: int = 2,
+    timeout: float = 240.0,
+    extra_env: dict | None = None,
+):
+    """Launch ``n_procs`` worker processes running ``case`` from
+    ``tests/mp_worker.py``; raise AssertionError with the combined logs if
+    any worker fails. Returns each worker's stdout."""
+    sys.path.insert(0, _REPO_DIR)
+    from _driver_env import cpu_scrubbed_env
+
+    port = free_port()
+    procs = []
+    for rank in range(n_procs):
+        env = cpu_scrubbed_env(local_devices)
+        env["MP_CASE"] = case
+        env["MP_RANK"] = str(rank)
+        env["MP_SIZE"] = str(n_procs)
+        env["MP_COORD"] = f"127.0.0.1:{port}"
+        if extra_env:
+            env.update({k: str(v) for k, v in extra_env.items()})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, os.path.join(_TESTS_DIR, "mp_worker.py")],
+                env=env,
+                cwd=_REPO_DIR,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+
+    deadline = time.monotonic() + timeout
+    outs = [None] * n_procs
+    try:
+        for i, p in enumerate(procs):
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                outs[i], _ = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs[i], _ = p.communicate()
+                outs[i] = (outs[i] or "") + "\n<<TIMED OUT>>"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    failures = [
+        f"--- rank {i} (rc={p.returncode}) ---\n{outs[i]}"
+        for i, p in enumerate(procs)
+        if p.returncode != 0 or "MP_CASE_OK" not in (outs[i] or "")
+    ]
+    assert not failures, (
+        f"multiprocess case {case!r} failed on {len(failures)}/{n_procs} "
+        "ranks:\n" + "\n".join(f[-3000:] for f in failures)
+    )
+    return outs

@@ -1,0 +1,195 @@
+"""Worker entry for the multi-process harness (``mp_harness.py``).
+
+Each worker: ``jax.distributed.initialize`` on the CPU backend (gloo
+cross-process collectives), then runs the case named by ``MP_CASE`` and
+prints ``MP_CASE_OK`` on success. Every case exercises code paths that are
+dead under the single-process suite (``host.size > 1`` branches).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    os.environ["MP_COORD"],
+    num_processes=int(os.environ["MP_SIZE"]),
+    process_id=int(os.environ["MP_RANK"]),
+)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+RANK = jax.process_index()
+SIZE = jax.process_count()
+
+
+def case_bcast_data():
+    """base.py multihost bcast_data/bcast/scatter branches + intra ranks."""
+    from chainermn_tpu import create_communicator
+
+    comm = create_communicator("xla")
+    assert comm.host.size == SIZE
+
+    # All processes share one hostname here, so the intra group is the
+    # whole process set (the reference's multi-process-per-node CI shape).
+    assert comm.intra_size == SIZE, comm.intra_size
+    assert comm.intra_rank == RANK, (comm.intra_rank, RANK)
+
+    # bcast_data: divergent params must converge to process-0's values.
+    params = {"w": jnp.full((4, 3), float(RANK + 1)), "b": jnp.arange(3.0) * (RANK + 1)}
+    params = comm.bcast_data(params)
+    np.testing.assert_allclose(np.asarray(params["w"]), np.full((4, 3), 1.0))
+    np.testing.assert_allclose(np.asarray(params["b"]), np.arange(3.0))
+
+    # bcast (plain value): root process's array everywhere.
+    x = comm.bcast(jnp.full((5,), float(RANK)))
+    np.testing.assert_allclose(np.asarray(x), np.zeros(5))
+
+    # scatter: all processes must agree on the root's stacked buffer. The
+    # result is globally sharded — each process can only read the shards it
+    # addresses, so compare per addressable shard.
+    expected = np.arange(comm.size * 2, dtype=np.float32).reshape(comm.size, 2)
+    stacked = expected * (1.0 if RANK == 0 else -99.0)
+    shards = comm.scatter(stacked)
+    assert shards.shape == expected.shape
+    for s in shards.addressable_shards:
+        np.testing.assert_allclose(np.asarray(s.data), expected[s.index])
+
+    # object collectives through the multihost_utils plane
+    got = comm.allgather_obj({"r": RANK})
+    assert [g["r"] for g in got] == list(range(SIZE))
+    obj = comm.bcast_obj({"v": RANK * 10} if RANK == 0 else None)
+    assert obj == {"v": 0}
+    total = comm.allreduce_obj({"n": 1, "loss": float(RANK)})
+    assert total["n"] == SIZE
+
+
+def case_hierarchical():
+    """xla_communicator.py n_proc>1 hierarchical mesh + 2-axis grad pmean."""
+    import optax
+    from chainermn_tpu.communicators.xla_communicator import (
+        HierarchicalCommunicator,
+    )
+    from chainermn_tpu.models import MLP
+    from chainermn_tpu.optimizers import create_multi_node_optimizer
+    from chainermn_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    comm = HierarchicalCommunicator()
+    assert comm.mesh.shape["inter"] == SIZE
+    assert comm.mesh.shape["intra"] == jax.local_device_count()
+    assert comm.inter_size == SIZE and comm.inter_rank == RANK
+
+    model = MLP(n_units=8, n_out=4)
+    batch = 2 * comm.size
+    # Same data on every process (host-local full batch -> global array).
+    xl = np.tile(np.arange(10, dtype=np.float32), (batch, 1)) / 10.0
+    yl = np.arange(batch, dtype=np.int32) % 4
+    x, y = multihost_utils.host_local_array_to_global_array(
+        (jnp.asarray(xl), jnp.asarray(yl)), comm.mesh, P()
+    )
+    variables = model.init(jax.random.PRNGKey(0), xl[:1])
+
+    def loss_fn(params, batch_):
+        xb, yb = batch_
+        logits = model.apply({"params": params}, xb)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    state = create_train_state(variables["params"], opt, comm)
+    step = make_train_step(loss_fn, opt, comm)
+    state, metrics = step(state, (x, y))
+    jax.block_until_ready(state.params)
+    # metrics are pmean-ed over the whole mesh -> fully replicated -> every
+    # process can fetch the global value directly.
+    loss = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss)
+    assert int(state.step) == 1
+
+
+def case_iterator():
+    """Multihost master-broadcast iterator: identical batches everywhere."""
+    from chainermn_tpu import create_communicator
+    from chainermn_tpu.iterators import create_multi_node_iterator
+
+    comm = create_communicator("xla")
+    dataset = [(np.full((2,), i, np.float32), i % 3) for i in range(12)]
+    it = create_multi_node_iterator(dataset, 4, comm, seed=7)
+    batches = [next(it) for _ in range(3)]
+    digest = [[int(b[0][0]) for b in batch] for batch in batches]
+    everyone = comm.allgather_obj(digest)
+    assert all(d == everyone[0] for d in everyone), everyone
+
+
+def case_checkpoint():
+    """Checkpoint max-common-iteration agreement across real processes."""
+    import shutil
+
+    from chainermn_tpu import create_communicator
+    from chainermn_tpu.extensions.checkpoint import (
+        create_multi_node_checkpointer,
+    )
+
+    comm = create_communicator("xla")
+    path = os.environ["MP_CKPT_DIR"]
+    ckpt = create_multi_node_checkpointer("mp", comm, path=path, keep=0)
+
+    state = {"w": jnp.full((3,), float(RANK)), "step": jnp.int32(0)}
+    # Rank 0 has iterations {1, 2}; other ranks only {1}: the max COMMON
+    # iteration must be 1 on every process.
+    ckpt.save({**state, "step": jnp.int32(1)}, 1)
+    if RANK == 0:
+        ckpt.save({**state, "step": jnp.int32(2)}, 2)
+    comm.barrier()
+
+    restored, it = ckpt.maybe_load(state)
+    assert it == 1, it
+    assert int(restored["step"]) == 1
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.full((3,), float(RANK)))
+
+
+def case_trainer_mnist():
+    """The mnist example's Trainer path end-to-end under real processes."""
+    sys.argv = [
+        "train_mnist.py",
+        "--communicator", "xla",
+        "--iterations", "8",
+        "--batchsize", str(4 * SIZE * jax.local_device_count()),
+    ]
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "train_mnist",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "mnist", "train_mnist.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    result = mod.main()
+    assert result is None or np.isfinite(
+        float(result.get("val_loss", 0.0))
+    )
+
+
+CASES = {
+    name[len("case_"):]: fn
+    for name, fn in list(globals().items())
+    if name.startswith("case_")
+}
+
+
+if __name__ == "__main__":
+    CASES[os.environ["MP_CASE"]]()
+    print("MP_CASE_OK", flush=True)

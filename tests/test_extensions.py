@@ -73,6 +73,35 @@ def test_checkpointer_resumes_max_common(tmp_path, comm):
     assert it == 20  # newest common (single process: newest local)
 
 
+def test_checkpointer_keys_by_tree_path_not_position(tmp_path, comm):
+    """Same-shaped leaves restore by NAME: a template whose dict ordering
+    differs still gets each array at its right key (the positional
+    ``leaf_{i}`` format silently mis-assigned here)."""
+    ckpt = create_multi_node_checkpointer("paths", comm, path=str(tmp_path))
+    state = {"alpha": jnp.full((2, 2), 1.0), "beta": jnp.full((2, 2), 2.0)}
+    ckpt.save(state, 1)
+
+    # dict insertion order differs; tree paths must still disambiguate
+    template = {"beta": jnp.zeros((2, 2)), "alpha": jnp.zeros((2, 2))}
+    restored, _ = ckpt.maybe_load(template)
+    np.testing.assert_array_equal(np.asarray(restored["alpha"]), np.full((2, 2), 1.0))
+    np.testing.assert_array_equal(np.asarray(restored["beta"]), np.full((2, 2), 2.0))
+
+
+def test_checkpointer_renamed_leaf_fails_loudly(tmp_path, comm):
+    ckpt = create_multi_node_checkpointer("rename", comm, path=str(tmp_path))
+    ckpt.save({"w": jnp.zeros((3,)), "b": jnp.zeros((3,))}, 1)
+    with pytest.raises(ValueError, match="key set"):
+        ckpt.maybe_load({"w": jnp.zeros((3,)), "bias": jnp.zeros((3,))})
+
+
+def test_checkpointer_shape_mismatch_fails_loudly(tmp_path, comm):
+    ckpt = create_multi_node_checkpointer("shape", comm, path=str(tmp_path))
+    ckpt.save({"w": jnp.zeros((3, 4))}, 1)
+    with pytest.raises(ValueError, match="shape"):
+        ckpt.maybe_load({"w": jnp.zeros((4, 3))})
+
+
 def test_checkpointer_cleanup(tmp_path, comm):
     ckpt = create_multi_node_checkpointer("clean", comm, path=str(tmp_path))
     ckpt.save({"x": jnp.zeros(1)}, 1)

@@ -1,0 +1,34 @@
+"""True multi-process tests (SURVEY.md section 4(b)): N real processes under
+``jax.distributed`` on local CPU, exercising the ``host.size > 1`` branches
+the single-process 8-device suite cannot reach — the TPU-native analog of
+the reference's ``mpiexec -n 2 pytest`` harness."""
+
+import pytest
+
+from mp_harness import run_workers
+
+pytestmark = pytest.mark.multiprocess
+
+
+def test_mp_bcast_data_scatter_objs():
+    run_workers("bcast_data", n_procs=2)
+
+
+def test_mp_hierarchical_train_step():
+    run_workers("hierarchical", n_procs=2)
+
+
+def test_mp_iterator():
+    run_workers("iterator", n_procs=2)
+
+
+def test_mp_checkpoint_agreement(tmp_path):
+    run_workers(
+        "checkpoint", n_procs=2, extra_env={"MP_CKPT_DIR": str(tmp_path)}
+    )
+
+
+def test_mp_trainer_mnist():
+    """The mnist example end-to-end (Trainer + scatter + sync iterator +
+    evaluator) under 2 real processes, unchanged — VERDICT round-1 item 10."""
+    run_workers("trainer_mnist", n_procs=2, timeout=420)
