@@ -213,6 +213,39 @@ class HostComm:
             out = op(out, it)
         return out
 
+    # -- subgroups (reference: MPI_Comm_split) -----------------------------
+
+    @property
+    def world_members(self) -> list[int]:
+        """World process indices backing this comm's ranks, in rank order.
+        Identity for the world comm; group-ordered subset after :meth:`split`."""
+        return getattr(self, "_world_members", None) or list(range(self.size))
+
+    def split(self, color: int, key: int = 0) -> "HostComm":
+        """Partition processes by ``color`` into independent sub-host-planes.
+
+        Requires the native TCP backend: ``multihost_utils`` collectives are
+        *globally* collective (every process of the JAX world must call
+        them), so two color groups issuing independent operations through it
+        would deadlock — the per-pair TCP channels have no such coupling.
+        """
+        if self.size == 1:
+            return self
+        if self.tcp is None:
+            raise NotImplementedError(
+                "multihost split() requires the native TCP host backend "
+                "(set CHAINERMN_TPU_RANK/SIZE/COORD): multihost_utils "
+                "collectives are global and cannot serve independent groups"
+            )
+        group = self.tcp.split(color, key)
+        sub = HostComm.__new__(HostComm)
+        sub.tcp = group
+        sub.rank = group.rank
+        sub.size = group.size
+        parents = self.world_members
+        sub._world_members = [parents[m] for m in group.members]
+        return sub
+
 
 def _default_sum(a: Any, b: Any) -> Any:
     if isinstance(a, dict):

@@ -50,10 +50,12 @@ class CommunicatorBase:
     #: name used by :func:`chainermn_tpu.create_communicator`
     name: str = "base"
 
-    def __init__(self, mesh: Mesh, *, allreduce_grad_dtype=None) -> None:
+    def __init__(
+        self, mesh: Mesh, *, allreduce_grad_dtype=None, _host: HostComm | None = None
+    ) -> None:
         self.mesh = mesh
         self.topology = MeshTopology(mesh)
-        self.host = HostComm()
+        self.host = _host if _host is not None else HostComm()
         #: dtype for compressed gradient allreduce
         #: (reference: ``allreduce_grad_dtype='float16'`` on
         #: ``PureNcclCommunicator`` (dagger); bf16 is the TPU-native choice).
@@ -182,11 +184,21 @@ class CommunicatorBase:
                 return collectives.allreduce(x, axes, op=op)
             return fn
 
+        def _alltoall(x):
+            # Local view is this rank's send row [size, ...]; piece j goes to
+            # rank j, received pieces concatenate back along axis 0 — the MPI
+            # alltoall exchange as ONE XLA collective over the (possibly
+            # factorised) mesh axes.
+            return collectives.alltoall(
+                x, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+
         return {
             "sum": smap(_reduce("sum"), out_stacked=False),
             "mean": smap(_reduce("mean"), out_stacked=False),
             "max": smap(_reduce("max"), out_stacked=False),
             "min": smap(_reduce("min"), out_stacked=False),
+            "alltoall": smap(_alltoall, out_stacked=True),
         }
 
     def allreduce(self, x: jax.Array, op: str = "sum") -> jax.Array:
@@ -197,10 +209,38 @@ class CommunicatorBase:
         return out[0]
 
     def _root_process(self, root: int) -> int:
-        """Process index owning mesh slot ``root`` — roots are *mesh-slot*
+        """Host-plane rank owning mesh slot ``root`` — roots are *mesh-slot*
         ranks (the reference's MPI ranks), not process indices; on a
-        multi-process runtime the two differ."""
-        return list(self.mesh.devices.flat)[root].process_index
+        multi-process runtime the two differ. For the world communicator the
+        host rank IS the process index (asserted at HostComm bootstrap);
+        split communicators translate through their member list."""
+        pid = list(self.mesh.devices.flat)[root].process_index
+        members = self.host.world_members
+        return members.index(pid) if members != list(range(len(members))) else pid
+
+    def _agree_value(self, tree: PyTree, root_host_rank: int) -> PyTree:
+        """Every process of this communicator gets the root process's value
+        of ``tree``.
+
+        World communicators prefer ``multihost_utils.broadcast_one_to_all``
+        (device-plane broadcast, scales to big param pytrees); subgroup
+        communicators from :meth:`split` — and TCP worlds running without
+        the JAX distributed runtime — ride the host plane instead, because
+        ``multihost_utils`` collectives are world-global and would deadlock
+        or over-synchronise a color group."""
+        if self.host.size == 1:
+            return tree
+        is_subgroup = getattr(self.host, "_world_members", None) is not None
+        if not is_subgroup and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.broadcast_one_to_all(
+                tree, is_source=(self.host.rank == root_host_rank)
+            )
+        payload = None
+        if self.host.rank == root_host_rank:
+            payload = jax.tree.map(lambda a: np.asarray(a), tree)
+        return self.host.bcast_obj(payload, root_host_rank)
 
     def bcast(self, x: jax.Array, root: int = 0, *, stacked: bool = False) -> jax.Array:
         """Broadcast ``x`` to a mesh-replicated value (the common
@@ -217,14 +257,9 @@ class CommunicatorBase:
                     f"({self.size}), got shape {x.shape}"
                 )
             x = x[root]
-        if self.host.size > 1:
-            # Cross-process agreement: every process must end up with the
-            # *root process's* value, not its own local one.
-            from jax.experimental import multihost_utils
-
-            x = multihost_utils.broadcast_one_to_all(
-                x, is_source=(self.host.rank == self._root_process(root))
-            )
+        # Cross-process agreement: every process must end up with the
+        # *root process's* value, not its own local one.
+        x = self._agree_value(x, self._root_process(root))
         return jax.device_put(x, NamedSharding(self.mesh, P()))
 
     def allgather(self, x: jax.Array) -> jax.Array:
@@ -238,23 +273,21 @@ class CommunicatorBase:
     def alltoall(self, x: jax.Array) -> jax.Array:
         """Eager all-to-all on ``x[size, size, ...]`` (rank i's row i is its
         send buffer): returns the transposed exchange, matching
-        ``MPI_Alltoall`` on the stacked view."""
+        ``MPI_Alltoall`` on the stacked view. Shards the stack over the mesh
+        and runs a real ``lax.all_to_all`` — the bytes move device-to-device
+        over ICI, not through a host transpose."""
         x = jnp.asarray(x)
         if x.ndim < 2 or x.shape[0] != self.size or x.shape[1] != self.size:
             raise ValueError("alltoall expects [size, size, ...] input")
-        return jnp.swapaxes(x, 0, 1)
+        x = self._shard_stacked(x)
+        return self._jitted["alltoall"](x)
 
     def scatter(self, x: jax.Array, root: int = 0) -> jax.Array:
         """Scatter root's ``[size, ...]`` buffer: shard i receives ``x[i]``,
         returned as the stacked sharded array. Multihost: the root process's
         buffer is broadcast first so every process shards the same data."""
         x = jnp.asarray(x)
-        if self.host.size > 1:
-            from jax.experimental import multihost_utils
-
-            x = multihost_utils.broadcast_one_to_all(
-                x, is_source=(self.host.rank == self._root_process(root))
-            )
+        x = self._agree_value(x, self._root_process(root))
         return self._shard_stacked(x)
 
     # ------------------------------------------------------------------
@@ -266,12 +299,7 @@ class CommunicatorBase:
         processes when multihost), so all ranks start from rank-``root``'s
         weights — reference ``bcast_data(model)`` called on the first
         optimizer update (``optimizers.py`` (dagger))."""
-        if self.host.size > 1:
-            from jax.experimental import multihost_utils
-
-            params = multihost_utils.broadcast_one_to_all(
-                params, is_source=(self.host.rank == self._root_process(root))
-            )
+        params = self._agree_value(params, self._root_process(root))
         repl = NamedSharding(self.mesh, P())
         return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), repl), params)
 
@@ -348,18 +376,24 @@ class CommunicatorBase:
         (there is nothing to split at host granularity; use
         :meth:`sub_communicator` to subset the mesh).
 
-        Multihost subgroup communicators are not yet supported: every
-        host-plane collective here rides globally-collective
-        ``multihost_utils`` calls, so two color groups issuing independent
-        operations would deadlock. Subgroup host collectives arrive with the
-        native TCP backend (``chainermn_tpu.native``)."""
-        del key
+        Multihost: requires the native TCP host backend (per-pair channels
+        serve independent groups; ``multihost_utils`` collectives are
+        world-global and would deadlock). The returned communicator's host
+        plane is the color group and its mesh covers the group processes'
+        devices, so both ``*_obj`` collectives and eager array collectives
+        run group-locally."""
         if self.host.size == 1:
             return self
-        raise NotImplementedError(
-            "multihost split() needs per-group host collectives "
-            "(chainermn_tpu.native); device-plane subsets are available via "
-            "sub_communicator()"
+        sub_host = self.host.split(color, key)
+        members = sub_host.world_members  # world process ids, group order
+        by_pid: dict[int, list] = {}
+        for d in self.mesh.devices.flat:
+            by_pid.setdefault(d.process_index, []).append(d)
+        devices = [d for pid in members for d in by_pid.get(pid, [])]
+        sub_mesh = Mesh(np.array(devices).reshape(len(devices)), (self.axis_name,))
+        return _SplitCommunicator(
+            sub_mesh, _host=sub_host,
+            allreduce_grad_dtype=self.allreduce_grad_dtype,
         )
 
     def sub_communicator(self, device_indices: Sequence[int]) -> "CommunicatorBase":
@@ -376,3 +410,17 @@ class CommunicatorBase:
             f"<{type(self).__name__} name={self.name!r} size={self.size} "
             f"axes={dict(self.mesh.shape)} processes={self.host.size}>"
         )
+
+
+class _SplitCommunicator(CommunicatorBase):
+    """Communicator over one color group of a multihost :meth:`split`.
+
+    ``rank``/``size`` are group-relative (MPI parity: the communicator you
+    get back from ``MPI_Comm_split`` renumbers you); the host plane is the
+    subgroup TCP comm and the mesh holds only group processes' devices."""
+
+    name = "split"
+
+    @property
+    def rank(self) -> int:  # group rank, not world process index
+        return self.host.rank

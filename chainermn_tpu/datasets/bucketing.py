@@ -59,18 +59,19 @@ def bucket_batches(
         pools.setdefault(b, []).append((src, tgt))
         pool = pools[b]
         if len(pool) == batch_size:
-            yield _emit(pool, b, pad_id)
+            yield _emit(pool, b, pad_id, len(pool))
             pools[b] = []
     if not drop_remainder:
         for b, pool in pools.items():
             if pool:
                 # pad the batch dim up with repeats so the shape stays fixed
+                n_real = len(pool)
                 while len(pool) < batch_size:
                     pool.append(pool[-1])
-                yield _emit(pool, b, pad_id)
+                yield _emit(pool, b, pad_id, n_real)
 
 
-def _emit(pool, bucket: int, pad_id: int) -> dict:
+def _emit(pool, bucket: int, pad_id: int, n_real: int) -> dict:
     srcs, tgts, sms, tms = [], [], [], []
     for s, t in pool:
         ps, ms = pad_to(s, bucket, pad_id)
@@ -85,4 +86,9 @@ def _emit(pool, bucket: int, pad_id: int) -> dict:
         "src_mask": np.stack(sms),
         "tgt_mask": np.stack(tms),
         "bucket": bucket,
+        # Eval-side extras: the ragged originals (BLEU references) and the
+        # real row count — rows past n_real are shape-keeping repeats and
+        # must not enter corpus statistics.
+        "tgt_raw": [list(t) for _, t in pool],
+        "n_real": n_real,
     }

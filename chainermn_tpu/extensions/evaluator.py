@@ -23,22 +23,40 @@ from chainermn_tpu.communicators.base import CommunicatorBase
 def create_multi_node_evaluator(
     evaluator: Callable[..., Mapping[str, Any]],
     communicator: CommunicatorBase,
+    *,
+    reduce: str = "mean",
+    finalize: Callable[[dict[str, float]], Mapping[str, Any]] | None = None,
 ):
     """Wrap ``evaluator`` (any callable returning ``{name: scalar}``) so its
-    results are averaged across processes.
+    results are aggregated across processes.
 
-    If the returned dict contains the key ``'n'`` (local example count), a
-    weighted average is computed; otherwise a plain mean over ranks —
-    matching the reference's divide-by-size behaviour.
+    ``reduce='mean'`` (default, the reference's divide-by-size behaviour):
+    if the returned dict contains the key ``'n'`` (local example count), a
+    weighted average is computed; otherwise a plain mean over ranks.
+
+    ``reduce='sum'``: plain element-wise sum — for metrics whose corpus
+    value is a function of summed sufficient statistics rather than an
+    average (corpus BLEU: :mod:`chainermn_tpu.utils.bleu`).
+
+    ``finalize``: applied to the aggregated dict on every rank (e.g.
+    ``bleu_from_stats`` turning summed n-gram counts into the score).
     """
+    if reduce not in ("mean", "sum"):
+        raise ValueError(f"reduce must be 'mean' or 'sum', got {reduce!r}")
 
-    def evaluate(*args, **kwargs) -> dict[str, float]:
+    def evaluate(*args, **kwargs):
         local = dict(evaluator(*args, **kwargs))
-        n = float(local.pop("n", 1.0))
-        weighted = {k: float(v) * n for k, v in local.items()}
-        weighted["__n"] = n
-        total = communicator.allreduce_obj(weighted)
-        n_total = total.pop("__n")
-        return {k: v / n_total for k, v in total.items()}
+        if reduce == "sum":
+            total = communicator.allreduce_obj(
+                {k: float(v) for k, v in local.items()}
+            )
+        else:
+            n = float(local.pop("n", 1.0))
+            weighted = {k: float(v) * n for k, v in local.items()}
+            weighted["__n"] = n
+            total = communicator.allreduce_obj(weighted)
+            n_total = total.pop("__n")
+            total = {k: v / n_total for k, v in total.items()}
+        return dict(finalize(total)) if finalize is not None else total
 
     return evaluate

@@ -74,6 +74,9 @@ class Seq2Seq(nn.Module):
 
     Mirrors the reference example's shape: embed → stacked-LSTM encoder →
     final state seeds the decoder → stacked-LSTM decoder → vocab projection.
+    Setup-style so :meth:`encode` / :meth:`decode_step` (greedy inference,
+    the reference example's BLEU-eval path) share submodules — and therefore
+    parameters — with the teacher-forced :meth:`__call__`.
     """
 
     src_vocab: int
@@ -83,7 +86,13 @@ class Seq2Seq(nn.Module):
     num_layers: int = 2
     compute_dtype: Any = jnp.float32
 
-    @nn.compact
+    def setup(self):
+        self.src_emb = nn.Embed(self.src_vocab, self.embed, name="src_emb")
+        self.tgt_emb = nn.Embed(self.tgt_vocab, self.embed, name="tgt_emb")
+        self.encoder = _StackedLSTM(self.hidden, self.num_layers, name="encoder")
+        self.decoder = _StackedLSTM(self.hidden, self.num_layers, name="decoder")
+        self.proj = nn.Dense(self.tgt_vocab, name="proj")
+
     def __call__(
         self,
         src_tokens: jax.Array,   # [B, Ts]
@@ -91,18 +100,63 @@ class Seq2Seq(nn.Module):
         src_mask: jax.Array,     # [B, Ts]
         tgt_mask: jax.Array,     # [B, Tt]
     ) -> jax.Array:
-        src = nn.Embed(self.src_vocab, self.embed, name="src_emb")(src_tokens)
-        tgt = nn.Embed(self.tgt_vocab, self.embed, name="tgt_emb")(tgt_tokens)
-        src = src.astype(self.compute_dtype)
-        tgt = tgt.astype(self.compute_dtype)
+        src = self.src_emb(src_tokens).astype(self.compute_dtype)
+        tgt = self.tgt_emb(tgt_tokens).astype(self.compute_dtype)
+        _, enc_carry = self.encoder(src, src_mask.astype(src.dtype))
+        dec_out, _ = self.decoder(tgt, tgt_mask.astype(tgt.dtype), carry=enc_carry)
+        return self.proj(dec_out)
 
-        _, enc_carry = _StackedLSTM(
-            self.hidden, self.num_layers, name="encoder"
-        )(src, src_mask.astype(src.dtype))
-        dec_out, _ = _StackedLSTM(
-            self.hidden, self.num_layers, name="decoder"
-        )(tgt, tgt_mask.astype(tgt.dtype), carry=enc_carry)
-        return nn.Dense(self.tgt_vocab, name="proj")(dec_out)
+    def encode(self, src_tokens: jax.Array, src_mask: jax.Array):
+        """Run the encoder; returns the carry that seeds the decoder."""
+        src = self.src_emb(src_tokens).astype(self.compute_dtype)
+        _, enc_carry = self.encoder(src, src_mask.astype(src.dtype))
+        return enc_carry
+
+    def decode_step(self, carry, tok: jax.Array):
+        """One greedy-decode step: ``tok [B]`` → (logits ``[B, V]``, carry)."""
+        emb = self.tgt_emb(tok[:, None]).astype(self.compute_dtype)  # [B,1,E]
+        out, carry = self.decoder(
+            emb, jnp.ones((tok.shape[0], 1), emb.dtype), carry=carry
+        )
+        return self.proj(out[:, 0]), carry
+
+
+def greedy_decode(
+    model: Seq2Seq,
+    variables,
+    src_tokens: jax.Array,
+    src_mask: jax.Array,
+    max_len: int,
+    *,
+    bos: int = 1,
+    eos: int = 2,
+) -> jax.Array:
+    """Jittable greedy decoding: ``[B, Ts]`` sources → ``[B, max_len]``
+    hypothesis token ids. Positions after the first emitted ``eos`` are
+    filled with ``eos`` (host-side truncation recovers the sentence) — the
+    static-shape answer to the reference example's variable-length decode
+    (``examples/seq2seq/seq2seq.py`` (dagger) BLEU eval, SURVEY.md §2.8).
+    """
+    B = src_tokens.shape[0]
+    carry = model.apply(variables, src_tokens, src_mask, method=Seq2Seq.encode)
+
+    def body(state, _):
+        carry, tok, done = state
+        logits, carry = model.apply(
+            variables, carry, tok, method=Seq2Seq.decode_step
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, jnp.int32(eos), nxt)
+        done = done | (nxt == eos)
+        return (carry, nxt, done), nxt
+
+    init = (
+        carry,
+        jnp.full((B,), bos, jnp.int32),
+        jnp.zeros((B,), dtype=bool),
+    )
+    _, toks = jax.lax.scan(body, init, None, length=max_len)
+    return toks.T  # [B, max_len]
 
 
 def seq2seq_loss(logits, targets, tgt_mask):

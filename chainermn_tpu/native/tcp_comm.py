@@ -52,60 +52,38 @@ def _load():
     return _lib
 
 
-class TcpHostComm:
-    """Full-mesh TCP communicator over processes.
+class _LinearObjCollectives:
+    """Object collectives as rooted linear exchanges over ``send_obj`` /
+    ``recv_obj`` + ``rank``/``size``. Payloads are small (metrics dicts,
+    dataset indices, checkpoint manifests), so simplicity beats tree
+    algorithms here; the bulk data path is XLA's. Shared by the world
+    communicator and by subgroup communicators from :meth:`split` — the
+    reference got the same reuse from ``MPI_Comm_split`` returning another
+    plain MPI communicator."""
 
-    The collective algorithms are rooted linear exchanges — object payloads
-    are small (metrics dicts, dataset indices, checkpoint manifests), so
-    simplicity beats tree algorithms here; the bulk data path is XLA's.
-    """
-
-    def __init__(self, rank: int, size: int, coord: str) -> None:
-        lib = _load()
-        host, port = coord.rsplit(":", 1)
-        self._h = lib.hc_init(rank, size, host.encode(), int(port))
-        if not self._h:
-            raise RuntimeError(
-                f"TcpHostComm bootstrap failed (rank {rank}/{size} @ {coord})"
-            )
-        self.rank = rank
-        self.size = size
-
-    @classmethod
-    def from_env(cls) -> Optional["TcpHostComm"]:
-        """Build from CHAINERMN_TPU_{RANK,SIZE,COORD}; None when unset."""
-        rank = os.environ.get("CHAINERMN_TPU_RANK")
-        size = os.environ.get("CHAINERMN_TPU_SIZE")
-        coord = os.environ.get("CHAINERMN_TPU_COORD")
-        if rank is None or size is None or coord is None:
-            return None
-        return cls(int(rank), int(size), coord)
-
-    # -- point-to-point (the reference's send_obj/recv_obj) ----------------
+    rank: int
+    size: int
 
     def send_obj(self, obj: Any, dest: int) -> None:
-        payload = pickle.dumps(obj)
-        rc = _load().hc_send(self._h, dest, payload, len(payload))
-        if rc != 0:
-            raise RuntimeError(f"send_obj to {dest} failed")
+        raise NotImplementedError
 
     def recv_obj(self, source: int) -> Any:
-        lib = _load()
-        n = lib.hc_recv_size(self._h, source)
-        if n < 0:
-            raise RuntimeError(f"recv_obj from {source} failed")
-        buf = ctypes.create_string_buffer(int(n))
-        if lib.hc_recv_body(self._h, source, buf, n) != 0:
-            raise RuntimeError(f"recv_obj from {source} failed")
-        return pickle.loads(buf.raw[:n])
-
-    # -- collectives -------------------------------------------------------
+        raise NotImplementedError
 
     def barrier(self) -> None:
+        """Linear p2p barrier: gather a token to group rank 0, then release.
+        (The world communicator overrides this with the native in-library
+        barrier.)"""
         if self.size == 1:
             return
-        if _load().hc_barrier(self._h) != 0:
-            raise RuntimeError("barrier failed")
+        if self.rank == 0:
+            for r in range(1, self.size):
+                self.recv_obj(r)
+            for r in range(1, self.size):
+                self.send_obj(None, r)
+        else:
+            self.send_obj(None, 0)
+            self.recv_obj(0)
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
         if self.size == 1:
@@ -205,6 +183,74 @@ class TcpHostComm:
             out = op(out, it)
         return out
 
+    # -- subgroups (the reference's MPI_Comm_split) ------------------------
+
+    def split(self, color: int, key: int = 0) -> "TcpGroupComm":
+        """Partition this communicator's processes by ``color`` into
+        independent subgroup communicators; ``key`` orders ranks within a
+        group (ties broken by parent rank — exactly ``MPI_Comm_split``).
+
+        Collective over *this* communicator (every member must call it).
+        The subgroup rides the parent's per-pair FIFO p2p channels, so
+        different groups' collectives proceed independently (disjoint rank
+        pairs); interleaving parent-level and group-level collectives on the
+        same pairs concurrently is the caller's responsibility to avoid,
+        same as MPI's per-communicator ordering rule.
+        """
+        info = self.allgather_obj((color, key, self.rank))
+        members = [r for c, k, r in sorted(
+            (c, k, r) for c, k, r in info) if c == color]
+        return TcpGroupComm(self, members)
+
+
+class TcpHostComm(_LinearObjCollectives):
+    """Full-mesh TCP communicator over processes (the world)."""
+
+    def __init__(self, rank: int, size: int, coord: str) -> None:
+        lib = _load()
+        host, port = coord.rsplit(":", 1)
+        self._h = lib.hc_init(rank, size, host.encode(), int(port))
+        if not self._h:
+            raise RuntimeError(
+                f"TcpHostComm bootstrap failed (rank {rank}/{size} @ {coord})"
+            )
+        self.rank = rank
+        self.size = size
+
+    @classmethod
+    def from_env(cls) -> Optional["TcpHostComm"]:
+        """Build from CHAINERMN_TPU_{RANK,SIZE,COORD}; None when unset."""
+        rank = os.environ.get("CHAINERMN_TPU_RANK")
+        size = os.environ.get("CHAINERMN_TPU_SIZE")
+        coord = os.environ.get("CHAINERMN_TPU_COORD")
+        if rank is None or size is None or coord is None:
+            return None
+        return cls(int(rank), int(size), coord)
+
+    # -- point-to-point (the reference's send_obj/recv_obj) ----------------
+
+    def send_obj(self, obj: Any, dest: int) -> None:
+        payload = pickle.dumps(obj)
+        rc = _load().hc_send(self._h, dest, payload, len(payload))
+        if rc != 0:
+            raise RuntimeError(f"send_obj to {dest} failed")
+
+    def recv_obj(self, source: int) -> Any:
+        lib = _load()
+        n = lib.hc_recv_size(self._h, source)
+        if n < 0:
+            raise RuntimeError(f"recv_obj from {source} failed")
+        buf = ctypes.create_string_buffer(int(n))
+        if lib.hc_recv_body(self._h, source, buf, n) != 0:
+            raise RuntimeError(f"recv_obj from {source} failed")
+        return pickle.loads(buf.raw[:n])
+
+    def barrier(self) -> None:
+        if self.size == 1:
+            return
+        if _load().hc_barrier(self._h) != 0:
+            raise RuntimeError("barrier failed")
+
     def finalize(self) -> None:
         if self._h:
             _load().hc_finalize(self._h)
@@ -215,3 +261,30 @@ class TcpHostComm:
             self.finalize()
         except Exception:
             pass
+
+
+class TcpGroupComm(_LinearObjCollectives):
+    """Subgroup communicator from :meth:`_LinearObjCollectives.split`.
+
+    A rank-translated view over the parent's p2p transport: group rank ``i``
+    is world rank ``members[i]``. All collective algorithms come from the
+    mixin; the barrier is the p2p one (the native in-library barrier is
+    world-wide). Nested ``split`` works — ``members`` always refers to the
+    *immediate* parent's rank space and translation composes.
+    """
+
+    def __init__(self, parent: _LinearObjCollectives, members: Sequence[int]) -> None:
+        if parent.rank not in members:
+            raise ValueError(
+                f"rank {parent.rank} not in its own split group {members}"
+            )
+        self.parent = parent
+        self.members = list(members)
+        self.rank = self.members.index(parent.rank)
+        self.size = len(self.members)
+
+    def send_obj(self, obj: Any, dest: int) -> None:
+        self.parent.send_obj(obj, self.members[dest])
+
+    def recv_obj(self, source: int) -> Any:
+        return self.parent.recv_obj(self.members[source])

@@ -1,0 +1,105 @@
+"""Corpus BLEU with distributable sufficient statistics.
+
+The reference's seq2seq example evaluated translations with BLEU
+(``examples/seq2seq/seq2seq.py`` (dagger), SURVEY.md §2.8). For multi-node
+eval the right aggregation is NOT averaging per-rank BLEU scores — corpus
+BLEU is a ratio of summed counts, so each rank computes clipped n-gram
+match/total counts and lengths over its shard, the counts are summed across
+ranks (``allreduce_obj``), and the score is computed once from the totals.
+This module provides exactly that split: :func:`bleu_stats` (per-shard,
+summable dict) and :func:`bleu_from_stats` (final score).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Iterable, Sequence
+
+MAX_N = 4
+
+
+def _ngrams(seq: Sequence[int], n: int) -> Counter:
+    return Counter(tuple(seq[i : i + n]) for i in range(len(seq) - n + 1))
+
+
+def bleu_stats(
+    hypothesis: Sequence[int], reference: Sequence[int], max_n: int = MAX_N
+) -> dict[str, int]:
+    """Sufficient statistics of one sentence pair: clipped n-gram matches and
+    totals for n = 1..max_n, plus hypothesis/reference lengths. Dicts from
+    many pairs (and many ranks) sum element-wise into corpus statistics."""
+    stats = {"hyp_len": len(hypothesis), "ref_len": len(reference)}
+    for n in range(1, max_n + 1):
+        hyp_ngrams = _ngrams(hypothesis, n)
+        ref_ngrams = _ngrams(reference, n)
+        match = sum(min(c, ref_ngrams[g]) for g, c in hyp_ngrams.items())
+        stats[f"match_{n}"] = match
+        stats[f"total_{n}"] = max(len(hypothesis) - n + 1, 0)
+    return stats
+
+
+def empty_stats(max_n: int = MAX_N) -> dict[str, int]:
+    """Zero-valued statistics with the full key set — the identity element
+    of :func:`sum_stats`. Ranks whose eval shard is empty must contribute
+    this (not ``{}``) so cross-rank summation sees identical keys."""
+    out = {"hyp_len": 0, "ref_len": 0}
+    for n in range(1, max_n + 1):
+        out[f"match_{n}"] = 0
+        out[f"total_{n}"] = 0
+    return out
+
+
+def sum_stats(
+    many: Iterable[dict[str, int]], max_n: int = MAX_N
+) -> dict[str, int]:
+    """Element-wise sum of stats dicts (what ``allreduce_obj`` does across
+    ranks; this is the in-rank reduction over a shard). Seeded with
+    :func:`empty_stats` so an empty iterable still yields the full key set."""
+    out = empty_stats(max_n)
+    for s in many:
+        for k, v in s.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def bleu_from_stats(stats: dict[str, int], max_n: int = MAX_N) -> float:
+    """Corpus BLEU from summed statistics: geometric mean of n-gram
+    precisions times the brevity penalty. Any zero match count → 0.0
+    (standard uncased corpus BLEU, no smoothing)."""
+    log_precisions = []
+    for n in range(1, max_n + 1):
+        match, total = stats.get(f"match_{n}", 0), stats.get(f"total_{n}", 0)
+        if match == 0 or total == 0:
+            return 0.0
+        log_precisions.append(math.log(match / total))
+    hyp_len, ref_len = stats["hyp_len"], stats["ref_len"]
+    if hyp_len == 0:
+        return 0.0
+    bp = 1.0 if hyp_len > ref_len else math.exp(1.0 - ref_len / hyp_len)
+    return bp * math.exp(sum(log_precisions) / max_n)
+
+
+def corpus_bleu(
+    hypotheses: Sequence[Sequence[int]],
+    references: Sequence[Sequence[int]],
+    max_n: int = MAX_N,
+) -> float:
+    """Single-process convenience: BLEU over aligned hypothesis/reference
+    token-id lists."""
+    assert len(hypotheses) == len(references)
+    return bleu_from_stats(
+        sum_stats(bleu_stats(h, r, max_n) for h, r in zip(hypotheses, references)),
+        max_n,
+    )
+
+
+def truncate_at_eos(tokens: Sequence[int], eos: int) -> list[int]:
+    """Cut a decoded row at the first ``eos`` (exclusive) — recovers the
+    ragged sentence from the static-shape greedy decode."""
+    out = []
+    for t in tokens:
+        if t == eos:
+            break
+        out.append(int(t))
+    return out

@@ -30,21 +30,23 @@ import chainermn_tpu
 from chainermn_tpu import global_except_hook
 from chainermn_tpu.datasets.bucketing import bucket_batches
 from chainermn_tpu.models import Seq2Seq, seq2seq_loss
+from chainermn_tpu.models.seq2seq import greedy_decode
+from chainermn_tpu.utils import bleu as bleu_utils
 
 VOCAB = 128
 BOS = 1
+EOS = 2
 
 
 def synthetic_pairs(n, seed):
-    """tgt = reversed src with small perturbation — learnable, ragged."""
+    """tgt = reversed src, EOS-terminated — learnable, ragged."""
     rng = np.random.RandomState(seed)
     pairs = []
     for _ in range(n):
         L = rng.randint(4, 30)
-        src = rng.randint(2, VOCAB, size=L)
+        src = rng.randint(3, VOCAB, size=L)
         tgt = src[::-1].copy()
-        return_pairs = (list(src), list(tgt))
-        pairs.append(return_pairs)
+        pairs.append((list(src), list(tgt) + [EOS]))
     return pairs
 
 
@@ -56,6 +58,10 @@ def main(argv=None):
     p.add_argument("--iterations", type=int, default=60)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--train-file", default=None)
+    p.add_argument("--eval", action="store_true",
+                   help="after training, greedy-decode a held-out set and "
+                        "report corpus BLEU aggregated across ranks")
+    p.add_argument("--eval-size", type=int, default=256)
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -150,7 +156,50 @@ def main(argv=None):
     if comm.rank == 0:
         print(f"final loss={float(loss):.4f} "
               f"({len(compiled_buckets)} bucket compilations)")
-    return float(loss)
+
+    result = {"loss": float(loss)}
+    if args.eval:
+        # Held-out set, sharded across ranks; greedy decode under jit per
+        # source-length bucket; corpus BLEU from allreduce-summed n-gram
+        # statistics (reference: the seq2seq example's BLEU eval, SURVEY.md
+        # §2.8 — aggregation via the multi-node evaluator).
+        held_out = synthetic_pairs(args.eval_size, seed=1234)
+        shard = chainermn_tpu.scatter_dataset(held_out, comm, shuffle=False)
+        decode = jax.jit(
+            lambda s, m: greedy_decode(
+                model, params, s, m, max_len=36, bos=BOS, eos=EOS
+            )
+        )
+
+        def local_bleu_stats() -> dict:
+            stats = []
+            for batch in bucket_batches(
+                shard, args.batchsize, drop_remainder=False
+            ):
+                hyp = np.asarray(
+                    decode(jnp.asarray(batch["src"]),
+                           jnp.asarray(batch["src_mask"]))
+                )
+                for row, ref in list(
+                    zip(hyp, batch["tgt_raw"])
+                )[: batch["n_real"]]:
+                    stats.append(bleu_utils.bleu_stats(
+                        bleu_utils.truncate_at_eos(row, EOS),
+                        bleu_utils.truncate_at_eos(ref, EOS),
+                    ))
+            return bleu_utils.sum_stats(stats)
+
+        evaluate = chainermn_tpu.create_multi_node_evaluator(
+            local_bleu_stats, comm, reduce="sum",
+            finalize=lambda total: {
+                "bleu": bleu_utils.bleu_from_stats(total)
+            },
+        )
+        result["bleu"] = evaluate()["bleu"]
+        if comm.rank == 0:
+            print(f"eval: corpus BLEU = {result['bleu']:.4f} "
+                  f"({args.eval_size} held-out pairs, all ranks)")
+    return result
 
 
 if __name__ == "__main__":

@@ -27,6 +27,22 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def free_ports(n: int) -> list[int]:
+    """``n`` distinct free ports, allocated while ALL the probe sockets are
+    held open — sequential ``free_port()`` calls can hand the same
+    just-released port out twice."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 def run_workers(
     case: str,
     n_procs: int = 2,
@@ -34,6 +50,7 @@ def run_workers(
     local_devices: int = 2,
     timeout: float = 240.0,
     extra_env: dict | None = None,
+    coord_port: int | None = None,
 ):
     """Launch ``n_procs`` worker processes running ``case`` from
     ``tests/mp_worker.py``; raise AssertionError with the combined logs if
@@ -41,10 +58,16 @@ def run_workers(
     sys.path.insert(0, _REPO_DIR)
     from _driver_env import cpu_scrubbed_env
 
-    port = free_port()
+    port = coord_port if coord_port is not None else free_port()
     procs = []
     for rank in range(n_procs):
         env = cpu_scrubbed_env(local_devices)
+        # Workers derive native-TCP config from MP_* vars themselves; stale
+        # CHAINERMN_TPU_* from the developer's shell would make HostComm's
+        # strict bootstrap fail on every rank.
+        for k in ("CHAINERMN_TPU_RANK", "CHAINERMN_TPU_SIZE",
+                  "CHAINERMN_TPU_COORD"):
+            env.pop(k, None)
         env["MP_CASE"] = case
         env["MP_RANK"] = str(rank)
         env["MP_SIZE"] = str(n_procs)

@@ -34,6 +34,25 @@ def main():
     s = c.allreduce_obj({"v": rank})
     assert s == {"v": sum(range(size))}
 
+    # split(): independent subgroup collectives (reference: MPI_Comm_split).
+    # Two color groups (low/high halves); key=-rank REVERSES in-group order.
+    if size >= 2:
+        half = size // 2
+        color = 0 if rank < half else 1
+        g = c.split(color, key=-rank)
+        lo, hi = (0, half) if color == 0 else (half, size)
+        assert g.members == list(reversed(range(lo, hi))), g.members
+        assert g.size == hi - lo and g.members[g.rank] == rank
+        # Group root (group rank 0) is the HIGHEST world rank in the group.
+        got = g.bcast_obj(("grp", color, rank) if g.rank == 0 else None, 0)
+        assert got == ("grp", color, hi - 1), got
+        assert g.allgather_obj(rank) == list(reversed(range(lo, hi)))
+        assert g.allreduce_obj(1) == hi - lo
+        g.barrier()  # p2p group barrier, not the world-wide native one
+        # Nested split: singleton groups, trivially consistent.
+        gg = g.split(g.rank, key=0)
+        assert gg.size == 1 and gg.allreduce_obj(rank) == rank
+
     # p2p ring with a large payload (exercises framing/chunked recv)
     big = bytes(range(256)) * 4096  # 1 MiB
     c.send_obj((rank, big), (rank + 1) % size)

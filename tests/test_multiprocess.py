@@ -28,6 +28,19 @@ def test_mp_checkpoint_agreement(tmp_path):
     )
 
 
+def test_mp_split_2x2():
+    """4 processes split 2+2: independent per-group host and device
+    collectives without deadlock — VERDICT round-1 item 5."""
+    from mp_harness import free_ports
+
+    jax_port, tcp_port = free_ports(2)
+    run_workers(
+        "split", n_procs=4, local_devices=2, timeout=300,
+        coord_port=jax_port,
+        extra_env={"MP_TCP_COORD": f"127.0.0.1:{tcp_port}"},
+    )
+
+
 def test_mp_trainer_mnist():
     """The mnist example end-to-end (Trainer + scatter + sync iterator +
     evaluator) under 2 real processes, unchanged — VERDICT round-1 item 10."""
