@@ -19,6 +19,10 @@ TPU-native execution model: the whole multi-stage model is ONE program under
     shard runs the chain, others produce zeros of the same (statically
     inferred) shape. At runtime each shard executes only its branch — the
     compute really is distributed, like the reference's per-rank processes.
+    Verified at the HLO level: the compiled SPMD module retains one true
+    ``conditional`` (with separate branch computations) per gated stage, not
+    a both-branches ``select`` (regression-tested in
+    ``tests/test_links.py::test_chain_list_compute_gating_is_true_conditional``).
 
 Because one traced program contains every stage, XLA schedules transfers and
 compute together; the delegate-variable ordering discipline of the reference

@@ -1,0 +1,139 @@
+"""Single-stage detection model — the Faster-RCNN-style stress workload.
+
+Reference: the fork's benchmark configs name "ChainerCV Faster-RCNN (stress
+hierarchical communicator, odd grad shapes)" (BASELINE.json ``configs``;
+SURVEY.md §7 hard-parts list). The stress, not the mAP, is the point:
+
+- **odd gradient shapes** — deliberately non-round channel counts (13, 27,
+  54...) and a mixed bag of parameter ranks, the shapes that broke naive
+  gradient packers in the reference era and that exercise this framework's
+  claim that XLA's fused allreduce needs no packing at all;
+- **dynamic image shapes** — detection batches come in many (H, W) sizes;
+  under jit this forces the bucketing discipline
+  (:mod:`chainermn_tpu.datasets.bucketing` for sequences; here a 2-d shape
+  ladder) with one compile per bucket;
+- **ragged ground truth** — variable boxes per image, padded + masked.
+
+The model is a small anchor-based detector: conv backbone → shared head →
+per-anchor objectness + box deltas; the loss does real IoU matching of
+anchors to padded GT boxes entirely under jit (static shapes, masked).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+#: anchor sizes (px) and aspect ratios per feature-map cell
+ANCHOR_SIZES = (32.0, 64.0, 128.0)
+ANCHOR_RATIOS = (0.5, 1.0, 2.0)
+STRIDE = 16  # backbone downsampling
+
+
+class TinyDetector(nn.Module):
+    """Backbone + RPN-style head with deliberately odd channel counts."""
+
+    channels: Sequence[int] = (13, 27, 54)  # odd on purpose (grad stress)
+    num_anchors: int = len(ANCHOR_SIZES) * len(ANCHOR_RATIOS)
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, images: jax.Array):
+        """images [B, H, W, 3] → (objectness [B, Hf, Wf, A],
+        box deltas [B, Hf, Wf, A, 4]) with Hf = H // STRIDE."""
+        x = images.astype(self.compute_dtype)
+        for i, ch in enumerate(self.channels):
+            # stride-2 convs: 3 levels + the head's stride-2 = /16 total
+            x = nn.Conv(ch, (3, 3), strides=(2, 2), name=f"conv{i}")(x)
+            x = nn.relu(x)
+        x = nn.Conv(self.channels[-1], (3, 3), strides=(2, 2), name="head")(x)
+        x = nn.relu(x)
+        obj = nn.Conv(self.num_anchors, (1, 1), name="objectness")(x)
+        deltas = nn.Conv(self.num_anchors * 4, (1, 1), name="boxes")(x)
+        B, Hf, Wf, _ = deltas.shape
+        return (
+            obj.astype(jnp.float32),
+            deltas.reshape(B, Hf, Wf, self.num_anchors, 4).astype(jnp.float32),
+        )
+
+
+def make_anchors(hf: int, wf: int) -> jax.Array:
+    """Anchor boxes [Hf*Wf*A, 4] as (y0, x0, y1, x1) in pixels."""
+    ys = (jnp.arange(hf) + 0.5) * STRIDE
+    xs = (jnp.arange(wf) + 0.5) * STRIDE
+    cy, cx = jnp.meshgrid(ys, xs, indexing="ij")  # [Hf, Wf]
+    boxes = []
+    for size in ANCHOR_SIZES:
+        for ratio in ANCHOR_RATIOS:
+            h = size * (ratio ** 0.5)
+            w = size / (ratio ** 0.5)
+            boxes.append(jnp.stack(
+                [cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2], axis=-1
+            ))
+    return jnp.stack(boxes, axis=2).reshape(-1, 4)  # [Hf*Wf*A, 4]
+
+
+def iou_matrix(anchors: jax.Array, gt: jax.Array) -> jax.Array:
+    """IoU of anchors [K, 4] against gt boxes [N, 4] → [K, N]."""
+    a = anchors[:, None, :]  # [K, 1, 4]
+    g = gt[None, :, :]       # [1, N, 4]
+    inter_h = jnp.clip(
+        jnp.minimum(a[..., 2], g[..., 2]) - jnp.maximum(a[..., 0], g[..., 0]),
+        0,
+    )
+    inter_w = jnp.clip(
+        jnp.minimum(a[..., 3], g[..., 3]) - jnp.maximum(a[..., 1], g[..., 1]),
+        0,
+    )
+    inter = inter_h * inter_w
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_g = jnp.clip(
+        (g[..., 2] - g[..., 0]) * (g[..., 3] - g[..., 1]), 1e-6
+    )
+    return inter / jnp.clip(area_a + area_g - inter, 1e-6)
+
+
+def detection_loss(
+    obj: jax.Array,        # [B, Hf, Wf, A]
+    deltas: jax.Array,     # [B, Hf, Wf, A, 4]
+    gt_boxes: jax.Array,   # [B, N, 4] padded
+    gt_mask: jax.Array,    # [B, N] 1 for real boxes
+    *,
+    pos_iou: float = 0.5,
+) -> jax.Array:
+    """RPN loss under jit: IoU-match anchors to (masked) GT, BCE objectness
+    + smooth-L1 box regression on positive anchors. Padded GT rows are
+    IoU-neutralised (set to -inf IoU), so garbage in padding cannot alter
+    the loss — tested."""
+    B, Hf, Wf, A = obj.shape
+    anchors = make_anchors(Hf, Wf)  # [K, 4]
+    K = anchors.shape[0]
+    obj = obj.reshape(B, K)
+    deltas = deltas.reshape(B, K, 4)
+
+    def one(obj_i, deltas_i, gt_i, m_i):
+        iou = iou_matrix(anchors, gt_i)  # [K, N]
+        iou = jnp.where(m_i[None, :] > 0, iou, -jnp.inf)
+        best = jnp.max(iou, axis=1)              # [K]
+        best_idx = jnp.argmax(iou, axis=1)       # [K]
+        any_gt = jnp.any(m_i > 0)
+        pos = (best >= pos_iou) & any_gt
+        labels = pos.astype(jnp.float32)
+        # objectness: BCE over all anchors
+        bce = optax.sigmoid_binary_cross_entropy(obj_i, labels).mean()
+        # box regression: smooth-L1 of (normalised) corner offsets, positives
+        matched = gt_i[best_idx]  # [K, 4]
+        scale = jnp.asarray([Hf, Wf, Hf, Wf], jnp.float32) * STRIDE
+        err = (deltas_i - (matched - anchors) / scale)
+        l1 = jnp.where(
+            jnp.abs(err) < 1.0, 0.5 * err * err, jnp.abs(err) - 0.5
+        ).sum(-1)
+        n_pos = jnp.clip(pos.sum(), 1)
+        reg = jnp.where(pos, l1, 0.0).sum() / n_pos
+        return bce + reg
+
+    return jax.vmap(one)(obj, deltas, gt_boxes, gt_mask).mean()

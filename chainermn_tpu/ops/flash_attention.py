@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention kernel.
+"""Pallas TPU flash-attention kernels: forward AND backward.
 
 The hot local attention op: online-softmax accumulation entirely in VMEM, so
 the ``[Tq, Tk]`` score matrix never touches HBM — HBM traffic drops from
@@ -9,12 +9,15 @@ allreduce path (``pure_nccl_communicator.py`` (dagger), SURVEY.md section
 2.1), the TPU build's equivalent hand-written layer is Pallas (SURVEY.md
 section 2.1 native-component note).
 
-Backward: a ``jax.custom_vjp`` whose reverse pass rematerialises through the
-lax blockwise implementation (:func:`chainermn_tpu.ops.attention.
-blockwise_attention`) — flash-style recompute-in-backward, with XLA fusing
-the recomputation; numerically identical to differentiating the forward.
+Forward emits the per-row logsumexp (LSE) alongside the output; backward is
+the standard flash recurrence re-deriving probabilities from LSE — two
+Pallas kernels (dq; dk+dv), no O(T^2) HBM tensor anywhere. The same block
+kernels power the sequence-parallel ring attention
+(:mod:`chainermn_tpu.parallel.ring_attention`), which rotates K/V blocks via
+``ppermute`` and calls them per arriving block.
 
-Layout: BTHD at the API (framework convention), BHTD inside the kernel grid.
+Layout: BTHD at the API (framework convention), BHTD inside the kernel grid;
+LSE/delta rows are ``[B, H, T]``.
 """
 
 from __future__ import annotations
@@ -28,14 +31,40 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from chainermn_tpu.ops.attention import NEG_INF, blockwise_attention
+from chainermn_tpu.ops.attention import NEG_INF
 
 _LANES = 128
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-            scale: float, causal: bool, block_q: int, block_k: int,
-            num_k_blocks: int):
+def _causal_mask(iq, ik, block_q, block_k, shape):
+    q_pos = iq * block_q + lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = ik * block_k + lax.broadcasted_iota(jnp.int32, shape, 1)
+    return q_pos >= k_pos
+
+
+def _live(ik, iq, block_q, block_k, causal):
+    """Causal: blocks strictly above the diagonal contribute nothing — skip
+    their matmuls entirely (≈2x for long sequences)."""
+    return (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+
+def _pick_block(requested: int, T: int) -> int:
+    """Largest block <= requested that divides ``T``: halve until it fits
+    (T=768 with a 512 request -> 256), else fall back to one whole-T block.
+    Keeps any sequence length runnable under the large default blocks."""
+    b = min(requested, T)
+    while T % b and b > 8:
+        b //= 2
+    return b if T % b == 0 else T
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                num_k_blocks: int):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -45,11 +74,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # Causal: blocks strictly above the diagonal contribute nothing — skip
-    # their matmuls entirely (≈2x for long sequences).
-    live = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
-
-    @pl.when(live)
+    @pl.when(_live(ik, iq, block_q, block_k, causal))
     def _accumulate():
         q = q_ref[0, 0]  # [block_q, D]
         k = k_ref[0, 0]  # [block_k, D]
@@ -61,9 +86,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         ) * scale  # [block_q, block_k]
 
         if causal:
-            q_pos = iq * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ik * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = q_pos >= k_pos
+            mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
             s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:, 0:1]  # [block_q, 1]
@@ -84,26 +107,28 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ik == num_k_blocks - 1)
     def _finalize():
+        m = m_ref[:, 0:1]
         l = l_ref[:, 0:1]
         o_ref[0, 0] = jnp.where(
             l > 0, acc_ref[...] / jnp.maximum(l, 1e-37), 0.0
         ).astype(o_ref.dtype)
+        # LSE in the scaled-score domain; fully-masked rows stay NEG_INF.
+        lse = jnp.where(
+            l > 0, m + jnp.log(jnp.maximum(l, 1e-37)), NEG_INF
+        )  # [block_q, 1]
+        lse_ref[0, 0] = lse
 
 
 def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
+    """BHTD forward → (out [B,H,Tq,D], lse [B,H,Tq])."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    block_q = min(block_q, Tq)
-    block_k = min(block_k, Tk)
-    if Tq % block_q or Tk % block_k:
-        raise ValueError(
-            f"flash_attention: seq lens ({Tq}, {Tk}) must be divisible by "
-            f"block sizes ({block_q}, {block_k})"
-        )
+    block_q = _pick_block(block_q, Tq)
+    block_k = _pick_block(block_k, Tk)
     nq, nk = Tq // block_q, Tk // block_k
 
     kernel = functools.partial(
-        _kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_k_blocks=nk,
     )
     return pl.pallas_call(
@@ -114,10 +139,15 @@ def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, iq, ik: (b, h, ik, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, iq, ik: (b, h, ik, 0)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),      # acc
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
@@ -127,44 +157,225 @@ def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
     )(q, k, v)
 
 
+# ---------------------------------------------------------------------------
+# Backward: dq kernel (iterate K blocks per fixed Q block)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *,
+                   scale: float, causal: bool, block_q: int, block_k: int,
+                   num_k_blocks: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_live(ik, iq, block_q, block_k, causal))
+    def _accumulate():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]    # [block_q, 1]
+        delta = delta_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
+            s = jnp.where(mask, s, NEG_INF)
+        # p from the saved LSE: exp(NEG_INF - lse) underflows to exactly 0,
+        # so masked/never-attended entries contribute nothing.
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        ds = p * (dp - delta) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Backward: dk/dv kernel (iterate Q blocks per fixed K block)
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale: float, causal: bool, block_q: int, block_k: int,
+                    num_q_blocks: int):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_live(ik, iq, block_q, block_k, causal))
+    def _accumulate():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]    # [block_q, 1]
+        delta = delta_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_k]
+        # dv += p^T @ do
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale  # [block_q, block_k]
+        # dk += ds^T @ q
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhtd(q, k, v, do, lse, delta, *, causal, scale,
+                    block_q, block_k, interpret):
+    """BHTD backward → (dq, dk, dv), each f32, given saved LSE and
+    ``delta = rowsum(do * o)``."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = _pick_block(block_q, Tq)
+    block_k = _pick_block(block_k, Tk)
+    nq, nk = Tq // block_q, Tk // block_k
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        ),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            q_spec,
+            row_spec,
+            row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q_blocks=nq,
+        ),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
+            k_spec,
+            k_spec,
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[k_spec, k_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public op: BTHD custom_vjp
+# ---------------------------------------------------------------------------
+
 def _use_interpret() -> bool:
+    """Mosaic-compile only when the computation will actually hit a TPU:
+    honour an explicit ``jax_default_device`` override (the test harness
+    pins CPU while a TPU plugin is also loaded) before the backend default."""
+    default = jax.config.jax_default_device
+    if default is not None:
+        # May be a Device object or a platform string (both accepted by JAX).
+        return getattr(default, "platform", default) != "tpu"
     return jax.default_backend() not in ("tpu",)
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
-)
+def _to_bhtd(x):
+    return x.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_impl(q, k, v, causal, scale, block_q, block_k, interpret)
-
-
-def _flash_impl(q, k, v, causal, scale, block_q, block_k, interpret):
-    # BTHD -> BHTD for the kernel grid
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-    out = _flash_fwd_bhtd(
-        qt, kt, vt, causal=causal, scale=scale,
+    out, _ = _flash_fwd_bhtd(
+        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out.transpose(0, 2, 1, 3)
+    return _to_bhtd(out)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_impl(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_bhtd(
+        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _to_bhtd(out), (q, k, v, out, lse)  # out saved in BHTD
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-
-    def ref(q, k, v):
-        return blockwise_attention(
-            q, k, v, block_k=block_k, causal=causal, scale=scale
-        )
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, out_bhtd, lse = res
+    do = _to_bhtd(g)
+    # delta_i = sum_d dO_i . O_i — the rowwise correction term of the flash
+    # backward (re-derives softmax jacobian contributions without P).
+    delta = jnp.sum(do.astype(jnp.float32) * out_bhtd.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B, H, Tq, 1] (kernel layout)
+    dq, dk, dv = _flash_bwd_bhtd(
+        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), do, lse, delta,
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return (
+        _to_bhtd(dq).astype(q.dtype),
+        _to_bhtd(dk).astype(k.dtype),
+        _to_bhtd(dv).astype(v.dtype),
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -177,17 +388,48 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Flash attention on ``[B, T, H, D]`` inputs.
+    """Flash attention on ``[B, T, H, D]`` inputs, Pallas forward AND
+    backward (both VMEM-blocked; the score matrix never exists in HBM in
+    either direction).
 
-    On TPU the forward runs as a Pallas VMEM kernel; elsewhere (CPU tests)
-    it runs in Pallas interpreter mode unless ``interpret=False``.
+    On TPU the kernels compile via Mosaic; elsewhere (CPU tests) they run in
+    Pallas interpreter mode unless ``interpret=False``.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = _use_interpret()
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Block-level entry points for ring attention
+# ---------------------------------------------------------------------------
+
+def flash_block_fwd(q, k_blk, v_blk, *, causal, scale, block_q, block_k,
+                    interpret):
+    """One ring step's forward: full flash over the resident Q shard and ONE
+    arriving K/V block, returning BTHD output + ``[B, H, Tq]`` LSE. The ring
+    merges successive blocks' (out, lse) partials in log space
+    (:func:`chainermn_tpu.parallel.ring_attention.merge_partials`)."""
+    out, lse = _flash_fwd_bhtd(
+        _to_bhtd(q), _to_bhtd(k_blk), _to_bhtd(v_blk), causal=causal,
+        scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _to_bhtd(out), lse[..., 0]
+
+
+def flash_block_bwd(q, k_blk, v_blk, do, lse, delta, *, causal, scale,
+                    block_q, block_k, interpret):
+    """One ring step's backward: (dq, dk_blk, dv_blk) contributions for one
+    K/V block, f32, BTHD (lse/delta are ``[B, H, Tq]``)."""
+    dq, dk, dv = _flash_bwd_bhtd(
+        _to_bhtd(q), _to_bhtd(k_blk), _to_bhtd(v_blk), _to_bhtd(do),
+        lse[..., None], delta[..., None], causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _to_bhtd(dq), _to_bhtd(dk), _to_bhtd(dv)

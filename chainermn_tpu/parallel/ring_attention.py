@@ -9,19 +9,26 @@ sitting where the model-parallel functions sit in the reference's stack
 Mechanism: the sequence is sharded over a ``'seq'`` mesh axis. Each shard
 keeps its Q block resident and the K/V blocks *rotate around the ring* via
 ``lax.ppermute`` (ICI neighbour exchange — bandwidth-optimal, no all-gather
-of the full sequence). Attention is accumulated blockwise with the online
-(flash) softmax, so per-shard memory stays ``O(T_local^2 / n)`` and the full
-``[T, T]`` score matrix never exists anywhere.
+of the full sequence). Each arriving block is processed by the Pallas flash
+kernel (:mod:`chainermn_tpu.ops.flash_attention`), which returns the block's
+attention output plus its logsumexp row; successive blocks merge in log
+space, so per-shard memory stays ``O(T_local * D)`` and the full ``[T, T]``
+score matrix never exists anywhere — the SURVEY §5/§7 "ring attention as a
+Pallas kernel" requirement.
 
-Differentiability: the whole loop is ``lax.scan`` + ``ppermute``, both of
-which JAX knows how to transpose — the backward pass is automatically the
-reverse ring rotation, the same send/recv duality the reference hand-built
-in ``Send.backward``/``Recv.backward``
-(``functions/point_to_point_communication.py`` (dagger)).
+Differentiability: a hand-written ``custom_vjp``. The backward pass is a
+second ring pass — K/V blocks rotate again, now accompanied by their
+gradient accumulators, and each stop adds that shard's (dq, dk, dv)
+contribution via the Pallas backward kernels. This is the same send/recv
+duality the reference hand-built in ``Send.backward``/``Recv.backward``
+(``functions/point_to_point_communication.py`` (dagger)), lifted to whole
+ring rotations. ``impl='einsum'`` keeps the lax/einsum path (differentiated
+automatically through ``scan``+``ppermute``) as the correctness reference.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -34,6 +41,157 @@ from chainermn_tpu.ops.attention import (
     finalize_online_softmax,
     online_softmax_block,
 )
+from chainermn_tpu.ops.flash_attention import (
+    _use_interpret,
+    flash_block_bwd,
+    flash_block_fwd,
+)
+
+
+def merge_partials(o, lse, o_blk, lse_blk):
+    """Merge two normalised attention partials in log space.
+
+    ``o``/``o_blk``: [B, T, H, D] f32 outputs, each normalised within its own
+    key set; ``lse``/``lse_blk``: [B, H, T] logsumexps of those key sets. The
+    merged pair is the attention over the union of the key sets.
+    """
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    # Both -inf (no keys seen yet, e.g. fully-masked rows): keep output 0.
+    safe = lse_new > NEG_INF / 2
+    a = jnp.where(safe, jnp.exp(lse - lse_new), 0.0)
+    b = jnp.where(safe, jnp.exp(lse_blk - lse_new), 0.0)
+    o_new = (
+        o * a.transpose(0, 2, 1)[..., None]
+        + o_blk.astype(jnp.float32) * b.transpose(0, 2, 1)[..., None]
+    )
+    return o_new, lse_new
+
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k,
+                interpret):
+    out, _lse, _k, _v = _ring_flash_fwd_impl(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k,
+                         interpret):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    kw = dict(scale=scale, block_q=block_q, block_k=block_k,
+              interpret=interpret)
+
+    o = jnp.zeros((B, Tq, H, D), jnp.float32)
+    lse = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    perm = _ring_perm(n)
+
+    def _full(o, lse, k_blk, v_blk):
+        o_b, lse_b = flash_block_fwd(q, k_blk, v_blk, causal=False, **kw)
+        return merge_partials(o, lse, o_b, lse_b)
+
+    def _diag(o, lse, k_blk, v_blk):
+        # src == my: equal global offsets, so the causal mask is the static
+        # relative mask — no dynamic offsets reach the kernel.
+        o_b, lse_b = flash_block_fwd(q, k_blk, v_blk, causal=True, **kw)
+        return merge_partials(o, lse, o_b, lse_b)
+
+    def _skip(o, lse, k_blk, v_blk):
+        return o, lse
+
+    def step(carry, s):
+        k_blk, v_blk, o, lse = carry
+        if causal:
+            src = (my - s) % n
+            # src < my: block is entirely in the past — full attention.
+            # src == my: the diagonal block. src > my: entirely future — skip
+            # (no matmul at all; the causal ring does ~half the FLOPs).
+            branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            o, lse = lax.switch(
+                branch, (_full, _diag, _skip), o, lse, k_blk, v_blk
+            )
+        else:
+            o, lse = _full(o, lse, k_blk, v_blk)
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return (k_blk, v_blk, o, lse), None
+
+    (k, v, o, lse), _ = lax.scan(step, (k, v, o, lse), jnp.arange(n))
+    # After n rotations K/V are home again — return them as residuals so the
+    # backward ring starts from the same layout without re-gathering.
+    return o.astype(q.dtype), lse, k, v
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
+                    interpret):
+    out, lse, k, v = _ring_flash_fwd_impl(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, interpret,
+                    res, g):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    kw = dict(scale=scale, block_q=block_q, block_k=block_k,
+              interpret=interpret)
+    do = g
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)  # [B, H, Tq]
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    perm = _ring_perm(n)
+
+    def _full(k_blk, v_blk):
+        return flash_block_bwd(q, k_blk, v_blk, do, lse, delta,
+                               causal=False, **kw)
+
+    def _diag(k_blk, v_blk):
+        return flash_block_bwd(q, k_blk, v_blk, do, lse, delta,
+                               causal=True, **kw)
+
+    def _skip(k_blk, v_blk):
+        return dq0, jnp.zeros(k_blk.shape, jnp.float32), \
+            jnp.zeros(v_blk.shape, jnp.float32)
+
+    def step(carry, s):
+        k_blk, v_blk, dk_t, dv_t, dq = carry
+        if causal:
+            src = (my - s) % n
+            branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            dq_c, dk_c, dv_c = lax.switch(
+                branch, (_full, _diag, _skip), k_blk, v_blk
+            )
+        else:
+            dq_c, dk_c, dv_c = _full(k_blk, v_blk)
+        dq = dq + dq_c
+        dk_t = dk_t + dk_c
+        dv_t = dv_t + dv_c
+        # The gradient accumulators travel WITH their K/V block: after the
+        # full ring each block's dk/dv has collected every shard's
+        # contribution and arrived back at the block's home shard.
+        k_blk, v_blk, dk_t, dv_t = lax.ppermute(
+            (k_blk, v_blk, dk_t, dv_t), axis_name, perm
+        )
+        return (k_blk, v_blk, dk_t, dv_t, dq), None
+
+    (k, v, dk, dv, dq), _ = lax.scan(
+        step, (k, v, dk0, dv0, dq0), jnp.arange(n)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_attention_local(
@@ -44,6 +202,10 @@ def ring_attention_local(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "flash",
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Ring attention over local shards — call INSIDE ``shard_map``.
 
@@ -51,10 +213,30 @@ def ring_attention_local(
       q/k/v: local sequence shards ``[B, T_local, H, D]``; the global
         sequence is the concatenation over ``axis_name`` in ring order.
       causal: apply a causal mask over *global* positions.
+      impl: ``'flash'`` (Pallas block kernels, hand-written ring backward;
+        the production path) or ``'einsum'`` (lax online-softmax blocks,
+        autodiff through scan+ppermute; the correctness reference).
+      interpret: run the Pallas kernels in interpreter mode. Inside
+        ``shard_map`` the mesh platform is invisible, so the default guesses
+        from the default backend/device — pass it explicitly when the
+        enclosing mesh's platform differs (``make_ring_attention`` derives
+        it from its mesh automatically).
 
     Returns:
       Local output shard ``[B, T_local, H, D]`` (dtype of ``q``).
     """
+    if impl == "flash":
+        if scale is None:
+            scale = q.shape[-1] ** -0.5
+        if interpret is None:
+            interpret = _use_interpret()
+        return _ring_flash(
+            q, k, v, axis_name, causal, float(scale), block_q, block_k,
+            interpret,
+        )
+    if impl != "einsum":
+        raise ValueError(f"impl must be 'flash' or 'einsum', got {impl!r}")
+
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
@@ -66,7 +248,7 @@ def ring_attention_local(
 
     # Rotate kv by +1 each step: after step s this shard holds the block that
     # started on shard (my - s) % n.
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = _ring_perm(n)
 
     def body(carry, s):
         k_blk, v_blk, o, m, l = carry
@@ -92,6 +274,7 @@ def make_ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     batch_axis: Optional[str] = None,
+    impl: str = "flash",
 ):
     """Jitted ring attention over globally (sequence-)sharded BTHD arrays.
 
@@ -104,10 +287,14 @@ def make_ring_attention(
     from jax import shard_map
 
     spec = P(batch_axis, axis_name, None, None)
+    # The mesh knows where this will execute; don't guess from the default
+    # backend (a TPU plugin may be loaded while this mesh is CPU).
+    interpret = mesh.devices.flat[0].platform != "tpu"
 
     def local(q, k, v):
         return ring_attention_local(
-            q, k, v, axis_name, causal=causal, scale=scale
+            q, k, v, axis_name, causal=causal, scale=scale, impl=impl,
+            interpret=interpret,
         )
 
     fn = shard_map(

@@ -231,3 +231,38 @@ def test_for_communicator_uses_grad_axes(comm):
         comm, use_running_average=False
     )
     assert bn.axis_name == "data"
+
+
+def test_chain_list_compute_gating_is_true_conditional(comm):
+    """VERDICT round-1 item 9: the cond-gated stages must survive to the
+    compiled module as real HLO `conditional` ops (each shard executes only
+    its branch at runtime -> the compute IS distributed), not be lowered to
+    select (both branches executed everywhere)."""
+    import re
+
+    from chainermn_tpu.links.multi_node_chain_list import MultiNodeChainList
+
+    mnc = MultiNodeChainList(comm, axis_name=comm.axis_name)
+
+    def stage(p, x):
+        return jnp.tanh(x @ p)
+
+    mnc.add_link(stage, rank=0, rank_out=1,
+                 init_fn=lambda r, x: jax.random.normal(r, (16, 32)) * 0.1)
+    mnc.add_link(stage, rank=1, rank_in=0,
+                 init_fn=lambda r, x: jax.random.normal(r, (32, 8)) * 0.1)
+    x = jnp.ones((4, 16))
+    params = mnc.init(jax.random.key(0), x)
+    txt = mnc.build().lower(params, x).compile().as_text()
+
+    conds = [ln for ln in txt.splitlines()
+             if "conditional(" in ln and "branch_computations" in ln]
+    assert len(conds) >= 2, (
+        "expected one HLO conditional per gated stage; compiled module has "
+        f"{len(conds)} — cond was lowered away:\n" + txt[:2000]
+    )
+    # The stage activations must not be produced by `select` over both
+    # branches' results (the both-branches-execute lowering).
+    assert not re.search(r"select\(f32\[4,(32|8)\]", txt), (
+        "stage outputs selected from both branches — compute not distributed"
+    )

@@ -56,7 +56,34 @@ def test_flash_grads_match_full():
     )
 
 
-def test_flash_rejects_indivisible_blocks():
+def test_flash_adapts_indivisible_blocks():
+    """Requested blocks that don't divide T are adapted (halved / collapsed
+    to one block), never an error — and numerics are unchanged."""
     q, k, v = _qkv(2)
-    with pytest.raises(ValueError, match="divisible"):
-        flash_attention(q, k, v, block_q=48, block_k=48, interpret=True)
+    out = flash_attention(q, k, v, block_q=48, block_k=48, interpret=True)
+    ref = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_flash_attention_odd_sequence_lengths():
+    """Sequence lengths not divisible by the large default blocks must
+    still run (block sizes adapt by halving, or fall back to one block)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.ops.attention import dot_product_attention
+    from chainermn_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(5)
+    for T in (96, 136, 768):
+        q = jnp.asarray(rng.randn(1, T, 2, 32), jnp.float32)
+        out = flash_attention(q, q, q, causal=True)
+        ref = dot_product_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+        g = jax.grad(lambda x: jnp.sum(flash_attention(x, x, x)))(q)
+        assert np.isfinite(np.asarray(g)).all()
