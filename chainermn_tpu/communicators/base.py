@@ -347,15 +347,81 @@ class CommunicatorBase:
     def allreduce_obj(self, obj: Any, op: Callable[[Any, Any], Any] | None = None) -> Any:
         return self.host.allreduce_obj(obj, op)
 
+    def send(self, x, dest: int, tag: int = 0) -> None:
+        """Eager point-to-point ndarray send (reference:
+        ``MpiCommunicatorBase.send`` — an ndarray or tuple of ndarrays,
+        preceded by a ``_MessageType`` header describing tuple-ness, shapes
+        and dtypes, ``mpi_communicator_base.py`` (dagger)).
+
+        Cross-process transport rides the native TCP host plane (the
+        reference's non-CUDA-aware staging: device → host → wire). The
+        in-jit production path for model parallelism is
+        :mod:`chainermn_tpu.functions.point_to_point` (ppermute); this eager
+        form exists for parity and host-driven control flows, not the hot
+        loop."""
+        is_tuple = isinstance(x, (tuple, list))
+        parts = list(x) if is_tuple else [x]
+        header = []
+        payloads = []
+        for p in parts:
+            arr = np.asarray(p)
+            header.append((arr.shape, str(arr.dtype)))
+            payloads.append(arr.tobytes())
+        self.send_obj(("ndarray", is_tuple, header, payloads), dest, tag)
+
+    def recv(self, source: int, tag: int = 0):
+        """Eager point-to-point ndarray receive; returns NumPy array(s)
+        matching the sender's shapes and dtypes EXACTLY (including 64-bit —
+        ``jax.device_put`` would canonicalise int64→int32 under the default
+        x64-off config, silently corrupting large values). Callers place on
+        device with their own sharding/dtype choice."""
+        kind, is_tuple, header, payloads = self.recv_obj(source, tag)
+        if kind != "ndarray":
+            raise RuntimeError(
+                f"recv expected an ndarray message, got {kind!r} (interleaved "
+                "send_obj/send on one channel must match recv_obj/recv order)"
+            )
+        arrays = tuple(
+            np.frombuffer(buf, dtype=np.dtype(dt)).reshape(shape)
+            for (shape, dt), buf in zip(header, payloads)
+        )
+        return arrays if is_tuple else arrays[0]
+
+    @functools.cached_property
+    def _self_p2p(self) -> dict:
+        """FIFO queues for same-process p2p (MPI permits self send/recv;
+        mesh-slot ranks sharing one process land here — including all
+        single-process use). Keyed ``(peer_slot, tag)``: the peer is the
+        slot named in the call (``dest`` on send, ``source`` on recv), so
+        messages to different local slots never cross-deliver."""
+        import collections
+
+        return collections.defaultdict(collections.deque)
+
     def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
         """Point-to-point host send (reference: ``send_obj`` via MPI). Rides
         the native TCP backend (:mod:`chainermn_tpu.native`); the channel is
         per-pair FIFO, so ``tag`` is carried in-band and matched on receive
-        (device-plane p2p lives in :mod:`chainermn_tpu.functions`)."""
-        self.host.send_obj((tag, obj), self._root_process(dest))
+        (device-plane p2p lives in :mod:`chainermn_tpu.functions`). Sends to
+        mesh slots owned by THIS process are buffered locally (MPI self-send
+        parity); the matching ``recv`` must name the same slot."""
+        dest_proc = self._root_process(dest)
+        if dest_proc == self.host.rank:
+            self._self_p2p[(dest, tag)].append(obj)
+            return
+        self.host.send_obj((tag, obj), dest_proc)
 
     def recv_obj(self, source: int, tag: int = 0) -> Any:
-        got_tag, obj = self.host.recv_obj(self._root_process(source))
+        src_proc = self._root_process(source)
+        if src_proc == self.host.rank:
+            if not self._self_p2p[(source, tag)]:
+                raise RuntimeError(
+                    f"recv_obj from local slot {source} (tag {tag}) with no "
+                    "buffered self-send — same-process p2p requires a prior "
+                    "send addressed to THAT slot/tag"
+                )
+            return self._self_p2p[(source, tag)].popleft()
+        got_tag, obj = self.host.recv_obj(src_proc)
         if got_tag != tag:
             raise RuntimeError(
                 f"recv_obj tag mismatch: expected {tag}, got {got_tag} "

@@ -37,15 +37,73 @@ def _path_key(path) -> str:
     return jax.tree_util.keystr(path) or "<root>"
 
 
+#: separates the tree-path key from a shard's global-index suffix
+_SHARD_SEP = "@@"
+
+
+def _index_str(index, shape) -> str:
+    """Canonical string for a shard's global index: ``start:stop`` per dim
+    (slices normalised against the global shape, so device numbering never
+    enters the format — restarts with renumbered devices restore fine)."""
+    parts = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        assert step == 1, "strided shard indices are not supported"
+        parts.append(f"{start}:{stop}")
+    return "|".join(parts)
+
+
 def _path_keyed_arrays(state: PyTree) -> dict[str, np.ndarray]:
+    """Flatten ``state`` to ``{tree_path: np.ndarray}``.
+
+    Fully-addressable leaves (replicated or single-process) are stored as
+    their global view. Multi-process *sharded* leaves are stored as this
+    process's addressable shards, keyed ``path@@start:stop|...`` by global
+    index — each host writes only the bytes it owns (the sharded-params
+    answer the npz whole-state format lacked; reference scale story:
+    SURVEY.md section 5 checkpoint/resume, 'sharded per-host checkpoints
+    with a manifest')."""
     flat, _ = jax.tree_util.tree_flatten_with_path(state)
     arrays: dict[str, np.ndarray] = {}
     for path, leaf in flat:
         key = _path_key(path)
+        if _SHARD_SEP in key:
+            raise ValueError(f"tree-path key {key!r} contains {_SHARD_SEP!r}")
         if key in arrays:
             raise ValueError(f"duplicate tree-path key {key!r}")
-        arrays[key] = np.asarray(leaf)
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            seen = set()
+            for s in leaf.addressable_shards:
+                ik = _index_str(s.index, leaf.shape)
+                if ik in seen:  # replicated over several local devices
+                    continue
+                seen.add(ik)
+                arrays[f"{key}{_SHARD_SEP}{ik}"] = np.asarray(s.data)
+        else:
+            arrays[key] = np.asarray(leaf)
     return arrays
+
+
+def _assemble_sharded(key: str, data, template_leaf, tshape):
+    """Rebuild a global sharded array from this process's saved shards,
+    using the *template's* sharding to place them."""
+    sharding = template_leaf.sharding
+    imap = sharding.addressable_devices_indices_map(tshape)
+    pieces = []
+    for device, index in imap.items():
+        skey = f"{key}{_SHARD_SEP}{_index_str(index, tshape)}"
+        if skey not in data:
+            raise ValueError(
+                f"checkpoint misses shard {skey!r} required by the template "
+                "sharding — was it saved under a different mesh layout?"
+            )
+        arr = np.asarray(data[skey]).astype(
+            np.dtype(template_leaf.dtype), copy=False
+        )
+        pieces.append(jax.device_put(arr, device))
+    return jax.make_array_from_single_device_arrays(
+        tshape, sharding, pieces
+    )
 
 
 class MultiNodeCheckpointer:
@@ -119,7 +177,13 @@ class MultiNodeCheckpointer:
         data = np.load(self._fname(it))
         flat, treedef = jax.tree_util.tree_flatten_with_path(state_template)
         keys = [_path_key(p) for p, _ in flat]
-        saved, wanted = set(data.files), set(keys)
+        # Shard entries (``path@@start:stop|...``) collapse onto their base
+        # key for the key-set agreement check.
+        saved = {k.split(_SHARD_SEP, 1)[0] for k in data.files}
+        sharded_saved = {
+            k.split(_SHARD_SEP, 1)[0] for k in data.files if _SHARD_SEP in k
+        }
+        wanted = set(keys)
         if saved != wanted and all(
             re.fullmatch(r"leaf_\d+", k) for k in saved
         ):
@@ -137,8 +201,16 @@ class MultiNodeCheckpointer:
             )
         restored = []
         for key, (_, t) in zip(keys, flat):
-            arr = np.asarray(data[key])
             tshape = np.shape(t)
+            if key in sharded_saved:
+                if not isinstance(t, jax.Array):
+                    raise ValueError(
+                        f"checkpoint leaf {key!r} was saved sharded but the "
+                        "template leaf carries no sharding to restore it with"
+                    )
+                restored.append(_assemble_sharded(key, data, t, tshape))
+                continue
+            arr = np.asarray(data[key])
             if arr.shape != tshape:
                 raise ValueError(
                     f"checkpoint leaf {key!r} has shape {arr.shape}, "

@@ -1,30 +1,66 @@
 """Cross-rank aggregation of training observations (metrics).
 
 Reference: upstream's ``ObservationAggregator`` extension (presence in the
-fork uncertain — SURVEY.md section 5 "Metrics / logging"): averages the
-reporter's observation dict across ranks each reporting interval so rank-0
-logs global, not local, statistics.
+fork uncertain — SURVEY.md section 5 "Metrics / logging"): every
+``interval`` iterations, the observations accumulated over the window are
+averaged over time AND across ranks, so rank-0 logs global statistics while
+the host-plane collective runs once per window, not once per step.
 """
 
 from __future__ import annotations
 
-from typing import Mapping
+from typing import Mapping, Optional
 
 from chainermn_tpu.communicators.base import CommunicatorBase
 
 
 class ObservationAggregator:
-    """Average numeric observations across processes.
+    """Average numeric observations across processes and a time window.
 
     Device-plane metrics inside a jitted step should use ``lax.pmean``
     directly; this aggregator handles host-side dicts (loss running means,
     timing counters) before rank-0 logging.
-    """
 
-    def __init__(self, communicator: CommunicatorBase) -> None:
+    With ``interval == 1`` (default) every call aggregates immediately.
+    With ``interval > 1`` calls buffer locally and return ``None`` until
+    the window closes; then the window-mean is allreduced in one host
+    collective and returned. Keys may vary between steps within a window
+    (each key averages over the steps that reported it)."""
+
+    def __init__(
+        self, communicator: CommunicatorBase, *, interval: int = 1
+    ) -> None:
+        if interval < 1:
+            raise ValueError(f"interval must be >= 1, got {interval}")
         self.comm = communicator
+        self.interval = interval
+        self._sums: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+        self._calls = 0
 
-    def __call__(self, observation: Mapping[str, float]) -> dict[str, float]:
-        obs = {k: float(v) for k, v in observation.items()}
-        total = self.comm.allreduce_obj(obs)
+    def __call__(
+        self, observation: Mapping[str, float]
+    ) -> Optional[dict[str, float]]:
+        for k, v in observation.items():
+            self._sums[k] = self._sums.get(k, 0.0) + float(v)
+            self._counts[k] = self._counts.get(k, 0) + 1
+        self._calls += 1
+        if self._calls < self.interval:
+            return None
+        # Window mean per rank, then ONE cross-rank averaging collective.
+        return self.flush()
+
+    def flush(self) -> Optional[dict[str, float]]:
+        """Aggregate whatever the current window holds (for end of training,
+        where a partial window would otherwise be silently dropped). Returns
+        ``None`` when the window is empty. Collective when multi-process —
+        every rank must call it at the same point."""
+        if not self._sums:
+            self._calls = 0
+            return None
+        local = {k: self._sums[k] / self._counts[k] for k in self._sums}
+        self._sums.clear()
+        self._counts.clear()
+        self._calls = 0
+        total = self.comm.allreduce_obj(local)
         return {k: v / self.comm.host.size for k, v in total.items()}

@@ -105,6 +105,13 @@ class ResNet(nn.Module):
     compute_dtype: Any = jnp.bfloat16
     bn_axis_name: Optional[Any] = None
     bn_momentum: float = 0.9
+    #: rematerialize each residual block in the backward pass. The b128
+    #: ResNet-50 train step is HBM-bandwidth-bound on one v5e chip (measured:
+    #: 46 GB accessed/step ~= 57 ms at peak BW vs 15 ms of pure FLOPs), so
+    #: recomputing block activations trades cheap MXU FLOPs for the bytes
+    #: that actually gate throughput (SURVEY.md env note: "use
+    #: jax.checkpoint/remat to trade FLOPs for memory").
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -127,10 +134,11 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(
+                x = block_cls(
                     self.num_filters * 2**i,
                     conv=conv,
                     norm=norm,

@@ -21,6 +21,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from chainermn_tpu.ops.attention import blockwise_attention
+from chainermn_tpu.ops.flash_attention import flash_attention
 
 
 def ulysses_attention_local(
@@ -32,6 +33,8 @@ def ulysses_attention_local(
     causal: bool = False,
     scale: Optional[float] = None,
     attn_fn: Optional[Callable] = None,
+    impl: str = "flash",
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Ulysses attention over local shards — call INSIDE ``shard_map``.
 
@@ -39,7 +42,11 @@ def ulysses_attention_local(
       q/k/v: local sequence shards ``[B, T_local, H, D]``; global heads H
         must be divisible by the axis size.
       attn_fn: local attention ``fn(q, k, v, causal=..., scale=...)`` on
-        ``[B, T, H_local, D]``; defaults to blockwise (flash) attention.
+        ``[B, T, H_local, D]``; overrides ``impl`` when given.
+      impl: ``'flash'`` — the Pallas kernel (fwd+bwd; the production path,
+        same kernels as ring attention) — or ``'blockwise'`` (lax scan
+        reference). ``interpret`` as in
+        :func:`chainermn_tpu.parallel.ring_attention.ring_attention_local`.
 
     Returns:
       Local output shard ``[B, T_local, H, D]``.
@@ -52,7 +59,17 @@ def ulysses_attention_local(
             f"size {n}"
         )
     if attn_fn is None:
-        attn_fn = blockwise_attention
+        if impl == "flash":
+            def attn_fn(q, k, v, *, causal, scale):
+                return flash_attention(
+                    q, k, v, causal=causal, scale=scale, interpret=interpret
+                )
+        elif impl == "blockwise":
+            attn_fn = blockwise_attention
+        else:
+            raise ValueError(
+                f"impl must be 'flash' or 'blockwise', got {impl!r}"
+            )
 
     def seq_to_heads(x):
         # [B, T/n, H, D] -> [B, T, H/n, D]
@@ -78,16 +95,19 @@ def make_ulysses_attention(
     scale: Optional[float] = None,
     attn_fn: Optional[Callable] = None,
     batch_axis: Optional[str] = None,
+    impl: str = "flash",
 ):
     """Jitted Ulysses attention over globally sequence-sharded BTHD arrays
     (counterpart of :func:`chainermn_tpu.parallel.make_ring_attention`)."""
     from jax import shard_map
 
     spec = P(batch_axis, axis_name, None, None)
+    interpret = mesh.devices.flat[0].platform != "tpu"
 
     def local(q, k, v):
         return ulysses_attention_local(
-            q, k, v, axis_name, causal=causal, scale=scale, attn_fn=attn_fn
+            q, k, v, axis_name, causal=causal, scale=scale, attn_fn=attn_fn,
+            impl=impl, interpret=interpret,
         )
 
     fn = shard_map(

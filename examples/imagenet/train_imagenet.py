@@ -38,10 +38,10 @@ from chainermn_tpu.training.train_step import create_train_state
 ARCHS = {
     # dropout off: a per-step rng is model-specific plumbing this throughput
     # example doesn't need
-    "alex": lambda bn_ax: AlexNet(dropout_rate=0.0),
-    "googlenet": lambda bn_ax: GoogLeNet(),
-    "googlenetbn": lambda bn_ax: GoogLeNet(use_bn=True, bn_axis_name=bn_ax),
-    "resnet50": lambda bn_ax: ResNet50(bn_axis_name=bn_ax),
+    "alex": lambda bn_ax, **kw: AlexNet(dropout_rate=0.0),
+    "googlenet": lambda bn_ax, **kw: GoogLeNet(),
+    "googlenetbn": lambda bn_ax, **kw: GoogLeNet(use_bn=True, bn_axis_name=bn_ax),
+    "resnet50": lambda bn_ax, **kw: ResNet50(bn_axis_name=bn_ax, **kw),
 }
 
 
@@ -62,6 +62,9 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--allreduce-grad-dtype", default="bfloat16")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize residual blocks (trade FLOPs for "
+                        "activation memory; enables bigger per-chip batches)")
     p.add_argument("--profile", default=None,
                    help="directory for a jax.profiler trace of iters 10-20")
     p.add_argument("--train-root", default=None)
@@ -78,7 +81,11 @@ def main(argv=None):
     if comm.rank == 0:
         print(f"communicator: {comm}  arch: {args.arch}")
 
-    model = ARCHS[args.arch](comm.bn_axis_name)
+    if args.remat and args.arch != "resnet50":
+        p.error(f"--remat is only supported for --arch resnet50 "
+                f"(got {args.arch!r})")
+    kw = {"remat": True} if args.remat else {}
+    model = ARCHS[args.arch](comm.bn_axis_name, **kw)
     global_batch = args.batchsize * comm.size
     rng = np.random.default_rng(0)
 

@@ -212,6 +212,102 @@ def case_split():
     comm.barrier()
 
 
+def case_array_p2p():
+    """Eager ndarray send/recv over the TCP host plane (reference:
+    MpiCommunicatorBase.send/recv with the _MessageType header)."""
+    from chainermn_tpu import create_communicator
+
+    comm = create_communicator("xla")
+    # Ranks are MESH SLOTS; with several local devices per process the next
+    # process's first slot is local_device_count() away.
+    ndev = jax.local_device_count()
+    nxt = ((RANK + 1) % SIZE) * ndev       # slot on the next process
+    prv_proc = (RANK - 1) % SIZE
+
+    base = np.arange(12, dtype=np.float32).reshape(3, 4)
+    comm.send(base * (RANK + 1), nxt)
+    got = comm.recv(prv_proc * ndev)
+    np.testing.assert_allclose(np.asarray(got), base * (prv_proc + 1))
+
+    # tuple message with mixed dtypes + a tag
+    comm.send((np.int32([RANK, 7]), np.float64([[1.5 * RANK]])), nxt, tag=3)
+    a, b = comm.recv(prv_proc * ndev, tag=3)
+    assert a.dtype == jnp.int32.dtype and int(a[0]) == prv_proc
+    np.testing.assert_allclose(np.asarray(b), [[1.5 * prv_proc]])
+
+    # self send/recv (slot owned by this process) buffers locally
+    comm.send(base, RANK * ndev + 1, tag=9)
+    np.testing.assert_allclose(np.asarray(comm.recv(RANK * ndev + 1, tag=9)), base)
+    comm.barrier()
+
+
+def case_sharded_checkpoint():
+    """Sharded-params checkpointing: each process saves only its addressable
+    shards (keyed by global index); restore reassembles through the
+    template's sharding. The npz whole-state path cannot represent
+    non-fully-addressable arrays at all — this is the scale story."""
+    from chainermn_tpu import create_communicator
+    from chainermn_tpu.extensions.checkpoint import (
+        create_multi_node_checkpointer,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    comm = create_communicator("xla")
+    sh = NamedSharding(comm.mesh, P("data"))
+    rows = comm.size * 3
+    global_np = np.arange(rows * 4, dtype=np.float32).reshape(rows, 4)
+    arr = jax.make_array_from_callback(
+        global_np.shape, sh, lambda idx: global_np[idx]
+    )
+    assert not arr.is_fully_addressable  # the case the npz path couldn't do
+
+    path = os.environ["MP_CKPT_DIR"]
+    ckpt = create_multi_node_checkpointer("shard", comm, path=path, keep=0)
+    ckpt.save({"w": arr, "step": jnp.int32(5)}, 1)
+    comm.barrier()
+
+    template = {
+        "w": jax.make_array_from_callback(
+            global_np.shape, sh, lambda idx: np.zeros_like(global_np[idx])
+        ),
+        "step": jnp.int32(0),
+    }
+    restored, it = ckpt.maybe_load(template)
+    assert it == 1 and int(restored["step"]) == 5
+    assert restored["w"].sharding == sh
+    for s in restored["w"].addressable_shards:
+        np.testing.assert_allclose(np.asarray(s.data), global_np[s.index])
+
+
+def case_preemption():
+    """Preemption guard: only rank 0 is signalled; the host-plane agreement
+    makes every rank checkpoint the same iteration and exit 0."""
+    import signal
+
+    from chainermn_tpu import create_communicator
+    from chainermn_tpu.extensions.checkpoint import (
+        create_multi_node_checkpointer,
+    )
+    from chainermn_tpu.utils.preemption import install_preemption_guard
+
+    comm = create_communicator("xla")
+    ckpt = create_multi_node_checkpointer(
+        "pre", comm, path=os.environ["MP_CKPT_DIR"], keep=0
+    )
+    guard = install_preemption_guard()
+
+    state = {"w": jnp.zeros((3,))}
+    for it in range(1, 200):
+        state = {"w": state["w"] + 1.0}
+        if it == 3 and RANK == 0:
+            os.kill(os.getpid(), signal.SIGTERM)  # rank 0 only
+        if guard.should_checkpoint(comm, every=5, iteration=it):
+            ckpt.save(state, it)
+            print("MP_CASE_OK", flush=True)  # exit_if_preempted never returns
+            guard.exit_if_preempted(comm)
+    raise AssertionError("preemption never triggered a checkpoint")
+
+
 def case_trainer_mnist():
     """The mnist example's Trainer path end-to-end under real processes."""
     sys.argv = [

@@ -136,3 +136,17 @@ def test_global_except_hook_installs():
     finally:
         sys.excepthook = old
         global_except_hook._hook_installed = False
+
+
+def test_observation_aggregator_windowed(comm):
+    """interval>1: calls buffer locally (None) until the window closes,
+    then the window mean is aggregated — upstream ObservationAggregator
+    semantics (time average, then cross-rank average)."""
+    agg = ObservationAggregator(comm, interval=3)
+    assert agg({"loss": 4.0}) is None
+    assert agg({"loss": 2.0, "acc": 1.0}) is None
+    out = agg({"loss": 0.0})
+    # single process: mean over the window per key
+    assert out == {"loss": 2.0, "acc": 1.0}
+    # window state resets
+    assert agg({"loss": 10.0}) is None

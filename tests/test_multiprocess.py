@@ -28,6 +28,15 @@ def test_mp_checkpoint_agreement(tmp_path):
     )
 
 
+def test_mp_sharded_checkpoint(tmp_path):
+    """Each process persists only its addressable shards; restore
+    reassembles the global sharded arrays via the template sharding."""
+    run_workers(
+        "sharded_checkpoint", n_procs=2, local_devices=2,
+        extra_env={"MP_CKPT_DIR": str(tmp_path)},
+    )
+
+
 def test_mp_split_2x2():
     """4 processes split 2+2: independent per-group host and device
     collectives without deadlock — VERDICT round-1 item 5."""
@@ -39,6 +48,31 @@ def test_mp_split_2x2():
         coord_port=jax_port,
         extra_env={"MP_TCP_COORD": f"127.0.0.1:{tcp_port}"},
     )
+
+
+def test_mp_array_p2p():
+    """Eager ndarray send/recv (MPI parity) across real processes."""
+    from mp_harness import free_ports
+
+    jax_port, tcp_port = free_ports(2)
+    run_workers(
+        "array_p2p", n_procs=2, local_devices=2,
+        coord_port=jax_port,
+        extra_env={"MP_TCP_COORD": f"127.0.0.1:{tcp_port}"},
+    )
+
+
+def test_mp_preemption(tmp_path):
+    """SIGTERM on one rank → all ranks checkpoint the same iteration and
+    exit 0 (the slice-preemption story, SURVEY §5)."""
+    run_workers(
+        "preemption", n_procs=2, local_devices=2,
+        extra_env={"MP_CKPT_DIR": str(tmp_path)},
+    )
+    saved = sorted(p.name for p in tmp_path.iterdir())
+    assert len(saved) == 2, saved
+    # both ranks agreed on the same (first every=5 multiple >= signal) iter
+    assert all("_5.npz" in s for s in saved), saved
 
 
 def test_mp_trainer_mnist():
