@@ -382,18 +382,28 @@ class CommunicatorBase:
                 "send_obj/send on one channel must match recv_obj/recv order)"
             )
         arrays = tuple(
-            np.frombuffer(buf, dtype=np.dtype(dt)).reshape(shape)
+            # .copy(): frombuffer views the wire bytes read-only; MPI recv
+            # hands back a writable buffer, so match that contract.
+            np.frombuffer(buf, dtype=np.dtype(dt)).reshape(shape).copy()
             for (shape, dt), buf in zip(header, payloads)
         )
         return arrays if is_tuple else arrays[0]
 
     @functools.cached_property
     def _self_p2p(self) -> dict:
-        """FIFO queues for same-process p2p (MPI permits self send/recv;
+        """FIFO mailboxes for same-process p2p (MPI permits self send/recv;
         mesh-slot ranks sharing one process land here — including all
-        single-process use). Keyed ``(peer_slot, tag)``: the peer is the
-        slot named in the call (``dest`` on send, ``source`` on recv), so
-        messages to different local slots never cross-deliver."""
+        single-process use). Keyed ``(slot, tag)`` where the slot is the one
+        NAMED IN THE CALL (``dest`` on send, ``source`` on recv), so
+        messages to different local slots never cross-deliver.
+
+        Semantics caveat: in single-controller eager mode the caller has no
+        rank identity, so MPI's "recv names the SENDER" cannot be expressed
+        for co-located pairs — a ring-style ``send(x, next); recv(prev)``
+        only pairs up when next/prev live on different processes. For
+        cross-slot exchanges inside one process, use the in-jit
+        differentiable p2p (:mod:`chainermn_tpu.functions.point_to_point`),
+        which has real per-slot identity via ``axis_index``."""
         import collections
 
         return collections.defaultdict(collections.deque)

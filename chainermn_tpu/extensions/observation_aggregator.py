@@ -53,14 +53,28 @@ class ObservationAggregator:
     def flush(self) -> Optional[dict[str, float]]:
         """Aggregate whatever the current window holds (for end of training,
         where a partial window would otherwise be silently dropped). Returns
-        ``None`` when the window is empty. Collective when multi-process —
-        every rank must call it at the same point."""
-        if not self._sums:
-            self._calls = 0
-            return None
-        local = {k: self._sums[k] / self._counts[k] for k in self._sums}
+        ``None`` when the window is empty on EVERY rank.
+
+        Collective when multi-process: every rank must call it at the same
+        point, and the collective runs unconditionally — a rank whose window
+        is empty contributes nothing but still participates (an early local
+        return would deadlock the others). Keys union across ranks; each
+        key averages over the ranks/steps that reported it."""
+        local = {
+            k: (self._sums[k], float(self._counts[k])) for k in self._sums
+        }
         self._sums.clear()
         self._counts.clear()
         self._calls = 0
-        total = self.comm.allreduce_obj(local)
-        return {k: v / self.comm.host.size for k, v in total.items()}
+
+        def union_sum(a: dict, b: dict) -> dict:
+            out = dict(a)
+            for k, (s, c) in b.items():
+                s0, c0 = out.get(k, (0.0, 0.0))
+                out[k] = (s0 + s, c0 + c)
+            return out
+
+        total = self.comm.allreduce_obj(local, op=union_sum)
+        if not total:
+            return None
+        return {k: s / c for k, (s, c) in total.items()}

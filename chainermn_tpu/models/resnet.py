@@ -112,6 +112,13 @@ class ResNet(nn.Module):
     #: that actually gate throughput (SURVEY.md env note: "use
     #: jax.checkpoint/remat to trade FLOPs for memory").
     remat: bool = False
+    #: ``'standard'`` — the classic 7x7/s2 conv + 3x3 maxpool;
+    #: ``'space_to_depth'`` — rearrange 4x4 pixel blocks into 48 channels and
+    #: run a 3x3/s1 conv (the MLPerf-era TPU stem): a 3-channel conv wastes
+    #: the 128-lane MXU, and the measured stem cost is ~13% of the whole b128
+    #: v5e train step. Same [56, 56, 64] stem output shape; NOT
+    #: weight-compatible with 'standard'.
+    stem: str = "standard"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -129,11 +136,25 @@ class ResNet(nn.Module):
         )
 
         x = x.astype(self.compute_dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            B, H, W, C = x.shape
+            if H % 4 or W % 4:
+                raise ValueError(
+                    f"space_to_depth stem needs H, W divisible by 4, got "
+                    f"({H}, {W})"
+                )
+            x = x.reshape(B, H // 4, 4, W // 4, 4, C)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // 4, W // 4, 16 * C)
+            x = conv(self.num_filters, (3, 3), name="conv_init_s2d")(x)
+        elif self.stem == "standard":
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        if self.stem == "standard":
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):

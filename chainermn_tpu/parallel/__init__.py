@@ -41,6 +41,12 @@ def __getattr__(name):
         from chainermn_tpu.parallel import moe as _m
 
         return getattr(_m, name)
+    if name in (
+        "fsdp_shardings", "create_fsdp_train_state", "make_fsdp_train_step"
+    ):
+        from chainermn_tpu.parallel import fsdp as _f
+
+        return getattr(_f, name)
     raise AttributeError(name)
 
 
@@ -61,4 +67,7 @@ __all__ = [
     "moe_layer_local",
     "top1_route",
     "make_expert_params",
+    "fsdp_shardings",
+    "create_fsdp_train_state",
+    "make_fsdp_train_step",
 ]

@@ -76,6 +76,34 @@ def create_train_state(
     )
 
 
+def normalize_loss_fn(loss_fn: Callable) -> Callable:
+    """Wrap the user's ``loss_fn`` into the canonical
+    ``(params, batch, model_state) -> (loss, (metrics, new_model_state))``
+    form, accepting every documented return shape: plain ``loss``,
+    ``(loss, metrics)``, or ``(loss, (metrics, new_model_state))``; with or
+    without the ``model_state`` argument. The single place that owns this
+    contract — used by the shard_map step here and the FSDP step
+    (:mod:`chainermn_tpu.parallel.fsdp`)."""
+    takes_model_state = _arity(loss_fn) >= 3
+
+    def _loss_with_aux(params, batch, model_state):
+        if takes_model_state:
+            out = loss_fn(params, batch, model_state)
+        else:
+            out = loss_fn(params, batch)
+        if isinstance(out, tuple):
+            loss, aux = out
+            if isinstance(aux, tuple) and len(aux) == 2:
+                metrics, new_model_state = aux
+            else:
+                metrics, new_model_state = aux, model_state
+        else:
+            loss, metrics, new_model_state = out, {}, model_state
+        return loss, (metrics, new_model_state)
+
+    return _loss_with_aux
+
+
 def make_train_step(
     loss_fn: Callable,
     optimizer,
@@ -107,22 +135,7 @@ def make_train_step(
         batch_spec = P(axes)
     reduce_in_step = not isinstance(optimizer, MultiNodeOptimizer)
 
-    takes_model_state = _arity(loss_fn) >= 3
-
-    def _loss_with_aux(params, batch, model_state):
-        if takes_model_state:
-            out = loss_fn(params, batch, model_state)
-        else:
-            out = loss_fn(params, batch)
-        if isinstance(out, tuple):
-            loss, aux = out
-            if isinstance(aux, tuple) and len(aux) == 2:
-                metrics, new_model_state = aux
-            else:
-                metrics, new_model_state = aux, model_state
-        else:
-            loss, metrics, new_model_state = out, {}, model_state
-        return loss, (metrics, new_model_state)
+    _loss_with_aux = normalize_loss_fn(loss_fn)
 
     def local_step(state: TrainState, batch):
         grad_fn = jax.value_and_grad(_loss_with_aux, has_aux=True)

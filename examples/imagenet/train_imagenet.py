@@ -62,6 +62,11 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--allreduce-grad-dtype", default="bfloat16")
+    p.add_argument("--stem", default="standard",
+                   choices=["standard", "space_to_depth"],
+                   help="resnet50 input stem; space_to_depth trades the "
+                        "MXU-hostile 3-channel 7x7 conv for a 48-channel "
+                        "3x3 (measured +16%% img/s on v5e)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize residual blocks (trade FLOPs for "
                         "activation memory; enables bigger per-chip batches)")
@@ -81,10 +86,14 @@ def main(argv=None):
     if comm.rank == 0:
         print(f"communicator: {comm}  arch: {args.arch}")
 
-    if args.remat and args.arch != "resnet50":
-        p.error(f"--remat is only supported for --arch resnet50 "
+    if (args.remat or args.stem != "standard") and args.arch != "resnet50":
+        p.error(f"--remat/--stem are only supported for --arch resnet50 "
                 f"(got {args.arch!r})")
-    kw = {"remat": True} if args.remat else {}
+    kw = {}
+    if args.remat:
+        kw["remat"] = True
+    if args.arch == "resnet50":
+        kw["stem"] = args.stem
     model = ARCHS[args.arch](comm.bn_axis_name, **kw)
     global_batch = args.batchsize * comm.size
     rng = np.random.default_rng(0)

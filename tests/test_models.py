@@ -160,3 +160,34 @@ class TestImageNetFamily:
         x = jnp.ones((2, 64, 64, 3))
         variables = model.init(jax.random.PRNGKey(0), x, train=False)
         assert "batch_stats" in variables
+
+
+def test_resnet_space_to_depth_stem_shapes():
+    """s2d stem: same [H/4, W/4, 64] stem output contract as the standard
+    7x7+maxpool stem; full model trains a step with finite grads."""
+    import optax
+
+    from chainermn_tpu.models import ResNet18
+
+    model = ResNet18(num_classes=10, compute_dtype=jnp.float32,
+                     stem="space_to_depth")
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=True)
+
+    def loss(p):
+        logits, _ = model.apply(
+            {"params": p, "batch_stats": variables["batch_stats"]}, x,
+            train=True, mutable=["batch_stats"],
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.asarray([1, 2])
+        ).mean()
+
+    g = jax.grad(loss)(variables["params"])
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+    # indivisible spatial dims are rejected loudly
+    import pytest
+
+    bad = jnp.zeros((1, 30, 32, 3))
+    with pytest.raises(ValueError, match="divisible by 4"):
+        model.init(jax.random.key(0), bad, train=True)
