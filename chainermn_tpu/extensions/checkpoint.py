@@ -119,6 +119,7 @@ class MultiNodeCheckpointer:
         self.comm = comm
         self.path = path
         self.keep = keep
+        self._writer = None  # lazy native async writer (save(block=False))
         os.makedirs(path, exist_ok=True)
 
     # ------------------------------------------------------------------
@@ -139,7 +140,7 @@ class MultiNodeCheckpointer:
 
     # ------------------------------------------------------------------
 
-    def save(self, state: PyTree, iteration: int) -> str:
+    def save(self, state: PyTree, iteration: int, *, block: bool = True) -> str:
         """Snapshot ``state`` (any pytree of arrays) for this process, then
         GC old local snapshots beyond ``keep`` (the reference's round-robin
         stale-file GC).
@@ -147,25 +148,59 @@ class MultiNodeCheckpointer:
         Arrays are keyed by their *tree path* (``jax.tree_util.keystr``),
         not position: a pytree reordered between save and load restores
         correctly by name, and a renamed/missing/extra leaf fails loudly at
-        load instead of silently mis-assigning a shape-compatible array."""
+        load instead of silently mis-assigning a shape-compatible array.
+
+        ``block=False`` hands the serialized bytes to the native async
+        writer (:mod:`chainermn_tpu.native.ckpt_writer`): the call returns
+        once device arrays are fetched and pickled; write+fsync+rename run
+        on a C++ worker thread. Call :meth:`wait_async` before treating the
+        iteration as durable (``maybe_load`` does so automatically)."""
         arrays = _path_keyed_arrays(state)
         fname = self._fname(iteration)
+        if not block:
+            import io
+
+            buf = io.BytesIO()
+            np.savez(buf, **arrays)
+            if self._writer is None:
+                from chainermn_tpu.native.ckpt_writer import (
+                    AsyncCheckpointWriter,
+                )
+
+                self._writer = AsyncCheckpointWriter()
+            self._writer.submit(fname, buf.getvalue())
+            # GC here too (not only at wait_async): long runs must not
+            # accumulate snapshots unboundedly between drains. Only durable
+            # (on-disk) files are scanned, so in-flight writes are safe.
+            self._gc()
+            return fname
         tmp = fname + ".tmp.npz"
         np.savez(tmp, **arrays)
         os.replace(tmp, fname)
+        self._gc()
+        return fname
 
+    def _gc(self) -> None:
         for it in self._local_iterations()[: -self.keep] if self.keep else []:
             try:
                 os.remove(self._fname(it))
             except OSError:
                 pass
-        return fname
+
+    def wait_async(self) -> None:
+        """Drain the async writer: on return every ``block=False`` save is
+        durable (raises if any failed), and stale snapshots are GC'd (GC is
+        deferred from async saves so it can't race the writes)."""
+        if self._writer is not None:
+            self._writer.wait()
+            self._gc()
 
     def maybe_load(self, state_template: PyTree) -> tuple[PyTree, Optional[int]]:
         """Resume from the newest iteration available on *all* processes
         (reference: gather available iters -> max common -> deserialize,
         SURVEY.md section 3.5). Returns ``(state, iteration)`` or
         ``(state_template, None)`` when no common snapshot exists."""
+        self.wait_async()  # in-flight async saves count once durable
         local = set(self._local_iterations())
         everyone = self.comm.allgather_obj(sorted(local))
         common = set(everyone[0])

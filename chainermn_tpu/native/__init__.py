@@ -29,6 +29,7 @@ _BUILD_DIR = Path(__file__).parent / "build"
 _COMPONENTS = {
     "host_comm": ("host_comm.cpp", []),
     "data_loader": ("data_loader.cpp", ["-pthread"]),
+    "ckpt_writer": ("ckpt_writer.cpp", ["-pthread"]),
 }
 
 

@@ -1,0 +1,92 @@
+"""ctypes wrapper over the native async checkpoint writer
+(``src/ckpt_writer.cpp``).
+
+TPU train steps take milliseconds; fsync-durable snapshot writes take much
+longer. The writer moves the write → fsync → atomic-rename sequence onto a
+C++ worker thread with a bounded queue, so :meth:`submit` returns as soon
+as the bytes are copied and training continues while the snapshot becomes
+durable. Failures are collected and surfaced at :meth:`wait` (the point
+where durability is actually needed — e.g. before reporting an iteration as
+checkpointed, or inside the preemption guard's exit path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from chainermn_tpu.native import lib_path
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(str(lib_path("ckpt_writer")))
+        lib.cw_init.restype = ctypes.c_void_p
+        lib.cw_init.argtypes = [ctypes.c_int]
+        lib.cw_submit.restype = ctypes.c_int
+        lib.cw_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_longlong,
+        ]
+        lib.cw_pending.restype = ctypes.c_int
+        lib.cw_pending.argtypes = [ctypes.c_void_p]
+        lib.cw_wait.restype = ctypes.c_int
+        lib.cw_wait.argtypes = [ctypes.c_void_p]
+        lib.cw_finalize.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class AsyncCheckpointWriter:
+    """Background durable-file writer (see module docstring).
+
+    ``queue_depth`` bounds buffered snapshots; a full queue makes
+    :meth:`submit` block (backpressure beats unbounded host memory when the
+    disk can't keep up with the snapshot cadence).
+    """
+
+    def __init__(self, queue_depth: int = 2) -> None:
+        self._h = _load().cw_init(queue_depth)
+
+    def _handle(self):
+        # finalize() frees the C Writer; a NULL handle into the library
+        # would segfault, so the liveness check lives here in Python.
+        if not self._h:
+            raise RuntimeError("AsyncCheckpointWriter used after finalize()")
+        return self._h
+
+    def submit(self, path: str, data: bytes) -> None:
+        """Enqueue ``data`` to become the durable content of ``path``
+        (written to a temp file, fsynced, atomically renamed)."""
+        rc = _load().cw_submit(self._handle(), str(path).encode(), data,
+                               len(data))
+        if rc != 0:
+            raise RuntimeError("submit rejected (writer shutting down)")
+
+    @property
+    def pending(self) -> int:
+        """Snapshots accepted but not yet durable."""
+        return _load().cw_pending(self._handle())
+
+    def wait(self) -> None:
+        """Block until every submitted snapshot is durable; raise if any
+        write failed since the last wait."""
+        failures = _load().cw_wait(self._handle())
+        if failures:
+            raise RuntimeError(
+                f"{failures} async checkpoint write(s) failed "
+                "(disk full / permissions / path removed?)"
+            )
+
+    def finalize(self) -> None:
+        if self._h:
+            _load().cw_finalize(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort
+        try:
+            self.finalize()
+        except Exception:
+            pass

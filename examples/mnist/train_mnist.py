@@ -54,6 +54,11 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--allreduce-grad-dtype", default=None)
+    p.add_argument("--checkpoint", default=None, metavar="DIR",
+                   help="fault-tolerant snapshots every --checkpoint-interval "
+                        "iters (async native writer); resumes automatically "
+                        "from the newest snapshot all ranks share")
+    p.add_argument("--checkpoint-interval", type=int, default=50)
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(
@@ -102,6 +107,18 @@ def main(argv=None):
         _evaluate(eval_step, test, args.batchsize, comm), comm
     )
 
+    ckpt = None
+    start_iteration = 0
+    if args.checkpoint:
+        ckpt = chainermn_tpu.create_multi_node_checkpointer(
+            "mnist", comm, path=args.checkpoint
+        )
+        state, restored_it = ckpt.maybe_load(state)
+        if restored_it is not None:
+            start_iteration = restored_it
+            if comm.rank == 0:
+                print(f"resumed from iteration {restored_it}")
+
     train_iter = chainermn_tpu.create_synchronized_iterator(
         train, args.batchsize, comm, seed=1
     )
@@ -113,7 +130,19 @@ def main(argv=None):
             print("  eval:", {k: round(v, 4) for k, v in metrics.items()})
 
     trainer.extend(run_eval, interval=100)
-    state = trainer.run(args.iterations)
+    if ckpt is not None:
+        def snapshot(tr):
+            # async: serialize now, write+fsync on the C++ worker thread
+            ckpt.save(tr.state, start_iteration + tr.iteration, block=False)
+
+        trainer.extend(snapshot, interval=args.checkpoint_interval)
+    state = trainer.run(max(0, args.iterations - start_iteration))
+    if ckpt is not None:
+        # Label with the TRUE iteration: when a restore already exceeded
+        # --iterations, trainer.run did 0 steps and the weights are still
+        # start_iteration's.
+        ckpt.save(state, start_iteration + trainer.iteration, block=False)
+        ckpt.wait_async()  # durable before we report success
 
     final = evaluator(state)
     if comm.rank == 0:

@@ -150,3 +150,76 @@ def test_observation_aggregator_windowed(comm):
     assert out == {"loss": 2.0, "acc": 1.0}
     # window state resets
     assert agg({"loss": 10.0}) is None
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_roundtrip(self, comm, tmp_path):
+        """block=False saves become durable at wait_async; maybe_load drains
+        first, so an immediately-following restore sees them."""
+        from chainermn_tpu.extensions.checkpoint import (
+            create_multi_node_checkpointer,
+        )
+
+        ckpt = create_multi_node_checkpointer(
+            "async", comm, path=str(tmp_path), keep=2
+        )
+        state = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.int32(0)}
+        for it in range(1, 5):
+            ckpt.save({**state, "step": jnp.int32(it)}, it, block=False)
+        ckpt.wait_async()
+        # GC ran at drain: only `keep` newest snapshots remain
+        files = sorted(p.name for p in tmp_path.iterdir())
+        assert len(files) == 2, files
+        restored, it = ckpt.maybe_load(state)
+        assert it == 4 and int(restored["step"]) == 4
+        np.testing.assert_allclose(
+            np.asarray(restored["w"]), np.arange(6.0).reshape(2, 3)
+        )
+
+    def test_async_failure_surfaces_at_wait(self, comm, tmp_path):
+        from chainermn_tpu.extensions.checkpoint import (
+            create_multi_node_checkpointer,
+        )
+        import pytest
+
+        ckpt = create_multi_node_checkpointer(
+            "fail", comm, path=str(tmp_path), keep=0
+        )
+        state = {"w": jnp.zeros((2,))}
+        ckpt.save(state, 1, block=False)
+        ckpt.wait_async()
+        # point the next write at a non-existent directory
+        ckpt.path = str(tmp_path / "gone" / "deeper")
+        ckpt.save(state, 2, block=False)
+        with pytest.raises(RuntimeError, match="checkpoint write"):
+            ckpt.wait_async()
+
+    def test_writer_overlaps(self, tmp_path):
+        """The writer really is asynchronous: submit returns while the data
+        is still being made durable (bounded queue accepts ahead)."""
+        from chainermn_tpu.native.ckpt_writer import AsyncCheckpointWriter
+
+        w = AsyncCheckpointWriter(queue_depth=4)
+        blob = b"x" * (4 << 20)
+        for i in range(4):
+            w.submit(str(tmp_path / f"f{i}.bin"), blob)
+        # some may already be done; all must be done after wait
+        w.wait()
+        assert w.pending == 0
+        for i in range(4):
+            assert (tmp_path / f"f{i}.bin").stat().st_size == len(blob)
+        w.finalize()
+
+
+def test_async_writer_use_after_finalize_raises(tmp_path):
+    from chainermn_tpu.native.ckpt_writer import AsyncCheckpointWriter
+    import pytest
+
+    w = AsyncCheckpointWriter()
+    w.submit(str(tmp_path / "a.bin"), b"abc")
+    w.wait()
+    w.finalize()
+    with pytest.raises(RuntimeError, match="after finalize"):
+        w.submit(str(tmp_path / "b.bin"), b"abc")
+    with pytest.raises(RuntimeError, match="after finalize"):
+        w.wait()
