@@ -176,7 +176,21 @@ class MultiNodeCheckpointer:
             return fname
         tmp = fname + ".tmp.npz"
         np.savez(tmp, **arrays)
+        # fsync file AND directory before/after the rename: the blocking
+        # path is the one durability-critical callers use (the preemption
+        # guard saves right before exit), so a power-off must not be able
+        # to publish a torn snapshot (async path does the same in C++).
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         os.replace(tmp, fname)
+        dfd = os.open(self.path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self._gc()
         return fname
 
@@ -200,12 +214,30 @@ class MultiNodeCheckpointer:
         (reference: gather available iters -> max common -> deserialize,
         SURVEY.md section 3.5). Returns ``(state, iteration)`` or
         ``(state_template, None)`` when no common snapshot exists."""
-        self.wait_async()  # in-flight async saves count once durable
+        # Drain in-flight async saves so they count once durable. A raising
+        # preamble BEFORE the collective would hang the other ranks inside
+        # allgather — gather each rank's failure status along with its
+        # iterations and raise symmetrically on every rank.
+        drain_err = None
+        try:
+            self.wait_async()
+        except RuntimeError as e:
+            drain_err = str(e)
         local = set(self._local_iterations())
-        everyone = self.comm.allgather_obj(sorted(local))
-        common = set(everyone[0])
-        for its in everyone[1:]:
-            common &= set(its)
+        everyone = self.comm.allgather_obj(
+            {"its": sorted(local), "err": drain_err}
+        )
+        errs = [
+            f"rank {r}: {e['err']}" for r, e in enumerate(everyone) if e["err"]
+        ]
+        if errs:
+            raise RuntimeError(
+                "async checkpoint write failures detected at restore: "
+                + "; ".join(errs)
+            )
+        common = set(everyone[0]["its"])
+        for entry in everyone[1:]:
+            common &= set(entry["its"])
         if not common:
             return state_template, None
         it = max(common)
@@ -257,6 +289,14 @@ class MultiNodeCheckpointer:
         return jax.tree.unflatten(treedef, restored), it
 
     def cleanup(self) -> None:
+        # Drain first: an in-flight async save landing AFTER the deletes
+        # would resurrect a snapshot. Failures don't matter here — we are
+        # removing everything anyway.
+        if self._writer is not None:
+            try:
+                self._writer.wait()
+            except RuntimeError:
+                pass
         for it in self._local_iterations():
             try:
                 os.remove(self._fname(it))

@@ -113,6 +113,7 @@ def make_pipeline(
     *,
     axis_name: str = "stage",
     n_microbatches: Optional[int] = None,
+    remat_stages: bool = False,
 ):
     """Build a jitted pipelined apply over stacked stage parameters.
 
@@ -121,11 +122,20 @@ def make_pipeline(
     the full batch ``[batch, ...]``; the batch is split into
     ``n_microbatches`` equal microbatches (default: the stage count, the
     classic GPipe minimum for full utilisation... of the steady state).
+
+    ``remat_stages=True`` wraps each stage in ``jax.checkpoint``: the
+    backward replays stage compute instead of storing one activation per
+    schedule tick, dropping peak activation memory from
+    ``O(n_micro + n_stages)`` to ``O(1)`` per stage — the memory profile
+    1F1B schedules buy on GPU, obtained here by recompute (the idiomatic
+    XLA trade: the schedule stays one scan, the compiler keeps fusing).
     """
     from jax import shard_map
 
     n_stages = mesh.shape[axis_name]
     n_micro = n_microbatches or n_stages
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
 
     param_spec = P(axis_name)
     x_spec = P()  # replicated; stage 0 reads it

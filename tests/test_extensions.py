@@ -200,10 +200,12 @@ class TestAsyncCheckpoint:
         from chainermn_tpu.native.ckpt_writer import AsyncCheckpointWriter
 
         w = AsyncCheckpointWriter(queue_depth=4)
-        blob = b"x" * (4 << 20)
+        blob = b"x" * (32 << 20)
         for i in range(4):
             w.submit(str(tmp_path / f"f{i}.bin"), blob)
-        # some may already be done; all must be done after wait
+        # asynchrony pinned: 128 MB of fsync cannot all be durable by the
+        # time the submits return — some work must still be pending.
+        assert w.pending > 0
         w.wait()
         assert w.pending == 0
         for i in range(4):

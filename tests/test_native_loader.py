@@ -49,10 +49,14 @@ def test_epoch_covers_every_record_once(dataset):
     )
     assert dl.batches_per_epoch == N // 8
     # Workers may interleave batches across the epoch boundary; group by
-    # the batch's epoch tag and account for epoch 0 exactly.
+    # the batch's epoch tag and account for epoch 0 exactly. The bound is
+    # generous (20 epochs of nexts): under full-suite CPU contention a
+    # worker holding one epoch-0 batch can be starved for several epochs of
+    # other workers' output before the scheduler runs it (observed flake at
+    # a 3-epoch bound).
     seen = []
     epoch0_batches = 0
-    for _ in range(3 * dl.batches_per_epoch):
+    for _ in range(20 * dl.batches_per_epoch):
         batch = next(dl)
         if dl.epoch == 0:
             seen.extend(batch["label"].tolist())
