@@ -124,11 +124,13 @@ def make_pipeline(
     classic GPipe minimum for full utilisation... of the steady state).
 
     ``remat_stages=True`` wraps each stage in ``jax.checkpoint``: the
-    backward replays stage compute instead of storing one activation per
-    schedule tick, dropping peak activation memory from
-    ``O(n_micro + n_stages)`` to ``O(1)`` per stage — the memory profile
-    1F1B schedules buy on GPU, obtained here by recompute (the idiomatic
-    XLA trade: the schedule stays one scan, the compiler keeps fusing).
+    backward recomputes each stage's INTERNAL activations instead of
+    storing them per schedule tick. The per-tick stage *inputs* are still
+    saved by the scan (``O(n_micro + n_stages)`` boundary tensors — that
+    part is inherent to replaying the schedule), so the saving scales with
+    stage depth: deep stages drop from "every intermediate per tick" to
+    "one boundary tensor per tick" — activation checkpointing per
+    microbatch, not a full 1F1B scheduler.
     """
     from jax import shard_map
 

@@ -102,18 +102,12 @@ class TestPipeline:
 def test_remat_stages_matches_plain(comm):
     """remat_stages recomputes in the backward; values and grads must be
     identical to the stored-activation schedule."""
-    import numpy as np
-
     from chainermn_tpu.parallel.pipeline import (
         make_pipeline,
         stack_stage_params,
     )
 
-    import numpy as _np
-    from jax.sharding import Mesh
-
     n = comm.size
-    mesh = Mesh(_np.array(jax.devices("cpu")[:n]), ("stage",))
     d = 4
 
     def stage_fn(w, x):
@@ -128,9 +122,10 @@ def test_remat_stages_matches_plain(comm):
     def loss(pipe):
         return lambda p, x: jnp.mean(pipe(p, x) ** 2)
 
-    plain = make_pipeline(stage_fn, mesh, n_microbatches=n)
-    remat = make_pipeline(stage_fn, mesh, n_microbatches=n,
-                          remat_stages=True)
+    plain = make_pipeline(stage_fn, comm.mesh, axis_name=comm.axis_name,
+                          n_microbatches=n)
+    remat = make_pipeline(stage_fn, comm.mesh, axis_name=comm.axis_name,
+                          n_microbatches=n, remat_stages=True)
     l1, g1 = jax.value_and_grad(loss(plain))(stacked, x)
     l2, g2 = jax.value_and_grad(loss(remat))(stacked, x)
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
