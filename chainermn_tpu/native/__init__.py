@@ -37,14 +37,37 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+def _build_dir() -> Path:
+    """Writable build-cache directory: the package's own ``build/`` when the
+    install is writable (dev checkouts), else a per-user cache — a root-
+    installed wheel in read-only site-packages must still compile on demand
+    for unprivileged users."""
+    try:
+        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+        if os.access(_BUILD_DIR, os.W_OK):
+            return _BUILD_DIR
+    except OSError:
+        pass
+    cache = Path(
+        os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache")
+    ) / "chainermn_tpu" / "native"
+    try:
+        cache.mkdir(parents=True, exist_ok=True)
+    except OSError as e:
+        raise NativeBuildError(
+            f"no writable build dir ({_BUILD_DIR} and {cache} both failed: {e})"
+        ) from e
+    return cache
+
+
 def lib_path(name: str = "host_comm", rebuild: bool = False) -> Path:
     """Path to a compiled native component, building it on demand."""
     src_name, flags = _COMPONENTS[name]
     src = _SRC_DIR / src_name
-    lib = _BUILD_DIR / f"lib{name.replace('_', '')}.so"
+    build_dir = _build_dir()
+    lib = build_dir / f"lib{name.replace('_', '')}.so"
     if lib.exists() and not rebuild and lib.stat().st_mtime >= src.stat().st_mtime:
         return lib
-    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O2", "-shared", "-fPIC", "-Wall", *flags,

@@ -60,6 +60,12 @@ def main(argv=None):
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--iterations", type=int, default=100)
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--optimizer", default="sgd",
+                   choices=["sgd", "lars", "lamb"],
+                   help="sgd+momentum (default) or the large-batch "
+                        "layer-adaptive optimizers — the regime the "
+                        "reference's 15-minute/32K-batch ImageNet runs "
+                        "lived in (arXiv:1711.04325)")
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--allreduce-grad-dtype", default="bfloat16")
     p.add_argument("--stem", default="standard",
@@ -157,8 +163,13 @@ def main(argv=None):
         acc = (logits.argmax(-1) == yb).mean()
         return loss, ({"accuracy": acc}, mutated.get("batch_stats", ()))
 
+    inner_opt = {
+        "sgd": lambda: optax.sgd(args.lr, momentum=0.9),
+        "lars": lambda: optax.lars(args.lr),
+        "lamb": lambda: optax.lamb(args.lr),
+    }[args.optimizer]()
     optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(args.lr, momentum=0.9),
+        inner_opt,
         comm,
         double_buffering=args.double_buffering,
     )
