@@ -18,6 +18,7 @@ from chainermn_tpu.communicators.xla_communicator import (
     HierarchicalCommunicator,
     NaiveCommunicator,
     SingleNodeCommunicator,
+    TwoDimensionalCommunicator,
     XlaCommunicator,
 )
 
@@ -29,7 +30,8 @@ _REGISTRY = {
     "flat": XlaCommunicator,            # flat fused buffer == what XLA emits
     "pure_nccl": XlaCommunicator,       # all-ranks single collective == psum
     "hierarchical": HierarchicalCommunicator,
-    "two_dimensional": HierarchicalCommunicator,  # 2-level ring == XLA's own
+    # explicit intra-RS -> inter-AR -> intra-AG pipeline (reference algo)
+    "two_dimensional": TwoDimensionalCommunicator,
     "non_cuda_aware": HierarchicalCommunicator,   # host staging is moot on TPU
     "single_node": SingleNodeCommunicator,
 }
@@ -64,5 +66,6 @@ __all__ = [
     "XlaCommunicator",
     "NaiveCommunicator",
     "HierarchicalCommunicator",
+    "TwoDimensionalCommunicator",
     "SingleNodeCommunicator",
 ]

@@ -303,6 +303,22 @@ class CommunicatorBase:
         repl = NamedSharding(self.mesh, P())
         return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), repl), params)
 
+    def reduce_gradients_in_jit(
+        self, grads: PyTree, *, compress_dtype=None
+    ) -> PyTree:
+        """The IN-JIT gradient reduction this communicator's strategy uses —
+        called from the train step / optimizer wrapper inside the named-axis
+        context. Base strategy: one fused ``pmean`` over ``grad_axes`` (XLA
+        derives the topology-aware schedule). Subclasses may pin an explicit
+        algorithm (:class:`TwoDimensionalCommunicator`)."""
+        from chainermn_tpu.optimizers import allreduce_gradients
+
+        if compress_dtype is None:
+            compress_dtype = self.allreduce_grad_dtype
+        return allreduce_gradients(
+            grads, axis_names=self.grad_axes, compress_dtype=compress_dtype
+        )
+
     def allreduce_grad(self, grads: PyTree, op: str = "mean") -> PyTree:
         """Eager gradient allreduce of *stacked* per-rank grads
         (leaves shaped ``[size, ...]``) → averaged pytree ``[...]``.

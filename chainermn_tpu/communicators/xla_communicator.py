@@ -13,7 +13,7 @@ kept as registry aliases so reference users find what they expect
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import jax
 import numpy as np
@@ -21,6 +21,8 @@ from jax.sharding import Mesh
 
 from chainermn_tpu.communicators.base import CommunicatorBase
 from chainermn_tpu.parallel.mesh import make_mesh
+
+PyTree = Any
 
 
 class XlaCommunicator(CommunicatorBase):
@@ -98,6 +100,51 @@ class HierarchicalCommunicator(CommunicatorBase):
     @property
     def axis_name(self) -> str:  # primary axis for data parallelism
         return "inter"
+
+
+class TwoDimensionalCommunicator(HierarchicalCommunicator):
+    """Hierarchical mesh with the EXPLICIT bandwidth-optimal reduction: the
+    gradient pipeline is intra ``psum_scatter`` → inter allreduce of the
+    1/n shard → intra ``all_gather``, pinned in the program rather than
+    left to XLA's schedule derivation — the reference's
+    ``TwoDimensionalCommunicator`` algorithm
+    (``two_dimensional_communicator.py`` (dagger): intra
+    ``ncclReduceScatter`` → inter MPI allreduce → intra ``ncclAllGather``).
+    Numerically identical to the hierarchical pmean (tested)."""
+
+    name = "two_dimensional"
+
+    def reduce_gradients_in_jit(
+        self, grads: PyTree, *, compress_dtype=None
+    ) -> PyTree:
+        import jax.numpy as jnp
+
+        from chainermn_tpu.parallel.collectives import two_level_allreduce
+
+        if compress_dtype is None:
+            compress_dtype = self.allreduce_grad_dtype
+        # Axes come from the mesh (a custom mesh= names them differently).
+        inter_ax, intra_ax = self.grad_axes
+
+        def reduce_leaf(g):
+            cast = (
+                g.astype(compress_dtype)
+                if compress_dtype is not None
+                and jnp.issubdtype(g.dtype, jnp.floating)
+                else g
+            )
+            return two_level_allreduce(cast, intra_ax, inter_ax).astype(
+                g.dtype
+            )
+
+        try:
+            return jax.tree.map(reduce_leaf, grads)
+        except NameError:
+            # Outside the named-axis context (auto-SPMD jit / single-device
+            # eager) — same tolerant degradation as the base pmean path.
+            return super().reduce_gradients_in_jit(
+                grads, compress_dtype=compress_dtype
+            )
 
 
 class SingleNodeCommunicator(XlaCommunicator):

@@ -68,9 +68,10 @@ def allreduce_gradients(
     if axis_names is None:
         if comm is None:
             raise ValueError("pass a communicator or axis_names")
-        axis_names = comm.grad_axes
-        if compress_dtype is None:
-            compress_dtype = comm.allreduce_grad_dtype
+        # Strategy dispatch: the communicator owns its in-jit reduction
+        # algorithm (base: fused pmean; two_dimensional: explicit
+        # reduce-scatter -> inter-allreduce -> all-gather).
+        return comm.reduce_gradients_in_jit(grads, compress_dtype=compress_dtype)
 
     def reduce_leaf(g):
         if compress_dtype is not None and jnp.issubdtype(g.dtype, jnp.floating):

@@ -148,6 +148,34 @@ def alltoall(x: jax.Array, axis_name: str, *, split_axis: int = 0,
     )
 
 
+def two_level_allreduce(
+    x: jax.Array, intra_axis: str, inter_axis: str, *, op: str = "mean"
+) -> jax.Array:
+    """Bandwidth-optimal two-level allreduce, written out explicitly:
+    intra-level ``psum_scatter`` → inter-level ``psum`` of the 1/n shard →
+    intra-level ``all_gather``. Each intra member moves only its shard over
+    the slow inter links — the reference's ``TwoDimensionalCommunicator``
+    algorithm (intra ``ncclReduceScatter`` → inter MPI allreduce → intra
+    ``ncclAllGather``, ``two_dimensional_communicator.py`` (dagger)),
+    expressed in named-axis collectives. XLA usually derives an equivalent
+    schedule from a plain 2-axis psum; this explicit form pins it.
+    """
+    n_intra = lax.axis_size(intra_axis)
+    flat = x.reshape(-1)
+    c = -(-flat.size // n_intra)  # ceil: pad so rows split evenly
+    rows = jnp.pad(flat, (0, n_intra * c - flat.size)).reshape(n_intra, c)
+    shard = lax.psum_scatter(
+        rows, intra_axis, scatter_dimension=0, tiled=False
+    )  # [c] — the intra-sum of this member's 1/n slice
+    shard = lax.psum(shard, inter_axis)
+    if op == "mean":
+        shard = shard / (n_intra * lax.axis_size(inter_axis))
+    elif op != "sum":
+        raise ValueError(f"op must be 'sum' or 'mean', got {op!r}")
+    rows = lax.all_gather(shard, intra_axis, axis=0, tiled=False)
+    return rows.reshape(-1)[: flat.size].reshape(x.shape)
+
+
 def shift(x: PyTree, axis_name: str, offset: int = 1) -> PyTree:
     """Rotate values around the axis ring by ``offset`` (ring-attention KV
     rotation step). Positive offset sends shard i's value to shard i+offset."""

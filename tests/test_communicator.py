@@ -179,3 +179,80 @@ def test_grad_axes_names():
     assert _make("xla").grad_axes == ("data",)
     assert _make("hierarchical").grad_axes == ("inter", "intra")
     assert _make("hierarchical").axis_name == "inter"
+
+
+class TestTwoDimensional:
+    """two_dimensional is no longer an alias: its gradient reduction is the
+    explicit intra reduce-scatter -> inter allreduce -> intra all-gather
+    pipeline, and must equal the fused pmean bit-for-bit-ish."""
+
+    def test_two_level_allreduce_matches_pmean(self):
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from chainermn_tpu.parallel.collectives import two_level_allreduce
+
+        devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("inter", "intra"))
+        # odd leaf sizes exercise the pad/unpad path
+        for shape in [(5,), (3, 7), (1,), (16, 16)]:
+            x = jnp.asarray(
+                np.random.RandomState(0).randn(8, *shape), jnp.float32
+            )
+
+            def explicit(xl):
+                return two_level_allreduce(xl[0], "intra", "inter")[None]
+
+            def fused(xl):
+                return jax.lax.pmean(xl[0], ("inter", "intra"))[None]
+
+            spec = P(("inter", "intra"), *([None] * len(shape)))
+            run = lambda f: jax.jit(shard_map(  # noqa: E731
+                f, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+            ))(x)
+            np.testing.assert_allclose(
+                np.asarray(run(explicit)), np.asarray(run(fused)),
+                rtol=1e-6, atol=1e-7,
+            )
+
+    def test_train_step_matches_xla_communicator(self):
+        import optax
+
+        from chainermn_tpu import (
+            create_communicator,
+            create_multi_node_optimizer,
+        )
+        from chainermn_tpu.models import MLP
+        from chainermn_tpu.training.train_step import (
+            create_train_state,
+            make_train_step,
+        )
+
+        model = MLP(n_units=16, n_out=4)
+        x = jnp.asarray(np.random.RandomState(1).randn(16, 10), jnp.float32)
+        y = jnp.asarray(np.arange(16) % 4, jnp.int32)
+        params = model.init(jax.random.key(0), x[:1])["params"]
+
+        def loss_fn(p, batch):
+            xb, yb = batch
+            logits = model.apply({"params": p}, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb
+            ).mean()
+
+        results = {}
+        for name in ("xla", "two_dimensional"):
+            comm = create_communicator(name, devices=jax.devices("cpu")[:8])
+            opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+            state = create_train_state(params, opt, comm)
+            step = make_train_step(loss_fn, opt, comm, donate=False)
+            for _ in range(3):
+                state, m = step(state, (x, y))
+            results[name] = (
+                jax.tree.leaves(jax.device_get(state.params)),
+                float(m["loss"]),
+            )
+        for a, b in zip(results["xla"][0], results["two_dimensional"][0]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        assert abs(results["xla"][1] - results["two_dimensional"][1]) < 1e-6

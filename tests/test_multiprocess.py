@@ -62,6 +62,13 @@ def test_mp_array_p2p():
     )
 
 
+def test_mp_fsdp_ring():
+    """Declarative FSDP sharding and the flash ring attention with the
+    process boundary inside the mesh — collectives ride gloo, not just
+    local device transfers."""
+    run_workers("fsdp_ring", n_procs=2, local_devices=2, timeout=300)
+
+
 def test_mp_preemption(tmp_path):
     """SIGTERM on one rank → all ranks checkpoint the same iteration and
     exit 0 (the slice-preemption story, SURVEY §5)."""
