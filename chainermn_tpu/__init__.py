@@ -16,7 +16,8 @@ Public API (mirrors the reference package surface, see SURVEY.md section 2):
 - :mod:`chainermn_tpu.functions` — differentiable cross-rank send/recv and
   collective functions (``chainermn/functions/`` (dagger)).
 - :mod:`chainermn_tpu.links` — ``MultiNodeChainList``,
-  ``MultiNodeBatchNormalization`` (``chainermn/links/`` (dagger)).
+  ``MultiNodeBatchNormalization``, ``create_mnbn_model``
+  (``chainermn/links/`` (dagger)).
 - :mod:`chainermn_tpu.extensions` — multi-node evaluator, fault-tolerant
   checkpointer (``chainermn/extensions/`` (dagger)).
 

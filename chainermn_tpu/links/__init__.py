@@ -5,5 +5,10 @@ Reference: ``chainermn/links/`` (dagger) (SURVEY.md section 2.5).
 
 from chainermn_tpu.links.multi_node_chain_list import MultiNodeChainList
 from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
+from chainermn_tpu.links.mnbn import create_mnbn_model
 
-__all__ = ["MultiNodeChainList", "MultiNodeBatchNormalization"]
+__all__ = [
+    "MultiNodeChainList",
+    "MultiNodeBatchNormalization",
+    "create_mnbn_model",
+]

@@ -1,0 +1,155 @@
+"""``create_mnbn_model`` — convert every BatchNorm in a model to
+synchronized (multi-node) batch normalization.
+
+Reference: ``chainermn/links/create_mnbn_model.py`` (dagger) (SURVEY.md
+section 2.5 family): upstream walks a Chainer link tree and rebuilds it
+with every ``L.BatchNormalization`` replaced by
+``MultiNodeBatchNormalization`` so an existing single-node model becomes
+global-batch-correct without edits.
+
+TPU-native design: flax modules are built inside ``setup``/``@nn.compact``,
+so there is no static link tree to rewrite. Instead of reconstructing the
+model, the conversion intercepts module calls (``nn.intercept_methods``)
+and gives every batch-norm layer whose ``axis_name`` is unset the
+communicator's data axis for the duration of the call — flax's own
+``nn.BatchNorm`` (and ours) already compute global statistics when an
+``axis_name`` is present, so "replacement" reduces to axis injection. The
+wrapper shares its scope with the wrapped model (``nn.share_scope``), so
+parameters, collections, and checkpoints keep the exact same tree paths as
+the unconverted model: it is a drop-in, both ways.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
+from chainermn_tpu.parallel.collectives import axes_bound
+
+_BN_TYPES = (nn.BatchNorm, MultiNodeBatchNormalization)
+
+
+def _bn_sync_interceptor(axis_name):
+    """Give BN layers with no ``axis_name`` the data axis for one call.
+
+    The attribute is restored afterwards — module instances are reused
+    across calls and transforms, so the override must not leak outside the
+    converted model's forward.
+    """
+
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        # axes_bound: run OUTSIDE shard_map (local debugging, single-device
+        # eval) the converted model degrades to plain-BN behavior instead
+        # of raising an unbound-axis NameError.
+        if (
+            context.method_name == "__call__"
+            and isinstance(mod, _BN_TYPES)
+            and mod.axis_name is None
+            and axes_bound(axis_name)
+        ):
+            object.__setattr__(mod, "axis_name", axis_name)
+            try:
+                return next_fun(*args, **kwargs)
+            finally:
+                object.__setattr__(mod, "axis_name", None)
+        return next_fun(*args, **kwargs)
+
+    return interceptor
+
+
+class _MnbnModel(nn.Module):
+    """The converted model. Transparent: same call signature, same
+    parameter/collection tree paths as ``inner`` (scope is shared), and
+    auxiliary methods pass through — ``apply(..., method='encode')`` works
+    on the converted model with BN layers inside ``encode`` synchronized
+    (upstream converted the whole link tree, so every entry point stayed
+    synchronized; the delegation below preserves that contract)."""
+
+    inner: nn.Module
+    sync_axis: Any
+
+    def setup(self):
+        nn.share_scope(self, self.inner)
+
+    def __call__(self, *args, **kwargs):
+        with nn.intercept_methods(_bn_sync_interceptor(self.sync_axis)):
+            return self.inner(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            pass
+        # Guard the delegation base itself: during unpickling/deepcopy the
+        # stdlib probes dunders on a __new__-created instance whose fields
+        # aren't set yet — falling through to self.inner would re-enter
+        # this __getattr__ forever.
+        if name in ("inner", "sync_axis") or "inner" not in vars(self):
+            raise AttributeError(name)
+        # Dataclass fields pass through as VALUES even when the value is
+        # callable (dtype classes, initializer functions): only genuine
+        # methods get the interception delegate.
+        if name in {f.name for f in dataclasses.fields(type(self.inner))}:
+            return getattr(self.inner, name)
+        if not callable(getattr(type(self.inner), name, None)):
+            return getattr(self.inner, name)
+
+        # flax resolves string `method=` names on the UNBOUND template and
+        # calls the result with the BOUND module prepended — re-resolve
+        # `inner` from that bound instance. A direct `bound.method(x)` call
+        # happens on an already-bound instance (scope set) and prepends
+        # nothing, so there the instance looked up on IS the receiver —
+        # even when the method's first real argument happens to be another
+        # converted model.
+        looked_up_on_bound = getattr(self, "scope", None) is not None
+
+        def _delegated(*args, **kwargs):
+            if (
+                not looked_up_on_bound
+                and args
+                and isinstance(args[0], _MnbnModel)
+            ):
+                mod_self, args = args[0], args[1:]
+            else:
+                mod_self = self
+            # flax only runs setup() when one of the module's OWN wrapped
+            # methods executes; this delegate bypasses that, so trigger it
+            # here — share_scope must be in effect before inner runs, or
+            # parameters resolve under an '/inner/...' scope that init
+            # never populated.
+            mod_self._try_setup()
+            with nn.intercept_methods(_bn_sync_interceptor(mod_self.sync_axis)):
+                return getattr(mod_self.inner, name)(*args, **kwargs)
+
+        return _delegated
+
+
+def create_mnbn_model(
+    model: nn.Module,
+    comm: Optional[CommunicatorBase] = None,
+    *,
+    axis_name: Any = None,
+) -> nn.Module:
+    """Return ``model`` with every batch-norm layer synchronized over the
+    communicator's data-parallel axis (or an explicit ``axis_name``).
+
+    Matches the reference's contract (``create_mnbn_model(link, comm)``
+    (dagger)): the returned model is used exactly like the original —
+    same ``init``/``apply`` signature, same parameter tree — but batch
+    statistics are computed over the GLOBAL batch when the forward runs
+    inside a ``shard_map``/mesh context carrying that axis. Layers that
+    already have an ``axis_name`` are left untouched.
+    """
+    if (comm is None) == (axis_name is None):
+        raise ValueError("pass exactly one of comm or axis_name")
+    if comm is not None:
+        axis_name = comm.bn_axis_name
+    return _MnbnModel(inner=model, sync_axis=axis_name)
+
+
+__all__ = ["create_mnbn_model"]

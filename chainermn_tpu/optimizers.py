@@ -44,10 +44,11 @@ def _pmean_if_in_axis(tree: PyTree, axis_names) -> PyTree:
     """pmean over ``axis_names`` when tracing inside that named-axis context
     (shard_map/pmap); identity otherwise (pjit auto-parallel mode, where XLA
     inserts the reduction from sharding propagation, or single-device)."""
-    try:
-        return lax.pmean(tree, axis_names)
-    except NameError:
+    from chainermn_tpu.parallel.collectives import axes_bound
+
+    if not axes_bound(axis_names):
         return tree
+    return lax.pmean(tree, axis_names)
 
 
 def allreduce_gradients(

@@ -47,6 +47,14 @@ def __getattr__(name):
         from chainermn_tpu.parallel import fsdp as _f
 
         return getattr(_f, name)
+    if name in (
+        "copy_to_tp", "reduce_from_tp", "gather_from_tp", "tp_slice", "stack_tp_params",
+        "column_parallel_dense", "row_parallel_dense", "tp_mlp",
+        "tp_attention",
+    ):
+        from chainermn_tpu.parallel import tensor as _t
+
+        return getattr(_t, name)
     raise AttributeError(name)
 
 
@@ -70,4 +78,13 @@ __all__ = [
     "fsdp_shardings",
     "create_fsdp_train_state",
     "make_fsdp_train_step",
+    "copy_to_tp",
+    "reduce_from_tp",
+    "gather_from_tp",
+    "tp_slice",
+    "stack_tp_params",
+    "column_parallel_dense",
+    "row_parallel_dense",
+    "tp_mlp",
+    "tp_attention",
 ]

@@ -148,6 +148,26 @@ def alltoall(x: jax.Array, axis_name: str, *, split_axis: int = 0,
     )
 
 
+def axes_bound(axis_names) -> bool:
+    """Whether every named mesh axis in ``axis_names`` (a name or a
+    name-sequence) is bound in the current trace. The degrade-gracefully
+    probe shared by the optimizer's pmean, the two-dimensional
+    communicator's packed reduction, and ``create_mnbn_model``'s BN axis
+    injection: outside ``shard_map``/``pmap`` these fall back to local
+    semantics instead of raising the unbound-axis NameError."""
+    names = (
+        axis_names
+        if isinstance(axis_names, (tuple, list))
+        else (axis_names,)
+    )
+    try:
+        for name in names:
+            lax.axis_size(name)
+    except NameError:
+        return False
+    return True
+
+
 def two_level_allreduce(
     x: jax.Array, intra_axis: str, inter_axis: str, *, op: str = "mean"
 ) -> jax.Array:

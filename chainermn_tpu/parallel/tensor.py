@@ -1,0 +1,256 @@
+"""Tensor (intra-layer model) parallelism over a ``'model'`` mesh axis.
+
+The reference's tensor-parallel story was a single channel-split
+convolution example (``examples/parallel_convolution`` (dagger), SURVEY.md
+section 2.2 "Tensor/channel parallel — narrow"); splitting a *layer* across
+ranks otherwise required hand-wiring send/recv functions. This module is
+the general library form, built the TPU way: Megatron-style column/row
+parallel layers as pure functions inside ``shard_map``, with exactly one
+``psum`` per column→row pair and the activation between them never
+materialised unsharded. On TPU the collective rides ICI, which is what
+makes intra-layer sharding practical at all.
+
+Two identity/collective adjoint pairs do all the gradient bookkeeping
+(Megatron's ``f``/``g`` operators):
+
+- :func:`copy_to_tp` — forward identity, backward ``psum``. Placed where a
+  replicated activation fans out to per-shard weight columns, so the
+  replicated input's gradient sums every shard's contribution.
+- :func:`reduce_from_tp` — forward ``psum``, backward identity. Placed
+  where per-shard partial products recombine, so the gradient broadcast is
+  free.
+
+Everything composes with the data-parallel optimizer wrapper unchanged:
+column/row shard weights get per-shard gradients (no reduction over the
+model axis), replicated weights (biases after the reduce, layer norms)
+receive bitwise-identical gradients on every model shard, so
+``comm.grad_axes`` (data axes only) stays the correct reduction set.
+
+Usage contract: differentiate INSIDE ``shard_map`` (``jax.value_and_grad``
+of the shard-local loss — the pattern every train step in this framework
+uses). The adjoint pairs make shard-local autodiff globally exact; taking
+gradients *through* the shard_map boundary with ``check_vma=False`` is not
+supported (the boundary transpose rescales cotangents of replicated
+arguments).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ---------------------------------------------------------------------------
+# Megatron f/g adjoint pairs
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp(x: jax.Array, axis_name) -> jax.Array:
+    """Identity forward; ``psum`` over ``axis_name`` backward.
+
+    Wrap a replicated activation before it meets column-sharded weights:
+    each shard then computes an independent cotangent slice and the true
+    input gradient is their sum.
+    """
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+copy_to_tp.defvjp(_copy_fwd, _copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp(x: jax.Array, axis_name) -> jax.Array:
+    """``psum`` over ``axis_name`` forward; identity backward.
+
+    Recombines per-shard partial products (row-parallel matmul outputs);
+    the reduced value is replicated, so its gradient needs no collective.
+    """
+    return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tp.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_tp(x: jax.Array, axis_name, dim: int) -> jax.Array:
+    """All-gather shard blocks along ``dim`` forward; slice this shard's
+    block out of the cotangent backward (Megatron's gather adjoint).
+
+    ``lax.all_gather``'s default transpose is a reduce-scatter, which SUMS
+    the replicated cotangents across shards — correct only when each
+    shard's cotangent is its own independent contribution. After a gather
+    the cotangent is replicated, so the sum overcounts by the axis size;
+    slicing is the true adjoint.
+    """
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _gather_fwd(x, axis_name, dim):
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True), x.shape[dim]
+
+
+def _gather_bwd(axis_name, dim, local_size, g):
+    start = lax.axis_index(axis_name) * local_size
+    return (lax.dynamic_slice_in_dim(g, start, local_size, dim),)
+
+
+gather_from_tp.defvjp(_gather_fwd, _gather_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Parameter sharding helpers
+# ---------------------------------------------------------------------------
+
+
+def tp_slice(w: jax.Array, axis_name, dim: int) -> jax.Array:
+    """This shard's slice of a full weight along ``dim`` (inside
+    ``shard_map``). ``dim`` must divide evenly by the axis size — TPU
+    tiling wants equal static shards; pad upstream if it doesn't."""
+    n = lax.axis_size(axis_name)
+    size = w.shape[dim]
+    if size % n != 0:
+        raise ValueError(
+            f"dim {dim} of shape {w.shape} not divisible by mesh axis "
+            f"size {n}; pad the layer width"
+        )
+    local = size // n
+    return lax.dynamic_slice_in_dim(w, lax.axis_index(axis_name) * local, local, dim)
+
+
+def stack_tp_params(full: jax.Array, n: int, dim: int) -> jax.Array:
+    """Pre-split a full weight into ``[n, ...]`` stacked shards along
+    ``dim`` (host-side; feed through ``shard_map`` with ``P('model')`` on
+    the leading axis)."""
+    parts = jnp.split(full, n, axis=dim)
+    return jnp.stack(parts, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Parallel layers (pure functions, shard_map-local)
+# ---------------------------------------------------------------------------
+
+
+def column_parallel_dense(
+    x: jax.Array,
+    w_local: jax.Array,  # [d_in, d_out // n]
+    b_local: Optional[jax.Array] = None,  # [d_out // n]
+    *,
+    axis_name,
+    gather_output: bool = False,
+) -> jax.Array:
+    """Output-dimension-sharded dense layer. Input replicated; output is
+    this shard's column block (or gathered when ``gather_output``)."""
+    x = copy_to_tp(x, axis_name)
+    y = x @ w_local
+    if b_local is not None:
+        y = y + b_local
+    if gather_output:
+        y = gather_from_tp(y, axis_name, y.ndim - 1)
+    return y
+
+
+def row_parallel_dense(
+    x_local: jax.Array,  # [..., d_in // n] — typically a column layer's output
+    w_local: jax.Array,  # [d_in // n, d_out]
+    b: Optional[jax.Array] = None,  # [d_out], replicated; added AFTER the reduce
+    *,
+    axis_name,
+) -> jax.Array:
+    """Input-dimension-sharded dense layer; the single ``psum`` of the
+    column→row pair lives here."""
+    y = reduce_from_tp(x_local @ w_local, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(
+    x: jax.Array,
+    w1_local: jax.Array,  # [d, d_ff // n]
+    b1_local: Optional[jax.Array],
+    w2_local: jax.Array,  # [d_ff // n, d]
+    b2: Optional[jax.Array],
+    *,
+    axis_name,
+    activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu,
+) -> jax.Array:
+    """The transformer MLP block, hidden dimension sharded: column dense →
+    activation (on the shard-local hidden slice) → row dense. One forward
+    ``psum``, one backward ``psum`` total."""
+    h = column_parallel_dense(x, w1_local, b1_local, axis_name=axis_name)
+    return row_parallel_dense(activation(h), w2_local, b2, axis_name=axis_name)
+
+
+def tp_attention(
+    x: jax.Array,  # [batch, seq, d_model], replicated over the model axis
+    wq_local: jax.Array,  # [d_model, d_model // n] — heads sharded
+    wk_local: jax.Array,
+    wv_local: jax.Array,
+    wo_local: jax.Array,  # [d_model // n, d_model]
+    *,
+    axis_name,
+    n_heads: int,
+    causal: bool = False,
+) -> jax.Array:
+    """Multi-head attention with heads sharded over the model axis (each
+    shard owns ``n_heads / n`` complete heads — head count must divide).
+    QKV projections are column-parallel, the attention itself is purely
+    local to the shard's heads (delegated to
+    :func:`chainermn_tpu.ops.attention.dot_product_attention` — one
+    implementation to maintain, f32 accumulation included), and the output
+    projection is row-parallel: one ``psum`` for the whole block."""
+    from chainermn_tpu.ops.attention import dot_product_attention
+
+    n = lax.axis_size(axis_name)
+    if n_heads % n != 0:
+        raise ValueError(f"n_heads={n_heads} not divisible by axis size {n}")
+    heads_local = n_heads // n
+    b, t, d_model = x.shape
+    if d_model % n_heads != 0:
+        raise ValueError(
+            f"d_model={d_model} not divisible by n_heads={n_heads}"
+        )
+    head_dim = d_model // n_heads
+
+    xc = copy_to_tp(x, axis_name)
+    q = (xc @ wq_local).reshape(b, t, heads_local, head_dim)
+    k = (xc @ wk_local).reshape(b, t, heads_local, head_dim)
+    v = (xc @ wv_local).reshape(b, t, heads_local, head_dim)
+
+    ctx = dot_product_attention(q, k, v, causal=causal)
+    ctx = ctx.reshape(b, t, heads_local * head_dim)
+    return row_parallel_dense(ctx, wo_local, axis_name=axis_name)
+
+
+__all__ = [
+    "copy_to_tp",
+    "reduce_from_tp",
+    "gather_from_tp",
+    "tp_slice",
+    "stack_tp_params",
+    "column_parallel_dense",
+    "row_parallel_dense",
+    "tp_mlp",
+    "tp_attention",
+]

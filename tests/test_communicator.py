@@ -215,6 +215,56 @@ class TestTwoDimensional:
                 rtol=1e-6, atol=1e-7,
             )
 
+    def test_packed_reduction_mixed_dtypes_matches_base(self):
+        """The flat-buffer pack (one collective pipeline per dtype group,
+        reference ``_memory_utility.pack_params`` (dagger)) must equal the
+        base fused-pmean path on a tree mixing f32/bf16-compressed leaves,
+        an int leaf, odd shapes, and a scalar."""
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from chainermn_tpu.communicators.xla_communicator import (
+            TwoDimensionalCommunicator,
+        )
+
+        devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("inter", "intra"))
+        comm = TwoDimensionalCommunicator(mesh=mesh)
+
+        rng = np.random.RandomState(5)
+        tree = {
+            "w": jnp.asarray(rng.randn(8, 3, 7), jnp.float32),
+            "b": jnp.asarray(rng.randn(8, 5), jnp.float32),
+            "scalar": jnp.asarray(rng.randn(8), jnp.float32),
+            "count": jnp.asarray(np.arange(8 * 4).reshape(8, 4), jnp.int32),
+        }
+
+        def run(fn):
+            def local(t):
+                squeezed = jax.tree.map(lambda l: l[0], t)
+                out = fn(squeezed)
+                return jax.tree.map(lambda l: l[None], out)
+
+            spec = jax.tree.map(lambda l: P(("inter", "intra"),
+                                            *([None] * (l.ndim - 1))), tree)
+            return jax.jit(shard_map(
+                local, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                check_vma=False,
+            ))(tree)
+
+        packed = run(lambda t: comm.reduce_gradients_in_jit(
+            t, compress_dtype=jnp.bfloat16))
+        base = run(lambda t: super(
+            TwoDimensionalCommunicator, comm
+        ).reduce_gradients_in_jit(t, compress_dtype=jnp.bfloat16))
+        for k in tree:
+            np.testing.assert_allclose(
+                np.asarray(packed[k]), np.asarray(base[k]),
+                rtol=1e-2, atol=1e-2,  # bf16 compression noise
+                err_msg=k,
+            )
+            assert packed[k].dtype == base[k].dtype, k
+
     def test_train_step_matches_xla_communicator(self):
         import optax
 

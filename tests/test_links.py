@@ -266,3 +266,205 @@ def test_chain_list_compute_gating_is_true_conditional(comm):
     assert not re.search(r"select\(f32\[4,(32|8)\]", txt), (
         "stage outputs selected from both branches — compute not distributed"
     )
+
+
+# ---------------------------------------------------------------------------
+# create_mnbn_model
+# ---------------------------------------------------------------------------
+
+
+class _PlainBnNet(nn.Module):
+    """A single-node model using stock flax BatchNorm — the conversion
+    target, mirroring the reference's "existing Chainer model" input."""
+
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(8)(x)
+        x = nn.BatchNorm(use_running_average=not self.train, momentum=0.9)(x)
+        x = nn.relu(x)
+        x = nn.Dense(4)(x)
+        x = nn.BatchNorm(use_running_average=not self.train, momentum=0.9)(x)
+        return x
+
+
+def test_create_mnbn_model_params_are_drop_in(comm):
+    """Conversion must not move parameters: same tree paths before/after
+    (upstream rebuilt the link tree in place; here the scope is shared)."""
+    from chainermn_tpu.links import create_mnbn_model
+
+    x = jnp.ones((4, 6))
+    plain = _PlainBnNet()
+    converted = create_mnbn_model(plain, comm)
+    vp = plain.init(jax.random.key(0), x)
+    vc = converted.init(jax.random.key(0), x)
+    assert jax.tree_util.tree_structure(vp) == jax.tree_util.tree_structure(vc)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        vp,
+        vc,
+    )
+
+
+def test_create_mnbn_model_syncs_over_shards(comm):
+    """Converted model over N shards == unconverted model on the whole
+    batch: the reference's sync-BN invariant, reached via conversion."""
+    from chainermn_tpu.links import create_mnbn_model
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(N * 4, 6).astype(np.float32) * 2 + 0.5
+
+    plain = _PlainBnNet()
+    converted = create_mnbn_model(plain, comm)
+    variables = plain.init(jax.random.key(1), x)
+    mesh = comm.mesh
+
+    @jax.jit
+    def dist(x):
+        def body(xl):
+            y, _ = converted.apply(variables, xl, mutable=["batch_stats"])
+            return y
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False,
+        )(x)
+
+    y_dist = np.asarray(dist(x))
+    y_ref, _ = plain.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(y_dist, np.asarray(y_ref), rtol=1e-4, atol=1e-5)
+    # The override must not leak: the original module is untouched after use.
+    y_plain_again, _ = plain.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(
+        np.asarray(y_plain_again), np.asarray(y_ref), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_create_mnbn_model_auxiliary_method(comm):
+    """``apply(..., method='encode')`` works on the converted model and BN
+    inside the auxiliary method is synchronized (upstream converted the
+    whole link tree, so every entry point stayed synchronized)."""
+    from chainermn_tpu.links import create_mnbn_model
+
+    class Net(nn.Module):
+        def setup(self):
+            self.bn = nn.BatchNorm(use_running_average=False, momentum=0.9)
+
+        def __call__(self, x):
+            return self.encode(x)
+
+        def encode(self, x):
+            return self.bn(x)
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(N * 4, 5).astype(np.float32) * 2 + 1
+
+    plain = Net()
+    converted = create_mnbn_model(plain, comm)
+    variables = plain.init(jax.random.key(0), x)
+    mesh = comm.mesh
+
+    @jax.jit
+    def dist(x):
+        def body(xl):
+            y, _ = converted.apply(
+                variables, xl, mutable=["batch_stats"], method="encode"
+            )
+            return y
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False,
+        )(x)
+
+    y_ref, _ = plain.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(
+        np.asarray(dist(x)), np.asarray(y_ref), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_create_mnbn_model_runs_outside_mesh(comm):
+    """Training-mode forward of a converted model OUTSIDE shard_map (local
+    debugging, single-device eval) degrades to plain-BN behavior instead of
+    raising an unbound-axis NameError."""
+    from chainermn_tpu.links import create_mnbn_model
+
+    rng = np.random.RandomState(11)
+    x = rng.randn(8, 6).astype(np.float32)
+    plain = _PlainBnNet()
+    converted = create_mnbn_model(plain, comm)
+    variables = plain.init(jax.random.key(2), x)
+    y_conv, _ = converted.apply(variables, x, mutable=["batch_stats"])
+    y_ref, _ = plain.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(
+        np.asarray(y_conv), np.asarray(y_ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_create_mnbn_model_field_values_pass_through(comm):
+    """Config attributes on the converted model are the FIELD VALUES, not
+    delegation closures — even when the value is callable (dtype classes,
+    initializer functions)."""
+    from chainermn_tpu.links import create_mnbn_model
+    from chainermn_tpu.models import ResNet50
+
+    m = create_mnbn_model(ResNet50(), axis_name="data")
+    assert m.compute_dtype is jnp.bfloat16
+    assert m.num_classes == 1000
+    inner = _PlainBnNet()
+    assert create_mnbn_model(inner, comm).train is True
+
+
+def test_create_mnbn_model_pickle_and_deepcopy(comm):
+    """Converted models survive pickle/deepcopy (stdlib probes dunders on
+    field-less instances; __getattr__ must raise AttributeError, not
+    recurse)."""
+    import copy
+    import pickle
+
+    from chainermn_tpu.links import create_mnbn_model
+
+    converted = create_mnbn_model(_PlainBnNet(), axis_name="data")
+    clone = pickle.loads(pickle.dumps(converted))
+    clone2 = copy.deepcopy(converted)
+    x = jnp.ones((4, 6))
+    v = converted.init(jax.random.key(0), x)
+    for c in (clone, clone2):
+        vc = c.init(jax.random.key(0), x)
+        assert jax.tree_util.tree_structure(v) == jax.tree_util.tree_structure(vc)
+
+
+def test_create_mnbn_model_respects_explicit_axis(comm):
+    """BN layers that already carry an axis_name are left untouched, and
+    exactly one of comm/axis_name must be given."""
+    from chainermn_tpu.links import create_mnbn_model
+
+    with pytest.raises(ValueError):
+        create_mnbn_model(_PlainBnNet())
+    with pytest.raises(ValueError):
+        create_mnbn_model(_PlainBnNet(), comm, axis_name="data")
+
+    class Pre(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return MultiNodeBatchNormalization(
+                use_running_average=False, axis_name="data"
+            )(x)
+
+    converted = create_mnbn_model(Pre(), axis_name="other")
+    x = jnp.ones((4, 3))
+    variables = converted.init(jax.random.key(0), x)
+    mesh = comm.mesh
+
+    # Runs under 'data' (not 'other') without error — proof the existing
+    # axis_name survived conversion.
+    def body(xl):
+        y, _ = converted.apply(variables, xl, mutable=["batch_stats"])
+        return y
+
+    out = shard_map(
+        body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False,
+    )(jnp.asarray(np.random.RandomState(0).randn(8, 3), jnp.float32))
+    assert out.shape == (8, 3)
