@@ -29,7 +29,10 @@ def __getattr__(name):
         from chainermn_tpu.parallel import ulysses as _ul
 
         return getattr(_ul, name)
-    if name in ("pipeline_local", "make_pipeline", "stack_stage_params"):
+    if name in (
+        "pipeline_local", "make_pipeline", "stack_stage_params",
+        "pipeline_1f1b_local", "make_pipeline_1f1b",
+    ):
         from chainermn_tpu.parallel import pipeline as _pp
 
         return getattr(_pp, name)
@@ -70,6 +73,8 @@ __all__ = [
     "pipeline_local",
     "make_pipeline",
     "stack_stage_params",
+    "pipeline_1f1b_local",
+    "make_pipeline_1f1b",
     "zero_shard_optimizer",
     "zero_state_specs",
     "moe_layer_local",

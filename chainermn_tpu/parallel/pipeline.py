@@ -170,3 +170,294 @@ def stack_stage_params(params_list) -> PyTree:
     leading axis — the layout ``make_pipeline`` expects, shardable over the
     ``'stage'`` mesh axis."""
     return jax.tree.map(lambda *ls: jnp.stack(ls), *params_list)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule
+# ---------------------------------------------------------------------------
+
+
+def pipeline_1f1b_local(
+    stage_fn: Callable,
+    loss_grad_fn: Callable,
+    stage_params: PyTree,
+    x: jax.Array,
+    targets: jax.Array,
+    axis_name: str = "stage",
+    *,
+    head_params: PyTree = None,
+    collect_input_grads: bool = False,
+):
+    """One-forward-one-backward pipeline schedule — call INSIDE ``shard_map``.
+
+    Where :func:`pipeline_local` + ``jax.grad`` replays the whole forward
+    schedule before the transposed backward (so every microbatch's boundary
+    activation is live at once — GPipe's memory profile), 1F1B interleaves:
+    after warmup each stage alternates one microbatch's forward with an
+    earlier microbatch's backward, so at most ``n_stages`` microbatch
+    inputs are ever saved per stage (a static ring buffer here), for any
+    number of microbatches. The backward recomputes the stage forward from
+    the saved INPUT (per-microbatch rematerialisation — the standard
+    trade in every 1F1B implementation).
+
+    Schedule (stage ``s`` of ``n``, microbatch ``i``): forward at tick
+    ``s + 2i``, backward at tick ``2(n-1) - s + 2i + 1`` — disjoint
+    parities, so each tick a stage executes exactly ONE op — forward,
+    backward, or (during fill/drain) nothing — selected by a true
+    per-stage ``lax.switch`` (not a masked all-branches select).
+    Forward activations hop stage ``s → s+1`` and backward cotangents hop
+    ``s → s-1``, each arriving exactly at its consumption tick.
+
+    Args:
+      stage_fn: ``stage_fn(params, x_mb) -> y_mb``, output shape == input
+        shape (homogeneous stages, as in :func:`pipeline_local`).
+      loss_grad_fn: without ``head_params``:
+        ``loss_grad_fn(y_mb, target_mb) -> (loss, dy_mb)`` — per-microbatch
+        loss and its gradient wrt the final stage output (typically
+        ``jax.value_and_grad`` of the caller's loss). With ``head_params``
+        (a trainable loss head living after the pipelined region):
+        ``loss_grad_fn(head_params, y_mb, target_mb) -> (loss, (dhead,
+        dy_mb))``. Runs ONLY on the LAST stage, where 1F1B starts each
+        microbatch's backward.
+      stage_params: this stage's parameter pytree.
+      x: ``[n_micro, mb, ...]`` microbatched input (stage 0 consumes it).
+      targets: ``[n_micro, ...]`` per-microbatch loss targets (last stage
+        consumes them).
+      head_params: optional trainable parameters of the loss head; their
+        gradients are accumulated alongside the stage gradients.
+      collect_input_grads: also return the loss gradient wrt ``x``
+        (``[n_micro, mb, ...]``, replicated) — backprop it into an
+        embed/encoder living before the pipelined region. Costs one
+        ``O(n_micro)`` buffer, the same order as ``x`` itself.
+
+    Returns:
+      ``(loss, grads[, head_grads][, x_grads])``: mean per-microbatch loss
+      (replicated), this stage's parameter gradients (mean over
+      microbatches), and — when requested — the head-parameter and input
+      gradients.
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    n_micro = x.shape[0]
+    mb_shape = x.shape[1:]
+    total = 2 * (n + n_micro - 1)
+
+    fwd_perm = [(i, i + 1) for i in range(n - 1)]
+    bwd_perm = [(i + 1, i) for i in range(n - 1)]
+    zeros_mb = jnp.zeros(mb_shape, x.dtype)
+    zeros_grads = jax.tree.map(jnp.zeros_like, stage_params)
+    zeros_head = jax.tree.map(jnp.zeros_like, head_params)
+
+    def tick(carry, t):
+        (fwd_msg, cot_msg, saved, y_last, grads, hgrads, dx_buf,
+         loss_sum) = carry
+
+        tf = t - s
+        parity_f = (tf % 2) == 0  # F ticks for this stage; B on the other
+        i_f_raw = tf // 2
+        f_valid = jnp.logical_and(
+            parity_f, jnp.logical_and(i_f_raw >= 0, i_f_raw < n_micro)
+        )
+        i_f = jnp.clip(i_f_raw, 0, n_micro - 1)
+        tb = t - (2 * (n - 1) - s + 1)
+        i_b_raw = tb // 2
+        b_valid = jnp.logical_and(
+            jnp.logical_not(parity_f),
+            jnp.logical_and(i_b_raw >= 0, i_b_raw < n_micro),
+        )
+        i_b = jnp.clip(i_b_raw, 0, n_micro - 1)
+
+        feed = lax.dynamic_index_in_dim(x, i_f, keepdims=False)
+        inp = jnp.where(s == 0, feed, fwd_msg)
+
+        zero_scalar = jnp.zeros((), jnp.float32)
+
+        def idle_branch(_):
+            return zeros_mb, zeros_mb, zeros_grads, zeros_head, zero_scalar
+
+        def f_branch(_):
+            out = stage_fn(stage_params, inp)
+            return out, zeros_mb, zeros_grads, zeros_head, zero_scalar
+
+        def b_branch(_):
+            x_saved = lax.dynamic_index_in_dim(saved, i_b % n, keepdims=False)
+
+            # The loss head runs ONLY on the last stage (nested true
+            # conditional): other stages take the arriving cotangent. This
+            # also keeps loss_grad_fn away from the zero-initialised
+            # y_last — a loss with a pole at 0 (e.g. log-likelihood) would
+            # otherwise produce NaNs that survive masked accumulation
+            # (NaN * 0 == NaN).
+            def last_stage(_):
+                tgt = lax.dynamic_index_in_dim(targets, i_b, keepdims=False)
+                if head_params is None:
+                    loss, dy = loss_grad_fn(y_last, tgt)
+                    dhead = zeros_head
+                else:
+                    loss, (dhead, dy) = loss_grad_fn(head_params, y_last, tgt)
+                return loss.astype(jnp.float32), dhead, dy
+
+            def mid_stage(_):
+                return zero_scalar, zeros_head, cot_msg
+
+            loss, dhead, dy = lax.cond(s == n - 1, last_stage, mid_stage, None)
+            _, vjp_fn = jax.vjp(stage_fn, stage_params, x_saved)
+            dparams, dx = vjp_fn(dy)
+            return zeros_mb, dx, dparams, dhead, loss
+
+        # Exactly one op per stage per tick; idle stages (fill/drain, and
+        # invalid parities) do NOTHING — no garbage evaluation to mask.
+        branch = jnp.where(f_valid, 1, jnp.where(b_valid, 2, 0))
+        out, dx, dparams, dhead, loss_d = lax.switch(
+            branch, (idle_branch, f_branch, b_branch), None
+        )
+
+        # Bank state touched only by valid ops.
+        saved = lax.dynamic_update_index_in_dim(
+            saved,
+            jnp.where(
+                f_valid,
+                inp,
+                lax.dynamic_index_in_dim(saved, i_f % n, keepdims=False),
+            ),
+            i_f % n,
+            0,
+        )
+        y_last = jnp.where(jnp.logical_and(f_valid, s == n - 1), out, y_last)
+        # Branch outputs are zeros except for the op that actually ran.
+        grads = jax.tree.map(jnp.add, grads, dparams)
+        hgrads = jax.tree.map(jnp.add, hgrads, dhead)
+        if dx_buf is not None:
+            write = jnp.logical_and(b_valid, s == 0)
+            dx_buf = lax.dynamic_update_index_in_dim(
+                dx_buf,
+                jnp.where(
+                    write,
+                    dx,
+                    lax.dynamic_index_in_dim(dx_buf, i_b, keepdims=False),
+                ),
+                i_b,
+                0,
+            )
+        loss_sum = loss_sum + loss_d
+
+        fwd_msg = lax.ppermute(
+            jnp.where(f_valid, out, zeros_mb), axis_name, fwd_perm
+        )
+        cot_msg = lax.ppermute(
+            jnp.where(b_valid, dx, zeros_mb), axis_name, bwd_perm
+        )
+        return (fwd_msg, cot_msg, saved, y_last, grads, hgrads, dx_buf,
+                loss_sum), None
+
+    carry0 = (
+        zeros_mb,  # fwd_msg
+        zeros_mb,  # cot_msg
+        jnp.zeros((n,) + mb_shape, x.dtype),  # saved input ring
+        zeros_mb,  # y_last
+        zeros_grads,
+        zeros_head,
+        jnp.zeros((n_micro,) + mb_shape, x.dtype)
+        if collect_input_grads
+        else None,
+        jnp.zeros((), jnp.float32),
+    )
+    (_, _, _, _, grads, hgrads, dx_buf, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(total)
+    )
+
+    grads = jax.tree.map(lambda g: g / n_micro, grads)
+    loss = lax.psum(jnp.where(s == n - 1, loss_sum, 0.0), axis_name) / n_micro
+    out = (loss, grads)
+    if head_params is not None:
+        # Only the last stage accumulated head grads; broadcast via psum.
+        out += (
+            jax.tree.map(
+                lambda g: lax.psum(g, axis_name) / n_micro, hgrads
+            ),
+        )
+    if collect_input_grads:
+        # Only stage 0 wrote its slots; psum broadcasts to every stage.
+        # Same mean-over-microbatches normalisation as the param grads:
+        # x_grads is d(returned loss)/dx.
+        out += (lax.psum(dx_buf, axis_name) / n_micro,)
+    return out
+
+
+def make_pipeline_1f1b(
+    stage_fn: Callable,
+    loss_grad_fn: Callable,
+    mesh: Mesh,
+    *,
+    axis_name: str = "stage",
+    n_microbatches: Optional[int] = None,
+):
+    """Build the jitted 1F1B train-step core:
+    ``fn(stacked_params, x, targets[, head_params]) ->
+    (loss, stacked_grads[, head_grads][, x_grads])``.
+
+    ``stacked_params`` leaves have leading dim ``n_stages`` (sharded over
+    ``axis_name``); ``x`` is the full batch ``[batch, ...]`` and
+    ``targets`` the per-example targets ``[batch, ...]``, both split into
+    ``n_microbatches``. Unlike :func:`make_pipeline` (a differentiable
+    *apply*), this IS the fwd+bwd engine — feed the returned grads to any
+    optimizer; raise ``n_microbatches`` freely, saved activations stay
+    ``O(n_stages)``. Passing ``head_params`` to the returned ``fn``
+    switches ``loss_grad_fn`` to the trainable-head contract (see
+    :func:`pipeline_1f1b_local`) and appends the head gradients to the
+    result; ``collect_input_grads=True`` additionally appends the
+    gradient wrt ``x`` (shape ``[batch, ...]``) for an embed before the
+    pipeline.
+    """
+    from jax import shard_map
+
+    n_stages = mesh.shape[axis_name]
+    n_micro = n_microbatches or n_stages
+
+    def build(with_head: bool, collect_input_grads: bool):
+        def local(stacked_params, x, targets, head_params):
+            params = jax.tree.map(lambda p: p[0], stacked_params)
+            batch = x.shape[0]
+            if batch % n_micro:
+                raise ValueError(
+                    f"batch {batch} not divisible by n_microbatches {n_micro}"
+                )
+            mb = batch // n_micro
+            xm = x.reshape((n_micro, mb) + x.shape[1:])
+            tm = targets.reshape((n_micro, mb) + targets.shape[1:])
+            res = pipeline_1f1b_local(
+                stage_fn, loss_grad_fn, params, xm, tm, axis_name,
+                head_params=head_params if with_head else None,
+                collect_input_grads=collect_input_grads,
+            )
+            loss, grads = res[0], jax.tree.map(lambda g: g[None], res[1])
+            rest = res[2:]
+            if collect_input_grads:
+                *rest, xg = rest
+                rest = tuple(rest) + (
+                    xg.reshape((batch,) + xg.shape[2:]),
+                )
+            return (loss, grads) + tuple(rest)
+
+        extra_specs = (P(),) * (int(with_head) + int(collect_input_grads))
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(), P(), P()),
+            out_specs=(P(), P(axis_name)) + extra_specs,
+            check_vma=False,
+        )
+
+    import functools
+
+    @functools.lru_cache(maxsize=4)
+    def _jitted(with_head: bool, collect_input_grads: bool):
+        return jax.jit(build(with_head, collect_input_grads))
+
+    def fn(stacked_params, x, targets, head_params=None, *,
+           collect_input_grads=False):
+        return _jitted(head_params is not None, collect_input_grads)(
+            stacked_params, x, targets, head_params
+        )
+
+    return fn

@@ -112,6 +112,7 @@ def make_train_step(
     axis_name: Optional[str] = None,
     batch_spec: P | None = None,
     donate: bool = True,
+    accum_steps: int = 1,
 ):
     """Build the jitted data-parallel train step.
 
@@ -125,6 +126,19 @@ def make_train_step(
         (the step then reduces gradients itself).
       batch_spec: PartitionSpec for every batch leaf; defaults to sharding
         the leading dim over the communicator's grad axes.
+      accum_steps: gradient accumulation — each shard's batch is split into
+        this many microbatches, run through a ``lax.scan`` (one compiled
+        program, activations live for ONE microbatch at a time), and the
+        averaged gradient crosses the wire in a SINGLE allreduce. The
+        large-effective-batch regime of the reference's 32K-batch ImageNet
+        runs (SURVEY.md section 6) without the memory of the full batch.
+        Microbatches see identical params; for STATELESS models the
+        accumulated step equals the full-batch step exactly. Models with
+        ``model_state`` (BatchNorm) thread it sequentially through the
+        microbatches — batch statistics become per-microbatch and running
+        averages get ``accum_steps`` momentum updates per step, the
+        standard grad-accumulation semantics but NOT identical to one
+        full-batch pass.
 
     Returns:
       ``step(state, batch) -> (state, metrics)``, jitted over ``comm.mesh``.
@@ -134,14 +148,56 @@ def make_train_step(
     if batch_spec is None:
         batch_spec = P(axes)
     reduce_in_step = not isinstance(optimizer, MultiNodeOptimizer)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     _loss_with_aux = normalize_loss_fn(loss_fn)
 
-    def local_step(state: TrainState, batch):
+    def _grads_single(state, batch):
         grad_fn = jax.value_and_grad(_loss_with_aux, has_aux=True)
         (loss, (metrics, model_state)), grads = grad_fn(
             state.params, batch, state.model_state
         )
+        return grads, loss, metrics, model_state
+
+    def _grads_accumulated(state, batch):
+        def to_micro(leaf):
+            if leaf.shape[0] % accum_steps != 0:
+                raise ValueError(
+                    f"local batch dim {leaf.shape[0]} not divisible by "
+                    f"accum_steps={accum_steps}"
+                )
+            return leaf.reshape(
+                accum_steps, leaf.shape[0] // accum_steps, *leaf.shape[1:]
+            )
+
+        micro = jax.tree.map(to_micro, batch)
+        grad_fn = jax.value_and_grad(_loss_with_aux, has_aux=True)
+
+        def body(carry, mb):
+            gsum, model_state = carry
+            (loss, (metrics, model_state)), g = grad_fn(
+                state.params, mb, model_state
+            )
+            gsum = jax.tree.map(jnp.add, gsum, g)
+            return (gsum, model_state), (loss, metrics)
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        (gsum, model_state), (losses, metrics_stack) = lax.scan(
+            body, (zeros, state.model_state), micro
+        )
+        grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+        loss = losses.mean()
+        metrics = jax.tree.map(lambda m: m.mean(0), metrics_stack)
+        return grads, loss, metrics, model_state
+
+    def local_step(state: TrainState, batch):
+        if accum_steps == 1:
+            grads, loss, metrics, model_state = _grads_single(state, batch)
+        else:
+            grads, loss, metrics, model_state = _grads_accumulated(
+                state, batch
+            )
         if reduce_in_step:
             grads = allreduce_gradients(grads, comm)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)

@@ -65,6 +65,39 @@ def test_distributed_step_equals_single_device(comm):
     assert int(new_state.step) == 1
 
 
+def test_accumulated_step_equals_full_batch(comm):
+    """accum_steps=K over the same total batch must produce the SAME update
+    as the plain step (microbatches see identical params; the mean of
+    microbatch gradients of batch-mean losses is the full-batch gradient)."""
+    x, y = _data()
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+
+    state_plain = create_train_state(params, opt, comm)
+    plain = make_train_step(_linreg_loss, opt, comm, donate=False)
+    state_plain, m_plain = plain(state_plain, (x, y))
+
+    state_acc = create_train_state(params, opt, comm)
+    acc = make_train_step(_linreg_loss, opt, comm, donate=False,
+                          accum_steps=4)
+    state_acc, m_acc = acc(state_acc, (x, y))
+
+    np.testing.assert_allclose(
+        np.asarray(state_acc.params["w"]),
+        np.asarray(state_plain.params["w"]), rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        float(m_acc["loss"]), float(m_plain["loss"]), rtol=1e-5
+    )
+
+    with pytest.raises(ValueError):
+        make_train_step(_linreg_loss, opt, comm, accum_steps=0)
+    bad = make_train_step(_linreg_loss, opt, comm, donate=False,
+                          accum_steps=3)
+    with pytest.raises(ValueError):
+        bad(create_train_state(params, opt, comm), (x, y))  # 8 % 3 != 0
+
+
 def test_multi_step_convergence(comm):
     x, y = _data(n=256)
     params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
