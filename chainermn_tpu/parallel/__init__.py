@@ -40,7 +40,8 @@ def __getattr__(name):
         from chainermn_tpu.parallel import zero as _z
 
         return getattr(_z, name)
-    if name in ("moe_layer_local", "top1_route", "make_expert_params"):
+    if name in ("moe_layer_local", "top1_route", "topk_route",
+                "load_balancing_loss", "make_expert_params"):
         from chainermn_tpu.parallel import moe as _m
 
         return getattr(_m, name)
@@ -79,6 +80,8 @@ __all__ = [
     "zero_state_specs",
     "moe_layer_local",
     "top1_route",
+    "topk_route",
+    "load_balancing_loss",
     "make_expert_params",
     "fsdp_shardings",
     "create_fsdp_train_state",

@@ -61,6 +61,74 @@ def top1_route(
     return dispatch, combine
 
 
+def topk_route(
+    logits: jax.Array,  # [tokens, n_experts]
+    capacity: int,
+    k: int = 2,
+):
+    """GShard-style top-k routing with capacity (k=2 is the classic
+    configuration; k=1 degenerates to :func:`top1_route` up to gate
+    normalisation).
+
+    Each token's k chosen experts receive it in slot order (slot 0 fills
+    queues first); gates are the chosen experts' softmax probabilities
+    normalised over the k choices. An overflowed (dropped) choice's share
+    is simply lost — the kept choice keeps its normalised weight
+    ``g_kept/(g1+..+gk)``, it is NOT re-scaled to 1 (GShard semantics;
+    the residual path covers the dropped mass). Returns the same
+    ``(dispatch, combine)`` pair as :func:`top1_route`
+    (``[tokens, n_experts, capacity]``).
+    """
+    n_experts = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    masked = probs
+    chosen = []  # (onehot_int [t,e], gate [t])
+    for _ in range(k):
+        expert = jnp.argmax(masked, axis=-1)
+        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+        gate = (probs * onehot).sum(-1)
+        chosen.append((onehot, gate))
+        masked = masked * (1 - onehot)
+
+    # Queue bookkeeping in int32 (as top1_route does): a low-precision
+    # logits dtype must never round slot indices — bf16 cumsum collides
+    # queue slots past 256 tokens.
+    denom = sum(g for _, g in chosen) + 1e-9
+    counts = jnp.zeros((n_experts,), jnp.int32)  # kept tokens per queue
+    dispatch = jnp.zeros((logits.shape[0], n_experts, capacity), logits.dtype)
+    combine = jnp.zeros_like(dispatch)
+    for onehot, gate in chosen:
+        pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot + counts[None, :]
+        pos_tok = (pos * onehot).sum(-1)
+        keep = (pos_tok < capacity) & (onehot.sum(-1) > 0)
+        d = (
+            onehot.astype(logits.dtype)[:, :, None]
+            * jax.nn.one_hot(pos_tok, capacity, dtype=logits.dtype)[:, None, :]
+        ) * keep[:, None, None].astype(logits.dtype)
+        dispatch = dispatch + d
+        combine = combine + d * (gate / denom)[:, None, None]
+        counts = counts + (onehot * keep[:, None]).sum(0)
+        counts = jnp.minimum(counts, capacity)
+    return dispatch, combine
+
+
+def load_balancing_loss(logits: jax.Array) -> jax.Array:
+    """Switch/GShard auxiliary load-balancing loss:
+    ``n_experts * mean_e(fraction_of_tokens_e * mean_router_prob_e)``
+    (top-1 assignment fraction, the standard estimator for any k) —
+    1.0 at perfect balance, grows as routing collapses onto few experts.
+    Add ``aux_weight * load_balancing_loss(logits)`` to the task loss.
+    """
+    n_experts = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    # fraction of tokens whose top-1 choice is each expert
+    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), n_experts, dtype=probs.dtype)
+    frac = top1.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    return n_experts * jnp.sum(frac * mean_prob)
+
+
 def moe_layer_local(
     x: jax.Array,              # [tokens_local, d_model]
     router_w: jax.Array,       # [d_model, n_experts_global]
@@ -69,9 +137,11 @@ def moe_layer_local(
     axis_name: str = "expert",
     *,
     capacity_factor: float = 1.25,
+    k: int = 1,
 ) -> jax.Array:
     """One MoE layer inside ``shard_map``: one expert per shard along
-    ``axis_name``; tokens ride two ``all_to_all``s.
+    ``axis_name``; tokens ride two ``all_to_all``s. ``k=1`` is Switch-style
+    top-1 routing, ``k=2`` GShard-style top-2 (capacity scales with k).
 
     Returns the combined expert outputs for the local tokens (zeros for
     dropped tokens — add the residual outside).
@@ -80,10 +150,13 @@ def moe_layer_local(
 
     n = lax.axis_size(axis_name)
     tokens, d = x.shape
-    capacity = max(1, math.ceil(tokens / n * capacity_factor))
+    capacity = max(1, math.ceil(tokens * k / n * capacity_factor))
 
     logits = x @ router_w  # [tokens, n]
-    dispatch, combine = top1_route(logits, capacity)
+    if k == 1:
+        dispatch, combine = top1_route(logits, capacity)
+    else:
+        dispatch, combine = topk_route(logits, capacity, k)
 
     # Gather each expert's queue locally: [n, capacity, d]
     queues = jnp.einsum("td,tec->ecd", x, dispatch)
