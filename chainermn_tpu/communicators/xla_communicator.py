@@ -58,8 +58,38 @@ class NaiveCommunicator(XlaCommunicator):
 
     def __init__(self, **kwargs) -> None:
         if kwargs.get("mesh") is None and kwargs.get("devices") is None:
+            self._pin_cpu_platform_if_uninitialized()
             kwargs["devices"] = jax.devices("cpu")
         super().__init__(**kwargs)
+
+    @staticmethod
+    def _pin_cpu_platform_if_uninitialized() -> None:
+        """Pin jax to the CPU platform before first backend init.
+
+        ``jax.devices('cpu')`` initialises EVERY registered backend, and an
+        externally injected accelerator plugin whose transport is dead can
+        hang that discovery forever (observed live: a wedged tunnelled TPU
+        plugin froze every example run). The naive communicator is
+        hermetic-CPU *by contract*, so creating one FIRST in a fresh
+        process deliberately OVERRIDES any pre-set platform list
+        (environment-injected plugin shims set ``JAX_PLATFORMS``
+        themselves, so a pre-set value does not imply user intent). The
+        pin is process-wide: mixing a first ``naive`` communicator with a
+        later accelerator communicator in one process requires opting out
+        via ``CHAINERMN_TPU_NAIVE_NO_PIN=1``. No-op once any backend is
+        live (then discovery already succeeded)."""
+        import os
+
+        if os.environ.get("CHAINERMN_TPU_NAIVE_NO_PIN"):
+            return
+        try:
+            from jax._src import xla_bridge as xb
+
+            if xb._backends:  # discovery already done and healthy
+                return
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # best-effort: fall through to normal discovery
 
 
 class HierarchicalCommunicator(CommunicatorBase):

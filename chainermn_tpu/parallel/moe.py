@@ -80,16 +80,23 @@ def topk_route(
     (``[tokens, n_experts, capacity]``).
     """
     n_experts = logits.shape[-1]
+    if k > n_experts:
+        raise ValueError(f"k={k} exceeds n_experts={n_experts}")
     probs = jax.nn.softmax(logits, axis=-1)
 
-    masked = probs
+    # Mask chosen experts in LOGIT space with -inf: multiplying probs by
+    # (1 - onehot) re-selects expert 0 whenever the remaining softmax mass
+    # underflows to exactly zero (diverged router), double-booking a queue.
+    masked = logits
     chosen = []  # (onehot_int [t,e], gate [t])
     for _ in range(k):
         expert = jnp.argmax(masked, axis=-1)
         onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
         gate = (probs * onehot).sum(-1)
         chosen.append((onehot, gate))
-        masked = masked * (1 - onehot)
+        masked = jnp.where(
+            onehot > 0, jnp.finfo(masked.dtype).min, masked
+        )
 
     # Queue bookkeeping in int32 (as top1_route does): a low-precision
     # logits dtype must never round slot indices — bf16 cumsum collides

@@ -102,6 +102,9 @@ def main(argv=None):
         def loss_fn(dense, experts):
             h = jnp.tanh(x @ dense["w_in"])
             my_experts = jax.tree.map(lambda l: l[0], experts)
+            # Aux loss must regularise the SAME router distribution the
+            # layer dispatched with — i.e. the pre-residual activations.
+            aux = load_balancing_loss(h @ dense["router"])
             h = h + moe_layer_local(
                 h, dense["router"], expert_fn, my_experts, "expert",
                 capacity_factor=args.capacity_factor, k=args.topk,
@@ -110,7 +113,6 @@ def main(argv=None):
             task = optax.softmax_cross_entropy_with_integer_labels(
                 logits, y
             ).mean()
-            aux = load_balancing_loss(h @ dense["router"])
             acc = (logits.argmax(-1) == y).mean()
             return task + args.aux_weight * aux, (task, acc)
 

@@ -18,6 +18,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# The suite is CPU-mesh-only by design, but an externally injected
+# accelerator-plugin shim (sitecustomize on PYTHONPATH) can HANG jax
+# backend discovery outright when its tunnel is dead — observed live in
+# round 2, and the cause of round 1's red driver artifacts. The shim also
+# overrides the JAX_PLATFORMS env var at interpreter startup, so the pin
+# must happen at the config level, after `import jax` (which runs after
+# sitecustomize) and before the first backend init: with platforms pinned
+# to cpu, the plugin's backend factory is simply never invoked.
+jax.config.update("jax_platforms", "cpu")
+
 # Default eager/jit computations to the CPU backend: reference values in
 # tests must use the same arithmetic as the CPU-mesh distributed versions
 # (the real TPU's default bf16 matmul precision would otherwise skew

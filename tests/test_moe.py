@@ -223,3 +223,21 @@ def test_topk_bf16_logits_no_slot_collisions():
     # nothing dropped: every token occupies exactly k slots
     np.testing.assert_allclose(d.sum(axis=(1, 2)), np.full(tokens, 2.0),
                                rtol=0, atol=1e-6)
+
+
+def test_topk_no_duplicate_expert_on_underflow():
+    """A diverged router (softmax mass underflows to 0 outside the top
+    choice) must still pick k DISTINCT experts — logit-space masking; and
+    k > n_experts is rejected."""
+    from chainermn_tpu.parallel.moe import topk_route
+
+    logits = jnp.zeros((16, 4), jnp.float32).at[:, 2].set(200.0)
+    dispatch, _ = topk_route(logits, capacity=16, k=2)
+    d = np.asarray(dispatch)
+    per_token_expert = d.sum(axis=2)  # [tokens, experts]
+    assert (per_token_expert <= 1.0 + 1e-6).all(), "expert chosen twice"
+    assert (d.sum(axis=(1, 2)) == 2.0).all()
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="exceeds"):
+        topk_route(logits, capacity=4, k=5)

@@ -326,3 +326,57 @@ def test_pipeline_example_converges(schedule):
         "--schedule", schedule,
     ])
     assert acc > 0.9, f"{schedule} did not converge: acc={acc}"
+
+
+def test_1f1b_uses_less_temp_memory_than_gpipe(comm):
+    """The 1F1B memory claim, measured by XLA's own buffer assignment:
+    with many microbatches and fat boundary activations, the interleaved
+    schedule's temp allocation must be well below GPipe+remat+autodiff
+    (which keeps O(n_micro) boundary tensors for the transposed replay)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.parallel import pipeline as pl
+
+    mesh = comm.mesh
+    ax = comm.axis_name
+    D, B, M = 1024, 512, 32
+
+    def stage_fn(w, x):
+        return x + jnp.tanh(x @ w)
+
+    ks = jax.random.split(jax.random.key(30), comm.size)
+    stacked = stack_stage_params(
+        [jax.random.normal(k, (D, D)) * 0.02 for k in ks]
+    )
+    x = jax.random.normal(jax.random.key(31), (B, D))
+    t = jnp.zeros((B, D))
+
+    pipe = pl.make_pipeline(stage_fn, mesh, axis_name=ax,
+                            n_microbatches=M, remat_stages=True)
+    g = (
+        jax.jit(jax.value_and_grad(
+            lambda s, x: jnp.mean((pipe(s, x) - t) ** 2)))
+        .lower(stacked, x).compile().memory_analysis()
+    )
+
+    lg = jax.value_and_grad(lambda y, tt: jnp.mean((y - tt) ** 2))
+
+    def local(sp, x, tt):
+        params = jax.tree.map(lambda p: p[0], sp)
+        xm = x.reshape((M, B // M, D))
+        tm = tt.reshape((M, B // M, D))
+        res = pl.pipeline_1f1b_local(stage_fn, lg, params, xm, tm, ax)
+        return res[0], jax.tree.map(lambda gg: gg[None], res[1])
+
+    f = (
+        jax.jit(shard_map(local, mesh=mesh,
+                          in_specs=(P(ax), P(), P()),
+                          out_specs=(P(), P(ax)), check_vma=False))
+        .lower(stacked, x, t).compile().memory_analysis()
+    )
+    # measured ~2x at this config; assert a conservative margin
+    assert f.temp_size_in_bytes < 0.8 * g.temp_size_in_bytes, (
+        f"1F1B temp {f.temp_size_in_bytes/1e6:.1f}MB not below GPipe "
+        f"{g.temp_size_in_bytes/1e6:.1f}MB"
+    )
