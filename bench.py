@@ -134,6 +134,33 @@ def _run_child(mode: str, timeout: float, env=None):
     return None, f"{mode} bench emitted no JSON line"
 
 
+_LAST_TPU_CACHE = os.path.join(_HERE, ".bench_last_tpu.json")
+
+
+def _save_last_tpu(result: dict) -> None:
+    try:
+        cached = dict(result)
+        cached["measured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        with open(_LAST_TPU_CACHE, "w") as f:
+            json.dump(cached, f)
+    except OSError:
+        pass
+
+
+def _attach_last_tpu(result: dict) -> None:
+    """On a CPU fallback, attach the most recent SUCCESSFUL on-chip result
+    (clearly labeled with its measurement time) so a transiently dead
+    accelerator tunnel doesn't erase real measured capability. The
+    top-level fields still describe THIS run honestly."""
+    try:
+        with open(_LAST_TPU_CACHE) as f:
+            result["last_good_tpu"] = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+
+
 def main() -> None:
     deadline = time.monotonic() + TOTAL_BUDGET
     errors = []
@@ -143,6 +170,7 @@ def main() -> None:
         budget = min(900.0, deadline - time.monotonic() - 300)
         result, err = _run_child("accel", budget)
         if result is not None:
+            _save_last_tpu(result)
             print(json.dumps(result))
             return
         errors.append(err)
@@ -153,21 +181,20 @@ def main() -> None:
     result, err = _run_child("cpu", budget, env=_cpu_env())
     if result is not None:
         result["error"] = "; ".join(errors)
+        _attach_last_tpu(result)
         print(json.dumps(result))
         return
     errors.append(err)
 
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_images_per_sec",
-                "value": 0.0,
-                "unit": "images/sec",
-                "vs_baseline": 0.0,
-                "error": "; ".join(e for e in errors if e),
-            }
-        )
-    )
+    out = {
+        "metric": "resnet50_images_per_sec",
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+        "error": "; ".join(e for e in errors if e),
+    }
+    _attach_last_tpu(out)
+    print(json.dumps(out))
 
 
 # ---------------------------------------------------------------------------

@@ -84,19 +84,22 @@ def topk_route(
         raise ValueError(f"k={k} exceeds n_experts={n_experts}")
     probs = jax.nn.softmax(logits, axis=-1)
 
-    # Mask chosen experts in LOGIT space with -inf: multiplying probs by
-    # (1 - onehot) re-selects expert 0 whenever the remaining softmax mass
-    # underflows to exactly zero (diverged router), double-booking a queue.
-    masked = logits
+    # Select in LOGIT space with an explicit taken-mask: prob-space
+    # masking re-selects expert 0 when remaining softmax mass underflows
+    # (diverged router), and -inf/finfo.min masking alone still re-picks a
+    # taken expert when the CALLER pads disallowed experts with -inf. A
+    # duplicate pick (only possible when every untaken expert is -inf) is
+    # zeroed outright — no queue slot, no gate weight.
+    taken = jnp.zeros_like(logits, dtype=jnp.int32)
     chosen = []  # (onehot_int [t,e], gate [t])
     for _ in range(k):
-        expert = jnp.argmax(masked, axis=-1)
+        avail = jnp.where(taken > 0, -jnp.inf, logits)
+        expert = jnp.argmax(avail, axis=-1)
         onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+        onehot = onehot * (1 - taken)  # zero a duplicate pick entirely
         gate = (probs * onehot).sum(-1)
         chosen.append((onehot, gate))
-        masked = jnp.where(
-            onehot > 0, jnp.finfo(masked.dtype).min, masked
-        )
+        taken = taken + onehot
 
     # Queue bookkeeping in int32 (as top1_route does): a low-precision
     # logits dtype must never round slot indices — bf16 cumsum collides

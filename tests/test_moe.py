@@ -241,3 +241,21 @@ def test_topk_no_duplicate_expert_on_underflow():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="exceeds"):
         topk_route(logits, capacity=4, k=5)
+
+
+def test_topk_respects_caller_neg_inf_padding():
+    """Callers mask disallowed experts with -inf; even when k exceeds the
+    remaining finite experts, a taken expert must never be picked twice
+    (the duplicate slot is dropped instead)."""
+    from chainermn_tpu.parallel.moe import topk_route
+
+    neg = float("-inf")
+    logits = jnp.array([[5.0, 1.0, neg, 0.5]] * 8, jnp.float32)
+    dispatch, combine = topk_route(logits, capacity=8, k=4)
+    d = np.asarray(dispatch)
+    per_token_expert = d.sum(axis=2)
+    assert (per_token_expert <= 1.0 + 1e-6).all(), "expert double-booked"
+    # the three finite experts each picked once; the -inf expert may absorb
+    # one pick with zero gate, never a duplicate of a finite one
+    c = np.asarray(combine)
+    assert np.isfinite(c).all()
