@@ -134,3 +134,9 @@ def create_synchronized_iterator(
     reference's synchronized iterator variant)."""
     del comm  # same seed on every process — nothing to exchange
     return _BatchIterator(dataset, batch_size, shuffle=shuffle, seed=seed)
+
+
+__all__ = [
+    "create_multi_node_iterator",
+    "create_synchronized_iterator",
+]

@@ -91,3 +91,10 @@ def available(name: str = "host_comm") -> bool:
         return True
     except NativeBuildError:
         return False
+
+
+__all__ = [
+    "NativeBuildError",
+    "lib_path",
+    "available",
+]

@@ -203,3 +203,11 @@ def create_multi_node_optimizer(
         double_buffering=double_buffering,
         compress_dtype=allreduce_grad_dtype,
     )
+
+
+__all__ = [
+    "MultiNodeOptimizer",
+    "allreduce_gradients",
+    "allreduce_grads_transform",
+    "create_multi_node_optimizer",
+]

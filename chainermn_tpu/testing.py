@@ -1,0 +1,176 @@
+"""Public testing utilities — the user-facing form of this repo's own
+test harness.
+
+The reference's users tested distributed code by launching pytest under
+MPI (``mpiexec -n 2 pytest``, SURVEY.md section 4) with ``MPI.COMM_WORLD``
+as the implicit fixture. The TPU-native analog is a single process with N
+virtual CPU devices; these helpers package that recipe so downstream
+projects don't have to rediscover it (device-count flags must be set
+before JAX initialises, reference values must use CPU arithmetic, and the
+key invariant — distributed result == single-device result — deserves a
+one-call assertion).
+
+Typical conftest.py in a downstream project::
+
+    import chainermn_tpu.testing as cmt
+    cmt.ensure_virtual_devices(8)      # BEFORE anything imports jax
+
+    import pytest
+
+    @pytest.fixture(scope="session")
+    def comm():
+        return cmt.make_test_communicator()
+
+and in tests::
+
+    cmt.assert_distributed_equals_single(
+        distributed_fn, single_fn, comm, batch)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+PyTree = Any
+
+
+def ensure_virtual_devices(n: int = 8) -> None:
+    """Arrange for ``n`` virtual CPU devices. Call BEFORE jax initialises
+    (ideally before it is imported): the host-platform device count is a
+    process-start XLA flag, not a runtime switch.
+
+    Raises if jax is already initialised with fewer CPU devices — a later
+    call cannot fix that, and silently continuing would make every
+    mesh-of-``n`` test fail with confusing divisibility errors.
+    """
+    import re
+    import sys
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    elif int(m.group(1)) < n:
+        # Raise the pre-set count. XLA reads the flag at backend INIT, so
+        # this works even after `import jax` — only a live backend (the
+        # check below) makes it too late.
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}"
+        )
+
+    if "jax" in sys.modules:
+        import jax
+
+        from jax._src import xla_bridge as xb
+
+        if xb._backends:
+            have = len(jax.devices("cpu"))
+            if have < n:
+                raise RuntimeError(
+                    f"jax already initialised with {have} CPU devices; "
+                    f"ensure_virtual_devices({n}) must run before the "
+                    "first jax backend use (put it at the top of "
+                    "conftest.py)"
+                )
+
+
+def make_test_communicator(name: str = "naive", **kwargs):
+    """The canonical hermetic test communicator: a CPU mesh that never
+    touches (or hangs on) an accelerator plugin. See
+    :class:`~chainermn_tpu.communicators.xla_communicator.NaiveCommunicator`
+    for the platform-pinning contract.
+
+    Also pins the DEFAULT device to CPU (as this repo's own conftest
+    does): reference values computed eagerly in tests must use the same
+    arithmetic as the CPU-mesh distributed computation — an accelerator
+    default device's bf16 matmul passes would skew them by ~1e-3 and
+    fail :func:`assert_distributed_equals_single` tolerances spuriously.
+    """
+    import jax
+
+    from chainermn_tpu import create_communicator
+
+    comm = create_communicator(name, **kwargs)
+    if name == "naive":
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    return comm
+
+
+def assert_allclose_tree(
+    actual: PyTree,
+    desired: PyTree,
+    *,
+    rtol: float = 1e-5,
+    atol: float = 1e-6,
+) -> None:
+    """``np.testing.assert_allclose`` over two pytrees, leaf-wise, with the
+    failing leaf's tree path in the error message."""
+    import jax
+    import numpy as np
+
+    actual_leaves = jax.tree_util.tree_leaves_with_path(actual)
+    desired_leaves = jax.tree_util.tree_leaves_with_path(desired)
+    assert len(actual_leaves) == len(desired_leaves), (
+        f"tree size mismatch: {len(actual_leaves)} vs {len(desired_leaves)}"
+    )
+    for (path_a, leaf_a), (path_d, leaf_d) in zip(
+        actual_leaves, desired_leaves
+    ):
+        assert path_a == path_d, f"tree paths diverge: {path_a} vs {path_d}"
+        np.testing.assert_allclose(
+            np.asarray(leaf_a),
+            np.asarray(leaf_d),
+            rtol=rtol,
+            atol=atol,
+            err_msg=jax.tree_util.keystr(path_a),
+        )
+
+
+def assert_distributed_equals_single(
+    distributed_fn: Callable,
+    single_fn: Callable,
+    comm,
+    batch: PyTree,
+    *,
+    rtol: float = 1e-4,
+    atol: float = 1e-5,
+) -> None:
+    """The reference's universal invariant (SURVEY.md section 4: "Key
+    invariant tested everywhere"), as one call.
+
+    Args:
+      distributed_fn: ``distributed_fn(comm, batch) -> result`` — runs the
+        distributed computation over the communicator's mesh (batch is the
+        GLOBAL batch; shard it inside however the code under test does).
+      single_fn: ``single_fn(batch) -> result`` — the single-device
+        reference on the same global batch.
+      comm: a communicator (typically :func:`make_test_communicator`).
+      batch: the global input pytree.
+    """
+    assert_allclose_tree(
+        distributed_fn(comm, batch),
+        single_fn(batch),
+        rtol=rtol,
+        atol=atol,
+    )
+
+
+def seeded_batch(shape, seed: int = 0, *, scale: float = 1.0):
+    """Deterministic synthetic f32 data — the same generator every example
+    uses, exposed so downstream tests match docs/snippets exactly."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return (rng.randn(*shape) * scale).astype(np.float32)
+
+
+__all__ = [
+    "ensure_virtual_devices",
+    "make_test_communicator",
+    "assert_allclose_tree",
+    "assert_distributed_equals_single",
+    "seeded_batch",
+]

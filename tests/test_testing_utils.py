@@ -1,0 +1,56 @@
+"""The public testing helpers must themselves work — they are the
+user-facing form of this suite's harness (SURVEY.md section 4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu.testing as cmt
+
+
+def test_ensure_virtual_devices_is_idempotent_when_satisfied():
+    # conftest already forced 8 CPU devices; asking for <= that is a no-op
+    cmt.ensure_virtual_devices(8)
+    cmt.ensure_virtual_devices(4)
+    assert len(jax.devices("cpu")) >= 8
+
+
+def test_ensure_virtual_devices_rejects_late_increase():
+    with pytest.raises(RuntimeError, match="before the first jax backend"):
+        cmt.ensure_virtual_devices(64)
+
+
+def test_assert_allclose_tree_reports_path():
+    good = {"a": jnp.ones(3), "b": (jnp.zeros(2), jnp.ones(1))}
+    cmt.assert_allclose_tree(good, good)
+    bad = {"a": jnp.ones(3), "b": (jnp.zeros(2) + 0.5, jnp.ones(1))}
+    with pytest.raises(AssertionError, match=r"\['b'\]"):
+        cmt.assert_allclose_tree(bad, good)
+
+
+def test_distributed_equals_single_helper():
+    comm = cmt.make_test_communicator()
+    x = cmt.seeded_batch((32, 4), seed=3)
+
+    def single(batch):
+        return (jnp.asarray(batch) ** 2).mean(axis=0)
+
+    def distributed(comm, batch):
+        def local(xl):
+            return jax.lax.pmean((xl**2).mean(axis=0), comm.axis_name)
+
+        return shard_map(
+            local, mesh=comm.mesh, in_specs=P(comm.axis_name),
+            out_specs=P(), check_vma=False,
+        )(jnp.asarray(batch))
+
+    cmt.assert_distributed_equals_single(distributed, single, comm, x)
+
+    def broken(comm, batch):
+        return distributed(comm, batch) * 1.5
+
+    with pytest.raises(AssertionError):
+        cmt.assert_distributed_equals_single(broken, single, comm, x)
