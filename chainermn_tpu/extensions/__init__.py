@@ -12,10 +12,22 @@ from chainermn_tpu.extensions.checkpoint import (
 from chainermn_tpu.extensions.allreduce_persistent import AllreducePersistent
 from chainermn_tpu.extensions.observation_aggregator import ObservationAggregator
 
+
+def __getattr__(name):
+    # Lazy: orbax import is heavy and optional for users of the npz path.
+    if name in ("OrbaxMultiNodeCheckpointer", "create_orbax_checkpointer"):
+        from chainermn_tpu.extensions import orbax_adapter
+
+        return getattr(orbax_adapter, name)
+    raise AttributeError(name)
+
+
 __all__ = [
     "create_multi_node_evaluator",
     "create_multi_node_checkpointer",
     "MultiNodeCheckpointer",
     "AllreducePersistent",
     "ObservationAggregator",
+    "OrbaxMultiNodeCheckpointer",
+    "create_orbax_checkpointer",
 ]

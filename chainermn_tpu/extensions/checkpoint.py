@@ -106,6 +106,35 @@ def _assemble_sharded(key: str, data, template_leaf, tshape):
     )
 
 
+def agree_max_common_step(
+    comm: CommunicatorBase,
+    local_iterations,
+    drain_err: Optional[str] = None,
+) -> Optional[int]:
+    """The cross-rank resume agreement, shared by every checkpoint backend
+    (npz and orbax): allgather ``(iterations, drain-error)`` in ONE
+    collective, raise SYMMETRICALLY on every rank if any rank's async
+    writes failed (a raising preamble before the collective would hang the
+    healthy ranks inside allgather), else return the newest iteration ALL
+    ranks possess (``None`` when no common step exists). Reference
+    protocol: SURVEY.md section 3.5."""
+    everyone = comm.allgather_obj(
+        {"its": sorted(local_iterations), "err": drain_err}
+    )
+    errs = [
+        f"rank {r}: {e['err']}" for r, e in enumerate(everyone) if e["err"]
+    ]
+    if errs:
+        raise RuntimeError(
+            "async checkpoint write failures detected at restore: "
+            + "; ".join(errs)
+        )
+    common = set(everyone[0]["its"])
+    for entry in everyone[1:]:
+        common &= set(entry["its"])
+    return max(common) if common else None
+
+
 class MultiNodeCheckpointer:
     def __init__(
         self,
@@ -209,6 +238,15 @@ class MultiNodeCheckpointer:
             self._writer.wait()
             self._gc()
 
+    def close(self) -> None:
+        """Drain AND release: the native writer's C worker thread and
+        queue buffers are freed here, not left for GC (long-lived
+        processes create many checkpointers)."""
+        self.wait_async()
+        if self._writer is not None:
+            self._writer.finalize()
+            self._writer = None
+
     def maybe_load(self, state_template: PyTree) -> tuple[PyTree, Optional[int]]:
         """Resume from the newest iteration available on *all* processes
         (reference: gather available iters -> max common -> deserialize,
@@ -223,24 +261,11 @@ class MultiNodeCheckpointer:
             self.wait_async()
         except RuntimeError as e:
             drain_err = str(e)
-        local = set(self._local_iterations())
-        everyone = self.comm.allgather_obj(
-            {"its": sorted(local), "err": drain_err}
+        it = agree_max_common_step(
+            self.comm, self._local_iterations(), drain_err
         )
-        errs = [
-            f"rank {r}: {e['err']}" for r, e in enumerate(everyone) if e["err"]
-        ]
-        if errs:
-            raise RuntimeError(
-                "async checkpoint write failures detected at restore: "
-                + "; ".join(errs)
-            )
-        common = set(everyone[0]["its"])
-        for entry in everyone[1:]:
-            common &= set(entry["its"])
-        if not common:
+        if it is None:
             return state_template, None
-        it = max(common)
         data = np.load(self._fname(it))
         flat, treedef = jax.tree_util.tree_flatten_with_path(state_template)
         keys = [_path_key(p) for p, _ in flat]

@@ -59,6 +59,11 @@ def main(argv=None):
                         "iters (async native writer); resumes automatically "
                         "from the newest snapshot all ranks share")
     p.add_argument("--checkpoint-interval", type=int, default=50)
+    p.add_argument("--checkpoint-backend", default="npz",
+                   choices=("npz", "orbax"),
+                   help="npz: the framework's per-rank snapshot format; "
+                        "orbax: stock orbax CheckpointManager storage with "
+                        "the same cross-rank resume agreement")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(
@@ -110,9 +115,16 @@ def main(argv=None):
     ckpt = None
     start_iteration = 0
     if args.checkpoint:
-        ckpt = chainermn_tpu.create_multi_node_checkpointer(
-            "mnist", comm, path=args.checkpoint
-        )
+        if args.checkpoint_backend == "orbax":
+            from chainermn_tpu.extensions import create_orbax_checkpointer
+
+            ckpt = create_orbax_checkpointer(
+                "mnist", comm, path=args.checkpoint
+            )
+        else:
+            ckpt = chainermn_tpu.create_multi_node_checkpointer(
+                "mnist", comm, path=args.checkpoint
+            )
         state, restored_it = ckpt.maybe_load(state)
         if restored_it is not None:
             start_iteration = restored_it
@@ -142,7 +154,7 @@ def main(argv=None):
         # --iterations, trainer.run did 0 steps and the weights are still
         # start_iteration's.
         ckpt.save(state, start_iteration + trainer.iteration, block=False)
-        ckpt.wait_async()  # durable before we report success
+        ckpt.close()  # drain async saves + release the backend
 
     final = evaluator(state)
     if comm.rank == 0:
