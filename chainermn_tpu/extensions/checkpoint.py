@@ -241,11 +241,14 @@ class MultiNodeCheckpointer:
     def close(self) -> None:
         """Drain AND release: the native writer's C worker thread and
         queue buffers are freed here, not left for GC (long-lived
-        processes create many checkpointers)."""
-        self.wait_async()
-        if self._writer is not None:
-            self._writer.finalize()
-            self._writer = None
+        processes create many checkpointers) — even when the drain
+        surfaces a write failure."""
+        try:
+            self.wait_async()
+        finally:
+            if self._writer is not None:
+                self._writer.finalize()
+                self._writer = None
 
     def maybe_load(self, state_template: PyTree) -> tuple[PyTree, Optional[int]]:
         """Resume from the newest iteration available on *all* processes

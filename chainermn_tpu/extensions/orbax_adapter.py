@@ -68,11 +68,15 @@ class OrbaxMultiNodeCheckpointer:
 
         # npz-backend parity: re-saving an iteration overwrites it (orbax's
         # ``force`` only bypasses the save-interval policy; an existing
-        # step raises instead). Delete-then-save is not atomic — a crash
-        # between the two loses this step locally — which the cross-rank
-        # agreement absorbs: resume falls back to the previous common step.
+        # step raises instead). Drain BEFORE the existence check — orbax
+        # commits pending async saves inside save() and would then raise
+        # on a step that wasn't in all_steps() moments earlier (TOCTOU:
+        # async save of step N in flight + resave of N). Delete-then-save
+        # is not atomic — a crash between the two loses this step locally
+        # — which the cross-rank agreement absorbs: resume falls back to
+        # the previous common step.
+        self._mgr.wait_until_finished()
         if iteration in self._mgr.all_steps():
-            self._mgr.wait_until_finished()
             self._mgr.delete(iteration)
         self._mgr.save(
             iteration, args=ocp.args.StandardSave(state), force=True

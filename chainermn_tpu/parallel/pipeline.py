@@ -114,6 +114,7 @@ def make_pipeline(
     axis_name: str = "stage",
     n_microbatches: Optional[int] = None,
     remat_stages: bool = False,
+    batch_axis: Optional[str] = None,
 ):
     """Build a jitted pipelined apply over stacked stage parameters.
 
@@ -131,6 +132,13 @@ def make_pipeline(
     stage depth: deep stages drop from "every intermediate per tick" to
     "one boundary tensor per tick" — activation checkpointing per
     microbatch, not a full 1F1B scheduler.
+
+    ``batch_axis`` composes data parallelism with the pipeline (a 2-D
+    ``(batch_axis, axis_name)`` mesh): the global batch is sharded over
+    ``batch_axis``, each data-slice runs its own pipeline schedule over
+    the stage axis, and ``n_microbatches`` splits each shard's LOCAL
+    batch. Gradient reduction over ``batch_axis`` is the caller's (e.g.
+    the multi-node optimizer's) job, as with any data-parallel step.
     """
     from jax import shard_map
 
@@ -140,7 +148,7 @@ def make_pipeline(
         stage_fn = jax.checkpoint(stage_fn)
 
     param_spec = P(axis_name)
-    x_spec = P()  # replicated; stage 0 reads it
+    x_spec = P(batch_axis)  # replicated over stages; dp-sharded if asked
 
     def local(stacked_params, x):
         # shard_map gave us a [1, ...] slice of each stacked leaf: collapse.
@@ -159,7 +167,7 @@ def make_pipeline(
         local,
         mesh=mesh,
         in_specs=(param_spec, x_spec),
-        out_specs=P(),
+        out_specs=P(batch_axis),
         check_vma=False,
     )
     return jax.jit(fn)
@@ -391,6 +399,7 @@ def make_pipeline_1f1b(
     *,
     axis_name: str = "stage",
     n_microbatches: Optional[int] = None,
+    batch_axis: Optional[str] = None,
 ):
     """Build the jitted 1F1B train-step core:
     ``fn(stacked_params, x, targets[, head_params]) ->
@@ -408,6 +417,12 @@ def make_pipeline_1f1b(
     result; ``collect_input_grads=True`` additionally appends the
     gradient wrt ``x`` (shape ``[batch, ...]``) for an embed before the
     pipeline.
+
+    ``batch_axis`` composes data parallelism (2-D ``(batch_axis,
+    axis_name)`` mesh): the global batch/targets shard over
+    ``batch_axis``, each data-slice runs its own 1F1B schedule, and the
+    returned loss / stage grads / head grads are ALREADY averaged over
+    ``batch_axis`` (x_grads stay per-shard, matching the sharded x).
     """
     from jax import shard_map
 
@@ -430,20 +445,36 @@ def make_pipeline_1f1b(
                 head_params=head_params if with_head else None,
                 collect_input_grads=collect_input_grads,
             )
-            loss, grads = res[0], jax.tree.map(lambda g: g[None], res[1])
-            rest = res[2:]
+            loss, grads = res[0], res[1]
+            rest = list(res[2:])
+            if batch_axis is not None:
+                # Data-parallel reduction INSIDE the program — the same
+                # place the train step pmeans its grads.
+                loss = lax.pmean(loss, batch_axis)
+                grads = lax.pmean(grads, batch_axis)
+                if with_head:
+                    rest[0] = lax.pmean(rest[0], batch_axis)
+            grads = jax.tree.map(lambda g: g[None], grads)
             if collect_input_grads:
-                *rest, xg = rest
-                rest = tuple(rest) + (
-                    xg.reshape((batch,) + xg.shape[2:]),
-                )
+                xg = rest.pop()
+                if batch_axis is not None:
+                    # x is sharded over batch_axis and each element lives
+                    # in exactly one shard, so d(pmean-ed loss)/dx is the
+                    # per-shard gradient scaled by 1/n_data — keeping the
+                    # 'gradient of the RETURNED loss' contract exact.
+                    xg = xg / lax.axis_size(batch_axis)
+                rest.append(xg.reshape((batch,) + xg.shape[2:]))
             return (loss, grads) + tuple(rest)
 
-        extra_specs = (P(),) * (int(with_head) + int(collect_input_grads))
+        extra_specs = ()
+        if with_head:
+            extra_specs += (P(),)
+        if collect_input_grads:
+            extra_specs += (P(batch_axis),)
         return shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(axis_name), P(), P(), P()),
+            in_specs=(P(axis_name), P(batch_axis), P(batch_axis), P()),
             out_specs=(P(), P(axis_name)) + extra_specs,
             check_vma=False,
         )

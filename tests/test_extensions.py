@@ -319,3 +319,19 @@ def test_orbax_restore_returns_host_arrays(tmp_path, comm):
     assert isinstance(restored["w"], np.ndarray)
     assert isinstance(restored["step"], np.ndarray)
     ckpt.close()
+
+
+def test_orbax_async_save_then_resave_same_step(tmp_path, comm):
+    """An uncommitted async save of step N followed by a blocking resave
+    of N must overwrite, not raise StepAlreadyExistsError (orbax commits
+    the pending save inside save() — the TOCTOU the drain-first fixes)."""
+    pytest.importorskip("orbax.checkpoint")
+    from chainermn_tpu.extensions import create_orbax_checkpointer
+
+    ckpt = create_orbax_checkpointer("toctou", comm, path=str(tmp_path))
+    ckpt.save({"x": jnp.zeros(2)}, iteration=5, block=False)
+    ckpt.save({"x": jnp.ones(2)}, iteration=5)  # same step, pending async
+    restored, it = ckpt.maybe_load({"x": jnp.zeros(2)})
+    assert it == 5
+    np.testing.assert_array_equal(np.asarray(restored["x"]), np.ones(2))
+    ckpt.close()

@@ -380,3 +380,59 @@ def test_1f1b_uses_less_temp_memory_than_gpipe(comm):
         f"1F1B temp {f.temp_size_in_bytes/1e6:.1f}MB not below GPipe "
         f"{g.temp_size_in_bytes/1e6:.1f}MB"
     )
+
+
+class TestDataParallelComposition:
+    """dp x pp on a (data=2, stage=4) mesh == sequential on the full
+    batch."""
+
+    def _mesh2d(self):
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+        return Mesh(devs, ("data", "stage"))
+
+    def test_gpipe_apply_values_with_batch_axis(self):
+        mesh = self._mesh2d()
+        params_list = _params(40, 4)
+        stacked = stack_stage_params(params_list)
+        x = jax.random.normal(jax.random.PRNGKey(41), (32, DIM))
+
+        fn = make_pipeline(stage_fn, mesh, axis_name="stage",
+                           n_microbatches=4, batch_axis="data")
+        out = fn(stacked, x)
+        ref = _sequential(params_list, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_1f1b_dp_grads_match_sequential(self):
+        from chainermn_tpu.parallel.pipeline import make_pipeline_1f1b
+
+        mesh = self._mesh2d()
+        n_stages, n_micro, batch = 4, 8, 32
+        params_list = _params(42, n_stages)
+        stacked = stack_stage_params(params_list)
+        x = jax.random.normal(jax.random.PRNGKey(43), (batch, DIM))
+        y = jax.random.normal(jax.random.PRNGKey(44), (batch, DIM))
+
+        lg = jax.value_and_grad(lambda o, t: ((o - t) ** 2).mean())
+        fn = make_pipeline_1f1b(stage_fn, lg, mesh, axis_name="stage",
+                                n_microbatches=n_micro, batch_axis="data")
+        loss, grads = fn(stacked, x, y)
+
+        # sequential reference: mean over (data shards x microbatches) of
+        # per-microbatch mean losses == full-batch mean (equal sizes)
+        def loss_seq(stacked):
+            pl = [jax.tree.map(lambda l: l[i], stacked)
+                  for i in range(n_stages)]
+            out = _sequential(pl, x)
+            return ((out - y) ** 2).mean()
+
+        ref_loss, ref_grads = jax.value_and_grad(loss_seq)(stacked)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            ),
+            grads, ref_grads,
+        )
