@@ -267,3 +267,54 @@ def test_tp_attention_head_divisibility(mesh):
             local, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
             check_vma=False,
         )(x, w)
+
+
+def test_tp_composes_with_data_parallelism():
+    """dp(2) x tp(4): batch sharded over 'data', hidden sharded over
+    'model'; grads (pmean over data inside the step, per the framework's
+    train-step pattern) equal the sequential full-batch computation."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices("cpu")[:N]).reshape(2, 4)
+    mesh2 = Mesh(devs, ("data", "model"))
+    d, d_ff, batch = 6, 16, 8
+
+    x = _rand(50, (batch, d))
+    w1, w2 = _rand(51, (d, d_ff)), _rand(52, (d_ff, d))
+    w1s, w2s = stack_tp_params(w1, 4, 1), stack_tp_params(w2, 4, 0)
+
+    def ref_loss(w1, w2, x):
+        return jnp.mean((jax.nn.gelu(x @ w1) @ w2) ** 2)
+
+    def local_step(w1l, w2l, xl):
+        def loss(w1l, w2l):
+            y = tp_mlp(xl, w1l, None, w2l, None, axis_name="model")
+            return jnp.mean(y**2)
+
+        l, g = jax.value_and_grad(loss, argnums=(0, 1))(w1l[0], w2l[0])
+        # data-parallel reduction, as in every train step
+        l = jax.lax.pmean(l, "data")
+        g = jax.lax.pmean(g, "data")
+        return l, g[0][None], g[1][None]
+
+    loss_d, g1, g2 = jax.jit(
+        shard_map(
+            local_step, mesh=mesh2,
+            in_specs=(P("model"), P("model"), P("data")),
+            out_specs=(P(), P("model"), P("model")),
+            check_vma=False,
+        )
+    )(w1s, w2s, x)
+
+    ref_l, (g1_ref, g2_ref) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1)
+    )(w1, w2, x)
+    np.testing.assert_allclose(float(loss_d), float(ref_l), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.concatenate(list(np.asarray(g1)), axis=1), np.asarray(g1_ref),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.concatenate(list(np.asarray(g2)), axis=0), np.asarray(g2_ref),
+        rtol=1e-4, atol=1e-5,
+    )
