@@ -9,5 +9,12 @@ the optimizer update — which is the TPU mapping of the reference's whole
 
 from chainermn_tpu.training.train_step import TrainState, make_train_step, make_eval_step
 from chainermn_tpu.training.trainer import Trainer
+from chainermn_tpu.training.prefetch import prefetch_to_device
 
-__all__ = ["TrainState", "make_train_step", "make_eval_step", "Trainer"]
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+    "Trainer",
+    "prefetch_to_device",
+]

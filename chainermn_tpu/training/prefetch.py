@@ -1,0 +1,73 @@
+"""Device-side input prefetching.
+
+The reference's input story was Chainer's ``MultiprocessIterator`` (host
+worker processes); its device transfer happened synchronously inside the
+update. This framework's native C++ loader covers the host side
+(:mod:`chainermn_tpu.native.data_loader`); this module covers the
+device side: keep the next ``size`` batches already submitted for
+transfer so the host→HBM copy of batch ``t+1`` overlaps the step running
+on batch ``t`` (JAX dispatch is asynchronous — ``device_put`` returns
+while the copy is in flight; yielding from a bounded deque gives the
+copies a head start without unbounded memory growth).
+
+The classic pattern (flax's ``jax_utils.prefetch_to_device``) adapted to
+this framework's batch flow: works on any pytree iterator, optionally
+placing to an explicit sharding (multihost global batches pass through
+untouched — they are already device-resident).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+
+PyTree = Any
+
+
+def prefetch_to_device(
+    iterator: Iterable[PyTree],
+    size: int = 2,
+    *,
+    sharding: Optional[Any] = None,
+) -> Iterator[PyTree]:
+    """Yield batches from ``iterator`` with up to ``size`` of them already
+    submitted to the device.
+
+    Args:
+      iterator: yields host-side batch pytrees (numpy or jax arrays; jax
+        arrays pass through placement untouched when already committed).
+      size: in-flight batch count. 2 = classic double buffering; each
+        buffered batch holds HBM for its full pytree, so keep it small.
+      sharding: optional ``jax.sharding.Sharding`` (or pytree of them) for
+        ``jax.device_put``; default places to the default device (the
+        jitted step re-places under its own in_shardings as needed, which
+        for host arrays is free — the bytes are already on device).
+    """
+    if size < 1:
+        raise ValueError(f"size must be >= 1, got {size}")
+
+    def put(batch: PyTree) -> PyTree:
+        if sharding is not None:
+            return jax.device_put(batch, sharding)
+        return jax.tree.map(
+            lambda leaf: leaf
+            if isinstance(leaf, jax.Array)
+            else jax.device_put(leaf),
+            batch,
+        )
+
+    queue: collections.deque = collections.deque()
+    it = iter(iterator)
+    try:
+        while True:
+            while len(queue) < size:
+                queue.append(put(next(it)))
+            yield queue.popleft()
+    except StopIteration:
+        while queue:
+            yield queue.popleft()
+
+
+__all__ = ["prefetch_to_device"]

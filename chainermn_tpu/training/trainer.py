@@ -71,6 +71,7 @@ class Trainer:
         batch_spec=None,
         log_interval: int = 100,
         out=sys.stdout,
+        prefetch: int = 0,
     ) -> None:
         self.step_fn = step_fn
         self.state = state
@@ -91,6 +92,10 @@ class Trainer:
         self.batch_spec = batch_spec
         self.log_interval = log_interval
         self.out = out
+        #: batches kept in flight on device ahead of the step (0 = off;
+        #: 2 = double buffering). See
+        #: :func:`chainermn_tpu.training.prefetch.prefetch_to_device`.
+        self.prefetch = prefetch
         self.iteration = 0
         self.observation: dict[str, float] = {}
         self._extensions: list[tuple[int, Callable]] = []
@@ -104,11 +109,13 @@ class Trainer:
         if self.comm.rank == 0:
             print(msg, file=self.out, flush=True)
 
-    def run(self, max_iterations: int) -> Any:
-        t0 = time.perf_counter()
+    def _collated_batches(self, n: int):
+        """Yield exactly ``n`` collated, mesh-global batches, restarting
+        the epoch iterator as needed (with the empty-epoch guard)."""
+        produced = 0
         it = iter(self.train_iter)
         fresh_epoch = True
-        while self.iteration < max_iterations:
+        while produced < n:
             try:
                 batch = next(it)
                 fresh_epoch = False
@@ -122,9 +129,19 @@ class Trainer:
                 it = iter(self.train_iter)
                 fresh_epoch = True
                 continue
-            collated = host_local_batch_to_global(
+            produced += 1
+            yield host_local_batch_to_global(
                 self.collate(batch), self.comm, self.batch_spec
             )
+
+    def run(self, max_iterations: int) -> Any:
+        t0 = time.perf_counter()
+        batches = self._collated_batches(max_iterations - self.iteration)
+        if self.prefetch:
+            from chainermn_tpu.training.prefetch import prefetch_to_device
+
+            batches = prefetch_to_device(batches, self.prefetch)
+        for collated in batches:
             self.state, metrics = self.step_fn(self.state, collated)
             self.iteration += 1
 

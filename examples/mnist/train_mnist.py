@@ -59,6 +59,9 @@ def main(argv=None):
                         "iters (async native writer); resumes automatically "
                         "from the newest snapshot all ranks share")
     p.add_argument("--checkpoint-interval", type=int, default=50)
+    p.add_argument("--prefetch", type=int, default=0,
+                   help="device-side input double buffering: batches kept "
+                        "in flight ahead of the step (0 = off)")
     p.add_argument("--checkpoint-backend", default="npz",
                    choices=("npz", "orbax"),
                    help="npz: the framework's per-rank snapshot format; "
@@ -134,7 +137,8 @@ def main(argv=None):
     train_iter = chainermn_tpu.create_synchronized_iterator(
         train, args.batchsize, comm, seed=1
     )
-    trainer = Trainer(step, state, train_iter, comm, log_interval=50)
+    trainer = Trainer(step, state, train_iter, comm, log_interval=50,
+                      prefetch=args.prefetch)
 
     def run_eval(tr):
         metrics = evaluator(tr.state)

@@ -199,3 +199,49 @@ def test_mnist_example_runs():
     final = ex.main(["--communicator", "naive", "--iterations", "20",
                      "--batchsize", "64"])
     assert "val_acc" in final and final["val_acc"] > 0.3
+
+
+def test_prefetch_to_device_order_and_count():
+    from chainermn_tpu.training import prefetch_to_device
+
+    batches = [{"x": np.full((2,), i, np.float32)} for i in range(7)]
+    out = list(prefetch_to_device(iter(batches), size=3))
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        assert isinstance(b["x"], jax.Array)  # placed on device
+        np.testing.assert_array_equal(np.asarray(b["x"]), np.full((2,), i))
+
+    with pytest.raises(ValueError, match=">= 1"):
+        next(prefetch_to_device(iter(batches), size=0))
+
+    # shorter than the buffer: everything still comes out
+    out = list(prefetch_to_device(iter(batches[:2]), size=5))
+    assert len(out) == 2
+
+
+def test_trainer_prefetch_matches_unprefetched(comm):
+    """prefetch=2 must not change training: same batches in the same
+    order -> bit-identical final parameters."""
+    x, y = _data()
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    step = make_train_step(_linreg_loss, opt, comm, donate=False)
+
+    class FixedIter:
+        def __iter__(self):
+            rng = np.random.RandomState(0)
+            for _ in range(6):
+                idx = rng.permutation(len(x))[:16]
+                yield [(x[i], y[i]) for i in idx]
+
+    results = []
+    for prefetch in (0, 2):
+        state = create_train_state(params, opt, comm)
+        tr = Trainer(step, state, FixedIter(), comm, log_interval=100,
+                     out=io.StringIO(), prefetch=prefetch)
+        state = tr.run(12)  # 2 epochs of 6 batches
+        results.append(jax.device_get(state.params))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        results[0], results[1],
+    )
