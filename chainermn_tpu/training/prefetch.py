@@ -58,16 +58,21 @@ def prefetch_to_device(
             batch,
         )
 
-    queue: collections.deque = collections.deque()
-    it = iter(iterator)
-    try:
-        while True:
-            while len(queue) < size:
-                queue.append(put(next(it)))
-            yield queue.popleft()
-    except StopIteration:
-        while queue:
-            yield queue.popleft()
+    def gen() -> Iterator[PyTree]:
+        queue: collections.deque = collections.deque()
+        it = iter(iterator)
+        try:
+            while True:
+                while len(queue) < size:
+                    queue.append(put(next(it)))
+                yield queue.popleft()
+        except StopIteration:
+            while queue:
+                yield queue.popleft()
+
+    # Validate eagerly at the call site (a generator function would defer
+    # the ValueError to the first next(), far from the faulty argument).
+    return gen()
 
 
 __all__ = ["prefetch_to_device"]

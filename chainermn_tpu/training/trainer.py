@@ -138,9 +138,23 @@ class Trainer:
         t0 = time.perf_counter()
         batches = self._collated_batches(max_iterations - self.iteration)
         if self.prefetch:
+            from jax.sharding import NamedSharding, PartitionSpec
+
             from chainermn_tpu.training.prefetch import prefetch_to_device
 
-            batches = prefetch_to_device(batches, self.prefetch)
+            # Place straight to the step's batch sharding: a bare
+            # device_put would commit the whole global batch to device 0
+            # (prefetch-deep HBM spike there) and the step would then
+            # reshard device-to-device.
+            spec = (
+                self.batch_spec
+                if self.batch_spec is not None
+                else PartitionSpec(self.comm.grad_axes)
+            )
+            batches = prefetch_to_device(
+                batches, self.prefetch,
+                sharding=NamedSharding(self.comm.mesh, spec),
+            )
         for collated in batches:
             self.state, metrics = self.step_fn(self.state, collated)
             self.iteration += 1

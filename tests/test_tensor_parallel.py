@@ -318,3 +318,14 @@ def test_tp_composes_with_data_parallelism():
         np.concatenate(list(np.asarray(g2)), axis=0), np.asarray(g2_ref),
         rtol=1e-4, atol=1e-5,
     )
+
+
+def test_tp_example_learns():
+    """The TP example CLI (dp x tp transformer block, teacher regression)
+    reduces loss substantially — attention AND MLP gradients flow through
+    the sharded layers (deterministic seeds: measured 0.25 at these
+    settings)."""
+    import examples.tensor_parallel.train_tp_transformer as ex
+
+    loss = ex.main(["--iterations", "200", "--lr", "3e-3"])
+    assert loss < 0.35, f"tp example did not learn: loss={loss}"
