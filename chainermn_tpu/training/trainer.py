@@ -138,6 +138,8 @@ class Trainer:
         t0 = time.perf_counter()
         batches = self._collated_batches(max_iterations - self.iteration)
         if self.prefetch:
+            import math
+
             from jax.sharding import NamedSharding, PartitionSpec
 
             from chainermn_tpu.training.prefetch import prefetch_to_device
@@ -151,10 +153,31 @@ class Trainer:
                 if self.batch_spec is not None
                 else PartitionSpec(self.comm.grad_axes)
             )
-            batches = prefetch_to_device(
-                batches, self.prefetch,
-                sharding=NamedSharding(self.comm.mesh, spec),
-            )
+            sharding = NamedSharding(self.comm.mesh, spec)
+            dim0_axes = spec[0] if len(spec) else None
+            if dim0_axes is None:
+                n_data = 1
+            elif isinstance(dim0_axes, tuple):
+                n_data = math.prod(
+                    self.comm.mesh.shape[a] for a in dim0_axes
+                )
+            else:
+                n_data = self.comm.mesh.shape[dim0_axes]
+
+            def _place(bs):
+                # Enabling prefetch must never change which batches are
+                # accepted: mesh-shard only batches whose leading dims
+                # divide the data axes; others keep the default placement
+                # (prefetch_to_device passes jax.Arrays through).
+                for b in bs:
+                    fits = all(
+                        leaf.shape[0] % n_data == 0
+                        for leaf in jax.tree.leaves(b)
+                        if getattr(leaf, "ndim", 0) >= 1
+                    )
+                    yield jax.device_put(b, sharding) if fits else b
+
+            batches = prefetch_to_device(_place(batches), self.prefetch)
         for collated in batches:
             self.state, metrics = self.step_fn(self.state, collated)
             self.iteration += 1

@@ -1,0 +1,115 @@
+"""Observability utilities and the global except hook — the aux-subsystem
+coverage SURVEY.md section 5 calls for (rank-0 gating, divergence checks,
+profiling wrappers, whole-job abort)."""
+
+import contextlib
+import io
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from chainermn_tpu import create_communicator, global_except_hook
+from chainermn_tpu.utils.observability import (
+    annotate,
+    assert_same_on_all_hosts,
+    log0,
+    profile,
+    rank_zero_only,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+def test_log0_gates_on_rank(comm, capsys):
+    log0(comm, "hello", 42)
+    assert capsys.readouterr().out == "hello 42\n"
+    log0(None, "also prints")
+    assert "also prints" in capsys.readouterr().out
+
+    class Fake:
+        rank = 3
+
+    log0(Fake(), "must not print")
+    assert capsys.readouterr().out == ""
+
+
+def test_rank_zero_only_decorator(comm):
+    calls = []
+
+    @rank_zero_only(comm)
+    def record(x):
+        calls.append(x)
+        return x * 2
+
+    assert record(3) == 6  # naive comm is rank 0
+    assert calls == [3]
+
+    class Fake:
+        rank = 1
+
+    @rank_zero_only(Fake())
+    def never(x):
+        raise AssertionError("ran on nonzero rank")
+
+    assert never(1) is None
+
+
+def test_assert_same_on_all_hosts_single_process_noop(comm):
+    # single-process: must be a no-op for scalars AND generic objects
+    assert_same_on_all_hosts(3, "step")
+    assert_same_on_all_hosts({"spec": (8, 224, 224, 3)}, "batch-shape")
+
+
+def test_annotate_and_profile(tmp_path):
+    with annotate("test-span"):
+        x = jnp.ones((4,)) * 2
+    with profile(str(tmp_path / "trace")):
+        y = (x @ x).block_until_ready()
+    assert float(y) == 16.0
+    # the profiler must have written its trace layout
+    written = []
+    for root, _, files in os.walk(tmp_path):
+        written += files
+    assert written, "profile() wrote no trace files"
+
+
+def test_global_except_hook_formats_and_preserves_process(capsys):
+    """Single-process: the hook prints the rank-tagged traceback and does
+    NOT hard-exit (teardown is only for multi-process worlds)."""
+    global_except_hook._add_hook()
+    global_except_hook._add_hook()  # idempotent
+    assert sys.excepthook is global_except_hook._global_except_hook
+
+    try:
+        raise ValueError("boom for the hook")
+    except ValueError:
+        exctype, value, tb = sys.exc_info()
+    sys.excepthook(exctype, value, tb)
+    err = capsys.readouterr().err
+    assert "uncaught exception on process 0" in err
+    assert "boom for the hook" in err
+
+
+def test_global_except_hook_never_masks_original(capsys, monkeypatch):
+    """A failure inside the hook itself falls back to the default
+    excepthook — the original traceback must still reach stderr."""
+    import traceback as tb_mod
+
+    def explode(*a, **k):
+        raise RuntimeError("hook internals broke")
+
+    monkeypatch.setattr(tb_mod, "print_exception", explode)
+    try:
+        raise KeyError("the real error")
+    except KeyError:
+        exctype, value, tb = sys.exc_info()
+    # must not raise; must delegate to sys.__excepthook__
+    global_except_hook._global_except_hook(exctype, value, tb)
+    err = capsys.readouterr().err
+    assert "the real error" in err

@@ -245,3 +245,33 @@ def test_trainer_prefetch_matches_unprefetched(comm):
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         results[0], results[1],
     )
+
+
+def test_trainer_prefetch_accepts_nondivisible_batches(comm):
+    """Enabling prefetch must not change which batch sizes are accepted:
+    a leading dim not divisible by the mesh falls back to default
+    placement instead of crashing in device_put."""
+    x, y = _data(n=24)
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    # plain jit step (not mesh-sharded): accepts any batch size
+    inner = optax.sgd(0.1)
+
+    @jax.jit
+    def step(state, batch):
+        (loss, _), grads = jax.value_and_grad(_linreg_loss, has_aux=True)(
+            state[0], batch
+        )
+        upd, opt_state = inner.update(grads, state[1], state[0])
+        return (optax.apply_updates(state[0], upd), opt_state), {"loss": loss}
+
+    class _Iter:
+        def __iter__(self):
+            # 12 examples per batch: 12 % 8 != 0
+            yield [(x[i], y[i]) for i in range(12)]
+            yield [(x[i], y[i]) for i in range(12, 24)]
+
+    tr = Trainer(step, (params, inner.init(params)), _Iter(), comm,
+                 log_interval=100, out=io.StringIO(), prefetch=2)
+    state = tr.run(2)
+    assert np.isfinite(float(jax.device_get(state[0]["w"])[0]))
