@@ -35,6 +35,23 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
+def load_example(*rel):
+    """Load an example module by FILE PATH. A site-packages regular
+    package named ``examples`` shadows the repo's namespace portions for
+    any subdirectory both define (observed: ``examples.transformer``),
+    so package imports are unreliable for examples — use this instead."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", *rel,
+    )
+    spec = importlib.util.spec_from_file_location(rel[-1][:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     devs = jax.devices("cpu")

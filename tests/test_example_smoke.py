@@ -1,0 +1,37 @@
+"""Smoke coverage for the examples without their own example-level test:
+tiny configs, a handful of iterations — proof every documented CLI still
+runs end to end on the 8-way CPU mesh (the reference ran its examples
+under MPI as its de-facto integration suite, SURVEY.md section 2.8)."""
+
+from conftest import load_example as _load_example
+
+
+def test_transformer_example_smoke():
+    ex = _load_example("transformer", "train_transformer_lm.py")
+    ex.main([
+        "--iterations", "4", "--batchsize", "8", "--seq-len", "32",
+        "--num-layers", "1", "--d-model", "32",
+    ])
+
+
+def test_transformer_example_sequence_parallel_smoke():
+    ex = _load_example("transformer", "train_transformer_lm.py")
+    ex.main([
+        "--iterations", "3", "--batchsize", "8", "--seq-len", "32",
+        "--num-layers", "1", "--d-model", "32", "--sequence-parallel",
+    ])
+
+
+def test_seq2seq_example_smoke_with_bleu():
+    import examples.seq2seq.seq2seq as ex
+
+    ex.main([
+        "--iterations", "30", "--batchsize", "16", "--eval",
+        "--eval-size", "32",
+    ])
+
+
+def test_parallel_conv_example_smoke():
+    import examples.parallel_convolution.train_parallel_conv as ex
+
+    ex.main(["--iterations", "5"])
