@@ -468,3 +468,97 @@ def test_create_mnbn_model_respects_explicit_axis(comm):
         check_vma=False,
     )(jnp.asarray(np.random.RandomState(0).randn(8, 3), jnp.float32))
     assert out.shape == (8, 3)
+
+
+def test_create_mnbn_model_full_training_equivalence(comm):
+    """Multi-step TRAINING with a converted model over 8 shards equals
+    single-device training of the plain model on the full batch — the
+    round-trip the unit equality tests don't cover (BN stats feeding back
+    into subsequent steps through the optimizer loop)."""
+    import optax
+
+    class Net(nn.Module):
+        train: bool = True
+
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(8)(x)
+            x = nn.BatchNorm(use_running_average=not self.train,
+                             momentum=0.9)(x)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    from chainermn_tpu.links import create_mnbn_model
+
+    rng = np.random.RandomState(9)
+    X = jnp.asarray(rng.randn(32, 6).astype(np.float32))
+    Y = jnp.asarray((rng.rand(32) * 4).astype(np.int32))
+    plain = Net()
+    converted = create_mnbn_model(plain, comm)
+    v0 = plain.init(jax.random.key(5), X)
+    opt = optax.sgd(0.1)
+
+    def train(model, dist):
+        params, bstats = v0["params"], v0["batch_stats"]
+        opt_state = opt.init(params)
+
+        def loss_fn(p, bs, xb, yb):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": bs}, xb,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb
+            ).mean()
+            return loss, mut["batch_stats"]
+
+        if dist:
+            @jax.jit
+            def step(p, bs, os_, x, y):
+                def local(p, bs, os_, xl, yl):
+                    (l, nbs), g = jax.value_and_grad(
+                        loss_fn, has_aux=True)(p, bs, xl, yl)
+                    g = jax.lax.pmean(g, "data")
+                    l = jax.lax.pmean(l, "data")
+                    # nbs deliberately NOT pmean-ed: if the conversion's
+                    # sync failed, per-shard stats would diverge and the
+                    # batch_stats comparison below must catch it.
+                    u, os2 = opt.update(g, os_, p)
+                    return optax.apply_updates(p, u), nbs, os2, l
+
+                return shard_map(
+                    local, mesh=comm.mesh,
+                    in_specs=(P(), P(), P(), P("data"), P("data")),
+                    out_specs=(P(), P(), P(), P()), check_vma=False,
+                )(p, bs, os_, x, y)
+        else:
+            @jax.jit
+            def step(p, bs, os_, x, y):
+                (l, nbs), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, bs, x, y)
+                u, os2 = opt.update(g, os_, p)
+                return optax.apply_updates(p, u), nbs, os2, l
+
+        for _ in range(5):
+            params, bstats, opt_state, loss = step(
+                params, bstats, opt_state, X, Y
+            )
+        return jax.device_get(params), jax.device_get(bstats), float(loss)
+
+    p_dist, bs_dist, l_dist = train(converted, dist=True)
+    p_ref, bs_ref, l_ref = train(plain, dist=False)
+    np.testing.assert_allclose(l_dist, l_ref, rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        p_dist, p_ref,
+    )
+    # Running statistics accumulated over the 5 steps must match too —
+    # the conversion's EMA must track GLOBAL batch moments.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        bs_dist, bs_ref,
+    )
