@@ -526,3 +526,50 @@ def test_3d_composition_dp_pp_tp():
             np.concatenate(list(g2[s]), axis=0),
             np.asarray(ref_grads[s]["w2"]), rtol=1e-4, atol=1e-5,
         )
+
+
+def test_1f1b_switch_survives_to_hlo(comm):
+    """The engine's claim that each tick runs exactly ONE op via a true
+    per-stage `lax.switch` (docstring) needs compiler-level evidence, as
+    with MultiNodeChainList's cond gating: the compiled module must
+    retain real HLO conditionals rather than lowering to execute-all-
+    branches selects."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.parallel import pipeline as pl
+
+    mesh = comm.mesh
+    ax = comm.axis_name
+    D, B, M = 16, 16, 4
+
+    def sf(w, x):
+        return jnp.tanh(x @ w)
+
+    stacked = stack_stage_params(
+        [jax.random.normal(jax.random.key(70 + i), (D, D)) * 0.2
+         for i in range(comm.size)]
+    )
+    lg = jax.value_and_grad(lambda y, t: jnp.mean((y - t) ** 2))
+
+    def local(sp, x, t):
+        params = jax.tree.map(lambda p: p[0], sp)
+        xm = x.reshape((M, B // M, D))
+        tm = t.reshape((M, B // M, D))
+        loss, grads = pl.pipeline_1f1b_local(sf, lg, params, xm, tm, ax)
+        return loss, jax.tree.map(lambda g: g[None], grads)
+
+    fn = jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P(ax), P(), P()),
+        out_specs=(P(), P(ax)), check_vma=False,
+    ))
+    x = jnp.ones((B, D))
+    txt = fn.lower(stacked, x, x).compile().as_text()
+    n_cond = sum(
+        1 for ln in txt.splitlines()
+        if "conditional(" in ln and "branch_computations" in ln
+    )
+    assert n_cond >= 1, (
+        "expected the 1F1B tick's lax.switch to survive as an HLO "
+        f"conditional; found {n_cond}:\n" + txt[:1500]
+    )
