@@ -9,12 +9,18 @@ leave it to get ChainerMN's fault-tolerance behaviour. This adapter keeps
 the same two-method surface (``save`` / ``maybe_load``) and the same
 cross-rank guarantees:
 
-- per-process directories (no write races between ranks);
 - retention of the last ``keep`` steps (orbax ``max_to_keep``);
 - resume from the NEWEST step that EVERY process possesses, agreed via a
   host-plane object collective (the reference's ``maybe_load``
   max-common-iteration protocol, SURVEY.md section 3.5) — a rank that
   crashed mid-save can't drag the job onto a step others don't have.
+
+Storage layout follows the runtime: single-process uses a per-rank
+directory; multi-process uses ORBAX'S native collective model (one
+shared directory, coordinated saves), whose contract is that state is
+replicated across processes or globally sharded — per-rank-DIVERGENT
+host-local state belongs to the npz backend (per-rank files by
+design).
 
 Storage format and everything below ``save``/``restore`` is pure orbax
 (``StandardCheckpointer`` under a ``CheckpointManager``): checkpoints
@@ -31,6 +37,18 @@ from chainermn_tpu.communicators.base import CommunicatorBase
 PyTree = Any
 
 
+def _to_host(leaf):
+    """Fully-addressable jax.Arrays -> host numpy (shared by save's
+    replicated-value handoff and maybe_load's npz-parity conversion);
+    everything else passes through."""
+    import jax
+    import numpy as np
+
+    if isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
+        return np.asarray(leaf)
+    return leaf
+
+
 class OrbaxMultiNodeCheckpointer:
     """``save(state, step)`` / ``maybe_load(template) -> (state, step)``
     on orbax storage, with cross-rank resume agreement."""
@@ -45,15 +63,29 @@ class OrbaxMultiNodeCheckpointer:
     ) -> None:
         import orbax.checkpoint as ocp
 
+        import jax as _jax
+
         self.name = name
         self.comm = comm
-        # Per-process subdirectory: single-process-per-host deployments
-        # could share one sharded checkpoint, but per-rank dirs preserve
-        # the reference's crash-isolation property (a half-written rank
-        # directory never corrupts another rank's snapshots).
-        self.path = os.path.abspath(
-            os.path.join(path, f"{name}_orbax_rank{comm.rank}")
-        )
+        self._multiprocess = _jax.process_count() > 1
+        if self._multiprocess:
+            # Multi-process runtimes follow ORBAX'S OWN model: one shared
+            # checkpoint directory, collective saves coordinated by the
+            # manager (primary-host metadata, cross-host barriers).
+            # Contract: state leaves must be replicated-identical across
+            # processes or globally sharded jax.Arrays — the standard
+            # orbax semantics, ENFORCED at save time. Per-rank-DIVERGENT
+            # host-local state is the npz backend's domain (per-rank
+            # files by design). No migration concern vs earlier layouts:
+            # no earlier multi-process layout ever functioned (orbax
+            # rejected host-local arrays outright).
+            self.path = os.path.abspath(
+                os.path.join(path, f"{name}_orbax")
+            )
+        else:
+            self.path = os.path.abspath(
+                os.path.join(path, f"{name}_orbax_rank{comm.rank}")
+            )
         self._mgr = ocp.CheckpointManager(
             self.path,
             options=ocp.CheckpointManagerOptions(
@@ -78,12 +110,49 @@ class OrbaxMultiNodeCheckpointer:
         self._mgr.wait_until_finished()
         if iteration in self._mgr.all_steps():
             self._mgr.delete(iteration)
+        # Multi-process runtimes: host-local jax.Arrays (single-device
+        # shardings) trip orbax's multihost safety check. Under this
+        # backend's multiprocess contract the values are replicated
+        # across processes, so hand them over as host numpy (orbax
+        # writes replicated numpy from the primary). Non-fully-
+        # addressable (globally sharded) leaves pass through for orbax's
+        # sharded writer.
+        import jax as _jax
+
+        if self._multiprocess:
+            state = _jax.tree.map(_to_host, state)
+            # The contract is ENFORCED, not assumed: divergent values
+            # would silently become the primary's on restore — raise
+            # loudly and point at the npz backend instead.
+            self._assert_replicated(state)
         self._mgr.save(
             iteration, args=ocp.args.StandardSave(state), force=True
         )
         if block:
             self._mgr.wait_until_finished()
         return os.path.join(self.path, str(iteration))
+
+    def _assert_replicated(self, state: PyTree) -> None:
+        import hashlib
+        import pickle
+
+        import jax as _jax
+        import numpy as _np
+
+        h = hashlib.sha256()
+        for leaf in _jax.tree.leaves(state):
+            if isinstance(leaf, _np.ndarray):
+                h.update(_np.ascontiguousarray(leaf).tobytes())
+            elif not isinstance(leaf, _jax.Array):
+                h.update(pickle.dumps(leaf))
+        digests = self.comm.allgather_obj(h.hexdigest())
+        if len(set(digests)) != 1:
+            raise ValueError(
+                "orbax backend multiprocess contract violated: state "
+                "differs across processes (digests "
+                f"{sorted(set(digests))}); per-rank-divergent state needs "
+                "create_multi_node_checkpointer (npz, per-rank files)"
+            )
 
     def _local_iterations(self) -> list[int]:
         return sorted(self._mgr.all_steps())
@@ -126,12 +195,7 @@ class OrbaxMultiNodeCheckpointer:
         import jax
         import numpy as np
 
-        def to_host(leaf):
-            if isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
-                return np.asarray(leaf)
-            return leaf
-
-        return jax.tree.map(to_host, state), step
+        return jax.tree.map(_to_host, state), step
 
     def wait_async(self) -> None:
         """Drain pending async saves (surface parity with the npz

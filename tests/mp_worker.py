@@ -165,6 +165,41 @@ def case_checkpoint():
     np.testing.assert_allclose(np.asarray(restored["w"]), np.full((3,), float(RANK)))
 
 
+def case_orbax_checkpoint():
+    """Orbax backend across real processes: collective saves into the
+    shared directory (orbax's native multihost model — replicated
+    state), resume through the shared agreement helper. Per-rank-
+    DIVERGENT state is the npz backend's contract, covered by
+    case_checkpoint."""
+    from chainermn_tpu import create_communicator
+    from chainermn_tpu.extensions import create_orbax_checkpointer
+
+    comm = create_communicator("xla")
+    path = os.environ["MP_CKPT_DIR"]
+    ckpt = create_orbax_checkpointer("mp", comm, path=path, keep=5)
+
+    state = {"w": jnp.arange(3.0), "step": jnp.int32(0)}
+    ckpt.save({**state, "step": jnp.int32(1)}, 1)  # collective
+    ckpt.save({**state, "step": jnp.int32(2)}, 2)  # collective
+    comm.barrier()
+
+    restored, it = ckpt.maybe_load(state)
+    assert it == 2, it
+    assert int(restored["step"]) == 2
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(3.0))
+
+    # The replicated-state contract is enforced: a divergent save raises
+    # on EVERY rank (the digest allgather is symmetric) instead of
+    # silently writing the primary's values.
+    try:
+        ckpt.save({"w": jnp.full((3,), float(RANK))}, 3)
+    except ValueError as e:
+        assert "contract violated" in str(e)
+    else:
+        raise AssertionError("divergent save did not raise")
+    ckpt.close()
+
+
 def case_split():
     """Full-stack multihost split(): independent host-plane and device-plane
     collectives per color group (the branch that raised NotImplementedError

@@ -28,6 +28,15 @@ def test_mp_checkpoint_agreement(tmp_path):
     )
 
 
+def test_mp_orbax_checkpoint_agreement(tmp_path):
+    """The orbax backend's resume agreement under real processes."""
+    pytest.importorskip("orbax.checkpoint")
+    run_workers(
+        "orbax_checkpoint", n_procs=2,
+        extra_env={"MP_CKPT_DIR": str(tmp_path)},
+    )
+
+
 def test_mp_sharded_checkpoint(tmp_path):
     """Each process persists only its addressable shards; restore
     reassembles the global sharded arrays via the template sharding."""
