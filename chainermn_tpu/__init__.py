@@ -19,7 +19,14 @@ Public API (mirrors the reference package surface, see SURVEY.md section 2):
   ``MultiNodeBatchNormalization``, ``create_mnbn_model``
   (``chainermn/links/`` (dagger)).
 - :mod:`chainermn_tpu.extensions` — multi-node evaluator, fault-tolerant
-  checkpointer (``chainermn/extensions/`` (dagger)).
+  checkpointer (npz + orbax backends) (``chainermn/extensions/`` (dagger)).
+- :mod:`chainermn_tpu.parallel` — the TPU-era parallelism library the
+  reference lacked: tensor/pipeline (GPipe + 1F1B)/sequence/expert
+  parallelism, ZeRO, FSDP (see ``docs/parallelism.md``).
+- :mod:`chainermn_tpu.training` — jitted train-step builder (gradient
+  accumulation, device prefetch) and the Trainer loop.
+- :mod:`chainermn_tpu.testing` — downstream test harness helpers (the
+  ``mpiexec -n N pytest`` recipe, TPU-style).
 
 The dagger convention follows SURVEY.md: the reference mount was empty at
 survey time, so citations are to the public upstream layout.
