@@ -35,24 +35,46 @@ def dot_product_attention(
     q_offset=0,
     kv_offset=0,
     scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Plain softmax attention — the correctness reference.
 
     Args:
-      q: ``[B, Tq, H, D]``; k/v: ``[B, Tk, H, D]``.
+      q: ``[B, Tq, H, D]``; k/v: ``[B, Tk, Hkv, D]`` where ``Hkv`` divides
+        ``H`` (GQA/MQA: kv heads are repeated across their group).
       causal: mask positions where ``kv_pos > q_pos`` (global positions,
         honouring the offsets).
+      segment_ids: optional ``[B, T]`` packed-segment ids (Tq == Tk);
+        attention is confined to equal ids. Rows with no visible key
+        return zeros.
     """
     s = _scale(q, scale)
+    if k.shape[2] != q.shape[2]:
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(
+                f"q heads ({q.shape[2]}) not a multiple of kv heads "
+                f"({k.shape[2]})"
+            )
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * s
+    mask = None
     if causal:
         q_pos = q_offset + lax.iota(jnp.int32, q.shape[1])
         kv_pos = kv_offset + lax.iota(jnp.int32, k.shape[1])
-        mask = q_pos[:, None] >= kv_pos[None, :]
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        mask = (q_pos[:, None] >= kv_pos[None, :])[None, None]
+    if segment_ids is not None:
+        seg = (segment_ids[:, :, None] == segment_ids[:, None, :])[:, None]
+        mask = seg if mask is None else mask & seg
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
+    if mask is not None:
+        # Fully-masked rows: softmax over all-NEG_INF is uniform garbage.
+        probs = jnp.where(mask.any(-1, keepdims=True), probs, 0.0)
     out = jnp.einsum(
         "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
     )

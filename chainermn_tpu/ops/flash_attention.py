@@ -62,9 +62,18 @@ def _pick_block(requested: int, T: int) -> int:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale: float, causal: bool, block_q: int, block_k: int,
-                num_k_blocks: int):
+def _seg_mask(sq_ref, sk_ref):
+    """Segment mask from the per-block segment-id refs ([1, block] each):
+    attention is allowed only within the same packed segment."""
+    sq = sq_ref[0]  # [block_q]
+    sk = sk_ref[0]  # [block_k]
+    return sq[:, None] == sk[None, :]
+
+
+def _fwd_body(q_ref, k_ref, v_ref, seg_refs, o_ref, lse_ref,
+              acc_ref, m_ref, l_ref, *,
+              scale: float, causal: bool, block_q: int, block_k: int,
+              num_k_blocks: int):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -85,16 +94,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             preferred_element_type=jnp.float32,
         ) * scale  # [block_q, block_k]
 
+        mask = None
         if causal:
             mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
+        if seg_refs is not None:
+            sm = _seg_mask(*seg_refs)
+            mask = sm if mask is None else mask & sm
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:, 0:1]  # [block_q, 1]
         l_prev = l_ref[:, 0:1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
+        # Guard fully-masked ROWS: with every score NEG_INF, exp(s - m_new)
+        # would be exp(0) = 1 per entry; the mask re-zeroes them.
         p = jnp.exp(s - m_new)
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)  # [block_q, 1]
         l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
@@ -119,26 +135,63 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0, 0] = lse
 
 
-def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
-    """BHTD forward → (out [B,H,Tq,D], lse [B,H,Tq])."""
+def _group(Hq: int, Hkv: int) -> int:
+    """GQA group size: q heads per kv head (MQA when Hkv == 1)."""
+    if Hq % Hkv:
+        raise ValueError(
+            f"q heads ({Hq}) must be a multiple of kv heads ({Hkv})"
+        )
+    return Hq // Hkv
+
+
+def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, *, causal, scale,
+                    block_q, block_k, interpret):
+    """BHTD forward → (out [B,H,Tq,D], lse [B,H,Tq]).
+
+    ``k``/``v`` may carry FEWER heads than ``q`` (GQA/MQA): kv head
+    ``h // g`` serves q head ``h`` via the BlockSpec index map — no
+    materialized ``jnp.repeat``. ``seg_q``/``seg_k`` are optional
+    ``[B, T]`` int32 packed-segment ids."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
+    g = _group(H, k.shape[1])
     block_q = _pick_block(block_q, Tq)
     block_k = _pick_block(block_k, Tk)
     nq, nk = Tq // block_q, Tk // block_k
 
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k_blocks=nk,
-    )
+    params = dict(scale=scale, causal=causal,
+                  block_q=block_q, block_k=block_k, num_k_blocks=nk)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, iq, ik: (b, h // g, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, iq, ik: (b, h // g, ik, 0)),
+    ]
+    has_segments = seg_q is not None
+    if has_segments:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, h, iq, ik: (b, iq)),
+            pl.BlockSpec((1, block_k), lambda b, h, iq, ik: (b, ik)),
+        ]
+
+        def kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
+                   acc, m, l):
+            _fwd_body(q_ref, k_ref, v_ref, (sq_ref, sk_ref), o_ref, lse_ref,
+                      acc, m, l, **params)
+
+        args = (q, k, v, seg_q, seg_k)
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
+            _fwd_body(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                      acc, m, l, **params)
+
+        args = (q, k, v)
+
     return pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, iq, ik: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, iq, ik: (b, h, ik, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
             pl.BlockSpec((1, 1, block_q, 1),
@@ -154,17 +207,17 @@ def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
 # ---------------------------------------------------------------------------
 # Backward: dq kernel (iterate K blocks per fixed Q block)
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *,
-                   scale: float, causal: bool, block_q: int, block_k: int,
-                   num_k_blocks: int):
+def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
+                 dq_ref, dq_acc, *,
+                 scale: float, causal: bool, block_q: int, block_k: int,
+                 num_k_blocks: int):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -185,8 +238,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+        mask = None
         if causal:
             mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
+        if seg_refs is not None:
+            sm = _seg_mask(*seg_refs)
+            mask = sm if mask is None else mask & sm
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         # p from the saved LSE: exp(NEG_INF - lse) underflows to exactly 0,
         # so masked/never-attended entries contribute nothing.
@@ -210,10 +268,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # Backward: dk/dv kernel (iterate Q blocks per fixed K block)
 # ---------------------------------------------------------------------------
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale: float, causal: bool, block_q: int, block_k: int,
-                    num_q_blocks: int):
+def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
+                  dk_ref, dv_ref, dk_acc, dv_acc, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  num_q_blocks: int):
     ik = pl.program_id(2)
     iq = pl.program_id(3)
 
@@ -235,8 +293,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+        mask = None
         if causal:
             mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
+        if seg_refs is not None:
+            sm = _seg_mask(*seg_refs)
+            mask = sm if mask is None else mask & sm
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)  # [block_q, block_k]
         # dv += p^T @ do
@@ -261,55 +324,106 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_bhtd(q, k, v, do, lse, delta, *, causal, scale,
-                    block_q, block_k, interpret):
+def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None, *,
+                    causal, scale, block_q, block_k, interpret):
     """BHTD backward → (dq, dk, dv), each f32, given saved LSE and
-    ``delta = rowsum(do * o)``."""
+    ``delta = rowsum(do * o)``. With GQA (kv heads Hkv < Hq), dk/dv come
+    back at the KV head count: the per-q-head contributions are written
+    per-head and group-summed outside the kernel."""
     B, H, Tq, D = q.shape
-    Tk = k.shape[2]
+    Hkv, Tk = k.shape[1], k.shape[2]
+    g = _group(H, Hkv)
     block_q = _pick_block(block_q, Tq)
     block_k = _pick_block(block_k, Tk)
     nq, nk = Tq // block_q, Tk // block_k
+    has_segments = seg_q is not None
 
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
 
+    dq_params = dict(scale=scale, causal=causal,
+                     block_q=block_q, block_k=block_k, num_k_blocks=nk)
+    dq_in_specs = [
+        q_spec,
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // g, j, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // g, j, 0)),
+        q_spec,
+        row_spec,
+        row_spec,
+    ]
+    if has_segments:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),
+        ]
+
+        def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      sq_ref, sk_ref, dq_ref, dq_acc):
+            _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         (sq_ref, sk_ref), dq_ref, dq_acc, **dq_params)
+
+        dq_args = (q, k, v, do, lse, delta, seg_q, seg_k)
+    else:
+        def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_acc):
+            _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         None, dq_ref, dq_acc, **dq_params)
+
+        dq_args = (q, k, v, do, lse, delta)
+
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_k_blocks=nk,
-        ),
+        dq_kernel,
         grid=(B, H, nq, nk),
-        in_specs=[
-            q_spec,
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
-            q_spec,
-            row_spec,
-            row_spec,
-        ],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
 
-    k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0))
+    # dk/dv grid iterates Q heads; with GQA each q head writes its own
+    # [B, H, Tk, D] slot (no cross-head accumulation inside the grid) and
+    # the group sum happens below.
+    k_spec_in = pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, h, i, j: (b, h // g, i, 0))
+    k_spec_out = pl.BlockSpec((1, 1, block_k, D),
+                              lambda b, h, i, j: (b, h, i, 0))
+    dkv_params = dict(scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, num_q_blocks=nq)
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
+        k_spec_in,
+        k_spec_in,
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
+    ]
+    if has_segments:
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, j)),
+            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, i)),
+        ]
+
+        def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       sq_ref, sk_ref, dk_ref, dv_ref, dk_acc, dv_acc):
+            _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          (sq_ref, sk_ref), dk_ref, dv_ref, dk_acc, dv_acc,
+                          **dkv_params)
+
+        dkv_args = (q, k, v, do, lse, delta, seg_q, seg_k)
+    else:
+        def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc):
+            _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          None, dk_ref, dv_ref, dk_acc, dv_acc, **dkv_params)
+
+        dkv_args = (q, k, v, do, lse, delta)
+
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_q_blocks=nq,
-        ),
+        dkv_kernel,
         grid=(B, H, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
-            k_spec,
-            k_spec,
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
-        ],
-        out_specs=[k_spec, k_spec],
+        in_specs=dkv_in_specs,
+        out_specs=[k_spec_out, k_spec_out],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
             jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
@@ -319,7 +433,10 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, *, causal, scale,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
+    if g > 1:
+        dk = dk.reshape(B, Hkv, g, Tk, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, g, Tk, D).sum(axis=2)
     return dq, dk, dv
 
 
@@ -381,6 +498,44 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_seg(q, k, v, seg, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd_bhtd(
+        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), seg, seg, causal=causal,
+        scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _to_bhtd(out)
+
+
+def _flash_seg_fwd(q, k, v, seg, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd_bhtd(
+        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), seg, seg, causal=causal,
+        scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _to_bhtd(out), (q, k, v, seg, out, lse)
+
+
+def _flash_seg_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, seg, out_bhtd, lse = res
+    do = _to_bhtd(g)
+    delta = jnp.sum(do.astype(jnp.float32) * out_bhtd.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    dq, dk, dv = _flash_bwd_bhtd(
+        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), do, lse, delta, seg, seg,
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return (
+        _to_bhtd(dq).astype(q.dtype),
+        _to_bhtd(dk).astype(k.dtype),
+        _to_bhtd(dv).astype(v.dtype),
+        None,  # integer segment ids carry no gradient
+    )
+
+
+_flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -388,6 +543,7 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
@@ -396,6 +552,12 @@ def flash_attention(
     backward (both VMEM-blocked; the score matrix never exists in HBM in
     either direction).
 
+    ``k``/``v`` may carry fewer heads than ``q`` (GQA/MQA — q heads must be
+    a multiple of kv heads; kv blocks are shared via the kernel's index map,
+    never materialized per-group). ``segment_ids`` is an optional ``[B, T]``
+    int array for packed sequences: attention is confined to positions with
+    equal ids (composes with ``causal``).
+
     On TPU the kernels compile via Mosaic; elsewhere (CPU tests) they run in
     Pallas interpreter mode unless ``interpret=False``.
     """
@@ -403,6 +565,10 @@ def flash_attention(
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = _use_interpret()
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.int32)
+        return _flash_seg(q, k, v, seg, causal, scale, block_q, block_k,
+                          interpret)
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
@@ -411,25 +577,29 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 def flash_block_fwd(q, k_blk, v_blk, *, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, seg_q=None, seg_kv=None):
     """One ring step's forward: full flash over the resident Q shard and ONE
     arriving K/V block, returning BTHD output + ``[B, H, Tq]`` LSE. The ring
     merges successive blocks' (out, lse) partials in log space
-    (:func:`chainermn_tpu.parallel.ring_attention.merge_partials`)."""
+    (:func:`chainermn_tpu.parallel.ring_attention.merge_partials`).
+    ``seg_q``/``seg_kv`` are the per-shard segment-id slices (the kv ids
+    travel with their block around the ring)."""
     out, lse = _flash_fwd_bhtd(
-        _to_bhtd(q), _to_bhtd(k_blk), _to_bhtd(v_blk), causal=causal,
+        _to_bhtd(q), _to_bhtd(k_blk), _to_bhtd(v_blk), seg_q, seg_kv,
+        causal=causal,
         scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return _to_bhtd(out), lse[..., 0]
 
 
 def flash_block_bwd(q, k_blk, v_blk, do, lse, delta, *, causal, scale,
-                    block_q, block_k, interpret):
+                    block_q, block_k, interpret, seg_q=None, seg_kv=None):
     """One ring step's backward: (dq, dk_blk, dv_blk) contributions for one
     K/V block, f32, BTHD (lse/delta are ``[B, H, Tq]``)."""
     dq, dk, dv = _flash_bwd_bhtd(
         _to_bhtd(q), _to_bhtd(k_blk), _to_bhtd(v_blk), _to_bhtd(do),
-        lse[..., None], delta[..., None], causal=causal, scale=scale,
+        lse[..., None], delta[..., None], seg_q, seg_kv,
+        causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return _to_bhtd(dq), _to_bhtd(dk), _to_bhtd(dv)
