@@ -94,6 +94,163 @@ class TestRingAttention:
             g_ref,
         )
 
+    def test_zigzag_matches_full_attention(self, comm):
+        """Zigzag layout (balanced causal ring): same values as dense causal
+        attention on the ORIGINAL sequence order — ``make_ring_attention``
+        converts to chunk-pair order and back internally."""
+        q, k, v = _qkv(5)
+        ref = dot_product_attention(q, k, v, causal=True)
+        fn = make_ring_attention(
+            comm.mesh, comm.axis_name, causal=True, layout="zigzag"
+        )
+        sharding = NamedSharding(comm.mesh, P(None, comm.axis_name))
+        qs, ks, vs = (jax.device_put(t, sharding) for t in (q, k, v))
+        np.testing.assert_allclose(
+            np.asarray(fn(qs, ks, vs)), ref, rtol=1e-5, atol=1e-5
+        )
+
+    def test_zigzag_grads_match_full_attention(self, comm):
+        q, k, v = _qkv(6)
+        fn = make_ring_attention(
+            comm.mesh, comm.axis_name, causal=True, layout="zigzag"
+        )
+
+        def loss_ring(q, k, v):
+            return (fn(q, k, v) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), b, rtol=1e-4, atol=1e-4
+            ),
+            g_ring,
+            g_ref,
+        )
+
+    def test_zigzag_layout_roundtrip(self):
+        from chainermn_tpu.parallel.ring_attention import (
+            from_zigzag,
+            to_zigzag,
+            zigzag_indices,
+        )
+
+        x = jnp.arange(64, dtype=jnp.float32).reshape(1, 32, 2)
+        zz = to_zigzag(x, 8, axis=1)
+        np.testing.assert_array_equal(np.asarray(from_zigzag(zz, 8, axis=1)),
+                                      np.asarray(x))
+        idx = zigzag_indices(4, 32)
+        # shard 0 of 4 holds chunks 0 and 7 of 8 (chunk size 4)
+        np.testing.assert_array_equal(idx[:8], [0, 1, 2, 3, 28, 29, 30, 31])
+
+    def test_zigzag_requires_causal_flash(self, comm):
+        from chainermn_tpu.parallel.ring_attention import ring_attention_local
+
+        q = jnp.zeros((1, 4, 1, 8))
+        with pytest.raises(ValueError, match="zigzag"):
+            ring_attention_local(q, q, q, "seq", causal=False, layout="zigzag")
+        with pytest.raises(ValueError, match="zigzag"):
+            ring_attention_local(q, q, q, "seq", causal=True, impl="einsum",
+                                 layout="zigzag")
+
+    @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+    def test_segment_ids_match_masked_dense(self, comm, layout):
+        """Packed sequences across the ring: segment ids travel with their
+        K/V blocks, so cross-document attention is masked even when the
+        documents span shard boundaries. Values AND grads vs the dense
+        masked reference."""
+        q, k, v = _qkv(7)
+        rng = np.random.RandomState(2)
+        seg = np.zeros((B, T), np.int32)
+        for b in range(B):
+            cuts = sorted(rng.choice(np.arange(2, T - 2), 2, replace=False))
+            seg[b, cuts[0]:cuts[1]] = 1
+            seg[b, cuts[1]:] = 2
+        seg = jnp.asarray(seg)
+
+        fn = make_ring_attention(
+            comm.mesh, comm.axis_name, causal=True, layout=layout,
+            with_segments=True,
+        )
+
+        def loss_ring(q, k, v):
+            return (fn(q, k, v, seg) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (dot_product_attention(
+                q, k, v, causal=True, segment_ids=seg) ** 2).sum()
+
+        np.testing.assert_allclose(
+            np.asarray(fn(q, k, v, seg)),
+            np.asarray(dot_product_attention(q, k, v, causal=True,
+                                             segment_ids=seg)),
+            rtol=1e-5, atol=1e-5,
+        )
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), b, rtol=1e-4, atol=1e-4
+            ),
+            g_ring,
+            g_ref,
+        )
+
+    def test_gqa_zigzag_grads(self, comm):
+        """GQA × zigzag layout: the backward's zero-pads must use the KV
+        head count where dk/dv concatenate (regression: q-head-shaped pads
+        crashed the trace)."""
+        ks = jax.random.split(jax.random.PRNGKey(11), 3)
+        q = jax.random.normal(ks[0], (B, T, H, D))
+        k = jax.random.normal(ks[1], (B, T, 2, D))
+        v = jax.random.normal(ks[2], (B, T, 2, D))
+        fn = make_ring_attention(comm.mesh, comm.axis_name, causal=True,
+                                 layout="zigzag")
+        np.testing.assert_allclose(
+            np.asarray(fn(q, k, v)),
+            np.asarray(dot_product_attention(q, k, v, causal=True)),
+            rtol=1e-5, atol=1e-5,
+        )
+        g = jax.grad(lambda a, b, c: (fn(a, b, c) ** 2).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(
+            lambda a, b, c: (dot_product_attention(
+                a, b, c, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            ),
+            g, g_ref,
+        )
+
+    def test_gqa_kv_heads_rotate_small(self, comm):
+        """GQA through the ring: kv blocks rotate at their own (smaller)
+        head count; output matches the dense GQA reference."""
+        ks = jax.random.split(jax.random.PRNGKey(9), 3)
+        q = jax.random.normal(ks[0], (B, T, H, D))
+        k = jax.random.normal(ks[1], (B, T, 2, D))
+        v = jax.random.normal(ks[2], (B, T, 2, D))
+        fn = make_ring_attention(comm.mesh, comm.axis_name, causal=True)
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(fn(q, k, v)), ref,
+                                   rtol=1e-5, atol=1e-5)
+        g = jax.grad(lambda a, b, c: (fn(a, b, c) ** 2).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(
+            lambda a, b, c: (dot_product_attention(
+                a, b, c, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            ),
+            g, g_ref,
+        )
+
     def test_bf16_inputs_f32_accumulation(self, comm):
         q, k, v = _qkv(4, jnp.bfloat16)
         fn = make_ring_attention(comm.mesh, comm.axis_name)
