@@ -5,7 +5,7 @@ plus the Transformer LM the benchmark configs add (BASELINE.json)."""
 from chainermn_tpu.models.mlp import MLP
 from chainermn_tpu.models.imagenet import AlexNet, GoogLeNet
 from chainermn_tpu.models.seq2seq import Seq2Seq, seq2seq_loss
-from chainermn_tpu.models.transformer import TransformerLM, lm_loss
+from chainermn_tpu.models.transformer import TransformerLM, lm_loss, lm_loss_fused
 from chainermn_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -23,6 +23,7 @@ __all__ = [
     "seq2seq_loss",
     "TransformerLM",
     "lm_loss",
+    "lm_loss_fused",
     "ResNet",
     "ResNet18",
     "ResNet34",

@@ -31,7 +31,9 @@ class TransformerBlock(nn.Module):
     dropout_rate: float = 0.0
 
     @nn.compact
-    def __call__(self, x, *, train: bool = True):
+    def __call__(self, x, train: bool = True):
+        # ``train`` is positional so ``nn.remat(..., static_argnums=(2,))``
+        # can mark it static.
         D = x.shape[-1]
         head_dim = D // self.num_heads
         attn = self.attention_fn or blockwise_attention
@@ -80,6 +82,16 @@ class TransformerLM(nn.Module):
     #: global position offset of the local sequence shard (sequence-parallel
     #: runs pass ``axis_index * T_local`` so learned positions line up).
     pos_offset: int = 0
+    #: rematerialize each block in the backward pass (keep only the matmul
+    #: outputs that feed the MXU — ``dots_with_no_batch_dims_saveable``);
+    #: trades ~1/3 more FLOPs for activation memory, the standard TPU move
+    #: for fitting larger B*T (SURVEY.md "use jax.checkpoint to trade FLOPs
+    #: for memory").
+    remat: bool = False
+    #: skip the weight-tied LM head and return the final (post-LN) hidden
+    #: states; pair with :func:`lm_loss_fused` to avoid materializing the
+    #:  ``[B, T, vocab]`` logits tensor.
+    return_hidden: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True):
@@ -97,15 +109,24 @@ class TransformerLM(nn.Module):
         x = emb(tokens)
         pos = jax.lax.dynamic_slice_in_dim(pos_emb, self.pos_offset, T, axis=0)
         x = x + pos[None].astype(self.compute_dtype)
+        block_cls = TransformerBlock
+        if self.remat:
+            block_cls = nn.remat(
+                TransformerBlock,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                static_argnums=(2,),  # (self, x, train)
+            )
         for i in range(self.num_layers):
-            x = TransformerBlock(
+            x = block_cls(
                 num_heads=self.num_heads,
                 d_ff=self.d_ff,
                 compute_dtype=self.compute_dtype,
                 attention_fn=self.attention_fn,
                 name=f"block_{i}",
-            )(x, train=train)
+            )(x, train)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
+        if self.return_hidden:
+            return x
         logits = emb.attend(x.astype(jnp.float32))  # weight-tied output head
         return logits
 
@@ -122,3 +143,57 @@ def lm_loss(logits, tokens, mask=None):
         m = mask[:, 1:].astype(losses.dtype)
         return (losses * m).sum() / jnp.maximum(m.sum(), 1)
     return losses.mean()
+
+
+def lm_loss_fused(hidden, emb_table, tokens, *, n_chunks=8,
+                  compute_dtype=jnp.bfloat16):
+    """Fused chunked LM-head + next-token cross-entropy.
+
+    The naive head materializes ``[B, T, vocab]`` f32 logits (≈ 4·B·T·V
+    bytes of HBM traffic both ways, plus an f32 matmul off the MXU's fast
+    path). This computes the head matmul per token-chunk in ``compute_dtype``
+    with f32 MXU accumulation, reduces each chunk to its scalar loss
+    immediately, and rematerializes the chunk in the backward pass
+    (``jax.checkpoint``) — so the full logits tensor never exists in HBM in
+    either pass. Equivalent to ``lm_loss(emb.attend(hidden), tokens)`` up to
+    compute-dtype rounding; pair with ``TransformerLM(return_hidden=True)``.
+
+    Args:
+      hidden: final post-LN hidden states ``[B, T, D]``.
+      emb_table: tied embedding table ``[vocab, D]`` (f32 master copy).
+      tokens: integer tokens ``[B, T]``.
+      n_chunks: token-dimension split; ``B*(T-1)`` need not divide evenly —
+        the tail partial chunk is padded and masked out.
+    """
+    B, T, D = hidden.shape
+    h = hidden[:, :-1].reshape(-1, D)
+    t = tokens[:, 1:].reshape(-1)
+    n = h.shape[0]
+    chunk = -(-n // n_chunks)  # ceil
+    pad = chunk * n_chunks - n
+    h = jnp.pad(h, ((0, pad), (0, 0)))
+    t = jnp.pad(t, (0, pad))
+    valid = jnp.pad(jnp.ones((n,), jnp.float32), (0, pad))
+    w = emb_table.astype(compute_dtype).T  # [D, vocab]
+
+    @jax.checkpoint
+    def chunk_loss(hc, tc, mc):
+        logits = jnp.dot(
+            hc.astype(compute_dtype), w,
+            preferred_element_type=jnp.float32,
+        )
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return jnp.sum((lse - gold) * mc)
+
+    def body(acc, xs):
+        hc, tc, mc = xs
+        return acc + chunk_loss(hc, tc, mc), ()
+
+    total, _ = jax.lax.scan(
+        body, jnp.float32(0.0),
+        (h.reshape(n_chunks, chunk, D),
+         t.reshape(n_chunks, chunk),
+         valid.reshape(n_chunks, chunk)),
+    )
+    return total / n
