@@ -55,8 +55,13 @@ _PEAK_BF16_FLOPS = {
     "v6e": 918e12,
 }
 
-PROBE_TIMEOUT = 120
-TOTAL_BUDGET = 1500  # seconds; never outlive the driver's patience
+# Env-tunable so the probe schedule can be compressed when driving the
+# orchestration in tests (the defaults fit the driver's real budget).
+PROBE_TIMEOUT = int(os.environ.get("CHAINERMN_BENCH_PROBE_TIMEOUT", 120))
+TOTAL_BUDGET = int(os.environ.get("CHAINERMN_BENCH_BUDGET", 1500))
+PROBE_RETRY_SLEEP = int(os.environ.get("CHAINERMN_BENCH_PROBE_SLEEP", 45))
+PROBE_RETRIES = int(os.environ.get("CHAINERMN_BENCH_PROBE_RETRIES", 5))
+CPU_BENCH_RESERVE = 330  # budget to keep for the CPU fallback + margin
 
 
 def _cpu_env(n_devices: int = 8) -> dict:
@@ -151,46 +156,118 @@ def _save_last_tpu(result: dict) -> None:
 
 def _attach_last_tpu(result: dict) -> None:
     """On a CPU fallback, attach the most recent SUCCESSFUL on-chip result
-    (clearly labeled with its measurement time) so a transiently dead
-    accelerator tunnel doesn't erase real measured capability. The
-    top-level fields still describe THIS run honestly."""
+    so a transiently dead accelerator tunnel doesn't erase real measured
+    capability. The carried blob is loudly marked — ``source: "carry"``,
+    ``stale: true``, and its age — so no consumer can mistake stale
+    capability for a current measurement. The top-level fields still
+    describe THIS run honestly."""
     try:
         with open(_LAST_TPU_CACHE) as f:
-            result["last_good_tpu"] = json.load(f)
+            carried = json.load(f)
     except (OSError, json.JSONDecodeError):
+        return
+    carried["source"] = "carry"
+    carried["stale"] = True
+    try:
+        import calendar
+
+        measured = calendar.timegm(
+            time.strptime(carried["measured_at"], "%Y-%m-%dT%H:%M:%SZ")
+        )
+        carried["age_hours"] = round((time.time() - measured) / 3600, 1)
+    except (KeyError, ValueError, OverflowError):
         pass
+    result["last_good_tpu"] = carried
+
+
+def _probe_with_retries(deadline: float, errors: list) -> dict | None:
+    """Probe the accelerator repeatedly with backoff (round-2 lesson: the
+    tunnelled TPU flaps — a single-shot probe lost two rounds' live
+    numbers). Keeps trying while enough budget remains for an accel bench
+    plus the CPU fallback reserve."""
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        if remaining < CPU_BENCH_RESERVE + 60:
+            errors.append(
+                f"accelerator probe gave up after {attempt - 1} attempts "
+                "(budget exhausted)"
+            )
+            return None
+        accel = _probe_accelerator(min(PROBE_TIMEOUT, remaining - CPU_BENCH_RESERVE))
+        if accel is not None:
+            if attempt > 1:
+                errors.append(
+                    f"accelerator probe succeeded on attempt {attempt}"
+                )
+            return accel
+        # ~5 attempts spread over ~10 minutes before conceding the chip.
+        if attempt >= PROBE_RETRIES:
+            errors.append(
+                f"accelerator probe failed {attempt} times over "
+                f"~{attempt * (PROBE_RETRY_SLEEP + 60) // 60} min "
+                "(backend init dead or hung)"
+            )
+            return None
+        time.sleep(PROBE_RETRY_SLEEP)
 
 
 def main() -> None:
     deadline = time.monotonic() + TOTAL_BUDGET
     errors = []
 
-    accel = _probe_accelerator(min(PROBE_TIMEOUT, deadline - time.monotonic()))
+    accel = _probe_with_retries(deadline, errors)
     if accel is not None:
-        budget = min(900.0, deadline - time.monotonic() - 300)
+        budget = min(900.0, deadline - time.monotonic() - CPU_BENCH_RESERVE)
         result, err = _run_child("accel", budget)
         if result is not None:
+            result["source"] = "live"
             _save_last_tpu(result)
             print(json.dumps(result))
             return
         errors.append(err)
-    else:
-        errors.append("accelerator probe failed (backend init dead or hung)")
 
-    budget = max(60.0, deadline - time.monotonic() - 10)
+    budget = max(60.0, deadline - time.monotonic() - 180)
     result, err = _run_child("cpu", budget, env=_cpu_env())
+    if result is None:
+        errors.append(err)
+
+    # Late re-probe: the tunnel flaps — it may be back by now. A reduced
+    # accel run still beats a carried number; its primary JSON line is
+    # printed before the supplementary benchmarks, so even a timeout
+    # salvages live TPU figures.
+    remaining = deadline - time.monotonic()
+    if remaining > 150:
+        accel = _probe_accelerator(min(PROBE_TIMEOUT, remaining - 30))
+        if accel is not None:
+            late, err2 = _run_child(
+                "accel", deadline - time.monotonic() - 15
+            )
+            if late is not None:
+                late["source"] = "live"
+                late["bench_note"] = (
+                    late.get("bench_note", "")
+                    + " captured on late re-probe after earlier probe failures"
+                ).strip()
+                _save_last_tpu(late)
+                print(json.dumps(late))
+                return
+            errors.append(f"late re-probe bench: {err2}")
+
     if result is not None:
-        result["error"] = "; ".join(errors)
+        result["source"] = "cpu-fallback"
+        result["error"] = "; ".join(e for e in errors if e)
         _attach_last_tpu(result)
         print(json.dumps(result))
         return
-    errors.append(err)
 
     out = {
         "metric": "resnet50_images_per_sec",
         "value": 0.0,
         "unit": "images/sec",
         "vs_baseline": 0.0,
+        "source": "failed",
         "error": "; ".join(e for e in errors if e),
     }
     _attach_last_tpu(out)
@@ -417,10 +494,14 @@ def _bench_s2d_resnet(comm, on_accel: bool):
 
 
 def _bench_transformer(comm, on_accel: bool):
-    """Transformer-base LM tokens/sec — the remaining BASELINE.json config
+    """Transformer LM tokens/sec + MFU — the remaining BASELINE.json config
     ("Transformer-base LM — large embedding grads, double-buffered
     allreduce"): full train step (fwd + bwd + bf16 grad pmean + adam) with
-    the flash-attention kernel and double buffering on."""
+    the flash-attention kernel, double buffering, per-block remat
+    (dots-saveable policy) and the fused chunked LM head
+    (``lm_loss_fused`` — the [B,T,vocab] logits tensor never hits HBM).
+    MFU comes from XLA's own cost analysis of the compiled per-device
+    module, same method as the ResNet headline metric."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -428,16 +509,25 @@ def _bench_transformer(comm, on_accel: bool):
     from jax.sharding import PartitionSpec as P
 
     from chainermn_tpu import create_multi_node_optimizer
-    from chainermn_tpu.models import TransformerLM, lm_loss
+    from chainermn_tpu.models import TransformerLM, lm_loss_fused
     from chainermn_tpu.ops.flash_attention import flash_attention
 
     if on_accel:
-        B, T, steps = 32, 1024, 10  # B=32 measured best (345k vs 301k @ B16)
-        model = TransformerLM()  # Transformer-base: 6L, d512, 8H, ff2048
+        # LM-scale config (VERDICT r2 item 3): 8L / d1024 / 16H / ff4096,
+        # T=2048 — ~134M params incl. the 32k tied embedding.
+        B, T, steps = 16, 2048, 10
+        model = TransformerLM(
+            num_layers=8, d_model=1024, num_heads=16, d_ff=4096,
+            max_len=2048, remat=True, return_hidden=True,
+        )
+        n_chunks = 16
+        cfg = "8L-d1024-ff4096-v32k"
     else:
         B, T, steps = 2, 128, 2
         model = TransformerLM(vocab_size=512, num_layers=2, d_model=64,
-                              d_ff=128, max_len=256)
+                              d_ff=128, max_len=256, return_hidden=True)
+        n_chunks = 2
+        cfg = "tiny-cpu-proxy"
     interpret = not on_accel
 
     def attn(q, k, v, *, causal, scale):
@@ -464,12 +554,15 @@ def _bench_transformer(comm, on_accel: bool):
     )
     axes = comm.grad_axes
 
+    def loss_fn(p, tok):
+        hidden = model.apply(p, tok, train=True)
+        emb = p["params"]["tok_emb"]["embedding"]
+        return lm_loss_fused(hidden, emb, tok, n_chunks=n_chunks)
+
     def local(params, opt_state, tok):
         def one(carry, _):
             params, opt_state = carry
-            loss, grads = jax.value_and_grad(
-                lambda p: lm_loss(model.apply(p, tok, train=True), tok)
-            )(params)
+            loss, grads = jax.value_and_grad(loss_fn)(params, tok)
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), loss
@@ -485,15 +578,53 @@ def _bench_transformer(comm, on_accel: bool):
                   out_specs=P(), check_vma=False)
     )
     opt_state = opt.init(params)
+
+    hw_step_flops = None
+    try:
+        compiled = fn.lower(params, opt_state, tokens).compile()
+        analysis = compiled.cost_analysis()
+        if analysis:
+            a = analysis[0] if isinstance(analysis, (list, tuple)) else analysis
+            total = float(a.get("flops", 0.0))
+            hw_step_flops = total / steps if total else None
+        fn = compiled
+    except Exception:
+        pass
+
     _fetch_scalar(fn(params, opt_state, tokens))  # compile + warm
     t0 = time.perf_counter()
     _fetch_scalar(fn(params, opt_state, tokens))
     dt = (time.perf_counter() - t0) / steps
-    return {
+
+    # MFU uses MODEL flops (the PaLM-appendix convention): 6P per token for
+    # the matmul stack + 6·L·T·d for causal attention fwd+bwd. Remat
+    # recomputation deliberately NOT counted — that's the price paid, not
+    # useful work. (XLA's cost analysis, which does count it, is reported
+    # separately as hardware utilisation.)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    model_flops_per_token = (
+        6 * n_params
+        + 6 * model.num_layers * T * model.d_model
+    )
+    model_step_flops = model_flops_per_token * B * T / comm.size  # per device
+
+    out = {
         "transformer_tokens_per_sec": round(B * T / dt, 1),
         "transformer_step_ms": round(dt * 1e3, 2),
-        "transformer_config": f"base-6L-d512 B{B}xT{T} flash+double-buffer",
+        "transformer_params_m": round(n_params / 1e6, 1),
+        "transformer_config": (
+            f"{cfg} B{B}xT{T} flash+double-buffer+remat+fused-head"
+        ),
     }
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    if peak:
+        out["transformer_mfu"] = round(model_step_flops / dt / peak, 4)
+        out["transformer_model_tflops_per_step"] = round(
+            model_step_flops / 1e12, 3
+        )
+        if hw_step_flops:
+            out["transformer_hw_util"] = round(hw_step_flops / dt / peak, 4)
+    return out
 
 
 def _bench_double_buffering(comm, on_accel: bool):
