@@ -79,6 +79,7 @@ class NaiveCommunicator(XlaCommunicator):
         via ``CHAINERMN_TPU_NAIVE_NO_PIN=1``. No-op once any backend is
         live (then discovery already succeeded)."""
         import os
+        import warnings
 
         if os.environ.get("CHAINERMN_TPU_NAIVE_NO_PIN"):
             return
@@ -87,6 +88,21 @@ class NaiveCommunicator(XlaCommunicator):
 
             if xb._backends:  # discovery already done and healthy
                 return
+            preset = os.environ.get("JAX_PLATFORMS")
+            if preset and preset != "cpu":
+                # The pre-set value may be the user's or an injected plugin
+                # shim's — either way, a later accelerator communicator in
+                # this process will find no devices unless the pin is
+                # opted out of. Say so instead of failing silently there.
+                warnings.warn(
+                    f"NaiveCommunicator is pinning JAX_PLATFORMS=cpu for "
+                    f"this process, overriding the pre-set "
+                    f"JAX_PLATFORMS={preset!r}. If you need an accelerator "
+                    f"communicator in the same process, set "
+                    f"CHAINERMN_TPU_NAIVE_NO_PIN=1 before creating the "
+                    f"naive communicator.",
+                    stacklevel=3,
+                )
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass  # best-effort: fall through to normal discovery
@@ -198,20 +214,40 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
         for i, g in enumerate(leaves):
             groups.setdefault(cast_dtype(g), []).append(i)
         out: list = [None] * len(leaves)
+        # Pack into ~64 MB buckets rather than one whole-model buffer: the
+        # concatenated flat copy is a TRANSIENT extra full gradient in HBM;
+        # bucketing bounds that transient while each bucket stays large
+        # enough to keep the inter (DCN) level bandwidth-bound. (A single
+        # leaf bigger than the bucket gets its own bucket, unsplit.)
+        bucket_bytes = 64 << 20
         for dt, idxs in groups.items():
-            flat = jnp.concatenate(
-                [leaves[i].astype(dt).ravel() for i in idxs]
-            )
-            red = two_level_allreduce(flat, intra_ax, inter_ax)
-            off = 0
+            itemsize = jnp.dtype(dt).itemsize
+            buckets: list[list[int]] = []
+            cur: list[int] = []
+            cur_bytes = 0
             for i in idxs:
-                n = leaves[i].size
-                out[i] = (
-                    red[off : off + n]
-                    .reshape(leaves[i].shape)
-                    .astype(leaves[i].dtype)
+                nbytes = leaves[i].size * itemsize
+                if cur and cur_bytes + nbytes > bucket_bytes:
+                    buckets.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(i)
+                cur_bytes += nbytes
+            if cur:
+                buckets.append(cur)
+            for bidx in buckets:
+                flat = jnp.concatenate(
+                    [leaves[i].astype(dt).ravel() for i in bidx]
                 )
-                off += n
+                red = two_level_allreduce(flat, intra_ax, inter_ax)
+                off = 0
+                for i in bidx:
+                    n = leaves[i].size
+                    out[i] = (
+                        red[off : off + n]
+                        .reshape(leaves[i].shape)
+                        .astype(leaves[i].dtype)
+                    )
+                    off += n
         return jax.tree.unflatten(treedef, out)
 
 

@@ -39,6 +39,13 @@ def _bn_sync_interceptor(axis_name):
     The attribute is restored afterwards — module instances are reused
     across calls and transforms, so the override must not leak outside the
     converted model's forward.
+
+    Not thread-safe: the override briefly mutates the SHARED module
+    instance (``object.__setattr__`` in a try/finally), so two threads
+    tracing the same bound module concurrently could observe each other's
+    injected ``axis_name`` (or the restored ``None``) mid-call. Typical
+    JAX tracing is single-threaded; key the override in a thread-local if
+    you trace converted models from multiple threads.
     """
 
     def interceptor(next_fun, args, kwargs, context):

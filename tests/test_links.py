@@ -30,11 +30,18 @@ def comm():
 
 
 def _dense_fn(w_key):
+    import zlib
+
     def fn(params, x):
         return jnp.tanh(x @ params["w"] + params["b"])
 
     def init(rng, x):
-        k1, k2 = jax.random.split(jax.random.fold_in(rng, hash(w_key) % 1000))
+        # crc32, NOT hash(): str hash is randomized per process
+        # (PYTHONHASHSEED), which made every run draw different params —
+        # any numeric flake became unreproducible by construction.
+        k1, k2 = jax.random.split(
+            jax.random.fold_in(rng, zlib.crc32(w_key.encode()) % 1000)
+        )
         d_in = x.shape[-1]
         return {
             "w": jax.random.normal(k1, (d_in, 4)) * 0.5,
