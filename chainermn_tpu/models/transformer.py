@@ -29,27 +29,38 @@ class TransformerBlock(nn.Module):
     compute_dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
     dropout_rate: float = 0.0
+    #: kv heads for GQA/MQA (None → num_heads, i.e. standard MHA). The kv
+    #: projection shrinks accordingly; the attention kernel shares kv heads
+    #: across their q-head group (:mod:`chainermn_tpu.ops.flash_attention`).
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
-        # ``train`` is positional so ``nn.remat(..., static_argnums=(2,))``
+    def __call__(self, x, segment_ids=None, train: bool = True):
+        # ``train`` is positional so ``nn.remat(..., static_argnums=(3,))``
         # can mark it static.
         D = x.shape[-1]
         head_dim = D // self.num_heads
+        kv_heads = self.num_kv_heads or self.num_heads
         attn = self.attention_fn or blockwise_attention
 
         h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
         qkv = nn.Dense(
-            3 * D, use_bias=False,
+            (self.num_heads + 2 * kv_heads) * head_dim, use_bias=False,
             dtype=self.compute_dtype, param_dtype=jnp.float32, name="qkv",
         )(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = jnp.split(
+            qkv,
+            [self.num_heads * head_dim, (self.num_heads + kv_heads) * head_dim],
+            axis=-1,
+        )
         B, T = q.shape[:2]
 
-        def heads(t):
-            return t.reshape(B, T, self.num_heads, head_dim)
+        def heads(t, n):
+            return t.reshape(B, T, n, head_dim)
 
-        o = attn(heads(q), heads(k), heads(v), causal=True, scale=head_dim**-0.5)
+        kw = {} if segment_ids is None else {"segment_ids": segment_ids}
+        o = attn(heads(q, self.num_heads), heads(k, kv_heads),
+                 heads(v, kv_heads), causal=True, scale=head_dim**-0.5, **kw)
         o = nn.Dense(
             D, use_bias=False,
             dtype=self.compute_dtype, param_dtype=jnp.float32, name="proj",
@@ -92,9 +103,20 @@ class TransformerLM(nn.Module):
     #: states; pair with :func:`lm_loss_fused` to avoid materializing the
     #:  ``[B, T, vocab]`` logits tensor.
     return_hidden: bool = False
+    #: kv heads for GQA/MQA (None → num_heads).
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = True):
+    def __call__(self, tokens, *, segment_ids=None, train: bool = True):
+        """``segment_ids`` (optional ``[B, T]``) confines attention to
+        packed documents; requires a segment-capable ``attention_fn``
+        (e.g. :func:`chainermn_tpu.ops.flash_attention.flash_attention`)."""
+        if segment_ids is not None and self.attention_fn is None:
+            raise ValueError(
+                "segment_ids needs a segment-capable attention_fn — pass "
+                "attention_fn=flash_attention (the default blockwise "
+                "reference does not take segment masks)"
+            )
         B, T = tokens.shape
         emb = nn.Embed(
             self.vocab_size, self.d_model, param_dtype=jnp.float32,
@@ -114,7 +136,7 @@ class TransformerLM(nn.Module):
             block_cls = nn.remat(
                 TransformerBlock,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                static_argnums=(2,),  # (self, x, train)
+                static_argnums=(3,),  # (self, x, segment_ids, train)
             )
         for i in range(self.num_layers):
             x = block_cls(
@@ -122,8 +144,9 @@ class TransformerLM(nn.Module):
                 d_ff=self.d_ff,
                 compute_dtype=self.compute_dtype,
                 attention_fn=self.attention_fn,
+                num_kv_heads=self.num_kv_heads,
                 name=f"block_{i}",
-            )(x, train)
+            )(x, segment_ids, train)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
         if self.return_hidden:
             return x

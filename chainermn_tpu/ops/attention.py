@@ -155,6 +155,12 @@ def blockwise_attention(
     :mod:`chainermn_tpu.parallel`."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    if k.shape[2] != H:
+        # GQA in the reference path: materialized repeat (the flash kernel
+        # shares kv blocks via its index map instead).
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if Tk % block_k != 0:
         block_k = Tk  # fall back to one block rather than padding
     n_blocks = Tk // block_k

@@ -34,37 +34,50 @@ def ulysses_attention_local(
     scale: Optional[float] = None,
     attn_fn: Optional[Callable] = None,
     impl: str = "flash",
+    segment_ids: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Ulysses attention over local shards — call INSIDE ``shard_map``.
 
     Args:
       q/k/v: local sequence shards ``[B, T_local, H, D]``; global heads H
-        must be divisible by the axis size.
+        must be divisible by the axis size. K/V may carry fewer heads
+        (GQA/MQA) — they too must be divisible by the axis size.
       attn_fn: local attention ``fn(q, k, v, causal=..., scale=...)`` on
         ``[B, T, H_local, D]``; overrides ``impl`` when given.
       impl: ``'flash'`` — the Pallas kernel (fwd+bwd; the production path,
         same kernels as ring attention) — or ``'blockwise'`` (lax scan
         reference). ``interpret`` as in
         :func:`chainermn_tpu.parallel.ring_attention.ring_attention_local`.
+      segment_ids: optional local ``[B, T_local]`` packed-segment slice;
+        all-gathered (ids only — tiny) so the head-sharded full-sequence
+        attention sees the whole mask. Requires ``impl='flash'`` or a
+        segment-capable ``attn_fn``.
 
     Returns:
       Local output shard ``[B, T_local, H, D]``.
     """
     n = lax.axis_size(axis_name)
     H = q.shape[2]
-    if H % n != 0:
-        raise ValueError(
-            f"ulysses: num_heads {H} not divisible by axis {axis_name!r} "
-            f"size {n}"
-        )
+    for name, h in (("q", H), ("kv", k.shape[2])):
+        if h % n != 0:
+            raise ValueError(
+                f"ulysses: {name} heads {h} not divisible by axis "
+                f"{axis_name!r} size {n}"
+            )
     if attn_fn is None:
         if impl == "flash":
-            def attn_fn(q, k, v, *, causal, scale):
+            def attn_fn(q, k, v, *, causal, scale, **kw):
                 return flash_attention(
-                    q, k, v, causal=causal, scale=scale, interpret=interpret
+                    q, k, v, causal=causal, scale=scale, interpret=interpret,
+                    **kw,
                 )
         elif impl == "blockwise":
+            if segment_ids is not None:
+                raise ValueError(
+                    "segment_ids requires impl='flash' (or a "
+                    "segment-capable attn_fn)"
+                )
             attn_fn = blockwise_attention
         else:
             raise ValueError(
@@ -82,8 +95,13 @@ def ulysses_attention_local(
             x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
 
+    kw = {}
+    if segment_ids is not None:
+        kw["segment_ids"] = lax.all_gather(
+            segment_ids, axis_name, axis=1, tiled=True
+        )
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    out = attn_fn(qh, kh, vh, causal=causal, scale=scale, **kw)
     return heads_to_seq(out)
 
 
@@ -96,22 +114,26 @@ def make_ulysses_attention(
     attn_fn: Optional[Callable] = None,
     batch_axis: Optional[str] = None,
     impl: str = "flash",
+    with_segments: bool = False,
 ):
     """Jitted Ulysses attention over globally sequence-sharded BTHD arrays
-    (counterpart of :func:`chainermn_tpu.parallel.make_ring_attention`)."""
+    (counterpart of :func:`chainermn_tpu.parallel.make_ring_attention`).
+    With ``with_segments`` the returned fn takes ``(q, k, v, segment_ids)``."""
     from jax import shard_map
 
     spec = P(batch_axis, axis_name, None, None)
+    seg_spec = P(batch_axis, axis_name)
     interpret = mesh.devices.flat[0].platform != "tpu"
 
-    def local(q, k, v):
+    def local(q, k, v, seg=None):
         return ulysses_attention_local(
             q, k, v, axis_name, causal=causal, scale=scale, attn_fn=attn_fn,
-            impl=impl, interpret=interpret,
+            impl=impl, segment_ids=seg, interpret=interpret,
         )
 
+    in_specs = (spec, spec, spec) + ((seg_spec,) if with_segments else ())
     fn = shard_map(
-        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        local, mesh=mesh, in_specs=in_specs, out_specs=spec,
         check_vma=False,
     )
     return jax.jit(fn)

@@ -10,6 +10,8 @@ parallelism for long context (``--sequence-parallel``).
         --communicator naive --iterations 40 --double-buffering
     python examples/transformer/train_transformer_lm.py \
         --communicator naive --sequence-parallel --seq-len 512
+    python examples/transformer/train_transformer_lm.py \
+        --communicator naive --packed --num-kv-heads 2
 """
 
 from __future__ import annotations
@@ -60,6 +62,12 @@ def main(argv=None):
     p.add_argument("--allreduce-grad-dtype", default="bfloat16")
     p.add_argument("--sequence-parallel", action="store_true",
                    help="shard the sequence over the mesh (ring attention)")
+    p.add_argument("--packed", action="store_true",
+                   help="pack variable-length documents into each row with "
+                        "segment-id flash-attention masks (cross-document "
+                        "attention and loss are masked)")
+    p.add_argument("--num-kv-heads", type=int, default=None,
+                   help="GQA: fewer kv heads than q heads (must divide)")
     p.add_argument("--num-layers", type=int, default=6)
     p.add_argument("--d-model", type=int, default=512)
     args = p.parse_args(argv)
@@ -77,10 +85,100 @@ def main(argv=None):
     )
     rng = np.random.default_rng(0)
 
+    if args.sequence_parallel and args.packed:
+        raise SystemExit(
+            "--sequence-parallel with --packed is not wired in this "
+            "example (ring attention does accept segment_ids — see "
+            "ring_attention_local — but this CLI keeps the modes separate)"
+        )
     if args.sequence_parallel:
         run_sequence_parallel(args, comm, compute_dtype, rng)
+    elif args.packed:
+        run_packed(args, comm, compute_dtype, rng)
     else:
         run_data_parallel(args, comm, compute_dtype, rng)
+
+
+def pack_documents(rng, batch, seqlen):
+    """Pack 2-5 variable-length synthetic documents per row: returns
+    ``(tokens, segment_ids)`` — the normal LM data layout (SURVEY.md §5
+    long-context gap; the reference's seq2seq bucketing was the 2017
+    answer to the same problem)."""
+    if seqlen < 32:
+        raise SystemExit(
+            f"--packed needs --seq-len >= 32 (got {seqlen}): rows hold up "
+            "to 5 documents with 8-token margins"
+        )
+    tokens = np.zeros((batch, seqlen), np.int32)
+    seg = np.zeros((batch, seqlen), np.int32)
+    for b in range(batch):
+        n_docs = rng.integers(2, 6)
+        cuts = np.sort(rng.choice(np.arange(8, seqlen - 8), n_docs - 1,
+                                  replace=False))
+        bounds = [0, *cuts.tolist(), seqlen]
+        for d in range(n_docs):
+            lo, hi = bounds[d], bounds[d + 1]
+            tokens[b:b + 1, lo:hi] = synthetic_tokens(rng, 1, hi - lo)
+            seg[b, lo:hi] = d
+    return tokens, seg
+
+
+def run_packed(args, comm, compute_dtype, rng):
+    """Packed-sequence training: flash attention with segment-id masks so
+    documents never attend across their boundaries, and the next-token loss
+    skips cross-document targets."""
+    from chainermn_tpu.ops.flash_attention import flash_attention
+
+    interpret = jax.default_backend() != "tpu"
+
+    def attn(q, k, v, *, causal, scale, segment_ids=None):
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               segment_ids=segment_ids, interpret=interpret)
+
+    model = TransformerLM(
+        vocab_size=VOCAB, num_layers=args.num_layers,
+        d_model=args.d_model, d_ff=4 * args.d_model,
+        max_len=args.seq_len, compute_dtype=compute_dtype,
+        attention_fn=attn, num_kv_heads=args.num_kv_heads,
+    )
+    global_batch = args.batchsize * comm.size
+    tokens0, seg0 = pack_documents(rng, global_batch, args.seq_len)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.asarray(tokens0[:1])
+    )["params"]
+
+    def loss_fn(params, batch):
+        tokens, seg = batch
+        logits = model.apply({"params": params}, tokens, segment_ids=seg)
+        # Mask targets that would cross a document boundary.
+        valid = jnp.concatenate(
+            [jnp.ones_like(seg[:, :1]), (seg[:, 1:] == seg[:, :-1])], axis=1
+        )
+        return lm_loss(logits, tokens, mask=valid)
+
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adamw(args.lr), comm,
+        double_buffering=args.double_buffering,
+    )
+    state = create_train_state(params, optimizer, comm)
+    step = make_train_step(loss_fn, optimizer, comm)
+
+    t0 = time.perf_counter()
+    for it in range(args.iterations):
+        tokens, seg = pack_documents(rng, global_batch, args.seq_len)
+        state, metrics = step(state, (jnp.asarray(tokens), jnp.asarray(seg)))
+        if comm.rank == 0 and (it + 1) % 10 == 0:
+            jax.block_until_ready(metrics["loss"])
+            tps = global_batch * args.seq_len * (it + 1) / (
+                time.perf_counter() - t0
+            )
+            print(
+                f"iter {it + 1}/{args.iterations} "
+                f"loss={float(metrics['loss']):.4f} ({tps:,.0f} tok/s, packed)"
+            )
+    jax.block_until_ready(state.params)
+    if comm.rank == 0:
+        print("done (packed)")
 
 
 def run_data_parallel(args, comm, compute_dtype, rng):
@@ -88,6 +186,7 @@ def run_data_parallel(args, comm, compute_dtype, rng):
         vocab_size=VOCAB, num_layers=args.num_layers,
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, compute_dtype=compute_dtype,
+        num_kv_heads=args.num_kv_heads,
     )
     global_batch = args.batchsize * comm.size
     tokens0 = synthetic_tokens(rng, global_batch, args.seq_len)
@@ -145,12 +244,13 @@ def run_sequence_parallel(args, comm, compute_dtype, rng):
         vocab_size=VOCAB, num_layers=args.num_layers,
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, compute_dtype=compute_dtype,
-        attention_fn=ring_attn,
+        attention_fn=ring_attn, num_kv_heads=args.num_kv_heads,
     )
     ref = TransformerLM(
         vocab_size=VOCAB, num_layers=args.num_layers,
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, compute_dtype=compute_dtype,
+        num_kv_heads=args.num_kv_heads,
     )
     batch = 2
     tokens0 = synthetic_tokens(rng, batch, args.seq_len)

@@ -71,6 +71,59 @@ class TestTransformerLM:
             params2, opt_state, ln = step(params2, opt_state)
         assert float(ln) < float(l0)
 
+    def test_gqa_model_trains_and_shrinks_kv(self):
+        """num_kv_heads shrinks the qkv projection and still trains; MHA
+        (num_kv_heads=num_heads) keeps the original 3*D parameter shape."""
+        mha = tiny_lm()
+        gqa = tiny_lm(num_kv_heads=2)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, VOCAB)
+        p_mha = mha.init(jax.random.PRNGKey(1), tokens)
+        p_gqa = gqa.init(jax.random.PRNGKey(1), tokens)
+        w_mha = p_mha["params"]["block_0"]["qkv"]["kernel"]
+        w_gqa = p_gqa["params"]["block_0"]["qkv"]["kernel"]
+        assert w_mha.shape == (32, 3 * 32)
+        # 4 q heads of 8 dims + 2*2 kv heads of 8 dims
+        assert w_gqa.shape == (32, (4 + 4) * 8)
+        loss = lm_loss(gqa.apply(p_gqa, tokens), tokens)
+        assert np.isfinite(float(loss))
+        g = jax.grad(lambda p: lm_loss(gqa.apply(p, tokens), tokens))(p_gqa)
+        assert all(np.isfinite(x).all() for x in jax.tree.leaves(g))
+
+    def test_packed_segments_confine_attention(self):
+        """With segment ids, changing tokens of document 2 must not change
+        logits inside document 1 (flash path; causality test's packed
+        analog)."""
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        def attn(q, k, v, *, causal, scale, segment_ids=None):
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   segment_ids=segment_ids, interpret=True)
+
+        model = tiny_lm(attention_fn=attn)
+        t1 = jax.random.randint(jax.random.PRNGKey(0), (1, 16), 0, VOCAB)
+        seg = jnp.asarray([[0] * 8 + [1] * 8])
+        t2 = t1.at[0, 8:].set((t1[0, 8:] + 3) % VOCAB)
+        params = model.init(jax.random.PRNGKey(1), t1)
+        l1 = model.apply(params, t1, segment_ids=seg)
+        l2 = model.apply(params, t2, segment_ids=seg)
+        np.testing.assert_allclose(l1[:, :8], l2[:, :8], rtol=1e-5, atol=1e-5)
+        # and with no segment ids the same edit WOULD leak backward? No —
+        # causal masking already stops past positions seeing the future;
+        # the real packed hazard is doc 1 attending doc 0. Check the other
+        # direction: change document 0, document 1's logits must ALSO stay
+        # fixed (only possible because of the segment mask).
+        t3 = t1.at[0, :8].set((t1[0, :8] + 5) % VOCAB)
+        l3 = model.apply(params, t3, segment_ids=seg)
+        np.testing.assert_allclose(l1[:, 8:], l3[:, 8:], rtol=1e-5, atol=1e-5)
+
+    def test_segment_ids_require_capable_attention(self):
+        model = tiny_lm()
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, VOCAB)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+        with pytest.raises(ValueError, match="segment-capable"):
+            model.apply(params, tokens, segment_ids=jnp.zeros((1, 8),
+                                                              jnp.int32))
+
     def test_fused_lm_loss_matches_plain(self):
         """``lm_loss_fused`` on hidden states == ``lm_loss`` on the full
         logits (f32 compute so rounding cannot hide a real defect), for an

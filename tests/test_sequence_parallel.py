@@ -299,3 +299,61 @@ class TestUlyssesAttention:
         fn = make_ulysses_attention(comm.mesh, comm.axis_name)
         with pytest.raises(ValueError, match="not divisible"):
             fn(q, q, q)
+
+    def test_segment_ids_match_masked_dense(self, comm):
+        """Packed segments through Ulysses: local id slices are
+        all-gathered for the head-sharded full-sequence kernel."""
+        q, k, v = _qkv(8)
+        rng = np.random.RandomState(3)
+        seg = np.zeros((B, T), np.int32)
+        for b in range(B):
+            cut = rng.randint(4, T - 4)
+            seg[b, cut:] = 1
+        seg = jnp.asarray(seg)
+        fn = make_ulysses_attention(
+            comm.mesh, comm.axis_name, causal=True, with_segments=True
+        )
+        ref = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(fn(q, k, v, seg)), ref,
+                                   rtol=1e-5, atol=1e-5)
+        g = jax.grad(lambda a, b_, c: (fn(a, b_, c, seg) ** 2).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(
+            lambda a, b_, c: (dot_product_attention(
+                a, b_, c, causal=True, segment_ids=seg) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b_: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4
+            ),
+            g, g_ref,
+        )
+
+    def test_gqa_kv_heads_reshard(self, comm):
+        """GQA through Ulysses: 16 q heads with 8 kv heads (== axis size,
+        the minimum reshardable count) — the reshard must keep head groups
+        aligned with the kernel's kv-sharing index map. Values AND grads."""
+        ks = jax.random.split(jax.random.PRNGKey(10), 3)
+        q = jax.random.normal(ks[0], (B, T, 16, D))
+        k = jax.random.normal(ks[1], (B, T, 8, D))
+        v = jax.random.normal(ks[2], (B, T, 8, D))
+        fn = make_ulysses_attention(comm.mesh, comm.axis_name, causal=True)
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(fn(q, k, v)), ref,
+                                   rtol=1e-5, atol=1e-5)
+        g = jax.grad(lambda a, b_, c: (fn(a, b_, c) ** 2).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(
+            lambda a, b_, c: (dot_product_attention(
+                a, b_, c, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b_: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4
+            ),
+            g, g_ref,
+        )
+        # a kv head count below the axis size is rejected with a clear error
+        k2 = jnp.zeros((B, T, 2, D))
+        with pytest.raises(ValueError, match="kv heads"):
+            fn(q, k2, k2)
