@@ -31,6 +31,7 @@ def __getattr__(name):
         return getattr(_ul, name)
     if name in (
         "pipeline_local", "make_pipeline", "stack_stage_params",
+        "stack_interleaved_stage_params", "pipeline_total_ticks",
         "pipeline_1f1b_local", "make_pipeline_1f1b",
     ):
         from chainermn_tpu.parallel import pipeline as _pp
@@ -73,6 +74,8 @@ __all__ = [
     "make_ulysses_attention",
     "pipeline_local",
     "make_pipeline",
+    "stack_interleaved_stage_params",
+    "pipeline_total_ticks",
     "stack_stage_params",
     "pipeline_1f1b_local",
     "make_pipeline_1f1b",

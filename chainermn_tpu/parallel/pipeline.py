@@ -37,61 +37,133 @@ from jax.sharding import Mesh, PartitionSpec as P
 PyTree = Any
 
 
+def pipeline_total_ticks(n_stages: int, n_micro: int,
+                         virtual_stages: int = 1) -> int:
+    """Schedule length of :func:`pipeline_local` in conveyor ticks (one
+    chunk execution per stage per tick).
+
+    ``virtual_stages == 1``: the classic GPipe ``n_micro + n - 1``, bubble
+    fraction ``(n-1)/(n_micro + n - 1)``.
+
+    ``virtual_stages == v > 1``: microbatches stream in waves of ``n``
+    through the looped conveyor; each wave occupies ``v*n`` ticks per
+    stage back-to-back, so for ``n | n_micro`` the total is
+    ``v*n_micro + n - 1`` and the bubble fraction shrinks to
+    ``(n - 1) / (v*n_micro + n - 1)`` — each tick is 1/v of a full-stage
+    forward, so the fill/drain cost is amortised over v× more (smaller)
+    ticks. Partial waves still occupy a full ``v*n``-tick wave slot
+    (choose ``n_micro`` a multiple of ``n_stages``)."""
+    if virtual_stages == 1:
+        return n_micro + n_stages - 1
+    waves = -(-n_micro // n_stages)
+    return virtual_stages * n_stages * waves + n_stages - 1
+
+
 def pipeline_local(
     stage_fn: Callable,
     stage_params: PyTree,
     x: jax.Array,
     axis_name: str = "stage",
+    virtual_stages: int = 1,
 ) -> jax.Array:
-    """Run the GPipe schedule over local shards — call INSIDE ``shard_map``.
+    """Run the (interleaved) GPipe schedule over local shards — call INSIDE
+    ``shard_map``.
 
     Args:
       stage_fn: ``stage_fn(params, x_microbatch) -> y_microbatch`` — one
         pipeline stage; output shape/dtype must equal input shape/dtype
         (stage-to-stage activations travel a homogeneous ring buffer).
-      stage_params: this stage's parameter pytree (the caller's in_spec
-        sharded the stacked params over ``axis_name`` and collapsed the
-        leading axis).
+      stage_params: this stage's parameter pytree. With
+        ``virtual_stages == 1`` the caller's in_spec sharded the stacked
+        params over ``axis_name`` and collapsed the leading axis; with
+        ``v > 1`` the leaves keep a leading ``[v, ...]`` axis — this
+        stage's model chunks (global stage ``j*n + s`` is chunk ``j``
+        here; see :func:`stack_interleaved_stage_params`).
       x: ``[n_micro, mb, ...]`` microbatched input (replicated across
         stages; only stage 0 consumes it).
+      virtual_stages: interleave ``v`` model chunks per physical stage —
+        the looped conveyor: microbatch ``i`` (wave ``w = i // n``, slot
+        ``r = i % n``) runs chunk ``j`` on stage ``s`` at tick
+        ``t = w*v*n + j*n + r + s``. Activations hop ``s → s+1`` every
+        tick, and the last stage's chunk-``j`` output loops back to stage
+        0 as chunk ``j+1``'s input — which the formula shows arrives
+        exactly one tick later. Each stage is busy ``v*n`` CONSECUTIVE
+        ticks per wave (fill is still only ``n-1`` ticks), so the bubble
+        shrinks to ``(n-1)/(v*n_micro + n - 1)``
+        (:func:`pipeline_total_ticks`). The transposed backward replays
+        the mirrored conveyor with the same fill — interleaving composes
+        with autodiff at full efficiency.
+
+        Why the GPipe engine and not 1F1B: an interleaved 1F1B built on
+        this conveyor (forwards on even ticks, mirrored backward conveyor
+        on odd ticks) idles ``(2v+2)n - 4`` chunk-ticks per stage — MORE
+        than plain 1F1B's ``2vn - 2v`` at equal microbatch count, because
+        the parity split wastes the warmup's odd slots and the drain's
+        even slots. Closing that gap needs Megatron's warmup/steady/drain
+        op reordering with per-chunk arrival buffers, which buys nothing
+        over this schedule in bubble terms (both reach ``(n-1)`` fill) —
+        its advantage is bounded activation memory, which
+        :func:`pipeline_1f1b_local` already provides at ``v == 1``. So:
+        interleave for bubble (here, GPipe memory profile, pair with
+        ``remat_stages``), 1F1B for memory.
 
     Returns:
-      ``[n_micro, mb, ...]`` — the final stage's outputs, valid on the last
+      ``[n_micro, mb, ...]`` — the final chunk's outputs, valid on the last
       stage and replicated to all stages for convenience (psum-broadcast).
     """
     n = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
+    v = virtual_stages
     n_micro = x.shape[0]
     mb_shape = x.shape[1:]
-    total = n_micro + n - 1
+    total = pipeline_total_ticks(n, n_micro, v)
 
-    # send stage i -> i+1 (last stage's output falls off the conveyor)
-    perm = [(i, i + 1) for i in range(n - 1)]
+    if v == 1:
+        # send stage i -> i+1 (last stage's output falls off the conveyor)
+        perm = [(i, i + 1) for i in range(n - 1)]
+    else:
+        # full rotation: the last stage's output loops back as the next
+        # chunk's input on stage 0
+        perm = [(i, (i + 1) % n) for i in range(n)]
 
     def tick(carry, t):
         buf, outputs = carry
-        # Stage 0 eats microbatch t (clamped; masked when t >= n_micro),
-        # other stages eat what arrived from the left neighbour.
-        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        d = t - s
+        if v == 1:
+            j = jnp.int32(0)
+            i_raw = d
+            chunk_params = stage_params
+        else:
+            dm = d % (v * n)
+            j = dm // n  # this tick's model chunk
+            i_raw = (d // (v * n)) * n + d % n
+            chunk_params = jax.tree.map(
+                lambda p: lax.dynamic_index_in_dim(
+                    p, jnp.clip(j, 0, v - 1), keepdims=False
+                ),
+                stage_params,
+            )
+        valid = jnp.logical_and(d >= 0, i_raw < n_micro)
+        mb_idx = jnp.clip(i_raw, 0, n_micro - 1)
         feed = lax.dynamic_index_in_dim(x, mb_idx, keepdims=False)
-        inp = jnp.where(s == 0, feed, buf)
-        out = stage_fn(stage_params, inp)
-        # Valid iff this stage is currently working on a real microbatch:
-        # stage s works on microbatch t - s.
-        valid = jnp.logical_and(t - s >= 0, t - s < n_micro)
+        # Stage 0 chunk 0 eats microbatch i; everything else eats the
+        # conveyor: stage s>0 gets (s-1, same chunk), stage 0 gets the
+        # loop-back (n-1, previous chunk).
+        inp = jnp.where(jnp.logical_and(s == 0, j == 0), feed, buf)
+        out = stage_fn(chunk_params, inp)
         out = jnp.where(valid, out, jnp.zeros_like(out))
-        # Last stage banks its finished microbatch.
-        out_idx = jnp.clip(t - (n - 1), 0, n_micro - 1)
-        is_last = s == n - 1
-        bank = jnp.logical_and(is_last, t - (n - 1) >= 0)
+        # Last stage banks its finished microbatch (final chunk only).
+        bank = jnp.logical_and(
+            valid, jnp.logical_and(s == n - 1, j == v - 1)
+        )
         outputs = lax.dynamic_update_index_in_dim(
             outputs,
             jnp.where(
                 bank,
                 out,
-                lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False),
+                lax.dynamic_index_in_dim(outputs, mb_idx, keepdims=False),
             ),
-            out_idx,
+            mb_idx,
             0,
         )
         buf = lax.ppermute(out, axis_name, perm)
@@ -115,6 +187,7 @@ def make_pipeline(
     n_microbatches: Optional[int] = None,
     remat_stages: bool = False,
     batch_axis: Optional[str] = None,
+    virtual_stages: int = 1,
 ):
     """Build a jitted pipelined apply over stacked stage parameters.
 
@@ -123,6 +196,11 @@ def make_pipeline(
     the full batch ``[batch, ...]``; the batch is split into
     ``n_microbatches`` equal microbatches (default: the stage count, the
     classic GPipe minimum for full utilisation... of the steady state).
+
+    ``virtual_stages=v`` interleaves ``v`` model chunks per physical stage
+    (``stacked_params`` leading dim becomes ``n_stages * v``, in the
+    layout of :func:`stack_interleaved_stage_params`), shrinking the
+    bubble to ``(n-1)/(v*n_micro + n - 1)`` — see :func:`pipeline_local`.
 
     ``remat_stages=True`` wraps each stage in ``jax.checkpoint``: the
     backward recomputes each stage's INTERNAL activations instead of
@@ -151,8 +229,22 @@ def make_pipeline(
     x_spec = P(batch_axis)  # replicated over stages; dp-sharded if asked
 
     def local(stacked_params, x):
-        # shard_map gave us a [1, ...] slice of each stacked leaf: collapse.
-        params = jax.tree.map(lambda p: p[0], stacked_params)
+        if virtual_stages == 1:
+            # shard_map gave a [1, ...] slice of each stacked leaf: collapse.
+            params = jax.tree.map(lambda p: p[0], stacked_params)
+        else:
+            # [v, ...] slice — this stage's model chunks, kept stacked.
+            leaves = jax.tree.leaves(stacked_params)
+            if leaves and leaves[0].shape[0] != virtual_stages:
+                raise ValueError(
+                    f"virtual_stages={virtual_stages} needs params stacked "
+                    f"to leading dim n_stages*virtual_stages="
+                    f"{n_stages * virtual_stages} (per-stage slice "
+                    f"{virtual_stages}); got per-stage slice "
+                    f"{leaves[0].shape[0]} — use "
+                    f"stack_interleaved_stage_params"
+                )
+            params = stacked_params
         batch = x.shape[0]
         if batch % n_micro:
             raise ValueError(
@@ -160,7 +252,8 @@ def make_pipeline(
             )
         mb = batch // n_micro
         xm = x.reshape((n_micro, mb) + x.shape[1:])
-        ym = pipeline_local(stage_fn, params, xm, axis_name)
+        ym = pipeline_local(stage_fn, params, xm, axis_name,
+                            virtual_stages=virtual_stages)
         return ym.reshape((batch,) + ym.shape[2:])
 
     fn = shard_map(
@@ -178,6 +271,23 @@ def stack_stage_params(params_list) -> PyTree:
     leading axis — the layout ``make_pipeline`` expects, shardable over the
     ``'stage'`` mesh axis."""
     return jax.tree.map(lambda *ls: jnp.stack(ls), *params_list)
+
+
+def stack_interleaved_stage_params(params_list, n_stages: int,
+                                   virtual_stages: int) -> PyTree:
+    """Stack ``n_stages * virtual_stages`` per-global-stage pytrees (in
+    execution order) into the interleaved layout ``make_pipeline(...,
+    virtual_stages=v)`` expects: position ``s*v + j`` holds global stage
+    ``j*n + s``, so the ``axis_name`` sharding hands physical stage ``s``
+    a contiguous ``[v, ...]`` slice containing exactly its chunks."""
+    n, v = n_stages, virtual_stages
+    if len(params_list) != n * v:
+        raise ValueError(
+            f"need n_stages*virtual_stages={n * v} stage params, "
+            f"got {len(params_list)}"
+        )
+    order = [j * n + s for s in range(n) for j in range(v)]
+    return stack_stage_params([params_list[g] for g in order])
 
 
 # ---------------------------------------------------------------------------

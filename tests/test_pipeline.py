@@ -99,6 +99,96 @@ class TestPipeline:
             fn(stacked, x)
 
 
+class TestInterleavedPipeline:
+    """Virtual-stage (interleaved) schedule: v model chunks per physical
+    stage on the looped conveyor — VERDICT r2 item 7."""
+
+    @pytest.mark.parametrize("v,n_micro", [(2, 8), (2, 16), (3, 8)])
+    def test_matches_sequential(self, comm, v, n_micro):
+        from chainermn_tpu.parallel.pipeline import (
+            stack_interleaved_stage_params,
+        )
+
+        n = comm.size
+        params_list = _params(7, n * v)  # n*v global stages
+        stacked = stack_interleaved_stage_params(params_list, n, v)
+        batch = 32
+        x = jax.random.normal(jax.random.PRNGKey(8), (batch, DIM))
+        fn = make_pipeline(
+            stage_fn, comm.mesh, axis_name=comm.axis_name,
+            n_microbatches=n_micro, virtual_stages=v,
+        )
+        ref = _sequential(params_list, x)
+        np.testing.assert_allclose(np.asarray(fn(stacked, x)), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grads_match_sequential(self, comm):
+        from chainermn_tpu.parallel.pipeline import (
+            stack_interleaved_stage_params,
+        )
+
+        n, v = comm.size, 2
+        params_list = _params(9, n * v)
+        stacked = stack_interleaved_stage_params(params_list, n, v)
+        batch = 16
+        x = jax.random.normal(jax.random.PRNGKey(10), (batch, DIM))
+        y = jax.random.normal(jax.random.PRNGKey(11), (batch, DIM))
+        fn = make_pipeline(
+            stage_fn, comm.mesh, axis_name=comm.axis_name,
+            n_microbatches=8, virtual_stages=v,
+        )
+
+        order = [j * n + s for s in range(n) for j in range(v)]
+        inv = [order.index(g) for g in range(n * v)]
+
+        def loss_pipe(stacked):
+            return ((fn(stacked, x) - y) ** 2).mean()
+
+        def loss_seq(stacked):
+            params_list = [
+                jax.tree.map(lambda l: l[inv[g]], stacked)
+                for g in range(n * v)
+            ]
+            return ((_sequential(params_list, x) - y) ** 2).mean()
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_seq = jax.grad(loss_seq)(stacked)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            ),
+            g_pipe,
+            g_seq,
+        )
+
+    def test_bubble_fraction_shrinks(self):
+        """The schedule-length formula: interleaving amortises the same
+        (n-1)-tick fill over v× more (1/v-sized) ticks, so the bubble
+        fraction drops from (n-1)/(m+n-1) to (n-1)/(v*m+n-1)."""
+        from chainermn_tpu.parallel.pipeline import pipeline_total_ticks
+
+        n, m = 8, 32
+        for v in (1, 2, 4):
+            total = pipeline_total_ticks(n, m, v)
+            assert total == v * m + n - 1  # n | m — clean waves
+            bubble = (n - 1) / total
+            assert abs(bubble - (n - 1) / (v * m + n - 1)) < 1e-12
+        t1 = pipeline_total_ticks(n, m, 1)
+        t4 = pipeline_total_ticks(n, m, 4)
+        # Wall-clock: a v-chunk tick is 1/v of a full-stage tick.
+        assert t4 / 4 < t1
+        # Partial waves occupy a full wave slot.
+        assert pipeline_total_ticks(4, 6, 2) == 2 * 4 * 2 + 3
+
+    def test_stacking_layout_validates(self):
+        from chainermn_tpu.parallel.pipeline import (
+            stack_interleaved_stage_params,
+        )
+
+        with pytest.raises(ValueError, match="stage params"):
+            stack_interleaved_stage_params(_params(0, 6), 4, 2)
+
+
 def test_remat_stages_matches_plain(comm):
     """remat_stages recomputes in the backward; values and grads must be
     identical to the stored-activation schedule."""
