@@ -30,6 +30,26 @@ from jax import lax
 PyTree = Any
 
 
+def _dense_from_slots(slots, logits, capacity):
+    """Expand index-form routing into the dense ``(dispatch, combine)``
+    pair (``[T, E, C]`` each, ``logits.dtype`` dispatch / f32-promoted
+    gates as before)."""
+    n_experts = logits.shape[-1]
+    sentinel = n_experts * capacity
+    tokens = logits.shape[0]
+    dispatch = jnp.zeros((tokens, n_experts, capacity), logits.dtype)
+    combine = None
+    for slot, gate in slots:
+        # one_hot over sentinel+1 classes; the sentinel (dropped) column is
+        # sliced off, zeroing dropped tokens.
+        oh = jax.nn.one_hot(slot, sentinel + 1, dtype=logits.dtype)
+        oh = oh[:, :sentinel].reshape(tokens, n_experts, capacity)
+        dispatch = dispatch + oh
+        term = oh * gate[:, None, None]
+        combine = term if combine is None else combine + term
+    return dispatch, combine
+
+
 def top1_route(
     logits: jax.Array,  # [tokens, n_experts]
     capacity: int,
@@ -41,24 +61,9 @@ def top1_route(
       combine:  same shape, dispatch * gate probability (for the return
         trip, carries the gradient to the router).
     """
-    n_experts = logits.shape[-1]
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # [tokens]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
-    # position of each token within its expert's queue
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [tokens, experts]
-    pos = pos.max(axis=-1)  # [tokens]
-    keep = pos < capacity
-
-    dispatch = (
-        jax.nn.one_hot(expert, n_experts, dtype=logits.dtype)[:, :, None]
-        * jax.nn.one_hot(pos, capacity, dtype=logits.dtype)[:, None, :]
+    return _dense_from_slots(
+        route_slots(logits, capacity, 1), logits, capacity
     )
-    dispatch = dispatch * keep[:, None, None].astype(logits.dtype)
-    combine = dispatch * gate[:, None, None]
-    return dispatch, combine
 
 
 def topk_route(
@@ -78,49 +83,13 @@ def topk_route(
     the residual path covers the dropped mass). Returns the same
     ``(dispatch, combine)`` pair as :func:`top1_route`
     (``[tokens, n_experts, capacity]``).
+
+    All routing bookkeeping lives in :func:`route_slots` (shared with the
+    sort dispatch path, so the two ``dispatch_impl``s cannot drift).
     """
-    n_experts = logits.shape[-1]
-    if k > n_experts:
-        raise ValueError(f"k={k} exceeds n_experts={n_experts}")
-    probs = jax.nn.softmax(logits, axis=-1)
-
-    # Select in LOGIT space with an explicit taken-mask: prob-space
-    # masking re-selects expert 0 when remaining softmax mass underflows
-    # (diverged router), and -inf/finfo.min masking alone still re-picks a
-    # taken expert when the CALLER pads disallowed experts with -inf. A
-    # duplicate pick (only possible when every untaken expert is -inf) is
-    # zeroed outright — no queue slot, no gate weight.
-    taken = jnp.zeros_like(logits, dtype=jnp.int32)
-    chosen = []  # (onehot_int [t,e], gate [t])
-    for _ in range(k):
-        avail = jnp.where(taken > 0, -jnp.inf, logits)
-        expert = jnp.argmax(avail, axis=-1)
-        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
-        onehot = onehot * (1 - taken)  # zero a duplicate pick entirely
-        gate = (probs * onehot).sum(-1)
-        chosen.append((onehot, gate))
-        taken = taken + onehot
-
-    # Queue bookkeeping in int32 (as top1_route does): a low-precision
-    # logits dtype must never round slot indices — bf16 cumsum collides
-    # queue slots past 256 tokens.
-    denom = sum(g for _, g in chosen) + 1e-9
-    counts = jnp.zeros((n_experts,), jnp.int32)  # kept tokens per queue
-    dispatch = jnp.zeros((logits.shape[0], n_experts, capacity), logits.dtype)
-    combine = jnp.zeros_like(dispatch)
-    for onehot, gate in chosen:
-        pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot + counts[None, :]
-        pos_tok = (pos * onehot).sum(-1)
-        keep = (pos_tok < capacity) & (onehot.sum(-1) > 0)
-        d = (
-            onehot.astype(logits.dtype)[:, :, None]
-            * jax.nn.one_hot(pos_tok, capacity, dtype=logits.dtype)[:, None, :]
-        ) * keep[:, None, None].astype(logits.dtype)
-        dispatch = dispatch + d
-        combine = combine + d * (gate / denom)[:, None, None]
-        counts = counts + (onehot * keep[:, None]).sum(0)
-        counts = jnp.minimum(counts, capacity)
-    return dispatch, combine
+    return _dense_from_slots(
+        route_slots(logits, capacity, k), logits, capacity
+    )
 
 
 def load_balancing_loss(logits: jax.Array) -> jax.Array:
@@ -139,6 +108,131 @@ def load_balancing_loss(logits: jax.Array) -> jax.Array:
     return n_experts * jnp.sum(frac * mean_prob)
 
 
+def route_slots(
+    logits: jax.Array,  # [tokens, n_experts]
+    capacity: int,
+    k: int = 1,
+):
+    """Index-form routing: the same Switch/GShard bookkeeping as
+    :func:`top1_route` / :func:`topk_route`, but returning per-choice
+    ``(slot, gate)`` pairs instead of dense ``[T, E, C]`` tensors.
+
+    ``slot[t] = expert[t]*capacity + queue_pos[t]`` for kept tokens and
+    the sentinel ``n_experts*capacity`` for dropped ones; ``gate`` carries
+    the (k-normalised) router weight. O(T·E) bookkeeping, nothing O(T·E·C).
+    """
+    n_experts = logits.shape[-1]
+    if k > n_experts:
+        raise ValueError(f"k={k} exceeds n_experts={n_experts}")
+    probs = jax.nn.softmax(logits, axis=-1)
+    sentinel = n_experts * capacity
+
+    if k == 1:
+        expert = jnp.argmax(probs, axis=-1)
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+        pos = ((jnp.cumsum(onehot, axis=0) - 1) * onehot).sum(-1)
+        keep = pos < capacity
+        slot = jnp.where(keep, expert * capacity + pos, sentinel)
+        return [(slot, gate)]
+
+    # Top-k selection in LOGIT space with an explicit taken-mask:
+    # prob-space masking re-selects expert 0 when remaining softmax mass
+    # underflows (diverged router), and -inf masking alone still re-picks
+    # a taken expert when the CALLER pads disallowed experts with -inf. A
+    # duplicate pick (only possible when every untaken expert is -inf) is
+    # zeroed outright — no queue slot, no gate weight. Queue bookkeeping
+    # stays int32: a low-precision logits dtype must never round slot
+    # indices (bf16 cumsum collides queue slots past 256 tokens).
+    taken = jnp.zeros_like(logits, dtype=jnp.int32)
+    chosen = []
+    for _ in range(k):
+        avail = jnp.where(taken > 0, -jnp.inf, logits)
+        expert = jnp.argmax(avail, axis=-1)
+        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+        onehot = onehot * (1 - taken)
+        gate = (probs * onehot).sum(-1)
+        chosen.append((expert, onehot, gate))
+        taken = taken + onehot
+
+    denom = sum(g for _, _, g in chosen) + 1e-9
+    counts = jnp.zeros((n_experts,), jnp.int32)
+    out = []
+    for expert, onehot, gate in chosen:
+        pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot + counts[None, :]
+        pos_tok = (pos * onehot).sum(-1)
+        keep = (pos_tok < capacity) & (onehot.sum(-1) > 0)
+        slot = jnp.where(keep, expert * capacity + pos_tok, sentinel)
+        out.append((slot, gate / denom))
+        counts = counts + (onehot * keep[:, None]).sum(0)
+        counts = jnp.minimum(counts, capacity)
+    return out
+
+
+def dispatch_einsum(x, logits, capacity, k):
+    """Dense one-hot dispatch (reference): builds ``[T, E, C]`` dispatch /
+    combine tensors. Returns ``(queues [E, C, d], combine_fn)`` where
+    ``combine_fn(back [E, C, d]) -> [T, d]``."""
+    if k == 1:
+        dispatch, combine = top1_route(logits, capacity)
+    else:
+        dispatch, combine = topk_route(logits, capacity, k)
+    queues = jnp.einsum("td,tec->ecd", x, dispatch)
+
+    def combine_fn(back):
+        return jnp.einsum("ecd,tec->td", back, combine)
+
+    return queues, combine_fn
+
+
+def dispatch_sort(x, logits, capacity, k):
+    """Index-based dispatch: queue assembly is one int scatter of slot ids
+    plus one row gather — O(T·d + E·C·d) work and memory, no ``[T, E, C]``
+    tensor anywhere (the scalable form at LM scale, where the dense form's
+    O(T·E·C·d) dispatch einsum dominates the layer).
+
+    Same routing bookkeeping as :func:`dispatch_einsum` (via
+    :func:`route_slots`), so results are identical. Returns the same
+    ``(queues, combine_fn)`` pair."""
+    tokens, d = x.shape
+    n_experts = logits.shape[-1]
+    slots = route_slots(logits, capacity, k)
+    sentinel = n_experts * capacity
+    # Match the einsum path's promotion semantics exactly: its queue einsum
+    # promotes (x, dispatch[logits.dtype]) and its combine einsum promotes
+    # (back, combine[f32-promoted gates]) — switching dispatch_impl must
+    # not change dtypes or gate precision.
+    q_dtype = jnp.promote_types(x.dtype, logits.dtype)
+
+    # token_of_slot: which token fills each queue slot (sentinel-initialised
+    # so empty slots gather the zero row). Dropped tokens write the
+    # sentinel slot, which is sliced off.
+    token_of_slot = jnp.full((sentinel + 1,), tokens, jnp.int32)
+    for slot, _ in slots:
+        token_of_slot = token_of_slot.at[slot].set(
+            jnp.arange(tokens, dtype=jnp.int32)
+        )
+    x_pad = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)]).astype(q_dtype)
+    queues = x_pad[token_of_slot[:sentinel]].reshape(n_experts, capacity, d)
+
+    def combine_fn(back):
+        gate_dtype = slots[0][1].dtype
+        out_dtype = jnp.promote_types(back.dtype, gate_dtype)
+        flat = jnp.concatenate(
+            [back.reshape(sentinel, d),
+             jnp.zeros((1, d), back.dtype)]
+        ).astype(out_dtype)
+        out = jnp.zeros((tokens, d), out_dtype)
+        for slot, gate in slots:
+            out = out + flat[slot] * gate[:, None].astype(out_dtype)
+        return out
+
+    return queues, combine_fn
+
+
+_DISPATCH = {"einsum": dispatch_einsum, "sort": dispatch_sort}
+
+
 def moe_layer_local(
     x: jax.Array,              # [tokens_local, d_model]
     router_w: jax.Array,       # [d_model, n_experts_global]
@@ -148,10 +242,15 @@ def moe_layer_local(
     *,
     capacity_factor: float = 1.25,
     k: int = 1,
+    dispatch_impl: str = "einsum",
 ) -> jax.Array:
     """One MoE layer inside ``shard_map``: one expert per shard along
     ``axis_name``; tokens ride two ``all_to_all``s. ``k=1`` is Switch-style
     top-1 routing, ``k=2`` GShard-style top-2 (capacity scales with k).
+
+    ``dispatch_impl``: ``'einsum'`` (dense one-hot [T,E,C] tensors — the
+    reference form, fine at test scale) or ``'sort'`` (index scatter +
+    gather, O(T·d) — the scalable form; same routing, same numbers).
 
     Returns the combined expert outputs for the local tokens (zeros for
     dropped tokens — add the residual outside).
@@ -163,13 +262,8 @@ def moe_layer_local(
     capacity = max(1, math.ceil(tokens * k / n * capacity_factor))
 
     logits = x @ router_w  # [tokens, n]
-    if k == 1:
-        dispatch, combine = top1_route(logits, capacity)
-    else:
-        dispatch, combine = topk_route(logits, capacity, k)
+    queues, combine_fn = _DISPATCH[dispatch_impl](x, logits, capacity, k)
 
-    # Gather each expert's queue locally: [n, capacity, d]
-    queues = jnp.einsum("td,tec->ecd", x, dispatch)
     # Exchange: shard i sends queue row e to shard e, receives its own
     # expert's queue from every shard -> [n(senders), capacity, d]
     recv = lax.all_to_all(queues, axis_name, split_axis=0, concat_axis=0,
@@ -180,7 +274,7 @@ def moe_layer_local(
     # Return trip + weighted combine back into token order
     back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                           tiled=True)
-    return jnp.einsum("ecd,tec->td", back, combine)
+    return combine_fn(back)
 
 
 def make_expert_params(init_fn: Callable, rng: jax.Array, n_experts: int):

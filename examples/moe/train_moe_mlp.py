@@ -49,6 +49,10 @@ def main(argv=None):
     p.add_argument("--topk", type=int, default=1, choices=(1, 2),
                    help="1: Switch top-1 routing; 2: GShard top-2")
     p.add_argument("--capacity-factor", type=float, default=1.5)
+    p.add_argument("--dispatch-impl", default="sort",
+                   choices=("einsum", "sort"),
+                   help="queue assembly: dense one-hot einsum (reference) "
+                        "or index sort/scatter (scalable, default)")
     p.add_argument("--aux-weight", type=float, default=1e-2,
                    help="load-balancing auxiliary loss weight")
     args = p.parse_args(argv)
@@ -108,6 +112,7 @@ def main(argv=None):
             h = h + moe_layer_local(
                 h, dense["router"], expert_fn, my_experts, "expert",
                 capacity_factor=args.capacity_factor, k=args.topk,
+                dispatch_impl=args.dispatch_impl,
             )
             logits = h @ dense["w_out"]
             task = optax.softmax_cross_entropy_with_integer_labels(

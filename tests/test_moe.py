@@ -5,6 +5,7 @@ invariant, applied to the new EP layer)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -125,6 +126,110 @@ class TestMoELayer:
         assert all(
             float(jnp.abs(l).sum()) > 0 for l in jax.tree.leaves(g_experts)
         )
+
+class TestSortDispatch:
+    """dispatch_impl='sort' (index scatter/gather, no [T,E,C] tensor) must
+    reproduce the dense einsum dispatch exactly — values AND gradients,
+    top-1 and top-2, WITH drops (tight capacity) — VERDICT r2 item 8."""
+
+    def _layer(self, comm, impl, k, capacity_factor):
+        ax = comm.axis_name
+
+        def local(x, router_w, stacked):
+            params = jax.tree.map(lambda l: l[0], stacked)
+            out = moe_layer_local(
+                x, router_w, expert_fn, params, ax,
+                capacity_factor=capacity_factor, k=k, dispatch_impl=impl,
+            )
+            return out
+
+        return jax.jit(
+            shard_map(
+                local, mesh=comm.mesh,
+                in_specs=(P(), P(), P(ax)), out_specs=P(),
+                check_vma=False,
+            )
+        )
+
+    @pytest.mark.parametrize("k", [1, 2])
+    @pytest.mark.parametrize("capacity_factor", [0.5, 4.0])
+    def test_matches_einsum(self, comm, k, capacity_factor):
+        n = comm.size
+        tokens = 16 * n
+        x = jax.random.normal(jax.random.PRNGKey(20), (tokens, D))
+        router_w = jax.random.normal(jax.random.PRNGKey(21), (D, n)) / 4.0
+        stacked = make_expert_params(_expert_init, jax.random.PRNGKey(22), n)
+
+        out_e = self._layer(comm, "einsum", k, capacity_factor)(
+            x, router_w, stacked
+        )
+        out_s = self._layer(comm, "sort", k, capacity_factor)(
+            x, router_w, stacked
+        )
+        np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_e),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mixed_precision_dtype_parity(self, comm):
+        """bf16 activations + f32 router (the normal mixed-precision
+        setup): sort dispatch must return the same dtype AND values as the
+        einsum path's promotion semantics."""
+        n = comm.size
+        tokens = 8 * n
+        x = jax.random.normal(jax.random.PRNGKey(30), (tokens, D),
+                              jnp.bfloat16)
+        router_w = jax.random.normal(jax.random.PRNGKey(31), (D, n),
+                                     jnp.float32) / 4.0
+        stacked = make_expert_params(_expert_init, jax.random.PRNGKey(32), n)
+
+        def run(impl):
+            ax = comm.axis_name
+
+            def local(x, router_w, stacked):
+                params = jax.tree.map(lambda l: l[0], stacked)
+                return moe_layer_local(
+                    x, router_w.astype(jnp.float32), expert_fn, params, ax,
+                    capacity_factor=2.0, k=2, dispatch_impl=impl,
+                )
+
+            return jax.jit(
+                shard_map(
+                    local, mesh=comm.mesh,
+                    in_specs=(P(), P(), P(ax)), out_specs=P(),
+                    check_vma=False,
+                )
+            )(x, router_w, stacked)
+
+        out_e, out_s = run("einsum"), run("sort")
+        assert out_s.dtype == out_e.dtype
+        np.testing.assert_allclose(
+            np.asarray(out_s, np.float32), np.asarray(out_e, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+    def test_grads_match_einsum(self, comm):
+        n = comm.size
+        tokens = 8 * n
+        x = jax.random.normal(jax.random.PRNGKey(23), (tokens, D))
+        router_w = jax.random.normal(jax.random.PRNGKey(24), (D, n)) / 4.0
+        stacked = make_expert_params(_expert_init, jax.random.PRNGKey(25), n)
+
+        def loss(impl):
+            layer = self._layer(comm, impl, 2, 1.0)
+
+            def f(x, rw, st):
+                return (layer(x, rw, st) ** 2).mean()
+
+            return jax.grad(f, argnums=(0, 1, 2))(x, router_w, stacked)
+
+        g_e = loss("einsum")
+        g_s = loss("sort")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            ),
+            g_s, g_e,
+        )
+
 
 class TestTopK:
     def test_topk_capacity_and_slots(self):
