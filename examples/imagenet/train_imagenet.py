@@ -110,13 +110,15 @@ def main(argv=None):
 
         hw = args.image_size
         # Each process reads only its own record-range shard (the dataset
-        # scatter of SURVEY.md section 3.3 applied to files) and assembles
-        # the global batch from it — sample-parallel across hosts.
-        n_proc, proc = jax.process_count(), jax.process_index()
+        # scatter of SURVEY.md section 3.3 applied to files — same ±1
+        # balance as scatter_dataset) and assembles the global batch from
+        # it — sample-parallel across hosts.
         import os
 
+        from chainermn_tpu.datasets.scatter_dataset import _shard_bounds
+
+        n_proc, proc = jax.process_count(), jax.process_index()
         n_total = os.path.getsize(args.native_loader) // (hw * hw * 3 + 4)
-        per = n_total // n_proc
         loader = NativeDataLoader(
             args.native_loader,
             [("image", np.uint8, (hw, hw, 3)), ("label", np.int32, ())],
@@ -124,16 +126,17 @@ def main(argv=None):
             threads=4,
             prefetch=4,
             seed=proc,
-            shard=(proc * per, (proc + 1) * per) if n_proc > 1 else None,
+            shard=_shard_bounds(n_total, n_proc, proc) if n_proc > 1 else None,
         )
+
+    # u8 records cross host→device as u8 (4x fewer bytes) and normalise
+    # on-device; the jitted cast fuses ahead of the first conv.
+    _norm = jax.jit(lambda img: img.astype(jnp.float32) / 127.5 - 1.0)
 
     def next_batch():
         if loader is not None:
             b = next(loader)
-            return (
-                b["image"].astype(np.float32) / 127.5 - 1.0,
-                b["label"],
-            )
+            return _norm(jnp.asarray(b["image"])), jnp.asarray(b["label"])
         return synthetic_batch(rng, global_batch, args.image_size)
 
     x0, y0 = next_batch()
