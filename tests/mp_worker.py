@@ -444,6 +444,98 @@ def case_trainer_mnist():
     )
 
 
+def case_scaling_imagenet():
+    """Scaling-efficiency rehearsal (VERDICT r2 item 9): the hierarchical
+    ImageNet-style step over a real (inter=processes, intra=local-devices)
+    mesh, reporting per-step wall time, HOST-PLANE overhead per step (the
+    object-collective cost the analytic model in docs/benchmarks.md needs),
+    and the gradient byte volume. Prints one MP_METRIC line per rank."""
+    import time
+
+    import optax
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.communicators.xla_communicator import (
+        HierarchicalCommunicator,
+    )
+    from chainermn_tpu.models import ResNet18
+    from chainermn_tpu.optimizers import create_multi_node_optimizer
+    from chainermn_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    comm = HierarchicalCommunicator()
+    assert comm.inter_size == SIZE
+
+    model = ResNet18(num_classes=10, compute_dtype=jnp.float32)
+    hw, per_dev = 32, 2
+    batch = per_dev * comm.size
+    rng = np.random.default_rng(0)
+    xl = rng.standard_normal((batch, hw, hw, 3)).astype(np.float32)
+    yl = (rng.integers(0, 10, size=batch)).astype(np.int32)
+    x, y = multihost_utils.host_local_array_to_global_array(
+        (jnp.asarray(xl), jnp.asarray(yl)), comm.mesh, P()
+    )
+    variables = model.init(jax.random.PRNGKey(0), xl[:1], train=True)
+
+    def loss_fn(params, batch_, model_state):
+        xb, yb = batch_
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": model_state}, xb,
+            train=True, mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb
+        ).mean()
+        return loss, ({}, mutated["batch_stats"])
+
+    opt = create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm,
+        allreduce_grad_dtype=jnp.bfloat16,
+    )
+    state = create_train_state(
+        variables["params"], opt, comm,
+        model_state=variables["batch_stats"],
+    )
+    step = make_train_step(loss_fn, opt, comm)
+
+    for _ in range(2):  # compile + warm
+        state, metrics = step(state, (x, y))
+    float(jax.device_get(metrics["loss"]))
+
+    steps = 5
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, (x, y))
+    float(jax.device_get(metrics["loss"]))
+    step_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    # Host-plane overhead: one object allreduce per step is the logging /
+    # evaluator pattern (SURVEY.md section 5 metrics aggregation). Warm
+    # once untimed — the first call compiles the process_allgather
+    # programs, which would otherwise dominate the 5-round average.
+    comm.allreduce_obj({"warm": 1})
+    rounds = 5
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        total = comm.allreduce_obj({"loss": float(RANK), "n": 1})
+    hostplane_ms = (time.perf_counter() - t0) / rounds * 1e3
+    assert total["n"] == SIZE
+
+    grad_bytes = sum(
+        l.size for l in jax.tree.leaves(variables["params"])
+    ) * 2  # bf16-compressed allreduce
+    print(
+        f"MP_METRIC step_ms={step_ms:.1f} hostplane_ms={hostplane_ms:.2f} "
+        f"grad_bytes={grad_bytes} inter={SIZE} "
+        f"intra={jax.local_device_count()}",
+        flush=True,
+    )
+    assert np.isfinite(step_ms) and hostplane_ms < 10_000
+
+
 CASES = {
     name[len("case_"):]: fn
     for name, fn in list(globals().items())

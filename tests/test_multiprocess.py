@@ -22,6 +22,25 @@ def test_mp_iterator():
     run_workers("iterator", n_procs=2)
 
 
+def test_mp_scaling_rehearsal():
+    """4 processes x 2 local devices running the hierarchical
+    ImageNet-style step (VERDICT r2 item 9): collects per-step wall time
+    and host-plane (object-collective) overhead — the measured inputs of
+    docs/benchmarks.md's analytic scaling model."""
+    outs = run_workers(
+        "scaling_imagenet", n_procs=4, local_devices=2, timeout=420.0
+    )
+    metrics = [ln for o in outs for ln in (o or "").splitlines()
+               if ln.startswith("MP_METRIC")]
+    assert len(metrics) == 4, metrics
+    # Host-plane overhead must be a small fraction of the step: the object
+    # plane carries only scalars/metadata, never gradients.
+    for ln in metrics:
+        kv = dict(p.split("=") for p in ln.split()[1:])
+        assert float(kv["hostplane_ms"]) < float(kv["step_ms"]), ln
+        assert int(kv["inter"]) == 4 and int(kv["intra"]) == 2
+
+
 def test_mp_checkpoint_agreement(tmp_path):
     run_workers(
         "checkpoint", n_procs=2, extra_env={"MP_CKPT_DIR": str(tmp_path)}
