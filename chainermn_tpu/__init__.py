@@ -33,7 +33,7 @@ survey time, so citations are to the public upstream layout.
 """
 
 from chainermn_tpu.communicators import create_communicator
-from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.communicators.base import ANY_SOURCE, CommunicatorBase
 from chainermn_tpu.optimizers import create_multi_node_optimizer
 from chainermn_tpu.datasets import scatter_dataset, create_empty_dataset
 from chainermn_tpu.iterators import (
@@ -48,6 +48,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "create_communicator",
+    "ANY_SOURCE",
     "CommunicatorBase",
     "create_multi_node_optimizer",
     "scatter_dataset",

@@ -13,7 +13,7 @@ since XLA owns those concerns on TPU. The new primary name is ``'xla'``
 
 from __future__ import annotations
 
-from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.communicators.base import ANY_SOURCE, CommunicatorBase
 from chainermn_tpu.communicators.xla_communicator import (
     HierarchicalCommunicator,
     NaiveCommunicator,
@@ -62,6 +62,7 @@ def create_communicator(
 
 __all__ = [
     "create_communicator",
+    "ANY_SOURCE",
     "CommunicatorBase",
     "XlaCommunicator",
     "NaiveCommunicator",

@@ -145,6 +145,17 @@ class HostComm:
             )
         return self.tcp.recv_obj(source)
 
+    def probe(self, source: int) -> bool:
+        """Non-blocking check for a pending message from ``source``
+        (MPI_Iprobe parity — the reference's eager transport offered
+        probing via mpi4py)."""
+        if self.tcp is None:
+            raise NotImplementedError(
+                "probe needs the native TCP backend: set "
+                "CHAINERMN_TPU_RANK/SIZE/COORD (see chainermn_tpu.native)"
+            )
+        return self.tcp.probe(source)
+
     # -- collectives -------------------------------------------------------
 
     def barrier(self, tag: str = "barrier") -> None:

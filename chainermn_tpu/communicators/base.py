@@ -38,6 +38,11 @@ from chainermn_tpu.parallel.mesh import MeshTopology
 
 PyTree = Any
 
+#: Wildcard source for :meth:`CommunicatorBase.recv` /
+#: :meth:`CommunicatorBase.recv_obj` / :meth:`CommunicatorBase.probe`
+#: (reference parity: ``MPI.ANY_SOURCE``).
+ANY_SOURCE = -1
+
 
 class CommunicatorBase:
     """Base communicator over a device mesh.
@@ -54,7 +59,17 @@ class CommunicatorBase:
         self, mesh: Mesh, *, allreduce_grad_dtype=None, _host: HostComm | None = None
     ) -> None:
         self.mesh = mesh
-        self.topology = MeshTopology(mesh)
+        # The lazy provider keeps topology.intra_rank/intra_size truthful
+        # AND mutually consistent on multi-process-per-host runtimes
+        # (hostname discovery, deferred so construction stays
+        # non-collective). Single-process returns None: the topology then
+        # keeps its devices-per-process intra_size semantics.
+        self.topology = MeshTopology(
+            mesh,
+            host_intra_provider=(
+                lambda: self._intra if self.host.size > 1 else None
+            ),
+        )
         self.host = _host if _host is not None else HostComm()
         #: dtype for compressed gradient allreduce
         #: (reference: ``allreduce_grad_dtype='float16'`` on
@@ -437,23 +452,124 @@ class CommunicatorBase:
             return
         self.host.send_obj((tag, obj), dest_proc)
 
+    @functools.cached_property
+    def _pending_remote(self) -> dict:
+        """Messages pulled off a peer socket while waiting for a different
+        tag, keyed ``(src_proc, tag)`` — the receive-side buffering that
+        turns the per-pair FIFO wire into MPI-style tag matching (a
+        mismatched arrival is stashed, never destroyed)."""
+        import collections
+
+        return collections.defaultdict(collections.deque)
+
     def recv_obj(self, source: int, tag: int = 0) -> Any:
+        if source == ANY_SOURCE:
+            return self.recv_any_obj(tag)[1]
         src_proc = self._root_process(source)
         if src_proc == self.host.rank:
-            if not self._self_p2p[(source, tag)]:
+            box = self._self_p2p.get((source, tag))
+            if not box:
                 raise RuntimeError(
                     f"recv_obj from local slot {source} (tag {tag}) with no "
                     "buffered self-send — same-process p2p requires a prior "
                     "send addressed to THAT slot/tag"
                 )
-            return self._self_p2p[(source, tag)].popleft()
-        got_tag, obj = self.host.recv_obj(src_proc)
-        if got_tag != tag:
-            raise RuntimeError(
-                f"recv_obj tag mismatch: expected {tag}, got {got_tag} "
-                f"(per-pair channels are FIFO; interleave tags in send order)"
+            return box.popleft()
+        pend = self._pending_remote.get((src_proc, tag))
+        if pend:
+            return pend.popleft()
+        while True:
+            got_tag, obj = self.host.recv_obj(src_proc)
+            if got_tag == tag:
+                return obj
+            # Other-tag arrival: buffer for its own receiver (MPI matching
+            # semantics; blocks here until the wanted tag arrives).
+            self._pending_remote[(src_proc, got_tag)].append(obj)
+
+    def _slot_of_process(self, proc: int) -> int:
+        """Lowest-numbered mesh slot owned by host-plane rank ``proc`` —
+        the source identity reported for cross-process ANY_SOURCE receives
+        (a single-controller process has no finer sender identity on the
+        eager plane)."""
+        for slot in range(self.size):
+            if self._root_process(slot) == proc:
+                return slot
+        raise RuntimeError(f"no mesh slot owned by process {proc}")
+
+    def probe(self, source: int, tag: int = 0) -> bool:
+        """Non-blocking pending-message check (reference parity:
+        ``MPI_Iprobe`` via mpi4py on the eager transport).
+
+        Same-process slots and already-buffered cross-process messages
+        match ``(source, tag)`` exactly. A cross-process SOCKET probe is
+        tag-agnostic (the wire is a per-pair FIFO; the tag is read with
+        the message), so ``probe(src, tag) == True`` guarantees a message
+        from ``src`` is pending but not its tag — the matching ``recv``
+        buffers any other-tag arrivals rather than losing them, and
+        blocks until the wanted tag arrives. ``source=ANY_SOURCE`` checks
+        all peers.
+
+        Ordering constraint (differs from full MPI matching): host-plane
+        COLLECTIVES (barrier, bcast_obj, ...) share the per-pair p2p
+        channels, so wildcard probes/receives must not run concurrently
+        with other ranks' collectives — sequence all p2p before entering
+        a collective."""
+        def _pending_remote_tag():
+            return any(t == tag and dq for (_, t), dq
+                       in self._pending_remote.items())
+
+        if source == ANY_SOURCE:
+            if any(t == tag and dq
+                   for (_, t), dq in self._self_p2p.items()):
+                return True
+            if _pending_remote_tag():
+                return True
+            return self.host.size > 1 and any(
+                self.host.probe(p)
+                for p in range(self.host.size) if p != self.host.rank
             )
-        return obj
+        src_proc = self._root_process(source)
+        if src_proc == self.host.rank:
+            return bool(self._self_p2p.get((source, tag)))
+        if self._pending_remote.get((src_proc, tag)):
+            return True
+        return self.host.probe(src_proc)
+
+    def recv_any_obj(self, tag: int = 0, *,
+                     poll_interval: float = 1e-3) -> tuple[int, Any]:
+        """Blocking receive from ANY source (reference parity:
+        ``recv(source=MPI.ANY_SOURCE)``); returns ``(source, obj)``.
+        Same-process mailboxes are served first, then already-buffered
+        cross-process messages, then the peer sockets round-robin
+        (other-tag arrivals are buffered for their own receivers, never
+        dropped). The reported source for a cross-process message is the
+        sending process's lowest-numbered mesh slot."""
+        import time as _time
+
+        while True:
+            for (slot, t), dq in list(self._self_p2p.items()):
+                if t == tag and dq:
+                    return slot, dq.popleft()
+            for (proc, t), dq in list(self._pending_remote.items()):
+                if t == tag and dq:
+                    return self._slot_of_process(proc), dq.popleft()
+            if self.host.size == 1:
+                raise RuntimeError(
+                    "recv_any_obj with no buffered self-send and no other "
+                    "process — nothing can ever arrive"
+                )
+            progressed = False
+            for proc in range(self.host.size):
+                if proc == self.host.rank:
+                    continue
+                if self.host.probe(proc):
+                    got_tag, obj = self.host.recv_obj(proc)
+                    if got_tag == tag:
+                        return self._slot_of_process(proc), obj
+                    self._pending_remote[(proc, got_tag)].append(obj)
+                    progressed = True
+            if not progressed:
+                _time.sleep(poll_interval)
 
     def barrier(self) -> None:
         self.host.barrier()

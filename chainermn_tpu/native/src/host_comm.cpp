@@ -22,6 +22,7 @@
 #include <cstring>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <string>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -228,6 +229,21 @@ int hc_recv_body(void* h, int src, void* buf, int64_t n) {
   if (src < 0 || src >= c->size || src == c->rank) return -1;
   if (n > 0 && !recv_all(c->peer[src], buf, n)) return -1;
   return 0;
+}
+
+// Non-blocking probe: 1 = at least one byte of a message (its length
+// header) is readable from src, 0 = nothing pending, -1 = invalid peer or
+// poll error. The MPI_Iprobe analog for the per-pair FIFO channels.
+int hc_probe(void* h, int src) {
+  auto* c = static_cast<Comm*>(h);
+  if (src < 0 || src >= c->size || src == c->rank) return -1;
+  struct pollfd pfd;
+  pfd.fd = c->peer[src];
+  pfd.events = POLLIN;
+  pfd.revents = 0;
+  int r = ::poll(&pfd, 1, 0);
+  if (r < 0) return -1;
+  return (r > 0 && (pfd.revents & POLLIN)) ? 1 : 0;
 }
 
 // Dissemination barrier: log2(size) rounds of token exchange.

@@ -45,6 +45,8 @@ def _load():
         lib.hc_recv_body.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
         ]
+        lib.hc_probe.restype = ctypes.c_int
+        lib.hc_probe.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.hc_barrier.restype = ctypes.c_int
         lib.hc_barrier.argtypes = [ctypes.c_void_p]
         lib.hc_finalize.argtypes = [ctypes.c_void_p]
@@ -245,6 +247,15 @@ class TcpHostComm(_LinearObjCollectives):
             raise RuntimeError(f"recv_obj from {source} failed")
         return pickle.loads(buf.raw[:n])
 
+    def probe(self, source: int) -> bool:
+        """Non-blocking: True when a message from ``source`` is pending
+        (the MPI_Iprobe analog; per-pair channels are FIFO, so the pending
+        message is the next one ``recv_obj(source)`` would return)."""
+        rc = _load().hc_probe(self._h, source)
+        if rc < 0:
+            raise RuntimeError(f"probe of {source} failed")
+        return bool(rc)
+
     def barrier(self) -> None:
         if self.size == 1:
             return
@@ -288,3 +299,6 @@ class TcpGroupComm(_LinearObjCollectives):
 
     def recv_obj(self, source: int) -> Any:
         return self.parent.recv_obj(self.members[source])
+
+    def probe(self, source: int) -> bool:
+        return self.parent.probe(self.members[source])

@@ -103,6 +103,25 @@ class MeshTopology:
     """
 
     mesh: Mesh
+    #: Optional provider of the hostname-discovered ``(intra_rank,
+    #: processes_on_this_host)`` pair, or ``None`` from the provider when
+    #: the runtime is single-process (then the device-count semantics
+    #: below apply). Communicators install their lazy host-plane
+    #: discovery here so the intra pair is truthful AND internally
+    #: consistent (``0 <= intra_rank < intra_size``) on
+    #: multi-process-per-host runtimes. CAUTION: with a provider
+    #: installed, the FIRST ``intra_rank``/``intra_size`` access on a
+    #: multi-process runtime is a blocking host-plane collective — read
+    #: it on every process or not at all (same discipline as
+    #: ``CommunicatorBase.intra_rank``, where this is documented).
+    host_intra_provider: "object" = dataclasses.field(
+        default=None, compare=False
+    )
+
+    def _host_intra(self):
+        if self.host_intra_provider is None:
+            return None
+        return self.host_intra_provider()
 
     @property
     def size(self) -> int:
@@ -126,19 +145,29 @@ class MeshTopology:
 
     @property
     def intra_size(self) -> int:
-        """Devices managed by this process (the reference's GPUs per node)."""
+        """Multi-process (provider present and reporting): processes
+        sharing this host — keeps ``0 <= intra_rank < intra_size``
+        coherent. Otherwise: devices managed by this process (the
+        reference's GPUs per node, single-controller reading)."""
+        pair = self._host_intra()
+        if pair is not None:
+            return pair[1]
         return jax.local_device_count()
 
     @property
     def intra_rank(self) -> int:
-        """Index of this process's slot within its node group.
+        """Index of this process among the processes sharing its host.
 
-        The reference's intra_rank distinguishes processes sharing a host;
-        with one process per host (the JAX norm) this is always 0. When
-        multiple processes share a host (multi-process CPU testing), fall
-        back to position among local processes — approximated as 0 because
-        JAX does not expose a host-local process index.
+        When a communicator owns this topology, the value comes from its
+        hostname-discovery collective (``host_intra_provider`` — the
+        reference's ``init_ranks`` hostname exchange; see the provider
+        field's collective-access caveat). Standalone (no provider): 0,
+        the one-process-per-host JAX norm — JAX itself exposes no
+        host-local process index.
         """
+        pair = self._host_intra()
+        if pair is not None:
+            return pair[0]
         return 0
 
     @property

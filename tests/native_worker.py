@@ -59,6 +59,25 @@ def main():
     src, payload = c.recv_obj((rank - 1) % size)
     assert src == (rank - 1) % size and payload == big
 
+    # probe (MPI_Iprobe analog), raced-free by construction: rank 1 sends
+    # NOTHING until rank 0's "go" arrives, so rank 0's empty-probe is
+    # deterministic (the preceding barrier consumed its own tokens).
+    import time
+
+    c.barrier()
+    if rank == 0:
+        assert c.probe(1) is False
+        c.send_obj("go", 1)
+        deadline = time.time() + 30
+        while not c.probe(1):
+            assert time.time() < deadline, "probe never saw the message"
+            time.sleep(0.002)
+        assert c.probe(1) is True  # non-consuming
+        assert c.recv_obj(1) == "probe-reply"
+    elif rank == 1:
+        assert c.recv_obj(0) == "go"
+        c.send_obj("probe-reply", 0)
+
     c.barrier()
     c.finalize()
     print(f"WORKER_OK {rank}")

@@ -170,6 +170,35 @@ def test_split_single_process_returns_self(comm):
     assert comm.split(color=0) is comm
 
 
+def test_probe_and_any_source_self_mailboxes(comm):
+    """MPI_Iprobe / ANY_SOURCE parity on the same-process mailbox plane
+    (the cross-process TCP path is covered by the multiprocess suite)."""
+    import numpy as np
+
+    from chainermn_tpu import ANY_SOURCE
+
+    assert comm.probe(1, tag=4) is False
+    assert comm.probe(ANY_SOURCE, tag=4) is False
+    comm.send_obj({"x": 1}, 1, tag=4)
+    assert comm.probe(1, tag=4) is True
+    assert comm.probe(1, tag=5) is False  # tag-exact on mailboxes
+    assert comm.probe(ANY_SOURCE, tag=4) is True
+    src, obj = comm.recv_any_obj(tag=4)
+    assert src == 1 and obj == {"x": 1}
+    assert comm.probe(1, tag=4) is False
+
+    # ndarray form through the same wildcard
+    comm.send(np.arange(4.0), 2, tag=7)
+    got = comm.recv(ANY_SOURCE, tag=7)
+    np.testing.assert_allclose(np.asarray(got), np.arange(4.0))
+
+    # nothing pending and nothing can arrive -> explicit error, not a hang
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="nothing can ever arrive"):
+        comm.recv_any_obj(tag=99)
+
+
 def test_stacked_shape_mismatch_raises(comm):
     with pytest.raises(ValueError, match="leading dim"):
         comm.allreduce(np.zeros((3, 2), np.float32))
