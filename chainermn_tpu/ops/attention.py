@@ -36,6 +36,7 @@ def dot_product_attention(
     kv_offset=0,
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Plain softmax attention — the correctness reference.
 
@@ -47,6 +48,8 @@ def dot_product_attention(
       segment_ids: optional ``[B, T]`` packed-segment ids (Tq == Tk);
         attention is confined to equal ids. Rows with no visible key
         return zeros.
+      bias: optional additive score bias ``[B|1, H|1, Tq, Tk]``, applied
+        after the qk scale and before masking.
     """
     s = _scale(q, scale)
     if k.shape[2] != q.shape[2]:
@@ -61,6 +64,8 @@ def dot_product_attention(
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * s
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
     mask = None
     if causal:
         q_pos = q_offset + lax.iota(jnp.int32, q.shape[1])

@@ -70,7 +70,7 @@ def _seg_mask(sq_ref, sk_ref):
     return sq[:, None] == sk[None, :]
 
 
-def _fwd_body(q_ref, k_ref, v_ref, seg_refs, o_ref, lse_ref,
+def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
               acc_ref, m_ref, l_ref, *,
               scale: float, causal: bool, block_q: int, block_k: int,
               num_k_blocks: int):
@@ -93,6 +93,8 @@ def _fwd_body(q_ref, k_ref, v_ref, seg_refs, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [block_q, block_k]
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
 
         mask = None
         if causal:
@@ -144,14 +146,45 @@ def _group(Hq: int, Hkv: int) -> int:
     return Hq // Hkv
 
 
-def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, *, causal, scale,
-                    block_q, block_k, interpret):
+def _split_refs(refs, n_fixed, has_segments, has_bias):
+    """Split a kernel's positional refs into (seg_refs, bias_ref, rest)
+    after ``n_fixed`` fixed inputs — shared by all three kernels."""
+    i = n_fixed
+    seg_refs = None
+    if has_segments:
+        seg_refs = (refs[i], refs[i + 1])
+        i += 2
+    bias_ref = None
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    return seg_refs, bias_ref, refs[i:]
+
+
+def _bias_spec(bias, block_q, block_k, swap=False):
+    """BlockSpec for an additive bias ``[B|1, H|1, Tq, Tk]`` — size-1
+    leading dims broadcast via the index map. ``swap=True`` for grids
+    whose 3rd/4th program ids are (ik, iq) instead of (iq, ik)."""
+    bb = 0 if bias.shape[0] == 1 else None
+    bh = 0 if bias.shape[1] == 1 else None
+
+    def idx(b, h, i, j):
+        iq, ik = (j, i) if swap else (i, j)
+        return (bb if bb is not None else b,
+                bh if bh is not None else h, iq, ik)
+
+    return pl.BlockSpec((1, 1, block_q, block_k), idx)
+
+
+def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
+                    scale, block_q, block_k, interpret):
     """BHTD forward → (out [B,H,Tq,D], lse [B,H,Tq]).
 
     ``k``/``v`` may carry FEWER heads than ``q`` (GQA/MQA): kv head
     ``h // g`` serves q head ``h`` via the BlockSpec index map — no
     materialized ``jnp.repeat``. ``seg_q``/``seg_k`` are optional
-    ``[B, T]`` int32 packed-segment ids."""
+    ``[B, T]`` int32 packed-segment ids; ``bias`` an optional additive
+    ``[B|1, H|1, Tq, Tk]`` score bias (ALiBi etc.), tiled per block."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     g = _group(H, k.shape[1])
@@ -169,24 +202,25 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, *, causal, scale,
                      lambda b, h, iq, ik: (b, h // g, ik, 0)),
     ]
     has_segments = seg_q is not None
+    has_bias = bias is not None
+    args = (q, k, v)
     if has_segments:
         in_specs += [
             pl.BlockSpec((1, block_q), lambda b, h, iq, ik: (b, iq)),
             pl.BlockSpec((1, block_k), lambda b, h, iq, ik: (b, ik)),
         ]
+        args += (seg_q, seg_k)
+    if has_bias:
+        in_specs.append(_bias_spec(bias, block_q, block_k))
+        args += (bias,)
 
-        def kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
-                   acc, m, l):
-            _fwd_body(q_ref, k_ref, v_ref, (sq_ref, sk_ref), o_ref, lse_ref,
-                      acc, m, l, **params)
-
-        args = (q, k, v, seg_q, seg_k)
-    else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
-            _fwd_body(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
-                      acc, m, l, **params)
-
-        args = (q, k, v)
+    def kernel(*refs):
+        seg_refs, bias_ref, rest = _split_refs(
+            refs, 3, has_segments, has_bias
+        )
+        o_ref, lse_ref, acc, m, l = rest
+        _fwd_body(refs[0], refs[1], refs[2], seg_refs, bias_ref,
+                  o_ref, lse_ref, acc, m, l, **params)
 
     return pl.pallas_call(
         kernel,
@@ -215,7 +249,7 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, *, causal, scale,
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
-                 dq_ref, dq_acc, *,
+                 bias_ref, dq_ref, dq_acc, *,
                  scale: float, causal: bool, block_q: int, block_k: int,
                  num_k_blocks: int):
     iq = pl.program_id(2)
@@ -238,6 +272,8 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         mask = None
         if causal:
             mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
@@ -269,7 +305,7 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
-                  dk_ref, dv_ref, dk_acc, dv_acc, *,
+                  bias_ref, dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   num_q_blocks: int):
     ik = pl.program_id(2)
@@ -280,7 +316,17 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    @pl.when(_live(ik, iq, block_q, block_k, causal))
+    live = _live(ik, iq, block_q, block_k, causal)
+
+    if dbias_ref is not None and causal:
+        # Each (iq, ik) tile is visited exactly once in this grid; dead
+        # (causal-skipped) tiles must still write zeros — Pallas outputs
+        # are not pre-zeroed.
+        @pl.when(jnp.logical_not(live))
+        def _zero_dbias():
+            dbias_ref[0, 0] = jnp.zeros_like(dbias_ref[0, 0])
+
+    @pl.when(live)
     def _accumulate():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -293,6 +339,8 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         mask = None
         if causal:
             mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
@@ -311,7 +359,12 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale  # [block_q, block_k]
+        ds_unscaled = p * (dp - delta)  # d loss / d s_total
+        if dbias_ref is not None:
+            # dbias tile == ds before the qk-scale factor (the bias adds
+            # AFTER the scale multiplies q·k).
+            dbias_ref[0, 0] = ds_unscaled.astype(dbias_ref.dtype)
+        ds = ds_unscaled * scale  # [block_q, block_k]
         # dk += ds^T @ q
         dk_acc[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -324,12 +377,17 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None, *,
+def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
+                    bias=None, want_dbias=False, *,
                     causal, scale, block_q, block_k, interpret):
-    """BHTD backward → (dq, dk, dv), each f32, given saved LSE and
-    ``delta = rowsum(do * o)``. With GQA (kv heads Hkv < Hq), dk/dv come
-    back at the KV head count: the per-q-head contributions are written
-    per-head and group-summed outside the kernel."""
+    """BHTD backward → ``(dq, dk, dv[, dbias])``, each f32, given saved
+    LSE and ``delta = rowsum(do * o)``. With GQA (kv heads Hkv < Hq),
+    dk/dv come back at the KV head count: the per-q-head contributions
+    are written per-head and group-summed outside the kernel.
+    ``want_dbias`` materializes the full ``[B, H, Tq, Tk]`` f32 bias
+    gradient (then reduced to ``bias``'s broadcast shape) — O(B·H·T²)
+    regardless of the bias's own broadcast shape; see the public
+    docstring's sizing caution."""
     B, H, Tq, D = q.shape
     Hkv, Tk = k.shape[1], k.shape[2]
     g = _group(H, Hkv)
@@ -337,6 +395,8 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None, *,
     block_k = _pick_block(block_k, Tk)
     nq, nk = Tq // block_q, Tk // block_k
     has_segments = seg_q is not None
+    has_bias = bias is not None
+    assert not (want_dbias and not has_bias)
 
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
@@ -351,25 +411,24 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None, *,
         row_spec,
         row_spec,
     ]
+    dq_args = (q, k, v, do, lse, delta)
     if has_segments:
         dq_in_specs += [
             pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i)),
             pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),
         ]
+        dq_args += (seg_q, seg_k)
+    if has_bias:
+        dq_in_specs.append(_bias_spec(bias, block_q, block_k))
+        dq_args += (bias,)
 
-        def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      sq_ref, sk_ref, dq_ref, dq_acc):
-            _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         (sq_ref, sk_ref), dq_ref, dq_acc, **dq_params)
-
-        dq_args = (q, k, v, do, lse, delta, seg_q, seg_k)
-    else:
-        def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dq_acc):
-            _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         None, dq_ref, dq_acc, **dq_params)
-
-        dq_args = (q, k, v, do, lse, delta)
+    def dq_kernel(*refs):
+        seg_refs, bias_ref, rest = _split_refs(
+            refs, 6, has_segments, has_bias
+        )
+        dq_ref, dq_acc = rest
+        _bwd_dq_body(refs[0], refs[1], refs[2], refs[3], refs[4], refs[5],
+                     seg_refs, bias_ref, dq_ref, dq_acc, **dq_params)
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -383,7 +442,7 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None, *,
 
     # dk/dv grid iterates Q heads; with GQA each q head writes its own
     # [B, H, Tk, D] slot (no cross-head accumulation inside the grid) and
-    # the group sum happens below.
+    # the group sum happens below. Grid program ids here are (ik, iq).
     k_spec_in = pl.BlockSpec((1, 1, block_k, D),
                              lambda b, h, i, j: (b, h // g, i, 0))
     k_spec_out = pl.BlockSpec((1, 1, block_k, D),
@@ -398,45 +457,71 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None, *,
         pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
         pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
     ]
+    dkv_args = (q, k, v, do, lse, delta)
     if has_segments:
         dkv_in_specs += [
             pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, j)),
             pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, i)),
         ]
+        dkv_args += (seg_q, seg_k)
+    if has_bias:
+        dkv_in_specs.append(_bias_spec(bias, block_q, block_k, swap=True))
+        dkv_args += (bias,)
 
-        def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       sq_ref, sk_ref, dk_ref, dv_ref, dk_acc, dv_acc):
-            _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          (sq_ref, sk_ref), dk_ref, dv_ref, dk_acc, dv_acc,
-                          **dkv_params)
+    out_specs = [k_spec_out, k_spec_out]
+    out_shape = [
+        jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+        jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+    ]
+    if want_dbias:
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q, block_k),
+                         lambda b, h, i, j: (b, h, j, i))
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, H, Tq, Tk), jnp.float32)
+        )
 
-        dkv_args = (q, k, v, do, lse, delta, seg_q, seg_k)
-    else:
-        def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, dk_acc, dv_acc):
-            _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          None, dk_ref, dv_ref, dk_acc, dv_acc, **dkv_params)
+    def dkv_kernel(*refs):
+        seg_refs, bias_ref, rest = _split_refs(
+            refs, 6, has_segments, has_bias
+        )
+        if want_dbias:
+            dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc = rest
+        else:
+            dk_ref, dv_ref, dk_acc, dv_acc = rest
+            dbias_ref = None
+        _bwd_dkv_body(refs[0], refs[1], refs[2], refs[3], refs[4], refs[5],
+                      seg_refs, bias_ref, dk_ref, dv_ref, dbias_ref,
+                      dk_acc, dv_acc, **dkv_params)
 
-        dkv_args = (q, k, v, do, lse, delta)
-
-    dk, dv = pl.pallas_call(
+    res = pl.pallas_call(
         dkv_kernel,
         grid=(B, H, nk, nq),
         in_specs=dkv_in_specs,
-        out_specs=[k_spec_out, k_spec_out],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
-            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
     )(*dkv_args)
+    if want_dbias:
+        dk, dv, dbias = res
+    else:
+        dk, dv = res
+        dbias = None
     if g > 1:
         dk = dk.reshape(B, Hkv, g, Tk, D).sum(axis=2)
         dv = dv.reshape(B, Hkv, g, Tk, D).sum(axis=2)
+    if want_dbias:
+        # Reduce to the bias's broadcast shape.
+        if bias.shape[1] == 1:
+            dbias = dbias.sum(axis=1, keepdims=True)
+        if bias.shape[0] == 1:
+            dbias = dbias.sum(axis=0, keepdims=True)
+        return dq, dk, dv, dbias
     return dq, dk, dv
 
 
@@ -459,81 +544,66 @@ def _to_bhtd(x):
     return x.transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+# One custom_vjp covers every operand combination: seg/bias are always
+# passed (zero-size dummies when unused, selected by the static has_*
+# flags), which avoids a per-combination class explosion.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _flash_core(q, k, v, seg, bias, has_seg, has_bias, bias_grad, causal,
+                scale, block_q, block_k, interpret):
     out, _ = _flash_fwd_bhtd(
-        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), causal=causal, scale=scale,
+        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v),
+        seg if has_seg else None, seg if has_seg else None,
+        bias if has_bias else None,  # bias is already scores-layout BHQK
+        causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return _to_bhtd(out)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_core_fwd(q, k, v, seg, bias, has_seg, has_bias, bias_grad,
+                    causal, scale, block_q, block_k, interpret):
     out, lse = _flash_fwd_bhtd(
-        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), causal=causal, scale=scale,
+        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v),
+        seg if has_seg else None, seg if has_seg else None,
+        bias if has_bias else None,  # bias is already scores-layout BHQK
+        causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return _to_bhtd(out), (q, k, v, out, lse)  # out saved in BHTD
+    return _to_bhtd(out), (q, k, v, seg, bias, out, lse)  # out in BHTD
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, out_bhtd, lse = res
+def _flash_core_bwd(has_seg, has_bias, bias_grad, causal, scale, block_q,
+                    block_k, interpret, res, g):
+    q, k, v, seg, bias, out_bhtd, lse = res
     do = _to_bhtd(g)
     # delta_i = sum_d dO_i . O_i — the rowwise correction term of the flash
     # backward (re-derives softmax jacobian contributions without P).
     delta = jnp.sum(do.astype(jnp.float32) * out_bhtd.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B, H, Tq, 1] (kernel layout)
-    dq, dk, dv = _flash_bwd_bhtd(
+    res_bwd = _flash_bwd_bhtd(
         _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), do, lse, delta,
+        seg if has_seg else None, seg if has_seg else None,
+        bias if has_bias else None, bias_grad,
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
-    return (
-        _to_bhtd(dq).astype(q.dtype),
-        _to_bhtd(dk).astype(k.dtype),
-        _to_bhtd(dv).astype(v.dtype),
-    )
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_seg(q, k, v, seg, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd_bhtd(
-        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), seg, seg, causal=causal,
-        scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
-    )
-    return _to_bhtd(out)
-
-
-def _flash_seg_fwd(q, k, v, seg, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_fwd_bhtd(
-        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), seg, seg, causal=causal,
-        scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
-    )
-    return _to_bhtd(out), (q, k, v, seg, out, lse)
-
-
-def _flash_seg_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, seg, out_bhtd, lse = res
-    do = _to_bhtd(g)
-    delta = jnp.sum(do.astype(jnp.float32) * out_bhtd.astype(jnp.float32),
-                    axis=-1, keepdims=True)
-    dq, dk, dv = _flash_bwd_bhtd(
-        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), do, lse, delta, seg, seg,
-        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret,
-    )
+    dq, dk, dv = res_bwd[:3]
+    if bias_grad:
+        dbias = res_bwd[3].astype(bias.dtype)  # already BHQK
+    else:
+        # No-grad bias (the common ALiBi/static case): a zero cotangent —
+        # callers training a bias must pass bias_grad=True.
+        dbias = jnp.zeros_like(bias)
     return (
         _to_bhtd(dq).astype(q.dtype),
         _to_bhtd(dk).astype(k.dtype),
         _to_bhtd(dv).astype(v.dtype),
         None,  # integer segment ids carry no gradient
+        dbias,
     )
 
 
-_flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(
@@ -544,6 +614,8 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    bias_grad: bool = False,
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
@@ -558,6 +630,18 @@ def flash_attention(
     int array for packed sequences: attention is confined to positions with
     equal ids (composes with ``causal``).
 
+    ``bias`` is an optional additive score bias ``[B|1, H|1, Tq, Tk]``
+    (BTHD-external layout ``[B|1, Tq, H|1, Tk]`` is NOT used — pass the
+    scores layout directly; size-1 batch/head dims broadcast). Applied
+    after the qk scale, before masking — the ALiBi/relative-position hook.
+    By default the bias gets a ZERO cotangent (static biases); pass
+    ``bias_grad=True`` to materialize the true gradient. CAUTION: the
+    intermediate dbias buffer is the FULL ``[B, H, Tq, Tk]`` f32 tensor
+    (reduced to the bias's broadcast shape only afterwards) — for a
+    broadcast bias that is B·H/broadcast-factor times the bias itself;
+    size it before asking (e.g. B8·H16·T8192² f32 = 32 GiB). Flash memory
+    behaviour is forfeited by request here and nowhere else.
+
     On TPU the kernels compile via Mosaic; elsewhere (CPU tests) they run in
     Pallas interpreter mode unless ``interpret=False``.
     """
@@ -565,11 +649,24 @@ def flash_attention(
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = _use_interpret()
-    if segment_ids is not None:
-        seg = segment_ids.astype(jnp.int32)
-        return _flash_seg(q, k, v, seg, causal, scale, block_q, block_k,
-                          interpret)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    has_seg = segment_ids is not None
+    has_bias = bias is not None
+    if bias_grad and not has_bias:
+        raise ValueError("bias_grad=True without a bias")
+    if has_bias:
+        if bias.ndim != 4 or bias.shape[0] not in (1, q.shape[0]) \
+                or bias.shape[1] not in (1, q.shape[2]) \
+                or bias.shape[2] != q.shape[1] or bias.shape[3] != k.shape[1]:
+            raise ValueError(
+                f"bias must be [B|1, H|1, Tq, Tk] = "
+                f"[{q.shape[0]}|1, {q.shape[2]}|1, {q.shape[1]}, "
+                f"{k.shape[1]}], got {bias.shape}"
+            )
+    seg = (segment_ids.astype(jnp.int32) if has_seg
+           else jnp.zeros((0,), jnp.int32))
+    b = bias if has_bias else jnp.zeros((0,), q.dtype)
+    return _flash_core(q, k, v, seg, b, has_seg, has_bias, bias_grad,
+                       causal, scale, block_q, block_k, interpret)
 
 
 # ---------------------------------------------------------------------------

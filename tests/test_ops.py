@@ -148,6 +148,74 @@ def test_flash_gqa_grads_match_full():
     )
 
 
+def _alibi_bias(n_heads, T):
+    """ALiBi-style additive bias [1, H, T, T]."""
+    slopes = 2.0 ** (-np.arange(1, n_heads + 1))
+    dist = np.arange(T)[None, :] - np.arange(T)[:, None]
+    return jnp.asarray(
+        (slopes[:, None, None] * np.minimum(dist, 0)[None])[None],
+        jnp.float32,
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bias_matches_full(causal):
+    """Additive score bias (ALiBi hook): flash == dense with the same
+    bias, fwd values and q/k/v grads (static bias — zero cotangent)."""
+    q, k, v = _qkv(9)
+    bias = _alibi_bias(H, T)
+    out = flash_attention(q, k, v, causal=causal, bias=bias,
+                          block_q=16, block_k=16, interpret=True)
+    ref = dot_product_attention(q, k, v, causal=causal, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    gf = jax.grad(lambda a, b, c: (flash_attention(
+        a, b, c, causal=causal, bias=bias, block_q=16, block_k=16,
+        interpret=True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: (dot_product_attention(
+        a, b, c, causal=causal, bias=bias) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+        ),
+        gf, gr,
+    )
+
+
+def test_flash_bias_grad_opt_in():
+    """bias_grad=True materializes the true bias gradient; default is a
+    zero cotangent (static-bias contract)."""
+    q, k, v = _qkv(10)
+    bias = _alibi_bias(H, T)
+
+    def loss(b, grad_flag):
+        return (flash_attention(q, k, v, causal=True, bias=b,
+                                bias_grad=grad_flag, block_q=16,
+                                block_k=16, interpret=True) ** 2).sum()
+
+    def loss_ref(b):
+        return (dot_product_attention(q, k, v, causal=True,
+                                      bias=b) ** 2).sum()
+
+    g_true = jax.grad(lambda b: loss(b, True))(bias)
+    g_ref = jax.grad(loss_ref)(bias)
+    np.testing.assert_allclose(np.asarray(g_true), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-4)
+    g_zero = jax.grad(lambda b: loss(b, False))(bias)
+    np.testing.assert_allclose(np.asarray(g_zero), 0.0)
+
+
+def test_flash_bias_shape_validated():
+    q, k, v = _qkv(11)
+    with pytest.raises(ValueError, match="bias must be"):
+        flash_attention(q, k, v, bias=jnp.zeros((2, H, T, T + 1)),
+                        interpret=True)
+    with pytest.raises(ValueError, match="bias_grad"):
+        flash_attention(q, k, v, bias_grad=True, interpret=True)
+
+
 def test_flash_gqa_head_mismatch_rejected():
     q = jnp.zeros((1, 16, 4, 8))
     kv = jnp.zeros((1, 16, 3, 8))
