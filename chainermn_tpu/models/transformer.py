@@ -23,6 +23,23 @@ import jax.numpy as jnp
 from chainermn_tpu.ops.attention import blockwise_attention
 
 
+def apply_rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding on ``[B, T, H, Dh]`` (half-split pairing).
+
+    ``positions``: ``[T]`` GLOBAL positions — sequence-parallel shards pass
+    their own offsets, so rotations agree across shards (rotation commutes
+    with the ring/Ulysses resharding because it is per-position).
+    """
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None]  # [T, half]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1)
+
+
 class TransformerBlock(nn.Module):
     num_heads: int
     d_ff: int
@@ -35,8 +52,9 @@ class TransformerBlock(nn.Module):
     num_kv_heads: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x, segment_ids=None, train: bool = True):
-        # ``train`` is positional so ``nn.remat(..., static_argnums=(3,))``
+    def __call__(self, x, segment_ids=None, rope_positions=None,
+                 train: bool = True):
+        # ``train`` is positional so ``nn.remat(..., static_argnums=(4,))``
         # can mark it static.
         D = x.shape[-1]
         head_dim = D // self.num_heads
@@ -58,8 +76,12 @@ class TransformerBlock(nn.Module):
         def heads(t, n):
             return t.reshape(B, T, n, head_dim)
 
+        qh, kh = heads(q, self.num_heads), heads(k, kv_heads)
+        if rope_positions is not None:
+            qh = apply_rope(qh, rope_positions)
+            kh = apply_rope(kh, rope_positions)
         kw = {} if segment_ids is None else {"segment_ids": segment_ids}
-        o = attn(heads(q, self.num_heads), heads(k, kv_heads),
+        o = attn(qh, kh,
                  heads(v, kv_heads), causal=True, scale=head_dim**-0.5, **kw)
         o = nn.Dense(
             D, use_bias=False,
@@ -105,38 +127,63 @@ class TransformerLM(nn.Module):
     return_hidden: bool = False
     #: kv heads for GQA/MQA (None → num_heads).
     num_kv_heads: Optional[int] = None
+    #: ``'learned'`` (reference-style absolute table) or ``'rope'``
+    #: (rotary — no position parameters; relative by construction, the
+    #: natural choice under sequence parallelism where a learned table
+    #: would need per-shard rolling).
+    pos_encoding: str = "learned"
 
     @nn.compact
-    def __call__(self, tokens, *, segment_ids=None, train: bool = True):
+    def __call__(self, tokens, *, segment_ids=None, positions=None,
+                 train: bool = True):
         """``segment_ids`` (optional ``[B, T]``) confines attention to
         packed documents; requires a segment-capable ``attention_fn``
-        (e.g. :func:`chainermn_tpu.ops.flash_attention.flash_attention`)."""
+        (e.g. :func:`chainermn_tpu.ops.flash_attention.flash_attention`).
+        ``positions`` (optional ``[T]`` int32 GLOBAL positions) overrides
+        ``pos_offset + arange(T)`` — sequence-parallel shards pass
+        ``axis_index * T_local + arange(T_local)``."""
         if segment_ids is not None and self.attention_fn is None:
             raise ValueError(
                 "segment_ids needs a segment-capable attention_fn — pass "
                 "attention_fn=flash_attention (the default blockwise "
                 "reference does not take segment masks)"
             )
+        if self.pos_encoding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_encoding must be 'learned' or 'rope', got "
+                f"{self.pos_encoding!r}"
+            )
         B, T = tokens.shape
         emb = nn.Embed(
             self.vocab_size, self.d_model, param_dtype=jnp.float32,
             dtype=self.compute_dtype, name="tok_emb",
         )
-        pos_emb = self.param(
-            "pos_emb",
-            nn.initializers.normal(0.02),
-            (self.max_len, self.d_model),
-            jnp.float32,
-        )
         x = emb(tokens)
-        pos = jax.lax.dynamic_slice_in_dim(pos_emb, self.pos_offset, T, axis=0)
-        x = x + pos[None].astype(self.compute_dtype)
+        rope_positions = None
+        if self.pos_encoding == "rope":
+            if positions is None:
+                positions = self.pos_offset + jnp.arange(T, dtype=jnp.int32)
+            rope_positions = positions
+        else:
+            pos_emb = self.param(
+                "pos_emb",
+                nn.initializers.normal(0.02),
+                (self.max_len, self.d_model),
+                jnp.float32,
+            )
+            if positions is not None:
+                pos = pos_emb[positions]
+            else:
+                pos = jax.lax.dynamic_slice_in_dim(
+                    pos_emb, self.pos_offset, T, axis=0
+                )
+            x = x + pos[None].astype(self.compute_dtype)
         block_cls = TransformerBlock
         if self.remat:
             block_cls = nn.remat(
                 TransformerBlock,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                static_argnums=(3,),  # (self, x, segment_ids, train)
+                static_argnums=(4,),  # (self, x, seg, rope_pos, train)
             )
         for i in range(self.num_layers):
             x = block_cls(
@@ -146,7 +193,7 @@ class TransformerLM(nn.Module):
                 attention_fn=self.attention_fn,
                 num_kv_heads=self.num_kv_heads,
                 name=f"block_{i}",
-            )(x, segment_ids, train)
+            )(x, segment_ids, rope_positions, train)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
         if self.return_hidden:
             return x

@@ -550,14 +550,13 @@ def _to_bhtd(x):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
 def _flash_core(q, k, v, seg, bias, has_seg, has_bias, bias_grad, causal,
                 scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd_bhtd(
-        _to_bhtd(q), _to_bhtd(k), _to_bhtd(v),
-        seg if has_seg else None, seg if has_seg else None,
-        bias if has_bias else None,  # bias is already scores-layout BHQK
-        causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+    # Primal == fwd minus the residuals: ONE body owns the operand
+    # plumbing so primal and vjp forwards can never diverge.
+    out, _res = _flash_core_fwd(
+        q, k, v, seg, bias, has_seg, has_bias, bias_grad, causal, scale,
+        block_q, block_k, interpret,
     )
-    return _to_bhtd(out)
+    return out
 
 
 def _flash_core_fwd(q, k, v, seg, bias, has_seg, has_bias, bias_grad,

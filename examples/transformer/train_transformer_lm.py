@@ -68,6 +68,10 @@ def main(argv=None):
                         "attention and loss are masked)")
     p.add_argument("--num-kv-heads", type=int, default=None,
                    help="GQA: fewer kv heads than q heads (must divide)")
+    p.add_argument("--pos-encoding", default="learned",
+                   choices=("learned", "rope"),
+                   help="absolute learned table (reference-style) or "
+                        "rotary (no position parameters)")
     p.add_argument("--num-layers", type=int, default=6)
     p.add_argument("--d-model", type=int, default=512)
     args = p.parse_args(argv)
@@ -140,6 +144,7 @@ def run_packed(args, comm, compute_dtype, rng):
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, compute_dtype=compute_dtype,
         attention_fn=attn, num_kv_heads=args.num_kv_heads,
+        pos_encoding=args.pos_encoding,
     )
     global_batch = args.batchsize * comm.size
     tokens0, seg0 = pack_documents(rng, global_batch, args.seq_len)
@@ -187,6 +192,7 @@ def run_data_parallel(args, comm, compute_dtype, rng):
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, compute_dtype=compute_dtype,
         num_kv_heads=args.num_kv_heads,
+        pos_encoding=args.pos_encoding,
     )
     global_batch = args.batchsize * comm.size
     tokens0 = synthetic_tokens(rng, global_batch, args.seq_len)
@@ -245,12 +251,14 @@ def run_sequence_parallel(args, comm, compute_dtype, rng):
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, compute_dtype=compute_dtype,
         attention_fn=ring_attn, num_kv_heads=args.num_kv_heads,
+        pos_encoding=args.pos_encoding,
     )
     ref = TransformerLM(
         vocab_size=VOCAB, num_layers=args.num_layers,
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, compute_dtype=compute_dtype,
         num_kv_heads=args.num_kv_heads,
+        pos_encoding=args.pos_encoding,
     )
     batch = 2
     tokens0 = synthetic_tokens(rng, batch, args.seq_len)
@@ -262,11 +270,11 @@ def run_sequence_parallel(args, comm, compute_dtype, rng):
         idx = jax.lax.axis_index(ax)
 
         def loss_fn(p):
-            pos = p["params"]["pos_emb"]
-            rolled = jnp.roll(pos, -idx * t_local, axis=0)
-            logits = model.apply(
-                {"params": {**p["params"], "pos_emb": rolled}}, tokens
-            )
+            # The shard's GLOBAL positions serve both encodings: a learned
+            # table gathers its rows (no more whole-table rolling + params
+            # surgery), rotary rotates by them directly.
+            pos = idx * t_local + jnp.arange(t_local, dtype=jnp.int32)
+            logits = model.apply(p, tokens, positions=pos)
             return lm_loss(logits, tokens)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)

@@ -71,6 +71,103 @@ class TestTransformerLM:
             params2, opt_state, ln = step(params2, opt_state)
         assert float(ln) < float(l0)
 
+    def test_rope_model_trains_without_pos_table(self):
+        """pos_encoding='rope': no pos_emb parameter, causality holds,
+        loss decreases."""
+        model = tiny_lm(pos_encoding="rope")
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, VOCAB)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+        assert "pos_emb" not in params["params"]
+        # causality
+        t2 = tokens.at[:, 10:].set((tokens[:, 10:] + 1) % VOCAB)
+        l1 = model.apply(params, tokens)
+        l2 = model.apply(params, t2)
+        np.testing.assert_allclose(l1[:, :10], l2[:, :10],
+                                   rtol=1e-5, atol=1e-5)
+        # The defining RoPE property: a UNIFORM shift of all positions
+        # cancels in q·k (relative encoding) — logits are invariant...
+        l3 = model.apply(params, tokens,
+                         positions=jnp.arange(16, dtype=jnp.int32) + 5)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l3),
+                                   rtol=1e-4, atol=1e-4)
+        # ...while a NON-uniform remapping (stretched gaps) changes them.
+        l4 = model.apply(params, tokens,
+                         positions=jnp.arange(16, dtype=jnp.int32) * 3)
+        assert not np.allclose(np.asarray(l1), np.asarray(l4), atol=1e-3)
+        # trains
+        opt = optax.adam(1e-2)
+        st = opt.init(params)
+
+        @jax.jit
+        def step(p, st):
+            l, g = jax.value_and_grad(
+                lambda p: lm_loss(model.apply(p, tokens), tokens))(p)
+            u, st = opt.update(g, st, p)
+            return optax.apply_updates(p, u), st, l
+
+        p2, st, l0 = step(params, st)
+        for _ in range(10):
+            p2, st, ln = step(p2, st)
+        assert float(ln) < float(l0)
+
+    def test_rope_sequence_parallel_matches_single_device(self, comm):
+        """RoPE + ring attention: per-shard GLOBAL positions reproduce
+        the single-device logits — the modern-position-encoding analog of
+        the learned-table rolling trick (no table to roll)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from chainermn_tpu.parallel.ring_attention import (
+            ring_attention_local,
+        )
+
+        n = comm.size
+        T = 4 * n
+
+        def ring_attn(q, k, v, *, causal, scale):
+            return ring_attention_local(q, k, v, "data", causal=causal,
+                                        scale=scale)
+
+        sp_model = tiny_lm(max_len=T, pos_encoding="rope",
+                           attention_fn=ring_attn)
+        ref_model = tiny_lm(max_len=T, pos_encoding="rope")
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, T), 0, VOCAB)
+        params = ref_model.init(jax.random.PRNGKey(3), tokens)
+        ref = ref_model.apply(params, tokens)
+
+        def local(p, tok):
+            t_local = tok.shape[1]
+            idx = jax.lax.axis_index("data")
+            pos = idx * t_local + jnp.arange(t_local, dtype=jnp.int32)
+            return sp_model.apply(p, tok, positions=pos)
+
+        out = jax.jit(
+            shard_map(
+                local, mesh=comm.mesh, in_specs=(P(), P(None, "data")),
+                out_specs=P(None, "data"), check_vma=False,
+            )
+        )(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_learned_positions_gather_matches_default(self):
+        """positions= on the learned-table path gathers table rows: with
+        the identity positions it equals the default slice (the SP
+        example's per-shard form)."""
+        model = tiny_lm()
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, VOCAB)
+        params = model.init(jax.random.PRNGKey(5), tokens)
+        l_default = model.apply(params, tokens)
+        l_pos = model.apply(params, tokens,
+                            positions=jnp.arange(16, dtype=jnp.int32))
+        np.testing.assert_allclose(np.asarray(l_pos), np.asarray(l_default),
+                                   rtol=1e-6, atol=1e-6)
+        # offset positions read different table rows
+        l_off = model.apply(params, tokens,
+                            positions=jnp.arange(16, dtype=jnp.int32) + 8)
+        assert not np.allclose(np.asarray(l_off), np.asarray(l_default),
+                               atol=1e-4)
+
     def test_gqa_model_trains_and_shrinks_kv(self):
         """num_kv_heads shrinks the qkv projection and still trains; MHA
         (num_kv_heads=num_heads) keeps the original 3*D parameter shape."""
