@@ -213,6 +213,57 @@ def _probe_with_retries(deadline: float, errors: list) -> dict | None:
         time.sleep(PROBE_RETRY_SLEEP)
 
 
+_DETAILS_PATH = os.path.join(_HERE, "BENCH_DETAILS.json")
+
+# The driver captures only a bounded tail of stdout and parses the last
+# JSON line from it (observed: BENCH_r01/r02 both carry ``parsed: null``
+# with a 2000-char tail that starts mid-line). Keys on this whitelist are
+# the headline numbers; everything else goes to BENCH_DETAILS.json.
+_COMPACT_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "source", "step_time_ms",
+    "device_kind", "n_devices", "mfu", "transformer_tokens_per_sec",
+    "transformer_mfu", "flash_fwdbwd_speedup", "allreduce_gbps",
+    "resnet50_s2d_images_per_sec", "moe_dispatch_sort_speedup",
+    "native_input_images_per_sec", "double_buffer_speedup",
+)
+
+
+def _emit_final(result: dict) -> None:
+    """Write the full result to BENCH_DETAILS.json and print a COMPACT
+    final JSON line guaranteed to fit (with margin) inside the driver's
+    2000-char stdout tail window."""
+    wrote_details = False
+    try:
+        full = dict(result)
+        full["emitted_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        with open(_DETAILS_PATH, "w") as f:
+            json.dump(full, f, indent=1)
+            f.write("\n")
+        wrote_details = True
+    except OSError:
+        pass
+    compact = {k: result[k] for k in _COMPACT_KEYS if k in result}
+    if "bench_note" in result:
+        compact["bench_note"] = str(result["bench_note"])[:160]
+    if "error" in result:
+        compact["error"] = str(result["error"])[:240]
+    carried = result.get("last_good_tpu")
+    if isinstance(carried, dict):
+        compact["last_good_tpu"] = {
+            k: carried[k]
+            for k in ("value", "mfu", "age_hours", "stale", "measured_at")
+            if k in carried
+        }
+        compact["last_good_tpu"]["stale"] = True
+    if wrote_details:
+        compact["details"] = "BENCH_DETAILS.json"
+    else:
+        compact["details_write_failed"] = True
+    print(json.dumps(compact), flush=True)
+
+
 def main() -> None:
     deadline = time.monotonic() + TOTAL_BUDGET
     errors = []
@@ -224,7 +275,7 @@ def main() -> None:
         if result is not None:
             result["source"] = "live"
             _save_last_tpu(result)
-            print(json.dumps(result))
+            _emit_final(result)
             return
         errors.append(err)
 
@@ -251,7 +302,7 @@ def main() -> None:
                     + " captured on late re-probe after earlier probe failures"
                 ).strip()
                 _save_last_tpu(late)
-                print(json.dumps(late))
+                _emit_final(late)
                 return
             errors.append(f"late re-probe bench: {err2}")
 
@@ -259,7 +310,7 @@ def main() -> None:
         result["source"] = "cpu-fallback"
         result["error"] = "; ".join(e for e in errors if e)
         _attach_last_tpu(result)
-        print(json.dumps(result))
+        _emit_final(result)
         return
 
     out = {
@@ -271,7 +322,7 @@ def main() -> None:
         "error": "; ".join(e for e in errors if e),
     }
     _attach_last_tpu(out)
-    print(json.dumps(out))
+    _emit_final(out)
 
 
 # ---------------------------------------------------------------------------
