@@ -33,6 +33,7 @@ def __getattr__(name):
         "pipeline_local", "make_pipeline", "stack_stage_params",
         "stack_interleaved_stage_params", "pipeline_total_ticks",
         "pipeline_1f1b_local", "make_pipeline_1f1b",
+        "pipeline_hetero_local", "make_pipeline_hetero",
     ):
         from chainermn_tpu.parallel import pipeline as _pp
 
@@ -79,6 +80,8 @@ __all__ = [
     "stack_stage_params",
     "pipeline_1f1b_local",
     "make_pipeline_1f1b",
+    "pipeline_hetero_local",
+    "make_pipeline_hetero",
     "zero_shard_optimizer",
     "zero_state_specs",
     "moe_layer_local",

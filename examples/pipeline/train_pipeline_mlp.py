@@ -52,10 +52,13 @@ def main(argv=None):
     p.add_argument("--remat-stages", action="store_true",
                    help="recompute stage-internal activations in the "
                         "backward (saves memory for deep stages)")
-    p.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
+    p.add_argument("--schedule", choices=("gpipe", "1f1b", "hetero"),
+                   default="gpipe",
                    help="gpipe: differentiable apply + autodiff backward; "
                         "1f1b: interleaved fwd/bwd engine, O(stages) "
-                        "activation memory at any microbatch count")
+                        "activation memory at any microbatch count; "
+                        "hetero: per-stage functions — embed and head "
+                        "run INSIDE the pipeline")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -107,6 +110,49 @@ def main(argv=None):
             h = jnp.tanh(x @ w_in)
             h = pipe(stacked, h)
             logits = h @ w_out
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+            acc = (logits.argmax(-1) == y).mean()
+            return loss, acc
+
+        @jax.jit
+        def step(params, opt_state, batch):
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    elif args.schedule == "hetero":
+        # Embed and head INSIDE the pipeline: stage 0 maps [mb, 784] ->
+        # [mb, W], the last stage banks [mb, 10] logits — no outside
+        # composition rule. Per-stage params are a replicated tuple.
+        from chainermn_tpu.parallel.pipeline import make_pipeline_hetero
+
+        def embed_fn(p, x):
+            return jnp.tanh(x @ p["w_in"])
+
+        def head_fn(p, h):
+            return h @ p["w_out"]
+
+        fns = [embed_fn] + [stage_fn] * (n_stages - 2) + [head_fn]
+        blocks = [
+            jax.tree.map(lambda l: l[i], stacked)
+            for i in range(n_stages - 2)
+        ]
+        params = tuple(
+            [{"w_in": w_in}] + blocks + [{"w_out": w_out}]
+        )
+        opt_state = opt.init(params)
+        pipe = make_pipeline_hetero(
+            fns, mesh, n_microbatches=n_micro,
+            remat_stages=args.remat_stages,
+        )
+
+        def loss_fn(params, batch):
+            x, y = batch
+            logits = pipe(params, x)
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, y
             ).mean()
