@@ -22,8 +22,10 @@ the unconverted model: it is a drop-in, both ways.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Optional
 
+import flax
 import flax.linen as nn
 
 from chainermn_tpu.communicators.base import CommunicatorBase
@@ -31,6 +33,29 @@ from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
 from chainermn_tpu.parallel.collectives import axes_bound
 
 _BN_TYPES = (nn.BatchNorm, MultiNodeBatchNormalization)
+
+# ``_MnbnModel.__getattr__`` leans on flax-internal behaviors (string
+# ``method=`` resolution on the unbound template, ``_try_setup``,
+# ``share_scope`` semantics) that are validated by the test suite against
+# the versions below. On a NEWER flax those could shift silently — the
+# symptom would be un-synchronized BN, not an error — so warn loudly once.
+_FLAX_VALIDATED_MAX = (0, 12)
+
+
+def _warn_if_flax_untested() -> None:
+    try:
+        major, minor = (int(p) for p in flax.__version__.split(".")[:2])
+    except (AttributeError, ValueError):
+        return
+    if (major, minor) > _FLAX_VALIDATED_MAX:
+        warnings.warn(
+            f"create_mnbn_model's method delegation was validated against "
+            f"flax <= {_FLAX_VALIDATED_MAX[0]}.{_FLAX_VALIDATED_MAX[1]}.x "
+            f"but flax {flax.__version__} is installed; run the "
+            "chainermn_tpu mnbn test suite before trusting synchronized-BN "
+            "conversion on this version.",
+            stacklevel=3,
+        )
 
 
 def _bn_sync_interceptor(axis_name):
@@ -154,6 +179,7 @@ def create_mnbn_model(
     """
     if (comm is None) == (axis_name is None):
         raise ValueError("pass exactly one of comm or axis_name")
+    _warn_if_flax_untested()
     if comm is not None:
         axis_name = comm.bn_axis_name
     return _MnbnModel(inner=model, sync_axis=axis_name)
