@@ -142,9 +142,44 @@ def _run_child(mode: str, timeout: float, env=None):
 _LAST_TPU_CACHE = os.path.join(_HERE, ".bench_last_tpu.json")
 
 
+_CACHE_META_KEYS = (
+    "measured_at", "carried_keys", "source", "stale", "age_hours",
+    "bench_note", "error",
+)
+
+
 def _save_last_tpu(result: dict) -> None:
+    """Merge ``result`` over the previous cached on-chip blob.
+
+    A live run that TIMES OUT mid-way salvages only its earlier rows; a
+    plain overwrite would silently drop supplementary rows (transformer
+    MFU, s2d, …) a previous fuller run had measured (observed r3). Rows
+    the new run didn't produce are kept and listed in ``carried_keys``
+    with their own measured_at, so provenance stays honest per row."""
     try:
-        cached = dict(result)
+        try:
+            with open(_LAST_TPU_CACHE) as f:
+                old = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            old = {}
+        kept = {
+            k: v for k, v in old.items()
+            if k not in result and k not in _CACHE_META_KEYS
+        }
+        cached = dict(kept)
+        cached.update(result)
+        cached.pop("carried_keys", None)
+        if kept:
+            # rows inherited from an older run, with that run's timestamp
+            prev = old.get("carried_keys", {})
+            stamps = dict(prev.get("stamps", {}))
+            old_stamp = old.get("measured_at")
+            for k in kept:
+                stamps.setdefault(k, old_stamp)
+            cached["carried_keys"] = {
+                "keys": sorted(kept),
+                "stamps": {k: stamps.get(k) for k in kept},
+            }
         cached["measured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )
@@ -257,6 +292,17 @@ def _emit_final(result: dict) -> None:
             if k in carried
         }
         compact["last_good_tpu"]["stale"] = True
+        # Rows the cache inherited from an OLDER run than measured_at
+        # (merge-on-save): surface count + oldest stamp so the compact
+        # line can't pass off a days-old row under an hours-old stamp.
+        ck = carried.get("carried_keys")
+        if isinstance(ck, dict) and ck.get("keys"):
+            stamps = [s for s in (ck.get("stamps") or {}).values() if s]
+            compact["last_good_tpu"]["rows_from_older_runs"] = len(ck["keys"])
+            if stamps:
+                compact["last_good_tpu"]["oldest_row_measured_at"] = (
+                    min(stamps)
+                )
     if wrote_details:
         compact["details"] = "BENCH_DETAILS.json"
     else:
