@@ -162,9 +162,16 @@ def _save_last_tpu(result: dict) -> None:
                 old = json.load(f)
         except (OSError, json.JSONDecodeError):
             old = {}
+        same_device = (
+            old.get("device_kind") == result.get("device_kind")
+            or "device_kind" not in old
+        )
+        # Device-relative rows (mfu, tokens/s) from a DIFFERENT chip must
+        # not be carried under this chip's identity.
         kept = {
             k: v for k, v in old.items()
-            if k not in result and k not in _CACHE_META_KEYS
+            if same_device
+            and k not in result and k not in _CACHE_META_KEYS
         }
         cached = dict(kept)
         cached.update(result)

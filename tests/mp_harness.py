@@ -85,14 +85,22 @@ def run_workers(
     extra_env: dict | None = None,
     coord_port: int | None = None,
     infra_retries: int = 1,
+    setup_factory=None,
 ):
     """Launch ``n_procs`` worker processes running ``case`` from
     ``tests/mp_worker.py``; raise AssertionError with the combined logs if
     any worker fails. Returns each worker's stdout. Coordination-plane
     infrastructure failures (see ``_INFRA_SIGNATURES``) are retried once —
-    framework/logic failures are not."""
+    framework/logic failures are not.
+
+    ``setup_factory``: zero-arg callable returning ``(coord_port,
+    extra_env)``, invoked PER ATTEMPT — tests that pin ports must use
+    this (not fixed ``coord_port``/``extra_env``) so a retry after a
+    port-collision flake binds fresh ports instead of the same busy one."""
     retries = max(0, infra_retries)
     for attempt in range(1 + retries):
+        if setup_factory is not None:
+            coord_port, extra_env = setup_factory()
         try:
             return _run_workers_once(
                 case, n_procs, local_devices=local_devices, timeout=timeout,

@@ -65,28 +65,28 @@ def test_mp_sharded_checkpoint(tmp_path):
     )
 
 
-def test_mp_split_2x2():
-    """4 processes split 2+2: independent per-group host and device
-    collectives without deadlock — VERDICT round-1 item 5."""
+
+def _fresh_ports():
+    """Per-attempt (coord_port, extra_env) — fresh ports on retry."""
     from mp_harness import free_ports
 
     jax_port, tcp_port = free_ports(2)
+    return jax_port, {"MP_TCP_COORD": f"127.0.0.1:{tcp_port}"}
+
+def test_mp_split_2x2():
+    """4 processes split 2+2: independent per-group host and device
+    collectives without deadlock — VERDICT round-1 item 5."""
     run_workers(
         "split", n_procs=4, local_devices=2, timeout=300,
-        coord_port=jax_port,
-        extra_env={"MP_TCP_COORD": f"127.0.0.1:{tcp_port}"},
+        setup_factory=_fresh_ports,
     )
 
 
 def test_mp_array_p2p():
     """Eager ndarray send/recv (MPI parity) across real processes."""
-    from mp_harness import free_ports
-
-    jax_port, tcp_port = free_ports(2)
     run_workers(
         "array_p2p", n_procs=2, local_devices=2,
-        coord_port=jax_port,
-        extra_env={"MP_TCP_COORD": f"127.0.0.1:{tcp_port}"},
+        setup_factory=_fresh_ports,
     )
 
 
@@ -94,13 +94,9 @@ def test_mp_probe_any_source():
     """MPI_Iprobe / ANY_SOURCE parity over the native TCP backend: 3
     processes, staggered senders, rank 0 drains via probe + recv_any_obj
     (VERDICT r2 missing item 2)."""
-    from mp_harness import free_ports
-
-    jax_port, tcp_port = free_ports(2)
     run_workers(
         "probe_any_source", n_procs=3, local_devices=2,
-        coord_port=jax_port,
-        extra_env={"MP_TCP_COORD": f"127.0.0.1:{tcp_port}"},
+        setup_factory=_fresh_ports,
     )
 
 
