@@ -81,3 +81,17 @@ def test_imagenet_example_native_loader(tmp_path):
         "--batchsize", "1", "--image-size", str(hw),
         "--native-loader", path,
     ])
+
+
+def test_transformer_sweep_tool_smoke():
+    """The MFU sweep tool (perf methodology for the tracked
+    transformer_mfu metric) runs a one-variant grid on the CPU mesh and
+    reports step_ms + tokens/s."""
+    ex = _load_example("transformer", "sweep_mfu.py")
+    results = ex.main([
+        "--communicator", "naive", "--layers", "2", "--d-model", "64",
+        "--heads", "2", "--d-ff", "128", "--seq-len", "128",
+        "--batch", "1", "--steps", "2", "--chunks", "2",
+        "--blocks", "64x128", "--remat", "true",
+    ])
+    assert results and results[0]["tokens_per_sec"] > 0

@@ -764,3 +764,46 @@ class TestHeteroPipeline:
         tok = jnp.zeros((16, self.T), jnp.int32)
         with pytest.raises(ValueError, match="conveyor"):
             fn(params, tok)
+
+
+def test_hetero_pipeline_with_batch_axis():
+    """dp x pp composition for the heterogeneous engine: 2-way data
+    parallel, 4 hetero stages (embed / 2 blocks / head) — values must
+    match the sequential single-device computation."""
+    from jax.sharding import Mesh
+
+    from chainermn_tpu.parallel.pipeline import make_pipeline_hetero
+
+    devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "stage"))
+    T, D, V = 4, 8, 16
+    ks = jax.random.split(jax.random.PRNGKey(50), 4)
+
+    def embed_fn(p, tok):
+        return p["emb"][tok]
+
+    def block_fn(p, h):
+        return h + jnp.tanh(h @ p["w"])
+
+    def head_fn(p, h):
+        return h @ p["out"]
+
+    fns = [embed_fn, block_fn, block_fn, head_fn]
+    params = (
+        {"emb": jax.random.normal(ks[0], (V, D)) * 0.5},
+        {"w": jax.random.normal(ks[1], (D, D)) / jnp.sqrt(D)},
+        {"w": jax.random.normal(ks[2], (D, D)) / jnp.sqrt(D)},
+        {"out": jax.random.normal(ks[3], (D, V)) * 0.1},
+    )
+    tok = jax.random.randint(jax.random.PRNGKey(51), (16, T), 0, V)
+
+    fn = make_pipeline_hetero(fns, mesh, axis_name="stage",
+                              n_microbatches=4, batch_axis="data")
+    out = fn(params, tok)
+
+    h = params[0]["emb"][tok]
+    for p in params[1:3]:
+        h = h + jnp.tanh(h @ p["w"])
+    ref = h @ params[3]["out"]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
