@@ -5,7 +5,13 @@ plus the Transformer LM the benchmark configs add (BASELINE.json)."""
 from chainermn_tpu.models.mlp import MLP
 from chainermn_tpu.models.imagenet import AlexNet, GoogLeNet
 from chainermn_tpu.models.seq2seq import Seq2Seq, seq2seq_loss
-from chainermn_tpu.models.transformer import TransformerLM, lm_loss, lm_loss_fused
+from chainermn_tpu.models.transformer import (
+    TransformerLM,
+    generate,
+    init_cache,
+    lm_loss,
+    lm_loss_fused,
+)
 from chainermn_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -24,6 +30,8 @@ __all__ = [
     "TransformerLM",
     "lm_loss",
     "lm_loss_fused",
+    "generate",
+    "init_cache",
     "ResNet",
     "ResNet18",
     "ResNet34",

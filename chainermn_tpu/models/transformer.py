@@ -50,10 +50,68 @@ class TransformerBlock(nn.Module):
     #: projection shrinks accordingly; the attention kernel shares kv heads
     #: across their q-head group (:mod:`chainermn_tpu.ops.flash_attention`).
     num_kv_heads: Optional[int] = None
+    #: KV-cache capacity for ``decode=True`` (single-token autoregressive
+    #: steps). Training/prefill paths ignore it.
+    decode_max_len: int = 2048
+
+    def _decode_attend(self, qh, kh_new, vh_new, head_dim):
+        """One-token attention against the mutable KV cache.
+
+        The cache is a fixed-shape ``[B, max_len, kvh, dh]`` ring written
+        at ``cache_index`` — fixed shapes keep the decode step a single
+        compiled program (XLA semantics: no dynamic shapes), the TPU
+        answer to the reference era's growing Python-side state. Masked
+        positions beyond the index cost bandwidth, not correctness;
+        decode is memory-bound either way.
+        """
+        B = qh.shape[0]
+        kv_heads = kh_new.shape[2]
+        ck = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros(
+                (B, self.decode_max_len, kv_heads, head_dim),
+                self.compute_dtype,
+            ),
+        )
+        cv = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros(
+                (B, self.decode_max_len, kv_heads, head_dim),
+                self.compute_dtype,
+            ),
+        )
+        idx = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        i = idx.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, kh_new.astype(self.compute_dtype), (0, i, 0, 0)
+        )
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, vh_new.astype(self.compute_dtype), (0, i, 0, 0)
+        )
+        idx.value = i + 1
+
+        group = self.num_heads // kv_heads
+        # q: [B, 1, H, dh] → [B, kvh, group, dh]; cache k/v: [B, L, kvh, dh]
+        q = qh[:, 0].reshape(B, kv_heads, group, head_dim)
+        scores = jnp.einsum(
+            "bngd,blnd->bngl", q.astype(jnp.float32),
+            ck.value.astype(jnp.float32),
+        ) * (head_dim ** -0.5)
+        mask = jnp.arange(self.decode_max_len) <= i  # [L]
+        scores = jnp.where(mask[None, None, None, :], scores, -jnp.inf)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum(
+            "bngl,blnd->bngd", w, cv.value.astype(jnp.float32)
+        )
+        return o.reshape(B, 1, self.num_heads, head_dim).astype(
+            self.compute_dtype
+        )
 
     @nn.compact
     def __call__(self, x, segment_ids=None, rope_positions=None,
-                 train: bool = True):
+                 train: bool = True, decode: bool = False):
         # ``train`` is positional so ``nn.remat(..., static_argnums=(4,))``
         # can mark it static.
         D = x.shape[-1]
@@ -80,9 +138,17 @@ class TransformerBlock(nn.Module):
         if rope_positions is not None:
             qh = apply_rope(qh, rope_positions)
             kh = apply_rope(kh, rope_positions)
-        kw = {} if segment_ids is None else {"segment_ids": segment_ids}
-        o = attn(qh, kh,
-                 heads(v, kv_heads), causal=True, scale=head_dim**-0.5, **kw)
+        if decode:
+            if T != 1:
+                raise ValueError(
+                    f"decode=True expects one token per step, got T={T}"
+                )
+            o = self._decode_attend(qh, kh, heads(v, kv_heads), head_dim)
+        else:
+            kw = {} if segment_ids is None else {"segment_ids": segment_ids}
+            o = attn(qh, kh,
+                     heads(v, kv_heads), causal=True, scale=head_dim**-0.5,
+                     **kw)
         o = nn.Dense(
             D, use_bias=False,
             dtype=self.compute_dtype, param_dtype=jnp.float32, name="proj",
@@ -135,13 +201,15 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None,
-                 train: bool = True):
+                 train: bool = True, decode: bool = False):
         """``segment_ids`` (optional ``[B, T]``) confines attention to
         packed documents; requires a segment-capable ``attention_fn``
         (e.g. :func:`chainermn_tpu.ops.flash_attention.flash_attention`).
         ``positions`` (optional ``[T]`` int32 GLOBAL positions) overrides
         ``pos_offset + arange(T)`` — sequence-parallel shards pass
-        ``axis_index * T_local + arange(T_local)``."""
+        ``axis_index * T_local + arange(T_local)``.
+        ``decode=True`` runs one-token autoregressive steps (``T == 1``)
+        against the mutable ``'cache'`` collection; see :func:`generate`."""
         if segment_ids is not None and self.attention_fn is None:
             raise ValueError(
                 "segment_ids needs a segment-capable attention_fn — pass "
@@ -183,7 +251,7 @@ class TransformerLM(nn.Module):
             block_cls = nn.remat(
                 TransformerBlock,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                static_argnums=(4,),  # (self, x, seg, rope_pos, train)
+                static_argnums=(4, 5),  # (self, x, seg, rope_pos, train, dec)
             )
         for i in range(self.num_layers):
             x = block_cls(
@@ -192,8 +260,9 @@ class TransformerLM(nn.Module):
                 compute_dtype=self.compute_dtype,
                 attention_fn=self.attention_fn,
                 num_kv_heads=self.num_kv_heads,
+                decode_max_len=self.max_len,
                 name=f"block_{i}",
-            )(x, segment_ids, rope_positions, train)
+            )(x, segment_ids, rope_positions, train, decode)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
         if self.return_hidden:
             return x
@@ -267,3 +336,95 @@ def lm_loss_fused(hidden, emb_table, tokens, *, n_chunks=8,
          valid.reshape(n_chunks, chunk)),
     )
     return total / n
+
+
+def init_cache(model: TransformerLM, params, batch_size: int):
+    """Allocate the fixed-shape KV cache for ``generate`` (one
+    ``[B, max_len, kv_heads, head_dim]`` key+value pair per block, plus a
+    scalar write index). Pure shape evaluation — no FLOPs run."""
+    dummy = jnp.zeros((batch_size, 1), jnp.int32)
+    variables = jax.eval_shape(
+        lambda: model.apply(
+            params, dummy,
+            positions=jnp.zeros((1,), jnp.int32),
+            train=False, decode=True, mutable=["cache"],
+        )[1]
+    )
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), variables)
+
+
+def generate(model: TransformerLM, params, prompt, n_steps: int, *,
+             temperature: float = 0.0, rng=None, pad_id: int = 0):
+    """Autoregressive generation with a per-block KV cache.
+
+    TPU-first shape discipline: ONE jitted ``lax.scan`` of single-token
+    decode steps covers both prefill and sampling — step ``t`` feeds the
+    prompt token while ``t < prompt_len`` (teacher forcing) and the
+    previous step's sampled token afterwards, so there is exactly one
+    compiled program regardless of prompt length (no per-length
+    recompiles; a ragged batch of prompts just pads with ``pad_id`` and
+    per-row lengths). The cache is written in the same pass the prompt is
+    consumed, so no separate prefill program is needed.
+
+    Args:
+      model: a ``TransformerLM`` (``return_hidden`` must be False).
+      params: the ``{'params': ...}`` variables from ``init``/training.
+      prompt: ``[B, P]`` int32 prompt tokens, right-padded with ``pad_id``.
+      n_steps: total sequence length to produce INCLUDING the prompt
+        (``<= model.max_len``).
+      temperature: 0 → greedy argmax; otherwise softmax sampling at this
+        temperature (requires ``rng``).
+      rng: PRNG key for sampling (ignored when greedy).
+      pad_id: padding token in ``prompt``; positions where every shorter
+        row has run out of prompt switch to model continuations.
+
+    Returns:
+      ``[B, n_steps]`` int32 tokens (prompt positions pass through).
+    """
+    if model.return_hidden:
+        raise ValueError("generate needs logits; build the model with "
+                         "return_hidden=False")
+    if n_steps > model.max_len:
+        raise ValueError(
+            f"n_steps={n_steps} exceeds the cache capacity "
+            f"max_len={model.max_len}"
+        )
+    B, P = prompt.shape
+    prompt_len = jnp.sum(
+        (prompt != pad_id).astype(jnp.int32), axis=1
+    )  # [B] per-row true lengths
+    cache = init_cache(model, params, B)["cache"]
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    padded_prompt = jnp.pad(prompt, ((0, 0), (0, max(0, n_steps - P))),
+                            constant_values=pad_id)
+
+    def step(carry, t):
+        cache, prev_tok, key = carry
+        # Teacher-force while this row still has prompt left.
+        in_prompt = t < prompt_len  # [B]
+        tok = jnp.where(in_prompt, padded_prompt[:, t], prev_tok)
+        logits, mutated = model.apply(
+            {**params, "cache": cache}, tok[:, None],
+            positions=jnp.full((1,), t, jnp.int32),
+            train=False, decode=True, mutable=["cache"],
+        )
+        logits = logits[:, 0]  # [B, vocab]
+        key, sub = jax.random.split(key)
+        if temperature > 0.0:
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        return (mutated["cache"], nxt.astype(prompt.dtype), key), tok
+
+    _, toks = jax.lax.scan(
+        step, (cache, padded_prompt[:, 0], rng),
+        jnp.arange(n_steps, dtype=jnp.int32),
+    )
+    # ``toks[t]`` is the token CONSUMED at position t, which is already
+    # the desired output there: the prompt token while t < prompt_len,
+    # and otherwise prev_tok — i.e. the model's sample from step t-1,
+    # its continuation for position t.
+    return jnp.moveaxis(toks, 0, 1)  # [B, n_steps]

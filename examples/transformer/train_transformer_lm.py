@@ -74,6 +74,10 @@ def main(argv=None):
                         "rotary (no position parameters)")
     p.add_argument("--num-layers", type=int, default=6)
     p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, greedy-decode N tokens from a "
+                        "synthetic prompt with the KV cache (data-parallel "
+                        "mode only)")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(
@@ -225,6 +229,22 @@ def run_data_parallel(args, comm, compute_dtype, rng):
                 f"loss={float(metrics['loss']):.4f} ({tps:,.0f} tok/s)"
             )
     jax.block_until_ready(state.params)
+    if args.generate and comm.rank == 0:
+        # Inference demo on the just-trained weights: KV-cache greedy
+        # decode (one jitted scan of single-token steps — see
+        # chainermn_tpu.models.transformer.generate).
+        from chainermn_tpu.models import generate
+
+        prompt = jnp.asarray(
+            synthetic_tokens(rng, 2, min(8, args.seq_len))
+        )
+        out = generate(
+            model, {"params": state.params}, prompt,
+            min(args.seq_len, prompt.shape[1] + args.generate),
+            pad_id=-1,  # synthetic tokens include 0; nothing is padding
+        )
+        print(f"generate: prompt {prompt.shape} -> {out.shape}; "
+              f"continuations {np.asarray(out[:, prompt.shape[1]:]).tolist()}")
     if comm.rank == 0:
         print("done (data-parallel)")
 
