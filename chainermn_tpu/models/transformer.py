@@ -53,6 +53,13 @@ class TransformerBlock(nn.Module):
     #: KV-cache capacity for ``decode=True`` (single-token autoregressive
     #: steps). Training/prefill paths ignore it.
     decode_max_len: int = 2048
+    #: causal sliding-window width. The TRAINING path cannot apply it
+    #: itself (attention is pluggable): pass an ``attention_fn`` that
+    #: honours the same window (``flash_attention(..., window=W)``) — a
+    #: window without one is rejected. The DECODE path applies it to the
+    #: KV-cache mask directly, keeping inference consistent with the
+    #: windowed training distribution.
+    window: Optional[int] = None
 
     def _decode_attend(self, qh, kh_new, vh_new, head_dim):
         """One-token attention against the mutable KV cache.
@@ -99,7 +106,11 @@ class TransformerBlock(nn.Module):
             "bngd,blnd->bngl", q.astype(jnp.float32),
             ck.value.astype(jnp.float32),
         ) * (head_dim ** -0.5)
-        mask = jnp.arange(self.decode_max_len) <= i  # [L]
+        pos = jnp.arange(self.decode_max_len)
+        mask = pos <= i  # [L]
+        if self.window is not None:
+            # Same band the windowed training attention saw: j > i - W.
+            mask &= pos > i - self.window
         scores = jnp.where(mask[None, None, None, :], scores, -jnp.inf)
         w = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum(
@@ -145,6 +156,12 @@ class TransformerBlock(nn.Module):
                 )
             o = self._decode_attend(qh, kh, heads(v, kv_heads), head_dim)
         else:
+            if self.window is not None and self.attention_fn is None:
+                raise ValueError(
+                    "window needs a window-honouring attention_fn (e.g. "
+                    "flash_attention(..., window=W)) — the default "
+                    "blockwise reference has no window support"
+                )
             kw = {} if segment_ids is None else {"segment_ids": segment_ids}
             o = attn(qh, kh,
                      heads(v, kv_heads), causal=True, scale=head_dim**-0.5,
@@ -198,6 +215,10 @@ class TransformerLM(nn.Module):
     #: natural choice under sequence parallelism where a learned table
     #: would need per-shard rolling).
     pos_encoding: str = "learned"
+    #: causal sliding-window width (see ``TransformerBlock.window``):
+    #: training requires a window-honouring ``attention_fn``; the decode
+    #: path masks the KV cache to the same band automatically.
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None,
@@ -261,6 +282,7 @@ class TransformerLM(nn.Module):
                 attention_fn=self.attention_fn,
                 num_kv_heads=self.num_kv_heads,
                 decode_max_len=self.max_len,
+                window=self.window,
                 name=f"block_{i}",
             )(x, segment_ids, rope_positions, train, decode)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)

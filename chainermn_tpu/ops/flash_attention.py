@@ -36,16 +36,32 @@ from chainermn_tpu.ops.attention import NEG_INF
 _LANES = 128
 
 
-def _causal_mask(iq, ik, block_q, block_k, shape):
+def _causal_mask(iq, ik, block_q, block_k, shape, window=None):
+    """Causal mask, optionally banded to a sliding window: query ``i``
+    sees keys ``j`` with ``i - window < j <= i`` (``window=None`` → full
+    causal)."""
     q_pos = iq * block_q + lax.broadcasted_iota(jnp.int32, shape, 0)
     k_pos = ik * block_k + lax.broadcasted_iota(jnp.int32, shape, 1)
-    return q_pos >= k_pos
+    mask = q_pos >= k_pos
+    if window is not None:
+        mask &= q_pos - k_pos < window
+    return mask
 
 
-def _live(ik, iq, block_q, block_k, causal):
+def _live(ik, iq, block_q, block_k, causal, window=None):
     """Causal: blocks strictly above the diagonal contribute nothing — skip
-    their matmuls entirely (≈2x for long sequences)."""
-    return (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+    their matmuls entirely (≈2x for long sequences). A sliding window
+    additionally kills blocks entirely BELOW the band (every pair with
+    ``q_pos - k_pos >= window``), predicating their MXU work away. NOTE:
+    the grid still visits (and DMAs) every block — see the public
+    docstring for what is and isn't saved."""
+    if not causal:
+        return True
+    alive = ik * block_k <= iq * block_q + block_q - 1
+    if window is not None:
+        # min q_pos in block = iq·bq; max k_pos = (ik+1)·bk - 1.
+        alive &= iq * block_q - ((ik + 1) * block_k - 1) < window
+    return alive
 
 
 def _pick_block(requested: int, T: int) -> int:
@@ -73,7 +89,7 @@ def _seg_mask(sq_ref, sk_ref):
 def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
               acc_ref, m_ref, l_ref, *,
               scale: float, causal: bool, block_q: int, block_k: int,
-              num_k_blocks: int):
+              num_k_blocks: int, window=None):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -83,7 +99,7 @@ def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    @pl.when(_live(ik, iq, block_q, block_k, causal))
+    @pl.when(_live(ik, iq, block_q, block_k, causal, window))
     def _accumulate():
         q = q_ref[0, 0]  # [block_q, D]
         k = k_ref[0, 0]  # [block_k, D]
@@ -98,7 +114,7 @@ def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
 
         mask = None
         if causal:
-            mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
+            mask = _causal_mask(iq, ik, block_q, block_k, s.shape, window)
         if seg_refs is not None:
             sm = _seg_mask(*seg_refs)
             mask = sm if mask is None else mask & sm
@@ -177,7 +193,7 @@ def _bias_spec(bias, block_q, block_k, swap=False):
 
 
 def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
-                    scale, block_q, block_k, interpret):
+                    scale, block_q, block_k, interpret, window=None):
     """BHTD forward → (out [B,H,Tq,D], lse [B,H,Tq]).
 
     ``k``/``v`` may carry FEWER heads than ``q`` (GQA/MQA): kv head
@@ -193,7 +209,8 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
     nq, nk = Tq // block_q, Tk // block_k
 
     params = dict(scale=scale, causal=causal,
-                  block_q=block_q, block_k=block_k, num_k_blocks=nk)
+                  block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                  window=window)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
         pl.BlockSpec((1, 1, block_k, D),
@@ -251,7 +268,7 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
 def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
                  bias_ref, dq_ref, dq_acc, *,
                  scale: float, causal: bool, block_q: int, block_k: int,
-                 num_k_blocks: int):
+                 num_k_blocks: int, window=None):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -259,7 +276,7 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    @pl.when(_live(ik, iq, block_q, block_k, causal))
+    @pl.when(_live(ik, iq, block_q, block_k, causal, window))
     def _accumulate():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -276,7 +293,7 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
             s = s + bias_ref[0, 0].astype(jnp.float32)
         mask = None
         if causal:
-            mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
+            mask = _causal_mask(iq, ik, block_q, block_k, s.shape, window)
         if seg_refs is not None:
             sm = _seg_mask(*seg_refs)
             mask = sm if mask is None else mask & sm
@@ -307,7 +324,7 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
 def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
                   bias_ref, dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
-                  num_q_blocks: int):
+                  num_q_blocks: int, window=None):
     ik = pl.program_id(2)
     iq = pl.program_id(3)
 
@@ -316,7 +333,7 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    live = _live(ik, iq, block_q, block_k, causal)
+    live = _live(ik, iq, block_q, block_k, causal, window)
 
     if dbias_ref is not None and causal:
         # Each (iq, ik) tile is visited exactly once in this grid; dead
@@ -343,7 +360,7 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
             s = s + bias_ref[0, 0].astype(jnp.float32)
         mask = None
         if causal:
-            mask = _causal_mask(iq, ik, block_q, block_k, s.shape)
+            mask = _causal_mask(iq, ik, block_q, block_k, s.shape, window)
         if seg_refs is not None:
             sm = _seg_mask(*seg_refs)
             mask = sm if mask is None else mask & sm
@@ -379,7 +396,7 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
 
 def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
                     bias=None, want_dbias=False, *,
-                    causal, scale, block_q, block_k, interpret):
+                    causal, scale, block_q, block_k, interpret, window=None):
     """BHTD backward → ``(dq, dk, dv[, dbias])``, each f32, given saved
     LSE and ``delta = rowsum(do * o)``. With GQA (kv heads Hkv < Hq),
     dk/dv come back at the KV head count: the per-q-head contributions
@@ -402,7 +419,8 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
     row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
 
     dq_params = dict(scale=scale, causal=causal,
-                     block_q=block_q, block_k=block_k, num_k_blocks=nk)
+                     block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                     window=window)
     dq_in_specs = [
         q_spec,
         pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // g, j, 0)),
@@ -448,7 +466,8 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
     k_spec_out = pl.BlockSpec((1, 1, block_k, D),
                               lambda b, h, i, j: (b, h, i, 0))
     dkv_params = dict(scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k, num_q_blocks=nq)
+                      block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                      window=window)
     dkv_in_specs = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
         k_spec_in,
@@ -547,32 +566,34 @@ def _to_bhtd(x):
 # One custom_vjp covers every operand combination: seg/bias are always
 # passed (zero-size dummies when unused, selected by the static has_*
 # flags), which avoids a per-combination class explosion.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13))
 def _flash_core(q, k, v, seg, bias, has_seg, has_bias, bias_grad, causal,
-                scale, block_q, block_k, interpret):
+                scale, block_q, block_k, interpret, window):
     # Primal == fwd minus the residuals: ONE body owns the operand
     # plumbing so primal and vjp forwards can never diverge.
     out, _res = _flash_core_fwd(
         q, k, v, seg, bias, has_seg, has_bias, bias_grad, causal, scale,
-        block_q, block_k, interpret,
+        block_q, block_k, interpret, window,
     )
     return out
 
 
 def _flash_core_fwd(q, k, v, seg, bias, has_seg, has_bias, bias_grad,
-                    causal, scale, block_q, block_k, interpret):
+                    causal, scale, block_q, block_k, interpret, window):
     out, lse = _flash_fwd_bhtd(
         _to_bhtd(q), _to_bhtd(k), _to_bhtd(v),
         seg if has_seg else None, seg if has_seg else None,
         bias if has_bias else None,  # bias is already scores-layout BHQK
         causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        window=window,
     )
     return _to_bhtd(out), (q, k, v, seg, bias, out, lse)  # out in BHTD
 
 
 def _flash_core_bwd(has_seg, has_bias, bias_grad, causal, scale, block_q,
-                    block_k, interpret, res, g):
+                    block_k, interpret, window, res, g):
     q, k, v, seg, bias, out_bhtd, lse = res
     do = _to_bhtd(g)
     # delta_i = sum_d dO_i . O_i — the rowwise correction term of the flash
@@ -584,7 +605,7 @@ def _flash_core_bwd(has_seg, has_bias, bias_grad, causal, scale, block_q,
         seg if has_seg else None, seg if has_seg else None,
         bias if has_bias else None, bias_grad,
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, window=window,
     )
     dq, dk, dv = res_bwd[:3]
     if bias_grad:
@@ -615,6 +636,7 @@ def flash_attention(
     segment_ids: Optional[jax.Array] = None,
     bias: Optional[jax.Array] = None,
     bias_grad: bool = False,
+    window: Optional[int] = None,
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
@@ -641,6 +663,16 @@ def flash_attention(
     size it before asking (e.g. B8·H16·T8192² f32 = 32 GiB). Flash memory
     behaviour is forfeited by request here and nowhere else.
 
+    ``window`` is a causal sliding window (Mistral-style local attention):
+    query ``i`` attends to keys ``j`` with ``i - window < j <= i``.
+    Requires ``causal=True``. Composes with segment ids, GQA, and bias.
+    Blocks entirely outside the band skip their MATMULS (the dominant
+    cost at moderate T): MXU work drops from O(T²/2) to O(T·window). The
+    grid itself still visits every (iq, ik) tile, so per-block DMA and
+    grid-step overhead remain O(T²) — at very long T with a small window
+    the op becomes DMA-bound above the ideal O(T·window) wall-clock; a
+    band-narrowed grid is the known fix and is not implemented yet.
+
     On TPU the kernels compile via Mosaic; elsewhere (CPU tests) they run in
     Pallas interpreter mode unless ``interpret=False``.
     """
@@ -652,6 +684,12 @@ def flash_attention(
     has_bias = bias is not None
     if bias_grad and not has_bias:
         raise ValueError("bias_grad=True without a bias")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (the sliding "
+                             "window is defined over past positions)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if has_bias:
         if bias.ndim != 4 or bias.shape[0] not in (1, q.shape[0]) \
                 or bias.shape[1] not in (1, q.shape[2]) \
@@ -665,7 +703,7 @@ def flash_attention(
            else jnp.zeros((0,), jnp.int32))
     b = bias if has_bias else jnp.zeros((0,), q.dtype)
     return _flash_core(q, k, v, seg, b, has_seg, has_bias, bias_grad,
-                       causal, scale, block_q, block_k, interpret)
+                       causal, scale, block_q, block_k, interpret, window)
 
 
 # ---------------------------------------------------------------------------

@@ -78,6 +78,9 @@ def main(argv=None):
                    help="after training, greedy-decode N tokens from a "
                         "synthetic prompt with the KV cache (data-parallel "
                         "mode only)")
+    p.add_argument("--window", type=int, default=0, metavar="W",
+                   help="causal sliding-window attention of width W via the "
+                        "flash kernel (0 = full causal; data-parallel mode)")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(
@@ -191,12 +194,26 @@ def run_packed(args, comm, compute_dtype, rng):
 
 
 def run_data_parallel(args, comm, compute_dtype, rng):
+    attention_fn = None
+    if args.window:
+        # Local attention needs the flash kernel (the blockwise default
+        # has no window support); out-of-band blocks skip their matmuls.
+        # The model also carries `window` so KV-cache decoding
+        # (--generate) masks the same band — train and inference agree.
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        def attention_fn(q, k, v, *, causal, scale):
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   window=args.window)
+
     model = TransformerLM(
         vocab_size=VOCAB, num_layers=args.num_layers,
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, compute_dtype=compute_dtype,
         num_kv_heads=args.num_kv_heads,
         pos_encoding=args.pos_encoding,
+        attention_fn=attention_fn,
+        window=args.window or None,
     )
     global_batch = args.batchsize * comm.size
     tokens0 = synthetic_tokens(rng, global_batch, args.seq_len)

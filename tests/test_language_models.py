@@ -512,3 +512,49 @@ class TestBucketing:
             assert batch["src_mask"].sum() <= batch["src"].size
         assert shapes <= set(DEFAULT_BUCKETS)
         assert n_items >= 100  # remainder batches pad up, never drop
+
+
+class TestWindowedDecode:
+    """Model-level sliding window: training (windowed flash) and KV-cache
+    decode must see the SAME attention band."""
+
+    def _windowed_model(self, window):
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        def attn(q, k, v, *, causal, scale):
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   window=window, block_q=16, block_k=16,
+                                   interpret=True)
+
+        return tiny_lm(attention_fn=attn, window=window)
+
+    def test_windowed_decode_matches_windowed_forward(self):
+        from chainermn_tpu.models.transformer import init_cache
+
+        window = 4
+        model = self._windowed_model(window)
+        B, T = 2, 12
+        toks = jax.random.randint(jax.random.PRNGKey(20), (B, T), 1, VOCAB)
+        params = model.init(jax.random.PRNGKey(21), toks, train=False)
+        full = model.apply(params, toks, train=False)
+
+        cache = init_cache(model, params, B)["cache"]
+        got = []
+        for t in range(T):
+            logits, mut = model.apply(
+                {**params, "cache": cache}, toks[:, t:t + 1],
+                positions=jnp.full((1,), t, jnp.int32),
+                train=False, decode=True, mutable=["cache"],
+            )
+            cache = mut["cache"]
+            got.append(logits[:, 0])
+        np.testing.assert_allclose(
+            np.asarray(jnp.stack(got, axis=1)), np.asarray(full),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_window_without_attention_fn_rejected(self):
+        model = tiny_lm(window=4)
+        toks = jnp.ones((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="window-honouring"):
+            model.init(jax.random.PRNGKey(0), toks, train=False)

@@ -254,3 +254,93 @@ def test_flash_attention_odd_sequence_lengths():
         )
         g = jax.grad(lambda x: jnp.sum(flash_attention(x, x, x)))(q)
         assert np.isfinite(np.asarray(g)).all()
+
+
+# ---------------------------------------------------------------------------
+# Causal sliding window (local attention)
+# ---------------------------------------------------------------------------
+
+
+def _window_bias(window, T):
+    """Dense emulation of the sliding window: 0 inside the band
+    ``0 <= i - j < window``, -inf outside (the causal flag handles j > i)."""
+    i = np.arange(T)[:, None]
+    j = np.arange(T)[None, :]
+    band = (i - j) < window
+    return jnp.asarray(
+        np.where(band, 0.0, -1e30)[None, None].astype(np.float32)
+    )
+
+
+@pytest.mark.parametrize("window", [1, 7, 16, 64])
+def test_flash_window_matches_masked_full(window):
+    q, k, v = _qkv(11)
+    out = flash_attention(
+        q, k, v, causal=True, window=window,
+        block_q=16, block_k=16, interpret=True,
+    )
+    ref = dot_product_attention(
+        q, k, v, causal=True, bias=_window_bias(window, T)
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_window_grads_match_masked_full():
+    q, k, v = _qkv(12)
+    window = 10
+
+    def loss_f(q, k, v):
+        return (flash_attention(
+            q, k, v, causal=True, window=window,
+            block_q=16, block_k=16, interpret=True,
+        ) ** 2).sum()
+
+    def loss_r(q, k, v):
+        return (dot_product_attention(
+            q, k, v, causal=True, bias=_window_bias(window, T)
+        ) ** 2).sum()
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+        ),
+        gf, gr,
+    )
+
+
+def test_flash_window_geq_T_equals_plain_causal():
+    q, k, v = _qkv(13)
+    w = flash_attention(q, k, v, causal=True, window=T,
+                        block_q=16, block_k=16, interpret=True)
+    c = flash_attention(q, k, v, causal=True,
+                        block_q=16, block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(w), np.asarray(c),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_flash_window_composes_with_segments_and_gqa():
+    ks = jax.random.split(jax.random.PRNGKey(14), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, 2, D))
+    v = jax.random.normal(ks[2], (B, T, 2, D))
+    seg = _segments()
+    window = 9
+    out = flash_attention(
+        q, k, v, causal=True, window=window, segment_ids=seg,
+        block_q=16, block_k=16, interpret=True,
+    )
+    ref = dot_product_attention(
+        q, k, v, causal=True, segment_ids=seg,
+        bias=_window_bias(window, T),
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_window_validation():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, window=4, interpret=True)
+    with pytest.raises(ValueError, match=">= 1"):
+        flash_attention(q, k, v, causal=True, window=0, interpret=True)
