@@ -52,9 +52,10 @@ def _live(ik, iq, block_q, block_k, causal, window=None):
     """Causal: blocks strictly above the diagonal contribute nothing — skip
     their matmuls entirely (≈2x for long sequences). A sliding window
     additionally kills blocks entirely BELOW the band (every pair with
-    ``q_pos - k_pos >= window``), predicating their MXU work away. NOTE:
-    the grid still visits (and DMAs) every block — see the public
-    docstring for what is and isn't saved."""
+    ``q_pos - k_pos >= window``). With the band-narrowed grids
+    (``_band_k``/``_band_q``) this predicate only handles the clipped
+    edge slots; the grid itself no longer visits far-out-of-band
+    blocks."""
     if not causal:
         return True
     alive = ik * block_k <= iq * block_q + block_q - 1
@@ -62,6 +63,68 @@ def _live(ik, iq, block_q, block_k, causal, window=None):
         # min q_pos in block = iq·bq; max k_pos = (ik+1)·bk - 1.
         alive &= iq * block_q - ((ik + 1) * block_k - 1) < window
     return alive
+
+
+def _band_k(block_q: int, block_k: int, window: int, nk: int):
+    """Banded-grid geometry for a sliding window, iterating K blocks per
+    fixed Q block: ``span`` k-block slots suffice to cover any query
+    block's band ``[iq·bq - W + 1, iq·bq + bq - 1]``; ``lo(iq)`` is the
+    (possibly negative) first candidate k block. Slots outside ``[0, nk)``
+    are dead — the body predicates them off; index maps clip them to a
+    valid (unused) block.
+
+    ``span`` is EXACT: the k-block count for query block iq depends only
+    on the residue ``r = iq·bq mod bk`` (achievable residues are the
+    multiples of gcd(bq, bk)); taking the max over them avoids the
+    lazy-bound's extra dead slot — at bq=bk=W it is the difference
+    between 2 and 3 DMAs per row."""
+    import math
+
+    g = math.gcd(block_q, block_k)
+    # Python // floors (also for negative numerators), which is what the
+    # band-start index needs.
+    span = max(
+        (r + block_q - 1) // block_k - ((r - window + 1) // block_k) + 1
+        for r in range(0, block_k, g)
+    )
+    span = min(nk, span)
+
+    def lo(iq):
+        # floor((iq*bq - (W-1)) / bk): shift the numerator non-negative so
+        # truncating traced-int division equals floor division.
+        return (iq * block_q - (window - 1) + nk * block_k) // block_k - nk
+
+    return span, lo
+
+
+def _band_q(block_q: int, block_k: int, window: int, nq: int):
+    """Banded-grid geometry iterating Q blocks per fixed K block: the
+    queries that can see k block ik lie in ``[ik·bk, ik·bk + bk + W - 2]``
+    (causal lower edge + window upper edge). ``lo`` here is never
+    negative; only the top end can overshoot ``nq``. ``span`` is exact by
+    the same residue enumeration as :func:`_band_k`."""
+    import math
+
+    g = math.gcd(block_q, block_k)
+    span = max(
+        (r + block_k + window - 2) // block_q + 1
+        for r in range(0, block_q, g)
+    )
+    span = min(nq, span)
+
+    def lo(ik):
+        return (ik * block_k) // block_q
+
+    return span, lo
+
+
+def _clipped_slot(lo, n):
+    """Slot→true-block mapper for index maps: identity when un-banded,
+    else ``clip(lo(i) + j, 0, n - 1)`` (dead slots land on a valid,
+    unused block — the body's liveness predicate skips them)."""
+    if lo is None:
+        return lambda i, j: j
+    return lambda i, j: jnp.clip(lo(i) + j, 0, n - 1)
 
 
 def _pick_block(requested: int, T: int) -> int:
@@ -89,17 +152,24 @@ def _seg_mask(sq_ref, sk_ref):
 def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
               acc_ref, m_ref, l_ref, *,
               scale: float, causal: bool, block_q: int, block_k: int,
-              num_k_blocks: int, window=None):
+              num_k_blocks: int, window=None, band_lo=None, nk_total=None):
     iq = pl.program_id(2)
-    ik = pl.program_id(3)
+    j = pl.program_id(3)
+    # Banded grid: slot j covers TRUE k block band_lo(iq) + j; slots
+    # falling outside [0, nk_total) are dead padding.
+    ik = j if band_lo is None else band_lo(iq) + j
 
-    @pl.when(ik == 0)
+    @pl.when(j == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    @pl.when(_live(ik, iq, block_q, block_k, causal, window))
+    live = _live(ik, iq, block_q, block_k, causal, window)
+    if band_lo is not None:
+        live &= (ik >= 0) & (ik < nk_total)
+
+    @pl.when(live)
     def _accumulate():
         q = q_ref[0, 0]  # [block_q, D]
         k = k_ref[0, 0]  # [block_k, D]
@@ -139,7 +209,7 @@ def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(ik == num_k_blocks - 1)
+    @pl.when(j == num_k_blocks - 1)
     def _finalize():
         m = m_ref[:, 0:1]
         l = l_ref[:, 0:1]
@@ -177,15 +247,22 @@ def _split_refs(refs, n_fixed, has_segments, has_bias):
     return seg_refs, bias_ref, refs[i:]
 
 
-def _bias_spec(bias, block_q, block_k, swap=False):
+def _bias_spec(bias, block_q, block_k, swap=False, k_of=None, q_of=None):
     """BlockSpec for an additive bias ``[B|1, H|1, Tq, Tk]`` — size-1
     leading dims broadcast via the index map. ``swap=True`` for grids
-    whose 3rd/4th program ids are (ik, iq) instead of (iq, ik)."""
+    whose 3rd/4th program ids are (ik, iq) instead of (iq, ik).
+    ``k_of(iq, j)`` / ``q_of(ik, j)`` translate a banded-grid slot to the
+    true (clipped) block index."""
     bb = 0 if bias.shape[0] == 1 else None
     bh = 0 if bias.shape[1] == 1 else None
 
     def idx(b, h, i, j):
-        iq, ik = (j, i) if swap else (i, j)
+        if swap:
+            ik = i
+            iq = q_of(i, j) if q_of is not None else j
+        else:
+            iq = i
+            ik = k_of(i, j) if k_of is not None else j
         return (bb if bb is not None else b,
                 bh if bh is not None else h, iq, ik)
 
@@ -208,15 +285,27 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
     block_k = _pick_block(block_k, Tk)
     nq, nk = Tq // block_q, Tk // block_k
 
+    # Banded grid: with a sliding window, only `span` k-block slots per
+    # query block can intersect the band — iterate those instead of all
+    # nk, making DMA traffic and grid steps O(T·W) too (not just matmuls).
+    band_lo = None
+    grid_k = nk
+    if causal and window is not None:
+        span, lo = _band_k(block_q, block_k, window, nk)
+        if span < nk:
+            band_lo, grid_k = lo, span
+
+    k_block = _clipped_slot(band_lo, nk)
+
     params = dict(scale=scale, causal=causal,
-                  block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                  window=window)
+                  block_q=block_q, block_k=block_k, num_k_blocks=grid_k,
+                  window=window, band_lo=band_lo, nk_total=nk)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
         pl.BlockSpec((1, 1, block_k, D),
-                     lambda b, h, iq, ik: (b, h // g, ik, 0)),
+                     lambda b, h, iq, j: (b, h // g, k_block(iq, j), 0)),
         pl.BlockSpec((1, 1, block_k, D),
-                     lambda b, h, iq, ik: (b, h // g, ik, 0)),
+                     lambda b, h, iq, j: (b, h // g, k_block(iq, j), 0)),
     ]
     has_segments = seg_q is not None
     has_bias = bias is not None
@@ -224,11 +313,14 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
     if has_segments:
         in_specs += [
             pl.BlockSpec((1, block_q), lambda b, h, iq, ik: (b, iq)),
-            pl.BlockSpec((1, block_k), lambda b, h, iq, ik: (b, ik)),
+            pl.BlockSpec((1, block_k),
+                         lambda b, h, iq, j: (b, k_block(iq, j))),
         ]
         args += (seg_q, seg_k)
     if has_bias:
-        in_specs.append(_bias_spec(bias, block_q, block_k))
+        in_specs.append(
+            _bias_spec(bias, block_q, block_k, k_of=k_block)
+        )
         args += (bias,)
 
     def kernel(*refs):
@@ -241,7 +333,7 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
 
     return pl.pallas_call(
         kernel,
-        grid=(B, H, nq, nk),
+        grid=(B, H, nq, grid_k),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -268,15 +360,21 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
 def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
                  bias_ref, dq_ref, dq_acc, *,
                  scale: float, causal: bool, block_q: int, block_k: int,
-                 num_k_blocks: int, window=None):
+                 num_k_blocks: int, window=None, band_lo=None,
+                 nk_total=None):
     iq = pl.program_id(2)
-    ik = pl.program_id(3)
+    j = pl.program_id(3)
+    ik = j if band_lo is None else band_lo(iq) + j
 
-    @pl.when(ik == 0)
+    @pl.when(j == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    @pl.when(_live(ik, iq, block_q, block_k, causal, window))
+    live = _live(ik, iq, block_q, block_k, causal, window)
+    if band_lo is not None:
+        live &= (ik >= 0) & (ik < nk_total)
+
+    @pl.when(live)
     def _accumulate():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -312,7 +410,7 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(ik == num_k_blocks - 1)
+    @pl.when(j == num_k_blocks - 1)
     def _finalize():
         dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
@@ -324,16 +422,20 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
 def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
                   bias_ref, dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
-                  num_q_blocks: int, window=None):
+                  num_q_blocks: int, window=None, band_lo=None,
+                  nq_total=None):
     ik = pl.program_id(2)
-    iq = pl.program_id(3)
+    j = pl.program_id(3)
+    iq = j if band_lo is None else band_lo(ik) + j
 
-    @pl.when(iq == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     live = _live(ik, iq, block_q, block_k, causal, window)
+    if band_lo is not None:
+        live &= iq < nq_total  # lo(ik) >= 0: only the top can overshoot
 
     if dbias_ref is not None and causal:
         # Each (iq, ik) tile is visited exactly once in this grid; dead
@@ -388,7 +490,7 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(iq == num_q_blocks - 1)
+    @pl.when(j == num_q_blocks - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
@@ -418,13 +520,34 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
 
+    # Banded grids (see _flash_fwd_bhtd): dq iterates only the k blocks in
+    # the window band; dk/dv only the q blocks that can see this k block.
+    # want_dbias forces the full grid — its output tiles every (iq, ik).
+    k_band_lo = None
+    grid_k = nk
+    q_band_lo = None
+    grid_q = nq
+    if causal and window is not None:
+        span_k, lo_k = _band_k(block_q, block_k, window, nk)
+        if span_k < nk:
+            k_band_lo, grid_k = lo_k, span_k
+        if not want_dbias:
+            span_q, lo_q = _band_q(block_q, block_k, window, nq)
+            if span_q < nq:
+                q_band_lo, grid_q = lo_q, span_q
+
+    k_block = _clipped_slot(k_band_lo, nk)
+    q_block = _clipped_slot(q_band_lo, nq)
+
     dq_params = dict(scale=scale, causal=causal,
-                     block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                     window=window)
+                     block_q=block_q, block_k=block_k, num_k_blocks=grid_k,
+                     window=window, band_lo=k_band_lo, nk_total=nk)
     dq_in_specs = [
         q_spec,
-        pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // g, j, 0)),
-        pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // g, j, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, i, j: (b, h // g, k_block(i, j), 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, i, j: (b, h // g, k_block(i, j), 0)),
         q_spec,
         row_spec,
         row_spec,
@@ -433,11 +556,11 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
     if has_segments:
         dq_in_specs += [
             pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i)),
-            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),
+            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, k_block(i, j))),
         ]
         dq_args += (seg_q, seg_k)
     if has_bias:
-        dq_in_specs.append(_bias_spec(bias, block_q, block_k))
+        dq_in_specs.append(_bias_spec(bias, block_q, block_k, k_of=k_block))
         dq_args += (bias,)
 
     def dq_kernel(*refs):
@@ -450,7 +573,7 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
 
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(B, H, nq, nk),
+        grid=(B, H, nq, grid_k),
         in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), jnp.float32),
@@ -466,25 +589,31 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
     k_spec_out = pl.BlockSpec((1, 1, block_k, D),
                               lambda b, h, i, j: (b, h, i, 0))
     dkv_params = dict(scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                      window=window)
+                      block_q=block_q, block_k=block_k, num_q_blocks=grid_q,
+                      window=window, band_lo=q_band_lo, nq_total=nq)
     dkv_in_specs = [
-        pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, h, i, j: (b, h, q_block(i, j), 0)),
         k_spec_in,
         k_spec_in,
-        pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
-        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
-        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, h, i, j: (b, h, q_block(i, j), 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b, h, i, j: (b, h, q_block(i, j), 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b, h, i, j: (b, h, q_block(i, j), 0)),
     ]
     dkv_args = (q, k, v, do, lse, delta)
     if has_segments:
         dkv_in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, j)),
+            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, q_block(i, j))),
             pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, i)),
         ]
         dkv_args += (seg_q, seg_k)
     if has_bias:
-        dkv_in_specs.append(_bias_spec(bias, block_q, block_k, swap=True))
+        dkv_in_specs.append(
+            _bias_spec(bias, block_q, block_k, swap=True, q_of=q_block)
+        )
         dkv_args += (bias,)
 
     out_specs = [k_spec_out, k_spec_out]
@@ -516,7 +645,7 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
 
     res = pl.pallas_call(
         dkv_kernel,
-        grid=(B, H, nk, nq),
+        grid=(B, H, nk, grid_q),
         in_specs=dkv_in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
@@ -666,12 +795,12 @@ def flash_attention(
     ``window`` is a causal sliding window (Mistral-style local attention):
     query ``i`` attends to keys ``j`` with ``i - window < j <= i``.
     Requires ``causal=True``. Composes with segment ids, GQA, and bias.
-    Blocks entirely outside the band skip their MATMULS (the dominant
-    cost at moderate T): MXU work drops from O(T²/2) to O(T·window). The
-    grid itself still visits every (iq, ik) tile, so per-block DMA and
-    grid-step overhead remain O(T²) — at very long T with a small window
-    the op becomes DMA-bound above the ideal O(T·window) wall-clock; a
-    band-narrowed grid is the known fix and is not implemented yet.
+    The kernel grids are BAND-NARROWED: per query block only the k blocks
+    that can intersect its window band are visited (and symmetrically for
+    dk/dv), so compute, DMA traffic, and grid steps are all O(T·window)
+    — true local-attention cost, not just predicated-off matmuls. One
+    exception: ``bias_grad=True`` forces the dk/dv kernel back to the
+    full grid (its dbias output must tile every (iq, ik)).
 
     On TPU the kernels compile via Mosaic; elsewhere (CPU tests) they run in
     Pallas interpreter mode unless ``interpret=False``.

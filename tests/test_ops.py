@@ -344,3 +344,85 @@ def test_flash_window_validation():
         flash_attention(q, k, v, window=4, interpret=True)
     with pytest.raises(ValueError, match=">= 1"):
         flash_attention(q, k, v, causal=True, window=0, interpret=True)
+
+
+@pytest.mark.parametrize("bq,bk", [(8, 16), (16, 8), (8, 8)])
+def test_flash_window_banded_grid_mixed_blocks(bq, bk):
+    """The band-narrowed grid must be exact for unequal block sizes and
+    windows that don't align to either block edge. Each case ASSERTS the
+    banding is actually active (span < n blocks) — an earlier version of
+    this test used block pairs whose spans covered the whole axis, so the
+    banded geometry ran nowhere."""
+    from chainermn_tpu.ops.flash_attention import _band_k, _band_q
+
+    q, k, v = _qkv(15)
+    nq, nk = T // bq, T // bk
+    for window in (2, 10):
+        span_k, _ = _band_k(bq, bk, window, nk)
+        span_q, _ = _band_q(bq, bk, window, nq)
+        assert span_k < nk, f"k-banding inactive: {span_k} >= {nk}"
+        assert span_q < nq, f"q-banding inactive: {span_q} >= {nq}"
+        out = flash_attention(
+            q, k, v, causal=True, window=window,
+            block_q=bq, block_k=bk, interpret=True,
+        )
+        ref = dot_product_attention(
+            q, k, v, causal=True, bias=_window_bias(window, T)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), ref, rtol=1e-5, atol=1e-5,
+            err_msg=f"window={window} bq={bq} bk={bk}",
+        )
+
+        def loss_f(q, k, v):
+            return (flash_attention(
+                q, k, v, causal=True, window=window,
+                block_q=bq, block_k=bk, interpret=True,
+            ) ** 2).sum()
+
+        def loss_r(q, k, v):
+            return (dot_product_attention(
+                q, k, v, causal=True, bias=_window_bias(window, T)
+            ) ** 2).sum()
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+                err_msg=f"grad window={window} bq={bq} bk={bk}",
+            ),
+            gf, gr,
+        )
+
+
+def test_flash_window_with_trainable_bias():
+    """bias_grad forces the dkv kernel back to the full grid (its dbias
+    output tiles every (iq, ik)); the dq kernel stays banded — gradients
+    must still be exact."""
+    q, k, v = _qkv(16)
+    window = 12
+    bias = jax.random.normal(jax.random.PRNGKey(17), (1, 1, T, T)) * 0.1
+
+    def loss_f(q, k, v, bias):
+        return (flash_attention(
+            q, k, v, causal=True, window=window, bias=bias, bias_grad=True,
+            block_q=16, block_k=16, interpret=True,
+        ) ** 2).sum()
+
+    def loss_r(q, k, v, bias):
+        return (dot_product_attention(
+            q, k, v, causal=True, bias=bias + _window_bias(window, T)
+        ) ** 2).sum()
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    # dbias entries outside the window band are zero in the kernel but
+    # nonzero-noise in the dense reference only where masked-out -> both
+    # are zero there because masked softmax kills the path; compare all.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+        ),
+        gf, gr,
+    )
