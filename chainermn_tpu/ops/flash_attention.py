@@ -36,11 +36,16 @@ from chainermn_tpu.ops.attention import NEG_INF
 _LANES = 128
 
 
-def _causal_mask(iq, ik, block_q, block_k, shape, window=None):
-    """Causal mask, optionally banded to a sliding window: query ``i``
-    sees keys ``j`` with ``i - window < j <= i`` (``window=None`` → full
-    causal)."""
-    q_pos = iq * block_q + lax.broadcasted_iota(jnp.int32, shape, 0)
+def _causal_mask(iq, ik, block_q, block_k, shape, window=None,
+                 q_offset=0):
+    """Causal mask, optionally banded to a sliding window: query at
+    GLOBAL position ``i + q_offset`` sees keys ``j`` with
+    ``i + q_offset - window < j <= i + q_offset`` (``window=None`` → full
+    causal). ``q_offset`` aligns Q against a K axis that starts earlier —
+    the sequence-parallel neighbour-tail layout."""
+    q_pos = q_offset + iq * block_q + lax.broadcasted_iota(
+        jnp.int32, shape, 0
+    )
     k_pos = ik * block_k + lax.broadcasted_iota(jnp.int32, shape, 1)
     mask = q_pos >= k_pos
     if window is not None:
@@ -48,7 +53,7 @@ def _causal_mask(iq, ik, block_q, block_k, shape, window=None):
     return mask
 
 
-def _live(ik, iq, block_q, block_k, causal, window=None):
+def _live(ik, iq, block_q, block_k, causal, window=None, q_offset=0):
     """Causal: blocks strictly above the diagonal contribute nothing — skip
     their matmuls entirely (≈2x for long sequences). A sliding window
     additionally kills blocks entirely BELOW the band (every pair with
@@ -58,14 +63,16 @@ def _live(ik, iq, block_q, block_k, causal, window=None):
     blocks."""
     if not causal:
         return True
-    alive = ik * block_k <= iq * block_q + block_q - 1
+    q0 = q_offset + iq * block_q  # min global q position in the block
+    alive = ik * block_k <= q0 + block_q - 1
     if window is not None:
-        # min q_pos in block = iq·bq; max k_pos = (ik+1)·bk - 1.
-        alive &= iq * block_q - ((ik + 1) * block_k - 1) < window
+        # max k_pos in block = (ik+1)·bk - 1.
+        alive &= q0 - ((ik + 1) * block_k - 1) < window
     return alive
 
 
-def _band_k(block_q: int, block_k: int, window: int, nk: int):
+def _band_k(block_q: int, block_k: int, window: int, nk: int,
+            q_offset: int = 0):
     """Banded-grid geometry for a sliding window, iterating K blocks per
     fixed Q block: ``span`` k-block slots suffice to cover any query
     block's band ``[iq·bq - W + 1, iq·bq + bq - 1]``; ``lo(iq)`` is the
@@ -81,39 +88,49 @@ def _band_k(block_q: int, block_k: int, window: int, nk: int):
     import math
 
     g = math.gcd(block_q, block_k)
-    # Python // floors (also for negative numerators), which is what the
-    # band-start index needs.
+    # Achievable start residues: (q_offset + iq*bq) mod bk ≡ q_offset
+    # (mod g). Python // floors (also for negative numerators), which is
+    # what the band-start index needs.
     span = max(
         (r + block_q - 1) // block_k - ((r - window + 1) // block_k) + 1
-        for r in range(0, block_k, g)
+        for r in range(q_offset % g, block_k, g)
     )
     span = min(nk, span)
 
+    shift = nk + (abs(q_offset) // block_k + 1)
+
     def lo(iq):
-        # floor((iq*bq - (W-1)) / bk): shift the numerator non-negative so
-        # truncating traced-int division equals floor division.
-        return (iq * block_q - (window - 1) + nk * block_k) // block_k - nk
+        # floor((q_offset + iq*bq - (W-1)) / bk): shift the numerator
+        # non-negative so truncating traced-int division equals floor.
+        return (
+            q_offset + iq * block_q - (window - 1) + shift * block_k
+        ) // block_k - shift
 
     return span, lo
 
 
-def _band_q(block_q: int, block_k: int, window: int, nq: int):
+def _band_q(block_q: int, block_k: int, window: int, nq: int,
+            q_offset: int = 0):
     """Banded-grid geometry iterating Q blocks per fixed K block: the
-    queries that can see k block ik lie in ``[ik·bk, ik·bk + bk + W - 2]``
-    (causal lower edge + window upper edge). ``lo`` here is never
-    negative; only the top end can overshoot ``nq``. ``span`` is exact by
-    the same residue enumeration as :func:`_band_k`."""
+    queries that can see k block ik lie (in LOCAL q coordinates) in
+    ``[ik·bk - q_offset, ik·bk - q_offset + bk + W - 2]`` (causal lower
+    edge + window upper edge). With ``q_offset > 0`` the low end can go
+    negative and the high end overshoot ``nq`` — both are dead slots.
+    ``span`` is exact by the same residue enumeration as
+    :func:`_band_k`."""
     import math
 
     g = math.gcd(block_q, block_k)
     span = max(
         (r + block_k + window - 2) // block_q + 1
-        for r in range(0, block_q, g)
+        for r in range((-q_offset) % g, block_q, g)
     )
     span = min(nq, span)
 
+    shift = nq + (abs(q_offset) // block_q + 1)
+
     def lo(ik):
-        return (ik * block_k) // block_q
+        return (ik * block_k - q_offset + shift * block_q) // block_q - shift
 
     return span, lo
 
@@ -152,7 +169,8 @@ def _seg_mask(sq_ref, sk_ref):
 def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
               acc_ref, m_ref, l_ref, *,
               scale: float, causal: bool, block_q: int, block_k: int,
-              num_k_blocks: int, window=None, band_lo=None, nk_total=None):
+              num_k_blocks: int, window=None, band_lo=None, nk_total=None,
+              q_offset: int = 0):
     iq = pl.program_id(2)
     j = pl.program_id(3)
     # Banded grid: slot j covers TRUE k block band_lo(iq) + j; slots
@@ -165,7 +183,7 @@ def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    live = _live(ik, iq, block_q, block_k, causal, window)
+    live = _live(ik, iq, block_q, block_k, causal, window, q_offset)
     if band_lo is not None:
         live &= (ik >= 0) & (ik < nk_total)
 
@@ -184,7 +202,8 @@ def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
 
         mask = None
         if causal:
-            mask = _causal_mask(iq, ik, block_q, block_k, s.shape, window)
+            mask = _causal_mask(iq, ik, block_q, block_k, s.shape, window,
+                                q_offset)
         if seg_refs is not None:
             sm = _seg_mask(*seg_refs)
             mask = sm if mask is None else mask & sm
@@ -270,7 +289,8 @@ def _bias_spec(bias, block_q, block_k, swap=False, k_of=None, q_of=None):
 
 
 def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
-                    scale, block_q, block_k, interpret, window=None):
+                    scale, block_q, block_k, interpret, window=None,
+                    q_offset=0):
     """BHTD forward → (out [B,H,Tq,D], lse [B,H,Tq]).
 
     ``k``/``v`` may carry FEWER heads than ``q`` (GQA/MQA): kv head
@@ -291,7 +311,7 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
     band_lo = None
     grid_k = nk
     if causal and window is not None:
-        span, lo = _band_k(block_q, block_k, window, nk)
+        span, lo = _band_k(block_q, block_k, window, nk, q_offset)
         if span < nk:
             band_lo, grid_k = lo, span
 
@@ -299,7 +319,8 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
 
     params = dict(scale=scale, causal=causal,
                   block_q=block_q, block_k=block_k, num_k_blocks=grid_k,
-                  window=window, band_lo=band_lo, nk_total=nk)
+                  window=window, band_lo=band_lo, nk_total=nk,
+                  q_offset=q_offset)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
         pl.BlockSpec((1, 1, block_k, D),
@@ -361,7 +382,7 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
                  bias_ref, dq_ref, dq_acc, *,
                  scale: float, causal: bool, block_q: int, block_k: int,
                  num_k_blocks: int, window=None, band_lo=None,
-                 nk_total=None):
+                 nk_total=None, q_offset: int = 0):
     iq = pl.program_id(2)
     j = pl.program_id(3)
     ik = j if band_lo is None else band_lo(iq) + j
@@ -370,7 +391,7 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    live = _live(ik, iq, block_q, block_k, causal, window)
+    live = _live(ik, iq, block_q, block_k, causal, window, q_offset)
     if band_lo is not None:
         live &= (ik >= 0) & (ik < nk_total)
 
@@ -391,7 +412,8 @@ def _bwd_dq_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
             s = s + bias_ref[0, 0].astype(jnp.float32)
         mask = None
         if causal:
-            mask = _causal_mask(iq, ik, block_q, block_k, s.shape, window)
+            mask = _causal_mask(iq, ik, block_q, block_k, s.shape, window,
+                                q_offset)
         if seg_refs is not None:
             sm = _seg_mask(*seg_refs)
             mask = sm if mask is None else mask & sm
@@ -423,7 +445,7 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
                   bias_ref, dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   num_q_blocks: int, window=None, band_lo=None,
-                  nq_total=None):
+                  nq_total=None, q_offset: int = 0):
     ik = pl.program_id(2)
     j = pl.program_id(3)
     iq = j if band_lo is None else band_lo(ik) + j
@@ -433,9 +455,10 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    live = _live(ik, iq, block_q, block_k, causal, window)
+    live = _live(ik, iq, block_q, block_k, causal, window, q_offset)
     if band_lo is not None:
-        live &= iq < nq_total  # lo(ik) >= 0: only the top can overshoot
+        # With q_offset > 0 the low end can undershoot too.
+        live &= (iq >= 0) & (iq < nq_total)
 
     if dbias_ref is not None and causal:
         # Each (iq, ik) tile is visited exactly once in this grid; dead
@@ -462,7 +485,8 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
             s = s + bias_ref[0, 0].astype(jnp.float32)
         mask = None
         if causal:
-            mask = _causal_mask(iq, ik, block_q, block_k, s.shape, window)
+            mask = _causal_mask(iq, ik, block_q, block_k, s.shape, window,
+                                q_offset)
         if seg_refs is not None:
             sm = _seg_mask(*seg_refs)
             mask = sm if mask is None else mask & sm
@@ -498,7 +522,8 @@ def _bwd_dkv_body(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_refs,
 
 def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
                     bias=None, want_dbias=False, *,
-                    causal, scale, block_q, block_k, interpret, window=None):
+                    causal, scale, block_q, block_k, interpret, window=None,
+                    q_offset=0):
     """BHTD backward → ``(dq, dk, dv[, dbias])``, each f32, given saved
     LSE and ``delta = rowsum(do * o)``. With GQA (kv heads Hkv < Hq),
     dk/dv come back at the KV head count: the per-q-head contributions
@@ -528,11 +553,11 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
     q_band_lo = None
     grid_q = nq
     if causal and window is not None:
-        span_k, lo_k = _band_k(block_q, block_k, window, nk)
+        span_k, lo_k = _band_k(block_q, block_k, window, nk, q_offset)
         if span_k < nk:
             k_band_lo, grid_k = lo_k, span_k
         if not want_dbias:
-            span_q, lo_q = _band_q(block_q, block_k, window, nq)
+            span_q, lo_q = _band_q(block_q, block_k, window, nq, q_offset)
             if span_q < nq:
                 q_band_lo, grid_q = lo_q, span_q
 
@@ -541,7 +566,8 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
 
     dq_params = dict(scale=scale, causal=causal,
                      block_q=block_q, block_k=block_k, num_k_blocks=grid_k,
-                     window=window, band_lo=k_band_lo, nk_total=nk)
+                     window=window, band_lo=k_band_lo, nk_total=nk,
+                     q_offset=q_offset)
     dq_in_specs = [
         q_spec,
         pl.BlockSpec((1, 1, block_k, D),
@@ -590,7 +616,8 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
                               lambda b, h, i, j: (b, h, i, 0))
     dkv_params = dict(scale=scale, causal=causal,
                       block_q=block_q, block_k=block_k, num_q_blocks=grid_q,
-                      window=window, band_lo=q_band_lo, nq_total=nq)
+                      window=window, band_lo=q_band_lo, nq_total=nq,
+                      q_offset=q_offset)
     dkv_in_specs = [
         pl.BlockSpec((1, 1, block_q, D),
                      lambda b, h, i, j: (b, h, q_block(i, j), 0)),
@@ -840,7 +867,8 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 def flash_block_fwd(q, k_blk, v_blk, *, causal, scale, block_q, block_k,
-                    interpret, seg_q=None, seg_kv=None):
+                    interpret, seg_q=None, seg_kv=None, window=None,
+                    q_offset=0):
     """One ring step's forward: full flash over the resident Q shard and ONE
     arriving K/V block, returning BTHD output + ``[B, H, Tq]`` LSE. The ring
     merges successive blocks' (out, lse) partials in log space
@@ -849,20 +877,21 @@ def flash_block_fwd(q, k_blk, v_blk, *, causal, scale, block_q, block_k,
     travel with their block around the ring)."""
     out, lse = _flash_fwd_bhtd(
         _to_bhtd(q), _to_bhtd(k_blk), _to_bhtd(v_blk), seg_q, seg_kv,
-        causal=causal,
+        causal=causal, window=window, q_offset=q_offset,
         scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return _to_bhtd(out), lse[..., 0]
 
 
 def flash_block_bwd(q, k_blk, v_blk, do, lse, delta, *, causal, scale,
-                    block_q, block_k, interpret, seg_q=None, seg_kv=None):
+                    block_q, block_k, interpret, seg_q=None, seg_kv=None,
+                    window=None, q_offset=0):
     """One ring step's backward: (dq, dk_blk, dv_blk) contributions for one
     K/V block, f32, BTHD (lse/delta are ``[B, H, Tq]``)."""
     dq, dk, dv = _flash_bwd_bhtd(
         _to_bhtd(q), _to_bhtd(k_blk), _to_bhtd(v_blk), _to_bhtd(do),
         lse[..., None], delta[..., None], seg_q, seg_kv,
-        causal=causal, scale=scale,
+        causal=causal, scale=scale, window=window, q_offset=q_offset,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return _to_bhtd(dq), _to_bhtd(dk), _to_bhtd(dv)

@@ -25,6 +25,10 @@ def __getattr__(name):
         from chainermn_tpu.parallel import ring_attention as _ra
 
         return getattr(_ra, name)
+    if name == "sliding_window_attention_local":
+        from chainermn_tpu.parallel import local_attention as _la
+
+        return getattr(_la, name)
     if name in ("ulysses_attention_local", "make_ulysses_attention"):
         from chainermn_tpu.parallel import ulysses as _ul
 
@@ -71,6 +75,7 @@ __all__ = [
     "collectives",
     "ring_attention_local",
     "make_ring_attention",
+    "sliding_window_attention_local",
     "ulysses_attention_local",
     "make_ulysses_attention",
     "pipeline_local",

@@ -1,0 +1,208 @@
+"""Sequence-parallel sliding-window (local) attention — O(1) communication.
+
+NEW capability relative to the reference (SURVEY.md section 5: no sequence
+parallelism existed in the 2017-era codebase). The distributed complement
+of ``flash_attention(window=W)``: when the attention window fits within
+one sequence shard (``W - 1 <= T_local``), a query can only reach keys in
+its OWN shard and the TAIL of the PREVIOUS shard. So instead of rotating
+K/V around the full ring (n - 1 ``ppermute`` hops, O(n) traffic —
+:mod:`chainermn_tpu.parallel.ring_attention`), each shard exchanges ONE
+neighbour tail of ``W - 1`` positions: communication is O(window), an
+n-fold saving that grows with the mesh.
+
+Mechanism (inside ``shard_map`` over the sequence axis):
+
+1. every shard sends the last ``W - 1`` K/V positions to its successor
+   (single ``ppermute`` shift);
+2. the receiver prepends them and runs the banded flash kernel with
+   ``q_offset = W - 1`` — local query row ``i`` sits at extended-key
+   position ``i + W - 1``, so the standard causal-window band lands
+   exactly on the right keys;
+3. shard 0's received tail is the wrap-around from the LAST shard and
+   must see nothing: a segment-id sentinel masks it (the kernel's packed
+   -segment mask, reused);
+4. backward: the flash backward yields gradients for the extended K/V;
+   the tail slice ``ppermute``s BACK to its owner (the transpose of the
+   forward shift — the same Send/Recv duality the reference hand-built in
+   ``functions/point_to_point_communication.py`` (dagger)) and adds into
+   the owner's last ``W - 1`` positions. The wrap-around edge carries
+   exact zeros (masked in forward ⇒ zero gradient), so no special case.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.ops.flash_attention import (
+    _use_interpret,
+    flash_block_bwd,
+    flash_block_fwd,
+)
+from chainermn_tpu.parallel.collectives import shift
+
+# Wrap-around mask sentinel: INT32_MIN cannot legitimately appear as a
+# user segment id (ids are labels, and -1-style padding conventions stay
+# far from the extreme), so shard 0's received tail can never match a
+# query id.
+_WRAP_SENTINEL = jnp.iinfo(jnp.int32).min
+
+
+def _ext_and_segs(k, v, seg_q, axis_name, tail):
+    """Build the extended K/V (previous shard's tail prepended) and the
+    segment ids that (a) mask shard 0's wrap-around tail and (b) carry
+    any user packed-segment ids across the boundary. ONE bundled
+    ``ppermute`` moves k/v/ids together (a single ICI exchange)."""
+    B, L = k.shape[0], k.shape[1]
+    if seg_q is None:
+        seg_q_ids = jnp.zeros((B, L), jnp.int32)
+    else:
+        seg_q_ids = seg_q.astype(jnp.int32)
+    k_tail, v_tail, tail_ids = shift(
+        (k[:, L - tail:], v[:, L - tail:], seg_q_ids[:, L - tail:]),
+        axis_name, 1,
+    )
+    k_ext = jnp.concatenate([k_tail, k], axis=1)
+    v_ext = jnp.concatenate([v_tail, v], axis=1)
+    first = lax.axis_index(axis_name) == 0
+    tail_ids = jnp.where(
+        first, jnp.full_like(tail_ids, _WRAP_SENTINEL), tail_ids
+    )
+    seg_k_ids = jnp.concatenate([tail_ids, seg_q_ids], axis=1)
+    return k_ext, v_ext, seg_q_ids, seg_k_ids
+
+
+def _local_fwd_impl(q, k, v, seg, axis_name, window, scale, block_q,
+                    block_k, interpret, has_seg):
+    tail = window - 1
+    seg_q = seg if has_seg else None
+    k_ext, v_ext, seg_q_ids, seg_k_ids = _ext_and_segs(
+        k, v, seg_q, axis_name, tail
+    )
+    out, lse = flash_block_fwd(
+        q, k_ext, v_ext, causal=True, scale=scale, window=window,
+        q_offset=tail, seg_q=seg_q_ids, seg_kv=seg_k_ids,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _local_window(q, k, v, seg, axis_name, window, scale, block_q, block_k,
+                  interpret, has_seg):
+    out, _ = _local_fwd_impl(q, k, v, seg, axis_name, window, scale,
+                             block_q, block_k, interpret, has_seg)
+    return out
+
+
+def _local_window_fwd(q, k, v, seg, axis_name, window, scale, block_q,
+                      block_k, interpret, has_seg):
+    out, lse = _local_fwd_impl(q, k, v, seg, axis_name, window, scale,
+                               block_q, block_k, interpret, has_seg)
+    return out, (q, k, v, seg, out, lse)
+
+
+def _local_window_bwd(axis_name, window, scale, block_q, block_k, interpret,
+                      has_seg, res, g):
+    q, k, v, seg, out, lse = res
+    tail = window - 1
+    L = q.shape[1]
+    seg_q = seg if has_seg else None
+    # Rebuild the extended K/V (recompute beats storing an overlapping
+    # copy — same remat philosophy as the flash backward itself).
+    k_ext, v_ext, seg_q_ids, seg_k_ids = _ext_and_segs(
+        k, v, seg_q, axis_name, tail
+    )
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(
+        do * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)  # [B, H, L]
+    dq, dk_ext, dv_ext = flash_block_bwd(
+        q, k_ext, v_ext, g, lse, delta, causal=True, scale=scale,
+        window=window, q_offset=tail, seg_q=seg_q_ids, seg_kv=seg_k_ids,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    # Own-shard part + the tail gradient returned to its owner (adds into
+    # the owner's LAST `tail` positions). Shard 0's tail grads are exact
+    # zeros (its tail was segment-masked), so the wrap-around is inert.
+    dk = dk_ext[:, tail:]
+    dv = dv_ext[:, tail:]
+    dk_back, dv_back = shift(
+        (dk_ext[:, :tail], dv_ext[:, :tail]), axis_name, -1
+    )
+    dk = dk.at[:, L - tail:].add(dk_back)
+    dv = dv.at[:, L - tail:].add(dv_back)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None)
+
+
+_local_window.defvjp(_local_window_fwd, _local_window_bwd)
+
+
+def sliding_window_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    window: int,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Causal sliding-window attention over sequence shards — call INSIDE
+    ``shard_map``. See the module docstring for the design.
+
+    Args:
+      q/k/v: local shards ``[B, T_local, H|Hkv, D]`` of a sequence
+        sharded CONTIGUOUSLY over ``axis_name`` (GQA/MQA supported —
+        fewer kv heads than q heads).
+      window: band width ``W``; global query ``i`` sees keys
+        ``(i - W, i]``. Requires ``W - 1 <= T_local`` (the band spans at
+        most one shard boundary; for wider windows use
+        :func:`~chainermn_tpu.parallel.ring_attention.ring_attention_local`,
+        which covers any reach).
+      segment_ids: optional local ``[B, T_local]`` packed-segment slice;
+        ids travel with the tail so cross-boundary masking stays exact.
+        Any int32 value except ``INT32_MIN`` is a valid id (that value is
+        the internal wrap-around mask sentinel).
+
+    Returns:
+      Local output shard ``[B, T_local, H, D]`` (dtype of ``q``).
+    """
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    L = q.shape[1]
+    if window - 1 > L:
+        raise ValueError(
+            f"window {window} reaches {window - 1} positions back but the "
+            f"local shard holds only {L}; use ring attention for windows "
+            "wider than a shard"
+        )
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    if window == 1:
+        # Degenerate: each query sees only itself — no communication.
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=True, window=1, scale=scale,
+            segment_ids=segment_ids, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+    has_seg = segment_ids is not None
+    seg = (segment_ids.astype(jnp.int32) if has_seg
+           else jnp.zeros((q.shape[0], L), jnp.int32))
+    return _local_window(q, k, v, seg, axis_name, window, float(scale),
+                         block_q, block_k, interpret, has_seg)
+
+
+__all__ = ["sliding_window_attention_local"]

@@ -280,8 +280,28 @@ def run_sequence_parallel(args, comm, compute_dtype, rng):
         raise SystemExit(f"--seq-len must be divisible by mesh size {n}")
     t_local = args.seq_len // n
 
-    def ring_attn(q, k, v, *, causal, scale):
-        return ring_attention_local(q, k, v, ax, causal=causal, scale=scale)
+    if args.window:
+        # Local attention: one neighbour-tail exchange instead of the
+        # full K/V ring — O(window) communication per layer.
+        from chainermn_tpu.parallel.local_attention import (
+            sliding_window_attention_local,
+        )
+
+        if args.window - 1 > t_local:
+            raise SystemExit(
+                f"--window {args.window} reaches past one shard "
+                f"(T_local={t_local}); drop --window or shrink the mesh"
+            )
+
+        def ring_attn(q, k, v, *, causal, scale):
+            return sliding_window_attention_local(
+                q, k, v, ax, window=args.window, scale=scale
+            )
+    else:
+
+        def ring_attn(q, k, v, *, causal, scale):
+            return ring_attention_local(q, k, v, ax, causal=causal,
+                                        scale=scale)
 
     model = TransformerLM(
         vocab_size=VOCAB, num_layers=args.num_layers,

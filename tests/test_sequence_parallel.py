@@ -391,3 +391,143 @@ def test_zigzag_causal_work_is_balanced():
     # — step() at ring_attention.py:151 — gives shard s a cost of s full
     # blocks + 1 diagonal, a 15x last-vs-first spread at n=8; that is the
     # imbalance the zigzag layout removes.)
+
+
+class TestSlidingWindowSP:
+    """O(1)-communication sequence-parallel local attention: one neighbour
+    -tail exchange must reproduce single-device windowed flash attention
+    (values AND gradients) when window - 1 <= T_local."""
+
+    def _dist(self, comm, window, seed=30, kv_heads=None, seg=None):
+        from jax import shard_map
+
+        from chainermn_tpu.parallel.local_attention import (
+            sliding_window_attention_local,
+        )
+
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (B, T, H, D))
+        hkv = kv_heads or H
+        k = jax.random.normal(ks[1], (B, T, hkv, D))
+        v = jax.random.normal(ks[2], (B, T, hkv, D))
+
+        def local(q, k, v, s):
+            return sliding_window_attention_local(
+                q, k, v, comm.axis_name, window=window,
+                segment_ids=None if seg is None else s,
+                block_q=4, block_k=4, interpret=True,
+            )
+
+        ax = comm.axis_name
+        s_arg = (seg if seg is not None
+                 else jnp.zeros((B, T), jnp.int32))
+        out = jax.jit(
+            shard_map(
+                local, mesh=comm.mesh,
+                in_specs=(P(None, ax), P(None, ax), P(None, ax),
+                          P(None, ax)),
+                out_specs=P(None, ax), check_vma=False,
+            )
+        )(q, k, v, s_arg)
+        return q, k, v, out
+
+    def _ref(self, q, k, v, window, seg=None):
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=True, window=window, segment_ids=seg,
+            block_q=8, block_k=8, interpret=True,
+        )
+
+    @pytest.mark.parametrize("window", [2, 3, 5])  # T_local = 4: max W-1=4
+    def test_matches_single_device_windowed(self, comm, window):
+        q, k, v, out = self._dist(comm, window)
+        ref = self._ref(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_window_one_no_comm(self, comm):
+        q, k, v, out = self._dist(comm, 1)
+        ref = self._ref(q, k, v, 1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gqa(self, comm):
+        q, k, v, out = self._dist(comm, 4, kv_heads=2)
+        ref = self._ref(q, k, v, 4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_single_device(self, comm):
+        from jax import shard_map
+
+        from chainermn_tpu.parallel.local_attention import (
+            sliding_window_attention_local,
+        )
+
+        window = 4
+        ks = jax.random.split(jax.random.PRNGKey(31), 3)
+        q = jax.random.normal(ks[0], (B, T, H, D))
+        k = jax.random.normal(ks[1], (B, T, H, D))
+        v = jax.random.normal(ks[2], (B, T, H, D))
+        ax = comm.axis_name
+
+        def loss_dist(q, k, v):
+            def local(q, k, v):
+                o = sliding_window_attention_local(
+                    q, k, v, ax, window=window,
+                    block_q=4, block_k=4, interpret=True,
+                )
+                return jax.lax.psum((o.astype(jnp.float32) ** 2).sum(), ax)
+
+            return shard_map(
+                local, mesh=comm.mesh,
+                in_specs=(P(None, ax),) * 3, out_specs=P(),
+                check_vma=False,
+            )(q, k, v)
+
+        def loss_ref(q, k, v):
+            o = self._ref(q, k, v, window)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        gd = jax.grad(loss_dist, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            ),
+            gd, gr,
+        )
+
+    def test_packed_segments_cross_boundary(self, comm):
+        """A document boundary NOT aligned to the shard cut: the tail's
+        travelling segment ids must keep masking exact."""
+        seg = np.zeros((B, T), np.int32)
+        seg[:, 10:23] = 1  # cuts at 10 and 23 — neither on a 4-boundary
+        seg[:, 23:] = 2
+        seg = jnp.asarray(seg)
+        window = 4
+        q, k, v, out = self._dist(comm, window, seg=seg)
+        ref = self._ref(q, k, v, window, seg=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_window_wider_than_shard_rejected(self, comm):
+        from jax import shard_map
+
+        from chainermn_tpu.parallel.local_attention import (
+            sliding_window_attention_local,
+        )
+
+        q, k, v = _qkv(33)
+        ax = comm.axis_name
+        with pytest.raises(ValueError, match="wider than a shard"):
+            jax.jit(
+                shard_map(
+                    lambda q, k, v: sliding_window_attention_local(
+                        q, k, v, ax, window=T, interpret=True
+                    ),
+                    mesh=comm.mesh, in_specs=(P(None, ax),) * 3,
+                    out_specs=P(None, ax), check_vma=False,
+                )
+            )(q, k, v)
