@@ -52,16 +52,13 @@ from chainermn_tpu.parallel.collectives import shift
 _WRAP_SENTINEL = jnp.iinfo(jnp.int32).min
 
 
-def _ext_and_segs(k, v, seg_q, axis_name, tail):
+def _ext_and_segs(k, v, seg_q_ids, axis_name, tail):
     """Build the extended K/V (previous shard's tail prepended) and the
     segment ids that (a) mask shard 0's wrap-around tail and (b) carry
-    any user packed-segment ids across the boundary. ONE bundled
-    ``ppermute`` moves k/v/ids together (a single ICI exchange)."""
-    B, L = k.shape[0], k.shape[1]
-    if seg_q is None:
-        seg_q_ids = jnp.zeros((B, L), jnp.int32)
-    else:
-        seg_q_ids = seg_q.astype(jnp.int32)
+    any user packed-segment ids across the boundary (all-zero ids when
+    the caller has no packed segments). ONE bundled ``ppermute`` moves
+    k/v/ids together (a single ICI exchange)."""
+    L = k.shape[1]
     k_tail, v_tail, tail_ids = shift(
         (k[:, L - tail:], v[:, L - tail:], seg_q_ids[:, L - tail:]),
         axis_name, 1,
@@ -77,11 +74,10 @@ def _ext_and_segs(k, v, seg_q, axis_name, tail):
 
 
 def _local_fwd_impl(q, k, v, seg, axis_name, window, scale, block_q,
-                    block_k, interpret, has_seg):
+                    block_k, interpret):
     tail = window - 1
-    seg_q = seg if has_seg else None
     k_ext, v_ext, seg_q_ids, seg_k_ids = _ext_and_segs(
-        k, v, seg_q, axis_name, tail
+        k, v, seg, axis_name, tail
     )
     out, lse = flash_block_fwd(
         q, k_ext, v_ext, causal=True, scale=scale, window=window,
@@ -91,31 +87,30 @@ def _local_fwd_impl(q, k, v, seg, axis_name, window, scale, block_q,
     return out.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _local_window(q, k, v, seg, axis_name, window, scale, block_q, block_k,
-                  interpret, has_seg):
+                  interpret):
     out, _ = _local_fwd_impl(q, k, v, seg, axis_name, window, scale,
-                             block_q, block_k, interpret, has_seg)
+                             block_q, block_k, interpret)
     return out
 
 
 def _local_window_fwd(q, k, v, seg, axis_name, window, scale, block_q,
-                      block_k, interpret, has_seg):
+                      block_k, interpret):
     out, lse = _local_fwd_impl(q, k, v, seg, axis_name, window, scale,
-                               block_q, block_k, interpret, has_seg)
+                               block_q, block_k, interpret)
     return out, (q, k, v, seg, out, lse)
 
 
 def _local_window_bwd(axis_name, window, scale, block_q, block_k, interpret,
-                      has_seg, res, g):
+                      res, g):
     q, k, v, seg, out, lse = res
     tail = window - 1
     L = q.shape[1]
-    seg_q = seg if has_seg else None
     # Rebuild the extended K/V (recompute beats storing an overlapping
     # copy — same remat philosophy as the flash backward itself).
     k_ext, v_ext, seg_q_ids, seg_k_ids = _ext_and_segs(
-        k, v, seg_q, axis_name, tail
+        k, v, seg, axis_name, tail
     )
     do = g.astype(jnp.float32)
     delta = jnp.sum(
@@ -198,11 +193,10 @@ def sliding_window_attention_local(
             segment_ids=segment_ids, block_q=block_q, block_k=block_k,
             interpret=interpret,
         )
-    has_seg = segment_ids is not None
-    seg = (segment_ids.astype(jnp.int32) if has_seg
+    seg = (segment_ids.astype(jnp.int32) if segment_ids is not None
            else jnp.zeros((q.shape[0], L), jnp.int32))
     return _local_window(q, k, v, seg, axis_name, window, float(scale),
-                         block_q, block_k, interpret, has_seg)
+                         block_q, block_k, interpret)
 
 
 __all__ = ["sliding_window_attention_local"]
