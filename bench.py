@@ -523,6 +523,29 @@ def _bench_attention(on_accel: bool):
         except Exception as e:
             # keep *_ms keys type-stable (floats); failures get their own key
             out["xla_32k_error"] = f"{type(e).__name__}"[:80]
+
+        # Sliding window at long context: the band-narrowed grid should
+        # approach full-causal-time * (window/T) — the row that certifies
+        # the O(T*W) claim on silicon (r3; docs/api.md ops section).
+        try:
+            win = 2048
+
+            def one_win(q, k, v):
+                return jnp.sum(
+                    flash_attention(
+                        q, k, v, causal=True, window=win
+                    ).astype(jnp.float32)
+                )
+
+            fw = jax.jit(one_win)
+            _fetch_scalar(fw(ql, ql, ql))
+            t0 = time.perf_counter()
+            _fetch_scalar(fw(ql, ql, ql))
+            out["flash_32k_window2k_fwd_ms"] = round(
+                (time.perf_counter() - t0) * 1000, 1
+            )
+        except Exception as e:
+            out["flash_32k_window_error"] = f"{type(e).__name__}"[:80]
     return out
 
 

@@ -7,6 +7,7 @@ from chainermn_tpu.models.imagenet import AlexNet, GoogLeNet
 from chainermn_tpu.models.seq2seq import Seq2Seq, seq2seq_loss
 from chainermn_tpu.models.transformer import (
     TransformerLM,
+    beam_search,
     generate,
     init_cache,
     lm_loss,
@@ -31,6 +32,7 @@ __all__ = [
     "lm_loss",
     "lm_loss_fused",
     "generate",
+    "beam_search",
     "init_cache",
     "ResNet",
     "ResNet18",

@@ -375,6 +375,25 @@ def init_cache(model: TransformerLM, params, batch_size: int):
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), variables)
 
 
+def _decode_setup(model: TransformerLM, params, prompt, n_steps, pad_id):
+    """Shared ``generate``/``beam_search`` scaffolding: validation,
+    per-row true prompt lengths, and the prompt padded out to the decode
+    horizon."""
+    if model.return_hidden:
+        raise ValueError("decoding needs logits; build the model with "
+                         "return_hidden=False")
+    if n_steps > model.max_len:
+        raise ValueError(
+            f"n_steps={n_steps} exceeds the cache capacity "
+            f"max_len={model.max_len}"
+        )
+    B, P = prompt.shape
+    prompt_len = jnp.sum((prompt != pad_id).astype(jnp.int32), axis=1)
+    padded = jnp.pad(prompt, ((0, 0), (0, max(0, n_steps - P))),
+                     constant_values=pad_id)
+    return B, P, prompt_len, padded
+
+
 def generate(model: TransformerLM, params, prompt, n_steps: int, *,
              temperature: float = 0.0, rng=None, pad_id: int = 0):
     """Autoregressive generation with a per-block KV cache.
@@ -403,25 +422,13 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
     Returns:
       ``[B, n_steps]`` int32 tokens (prompt positions pass through).
     """
-    if model.return_hidden:
-        raise ValueError("generate needs logits; build the model with "
-                         "return_hidden=False")
-    if n_steps > model.max_len:
-        raise ValueError(
-            f"n_steps={n_steps} exceeds the cache capacity "
-            f"max_len={model.max_len}"
-        )
-    B, P = prompt.shape
-    prompt_len = jnp.sum(
-        (prompt != pad_id).astype(jnp.int32), axis=1
-    )  # [B] per-row true lengths
+    B, P, prompt_len, padded_prompt = _decode_setup(
+        model, params, prompt, n_steps, pad_id
+    )
     cache = init_cache(model, params, B)["cache"]
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-
-    padded_prompt = jnp.pad(prompt, ((0, 0), (0, max(0, n_steps - P))),
-                            constant_values=pad_id)
 
     def step(carry, t):
         cache, prev_tok, key = carry
@@ -450,3 +457,131 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
     # and otherwise prev_tok — i.e. the model's sample from step t-1,
     # its continuation for position t.
     return jnp.moveaxis(toks, 0, 1)  # [B, n_steps]
+
+
+def beam_search(model: TransformerLM, params, prompt, n_steps: int,
+                beam_size: int, *, eos_id: Optional[int] = None,
+                pad_id: int = 0):
+    """Beam-search decoding over the KV cache — ONE jitted ``lax.scan``.
+
+    Same shape discipline as :func:`generate`: prompt consumption and
+    beam expansion share the scan (prompt steps force every beam onto the
+    prompt token with scores pinned to ``[0, -inf, ...]``, so the first
+    free step expands from a single live beam), and the per-block caches
+    are batched ``B·beam`` and REORDERED by backpointer gather at every
+    step — no post-hoc hypothesis reconstruction pass.
+
+    Args:
+      model: ``TransformerLM`` with ``return_hidden=False``.
+      params: ``{'params': ...}`` variables.
+      prompt: ``[B, P]`` int32, right-padded with ``pad_id`` (ragged rows
+        expand beams from their own true length).
+      n_steps: total length INCLUDING the prompt (``<= model.max_len``).
+      beam_size: hypotheses kept per row.
+      eos_id: optional end token: finished beams are frozen (they extend
+        only with ``pad_id`` at no score change).
+
+    Returns:
+      ``(tokens, scores)``: ``[B, beam, n_steps]`` int32 hypotheses
+      (best-first) and their ``[B, beam]`` summed log-probabilities.
+    """
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    B, P, prompt_len, padded = _decode_setup(
+        model, params, prompt, n_steps, pad_id
+    )
+    K = beam_size
+    V = model.vocab_size
+
+    cache = init_cache(model, params, B * K)["cache"]
+    scores0 = jnp.tile(
+        jnp.array([0.0] + [-jnp.inf] * (K - 1), jnp.float32), (B, 1)
+    )
+    seqs0 = jnp.full((B, K, n_steps), pad_id, prompt.dtype)
+
+    def reorder(tree, parents):
+        """Gather the beam dimension of ``[B·K, ...]`` cache leaves by
+        the ``[B, K]`` backpointers."""
+        def one(leaf):
+            if leaf.ndim == 0:  # shared cache_index scalar
+                return leaf
+            shaped = leaf.reshape(B, K, *leaf.shape[1:])
+            idx = parents.reshape(B, K, *([1] * (leaf.ndim - 1)))
+            return jnp.take_along_axis(shaped, idx, axis=1).reshape(
+                leaf.shape
+            )
+        return jax.tree.map(one, tree)
+
+    def step(carry, t):
+        cache, prev_tok, scores, seqs, finished = carry
+        # Two per-row phases, offset by one: the token CONSUMED at t is
+        # prompt-forced while t < prompt_len, but the EXPANSION chosen at
+        # t is consumed at t+1 — so beam search activates one step early,
+        # at the LAST prompt step (t == prompt_len - 1), where the top-K
+        # first tokens and their scores spread from the single live beam.
+        in_prompt = (t < prompt_len)[:, None]  # [B, 1] consumption phase
+        # Beam phase: the expansion chosen at t is consumed at t+1, so it
+        # activates one step before the prompt ends AND must NOT commit on
+        # the final step (that choice would never be consumed — scoring or
+        # reordering by it would corrupt the returned hypotheses).
+        expanding = (
+            (t >= prompt_len - 1)[:, None] & (t < n_steps - 1)
+        )  # [B, 1]
+        tok = jnp.where(in_prompt, padded[:, t][:, None], prev_tok)
+
+        logits, mutated = model.apply(
+            {**params, "cache": cache}, tok.reshape(B * K, 1),
+            positions=jnp.full((1,), t, jnp.int32),
+            train=False, decode=True, mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32)
+        ).reshape(B, K, V)
+
+        # Frozen (finished) beams may only extend with pad at no cost.
+        if eos_id is not None:
+            frozen = jnp.full((V,), -jnp.inf).at[pad_id].set(0.0)
+            logp = jnp.where(finished[..., None], frozen[None, None], logp)
+
+        total = scores[..., None] + logp  # [B, K, V]
+        top_scores, flat_idx = jax.lax.top_k(total.reshape(B, K * V), K)
+        parents = flat_idx // V  # [B, K]
+        next_tok = (flat_idx % V).astype(prompt.dtype)
+
+        # Pre-expansion prompt steps: identity beams, pinned scores (the
+        # chosen next_tok is irrelevant — consumption stays forced).
+        ident = jnp.broadcast_to(jnp.arange(K, dtype=parents.dtype), (B, K))
+        parents = jnp.where(expanding, parents, ident)
+        new_scores = jnp.where(expanding, top_scores, scores)
+
+        # The identity gather of prefill steps is not free (parents is
+        # traced — XLA cannot fold it): skip the whole-cache copy until
+        # some row actually expands.
+        cache = jax.lax.cond(
+            jnp.any(expanding),
+            lambda c: reorder(c, parents),
+            lambda c: c,
+            mutated["cache"],
+        )
+        seqs = jnp.take_along_axis(seqs, parents[..., None], axis=1)
+        # Position t records the token CONSUMED at t by this slot's
+        # PARENT lineage (gather tok by backpointer — in prompt steps the
+        # token is row-uniform so the gather is a no-op).
+        seqs = seqs.at[:, :, t].set(
+            jnp.take_along_axis(tok, parents, axis=1)
+        )
+        if eos_id is not None:
+            finished = jnp.take_along_axis(finished, parents, axis=1)
+            finished = finished | (expanding & (next_tok == eos_id))
+        return ((cache, next_tok, new_scores, seqs, finished), None)
+
+    finished0 = jnp.zeros((B, K), bool)
+    (cache, last, scores, seqs, finished), _ = jax.lax.scan(
+        step,
+        (cache, jnp.broadcast_to(padded[:, 0][:, None], (B, K)),
+         scores0, seqs0, finished0),
+        jnp.arange(n_steps, dtype=jnp.int32),
+    )
+    order = jnp.argsort(-scores, axis=1)
+    return (jnp.take_along_axis(seqs, order[..., None], axis=1),
+            jnp.take_along_axis(scores, order, axis=1))

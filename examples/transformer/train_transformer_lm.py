@@ -81,6 +81,9 @@ def main(argv=None):
     p.add_argument("--window", type=int, default=0, metavar="W",
                    help="causal sliding-window attention of width W via the "
                         "flash kernel (0 = full causal; data-parallel mode)")
+    p.add_argument("--beam", type=int, default=0, metavar="K",
+                   help="with --generate: beam-search decode with K beams "
+                        "instead of greedy")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(
@@ -255,9 +258,20 @@ def run_data_parallel(args, comm, compute_dtype, rng):
         prompt = jnp.asarray(
             synthetic_tokens(rng, 2, min(8, args.seq_len))
         )
+        n = min(args.seq_len, prompt.shape[1] + args.generate)
+        if args.beam:
+            from chainermn_tpu.models import beam_search
+
+            beams, bscores = beam_search(
+                model, {"params": state.params}, prompt, n, args.beam,
+                pad_id=-1,
+            )
+            print(f"beam_search (K={args.beam}): best scores "
+                  f"{np.asarray(bscores[:, 0]).round(2).tolist()}; top "
+                  f"continuations "
+                  f"{np.asarray(beams[:, 0, prompt.shape[1]:]).tolist()}")
         out = generate(
-            model, {"params": state.params}, prompt,
-            min(args.seq_len, prompt.shape[1] + args.generate),
+            model, {"params": state.params}, prompt, n,
             pad_id=-1,  # synthetic tokens include 0; nothing is padding
         )
         print(f"generate: prompt {prompt.shape} -> {out.shape}; "

@@ -558,3 +558,100 @@ class TestWindowedDecode:
         toks = jnp.ones((1, 8), jnp.int32)
         with pytest.raises(ValueError, match="window-honouring"):
             model.init(jax.random.PRNGKey(0), toks, train=False)
+
+
+class TestBeamSearch:
+    def test_beam1_equals_greedy(self):
+        from chainermn_tpu.models.transformer import beam_search, generate
+
+        model = tiny_lm()
+        B, P, N = 2, 4, 10
+        prompt = jax.random.randint(jax.random.PRNGKey(40), (B, P), 1, VOCAB)
+        params = model.init(jax.random.PRNGKey(41), prompt, train=False)
+        greedy = generate(model, params, prompt, N)
+        beams, scores = beam_search(model, params, prompt, N, beam_size=1)
+        np.testing.assert_array_equal(np.asarray(beams[:, 0]),
+                                      np.asarray(greedy))
+        assert np.all(np.isfinite(np.asarray(scores)))
+
+    def test_scores_are_true_log_probs_and_ordered(self):
+        """Each returned hypothesis's score must equal the sum of its own
+        next-token log-probs under a full forward — and the top beam must
+        score at least as high as greedy."""
+        from chainermn_tpu.models.transformer import beam_search, generate
+
+        model = tiny_lm()
+        B, P, N, K = 1, 3, 8, 3
+        prompt = jax.random.randint(jax.random.PRNGKey(42), (B, P), 1, VOCAB)
+        params = model.init(jax.random.PRNGKey(43), prompt, train=False)
+        beams, scores = beam_search(model, params, prompt, N, beam_size=K)
+
+        def seq_logprob(seq):
+            logits = model.apply(params, seq[None], train=False)[0]
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            # generated positions: P..N-1; token at t scored by logits at t-1
+            idx = jnp.arange(P, N)
+            return float(jnp.sum(lp[idx - 1, seq[idx]]))
+
+        for k in range(K):
+            expected = seq_logprob(beams[0, k])
+            np.testing.assert_allclose(float(scores[0, k]), expected,
+                                       rtol=1e-4, atol=1e-4)
+        assert np.all(np.diff(np.asarray(scores[0])) <= 1e-6)  # sorted
+
+        greedy = generate(model, params, prompt, N)
+        assert float(scores[0, 0]) >= seq_logprob(greedy[0]) - 1e-5
+
+    def test_eos_freezes_beam(self):
+        """Designate the model's own argmax continuation as EOS so the
+        top beam is GUARANTEED to emit it at the first free position —
+        the frozen beam must then pad out at an unchanged score. (An
+        arbitrary eos id would make every assertion vacuously skippable
+        when it never lands in a beam.)"""
+        from chainermn_tpu.models.transformer import beam_search, generate
+
+        model = tiny_lm()
+        B, P, N, K = 1, 2, 7, 2
+        prompt = jnp.asarray([[7, 9]], jnp.int32)
+        params = model.init(jax.random.PRNGKey(44), prompt, train=False)
+        greedy = generate(model, params, prompt, N)
+        eos = int(greedy[0, P])  # the argmax first continuation
+        assert eos != 0  # pad would confuse the check
+
+        beams, scores = beam_search(model, params, prompt, N, beam_size=K,
+                                    eos_id=eos)
+        beams = np.asarray(beams)
+        # Some hypothesis must contain the designated EOS.
+        assert np.any(beams == eos)
+        hit = False
+        for k in range(K):
+            row = beams[0, k]
+            eos_pos = np.where(row == eos)[0]
+            if eos_pos.size:
+                hit = True
+                assert np.all(row[eos_pos[0] + 1:] == 0)
+        assert hit
+        # The frozen hypothesis [prompt, eos, pad...] scores exactly the
+        # eos token's log-prob — verify against a full forward.
+        frozen = np.asarray([[*np.asarray(prompt[0]), eos] + [0] * (N - P - 1)])
+        k_frozen = next(
+            k for k in range(K)
+            if np.array_equal(beams[0, k], frozen[0])
+        )
+        logits = model.apply(params, jnp.asarray(frozen), train=False)[0]
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        np.testing.assert_allclose(
+            float(scores[0, k_frozen]), float(lp[P - 1, eos]),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_capacity_and_beam_validation(self):
+        from chainermn_tpu.models.transformer import beam_search
+
+        model = tiny_lm()
+        prompt = jnp.ones((1, 3), jnp.int32)
+        params = model.init(jax.random.PRNGKey(45), prompt, train=False)
+        with pytest.raises(ValueError, match="cache capacity"):
+            beam_search(model, params, prompt, model.max_len + 1, 2)
+        with pytest.raises(ValueError, match="beam_size"):
+            beam_search(model, params, prompt, 6, 0)
