@@ -393,6 +393,35 @@ def case_fsdp_ring():
             rtol=1e-4, atol=1e-4,
         )
 
+    # Sliding-window SP: the single neighbour-tail ppermute crosses the
+    # process boundary; equals the dense windowed reference.
+    from chainermn_tpu.parallel.local_attention import (
+        sliding_window_attention_local,
+    )
+
+    W = 3  # W - 1 = 2 <= T_local = 4
+    band = np.where(
+        (np.arange(T)[:, None] - np.arange(T)[None, :]) < W, 0.0, -1e30
+    )[None, None].astype(np.float32)
+    sw = jax.jit(shard_map(
+        lambda q, k, v: sliding_window_attention_local(
+            q, k, v, "data", window=W, block_q=4, block_k=4,
+            interpret=True,
+        ),
+        mesh=comm.mesh, in_specs=(spec,) * 3, out_specs=spec,
+        check_vma=False,
+    ))
+    out_sw = sw(q, k, v)
+    ref_sw = dot_product_attention(
+        *(jnp.asarray(a) for a in qkv), causal=True,
+        bias=jnp.asarray(band),
+    )
+    for s in out_sw.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(s.data), np.asarray(ref_sw)[s.index],
+            rtol=1e-4, atol=1e-4,
+        )
+
 
 def case_preemption():
     """Preemption guard: only rank 0 is signalled; the host-plane agreement
