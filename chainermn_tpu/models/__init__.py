@@ -4,7 +4,12 @@ plus the Transformer LM the benchmark configs add (BASELINE.json)."""
 
 from chainermn_tpu.models.mlp import MLP
 from chainermn_tpu.models.imagenet import AlexNet, GoogLeNet
-from chainermn_tpu.models.seq2seq import Seq2Seq, seq2seq_loss
+from chainermn_tpu.models.seq2seq import (
+    Seq2Seq,
+    beam_search_decode,
+    greedy_decode,
+    seq2seq_loss,
+)
 from chainermn_tpu.models.transformer import (
     TransformerLM,
     beam_search,
@@ -27,6 +32,8 @@ __all__ = [
     "AlexNet",
     "GoogLeNet",
     "Seq2Seq",
+    "beam_search_decode",
+    "greedy_decode",
     "seq2seq_loss",
     "TransformerLM",
     "lm_loss",

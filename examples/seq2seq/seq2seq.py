@@ -30,7 +30,7 @@ import chainermn_tpu
 from chainermn_tpu import global_except_hook
 from chainermn_tpu.datasets.bucketing import bucket_batches
 from chainermn_tpu.models import Seq2Seq, seq2seq_loss
-from chainermn_tpu.models.seq2seq import greedy_decode
+from chainermn_tpu.models.seq2seq import beam_search_decode, greedy_decode
 from chainermn_tpu.utils import bleu as bleu_utils
 
 VOCAB = 128
@@ -64,6 +64,9 @@ def main(argv=None):
                         "(the synthetic reversal task needs ~2000+ "
                         "iterations before BLEU leaves zero)")
     p.add_argument("--eval-size", type=int, default=256)
+    p.add_argument("--beam", type=int, default=0, metavar="K",
+                   help="with --eval: beam-search decode with K beams "
+                        "instead of greedy (takes each row's top beam)")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -167,11 +170,18 @@ def main(argv=None):
         # §2.8 — aggregation via the multi-node evaluator).
         held_out = synthetic_pairs(args.eval_size, seed=1234)
         shard = chainermn_tpu.scatter_dataset(held_out, comm, shuffle=False)
-        decode = jax.jit(
-            lambda s, m: greedy_decode(
-                model, params, s, m, max_len=36, bos=BOS, eos=EOS
+        if args.beam:
+            decode = jax.jit(
+                lambda s, m: beam_search_decode(
+                    model, params, s, m, 36, args.beam, bos=BOS, eos=EOS
+                )[0][:, 0]  # each row's best hypothesis
             )
-        )
+        else:
+            decode = jax.jit(
+                lambda s, m: greedy_decode(
+                    model, params, s, m, max_len=36, bos=BOS, eos=EOS
+                )
+            )
 
         def local_bleu_stats() -> dict:
             stats = []

@@ -655,3 +655,90 @@ class TestBeamSearch:
             beam_search(model, params, prompt, model.max_len + 1, 2)
         with pytest.raises(ValueError, match="beam_size"):
             beam_search(model, params, prompt, 6, 0)
+
+
+class TestSeq2SeqBeam:
+    def _setup(self):
+        from chainermn_tpu.models import Seq2Seq
+
+        model = Seq2Seq(src_vocab=VOCAB, tgt_vocab=VOCAB, embed=16,
+                        hidden=32, num_layers=2)
+        B, Ts = 2, 6
+        src = jax.random.randint(jax.random.PRNGKey(50), (B, Ts), 3, VOCAB)
+        mask = jnp.ones((B, Ts))
+        variables = model.init(jax.random.PRNGKey(51), src,
+                               src[:, :4], mask, jnp.ones((B, 4)))
+        return model, variables, src, mask
+
+    def test_beam1_equals_greedy(self):
+        from chainermn_tpu.models.seq2seq import (
+            beam_search_decode,
+            greedy_decode,
+        )
+
+        model, variables, src, mask = self._setup()
+        N = 8
+        g = greedy_decode(model, variables, src, mask, N)
+        beams, scores = beam_search_decode(model, variables, src, mask, N,
+                                           beam_size=1)
+        np.testing.assert_array_equal(np.asarray(beams[:, 0]), np.asarray(g))
+        assert np.all(np.isfinite(np.asarray(scores)))
+
+    def test_scores_are_true_log_probs(self):
+        """Each hypothesis's score equals the teacher-forced log-prob of
+        its tokens up to and including the first EOS (frozen steps add
+        exactly zero)."""
+        from chainermn_tpu.models.seq2seq import beam_search_decode
+
+        model, variables, src, mask = self._setup()
+        N, K = 7, 3
+        bos, eos = 1, 2
+        beams, scores = beam_search_decode(model, variables, src, mask, N,
+                                           beam_size=K, bos=bos, eos=eos)
+        beams_np = np.asarray(beams)
+        for b in range(src.shape[0]):
+            for k in range(K):
+                hyp = beams_np[b, k]
+                dec_in = jnp.asarray(
+                    np.concatenate([[bos], hyp[:-1]])[None]
+                )
+                logits = model.apply(
+                    variables, src[b:b + 1], dec_in, mask[b:b + 1],
+                    jnp.ones((1, N)),
+                )[0]
+                lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+                eos_pos = np.where(hyp == eos)[0]
+                upto = (eos_pos[0] + 1) if eos_pos.size else N
+                expected = float(sum(
+                    lp[t, hyp[t]] for t in range(upto)
+                ))
+                np.testing.assert_allclose(float(scores[b, k]), expected,
+                                           rtol=1e-4, atol=1e-4)
+        # best-first ordering
+        assert np.all(np.diff(np.asarray(scores), axis=1) <= 1e-6)
+
+    def test_top_beam_at_least_greedy(self):
+        from chainermn_tpu.models.seq2seq import (
+            beam_search_decode,
+            greedy_decode,
+        )
+
+        model, variables, src, mask = self._setup()
+        N = 8
+        beams, scores = beam_search_decode(model, variables, src, mask, N,
+                                           beam_size=4)
+        g = greedy_decode(model, variables, src, mask, N)
+        # score the greedy hypothesis the same way
+        bos, eos = 1, 2
+        g_np = np.asarray(g)
+        for b in range(src.shape[0]):
+            dec_in = jnp.asarray(np.concatenate([[bos], g_np[b, :-1]])[None])
+            logits = model.apply(
+                variables, src[b:b + 1], dec_in, mask[b:b + 1],
+                jnp.ones((1, N)),
+            )[0]
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            eos_pos = np.where(g_np[b] == eos)[0]
+            upto = (eos_pos[0] + 1) if eos_pos.size else N
+            g_score = float(sum(lp[t, g_np[b, t]] for t in range(upto)))
+            assert float(scores[b, 0]) >= g_score - 1e-5
