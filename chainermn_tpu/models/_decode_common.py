@@ -1,0 +1,29 @@
+"""Shared decoding utilities for the transformer and seq2seq beam
+searches — one owner for the ranking formula so the two decoders cannot
+drift."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gnmt_ranking(scores, gen_len, alpha: float):
+    """GNMT length-penalized ranking values:
+    ``score / ((5 + len) / 6)**alpha``.
+
+    Well-defined for any alpha: positive counters the short-hypothesis
+    bias of raw summed log-probs; negative favours shorter hypotheses;
+    0 is the raw score (callers usually skip the call entirely then).
+    """
+    return scores / ((5.0 + gen_len.astype(jnp.float32)) / 6.0) ** alpha
+
+
+def rank_beams(seqs, scores, gen_len, alpha: float):
+    """Order ``(seqs [B, K, T], scores [B, K])`` best-first under the
+    GNMT-penalized ranking; the returned scores stay raw."""
+    order = jnp.argsort(-gnmt_ranking(scores, gen_len, alpha), axis=1)
+    return (jnp.take_along_axis(seqs, order[..., None], axis=1),
+            jnp.take_along_axis(scores, order, axis=1))
+
+
+__all__ = ["gnmt_ranking", "rank_beams"]

@@ -169,6 +169,7 @@ def beam_search_decode(
     *,
     bos: int = 1,
     eos: int = 2,
+    length_penalty: float = 0.0,
 ):
     """Jittable beam-search decoding: ``[B, Ts]`` sources →
     ``([B, beam, max_len]`` hypotheses best-first, ``[B, beam]`` summed
@@ -179,6 +180,11 @@ def beam_search_decode(
     than the transformer's :func:`~chainermn_tpu.models.transformer.
     beam_search`: there is no prompt phase, so every step's expansion is
     recorded at its own position.
+
+    ``length_penalty`` (GNMT alpha) ranks hypotheses by
+    ``score / ((5 + len) / 6)**alpha`` with ``len`` counted up to and
+    including EOS (positive favours longer hypotheses, negative shorter);
+    returned scores stay raw.
     """
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
@@ -203,7 +209,7 @@ def beam_search_decode(
         return jax.tree.map(one, tree)
 
     def body(state, _):
-        carry, tok, scores, finished = state
+        carry, tok, scores, finished, gen_len = state
         logits, carry = model.apply(
             variables, carry, tok.reshape(B * K),
             method=Seq2Seq.decode_step,
@@ -221,16 +227,20 @@ def beam_search_decode(
 
         carry = reorder(carry, parents)
         finished = jnp.take_along_axis(finished, parents, axis=1)
+        gen_len = jnp.take_along_axis(gen_len, parents, axis=1)
+        gen_len = gen_len + (~finished).astype(jnp.int32)
         finished = finished | (next_tok == eos)
-        return (carry, next_tok, top_scores, finished), (next_tok, parents)
+        return ((carry, next_tok, top_scores, finished, gen_len),
+                (next_tok, parents))
 
     init = (
         carry,
         jnp.full((B, K), bos, jnp.int32),
         scores0,
         jnp.zeros((B, K), bool),
+        jnp.zeros((B, K), jnp.int32),
     )
-    (_, _, scores, _), (toks, parents) = jax.lax.scan(
+    (_, _, scores, _, gen_len), (toks, parents) = jax.lax.scan(
         body, init, None, length=max_len
     )
 
@@ -248,8 +258,12 @@ def beam_search_decode(
         back, slot0, (jnp.flip(toks, 0), jnp.flip(parents, 0))
     )
     seqs = jnp.flip(jnp.moveaxis(rev, 0, 2), 2)  # [B, K, max_len]
-    # Already best-first: the final step's top_k returns scores sorted
-    # descending, and seqs slots were reconstructed in that order.
+    if length_penalty != 0.0:
+        from chainermn_tpu.models._decode_common import rank_beams
+
+        return rank_beams(seqs, scores, gen_len, length_penalty)
+    # Already best-first under raw scores: the final step's top_k returns
+    # them sorted descending, and seqs slots match that order.
     return seqs, scores
 
 

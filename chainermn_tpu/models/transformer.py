@@ -461,7 +461,7 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
 
 def beam_search(model: TransformerLM, params, prompt, n_steps: int,
                 beam_size: int, *, eos_id: Optional[int] = None,
-                pad_id: int = 0):
+                pad_id: int = 0, length_penalty: float = 0.0):
     """Beam-search decoding over the KV cache — ONE jitted ``lax.scan``.
 
     Same shape discipline as :func:`generate`: prompt consumption and
@@ -480,10 +480,17 @@ def beam_search(model: TransformerLM, params, prompt, n_steps: int,
       beam_size: hypotheses kept per row.
       eos_id: optional end token: finished beams are frozen (they extend
         only with ``pad_id`` at no score change).
+      length_penalty: GNMT alpha — hypotheses are RANKED by
+        ``score / ((5 + len) / 6)**alpha`` (len = generated tokens up to
+        and including EOS): positive counters the short-hypothesis bias
+        of raw summed log-probs, negative favours shorter hypotheses,
+        0 ranks by raw score. The returned ``scores`` stay raw either
+        way.
 
     Returns:
       ``(tokens, scores)``: ``[B, beam, n_steps]`` int32 hypotheses
-      (best-first) and their ``[B, beam]`` summed log-probabilities.
+      (best-first under the chosen ranking) and their ``[B, beam]`` raw
+      summed log-probabilities.
     """
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
@@ -513,7 +520,7 @@ def beam_search(model: TransformerLM, params, prompt, n_steps: int,
         return jax.tree.map(one, tree)
 
     def step(carry, t):
-        cache, prev_tok, scores, seqs, finished = carry
+        cache, prev_tok, scores, seqs, finished, gen_len = carry
         # Two per-row phases, offset by one: the token CONSUMED at t is
         # prompt-forced while t < prompt_len, but the EXPANSION chosen at
         # t is consumed at t+1 — so beam search activates one step early,
@@ -570,18 +577,28 @@ def beam_search(model: TransformerLM, params, prompt, n_steps: int,
         seqs = seqs.at[:, :, t].set(
             jnp.take_along_axis(tok, parents, axis=1)
         )
+        # Generated-token count per surviving lineage (for the length
+        # penalty): a committed expansion by an unfinished beam adds one.
+        gen_len = jnp.take_along_axis(gen_len, parents, axis=1)
         if eos_id is not None:
             finished = jnp.take_along_axis(finished, parents, axis=1)
+        gen_len = gen_len + (expanding & ~finished).astype(jnp.int32)
+        if eos_id is not None:
             finished = finished | (expanding & (next_tok == eos_id))
-        return ((cache, next_tok, new_scores, seqs, finished), None)
+        return ((cache, next_tok, new_scores, seqs, finished, gen_len),
+                None)
 
     finished0 = jnp.zeros((B, K), bool)
-    (cache, last, scores, seqs, finished), _ = jax.lax.scan(
+    (cache, last, scores, seqs, finished, gen_len), _ = jax.lax.scan(
         step,
         (cache, jnp.broadcast_to(padded[:, 0][:, None], (B, K)),
-         scores0, seqs0, finished0),
+         scores0, seqs0, finished0, jnp.zeros((B, K), jnp.int32)),
         jnp.arange(n_steps, dtype=jnp.int32),
     )
+    if length_penalty != 0.0:
+        from chainermn_tpu.models._decode_common import rank_beams
+
+        return rank_beams(seqs, scores, gen_len, length_penalty)
     order = jnp.argsort(-scores, axis=1)
     return (jnp.take_along_axis(seqs, order[..., None], axis=1),
             jnp.take_along_axis(scores, order, axis=1))

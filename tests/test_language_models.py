@@ -742,3 +742,78 @@ class TestSeq2SeqBeam:
             upto = (eos_pos[0] + 1) if eos_pos.size else N
             g_score = float(sum(lp[t, g_np[b, t]] for t in range(upto)))
             assert float(scores[b, 0]) >= g_score - 1e-5
+
+
+class TestLengthPenalty:
+    def test_alpha0_is_identity_transformer(self):
+        from chainermn_tpu.models.transformer import beam_search
+
+        model = tiny_lm()
+        prompt = jax.random.randint(jax.random.PRNGKey(60), (2, 3), 1, VOCAB)
+        params = model.init(jax.random.PRNGKey(61), prompt, train=False)
+        a = beam_search(model, params, prompt, 9, 3)
+        b = beam_search(model, params, prompt, 9, 3, length_penalty=0.0)
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+    def test_penalized_ranking_is_monotone(self):
+        """With alpha > 0 the returned order must sort the PENALIZED
+        scores descending (recomputed from the returned hypotheses'
+        generated lengths), while raw scores come back unpenalized."""
+        from chainermn_tpu.models.transformer import beam_search, generate
+
+        model = tiny_lm()
+        B, P, N, K = 1, 3, 9, 3
+        prompt = jax.random.randint(jax.random.PRNGKey(62), (B, P), 1, VOCAB)
+        params = model.init(jax.random.PRNGKey(63), prompt, train=False)
+        # designate the argmax continuation as EOS so lengths VARY
+        eos = int(generate(model, params, prompt, N)[0, P])
+        alpha = 5.0
+        beams, scores = beam_search(model, params, prompt, N, K,
+                                    eos_id=eos, length_penalty=alpha)
+        beams_np, pen = np.asarray(beams), []
+        for k in range(K):
+            row = beams_np[0, k, P:]
+            eos_pos = np.where(row == eos)[0]
+            glen = (eos_pos[0] + 1) if eos_pos.size else N - P
+            pen.append(float(scores[0, k]) / ((5.0 + glen) / 6.0) ** alpha)
+        assert all(pen[i] >= pen[i + 1] - 1e-5 for i in range(K - 1)), pen
+
+    def test_alpha0_is_identity_seq2seq(self):
+        from chainermn_tpu.models.seq2seq import beam_search_decode
+
+        from chainermn_tpu.models import Seq2Seq
+
+        model = Seq2Seq(src_vocab=VOCAB, tgt_vocab=VOCAB, embed=16,
+                        hidden=32, num_layers=1)
+        src = jax.random.randint(jax.random.PRNGKey(64), (2, 5), 3, VOCAB)
+        mask = jnp.ones((2, 5))
+        variables = model.init(jax.random.PRNGKey(65), src, src[:, :3],
+                               mask, jnp.ones((2, 3)))
+        a = beam_search_decode(model, variables, src, mask, 7, 3)
+        b = beam_search_decode(model, variables, src, mask, 7, 3,
+                               length_penalty=0.0)
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+
+    def test_penalized_ranking_is_monotone_seq2seq(self):
+        from chainermn_tpu.models import Seq2Seq
+        from chainermn_tpu.models.seq2seq import beam_search_decode
+
+        model = Seq2Seq(src_vocab=VOCAB, tgt_vocab=VOCAB, embed=16,
+                        hidden=32, num_layers=1)
+        src = jax.random.randint(jax.random.PRNGKey(66), (1, 5), 3, VOCAB)
+        mask = jnp.ones((1, 5))
+        variables = model.init(jax.random.PRNGKey(67), src, src[:, :3],
+                               mask, jnp.ones((1, 3)))
+        N, K, alpha, eos = 8, 4, 5.0, 2
+        beams, scores = beam_search_decode(
+            model, variables, src, mask, N, K, eos=eos,
+            length_penalty=alpha,
+        )
+        beams_np, pen = np.asarray(beams), []
+        for k in range(K):
+            row = beams_np[0, k]
+            eos_pos = np.where(row == eos)[0]
+            glen = (eos_pos[0] + 1) if eos_pos.size else N
+            pen.append(float(scores[0, k]) / ((5.0 + glen) / 6.0) ** alpha)
+        assert all(pen[i] >= pen[i + 1] - 1e-5 for i in range(K - 1)), pen
