@@ -45,6 +45,11 @@ class TransformerBlock(nn.Module):
     d_ff: int
     compute_dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    #: residual dropout on the attention and FFN branch outputs (the
+    #: GPT-2 placement; attention-matrix dropout is deliberately NOT
+    #: offered — it would break the flash kernels' LSE bookkeeping and
+    #: modern LM recipes train without it). Active when ``train=True``;
+    #: callers supply the ``'dropout'`` rng.
     dropout_rate: float = 0.0
     #: kv heads for GQA/MQA (None → num_heads, i.e. standard MHA). The kv
     #: projection shrinks accordingly; the attention kernel shares kv heads
@@ -170,6 +175,8 @@ class TransformerBlock(nn.Module):
             D, use_bias=False,
             dtype=self.compute_dtype, param_dtype=jnp.float32, name="proj",
         )(o.reshape(B, T, D))
+        if self.dropout_rate > 0.0:
+            o = nn.Dropout(self.dropout_rate, deterministic=not train)(o)
         x = x + o
 
         h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
@@ -181,6 +188,8 @@ class TransformerBlock(nn.Module):
         h = nn.Dense(
             D, dtype=self.compute_dtype, param_dtype=jnp.float32, name="ff_down",
         )(h)
+        if self.dropout_rate > 0.0:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         return x + h
 
 
@@ -219,6 +228,9 @@ class TransformerLM(nn.Module):
     #: training requires a window-honouring ``attention_fn``; the decode
     #: path masks the KV cache to the same band automatically.
     window: Optional[int] = None
+    #: residual dropout rate (see ``TransformerBlock.dropout_rate``);
+    #: pass ``rngs={'dropout': key}`` to ``apply`` when training with it.
+    dropout_rate: float = 0.0
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None,
@@ -283,6 +295,7 @@ class TransformerLM(nn.Module):
                 num_kv_heads=self.num_kv_heads,
                 decode_max_len=self.max_len,
                 window=self.window,
+                dropout_rate=self.dropout_rate,
                 name=f"block_{i}",
             )(x, segment_ids, rope_positions, train, decode)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)

@@ -817,3 +817,47 @@ class TestLengthPenalty:
             glen = (eos_pos[0] + 1) if eos_pos.size else N
             pen.append(float(scores[0, k]) / ((5.0 + glen) / 6.0) ** alpha)
         assert all(pen[i] >= pen[i + 1] - 1e-5 for i in range(K - 1)), pen
+
+
+class TestDropout:
+    def test_dropout_active_in_train_inert_in_eval(self):
+        model = tiny_lm(dropout_rate=0.5)
+        tokens = jax.random.randint(jax.random.PRNGKey(70), (2, 12), 1, VOCAB)
+        params = model.init(
+            {"params": jax.random.PRNGKey(71),
+             "dropout": jax.random.PRNGKey(72)},
+            tokens,
+        )
+        # train=True: different dropout rngs -> different logits
+        a = model.apply(params, tokens, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(1)})
+        b = model.apply(params, tokens, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(2)})
+        assert not np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+        # eval: no rng needed, deterministic, equals the rate-0 model
+        e1 = model.apply(params, tokens, train=False)
+        e2 = model.apply(params, tokens, train=False)
+        np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+        ref = tiny_lm().apply(params, tokens, train=False)
+        np.testing.assert_allclose(np.asarray(e1), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_dropout_composes_with_remat(self):
+        model = tiny_lm(dropout_rate=0.3, remat=True)
+        tokens = jax.random.randint(jax.random.PRNGKey(73), (2, 8), 1, VOCAB)
+        params = model.init(
+            {"params": jax.random.PRNGKey(74),
+             "dropout": jax.random.PRNGKey(75)},
+            tokens,
+        )
+
+        def loss(p):
+            logits = model.apply(
+                p, tokens, train=True,
+                rngs={"dropout": jax.random.PRNGKey(3)},
+            )
+            return lm_loss(logits, tokens)
+
+        g = jax.grad(loss)(params)
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(g))
