@@ -35,6 +35,7 @@ def ulysses_attention_local(
     attn_fn: Optional[Callable] = None,
     impl: str = "flash",
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Ulysses attention over local shards — call INSIDE ``shard_map``.
@@ -53,6 +54,10 @@ def ulysses_attention_local(
         all-gathered (ids only — tiny) so the head-sharded full-sequence
         attention sees the whole mask. Requires ``impl='flash'`` or a
         segment-capable ``attn_fn``.
+      window: causal sliding-window width, handed to the flash kernel
+        (banded grids — heads are sharded here, so each device runs the
+        full-sequence window band over its own heads). Requires
+        ``causal=True`` and ``impl='flash'``.
 
     Returns:
       Local output shard ``[B, T_local, H, D]``.
@@ -65,12 +70,18 @@ def ulysses_attention_local(
                 f"ulysses: {name} heads {h} not divisible by axis "
                 f"{axis_name!r} size {n}"
             )
+    if window is not None and (impl != "flash" or attn_fn is not None):
+        raise ValueError(
+            "window is implemented by the flash kernel — use impl='flash' "
+            "without a custom attn_fn (or honour the window inside your "
+            "attn_fn yourself)"
+        )
     if attn_fn is None:
         if impl == "flash":
             def attn_fn(q, k, v, *, causal, scale, **kw):
                 return flash_attention(
                     q, k, v, causal=causal, scale=scale, interpret=interpret,
-                    **kw,
+                    window=window, **kw,
                 )
         elif impl == "blockwise":
             if segment_ids is not None:
@@ -115,6 +126,7 @@ def make_ulysses_attention(
     batch_axis: Optional[str] = None,
     impl: str = "flash",
     with_segments: bool = False,
+    window: Optional[int] = None,
 ):
     """Jitted Ulysses attention over globally sequence-sharded BTHD arrays
     (counterpart of :func:`chainermn_tpu.parallel.make_ring_attention`).
@@ -128,7 +140,7 @@ def make_ulysses_attention(
     def local(q, k, v, seg=None):
         return ulysses_attention_local(
             q, k, v, axis_name, causal=causal, scale=scale, attn_fn=attn_fn,
-            impl=impl, segment_ids=seg, interpret=interpret,
+            impl=impl, segment_ids=seg, window=window, interpret=interpret,
         )
 
     in_specs = (spec, spec, spec) + ((seg_spec,) if with_segments else ())
