@@ -407,8 +407,45 @@ def _decode_setup(model: TransformerLM, params, prompt, n_steps, pad_id):
     return B, P, prompt_len, padded
 
 
+def _filter_logits(logits, top_k, top_p):
+    """Top-k / nucleus filtering on ``[B, V]`` logits: tokens outside the
+    k highest (and outside the smallest set whose probability mass
+    reaches ``top_p``) are masked to -inf. Static shapes throughout —
+    the nucleus cut uses a sorted cumulative sum, no dynamic slicing."""
+    if top_p is None:
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]  # k-th largest
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    if top_k is not None:
+        # Reuse the descending sort for the k-th threshold — no second
+        # vocab-sized pass — and restrict the nucleus mass to the top-k
+        # survivors (HF semantics: top_p renormalizes AFTER top_k).
+        kth = sorted_logits[:, top_k - 1:top_k]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        sorted_logits = jnp.where(
+            jnp.arange(sorted_logits.shape[-1])[None] < top_k,
+            sorted_logits, -jnp.inf,
+        )
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens while the mass BEFORE them is < top_p (the first
+    # token is always kept).
+    keep_sorted = jnp.concatenate(
+        [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p], axis=-1
+    )
+    # Threshold = smallest kept logit per row.
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+        keepdims=True,
+    )
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
 def generate(model: TransformerLM, params, prompt, n_steps: int, *,
-             temperature: float = 0.0, rng=None, pad_id: int = 0):
+             temperature: float = 0.0, rng=None, pad_id: int = 0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None):
     """Autoregressive generation with a per-block KV cache.
 
     TPU-first shape discipline: ONE jitted ``lax.scan`` of single-token
@@ -429,6 +466,11 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
       temperature: 0 → greedy argmax; otherwise softmax sampling at this
         temperature (requires ``rng``).
       rng: PRNG key for sampling (ignored when greedy).
+      top_k: sample only among the k highest-probability tokens.
+      top_p: nucleus sampling — restrict to the smallest token set whose
+        probability mass reaches ``top_p``. Composes with ``top_k``
+        (intersection) and applies before the temperature division.
+        Both require ``temperature > 0``.
       pad_id: padding token in ``prompt``; positions where every shorter
         row has run out of prompt switch to model continuations.
 
@@ -441,6 +483,16 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
     cache = init_cache(model, params, B)["cache"]
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
+    if (top_k is not None or top_p is not None) and temperature <= 0.0:
+        raise ValueError("top_k/top_p filtering is for sampling — set "
+                         "temperature > 0")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None and not (1 <= top_k <= model.vocab_size):
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={model.vocab_size}], "
+            f"got {top_k}"
+        )
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def step(carry, t):
@@ -456,6 +508,7 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
         logits = logits[:, 0]  # [B, vocab]
         key, sub = jax.random.split(key)
         if temperature > 0.0:
+            logits = _filter_logits(logits, top_k, top_p)
             nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)

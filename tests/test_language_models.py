@@ -861,3 +861,66 @@ class TestDropout:
         g = jax.grad(loss)(params)
         assert all(np.isfinite(np.asarray(x)).all()
                    for x in jax.tree.leaves(g))
+
+
+class TestSamplingFilters:
+    def test_filter_logits_top_k(self):
+        from chainermn_tpu.models.transformer import _filter_logits
+
+        logits = jnp.asarray([[1.0, 3.0, 2.0, 0.5, -1.0]])
+        out = np.asarray(_filter_logits(logits, 2, None))
+        assert np.isfinite(out[0, 1]) and np.isfinite(out[0, 2])
+        assert np.isneginf(out[0, 0]) and np.isneginf(out[0, 3])
+        assert np.isneginf(out[0, 4])
+
+    def test_filter_logits_top_p(self):
+        from chainermn_tpu.models.transformer import _filter_logits
+
+        # probs ~ [0.643, 0.237, 0.087, 0.032] for logits [3, 2, 1, 0]
+        logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+        # top_p=0.7: mass before token0=0 < .7 keep; before token1=.643<.7
+        # keep; before token2=.880>.7 drop.
+        out = np.asarray(_filter_logits(logits, None, 0.7))
+        assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+        assert np.isneginf(out[0, 2]) and np.isneginf(out[0, 3])
+        # top_p tiny: only the argmax survives
+        out1 = np.asarray(_filter_logits(logits, None, 1e-6))
+        assert np.isfinite(out1[0, 0]) and np.all(np.isneginf(out1[0, 1:]))
+        # top_p=1.0 keeps everything
+        outall = np.asarray(_filter_logits(logits, None, 1.0))
+        assert np.all(np.isfinite(outall))
+
+    def test_generate_with_filters_runs_and_validates(self):
+        from chainermn_tpu.models.transformer import generate
+
+        model = tiny_lm()
+        prompt = jax.random.randint(jax.random.PRNGKey(80), (1, 4), 1, VOCAB)
+        params = model.init(jax.random.PRNGKey(81), prompt, train=False)
+        key = jax.random.PRNGKey(82)
+        out = generate(model, params, prompt, 9, temperature=0.8,
+                       top_k=5, top_p=0.9, rng=key)
+        assert out.shape == (1, 9)
+        # top_k=1 sampling == greedy regardless of temperature
+        g = generate(model, params, prompt, 9)
+        s1 = generate(model, params, prompt, 9, temperature=2.0, top_k=1,
+                      rng=key)
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(g))
+        with pytest.raises(ValueError, match="temperature > 0"):
+            generate(model, params, prompt, 9, top_k=3)
+        with pytest.raises(ValueError, match="top_p must be"):
+            generate(model, params, prompt, 9, temperature=1.0, top_p=1.5,
+                     rng=key)
+
+    def test_top_k_range_validated(self):
+        from chainermn_tpu.models.transformer import generate
+
+        model = tiny_lm()
+        prompt = jnp.ones((1, 3), jnp.int32)
+        params = model.init(jax.random.PRNGKey(83), prompt, train=False)
+        key = jax.random.PRNGKey(84)
+        with pytest.raises(ValueError, match="top_k must be"):
+            generate(model, params, prompt, 6, temperature=1.0, top_k=0,
+                     rng=key)
+        with pytest.raises(ValueError, match="top_k must be"):
+            generate(model, params, prompt, 6, temperature=1.0,
+                     top_k=VOCAB + 1, rng=key)
