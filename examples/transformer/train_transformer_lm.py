@@ -80,7 +80,8 @@ def main(argv=None):
                         "mode only)")
     p.add_argument("--window", type=int, default=0, metavar="W",
                    help="causal sliding-window attention of width W via the "
-                        "flash kernel (0 = full causal; data-parallel mode)")
+                        "flash kernel (0 = full causal; composes with "
+                        "--packed and --sequence-parallel)")
     p.add_argument("--beam", type=int, default=0, metavar="K",
                    help="with --generate: beam-search decode with K beams "
                         "instead of greedy")
@@ -146,8 +147,12 @@ def run_packed(args, comm, compute_dtype, rng):
     interpret = jax.default_backend() != "tpu"
 
     def attn(q, k, v, *, causal, scale, segment_ids=None):
+        # window composes with the packed-segment masks in the kernel
+        # (0 = no window — full causal within each document).
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               segment_ids=segment_ids, interpret=interpret)
+                               segment_ids=segment_ids,
+                               window=args.window or None,
+                               interpret=interpret)
 
     model = TransformerLM(
         vocab_size=VOCAB, num_layers=args.num_layers,
@@ -155,6 +160,7 @@ def run_packed(args, comm, compute_dtype, rng):
         max_len=args.seq_len, compute_dtype=compute_dtype,
         attention_fn=attn, num_kv_heads=args.num_kv_heads,
         pos_encoding=args.pos_encoding,
+        window=args.window or None,
     )
     global_batch = args.batchsize * comm.size
     tokens0, seg0 = pack_documents(rng, global_batch, args.seq_len)
