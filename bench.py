@@ -291,6 +291,7 @@ _COMPACT_KEYS = (
     "transformer_mfu", "flash_fwdbwd_speedup", "allreduce_gbps",
     "resnet50_s2d_images_per_sec", "moe_dispatch_sort_speedup",
     "native_input_images_per_sec", "double_buffer_speedup",
+    "flash_32k_fwd_ms", "flash_32k_window2k_fwd_ms",
 )
 
 
