@@ -1,32 +1,34 @@
-"""Sequence-parallel sliding-window (local) attention — O(1) communication.
+"""Sequence-parallel sliding-window (local) attention — O(window) comm.
 
 NEW capability relative to the reference (SURVEY.md section 5: no sequence
 parallelism existed in the 2017-era codebase). The distributed complement
-of ``flash_attention(window=W)``: when the attention window fits within
-one sequence shard (``W - 1 <= T_local``), a query can only reach keys in
-its OWN shard and the TAIL of the PREVIOUS shard. So instead of rotating
-K/V around the full ring (n - 1 ``ppermute`` hops, O(n) traffic —
-:mod:`chainermn_tpu.parallel.ring_attention`), each shard exchanges ONE
-neighbour tail of ``W - 1`` positions: communication is O(window), an
-n-fold saving that grows with the mesh.
+of ``flash_attention(window=W)``: a query can only reach keys within the
+last ``W`` positions, which live on its OWN shard plus the TAILS of its
+``m = ceil((W-1)/T_local)`` nearest predecessors. So instead of rotating
+K/V around the full ring (n - 1 ``ppermute`` hops, O(T) traffic —
+:mod:`chainermn_tpu.parallel.ring_attention`), each shard exchanges
+exactly the ``W - 1`` needed positions (one bundled ``ppermute`` per
+neighbour distance): communication is O(window) regardless of sequence
+length or mesh size — a T/W-fold saving.
 
 Mechanism (inside ``shard_map`` over the sequence axis):
 
-1. every shard sends the last ``W - 1`` K/V positions to its successor
-   (single ``ppermute`` shift);
-2. the receiver prepends them and runs the banded flash kernel with
-   ``q_offset = W - 1`` — local query row ``i`` sits at extended-key
-   position ``i + W - 1``, so the standard causal-window band lands
-   exactly on the right keys;
-3. shard 0's received tail is the wrap-around from the LAST shard and
-   must see nothing: a segment-id sentinel masks it (the kernel's packed
+1. predecessor ``s-d`` (``d = 1..m``) sends its last
+   ``c_d = min(T_local, W-1-(d-1)·T_local)`` K/V positions ``d`` steps
+   forward; the receiver prepends them furthest-first;
+2. the banded flash kernel runs with ``q_offset = prefix_len`` — local
+   query row ``i`` sits at extended-key position ``i + prefix_len``, so
+   the standard causal-window band lands exactly on the right keys;
+3. wrap-around slices (shard ``s`` receiving from ``s - d < 0``) must
+   see nothing: a segment-id sentinel masks them (the kernel's packed
    -segment mask, reused);
 4. backward: the flash backward yields gradients for the extended K/V;
-   the tail slice ``ppermute``s BACK to its owner (the transpose of the
-   forward shift — the same Send/Recv duality the reference hand-built in
-   ``functions/point_to_point_communication.py`` (dagger)) and adds into
-   the owner's last ``W - 1`` positions. The wrap-around edge carries
-   exact zeros (masked in forward ⇒ zero gradient), so no special case.
+   each prefix slice ``ppermute``s BACK to its owner (the transpose of
+   the forward shift — the same Send/Recv duality the reference
+   hand-built in ``functions/point_to_point_communication.py`` (dagger))
+   and adds into the owner's last ``c_d`` positions. Wrap-around edges
+   carry exact zeros (masked in forward ⇒ zero gradient), no special
+   case.
 """
 
 from __future__ import annotations
@@ -52,24 +54,42 @@ from chainermn_tpu.parallel.collectives import shift
 _WRAP_SENTINEL = jnp.iinfo(jnp.int32).min
 
 
+def _tail_slices(tail: int, L: int, n: int):
+    """Static geometry of the multi-neighbour prefix: predecessor ``s-d``
+    (``d = 1..m``) contributes its LAST ``c_d = min(L, tail - (d-1)L)``
+    positions. ``m`` is capped at ``n - 1`` — further reach is before the
+    sequence start (or a full wrap) and simply doesn't exist. Returns
+    ``[(d, c_d), ...]`` ordered FURTHEST-first (prefix concat order)."""
+    m = min(-(-tail // L), n - 1)
+    # Every c_d >= 1 by construction: d <= ceil(tail/L) ⇒ tail-(d-1)L >= 1.
+    return [(d, min(L, tail - (d - 1) * L)) for d in range(m, 0, -1)]
+
+
 def _ext_and_segs(k, v, seg_q_ids, axis_name, tail):
-    """Build the extended K/V (previous shard's tail prepended) and the
-    segment ids that (a) mask shard 0's wrap-around tail and (b) carry
-    any user packed-segment ids across the boundary (all-zero ids when
-    the caller has no packed segments). ONE bundled ``ppermute`` moves
-    k/v/ids together (a single ICI exchange)."""
+    """Build the extended K/V (predecessors' tails prepended, furthest
+    first) and the segment ids that (a) mask wrap-around slices — shard
+    ``s`` receives garbage from ``s - d`` whenever ``s < d`` — and (b)
+    carry any user packed-segment ids across the boundaries (all-zero
+    ids when the caller has no packed segments). One bundled ``ppermute``
+    per neighbour distance moves k/v/ids together."""
     L = k.shape[1]
-    k_tail, v_tail, tail_ids = shift(
-        (k[:, L - tail:], v[:, L - tail:], seg_q_ids[:, L - tail:]),
-        axis_name, 1,
-    )
-    k_ext = jnp.concatenate([k_tail, k], axis=1)
-    v_ext = jnp.concatenate([v_tail, v], axis=1)
-    first = lax.axis_index(axis_name) == 0
-    tail_ids = jnp.where(
-        first, jnp.full_like(tail_ids, _WRAP_SENTINEL), tail_ids
-    )
-    seg_k_ids = jnp.concatenate([tail_ids, seg_q_ids], axis=1)
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    k_parts, v_parts, id_parts = [], [], []
+    for d, c in _tail_slices(tail, L, n):
+        k_t, v_t, ids_t = shift(
+            (k[:, L - c:], v[:, L - c:], seg_q_ids[:, L - c:]),
+            axis_name, d,
+        )
+        ids_t = jnp.where(
+            me >= d, ids_t, jnp.full_like(ids_t, _WRAP_SENTINEL)
+        )
+        k_parts.append(k_t)
+        v_parts.append(v_t)
+        id_parts.append(ids_t)
+    k_ext = jnp.concatenate(k_parts + [k], axis=1)
+    v_ext = jnp.concatenate(v_parts + [v], axis=1)
+    seg_k_ids = jnp.concatenate(id_parts + [seg_q_ids], axis=1)
     return k_ext, v_ext, seg_q_ids, seg_k_ids
 
 
@@ -79,9 +99,13 @@ def _local_fwd_impl(q, k, v, seg, axis_name, window, scale, block_q,
     k_ext, v_ext, seg_q_ids, seg_k_ids = _ext_and_segs(
         k, v, seg, axis_name, tail
     )
+    # The realized prefix may be SHORTER than tail when the window
+    # reaches past the sequence start (slices are capped at n-1
+    # predecessors): q_offset is the true prefix length.
+    prefix = k_ext.shape[1] - k.shape[1]
     out, lse = flash_block_fwd(
         q, k_ext, v_ext, causal=True, scale=scale, window=window,
-        q_offset=tail, seg_q=seg_q_ids, seg_kv=seg_k_ids,
+        q_offset=prefix, seg_q=seg_q_ids, seg_kv=seg_k_ids,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return out.astype(q.dtype), lse
@@ -107,30 +131,37 @@ def _local_window_bwd(axis_name, window, scale, block_q, block_k, interpret,
     q, k, v, seg, out, lse = res
     tail = window - 1
     L = q.shape[1]
+    n = lax.axis_size(axis_name)
     # Rebuild the extended K/V (recompute beats storing an overlapping
     # copy — same remat philosophy as the flash backward itself).
     k_ext, v_ext, seg_q_ids, seg_k_ids = _ext_and_segs(
         k, v, seg, axis_name, tail
     )
+    prefix = k_ext.shape[1] - L
     do = g.astype(jnp.float32)
     delta = jnp.sum(
         do * out.astype(jnp.float32), axis=-1
     ).transpose(0, 2, 1)  # [B, H, L]
     dq, dk_ext, dv_ext = flash_block_bwd(
         q, k_ext, v_ext, g, lse, delta, causal=True, scale=scale,
-        window=window, q_offset=tail, seg_q=seg_q_ids, seg_kv=seg_k_ids,
+        window=window, q_offset=prefix, seg_q=seg_q_ids, seg_kv=seg_k_ids,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    # Own-shard part + the tail gradient returned to its owner (adds into
-    # the owner's LAST `tail` positions). Shard 0's tail grads are exact
-    # zeros (its tail was segment-masked), so the wrap-around is inert.
-    dk = dk_ext[:, tail:]
-    dv = dv_ext[:, tail:]
-    dk_back, dv_back = shift(
-        (dk_ext[:, :tail], dv_ext[:, :tail]), axis_name, -1
-    )
-    dk = dk.at[:, L - tail:].add(dk_back)
-    dv = dv.at[:, L - tail:].add(dv_back)
+    # Own-shard part + each prefix slice's gradient returned to its owner
+    # (the transpose of the forward shift-by-d), added into the owner's
+    # last c_d positions. Wrapped slices carry exact zeros (they were
+    # segment-masked in the forward), so no special case.
+    dk = dk_ext[:, prefix:]
+    dv = dv_ext[:, prefix:]
+    off = 0
+    for d, c in _tail_slices(tail, L, n):
+        dk_b, dv_b = shift(
+            (dk_ext[:, off:off + c], dv_ext[:, off:off + c]),
+            axis_name, -d,
+        )
+        dk = dk.at[:, L - c:].add(dk_b)
+        dv = dv.at[:, L - c:].add(dv_b)
+        off += c
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             None)
 
@@ -159,10 +190,10 @@ def sliding_window_attention_local(
         sharded CONTIGUOUSLY over ``axis_name`` (GQA/MQA supported —
         fewer kv heads than q heads).
       window: band width ``W``; global query ``i`` sees keys
-        ``(i - W, i]``. Requires ``W - 1 <= T_local`` (the band spans at
-        most one shard boundary; for wider windows use
-        :func:`~chainermn_tpu.parallel.ring_attention.ring_attention_local`,
-        which covers any reach).
+        ``(i - W, i]``. Any width: the prefix gathers from
+        ``ceil((W-1)/T_local)`` predecessors (capped at the mesh — a
+        window covering the whole sequence degenerates to full causal
+        attention, where the plain ring is the better choice).
       segment_ids: optional local ``[B, T_local]`` packed-segment slice;
         ids travel with the tail so cross-boundary masking stays exact.
         Any int32 value except ``INT32_MIN`` is a valid id (that value is
@@ -174,12 +205,6 @@ def sliding_window_attention_local(
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     L = q.shape[1]
-    if window - 1 > L:
-        raise ValueError(
-            f"window {window} reaches {window - 1} positions back but the "
-            f"local shard holds only {L}; use ring attention for windows "
-            "wider than a shard"
-        )
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:

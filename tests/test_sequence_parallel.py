@@ -512,25 +512,64 @@ class TestSlidingWindowSP:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 
-    def test_window_wider_than_shard_rejected(self, comm):
+    @pytest.mark.parametrize("window", [6, 9, 13])  # m = 2, 2, 3
+    def test_window_wider_than_shard(self, comm, window):
+        """Multi-neighbour prefixes: the band spans several shard
+        boundaries, gathered as one tail slice per predecessor."""
+        q, k, v, out = self._dist(comm, window)
+        ref = self._ref(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_window_covering_whole_sequence_is_full_causal(self, comm):
+        q, k, v, out = self._dist(comm, T + 5)
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        ref = flash_attention(q, k, v, causal=True,
+                              block_q=8, block_k=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_wide_window_grads_match_single_device(self, comm):
         from jax import shard_map
 
         from chainermn_tpu.parallel.local_attention import (
             sliding_window_attention_local,
         )
 
-        q, k, v = _qkv(33)
+        window = 9  # spans 2 shard boundaries at T_local = 4
+        ks = jax.random.split(jax.random.PRNGKey(35), 3)
+        q = jax.random.normal(ks[0], (B, T, H, D))
+        k = jax.random.normal(ks[1], (B, T, H, D))
+        v = jax.random.normal(ks[2], (B, T, H, D))
         ax = comm.axis_name
-        with pytest.raises(ValueError, match="wider than a shard"):
-            jax.jit(
-                shard_map(
-                    lambda q, k, v: sliding_window_attention_local(
-                        q, k, v, ax, window=T, interpret=True
-                    ),
-                    mesh=comm.mesh, in_specs=(P(None, ax),) * 3,
-                    out_specs=P(None, ax), check_vma=False,
+
+        def loss_dist(q, k, v):
+            def local(q, k, v):
+                o = sliding_window_attention_local(
+                    q, k, v, ax, window=window,
+                    block_q=4, block_k=4, interpret=True,
                 )
+                return jax.lax.psum((o.astype(jnp.float32) ** 2).sum(), ax)
+
+            return shard_map(
+                local, mesh=comm.mesh,
+                in_specs=(P(None, ax),) * 3, out_specs=P(),
+                check_vma=False,
             )(q, k, v)
+
+        def loss_ref(q, k, v):
+            o = self._ref(q, k, v, window)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        gd = jax.grad(loss_dist, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            ),
+            gd, gr,
+        )
 
 
 class TestUlyssesWindow:
