@@ -31,6 +31,21 @@ def tiny_lm(**kw):
     return TransformerLM(**cfg)
 
 
+
+def windowed_lm(window, **kw):
+    """Tiny LM with a window-honouring flash attention_fn — shared by the
+    windowed-decode and windowed-beam tests so both exercise the same
+    attention configuration."""
+    from chainermn_tpu.ops.flash_attention import flash_attention
+
+    def attn(q, k, v, *, causal, scale):
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               window=window, block_q=16, block_k=16,
+                               interpret=True)
+
+    return tiny_lm(attention_fn=attn, window=window, **kw)
+
+
 class TestTransformerLM:
     def test_shapes_and_loss(self):
         model = tiny_lm()
@@ -519,14 +534,7 @@ class TestWindowedDecode:
     decode must see the SAME attention band."""
 
     def _windowed_model(self, window):
-        from chainermn_tpu.ops.flash_attention import flash_attention
-
-        def attn(q, k, v, *, causal, scale):
-            return flash_attention(q, k, v, causal=causal, scale=scale,
-                                   window=window, block_q=16, block_k=16,
-                                   interpret=True)
-
-        return tiny_lm(attention_fn=attn, window=window)
+        return windowed_lm(window)
 
     def test_windowed_decode_matches_windowed_forward(self):
         from chainermn_tpu.models.transformer import init_cache
@@ -924,3 +932,27 @@ class TestSamplingFilters:
         with pytest.raises(ValueError, match="top_k must be"):
             generate(model, params, prompt, 6, temperature=1.0,
                      top_k=VOCAB + 1, rng=key)
+
+
+class TestWindowedBeam:
+    def test_beam1_on_windowed_model_equals_windowed_greedy(self):
+        """Beam decoding shares _decode_attend, so the window band must
+        apply identically: K=1 beam == greedy on a windowed model, and
+        both reflect the banded distribution (scores equal the windowed
+        full forward's log-probs)."""
+        from chainermn_tpu.models.transformer import beam_search, generate
+
+        model = windowed_lm(4)
+        B, P, N = 1, 3, 9
+        prompt = jax.random.randint(jax.random.PRNGKey(90), (B, P), 1, VOCAB)
+        params = model.init(jax.random.PRNGKey(91), prompt, train=False)
+        g = generate(model, params, prompt, N)
+        beams, scores = beam_search(model, params, prompt, N, beam_size=1)
+        np.testing.assert_array_equal(np.asarray(beams[:, 0]), np.asarray(g))
+        # score == sum of the WINDOWED model's log-probs for the sequence
+        logits = model.apply(params, beams[0, 0][None], train=False)[0]
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        idx = jnp.arange(P, N)
+        expected = float(jnp.sum(lp[idx - 1, beams[0, 0][idx]]))
+        np.testing.assert_allclose(float(scores[0, 0]), expected,
+                                   rtol=1e-4, atol=1e-4)
