@@ -112,6 +112,14 @@ class ResNet(nn.Module):
     #: that actually gate throughput (SURVEY.md env note: "use
     #: jax.checkpoint/remat to trade FLOPs for memory").
     remat: bool = False
+    #: remat save policy (only with ``remat=True``): ``None`` — save
+    #: nothing (full recompute; measured r2: LOSES throughput, 57->66 ms,
+    #: XLA re-reads block inputs more than it saves); ``'conv'`` — save
+    #: conv/matmul outputs, recompute only the cheap elementwise BN
+    #: normalize + relu chain: the bytes of 2 of every 3 saved tensors
+    #: disappear while the recompute is VPU-trivial — the fine-grained
+    #: point the whole-block policy overshoots.
+    remat_policy: Optional[str] = None
     #: ``'standard'`` — the classic 7x7/s2 conv + 3x3 maxpool;
     #: ``'space_to_depth'`` — rearrange 4x4 pixel blocks into 48 channels and
     #: run a 3x3/s1 conv (the MLPerf-era TPU stem): a 3-channel conv wastes
@@ -155,7 +163,21 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         if self.stem == "standard":
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
-        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
+        if self.remat_policy not in (None, "conv"):
+            raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
+        if self.remat_policy is not None and not self.remat:
+            raise ValueError("remat_policy requires remat=True")
+        if self.remat:
+            if self.remat_policy == "conv":
+                def _save_conv(prim, *_, **__):
+                    return prim.name in ("conv_general_dilated",
+                                         "dot_general")
+
+                block_cls = nn.remat(self.block_cls, policy=_save_conv)
+            else:
+                block_cls = nn.remat(self.block_cls)
+        else:
+            block_cls = self.block_cls
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)

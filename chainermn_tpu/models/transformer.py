@@ -401,7 +401,16 @@ def _decode_setup(model: TransformerLM, params, prompt, n_steps, pad_id):
             f"max_len={model.max_len}"
         )
     B, P = prompt.shape
-    prompt_len = jnp.sum((prompt != pad_id).astype(jnp.int32), axis=1)
+    # True length = index of the FIRST pad (rows without pad span all of
+    # P): the right-padding convention. Tokens after a mid-row pad_id are
+    # ignored — counting non-pad tokens instead would silently misalign
+    # teacher forcing for such rows, which is worse than truncating.
+    is_pad = prompt == pad_id
+    prompt_len = jnp.where(
+        jnp.any(is_pad, axis=1),
+        jnp.argmax(is_pad, axis=1).astype(jnp.int32),
+        jnp.int32(P),
+    )
     padded = jnp.pad(prompt, ((0, 0), (0, max(0, n_steps - P))),
                      constant_values=pad_id)
     return B, P, prompt_len, padded
@@ -443,6 +452,15 @@ def _filter_logits(logits, top_k, top_p):
     return jnp.where(logits < thresh, -jnp.inf, logits)
 
 
+def _tempered_filtered(logits, temperature, top_k, top_p):
+    """Sampling logits: temperature FIRST, then top-k/top-p (the HF
+    convention — the nucleus is selected from the temperature-adjusted
+    distribution, so top_p values tuned elsewhere transfer; under
+    filter-then-temperature the survivor set would be temperature
+    -independent)."""
+    return _filter_logits(logits / temperature, top_k, top_p)
+
+
 def generate(model: TransformerLM, params, prompt, n_steps: int, *,
              temperature: float = 0.0, rng=None, pad_id: int = 0,
              top_k: Optional[int] = None, top_p: Optional[float] = None):
@@ -469,8 +487,9 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
       top_k: sample only among the k highest-probability tokens.
       top_p: nucleus sampling — restrict to the smallest token set whose
         probability mass reaches ``top_p``. Composes with ``top_k``
-        (intersection) and applies before the temperature division.
-        Both require ``temperature > 0``.
+        (intersection) and is computed AFTER the temperature division
+        (the HF convention, so tuned values transfer). Both require
+        ``temperature > 0``.
       pad_id: padding token in ``prompt``; positions where every shorter
         row has run out of prompt switch to model continuations.
 
@@ -508,8 +527,10 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
         logits = logits[:, 0]  # [B, vocab]
         key, sub = jax.random.split(key)
         if temperature > 0.0:
-            logits = _filter_logits(logits, top_k, top_p)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            nxt = jax.random.categorical(
+                sub, _tempered_filtered(logits, temperature, top_k, top_p),
+                axis=-1,
+            )
         else:
             nxt = jnp.argmax(logits, axis=-1)
         return (mutated["cache"], nxt.astype(prompt.dtype), key), tok

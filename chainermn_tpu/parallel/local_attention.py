@@ -93,6 +93,29 @@ def _ext_and_segs(k, v, seg_q_ids, axis_name, tail):
     return k_ext, v_ext, seg_q_ids, seg_k_ids
 
 
+def _pad_ext_to_block(k_ext, v_ext, seg_k_ids, block_k):
+    """Round the extended K axis up to a multiple of the effective K
+    block. The extended length ``T_local + prefix`` is odd whenever the
+    window is even (the common case) — without padding no power-of-two
+    block divides it, ``_pick_block`` collapses to one whole-T block and
+    the banded grid degenerates to O(T + W) DMA per query block (and a
+    potentially VMEM-busting single K/V block). Back-padding is inert:
+    pad positions exceed every query's extended position, so the causal
+    mask kills them; the wrap sentinel in the segment ids is
+    belt-and-braces."""
+    T = k_ext.shape[1]
+    b = min(block_k, T)
+    pad = -T % b
+    if pad:
+        widths = [(0, 0)] * k_ext.ndim
+        widths[1] = (0, pad)
+        k_ext = jnp.pad(k_ext, widths)
+        v_ext = jnp.pad(v_ext, widths)
+        seg_k_ids = jnp.pad(seg_k_ids, ((0, 0), (0, pad)),
+                            constant_values=_WRAP_SENTINEL)
+    return k_ext, v_ext, seg_k_ids
+
+
 def _local_fwd_impl(q, k, v, seg, axis_name, window, scale, block_q,
                     block_k, interpret):
     tail = window - 1
@@ -101,8 +124,12 @@ def _local_fwd_impl(q, k, v, seg, axis_name, window, scale, block_q,
     )
     # The realized prefix may be SHORTER than tail when the window
     # reaches past the sequence start (slices are capped at n-1
-    # predecessors): q_offset is the true prefix length.
+    # predecessors): q_offset is the true prefix length. Computed BEFORE
+    # tile padding (the pad goes on the back; the prefix is the front).
     prefix = k_ext.shape[1] - k.shape[1]
+    k_ext, v_ext, seg_k_ids = _pad_ext_to_block(
+        k_ext, v_ext, seg_k_ids, block_k
+    )
     out, lse = flash_block_fwd(
         q, k_ext, v_ext, causal=True, scale=scale, window=window,
         q_offset=prefix, seg_q=seg_q_ids, seg_kv=seg_k_ids,
@@ -138,6 +165,9 @@ def _local_window_bwd(axis_name, window, scale, block_q, block_k, interpret,
         k, v, seg, axis_name, tail
     )
     prefix = k_ext.shape[1] - L
+    k_ext, v_ext, seg_k_ids = _pad_ext_to_block(
+        k_ext, v_ext, seg_k_ids, block_k
+    )
     do = g.astype(jnp.float32)
     delta = jnp.sum(
         do * out.astype(jnp.float32), axis=-1
@@ -150,9 +180,10 @@ def _local_window_bwd(axis_name, window, scale, block_q, block_k, interpret,
     # Own-shard part + each prefix slice's gradient returned to its owner
     # (the transpose of the forward shift-by-d), added into the owner's
     # last c_d positions. Wrapped slices carry exact zeros (they were
-    # segment-masked in the forward), so no special case.
-    dk = dk_ext[:, prefix:]
-    dv = dv_ext[:, prefix:]
+    # segment-masked in the forward), so no special case. Tile padding
+    # (fully masked, zero grad) is simply dropped.
+    dk = dk_ext[:, prefix:prefix + L]
+    dv = dv_ext[:, prefix:prefix + L]
     off = 0
     for d, c in _tail_slices(tail, L, n):
         dk_b, dv_b = shift(

@@ -167,10 +167,163 @@ def seeded_batch(shape, seed: int = 0, *, scale: float = 1.0):
     return (rng.randn(*shape) * scale).astype(np.float32)
 
 
+# ---------------------------------------------------------------------------
+# Structural dependency analysis (the "measured, not asserted" convention
+# for claims about communication — CLAUDE.md / the ppermute-count tests).
+# ---------------------------------------------------------------------------
+
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "psum_scatter", "pmin", "pmax", "all_gather", "all_to_all",
+    "ppermute", "pbroadcast", "reduce_scatter",
+})
+
+
+def _subjaxprs(params):
+    """(name, jaxpr-or-closed) pairs found in an eqn's params."""
+    from jax.extend import core as jex_core
+
+    out = []
+    for k, v in params.items():
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        for item in vs:
+            if isinstance(item, jex_core.ClosedJaxpr):
+                out.append((k, item.jaxpr))
+            elif isinstance(item, jex_core.Jaxpr):
+                out.append((k, item))
+    return out
+
+
+def _taint_jaxpr(jaxpr, in_taint, targets):
+    """Forward taint propagation: which jaxpr outputs data-depend on any
+    primitive named in ``targets``. Precise through pjit/scan/cond/while/
+    custom-AD calls; conservative (taint-all when any input is tainted OR
+    a target exists inside) for anything unrecognised."""
+    from jax.extend import core as jex_core
+
+    Literal = jex_core.Literal
+    env = {}
+
+    def read(v):
+        return False if isinstance(v, Literal) else env.get(v, False)
+
+    def contains_target(j):
+        for eqn in j.eqns:
+            if eqn.primitive.name in targets:
+                return True
+            for _, sub in _subjaxprs(eqn.params):
+                if contains_target(sub):
+                    return True
+        return False
+
+    for v, t in zip(jaxpr.invars, in_taint):
+        env[v] = t
+    for v in jaxpr.constvars:
+        env[v] = False
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        ins = [read(v) for v in eqn.invars]
+        subs = _subjaxprs(eqn.params)
+        if name in targets:
+            outs = [True] * len(eqn.outvars)
+        elif name == "scan":
+            body = subs[0][1]
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            consts, carry = ins[:nc], ins[nc:nc + ncar]
+            xs = ins[nc + ncar:]
+            for _ in range(len(carry) + 1):  # carry fixpoint
+                body_out = _taint_jaxpr(body, consts + carry + xs, targets)
+                new_carry = [a or b for a, b in
+                             zip(carry, body_out[:ncar])]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            outs = carry + body_out[ncar:]
+        elif name == "while":
+            sub_map = dict(subs)
+            body = sub_map["body_jaxpr"]
+            cond_j = sub_map["cond_jaxpr"]
+            nb = eqn.params["body_nconsts"]
+            ncc = eqn.params["cond_nconsts"]
+            cconsts, bconsts = ins[:ncc], ins[ncc:ncc + nb]
+            carry = ins[ncc + nb:]
+            for _ in range(len(carry) + 1):
+                body_out = _taint_jaxpr(body, bconsts + carry, targets)
+                new_carry = [a or b for a, b in zip(carry, body_out)]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            # Control dependency: a collective-derived loop PREDICATE
+            # decided how many iterations shaped every carry value.
+            if any(_taint_jaxpr(cond_j, cconsts + carry, targets)):
+                carry = [True] * len(carry)
+            outs = carry
+        elif name == "cond":
+            branches = [s for k, s in subs if k == "branches"]
+            per = [_taint_jaxpr(b, ins[1:], targets) for b in branches]
+            outs = [any(col) for col in zip(*per)] if per else []
+            # Control dependency: a collective-derived predicate SELECTS
+            # the output — every output inherits its taint.
+            if ins and ins[0]:
+                outs = [True] * len(outs)
+        elif subs and len(subs) == 1 and (
+            len(subs[0][1].invars) == len(eqn.invars)
+            and len(subs[0][1].outvars) == len(eqn.outvars)
+        ):
+            # pjit / remat / closed_call / custom_*_call with a 1:1
+            # operand mapping: recurse precisely.
+            outs = _taint_jaxpr(subs[0][1], ins, targets)
+        elif subs:
+            t = any(ins) or any(contains_target(s) for _, s in subs)
+            outs = [t] * len(eqn.outvars)
+        else:
+            t = any(ins)
+            outs = [t] * len(eqn.outvars)
+        for v, t in zip(eqn.outvars, outs):
+            env[v] = t
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def collective_taint(fn, *args, targets=COLLECTIVE_PRIMITIVES, axis_env=()):
+    """Trace ``fn(*args)`` and report, per output leaf, whether it
+    DATA-DEPENDS on any collective primitive in ``targets`` — e.g. to
+    certify that a double-buffered optimizer's parameter update is
+    independent of the SAME step's gradient allreduce (the precondition
+    for overlapping the collective with compute; the reference bought
+    this with a side CUDA stream, ``optimizers.py`` † — here it is a
+    property of the dependency graph that XLA's async scheduler can
+    exploit).
+
+    Args:
+      axis_env: ``[(axis_name, size), ...]`` for tracing named-axis
+        collectives outside shard_map.
+
+    Returns:
+      A pytree of bools matching ``fn``'s output structure.
+    """
+    import jax
+
+    closed, shape_tree = jax.make_jaxpr(
+        fn, axis_env=list(axis_env), return_shape=True
+    )(*args)
+    flat_taint = _taint_jaxpr(
+        closed.jaxpr, [False] * len(closed.jaxpr.invars), set(targets)
+    )
+    leaves, treedef = jax.tree.flatten(
+        shape_tree, is_leaf=lambda x: x is None
+    )
+    assert len(leaves) == len(flat_taint), (len(leaves), len(flat_taint))
+    return jax.tree.unflatten(treedef, flat_taint)
+
+
 __all__ = [
     "ensure_virtual_devices",
     "make_test_communicator",
     "assert_allclose_tree",
     "assert_distributed_equals_single",
     "seeded_batch",
+    "collective_taint",
+    "COLLECTIVE_PRIMITIVES",
 ]

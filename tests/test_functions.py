@@ -252,3 +252,114 @@ def test_gather_scatter_roundtrip_gradient(comm):
     val, g = _grad_smap(comm, scalar, jnp.asarray(x))
     assert float(np.asarray(val)[0]) == pytest.approx(2.0 * x.sum(), rel=1e-5)
     np.testing.assert_allclose(np.asarray(g), np.full((N, 1), 2.0), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# collectives: PER-collective backward vs single-device autodiff (round-4
+# VERDICT item 10). The reference numerically gradient-checked each
+# collective Function's hand-written transpose (SURVEY.md section 4,
+# ``test_collective_communication.py`` (dagger)); here the transposes are
+# inherited from JAX AD, so each is pinned against the gradient of the
+# SAME loss written densely on the stacked array — autodiff vs autodiff,
+# no hand-derived expectations.
+# ---------------------------------------------------------------------------
+
+
+def _dist_vs_dense_grad(comm, dist_scalar, dense_loss, x):
+    """Gradient of sum-over-shards dist_scalar vs jax.grad of the dense
+    single-device formulation of the same loss on the stacked array."""
+    val, g = _grad_smap(comm, dist_scalar, jnp.asarray(x))
+    dense_val, dense_g = jax.value_and_grad(dense_loss)(jnp.asarray(x))
+    assert float(np.asarray(val)[0]) == pytest.approx(
+        float(dense_val), rel=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(g), np.asarray(dense_g),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_allgather_backward_vs_dense_autodiff(comm):
+    rng = np.random.RandomState(10)
+    x = rng.randn(N, 3).astype(np.float32)
+    W = jnp.asarray(rng.randn(N, N, 3).astype(np.float32))  # per-shard wts
+
+    def dist(v):  # shard s: loss_s = sum(allgather(x) * W[s])
+        full = allgather(v, AX)
+        idx = jax.lax.axis_index(AX)
+        return jnp.sum(full * jax.lax.dynamic_index_in_dim(
+            W, idx, 0, keepdims=False))
+
+    def dense(xs):
+        return sum(jnp.sum(xs * W[s]) for s in range(N))
+
+    _dist_vs_dense_grad(comm, dist, dense, x)
+
+
+def test_bcast_backward_vs_dense_autodiff(comm):
+    rng = np.random.RandomState(11)
+    x = rng.randn(N, 2).astype(np.float32)
+    W = jnp.asarray(rng.randn(N, 2).astype(np.float32))
+    root = 1
+
+    def dist(v):  # shard s: loss_s = sum(bcast(x) * W[s])
+        y = bcast(v, AX, root=root)
+        idx = jax.lax.axis_index(AX)
+        return jnp.sum(y * jax.lax.dynamic_index_in_dim(
+            W, idx, 0, keepdims=False))
+
+    def dense(xs):
+        return sum(jnp.sum(xs[root] * W[s]) for s in range(N))
+
+    _dist_vs_dense_grad(comm, dist, dense, x)
+
+
+def test_gather_backward_vs_dense_autodiff(comm):
+    rng = np.random.RandomState(12)
+    x = rng.randn(N, 1).astype(np.float32)
+    W = jnp.asarray(rng.randn(N, 1).astype(np.float32))
+    root = 2
+
+    def dist(v):  # gather -> [N, 1] on root, zeros elsewhere
+        full = gather(v, AX, root=root)
+        return jnp.sum(full * W)
+
+    def dense(xs):  # only the root's copy carries the loss
+        return jnp.sum(xs * W)
+
+    _dist_vs_dense_grad(comm, dist, dense, x)
+
+
+def test_scatter_backward_vs_dense_autodiff(comm):
+    rng = np.random.RandomState(13)
+    # Every shard holds an [N, 1] buffer; scatter uses only the root's.
+    x = rng.randn(N, N, 1).astype(np.float32)
+    W = jnp.asarray(rng.randn(N, 1).astype(np.float32))
+    root = 3
+
+    def dist(v):  # shard s receives root's row s
+        mine = scatter(v, AX, root=root)
+        idx = jax.lax.axis_index(AX)
+        return jnp.sum(mine * jax.lax.dynamic_index_in_dim(
+            W, idx, 0, keepdims=False))
+
+    def dense(xs):
+        return sum(jnp.sum(xs[root, s] * W[s]) for s in range(N))
+
+    _dist_vs_dense_grad(comm, dist, dense, x)
+
+
+def test_allreduce_backward_vs_dense_autodiff(comm):
+    rng = np.random.RandomState(14)
+    x = rng.randn(N, 2).astype(np.float32)
+    W = jnp.asarray(rng.randn(N, 2).astype(np.float32))
+
+    def dist(v):
+        y = allreduce(v, AX)
+        idx = jax.lax.axis_index(AX)
+        return jnp.sum(y * jax.lax.dynamic_index_in_dim(
+            W, idx, 0, keepdims=False))
+
+    def dense(xs):
+        total = jnp.sum(xs, axis=0)
+        return sum(jnp.sum(total * W[s]) for s in range(N))
+
+    _dist_vs_dense_grad(comm, dist, dense, x)

@@ -898,6 +898,35 @@ class TestSamplingFilters:
         outall = np.asarray(_filter_logits(logits, None, 1.0))
         assert np.all(np.isfinite(outall))
 
+    def test_temperature_applies_before_nucleus(self):
+        """Round-4 ADVICE: the nucleus must be selected from the
+        temperature-adjusted distribution (HF order). A hot temperature
+        flattens the distribution, so MORE tokens survive a fixed top_p;
+        under the wrong (filter-then-temperature) order the survivor set
+        would be temperature-independent."""
+        from chainermn_tpu.models.transformer import _tempered_filtered
+
+        logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+        cold = np.asarray(_tempered_filtered(logits, 1.0, None, 0.7))
+        hot = np.asarray(_tempered_filtered(logits, 4.0, None, 0.7))
+        assert np.isfinite(cold).sum() == 2  # probs .64/.24: keep 2
+        assert np.isfinite(hot).sum() == 3   # flattened: keep 3
+
+    def test_prompt_len_is_prefix_before_first_pad(self):
+        """Round-4 ADVICE: a vocabulary token EQUAL to pad_id mid-prompt
+        must not inflate the teacher-forcing length — the true length is
+        the index of the FIRST pad."""
+        from chainermn_tpu.models.transformer import _decode_setup
+
+        model = tiny_lm()
+        prompt = jnp.asarray([
+            [5, 0, 7, 0],   # first pad at 1 (7 is unreachable junk)
+            [5, 3, 7, 2],   # no pad: full length 4
+            [5, 3, 0, 0],   # ordinary right-padding: 2
+        ], jnp.int32)
+        _, _, plen, _ = _decode_setup(model, None, prompt, 6, 0)
+        np.testing.assert_array_equal(np.asarray(plen), [1, 4, 2])
+
     def test_generate_with_filters_runs_and_validates(self):
         from chainermn_tpu.models.transformer import generate
 

@@ -612,6 +612,43 @@ class TestSlidingWindowSP:
             assert count_ppermutes(window) == 3 * m, (window, m)
             assert count_ppermutes(window, grad=True) == 8 * m, (window, m)
 
+    def test_even_window_keeps_banded_grid(self):
+        """Regression (round-4 ADVICE): an EVEN window makes the extended
+        K length T_local + W - 1 odd, which no power-of-two block divides
+        — without tile padding ``_pick_block`` collapses to one whole-T
+        K/V block (nk = 1), reverting the banded grid to O(T + W) DMA per
+        query block and risking a VMEM-busting single block at long
+        context. ``_pad_ext_to_block`` must restore an exact multiple of
+        the requested block at realistic sizes."""
+        from chainermn_tpu.ops.flash_attention import _pick_block
+        from chainermn_tpu.parallel.local_attention import (
+            _pad_ext_to_block,
+        )
+
+        for T_local, window, block_k in (
+            (4096, 2048, 1024),   # the common even-window case
+            (8192, 4096, 1024),
+            (2048, 2048, 512),    # prefix == T_local - ... still odd ext
+            (4096, 1000, 1024),   # non-power-of-two window
+        ):
+            prefix = window - 1
+            T_ext = T_local + prefix
+            # Demonstrate the degenerate case first: without padding,
+            # _pick_block can only fall back to ONE whole-T block here.
+            assert _pick_block(block_k, T_ext) == T_ext, (T_local, window)
+            k = jnp.zeros((1, T_ext, 1, 8))
+            seg = jnp.zeros((1, T_ext), jnp.int32)
+            k_p, v_p, seg_p = _pad_ext_to_block(k, k, seg, block_k)
+            T_pad = k_p.shape[1]
+            b = _pick_block(block_k, T_pad)
+            assert b == block_k, (T_local, window, T_pad, b)
+            assert T_pad - T_ext < block_k  # pad is bounded by one block
+            assert v_p.shape[1] == T_pad and seg_p.shape[1] == T_pad
+            # The pad slots carry the wrap sentinel (belt-and-braces on
+            # top of the causal mask).
+            if T_pad > T_ext:
+                assert int(seg_p[0, -1]) == jnp.iinfo(jnp.int32).min
+
 
 class TestUlyssesWindow:
     def test_ulysses_window_matches_single_device(self, comm):
