@@ -26,6 +26,12 @@ from chainermn_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
+from chainermn_tpu.models.detection import (
+    TinyDetector,
+    TwoStageDetector,
+    detection_loss,
+    two_stage_loss,
+)
 
 __all__ = [
     "MLP",
@@ -47,4 +53,8 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ResNet152",
+    "TinyDetector",
+    "TwoStageDetector",
+    "detection_loss",
+    "two_stage_loss",
 ]

@@ -318,6 +318,29 @@ def collective_taint(fn, *args, targets=COLLECTIVE_PRIMITIVES, axis_env=()):
     return jax.tree.unflatten(treedef, flat_taint)
 
 
+def count_primitives(fn, *args, axis_env=()):
+    """Count primitive occurrences in the traced jaxpr of ``fn(*args)``,
+    recursing into subjaxprs (pjit/scan/cond/...). The tool behind the
+    structural collective-count tests (the ppermute-count convention:
+    claims about communication are measured on the program, not asserted
+    in prose). Returns ``{primitive_name: count}``."""
+    import collections
+
+    import jax
+
+    closed = jax.make_jaxpr(fn, axis_env=list(axis_env))(*args)
+    counts: collections.Counter = collections.Counter()
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            counts[eqn.primitive.name] += 1
+            for _, sub in _subjaxprs(eqn.params):
+                walk(sub)
+
+    walk(closed.jaxpr)
+    return dict(counts)
+
+
 __all__ = [
     "ensure_virtual_devices",
     "make_test_communicator",
@@ -325,5 +348,6 @@ __all__ = [
     "assert_distributed_equals_single",
     "seeded_batch",
     "collective_taint",
+    "count_primitives",
     "COLLECTIVE_PRIMITIVES",
 ]

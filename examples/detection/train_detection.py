@@ -27,15 +27,20 @@ sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
 
 import chainermn_tpu
 from chainermn_tpu import global_except_hook
-from chainermn_tpu.models.detection import TinyDetector, detection_loss
+from chainermn_tpu.models.detection import (
+    TinyDetector,
+    TwoStageDetector,
+    detection_loss,
+    two_stage_loss,
+)
 
 #: (H, W) bucket ladder — multiples of 32 (backbone stride x2 safety)
 SHAPE_BUCKETS = ((256, 256), (256, 320), (320, 256), (320, 320))
 MAX_BOXES = 8
 
 
-def synthetic_batch(rng, batch, hw):
-    """Images + padded boxes for one shape bucket."""
+def synthetic_batch(rng, batch, hw, with_labels=False):
+    """Images + padded boxes (+ class labels) for one shape bucket."""
     H, W = hw
     images = rng.randn(batch, H, W, 3).astype(np.float32)
     n = rng.randint(1, MAX_BOXES + 1, size=batch)
@@ -49,6 +54,9 @@ def synthetic_batch(rng, batch, hw):
             w = rng.uniform(32, min(160, W - x0))
             boxes[i, j] = (y0, x0, y0 + h, x0 + w)
             mask[i, j] = 1.0
+    if with_labels:
+        labels = rng.randint(0, 7, size=(batch, MAX_BOXES)).astype(np.int32)
+        return images, boxes, mask, labels
     return images, boxes, mask
 
 
@@ -60,6 +68,9 @@ def main(argv=None):
     p.add_argument("--batchsize", type=int, default=8)
     p.add_argument("--iterations", type=int, default=24)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--two-stage", action="store_true",
+                   help="Faster-RCNN-style RPN -> static top-K proposals "
+                        "-> RoI-align -> per-RoI class+box head")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -67,7 +78,7 @@ def main(argv=None):
     if comm.rank == 0:
         print(f"communicator: {comm}")
 
-    model = TinyDetector()
+    model = TwoStageDetector() if args.two_stage else TinyDetector()
     optimizer = chainermn_tpu.create_multi_node_optimizer(
         optax.adam(args.lr), comm
     )
@@ -78,9 +89,16 @@ def main(argv=None):
 
     def build_step():
         def local_step(params, opt_state, batch):
-            images, boxes, mask = batch
+            if args.two_stage:
+                images, boxes, mask, labels = batch
+            else:
+                images, boxes, mask = batch
 
             def loss_fn(p):
+                if args.two_stage:
+                    return two_stage_loss(
+                        model.apply(p, images), boxes, mask, labels
+                    )
                 obj, deltas = model.apply(p, images)
                 return detection_loss(obj, deltas, boxes, mask)
 
@@ -111,7 +129,9 @@ def main(argv=None):
 
     for it in range(args.iterations):
         hw = SHAPE_BUCKETS[it % len(SHAPE_BUCKETS)]
-        images, boxes, mask = synthetic_batch(rng, args.batchsize, hw)
+        batch = synthetic_batch(rng, args.batchsize, hw,
+                                with_labels=args.two_stage)
+        images = batch[0]
         if params is None:
             params = model.init(jax.random.key(0), jnp.asarray(images[:1]))
             params = comm.bcast_data(params)
@@ -121,8 +141,7 @@ def main(argv=None):
             if comm.rank == 0:
                 print(f"  compiling shape bucket {hw}")
         params, opt_state, loss = step(
-            params, opt_state,
-            (jnp.asarray(images), jnp.asarray(boxes), jnp.asarray(mask)),
+            params, opt_state, tuple(jnp.asarray(a) for a in batch),
         )
         if comm.rank == 0 and (it + 1) % 8 == 0:
             print(f"iter {it + 1}/{args.iterations} loss={float(loss):.4f}")

@@ -294,6 +294,65 @@ class TestTwoDimensional:
             )
             assert packed[k].dtype == base[k].dtype, k
 
+    def test_collective_pipeline_structure(self):
+        """Structural certificate (round-4 VERDICT item 6, the
+        ppermute-count convention): the two_dimensional reduction must
+        trace to EXACTLY one intra psum_scatter -> one inter psum -> one
+        intra all_gather per bucket — and to the expected bucket count
+        for a given tree (~64 MB buckets, per-dtype groups). Traced
+        abstractly, so the >64 MB case costs no memory."""
+        from jax.sharding import Mesh
+
+        from chainermn_tpu.communicators.xla_communicator import (
+            TwoDimensionalCommunicator,
+        )
+        from chainermn_tpu.testing import count_primitives
+
+        devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("inter", "intra"))
+        comm = TwoDimensionalCommunicator(mesh=mesh)
+        env = [("inter", 2), ("intra", 4)]
+
+        def counts_for(tree, compress=None):
+            return count_primitives(
+                lambda t: comm.reduce_gradients_in_jit(
+                    t, compress_dtype=compress
+                ),
+                tree, axis_env=env,
+            )
+
+        # Three small f32 leaves -> ONE bucket -> one pipeline.
+        small = {
+            "w": jnp.zeros((3, 7)), "b": jnp.zeros((5,)),
+            "s": jnp.zeros(()),
+        }
+        c = counts_for(small)
+        # lax.psum_scatter traces to the reduce_scatter primitive.
+        assert c.get("reduce_scatter") == 1, c
+        assert c.get("psum") == 1, c
+        assert c.get("all_gather") == 1, c
+
+        # Two dtype groups (bf16-compressed floats + int pass-through):
+        # ints keep their dtype, forming a second group/pipeline.
+        mixed = {
+            "w": jnp.zeros((3, 7)),
+            "n": jnp.zeros((4,), jnp.int32),
+        }
+        c = counts_for(mixed, compress=jnp.bfloat16)
+        assert c.get("reduce_scatter") == 2, c
+        assert c.get("psum") == 2, c
+        assert c.get("all_gather") == 2, c
+
+        # 3 x 48 MB f32 leaves: greedy ~64 MB packing puts each leaf in
+        # its own bucket (48+48 > 64) -> exactly 3 pipelines. Abstract
+        # ShapeDtypeStruct args keep the trace allocation-free.
+        big = {f"p{i}": jax.ShapeDtypeStruct((12 << 20,), jnp.float32)
+               for i in range(3)}
+        c = counts_for(big)
+        assert c.get("reduce_scatter") == 3, c
+        assert c.get("psum") == 3, c
+        assert c.get("all_gather") == 3, c
+
     def test_train_step_matches_xla_communicator(self):
         import optax
 
