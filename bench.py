@@ -1309,7 +1309,7 @@ def _bench_allreduce_curve(comm, on_accel: bool):
                 "mib": round(n_elems * jnp.dtype(dtype).itemsize / 2**20,
                              3),
                 "dtype": jnp.dtype(dtype).name, "mode": mode_,
-                "error": f"{type(e).__name__}"[:80],
+                "error": f"{type(e).__name__}: {e}"[:160],
             })
             continue
         nbytes = n_elems * jnp.dtype(dtype).itemsize

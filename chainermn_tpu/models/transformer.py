@@ -213,6 +213,12 @@ class TransformerLM(nn.Module):
     #: for fitting larger B*T (SURVEY.md "use jax.checkpoint to trade FLOPs
     #: for memory").
     remat: bool = False
+    #: remat save policy (with ``remat=True``): ``'dots'`` — keep matmul
+    #: outputs, recompute elementwise/norm chains (the default; cheapest
+    #: recompute); ``'nothing'`` — save only block inputs, recompute
+    #: everything (max memory saving, ~1/3 extra FLOPs: the knob the MFU
+    #: sweep explores for HBM-bound configs).
+    remat_policy: str = "dots"
     #: skip the weight-tied LM head and return the final (post-LN) hidden
     #: states; pair with :func:`lm_loss_fused` to avoid materializing the
     #:  ``[B, T, vocab]`` logits tensor.
@@ -281,9 +287,20 @@ class TransformerLM(nn.Module):
             x = x + pos[None].astype(self.compute_dtype)
         block_cls = TransformerBlock
         if self.remat:
+            if self.remat_policy == "dots":
+                policy = (
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                )
+            elif self.remat_policy == "nothing":
+                policy = None  # jax.checkpoint default: save nothing
+            else:
+                raise ValueError(
+                    f"remat_policy must be 'dots' or 'nothing', got "
+                    f"{self.remat_policy!r}"
+                )
             block_cls = nn.remat(
                 TransformerBlock,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                policy=policy,
                 static_argnums=(4, 5),  # (self, x, seg, rope_pos, train, dec)
             )
         for i in range(self.num_layers):

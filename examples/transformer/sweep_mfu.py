@@ -40,8 +40,8 @@ from chainermn_tpu.models import TransformerLM, lm_loss_fused
 from chainermn_tpu.ops.flash_attention import flash_attention
 
 
-def time_variant(comm, args, *, remat: bool, n_chunks: int,
-                 block_q: int, block_k: int) -> dict:
+def time_variant(comm, args, *, remat: str, n_chunks: int,
+                 block_q: int, block_k: int, batch: int) -> dict:
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -55,9 +55,11 @@ def time_variant(comm, args, *, remat: bool, n_chunks: int,
     model = TransformerLM(
         num_layers=args.layers, d_model=args.d_model,
         num_heads=args.heads, d_ff=args.d_ff, max_len=args.seq_len,
-        remat=remat, return_hidden=True, attention_fn=attn,
+        remat=remat != "none",
+        remat_policy="dots" if remat != "nothing" else "nothing",
+        return_hidden=True, attention_fn=attn,
     )
-    B, T, steps = args.batch * comm.size, args.seq_len, args.steps
+    B, T, steps = batch * comm.size, args.seq_len, args.steps
     tokens = jax.random.randint(
         jax.random.PRNGKey(0), (B, T), 0, model.vocab_size
     )
@@ -111,7 +113,7 @@ def time_variant(comm, args, *, remat: bool, n_chunks: int,
         / comm.size
     )
     out = {
-        "remat": remat, "n_chunks": n_chunks,
+        "remat": remat, "n_chunks": n_chunks, "batch": batch,
         "block_q": block_q, "block_k": block_k,
         "step_ms": round(dt * 1e3, 2),
         "tokens_per_sec": round(B * T / dt, 1),
@@ -131,11 +133,11 @@ def main(argv=None):
     p.add_argument("--heads", type=int, default=16)
     p.add_argument("--d-ff", type=int, default=4096)
     p.add_argument("--seq-len", type=int, default=2048)
-    p.add_argument("--batch", type=int, default=16,
-                   help="per-device batch")
+    p.add_argument("--batch", type=str, default="16",
+                   help="comma list of per-device batch sizes")
     p.add_argument("--steps", type=int, default=8)
-    p.add_argument("--remat", type=str, default="true,false",
-                   help="comma list of true/false")
+    p.add_argument("--remat", type=str, default="dots,none,nothing",
+                   help="comma list of none|dots|nothing (granularity)")
     p.add_argument("--chunks", type=str, default="8,16,32")
     p.add_argument("--blocks", type=str, default="512x1024,256x512",
                    help="comma list of block_q x block_k")
@@ -143,25 +145,29 @@ def main(argv=None):
 
     comm = create_communicator(args.communicator)
     remats = []
-    for s in args.remat.split(","):
-        v = s.strip().lower()
-        if v not in ("true", "false"):
-            p.error(f"--remat values must be true/false, got {s!r}")
-        remats.append(v == "true")
-    chunks = [int(s) for s in args.chunks.split(",")]
-    blocks = [tuple(int(v) for v in s.split("x"))
-              for s in args.blocks.split(",")]
+    for v in args.remat.split(","):
+        v = v.strip().lower()
+        # legacy spellings from earlier rounds keep working
+        v = {"true": "dots", "false": "none"}.get(v, v)
+        if v not in ("none", "dots", "nothing"):
+            p.error(f"--remat values must be none|dots|nothing, got {v!r}")
+        remats.append(v)
+    chunks = [int(v) for v in args.chunks.split(",")]
+    blocks = [tuple(int(v) for v in b.split("x"))
+              for b in args.blocks.split(",")]
+    batches = [int(v) for v in args.batch.split(",")]
 
     results = []
-    for remat, n_chunks, (bq, bk) in itertools.product(
-        remats, chunks, blocks
+    for remat, n_chunks, (bq, bk), batch in itertools.product(
+        remats, chunks, blocks, batches
     ):
         try:
             r = time_variant(comm, args, remat=remat, n_chunks=n_chunks,
-                             block_q=bq, block_k=bk)
+                             block_q=bq, block_k=bk, batch=batch)
         except Exception as e:  # OOM / Mosaic layout reject: keep sweeping
             r = {"remat": remat, "n_chunks": n_chunks, "block_q": bq,
-                 "block_k": bk, "error": f"{type(e).__name__}: {e}"[:160]}
+                 "block_k": bk, "batch": batch,
+                 "error": f"{type(e).__name__}: {e}"[:160]}
         print(json.dumps(r), flush=True)
         results.append(r)
 

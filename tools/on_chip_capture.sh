@@ -1,0 +1,26 @@
+#!/bin/bash
+# One-command on-chip capture (round-4 VERDICT items 1+2+6+7): the moment
+# the tunnelled TPU answers, grab — in priority order — the headline bench
+# (fresh last_good_tpu + curve + kernel sweep), then the ResNet-50 MFU
+# sweep, then the transformer MFU sweep. Each stage bounded; outputs to
+# tools/capture_logs/.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p tools/capture_logs
+stamp=$(date -u +%Y%m%dT%H%M%SZ)
+
+echo "[capture $stamp] stage 1: bench.py" 
+timeout 1800 python bench.py > "tools/capture_logs/bench_$stamp.log" 2>&1
+echo "[capture] bench rc=$? last line:"; tail -1 "tools/capture_logs/bench_$stamp.log" | cut -c1-400
+
+echo "[capture] stage 2: resnet sweep"
+timeout 2400 python examples/imagenet/sweep_mfu.py \
+  > "tools/capture_logs/resnet_sweep_$stamp.log" 2>&1
+echo "[capture] resnet sweep rc=$?"; tail -2 "tools/capture_logs/resnet_sweep_$stamp.log"
+
+echo "[capture] stage 3: transformer sweep"
+timeout 2400 python examples/transformer/sweep_mfu.py \
+  --remat dots,nothing --chunks 16,32 --blocks 512x1024,512x512 --batch 16,32 \
+  > "tools/capture_logs/transformer_sweep_$stamp.log" 2>&1
+echo "[capture] transformer sweep rc=$?"; tail -2 "tools/capture_logs/transformer_sweep_$stamp.log"
+echo "[capture $stamp] done"
