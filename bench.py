@@ -573,7 +573,8 @@ def _bench_attention(on_accel: bool):
     return out
 
 
-def _resnet_setup(comm, on_accel: bool, *, stem: str = "standard"):
+def _resnet_setup(comm, on_accel: bool, *, stem: str = "standard",
+                  force_remat: str | None = None):
     """Shared ResNet bench setup (headline and s2d variants): model, global
     batch (multihost-converted), jitted step, initial state. One place owns
     the workload definition so the variants cannot drift."""
@@ -588,10 +589,32 @@ def _resnet_setup(comm, on_accel: bool, *, stem: str = "standard"):
         make_train_step,
     )
 
+    knobs = {}
     if on_accel:
-        model = ResNet50(num_classes=1000, stem=stem)
-        per_device_batch, hw = 128, 224
+        # Perf knobs adoptable from the sweep's winner without a code
+        # edit (examples/imagenet/sweep_mfu.py -> docs/benchmarks.md
+        # roofline): remat mode and per-device batch. ALWAYS recorded in
+        # the returned knobs (defaults included) so the carried-result
+        # machinery compares like with like.
+        remat_mode = (force_remat if force_remat is not None else
+                      os.environ.get("CHAINERMN_BENCH_RESNET_REMAT", "none"))
+        if remat_mode not in ("none", "conv", "full"):
+            raise ValueError(
+                "CHAINERMN_BENCH_RESNET_REMAT must be none|conv|full, "
+                f"got {remat_mode!r}"
+            )
+        model = ResNet50(
+            num_classes=1000, stem=stem,
+            remat=remat_mode != "none",
+            remat_policy="conv" if remat_mode == "conv" else None,
+        )
+        per_device_batch = int(
+            os.environ.get("CHAINERMN_BENCH_RESNET_BATCH", "128")
+        )
+        hw = 224
         metric = "resnet50_images_per_sec"
+        knobs = {"resnet_remat": remat_mode,
+                 "resnet_batch": per_device_batch}
     else:
         model = ResNet18(num_classes=100, compute_dtype=jnp.float32,
                          stem=stem)
@@ -641,7 +664,7 @@ def _resnet_setup(comm, on_accel: bool, *, stem: str = "standard"):
         model_state=variables["batch_stats"],
     )
     step = make_train_step(loss_fn, optimizer, comm, donate=False)
-    return step, state, (x, y), batch, metric
+    return step, state, (x, y), batch, metric, knobs
 
 
 def _bench_s2d_resnet(comm, on_accel: bool):
@@ -651,7 +674,7 @@ def _bench_s2d_resnet(comm, on_accel: bool):
     separately because the stem is not weight-compatible with the standard
     ResNet-50 the headline metric measures."""
     steps = 13 if on_accel else 2
-    step, state, batch_arrays, batch, _ = _resnet_setup(
+    step, state, batch_arrays, batch, _, _ = _resnet_setup(
         comm, on_accel, stem="space_to_depth"
     )
     for _ in range(3):
@@ -750,7 +773,7 @@ def _bench_native_input(comm, on_accel: bool):
     # entirely from buffers filled during the untimed warmup/compile,
     # which would bias the difference toward pure loader time.
     steps_small, steps_big = (8, 24) if on_accel else (8, 16)
-    step, state, (x_syn, y_syn), batch, _ = _resnet_setup(comm, on_accel)
+    step, state, (x_syn, y_syn), batch, _, _ = _resnet_setup(comm, on_accel)
     hw = x_syn.shape[1]
 
     # A few batches of records; the loader loops epochs, which is fine for
@@ -898,7 +921,7 @@ def _run_native_loop() -> None:
     from chainermn_tpu.training.prefetch import prefetch_to_device
 
     comm = create_communicator("xla")
-    step, state, (x_syn, _), _, _ = _resnet_setup(comm, on_accel)
+    step, state, (x_syn, _), _, _, _ = _resnet_setup(comm, on_accel)
     dtype = x_syn.dtype
     del x_syn
 
@@ -1422,7 +1445,9 @@ def _run_bench(mode: str) -> None:
     comm = create_communicator("xla")
 
     steps, warmup = (20, 3) if on_accel else (5, 1)
-    step, state, (x, y), batch, metric = _resnet_setup(comm, on_accel)
+    step, state, (x, y), batch, metric, knob_fields = _resnet_setup(
+        comm, on_accel
+    )
 
     # AOT-compile once; reuse the executable for the timing loops and pull
     # XLA's own FLOP count (of the per-device partitioned module) for MFU.
@@ -1436,6 +1461,35 @@ def _run_bench(mode: str) -> None:
         step = compiled
     except Exception:
         pass
+
+    # MFU keeps the MODEL-flops convention: under remat, cost_analysis
+    # of the compiled step counts recompute as work, so pull the flops
+    # from a remat-free compile of the same workload instead (one extra
+    # AOT compile, only on the non-default path — same convention as
+    # examples/imagenet/sweep_mfu.py). The probe's duplicate state is
+    # deleted before the timed region so it cannot occupy HBM during
+    # the measurement it calibrates.
+    if knob_fields.get("resnet_remat", "none") != "none":
+        try:
+            step0, state0, batch0, _, _, _ = _resnet_setup(
+                comm, on_accel, force_remat="none"
+            )
+            compiled0 = step0.lower(state0, batch0).compile()
+            a0 = compiled0.cost_analysis()
+            a0 = a0[0] if isinstance(a0, (list, tuple)) else a0
+            model_flops = float(a0.get("flops", 0.0)) or None
+            del step0, state0, batch0, compiled0
+            if model_flops:
+                step_flops = model_flops
+                knob_fields["mfu_note"] = (
+                    "model flops from the remat-free program; recompute "
+                    "counted as price, not useful work"
+                )
+        except Exception as e:
+            knob_fields["mfu_note"] = (
+                f"remat-free flops compile failed ({type(e).__name__}); "
+                "mfu uses compiled-step flops INCLUDING recompute"
+            )
 
     for _ in range(warmup):
         state, metrics = step(state, (x, y))
@@ -1466,6 +1520,7 @@ def _run_bench(mode: str) -> None:
             "125 img/s/P100 ChainerMN-era figure (different hardware); "
             "mfu is the hardware-honest metric"
         ),
+        **knob_fields,
     }
     peak = _peak_flops(devices[0].device_kind)
     if step_flops and peak:

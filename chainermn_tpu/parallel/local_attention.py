@@ -29,6 +29,16 @@ Mechanism (inside ``shard_map`` over the sequence axis):
    and adds into the owner's last ``c_d`` positions. Wrap-around edges
    carry exact zeros (masked in forward ⇒ zero gradient), no special
    case.
+
+Known cost accepted (round-4 ADVICE, low): the wrap sentinel rides the
+segment-id path even when the caller has no packed segments, so every
+block pays a small ([1, block] int32) segment DMA + compare. The
+sentinel-free alternative — masking wrapped positions by GLOBAL
+position — needs a traced per-shard scalar (``axis_index``-derived)
+threaded into all three flash kernels via SMEM; measured against the
+K/V block DMAs (hundreds of KB vs ~4 KB) the saving is marginal, and
+kernel-signature changes are not made without same-session Mosaic
+compile-checks on a real chip (CLAUDE.md kernel convention).
 """
 
 from __future__ import annotations
