@@ -346,10 +346,30 @@ class CommunicatorBase:
         section 3.2) into its collective scheduling.
         """
         dtype = self.allreduce_grad_dtype
+        int8_wire = (dtype is not None
+                     and jnp.dtype(dtype) == jnp.dtype(jnp.int8))
+
+        def quantize_roundtrip(g):
+            # One quantization stage of the int8 wire (the in-jit path's
+            # two stages live in int8_allreduce_mean): max-abs scale,
+            # round, dequantize. A bare astype(int8) would TRUNCATE
+            # sub-1.0 gradients to zero.
+            amax = jnp.max(jnp.abs(g), axis=tuple(range(1, g.ndim)),
+                           keepdims=True)
+            scale = jnp.maximum(amax, 1e-30) / 127.0
+            return jnp.clip(jnp.round(g / scale), -127, 127) * scale
 
         def reduce_leaf(g):
             g = jnp.asarray(g)
             orig = g.dtype
+            if int8_wire and jnp.issubdtype(orig, jnp.floating):
+                # Eager approximation of the quantized wire: per-rank
+                # quantize-dequantize (stage 1), exact mean, one final
+                # quantize-dequantize (stage 2) — same two-rounding
+                # noise model as the in-jit scheme without its chunking.
+                g = quantize_roundtrip(g.astype(jnp.float32))
+                out = self.allreduce(g, op=op)
+                return quantize_roundtrip(out[None])[0].astype(orig)
             if dtype is not None and jnp.issubdtype(orig, jnp.floating):
                 g = g.astype(dtype)
             out = self.allreduce(g, op=op)

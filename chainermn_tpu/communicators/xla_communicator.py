@@ -178,6 +178,13 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
 
         if compress_dtype is None:
             compress_dtype = self.allreduce_grad_dtype
+        # int8 selects the two-phase quantized wire (summing int8 through
+        # the two-level pipeline would overflow): float buckets PACK in
+        # f32 and reduce via int8_allreduce_mean — the flat-buffer
+        # discipline is kept, so tiny bias/scale leaves still ride one
+        # collective per ~64 MB bucket instead of one per leaf.
+        int8_wire = (compress_dtype is not None
+                     and jnp.dtype(compress_dtype) == jnp.dtype(jnp.int8))
         # Axes come from the mesh (a custom mesh= names them differently).
         inter_ax, intra_ax = self.grad_axes
 
@@ -207,7 +214,10 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
             if compress_dtype is not None and jnp.issubdtype(
                 g.dtype, jnp.floating
             ):
-                return jnp.dtype(compress_dtype)
+                # int8 wire: buckets pack in f32; quantization happens
+                # inside int8_allreduce_mean per bucket.
+                return (jnp.dtype(jnp.float32) if int8_wire
+                        else jnp.dtype(compress_dtype))
             return jnp.dtype(g.dtype)
 
         groups: dict = {}
@@ -238,7 +248,14 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
                 flat = jnp.concatenate(
                     [leaves[i].astype(dt).ravel() for i in bidx]
                 )
-                red = two_level_allreduce(flat, intra_ax, inter_ax)
+                if int8_wire and jnp.issubdtype(dt, jnp.floating):
+                    from chainermn_tpu.parallel.collectives import (
+                        int8_allreduce_mean,
+                    )
+
+                    red = int8_allreduce_mean(flat, (inter_ax, intra_ax))
+                else:
+                    red = two_level_allreduce(flat, intra_ax, inter_ax)
                 off = 0
                 for i in bidx:
                     n = leaves[i].size

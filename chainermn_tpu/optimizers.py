@@ -65,6 +65,14 @@ def allreduce_gradients(
     ``allreduce_grad_dtype='float16'`` compressed allreduce
     (``pure_nccl_communicator.py`` (dagger), shu65's v1.3 feature) — halves
     bytes on ICI/DCN; master accumulation stays f32.
+
+    ``compress_dtype=jnp.int8`` selects the QUANTIZED wire (beyond the
+    reference): max-abs-scaled int8 over a two-phase
+    all_to_all/all_gather scheme
+    (:func:`chainermn_tpu.parallel.collectives.int8_allreduce_mean`) —
+    ~2 bytes/element on the wire vs bf16's 4, at ~1/127-relative
+    rounding noise per stage. Outside a named-axis context int8 is an
+    identity (no pointless quantization round-trip).
     """
     if axis_names is None:
         if comm is None:
@@ -74,8 +82,22 @@ def allreduce_gradients(
         # reduce-scatter -> inter-allreduce -> all-gather).
         return comm.reduce_gradients_in_jit(grads, compress_dtype=compress_dtype)
 
+    int8_wire = (compress_dtype is not None
+                 and jnp.dtype(compress_dtype) == jnp.dtype(jnp.int8))
+
     def reduce_leaf(g):
-        if compress_dtype is not None and jnp.issubdtype(g.dtype, jnp.floating):
+        if int8_wire and jnp.issubdtype(g.dtype, jnp.floating):
+            from chainermn_tpu.parallel.collectives import (
+                axes_bound,
+                int8_allreduce_mean,
+            )
+
+            if not axes_bound(axis_names):
+                return g
+            return int8_allreduce_mean(g, axis_names)
+        if compress_dtype is not None and not int8_wire and jnp.issubdtype(
+            g.dtype, jnp.floating
+        ):
             return _pmean_if_in_axis(g.astype(compress_dtype), axis_names).astype(
                 g.dtype
             )

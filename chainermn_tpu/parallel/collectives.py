@@ -19,6 +19,7 @@ section 2.4). The user-facing differentiable wrappers live in
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -194,6 +195,96 @@ def two_level_allreduce(
         raise ValueError(f"op must be 'sum' or 'mean', got {op!r}")
     rows = lax.all_gather(shard, intra_axis, axis=0, tiled=False)
     return rows.reshape(-1)[: flat.size].reshape(x.shape)
+
+
+def int8_allreduce_mean(x: jax.Array, axis_names) -> jax.Array:
+    """Quantized mean-allreduce with an INT8 WIRE — beyond the
+    reference's fp16 compression (``allreduce_grad_dtype='float16'``,
+    ``pure_nccl_communicator.py`` (dagger), shu65's v1.3 feature): 4x
+    fewer gradient bytes than f32, 2x fewer than bf16.
+
+    A summing allreduce cannot stay int8 (n ranks of +-127 overflow), so
+    the bandwidth-honest algorithm is TWO quantized phases, mirroring
+    reduce-scatter -> all-gather:
+
+    1. each member quantizes its full buffer against its own max-abs
+       scale and ``all_to_all``s int8 CHUNKS (+ an all-gather of the
+       n scalar scales);
+    2. each member dequantizes the n received chunks in f32, sums them
+       (its exactly-reduced 1/n shard), requantizes against the shard's
+       new scale, and ``all_gather``s int8 shards back.
+
+    Wire cost per element: ~2(n-1)/n bytes (vs 4(n-1)/n for a bf16 ring
+    and 8(n-1)/n for f32) — certified structurally in
+    ``tests/test_optimizer.py`` (the jaxpr's all_to_all/all_gather carry
+    int8). Error: two rounding stages, relative error ~1/127 of each
+    stage's max-abs — gradient-sized noise well under bf16+momentum
+    tolerances for SGD-scale training; see the accuracy tests.
+
+    Must run inside the named-axis context of ``axis_names`` (a name or
+    tuple of names, flattened into one logical ring).
+
+    Differentiation: quantization (round/clip) has zero gradient almost
+    everywhere, so this op carries a STRAIGHT-THROUGH custom VJP — the
+    backward pass is the exact mean-allreduce's transpose (``pmean`` of
+    the cotangent), i.e. gradients flow as if the wire were lossless.
+    The estimator bias is the quantization noise itself (~1/127 of each
+    stage's max-abs).
+    """
+    return _int8_allreduce_mean(x, _names_tuple(axis_names))
+
+
+def _names_tuple(axis_names):
+    return (tuple(axis_names) if isinstance(axis_names, (tuple, list))
+            else (axis_names,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _int8_allreduce_mean(x: jax.Array, names) -> jax.Array:
+    n = 1
+    for a in names:
+        n *= lax.axis_size(a)
+    if n == 1:
+        # Degenerate axis: the exact mean is x itself — do not pay two
+        # lossy roundings for zero communication.
+        return x
+    orig_dtype = x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    c = -(-flat.size // n)
+    rows = jnp.pad(flat, (0, n * c - flat.size)).reshape(n, c)
+
+    def quantize(v):
+        amax = jnp.max(jnp.abs(v))
+        scale = jnp.maximum(amax, 1e-30) / 127.0
+        q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8)
+        return q, scale
+
+    q, scale = quantize(rows)  # [n, c] int8, own scale
+    # Phase 1: int8 chunks to their shard owners + the n tiny scales.
+    qt = lax.all_to_all(q, names, split_axis=0, concat_axis=0,
+                        tiled=True)              # [n, c] int8 (senders)
+    scales = lax.all_gather(scale, names, axis=0, tiled=False)  # [n]
+    shard = jnp.sum(
+        qt.astype(jnp.float32) * scales[:, None], axis=0
+    )  # [c] f32 — this member's exactly-summed shard
+    # Phase 2: requantize the reduced shard, int8 all-gather back.
+    q2, scale2 = quantize(shard)
+    q2g = lax.all_gather(q2, names, axis=0, tiled=False)      # [n, c] int8
+    scale2g = lax.all_gather(scale2, names, axis=0, tiled=False)  # [n]
+    out = (q2g.astype(jnp.float32) * scale2g[:, None]).reshape(-1)
+    return (out[: flat.size] / n).reshape(x.shape).astype(orig_dtype)
+
+
+def _int8_ar_fwd(x, names):
+    return _int8_allreduce_mean(x, names), None
+
+
+def _int8_ar_bwd(names, _, ct):
+    # Straight-through: the transpose of the EXACT mean-allreduce.
+    return (lax.pmean(ct, names),)
+
+
+_int8_allreduce_mean.defvjp(_int8_ar_fwd, _int8_ar_bwd)
 
 
 def shift(x: PyTree, axis_name: str, offset: int = 1) -> PyTree:
