@@ -1297,6 +1297,13 @@ def _bench_allreduce_curve(comm, on_accel: bool):
         (1 << 18, jnp.bfloat16, "fused", 5),
         (1 << 18, jnp.bfloat16, "bucketed", 5),
     ])
+    if n > 1:
+        # The quantized wire only exists on a real multi-member axis
+        # (size-1 short-circuits to the exact value by design).
+        cases.append(
+            (1 << 26, jnp.float32, "int8", 20) if on_accel
+            else (1 << 18, jnp.float32, "int8", 5)
+        )
 
     rows = []
     for n_elems, dtype, mode_, iters in cases:
@@ -1304,11 +1311,19 @@ def _bench_allreduce_curve(comm, on_accel: bool):
         n_buckets = (max(1, n_elems // bucket_elems_bf16)
                      if mode_ == "bucketed" else 1)
 
-        def local(x, n_buckets=n_buckets):
+        def local(x, n_buckets=n_buckets, mode=mode_):
             salt = sum(jax.lax.axis_index(a) for a in axes_tuple)
 
             def body(b, _):
-                if n_buckets == 1:
+                if mode == "int8":
+                    from chainermn_tpu.parallel.collectives import (
+                        int8_allreduce_mean,
+                    )
+
+                    red = int8_allreduce_mean(
+                        b + salt.astype(b.dtype), axes_tuple
+                    )
+                elif n_buckets == 1:
                     red = jax.lax.psum(b + salt.astype(b.dtype), axes)
                 else:
                     parts = jnp.split(b + salt.astype(b.dtype), n_buckets)
@@ -1336,8 +1351,15 @@ def _bench_allreduce_curve(comm, on_accel: bool):
             })
             continue
         nbytes = n_elems * jnp.dtype(dtype).itemsize
-        algbw = nbytes / dt
-        busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
+        algbw = nbytes / dt  # logical (pre-compression) bytes reduced/s
+        # Bus bandwidth from the bytes that PHYSICALLY cross the wire:
+        # ring allreduce moves 2(n-1)/n * itemsize per element; the int8
+        # scheme moves ~2(n-1)/n * 1 byte regardless of logical dtype
+        # (all_to_all int8 chunks + int8 all-gather; scales negligible).
+        wire_itemsize = 1 if mode_ == "int8" else jnp.dtype(dtype).itemsize
+        wire_bytes = n_elems * wire_itemsize
+        busbw = (wire_bytes / dt) * (2 * (n - 1) / n) if n > 1 \
+            else wire_bytes / dt
         rows.append({
             "mib": round(nbytes / 2**20, 3),
             "dtype": jnp.dtype(dtype).name,
