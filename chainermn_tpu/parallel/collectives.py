@@ -239,15 +239,18 @@ def _names_tuple(axis_names):
             else (axis_names,))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def _int8_allreduce_mean(x: jax.Array, names) -> jax.Array:
+def _int8_core(x: jax.Array, names):
+    """Shared two-phase quantized reduction. Returns ``(mean,
+    local_roundtrip)`` where ``local_roundtrip`` is THIS member's
+    dequantized stage-1 message ``D(C(x))`` — what the peers actually
+    received from us — enabling error feedback (``e = x - D(C(x))``)."""
     n = 1
     for a in names:
         n *= lax.axis_size(a)
     if n == 1:
         # Degenerate axis: the exact mean is x itself — do not pay two
         # lossy roundings for zero communication.
-        return x
+        return x, x
     orig_dtype = x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     c = -(-flat.size // n)
@@ -260,6 +263,10 @@ def _int8_allreduce_mean(x: jax.Array, names) -> jax.Array:
         return q, scale
 
     q, scale = quantize(rows)  # [n, c] int8, own scale
+    local_rt = (
+        (q.astype(jnp.float32) * scale).reshape(-1)[: flat.size]
+        .reshape(x.shape).astype(orig_dtype)
+    )
     # Phase 1: int8 chunks to their shard owners + the n tiny scales.
     qt = lax.all_to_all(q, names, split_axis=0, concat_axis=0,
                         tiled=True)              # [n, c] int8 (senders)
@@ -272,7 +279,24 @@ def _int8_allreduce_mean(x: jax.Array, names) -> jax.Array:
     q2g = lax.all_gather(q2, names, axis=0, tiled=False)      # [n, c] int8
     scale2g = lax.all_gather(scale2, names, axis=0, tiled=False)  # [n]
     out = (q2g.astype(jnp.float32) * scale2g[:, None]).reshape(-1)
-    return (out[: flat.size] / n).reshape(x.shape).astype(orig_dtype)
+    mean = (out[: flat.size] / n).reshape(x.shape).astype(orig_dtype)
+    return mean, local_rt
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _int8_allreduce_mean(x: jax.Array, names) -> jax.Array:
+    return _int8_core(x, names)[0]
+
+
+def int8_allreduce_mean_with_feedback(x: jax.Array, axis_names):
+    """The error-feedback form: ``(mean, local_roundtrip)`` where
+    ``local_roundtrip = D(C(x))`` is this member's own stage-1
+    quantize-dequantize — the caller keeps ``e = x - local_roundtrip``
+    and adds it into the NEXT step's message (EF-SGD: the compression
+    error is fed back instead of lost, removing the systematic bias of
+    deterministic rounding). NOT differentiable (optimizer-internal;
+    use :func:`int8_allreduce_mean` for the straight-through form)."""
+    return _int8_core(x, _names_tuple(axis_names))
 
 
 def _int8_ar_fwd(x, names):

@@ -150,6 +150,19 @@ def make_train_step(
     reduce_in_step = not isinstance(optimizer, MultiNodeOptimizer)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if getattr(optimizer, "error_feedback", False):
+        # The EF residual is PER-RANK state; this step's replicated
+        # (P()) state specs cannot carry per-rank values across the jit
+        # boundary without undefined replication semantics. Refuse
+        # loudly rather than corrupt silently.
+        raise ValueError(
+            "error_feedback keeps a per-rank quantization residual in "
+            "the optimizer state, which make_train_step's replicated "
+            "state specs cannot carry across steps; drive opt.update "
+            "inside your own shard_map with an explicit per-rank "
+            "residual spec (see tests/test_optimizer.py "
+            "TestErrorFeedback for the pattern)"
+        )
 
     _loss_with_aux = normalize_loss_fn(loss_fn)
 

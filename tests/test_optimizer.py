@@ -508,3 +508,216 @@ class TestInt8CompressedAllreduce:
             tree, axis_env=[("inter", 2), ("intra", 4)],
         )
         assert c.get("all_to_all") == 1, c  # one bucket -> one pipeline
+
+
+class TestErrorFeedback:
+    """EF-SGD over the int8 wire: the stage-1 quantization error is
+    carried in optimizer state and fed into the next message, so the
+    CUMULATIVE applied gradient tracks the exact mean to one-step noise
+    — where plain deterministic rounding drifts linearly.
+
+    The residual is PER-RANK state: these tests thread it across steps
+    explicitly stacked [N, ...] under a P(axes) spec (make_train_step
+    refuses EF optimizers for exactly this reason — replicated state
+    specs cannot carry per-rank values)."""
+
+    def _run_ef_update(self, comm, opt, grads_stacked, params,
+                       n_steps=1):
+        from chainermn_tpu.optimizers import _ErrorFeedbackState
+
+        mesh, axes = comm.mesh, comm.grad_axes
+        state0 = opt.init(params)
+        res = jax.tree.map(
+            lambda r: jnp.broadcast_to(r[None], (N,) + r.shape),
+            state0.residual,
+        )
+        inner = state0.inner
+
+        @jax.jit
+        def step(params, inner, res, gstack):
+            def body(gl, rl):
+                st = _ErrorFeedbackState(
+                    inner=inner,
+                    residual=jax.tree.map(lambda x: x[0], rl),
+                )
+                updates, new_state = opt.update(gl[0], st, params)
+                new_params = optax.apply_updates(params, updates)
+                return (
+                    new_params,
+                    new_state.inner,
+                    jax.tree.map(lambda x: x[None], new_state.residual),
+                )
+
+            return shard_map(
+                body, mesh=mesh,
+                in_specs=(P(axes), P(axes)),
+                out_specs=(P(), P(), P(axes)), check_vma=False,
+            )(gstack, res)
+
+        for _ in range(n_steps):
+            params, inner, res = step(params, inner, res, grads_stacked)
+        return params, inner, res
+
+    def _cumulative_error(self, error_feedback, steps=30):
+        comm = create_communicator("naive")
+        rng = np.random.RandomState(21)
+        # small values with a deliberate sub-quantum spread: one int8
+        # quantum is amax/127, so per-rank rounding bias is material
+        grads = (rng.randn(N, 6) * 0.01).astype(np.float32)
+        grads[0, :] = 0.9  # sets amax; makes tiny entries sub-quantum
+        params = jnp.zeros((6,), jnp.float32)
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8,
+            error_feedback=error_feedback,
+        )
+        if error_feedback:
+            new_params, _, _ = self._run_ef_update(
+                comm, opt, jnp.asarray(grads), params, n_steps=steps
+            )
+        else:
+            new_params, _ = _run_sharded_update(
+                comm, opt, jnp.asarray(grads), params, n_steps=steps
+            )
+        # params = -sum(applied grads); exact would be -steps * mean
+        exact = -steps * grads.mean(0)
+        return np.abs(np.asarray(new_params) - exact).max(), grads
+
+    def test_cumulative_bias_removed(self):
+        err_plain, grads = self._cumulative_error(False)
+        err_ef, _ = self._cumulative_error(True)
+        quantum = np.abs(grads).max() / 127.0
+        # EF keeps the total error bounded by ~a couple of quanta
+        assert err_ef < 4 * quantum, (err_ef, quantum)
+        # and beats plain rounding (which accumulates its per-step bias)
+        assert err_ef < err_plain / 3, (err_ef, err_plain)
+
+    def test_residuals_are_per_rank_distinct(self):
+        """The reason the residual needs a per-rank spec: after one step
+        with distinct per-rank grads, the residuals differ by rank."""
+        comm = create_communicator("naive")
+        grads = _per_rank_grads(comm)
+        params = jnp.zeros((4,), jnp.float32)
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8, error_feedback=True,
+        )
+        _, _, res = self._run_ef_update(
+            comm, opt, jnp.asarray(grads), params, n_steps=1
+        )
+        stacked = np.asarray(jax.tree.leaves(res)[0])  # [N, 4]
+        assert not all(
+            np.allclose(stacked[r], stacked[0]) for r in range(1, N)
+        ), "per-rank residuals should differ for distinct grads"
+
+    def test_non_float_leaves_still_reduced(self):
+        """EF must not skip integer leaves: they take the exact pmean
+        (reference parity), keeping all ranks' state in sync."""
+        from chainermn_tpu.optimizers import _ErrorFeedbackState
+
+        comm = create_communicator("naive")
+        mesh, axes = comm.mesh, comm.grad_axes
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8, error_feedback=True,
+        )
+        g = {
+            "w": jnp.asarray(
+                np.random.RandomState(3).randn(N, 4), jnp.float32),
+            "count": jnp.asarray(
+                np.arange(N, dtype=np.int32)[:, None] * np.ones(
+                    (1, 2), np.int32)),
+        }
+        params = {"w": jnp.zeros((4,)),
+                  "count": jnp.zeros((2,), jnp.int32)}
+        state = opt.init(params)
+
+        def body(gl, rl):
+            st = _ErrorFeedbackState(
+                inner=state.inner,
+                residual=jax.tree.map(lambda x: x[0], rl),
+            )
+            updates, _ = opt.update(
+                jax.tree.map(lambda x: x[0], gl), st, params
+            )
+            return updates["count"][None]
+
+        res = jax.tree.map(
+            lambda r: jnp.broadcast_to(r[None], (N,) + r.shape),
+            state.residual,
+        )
+        out = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P(axes), P(axes)),
+            out_specs=P(axes), check_vma=False,
+        ))(g, res)
+        stacked = np.asarray(out)  # [N, 2]
+        # every rank got the same (mean) value for the int leaf
+        for r in range(1, N):
+            np.testing.assert_array_equal(stacked[r], stacked[0])
+
+    def test_requires_int8_wire(self):
+        comm = create_communicator("naive")
+        with pytest.raises(ValueError, match="error_feedback requires"):
+            create_multi_node_optimizer(
+                optax.sgd(1.0), comm,
+                allreduce_grad_dtype=jnp.bfloat16, error_feedback=True,
+            )
+
+    def test_train_step_refuses_ef(self):
+        from chainermn_tpu.training.train_step import make_train_step
+
+        comm = create_communicator("naive")
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8, error_feedback=True,
+        )
+        with pytest.raises(ValueError, match="per-rank"):
+            make_train_step(lambda p, b: 0.0, opt, comm)
+
+    def test_composes_with_double_buffering(self):
+        """EF + double buffering: staleness-1 semantics intact (step 0
+        applies zeros; two steps apply exactly one reduced grad) and
+        both state layers are present."""
+        comm = create_communicator("naive")
+        grads = _per_rank_grads(comm)
+        params = jnp.zeros((4,), jnp.float32)
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8,
+            double_buffering=True, error_feedback=True,
+        )
+        state = opt.init(params)
+        from chainermn_tpu.optimizers import (
+            _DoubleBufferState,
+            _ErrorFeedbackState,
+        )
+
+        assert isinstance(state, _ErrorFeedbackState)
+        assert isinstance(state.inner, _DoubleBufferState)
+
+        p1, _, _ = self._run_ef_update(comm, opt, grads, params,
+                                       n_steps=1)
+        np.testing.assert_allclose(np.asarray(p1), np.zeros(4), atol=1e-7)
+        p2, _, _ = self._run_ef_update(comm, opt, grads, params,
+                                       n_steps=2)
+        amax = np.abs(grads).max()
+        np.testing.assert_allclose(
+            np.asarray(p2), -grads.mean(0), atol=2 * amax / 100
+        )
+
+    def test_identity_outside_axis_context_keeps_residual(self):
+        comm = create_communicator("naive")
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8, error_feedback=True,
+        )
+        params = jnp.zeros((4,), jnp.float32)
+        g = jnp.full((4,), 0.25, jnp.float32)
+        state = opt.init(params)
+        updates, new_state = jax.jit(opt.update)(g, state, params)
+        np.testing.assert_allclose(np.asarray(updates), -0.25 * np.ones(4),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(new_state.residual)[0]),
+            np.zeros(4),
+        )
