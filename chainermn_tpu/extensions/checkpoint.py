@@ -167,6 +167,76 @@ class MultiNodeCheckpointer:
                 its.append(int(m.group("iter")))
         return sorted(its)
 
+    def _directory_iterations(self) -> list[int]:
+        """Iterations present for ANY rank (world-resize restore: the
+        saving world's rank numbering is irrelevant; completeness is
+        verified leaf-by-leaf during the load)."""
+        its = set()
+        for fn in os.listdir(self.path):
+            m = _FNAME_RE.match(fn)
+            if m and m.group("name") == self.name:
+                its.add(int(m.group("iter")))
+        return sorted(its)
+
+    def _merged_shard_data(self, iteration: int) -> dict:
+        """Union of every rank's saved arrays for ``iteration`` —
+        requires the snapshot directory to be SHARED storage (the
+        world-resize contract). Duplicate keys (shards replicated
+        across the old world) are verified identical."""
+        merged: dict[str, np.ndarray] = {}
+        for fn in sorted(os.listdir(self.path)):
+            m = _FNAME_RE.match(fn)
+            if not (m and m.group("name") == self.name
+                    and int(m.group("iter")) == iteration):
+                continue
+            with np.load(os.path.join(self.path, fn)) as data:
+                for k in data.files:
+                    arr = np.asarray(data[k])
+                    if k in merged:
+                        prev = merged[k]
+                        # Bytes comparison: NaN-safe (NaN == NaN must
+                        # count as the same saved value) and dtype-exact.
+                        if (prev.shape != arr.shape
+                                or prev.dtype != arr.dtype
+                                or prev.tobytes() != arr.tobytes()):
+                            raise ValueError(
+                                f"conflicting copies of {k!r} across "
+                                f"ranks' snapshots at iteration "
+                                f"{iteration} — corrupt checkpoint set"
+                            )
+                        continue
+                    merged[k] = arr
+        return merged
+
+    @staticmethod
+    def _global_from_shards(key: str, merged: dict, tshape, dtype):
+        """Reassemble one leaf's FULL global array from the merged shard
+        entries (any old-world sharding); raises if coverage has holes."""
+        out = np.zeros(tshape, dtype)
+        covered = np.zeros(tshape, bool)
+        prefix = f"{key}{_SHARD_SEP}"
+        found = False
+        for skey, arr in merged.items():
+            if not skey.startswith(prefix):
+                continue
+            found = True
+            slices = tuple(
+                slice(*map(int, part.split(":")))
+                for part in skey[len(prefix):].split("|")
+            )
+            out[slices] = arr
+            covered[slices] = True
+        if not found:
+            raise ValueError(f"no shards found for leaf {key!r}")
+        if not covered.all():
+            raise ValueError(
+                f"shards for leaf {key!r} do not cover the full global "
+                f"shape {tuple(tshape)} — snapshot set incomplete (all "
+                "ranks' files must be on shared storage for a "
+                "world-resize restore)"
+            )
+        return out
+
     # ------------------------------------------------------------------
 
     def save(self, state: PyTree, iteration: int, *, block: bool = True) -> str:
@@ -250,11 +320,22 @@ class MultiNodeCheckpointer:
                 self._writer.finalize()
                 self._writer = None
 
-    def maybe_load(self, state_template: PyTree) -> tuple[PyTree, Optional[int]]:
+    def maybe_load(
+        self, state_template: PyTree, *, allow_world_resize: bool = False
+    ) -> tuple[PyTree, Optional[int]]:
         """Resume from the newest iteration available on *all* processes
         (reference: gather available iters -> max common -> deserialize,
         SURVEY.md section 3.5). Returns ``(state, iteration)`` or
-        ``(state_template, None)`` when no common snapshot exists."""
+        ``(state_template, None)`` when no common snapshot exists.
+
+        ``allow_world_resize=True`` restores snapshots written by a
+        DIFFERENT world size/mesh layout (beyond the reference's static
+        MPI world): iterations are discovered directory-wide (new ranks
+        have no files of their own), and any sharded leaf whose saved
+        shard boundaries don't match the new template's sharding is
+        reassembled globally from ALL ranks' files and re-sliced —
+        requires the snapshot directory to be shared storage, and
+        verifies full coverage leaf-by-leaf."""
         # Drain in-flight async saves so they count once durable. A raising
         # preamble BEFORE the collective would hang the other ranks inside
         # allgather — gather each rank's failure status along with its
@@ -264,12 +345,18 @@ class MultiNodeCheckpointer:
             self.wait_async()
         except RuntimeError as e:
             drain_err = str(e)
-        it = agree_max_common_step(
-            self.comm, self._local_iterations(), drain_err
-        )
+        its = (self._directory_iterations() if allow_world_resize
+               else self._local_iterations())
+        it = agree_max_common_step(self.comm, its, drain_err)
         if it is None:
             return state_template, None
-        data = np.load(self._fname(it))
+        if allow_world_resize:
+            merged = self._merged_shard_data(it)
+            return self._restore_resized(state_template, it, merged)
+        with np.load(self._fname(it)) as data:
+            return self._restore_strict(state_template, it, data)
+
+    def _restore_strict(self, state_template, it, data):
         flat, treedef = jax.tree_util.tree_flatten_with_path(state_template)
         keys = [_path_key(p) for p, _ in flat]
         # Shard entries (``path@@start:stop|...``) collapse onto their base
@@ -314,6 +401,79 @@ class MultiNodeCheckpointer:
             restored.append(
                 jax.numpy.asarray(arr.astype(np.asarray(t).dtype))
             )
+        return jax.tree.unflatten(treedef, restored), it
+
+    def _restore_resized(self, state_template: PyTree, it: int,
+                         merged: dict) -> tuple[PyTree, int]:
+        """The world-resize restore path: every leaf comes from the
+        MERGED cross-rank data; sharded leaves are reassembled globally
+        and re-sliced onto the template's (new) sharding.
+
+        Cost note: each restoring process reads the full old snapshot
+        set and materialises each leaf at global size on the host (plus
+        a transient bool coverage mask) — O(world x checkpoint) shared
+        -storage traffic, paid once per RESIZE restore, not on the
+        normal resume path."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            state_template
+        )
+        base_keys = {k.split(_SHARD_SEP, 1)[0] for k in merged}
+        wanted = {_path_key(p) for p, _ in flat}
+        # Same key-set agreement (and legacy-format detection) as the
+        # strict path: a dropped template field or an orphaned saved
+        # leaf must fail loudly, resize or not.
+        if base_keys != wanted and all(
+            re.fullmatch(r"leaf_\d+", k) for k in base_keys
+        ):
+            raise ValueError(
+                f"checkpoint iteration {it} uses the legacy positional "
+                "'leaf_{i}' format (pre-tree-path snapshots); it cannot "
+                "be restored safely by name — re-save from a live state "
+                "or delete the stale snapshot files"
+            )
+        if base_keys != wanted:
+            raise ValueError(
+                f"checkpoint iteration {it} key set does not match the "
+                f"state template: missing={sorted(wanted - base_keys)[:8]} "
+                f"unexpected={sorted(base_keys - wanted)[:8]}"
+            )
+        restored = []
+        for path, t in flat:
+            key = _path_key(path)
+            tshape = np.shape(t)
+            tdtype = np.dtype(
+                t.dtype if hasattr(t, "dtype") else np.asarray(t).dtype
+            )
+            if key in merged:  # saved as a full global view
+                arr = np.asarray(merged[key])
+                if arr.shape != tshape:
+                    raise ValueError(
+                        f"checkpoint leaf {key!r} has shape {arr.shape}, "
+                        f"template expects {tshape}"
+                    )
+            else:  # shard entries only: reassemble globally
+                arr = self._global_from_shards(key, merged, tshape, tdtype)
+            if isinstance(t, jax.Array) and not t.is_fully_addressable:
+                sharding = t.sharding
+                imap = sharding.addressable_devices_indices_map(tshape)
+                pieces = [
+                    jax.device_put(
+                        arr[index].astype(tdtype, copy=False), device
+                    )
+                    for device, index in imap.items()
+                ]
+                restored.append(jax.make_array_from_single_device_arrays(
+                    tshape, sharding, pieces
+                ))
+            elif isinstance(t, jax.Array):
+                # Fully addressable (e.g. restoring into ONE process
+                # with a multi-device mesh): honour the template's
+                # sharding instead of silently defaulting it.
+                restored.append(
+                    jax.device_put(arr.astype(tdtype), t.sharding)
+                )
+            else:
+                restored.append(jax.numpy.asarray(arr.astype(tdtype)))
         return jax.tree.unflatten(treedef, restored), it
 
     def cleanup(self) -> None:

@@ -318,6 +318,52 @@ def case_sharded_checkpoint():
         np.testing.assert_allclose(np.asarray(s.data), global_np[s.index])
 
 
+def case_resize_restore():
+    """World-resize restore (beyond the reference's static MPI world):
+    phase 1 saves a SHARDED state from a small world; phase 2 restores
+    it into a LARGER world whose template sharding has different shard
+    boundaries — `maybe_load(allow_world_resize=True)` reassembles the
+    global arrays from all old ranks' files and re-slices."""
+    from chainermn_tpu import create_communicator
+    from chainermn_tpu.extensions.checkpoint import (
+        create_multi_node_checkpointer,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    comm = create_communicator("xla")
+    phase = int(os.environ.get("MP_PHASE", "1"))
+    sh = NamedSharding(comm.mesh, P("data"))
+    ROWS = 24  # divisible by both worlds' slot counts (4 and 8)
+    global_np = np.arange(ROWS * 4, dtype=np.float32).reshape(ROWS, 4)
+    path = os.environ["MP_CKPT_DIR"]
+    ckpt = create_multi_node_checkpointer("resize", comm, path=path, keep=0)
+
+    if phase == 1:
+        arr = jax.make_array_from_callback(
+            global_np.shape, sh, lambda idx: global_np[idx]
+        )
+        assert not arr.is_fully_addressable
+        ckpt.save({"w": arr, "step": jnp.int32(7)}, 3)
+        comm.barrier()
+        return
+
+    # Phase 2: larger world, different shard boundaries.
+    template = {
+        "w": jax.make_array_from_callback(
+            global_np.shape, sh, lambda idx: np.zeros_like(global_np[idx])
+        ),
+        "step": jnp.int32(0),
+    }
+    # Without the flag, the new ranks have no files -> no common step.
+    _, it_strict = ckpt.maybe_load(template)
+    assert it_strict is None, it_strict
+    restored, it = ckpt.maybe_load(template, allow_world_resize=True)
+    assert it == 3 and int(restored["step"]) == 7
+    assert restored["w"].sharding == sh
+    for s in restored["w"].addressable_shards:
+        np.testing.assert_allclose(np.asarray(s.data), global_np[s.index])
+
+
 def case_fsdp_ring():
     """FSDP auto-sharding and flash-ring attention across REAL processes:
     the declarative param sharding and the ppermute ring both cross the

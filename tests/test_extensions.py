@@ -335,3 +335,32 @@ def test_orbax_async_save_then_resave_same_step(tmp_path, comm):
     assert it == 5
     np.testing.assert_array_equal(np.asarray(restored["x"]), np.ones(2))
     ckpt.close()
+
+
+def test_global_from_shards_coverage_and_conflicts(tmp_path):
+    """Unit pins for the world-resize reassembly: full coverage required,
+    conflicting duplicate shards rejected."""
+    import numpy as np
+
+    from chainermn_tpu.extensions.checkpoint import MultiNodeCheckpointer
+
+    full = np.arange(12, dtype=np.float32).reshape(6, 2)
+    merged = {
+        "w@@0:3|0:2": full[0:3],
+        "w@@3:6|0:2": full[3:6],
+    }
+    out = MultiNodeCheckpointer._global_from_shards(
+        "w", merged, (6, 2), np.float32
+    )
+    np.testing.assert_array_equal(out, full)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="do not cover"):
+        MultiNodeCheckpointer._global_from_shards(
+            "w", {"w@@0:3|0:2": full[0:3]}, (6, 2), np.float32
+        )
+    with pytest.raises(ValueError, match="no shards"):
+        MultiNodeCheckpointer._global_from_shards(
+            "v", merged, (6, 2), np.float32
+        )
