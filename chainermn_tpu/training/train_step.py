@@ -63,14 +63,37 @@ def create_train_state(
     model_state: PyTree = (),
 ) -> TrainState:
     """Initialise (and replicate, when a communicator is given) the state —
-    the explicit version of the reference's first-update ``bcast_data``."""
+    the explicit version of the reference's first-update ``bcast_data``.
+
+    With an error-feedback optimizer the EF residual is PER-RANK state:
+    it is initialised stacked ``[n_slots, ...]`` and SHARDED over the
+    communicator's grad axes, so the jitted train step can carry it with
+    honest per-rank sharding (see ``make_train_step``'s EF state spec)."""
     if comm is not None:
         params = comm.bcast_data(params)
         if jax.tree.leaves(model_state):
             model_state = comm.bcast_data(model_state)
+    opt_state = optimizer.init(params)
+    if getattr(optimizer, "error_feedback", False):
+        if comm is None:
+            raise ValueError(
+                "error_feedback training state needs a communicator "
+                "(the residual is sharded over its grad axes)"
+            )
+        sharding = NamedSharding(comm.mesh, P(comm.grad_axes))
+        n = comm.size
+
+        def stack(r):
+            return jax.device_put(
+                jnp.zeros((n,) + r.shape, r.dtype), sharding
+            )
+
+        opt_state = opt_state._replace(
+            residual=jax.tree.map(stack, opt_state.residual)
+        )
     return TrainState(
         params=params,
-        opt_state=optimizer.init(params),
+        opt_state=opt_state,
         step=jnp.zeros((), jnp.int32),
         model_state=model_state,
     )
@@ -150,18 +173,25 @@ def make_train_step(
     reduce_in_step = not isinstance(optimizer, MultiNodeOptimizer)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    if getattr(optimizer, "error_feedback", False):
-        # The EF residual is PER-RANK state; this step's replicated
-        # (P()) state specs cannot carry per-rank values across the jit
-        # boundary without undefined replication semantics. Refuse
-        # loudly rather than corrupt silently.
-        raise ValueError(
-            "error_feedback keeps a per-rank quantization residual in "
-            "the optimizer state, which make_train_step's replicated "
-            "state specs cannot carry across steps; drive opt.update "
-            "inside your own shard_map with an explicit per-rank "
-            "residual spec (see tests/test_optimizer.py "
-            "TestErrorFeedback for the pattern)"
+    # The EF residual is PER-RANK state: carry it with an honest
+    # per-rank spec (stacked [n_slots, ...] over the COMMUNICATOR's grad
+    # axes — the layout create_train_state initialises; independent of
+    # any axis_name override, because the EF reduction itself always
+    # runs over comm.grad_axes) instead of the replicated P() the rest
+    # of the state uses. The optimizer sees a single layout: local_step
+    # squeezes the per-slot [1, ...] slice around opt.update.
+    ef = getattr(optimizer, "error_feedback", False)
+    state_spec: Any = P()
+    if ef:
+        from chainermn_tpu.optimizers import _ErrorFeedbackState
+
+        state_spec = TrainState(
+            params=P(),
+            opt_state=_ErrorFeedbackState(
+                inner=P(), residual=P(comm.grad_axes)
+            ),
+            step=P(),
+            model_state=P(),
         )
 
     _loss_with_aux = normalize_loss_fn(loss_fn)
@@ -213,7 +243,34 @@ def make_train_step(
             )
         if reduce_in_step:
             grads = allreduce_gradients(grads, comm)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        opt_in = state.opt_state
+        if ef:
+            # Validate the stacked-layout contract LOUDLY at trace time
+            # (a state from optimizer.init(params) is unstacked — the
+            # mistake must name its fix, not surface as a reshape error
+            # deep in the quantizer), then hand the optimizer its single
+            # supported layout: this slot's squeezed residual.
+            for e, g in zip(jax.tree.leaves(opt_in.residual),
+                            jax.tree.leaves(grads)):
+                if e.shape != (1,) + g.shape:
+                    raise ValueError(
+                        "error-feedback residual leaf has per-shard "
+                        f"shape {e.shape}, expected {(1,) + g.shape} — "
+                        "build the state with create_train_state(...) "
+                        "(it stacks the residual [n_slots, ...] sharded "
+                        "over the communicator's grad axes); a bare "
+                        "optimizer.init(params) state cannot be carried "
+                        "by make_train_step"
+                    )
+            opt_in = opt_in._replace(
+                residual=jax.tree.map(lambda e: e[0], opt_in.residual)
+            )
+        updates, opt_state = optimizer.update(grads, opt_in, state.params)
+        if ef:
+            opt_state = opt_state._replace(
+                residual=jax.tree.map(lambda e: e[None],
+                                      opt_state.residual)
+            )
         params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, **metrics}
         metrics = lax.pmean(metrics, axes)
@@ -230,8 +287,8 @@ def make_train_step(
     sharded = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(), batch_spec),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -54,6 +54,9 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--allreduce-grad-dtype", default=None)
+    p.add_argument("--error-feedback", action="store_true",
+                   help="EF-SGD residual feedback over the int8 wire "
+                        "(requires --allreduce-grad-dtype int8)")
     p.add_argument("--checkpoint", default=None, metavar="DIR",
                    help="fault-tolerant snapshots every --checkpoint-interval "
                         "iters (async native writer); resumes automatically "
@@ -88,6 +91,7 @@ def main(argv=None):
         optax.sgd(args.lr, momentum=0.9),
         comm,
         double_buffering=args.double_buffering,
+        error_feedback=args.error_feedback,
     )
     state = create_train_state(params, optimizer, comm)
 

@@ -663,16 +663,74 @@ class TestErrorFeedback:
                 allreduce_grad_dtype=jnp.bfloat16, error_feedback=True,
             )
 
-    def test_train_step_refuses_ef(self):
-        from chainermn_tpu.training.train_step import make_train_step
+    def test_train_step_carries_residual_per_rank(self):
+        """EF through the STANDARD trainer path: make_train_step carries
+        the residual sharded over the grad axes (stacked [n, ...]), the
+        cumulative applied gradient tracks the exact mean (EF working),
+        and the residual array is genuinely per-rank-sharded."""
+        from chainermn_tpu.training.train_step import (
+            create_train_state,
+            make_train_step,
+        )
 
         comm = create_communicator("naive")
+        rng = np.random.RandomState(22)
+        grads_np = (rng.randn(N, 6) * 0.01).astype(np.float32)
+        grads_np[0, :] = 0.9  # amax row: makes tiny entries sub-quantum
+        params = {"w": jnp.zeros((6,), jnp.float32)}
         opt = create_multi_node_optimizer(
             optax.sgd(1.0), comm,
             allreduce_grad_dtype=jnp.int8, error_feedback=True,
         )
-        with pytest.raises(ValueError, match="per-rank"):
-            make_train_step(lambda p, b: 0.0, opt, comm)
+        state = create_train_state(params, opt, comm)
+        res0 = jax.tree.leaves(state.opt_state.residual)[0]
+        assert res0.shape == (N, 6)
+        assert not res0.sharding.is_fully_replicated
+
+        # loss = sum(params * batch-row): grad per shard = its batch row
+        def loss_fn(p, batch):
+            return jnp.sum(p["w"] * batch[0])
+
+        step = make_train_step(loss_fn, opt, comm, donate=False)
+        batch = jnp.asarray(grads_np)
+        steps = 30
+        for _ in range(steps):
+            state, _ = step(state, batch)
+        exact = -steps * grads_np.mean(0)
+        err = np.abs(np.asarray(state.params["w"]) - exact).max()
+        quantum = np.abs(grads_np).max() / 127.0
+        assert err < 4 * quantum, (err, quantum)
+        # residuals differ per rank (per-rank state survived the loop)
+        stacked = np.asarray(
+            jax.tree.leaves(state.opt_state.residual)[0]
+        )
+        assert not all(
+            np.allclose(stacked[r], stacked[0]) for r in range(1, N)
+        )
+
+    def test_train_step_rejects_unstacked_residual(self):
+        """A bare optimizer.init() state (unstacked residual) must fail
+        LOUDLY at trace time, naming create_train_state as the fix."""
+        from chainermn_tpu.training.train_step import (
+            TrainState,
+            make_train_step,
+        )
+
+        comm = create_communicator("naive")
+        params = {"w": jnp.zeros((8,), jnp.float32)}
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8, error_feedback=True,
+        )
+        bad_state = TrainState(
+            params=params, opt_state=opt.init(params),
+            step=jnp.zeros((), jnp.int32), model_state=(),
+        )
+        step = make_train_step(
+            lambda p, b: jnp.sum(p["w"] * b[0]), opt, comm, donate=False
+        )
+        with pytest.raises(Exception, match="create_train_state"):
+            step(bad_state, jnp.ones((N, 8)))
 
     def test_composes_with_double_buffering(self):
         """EF + double buffering: staleness-1 semantics intact (step 0
