@@ -17,12 +17,17 @@ from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax, shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.communicators.base import CommunicatorBase
-from chainermn_tpu.optimizers import MultiNodeOptimizer, allreduce_gradients
+from chainermn_tpu.optimizers import (
+    MultiNodeOptimizer,
+    _ErrorFeedbackState,
+    allreduce_gradients,
+)
 
 PyTree = Any
 
@@ -84,8 +89,17 @@ def create_train_state(
         n = comm.size
 
         def stack(r):
-            return jax.device_put(
-                jnp.zeros((n,) + r.shape, r.dtype), sharding
+            # Created directly sharded: a bare jnp.zeros + device_put
+            # would commit the full n x params array to device 0 first
+            # (the same spike trainer.py's prefetch placement avoids).
+            shape = (n,) + r.shape
+            return jax.make_array_from_callback(
+                shape, sharding,
+                lambda idx: np.zeros(
+                    tuple(len(range(*sl.indices(dim)))
+                          for sl, dim in zip(idx, shape)),
+                    r.dtype,
+                ),
             )
 
         opt_state = opt_state._replace(
@@ -183,8 +197,6 @@ def make_train_step(
     ef = getattr(optimizer, "error_feedback", False)
     state_spec: Any = P()
     if ef:
-        from chainermn_tpu.optimizers import _ErrorFeedbackState
-
         state_spec = TrainState(
             params=P(),
             opt_state=_ErrorFeedbackState(
@@ -245,23 +257,9 @@ def make_train_step(
             grads = allreduce_gradients(grads, comm)
         opt_in = state.opt_state
         if ef:
-            # Validate the stacked-layout contract LOUDLY at trace time
-            # (a state from optimizer.init(params) is unstacked — the
-            # mistake must name its fix, not surface as a reshape error
-            # deep in the quantizer), then hand the optimizer its single
-            # supported layout: this slot's squeezed residual.
-            for e, g in zip(jax.tree.leaves(opt_in.residual),
-                            jax.tree.leaves(grads)):
-                if e.shape != (1,) + g.shape:
-                    raise ValueError(
-                        "error-feedback residual leaf has per-shard "
-                        f"shape {e.shape}, expected {(1,) + g.shape} — "
-                        "build the state with create_train_state(...) "
-                        "(it stacks the residual [n_slots, ...] sharded "
-                        "over the communicator's grad axes); a bare "
-                        "optimizer.init(params) state cannot be carried "
-                        "by make_train_step"
-                    )
+            # Hand the optimizer its single supported layout: this
+            # slot's squeezed residual (the [n_slots, ...] layout is
+            # validated host-side before the jitted call).
             opt_in = opt_in._replace(
                 residual=jax.tree.map(lambda e: e[0], opt_in.residual)
             )
@@ -291,7 +289,32 @@ def make_train_step(
         out_specs=(state_spec, P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    jitted = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    if not ef:
+        return jitted
+
+    def step_with_residual_check(state, batch):
+        # Host-side shape gate BEFORE shard_map applies its specs: a
+        # bare optimizer.init() state (unstacked residual) would
+        # otherwise die in a generic divisibility/rank sharding error
+        # that never names the real mistake.
+        for e, p_leaf in zip(jax.tree.leaves(state.opt_state.residual),
+                             jax.tree.leaves(state.params)):
+            eshape = np.shape(e)
+            if not (len(eshape) == np.ndim(p_leaf) + 1
+                    and eshape[0] == comm.size
+                    and eshape[1:] == np.shape(p_leaf)):
+                raise ValueError(
+                    "error-feedback residual leaf has shape "
+                    f"{eshape}, expected {(comm.size,) + np.shape(p_leaf)} "
+                    "(stacked per mesh slot) — build the state with "
+                    "create_train_state(...); a bare "
+                    "optimizer.init(params) state cannot be carried by "
+                    "make_train_step"
+                )
+        return jitted(state, batch)
+
+    return step_with_residual_check
 
 
 def make_eval_step(

@@ -729,8 +729,17 @@ class TestErrorFeedback:
         step = make_train_step(
             lambda p, b: jnp.sum(p["w"] * b[0]), opt, comm, donate=False
         )
-        with pytest.raises(Exception, match="create_train_state"):
+        with pytest.raises(ValueError, match="create_train_state"):
             step(bad_state, jnp.ones((N, 8)))
+        # Non-divisible / scalar-leaf shapes must hit the SAME message,
+        # not a generic shard_map divisibility error.
+        params6 = {"w": jnp.zeros((6,), jnp.float32)}
+        bad6 = TrainState(
+            params=params6, opt_state=opt.init(params6),
+            step=jnp.zeros((), jnp.int32), model_state=(),
+        )
+        with pytest.raises(ValueError, match="create_train_state"):
+            step(bad6, jnp.ones((N, 6)))
 
     def test_composes_with_double_buffering(self):
         """EF + double buffering: staleness-1 semantics intact (step 0
