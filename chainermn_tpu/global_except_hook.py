@@ -42,15 +42,37 @@ def _global_except_hook(exctype, value, tb) -> None:
         sys.stderr.write("*****************************************************\n\n")
         sys.stderr.flush()
         if nprocs is not None and nprocs > 1:
+            # BOUNDED clean-shutdown attempt: jax.distributed.shutdown()
+            # waits at a coordination shutdown barrier for ALL tasks —
+            # but the peers cannot reach it, they are blocked in
+            # collectives waiting on THIS process. Unbounded, that is a
+            # deadlock: our sockets stay open, peers never get EOF,
+            # nobody exits (measured in the crash-teardown drill: 3-way
+            # wedge until coordination timeouts, leader hung forever).
+            # A daemon thread + short join keeps the attempt best-effort;
+            # the hard exit below is the real MPI_Abort.
             try:
-                import jax
+                import threading
 
-                jax.distributed.shutdown()
-            except Exception:
-                pass
-            # Hard exit: the coordinator notices the death and peers abort
-            # (the reference's MPI_Abort equivalent).
-            os._exit(1)
+                def _try_shutdown():
+                    try:
+                        import jax
+
+                        jax.distributed.shutdown()
+                    except Exception:
+                        pass
+
+                t = threading.Thread(target=_try_shutdown, daemon=True)
+                t.start()
+                t.join(5.0)
+            finally:
+                # Hard exit UNCONDITIONALLY (even if the thread could
+                # not start): fds close, peers' host-plane recvs EOF,
+                # their own hooks fire — death propagates promptly (the
+                # reference's MPI_Abort equivalent). Falling through to
+                # a normal exit would hit jax's atexit shutdown barrier
+                # and re-create the deadlock.
+                os._exit(1)
     except Exception:
         # The hook itself must never mask the original error.
         sys.__excepthook__(exctype, value, tb)

@@ -318,6 +318,30 @@ def case_sharded_checkpoint():
         np.testing.assert_allclose(np.asarray(s.data), global_np[s.index])
 
 
+def case_crash_teardown():
+    """One rank's uncaught Python exception must tear the WHOLE job down
+    (the reference's MPI_Abort story, ``global_except_hook.py`` (dagger),
+    SURVEY L8): rank 1 raises outside any collective; the peers sit in a
+    host-plane barrier whose sockets die with the crashed process, their
+    own hook fires, and every rank exits nonzero with the rank-tagged
+    banner — promptly, not by coordination-timeout."""
+    from chainermn_tpu import create_communicator, global_except_hook
+
+    comm = create_communicator("xla")
+    # The prompt-teardown claim rests on the native TCP plane (socket
+    # EOF when a peer dies); fail fast if the launcher didn't wire it.
+    assert comm.host.tcp is not None, "case needs MP_TCP_COORD"
+    global_except_hook._add_hook()
+    print("MP_CRASH_READY", flush=True)
+    if RANK == 1:
+        import time
+
+        time.sleep(0.5)  # let peers reach the barrier first
+        raise RuntimeError("deliberate crash for the teardown drill")
+    comm.barrier()  # dies when rank 1's sockets close
+    print("MP_CASE_OK", flush=True)  # must NOT be reached
+
+
 def case_resize_restore():
     """World-resize restore (beyond the reference's static MPI world):
     phase 1 saves a SHARDED state from a small world; phase 2 restores

@@ -137,6 +137,26 @@ def test_mp_preemption(tmp_path):
     assert all("_5.npz" in s for s in saved), saved
 
 
+def test_mp_crash_tears_down_whole_job():
+    """The except-hook's MPI_Abort parity, measured on real processes
+    (round-4: the unit test only checked installation): rank 1 raises,
+    and EVERY rank must exit — promptly and nonzero — with the crasher
+    carrying the rank-tagged banner. The harness is expected to REPORT
+    failure here; the assertion inspects its evidence."""
+    with pytest.raises(AssertionError) as e:
+        run_workers("crash_teardown", n_procs=3, local_devices=2,
+                    timeout=120, infra_retries=0,
+                    setup_factory=_fresh_ports)
+    msg = str(e.value)
+    assert "failed on 3/3 ranks" in msg, msg[:600]
+    assert "uncaught exception on process 1" in msg, msg[:600]
+    assert "deliberate crash for the teardown drill" in msg
+    # nobody reached past the barrier, and nobody timed out (prompt
+    # teardown through the closed sockets, not a 120 s hang)
+    assert "MP_CASE_OK" not in msg
+    assert "<<TIMED OUT>>" not in msg
+
+
 def test_mp_resize_restore(tmp_path):
     """Save sharded state with a 2-process world, restore into a
     4-process world with different shard boundaries (round-4 beyond
