@@ -970,8 +970,8 @@ def _bench_transformer(comm, on_accel: bool):
     the flash-attention kernel, double buffering, per-block remat
     (dots-saveable policy) and the fused chunked LM head
     (``lm_loss_fused`` — the [B,T,vocab] logits tensor never hits HBM).
-    MFU comes from XLA's own cost analysis of the compiled per-device
-    module, same method as the ResNet headline metric."""
+    MFU uses MODEL flops (6P/token + attention), not cost analysis —
+    see the note at the bottom of this function."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -982,16 +982,35 @@ def _bench_transformer(comm, on_accel: bool):
     from chainermn_tpu.models import TransformerLM, lm_loss_fused
     from chainermn_tpu.ops.flash_attention import flash_attention
 
+    knob_fields = {}
     if on_accel:
         # LM-scale config (VERDICT r2 item 3): 8L / d1024 / 16H / ff4096,
-        # T=2048 — ~134M params incl. the 32k tied embedding.
-        B, T, steps = 16, 2048, 10
+        # T=2048 — ~134M params incl. the 32k tied embedding. Perf knobs
+        # adoptable from the sweep's winner without a code edit
+        # (examples/transformer/sweep_mfu.py); MFU here uses MODEL flops
+        # (6P/token), so remat granularity never inflates it. Non-default
+        # knob values are recorded in the artifact.
+        remat_mode = os.environ.get("CHAINERMN_BENCH_TF_REMAT", "dots")
+        if remat_mode not in ("none", "dots", "nothing"):
+            raise ValueError(
+                "CHAINERMN_BENCH_TF_REMAT must be none|dots|nothing, "
+                f"got {remat_mode!r}"
+            )
+        B = int(os.environ.get("CHAINERMN_BENCH_TF_BATCH", "16"))
+        n_chunks = int(os.environ.get("CHAINERMN_BENCH_TF_CHUNKS", "16"))
+        T, steps = 2048, 10
         model = TransformerLM(
             num_layers=8, d_model=1024, num_heads=16, d_ff=4096,
-            max_len=2048, remat=True, return_hidden=True,
+            max_len=2048, remat=remat_mode != "none",
+            remat_policy="dots" if remat_mode != "nothing" else "nothing",
+            return_hidden=True,
         )
-        n_chunks = 16
         cfg = "8L-d1024-ff4096-v32k"
+        # ALWAYS recorded (defaults included) so the carried-result
+        # machinery compares like with like — same rule as the ResNet
+        # knobs.
+        knob_fields = {"tf_remat": remat_mode, "tf_batch": B,
+                       "tf_chunks": n_chunks}
     else:
         B, T, steps = 2, 128, 2
         model = TransformerLM(vocab_size=512, num_layers=2, d_model=64,
@@ -1076,8 +1095,11 @@ def _bench_transformer(comm, on_accel: bool):
         "transformer_step_ms": round(dt * 1e3, 2),
         "transformer_params_m": round(n_params / 1e6, 1),
         "transformer_config": (
-            f"{cfg} B{B}xT{T} flash+double-buffer+remat+fused-head"
+            f"{cfg} B{B}xT{T} flash+double-buffer"
+            + (f"+remat[{model.remat_policy}]" if model.remat else "")
+            + "+fused-head"
         ),
+        **knob_fields,
     }
     peak = _peak_flops(jax.devices()[0].device_kind)
     if peak:
