@@ -1596,6 +1596,15 @@ def _run_bench(mode: str) -> None:
         out["attn_error"] = f"{type(e).__name__}: {e}"[:200]
     print(json.dumps(out), flush=True)
 
+    # Early on purpose (round-4 VERDICT item 7): a Mosaic layout
+    # rejection must reach the artifact even if the budget cuts the
+    # expensive transformer/native phases below.
+    try:
+        out.update(_bench_kernel_sweep(on_accel))
+    except Exception as e:
+        out["kernel_sweep_error"] = f"{type(e).__name__}: {e}"[:200]
+    print(json.dumps(out), flush=True)
+
     try:
         out.update(_bench_double_buffering(comm, on_accel))
     except Exception as e:
@@ -1618,12 +1627,6 @@ def _run_bench(mode: str) -> None:
         out.update(_bench_moe_dispatch(on_accel))
     except Exception as e:
         out["moe_dispatch_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
-
-    try:
-        out.update(_bench_kernel_sweep(on_accel))
-    except Exception as e:
-        out["kernel_sweep_error"] = f"{type(e).__name__}: {e}"[:200]
     print(json.dumps(out), flush=True)
 
     # Last on purpose: this one spawns fresh child processes whose backend
