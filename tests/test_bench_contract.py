@@ -1,0 +1,50 @@
+"""Driver-contract pins for bench.py: the FINAL stdout line must stay a
+single compact JSON object that fits (with margin) inside the driver's
+2000-char tail-capture window, whatever rows/notes/carried blobs the run
+accumulated (the round-1 artifacts went red precisely because a fat line
+got truncated into unparseable JSON)."""
+
+import contextlib
+import io
+import json
+
+import bench
+
+
+def test_compact_line_fits_tail_window(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_DETAILS_PATH",
+                        str(tmp_path / "details.json"))
+    # Worst-case: every compact key present, fat note/error strings, a
+    # carried blob with many older-run rows.
+    result = {k: 123456.789 for k in bench._COMPACT_KEYS}
+    result.update(
+        metric="resnet50_images_per_sec",
+        unit="images/sec",
+        device_kind="TPU v5 lite",
+        bench_note="x" * 500,
+        error="y" * 500,
+        last_good_tpu={
+            "value": 2459.12, "mfu": 0.2998, "age_hours": 123.5,
+            "stale": True, "measured_at": "2026-07-31T03:31:43Z",
+            "carried_keys": {
+                "keys": [f"k{i}" for i in range(30)],
+                "stamps": {"k0": "2026-07-30T01:00:00Z"},
+            },
+        },
+        # Fat non-compact rows must NOT leak into the line at all.
+        allreduce_curve=[{"mib": 512, "busbw_gbps": 1.0}] * 8,
+        kernel_sweep=[{"kernel": "causal_fwd", "ok": True}] * 8,
+    )
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench._emit_final(result)
+    line = buf.getvalue().strip().splitlines()[-1]
+    assert len(line) < 1900, len(line)
+    parsed = json.loads(line)  # a single well-formed object
+    assert parsed["metric"] == "resnet50_images_per_sec"
+    assert "allreduce_curve" not in parsed
+    assert "kernel_sweep" not in parsed
+    assert parsed["details"] == "BENCH_DETAILS.json"
+    # the full details file holds everything
+    full = json.load(open(tmp_path / "details.json"))
+    assert "allreduce_curve" in full and "kernel_sweep" in full
