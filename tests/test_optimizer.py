@@ -788,3 +788,40 @@ class TestErrorFeedback:
             np.asarray(jax.tree.leaves(new_state.residual)[0]),
             np.zeros(4),
         )
+
+    def test_train_step_ef_on_hierarchical_mesh(self):
+        """EF through the trainer on a TWO-axis ('inter','intra') mesh:
+        the residual shards over the flattened axes tuple and the
+        quantized mean still tracks the exact mean."""
+        from chainermn_tpu.training.train_step import (
+            create_train_state,
+            make_train_step,
+        )
+
+        comm = create_communicator(
+            "hierarchical", devices=jax.devices("cpu")[:N],
+            allreduce_grad_dtype=jnp.int8,
+        )
+        rng = np.random.RandomState(23)
+        grads_np = (rng.randn(N, 4) * 0.01).astype(np.float32)
+        grads_np[0, :] = 0.9
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8, error_feedback=True,
+        )
+        state = create_train_state(params, opt, comm)
+        assert jax.tree.leaves(state.opt_state.residual)[0].shape == (N, 4)
+
+        def loss_fn(p, batch):
+            return jnp.sum(p["w"] * batch[0])
+
+        step = make_train_step(loss_fn, opt, comm, donate=False)
+        batch = jnp.asarray(grads_np)
+        steps = 20
+        for _ in range(steps):
+            state, _ = step(state, batch)
+        exact = -steps * grads_np.mean(0)
+        err = np.abs(np.asarray(state.params["w"]) - exact).max()
+        quantum = np.abs(grads_np).max() / 127.0
+        assert err < 4 * quantum, (err, quantum)
