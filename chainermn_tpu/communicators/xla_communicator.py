@@ -178,10 +178,11 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
 
         if compress_dtype is None:
             compress_dtype = self.allreduce_grad_dtype
-        # int8 selects the two-phase quantized wire (summing int8 through
-        # the two-level pipeline would overflow): float buckets PACK in
-        # f32 and reduce via int8_allreduce_mean — the flat-buffer
-        # discipline is kept, so tiny bias/scale leaves still ride one
+        # int8 selects the quantized wire (summing int8 through the
+        # two-level pipeline would overflow): float buckets PACK in f32
+        # and reduce via int8_two_level_allreduce_mean — exact over
+        # intra, int8 only over inter — keeping the flat-buffer
+        # discipline, so tiny bias/scale leaves still ride one
         # collective per ~64 MB bucket instead of one per leaf.
         int8_wire = (compress_dtype is not None
                      and jnp.dtype(compress_dtype) == jnp.dtype(jnp.int8))
@@ -215,7 +216,7 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
                 g.dtype, jnp.floating
             ):
                 # int8 wire: buckets pack in f32; quantization happens
-                # inside int8_allreduce_mean per bucket.
+                # inside int8_two_level_allreduce_mean per bucket.
                 return (jnp.dtype(jnp.float32) if int8_wire
                         else jnp.dtype(compress_dtype))
             return jnp.dtype(g.dtype)
@@ -249,11 +250,17 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
                     [leaves[i].astype(dt).ravel() for i in bidx]
                 )
                 if int8_wire and jnp.issubdtype(dt, jnp.floating):
+                    # Topology-aware: exact over intra (ICI), the int8
+                    # wire's two rounding stages only over inter (DCN)
+                    # — compression where bandwidth is scarce, no
+                    # quantization noise from the intra reduction.
                     from chainermn_tpu.parallel.collectives import (
-                        int8_allreduce_mean,
+                        int8_two_level_allreduce_mean,
                     )
 
-                    red = int8_allreduce_mean(flat, (inter_ax, intra_ax))
+                    red = int8_two_level_allreduce_mean(
+                        flat, intra_ax, inter_ax
+                    )
                 else:
                     red = two_level_allreduce(flat, intra_ax, inter_ax)
                 off = 0

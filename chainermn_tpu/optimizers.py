@@ -207,7 +207,15 @@ class MultiNodeOptimizer:
         discipline as the two-dimensional communicator's pipeline —
         tiny bias/scale leaves must not each pay their own collective);
         non-float leaves take the exact pmean, matching the non-EF
-        path's reference-parity behaviour."""
+        path's reference-parity behaviour.
+
+        Known trade-off: EF uses the FLAT int8 wire over all grad axes
+        even on hierarchical meshes (the topology-aware two-level
+        scheme quantizes the intra-summed SHARD, whose error lives at
+        shard shape — feeding it back would need shard-shaped residual
+        state or an extra f32 gather, both worse than the noise saved);
+        the non-EF int8 path on TwoDimensionalCommunicator IS
+        topology-aware."""
         from chainermn_tpu.parallel.collectives import (
             axes_bound,
             int8_allreduce_mean_with_feedback,

@@ -825,3 +825,127 @@ class TestErrorFeedback:
         err = np.abs(np.asarray(state.params["w"]) - exact).max()
         quantum = np.abs(grads_np).max() / 127.0
         assert err < 4 * quantum, (err, quantum)
+
+
+class TestInt8TwoLevel:
+    """Topology-aware quantized reduction (round-4): exact psum_scatter
+    over intra (ICI), int8 two-phase ONLY over inter (DCN), exact
+    all_gather back — the quantized rendering of the reference's
+    TwoDimensionalCommunicator algorithm."""
+
+    def _mesh_comm(self):
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices("cpu")[:N]).reshape(2, 4)
+        return Mesh(devs, ("inter", "intra"))
+
+    def test_matches_exact_mean_within_single_stage_noise(self):
+        from chainermn_tpu.parallel.collectives import (
+            int8_two_level_allreduce_mean,
+        )
+
+        mesh = self._mesh_comm()
+        rng = np.random.RandomState(31)
+        x = jnp.asarray(rng.randn(N, 501).astype(np.float32))  # odd size
+        spec = P(("inter", "intra"))
+
+        def run(fn):
+            def body(xl):
+                return fn(xl[0])[None]
+
+            return np.asarray(jax.jit(shard_map(
+                body, mesh=mesh, in_specs=spec, out_specs=spec,
+                check_vma=False,
+            ))(x))
+
+        quant = run(lambda v: int8_two_level_allreduce_mean(
+            v, "intra", "inter"))
+        exact = run(lambda v: jax.lax.pmean(v, ("inter", "intra")))
+        amax = np.abs(np.asarray(x)).max()
+        # intra stays exact; only the inter stage quantizes (2 roundings
+        # of the int8 scheme over the intra-summed shard)
+        np.testing.assert_allclose(quant[0], exact[0],
+                                   atol=2 * N * amax / 100)
+        for r in range(1, N):
+            np.testing.assert_array_equal(quant[r], quant[0])
+
+    def test_topology_structure(self):
+        """Structural certificate: exact reduce_scatter + all_gather ride
+        INTRA; the int8 all_to_all + payload gather ride INTER only."""
+        from jax.extend import core as jex_core
+
+        from chainermn_tpu.parallel.collectives import (
+            int8_two_level_allreduce_mean,
+        )
+        from chainermn_tpu.testing import _subjaxprs
+
+        closed = jax.make_jaxpr(
+            lambda g: int8_two_level_allreduce_mean(g, "intra", "inter"),
+            axis_env=[("inter", 2), ("intra", 4)],
+        )(jnp.zeros((1024,), jnp.float32))
+
+        seen = []
+
+        def walk(jaxpr):
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name in ("reduce_scatter", "all_gather",
+                                          "all_to_all"):
+                    axes = eqn.params.get("axis_name")
+                    dt = (eqn.invars[0].aval.dtype
+                          if not isinstance(eqn.invars[0], jex_core.Literal)
+                          else eqn.invars[0].val.dtype)
+                    seen.append((eqn.primitive.name, axes, str(dt)))
+                for _, sub in _subjaxprs(eqn.params):
+                    walk(sub)
+
+        walk(closed.jaxpr)
+        def axes_of(entry):
+            a = entry[1]
+            return a if isinstance(a, tuple) else (a,)
+
+        a2a = [e for e in seen if e[0] == "all_to_all"]
+        assert a2a and all(axes_of(e) == ("inter",) and e[2] == "int8"
+                           for e in a2a), seen
+        rs = [e for e in seen if e[0] == "reduce_scatter"]
+        assert rs and all(axes_of(e) == ("intra",) and e[2] == "float32"
+                          for e in rs), seen
+        int8_gathers = [e for e in seen
+                        if e[0] == "all_gather" and e[2] == "int8"]
+        assert int8_gathers and all(axes_of(e) == ("inter",)
+                                    for e in int8_gathers), seen
+
+    def test_gradient_is_straight_through(self):
+        """CLAUDE.md values-AND-gradients invariant: jax.grad through
+        the topology-aware quantized reduction equals jax.grad through
+        the exact two-axis pmean (straight-through custom VJP)."""
+        from chainermn_tpu.parallel.collectives import (
+            int8_two_level_allreduce_mean,
+        )
+
+        mesh = self._mesh_comm()
+        rng = np.random.RandomState(32)
+        x = jnp.asarray(rng.randn(N, 16).astype(np.float32))
+        W = jnp.asarray(rng.randn(N, 16).astype(np.float32))
+        spec = P(("inter", "intra"))
+
+        def grad_of(red):
+            def body(xl):
+                def lf(v):
+                    y = red(v[0])
+                    ii = jax.lax.axis_index("inter")
+                    jj = jax.lax.axis_index("intra")
+                    idx = ii * 4 + jj
+                    return jnp.sum(y * jax.lax.dynamic_index_in_dim(
+                        W, idx, 0, keepdims=False))
+
+                return jax.grad(lf)(xl)
+
+            return np.asarray(jax.jit(shard_map(
+                body, mesh=mesh, in_specs=spec, out_specs=spec,
+                check_vma=False,
+            ))(x))
+
+        g_quant = grad_of(lambda v: int8_two_level_allreduce_mean(
+            v, "intra", "inter"))
+        g_exact = grad_of(lambda v: jax.lax.pmean(v, ("inter", "intra")))
+        np.testing.assert_allclose(g_quant, g_exact, rtol=1e-6)
