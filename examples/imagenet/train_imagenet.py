@@ -73,9 +73,13 @@ def main(argv=None):
                    help="resnet50 input stem; space_to_depth trades the "
                         "MXU-hostile 3-channel 7x7 conv for a 48-channel "
                         "3x3 (measured +16%% img/s on v5e)")
-    p.add_argument("--remat", action="store_true",
-                   help="rematerialize residual blocks (trade FLOPs for "
-                        "activation memory; enables bigger per-chip batches)")
+    p.add_argument("--remat", nargs="?", const="full",
+                   default=None, choices=["full", "conv"],
+                   help="rematerialize residual blocks: 'full' (save only "
+                        "block inputs — max memory saving) or 'conv' (save "
+                        "conv outputs, recompute the BN/relu chain — the "
+                        "byte-cutting mode from the docs/benchmarks.md "
+                        "roofline). Bare --remat means 'full' (back-compat)")
     p.add_argument("--profile", default=None,
                    help="directory for a jax.profiler trace of iters 10-20")
     p.add_argument("--train-root", default=None)
@@ -98,6 +102,8 @@ def main(argv=None):
     kw = {}
     if args.remat:
         kw["remat"] = True
+        if args.remat == "conv":
+            kw["remat_policy"] = "conv"
     if args.arch == "resnet50":
         kw["stem"] = args.stem
     model = ARCHS[args.arch](comm.bn_axis_name, **kw)
