@@ -349,13 +349,25 @@ class CommunicatorBase:
         int8_wire = (dtype is not None
                      and jnp.dtype(dtype) == jnp.dtype(jnp.int8))
 
-        def quantize_roundtrip(g):
+        def quantize_roundtrip(g, *, per_member: bool):
             # One quantization stage of the int8 wire (the in-jit path's
-            # two stages live in int8_allreduce_mean): max-abs scale,
-            # round, dequantize. A bare astype(int8) would TRUNCATE
-            # sub-1.0 gradients to zero.
-            amax = jnp.max(jnp.abs(g), axis=tuple(range(1, g.ndim)),
-                           keepdims=True)
+            # two stages live in _int8_core): max-abs scale, round,
+            # dequantize. Stage 1 gets PER-MEMBER scales — the stacked
+            # dim-0 slices here ARE the per-rank buffers, and _int8_core
+            # has each member scale by its OWN amax (a global scale over
+            # the stack would truncate small-magnitude ranks to zero —
+            # the very failure a bare astype(int8) has). Stage 2 (the
+            # reduced buffer, no rank dim) gets one global scale, like
+            # the wire's requantize-the-shard. A 1-D stacked leaf means
+            # scalar per-rank buffers, whose roundtrip is exact — the
+            # wire's own behaviour on 1-element buffers, not a bug
+            # (the in-jit path quantizes per leaf: a scalar per-rank
+            # buffer dequantizes exactly there as well).
+            if per_member:
+                amax = jnp.max(jnp.abs(g), axis=tuple(range(1, g.ndim)),
+                               keepdims=True)
+            else:
+                amax = jnp.max(jnp.abs(g))
             scale = jnp.maximum(amax, 1e-30) / 127.0
             return jnp.clip(jnp.round(g / scale), -127, 127) * scale
 
@@ -367,9 +379,10 @@ class CommunicatorBase:
                 # quantize-dequantize (stage 1), exact mean, one final
                 # quantize-dequantize (stage 2) — same two-rounding
                 # noise model as the in-jit scheme without its chunking.
-                g = quantize_roundtrip(g.astype(jnp.float32))
+                g = quantize_roundtrip(g.astype(jnp.float32),
+                                       per_member=True)
                 out = self.allreduce(g, op=op)
-                return quantize_roundtrip(out[None])[0].astype(orig)
+                return quantize_roundtrip(out, per_member=False).astype(orig)
             if dtype is not None and jnp.issubdtype(orig, jnp.floating):
                 g = g.astype(dtype)
             out = self.allreduce(g, op=op)

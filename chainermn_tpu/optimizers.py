@@ -188,14 +188,24 @@ class MultiNodeOptimizer:
 
     def init(self, params: PyTree):
         state = self.actual_optimizer.init(params)
-        zeros = jax.tree.map(jnp.zeros_like, params)
         if self.double_buffering:
             state = _DoubleBufferState(
-                inner=state, communicated_grads=zeros,
+                inner=state,
+                communicated_grads=jax.tree.map(jnp.zeros_like, params),
                 step=jnp.zeros((), jnp.int32),
             )
         if self.error_feedback:
-            state = _ErrorFeedbackState(inner=state, residual=zeros)
+            # Residual lives in float32 regardless of param dtype: with
+            # bf16 params a bf16 residual would itself drop ~2/3 of the
+            # quantization error being fed back each step, weakening the
+            # cumulative-bias-removal guarantee EF exists for. One
+            # params-sized f32 buffer of optimizer state.
+            state = _ErrorFeedbackState(
+                inner=state,
+                residual=jax.tree.map(
+                    lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
+                ),
+            )
         return state
 
     def _reduce_with_feedback(self, grads: PyTree, residual: PyTree):

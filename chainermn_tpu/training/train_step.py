@@ -298,8 +298,17 @@ def make_train_step(
         # bare optimizer.init() state (unstacked residual) would
         # otherwise die in a generic divisibility/rank sharding error
         # that never names the real mistake.
-        for e, p_leaf in zip(jax.tree.leaves(state.opt_state.residual),
-                             jax.tree.leaves(state.params)):
+        e_leaves = jax.tree.leaves(state.opt_state.residual)
+        p_leaves = jax.tree.leaves(state.params)
+        if len(e_leaves) != len(p_leaves):
+            raise ValueError(
+                "error-feedback residual has "
+                f"{len(e_leaves)} leaves but params has {len(p_leaves)} "
+                "— a partially restored or hand-edited opt_state cannot "
+                "be carried by make_train_step; rebuild it with "
+                "create_train_state(...)"
+            )
+        for e, p_leaf in zip(e_leaves, p_leaves):
             eshape = np.shape(e)
             if not (len(eshape) == np.ndim(p_leaf) + 1
                     and eshape[0] == comm.size

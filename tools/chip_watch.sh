@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-long chip pursuit (round-5 VERDICT ask #1): poll the relay
+# endpoint cheaply (2 s TCP check — no JAX import, no hang) and the
+# moment it answers, run the full on-chip capture. Every poll leaves a
+# record in tools/capture_logs/probes.jsonl, so even an all-failed round
+# ships a diagnosis trail instead of silence.
+#
+# Usage: tools/chip_watch.sh [interval_s] [max_hours]
+set -u
+cd "$(dirname "$0")/.."
+interval=${1:-120}
+max_hours=${2:-11}
+deadline=$(( $(date +%s) + max_hours * 3600 ))
+mkdir -p tools/capture_logs
+log=tools/capture_logs/watch.log
+echo "[watch $(date -u +%H:%M:%S)] start: interval=${interval}s max=${max_hours}h" >> "$log"
+captures=0
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  python tools/probe_tpu.py 180 > /dev/null 2>&1
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
+    echo "[watch $(date -u +%H:%M:%S)] CHIP UP — launching capture" >> "$log"
+    bash tools/on_chip_capture.sh >> "$log" 2>&1
+    captures=$((captures + 1))
+    echo "[watch $(date -u +%H:%M:%S)] capture #$captures done" >> "$log"
+    # One full capture is the round's goal; keep a slow heartbeat after
+    # so a later flap is still recorded, but don't re-run the capture.
+    interval=1800
+  else
+    echo "[watch $(date -u +%H:%M:%S)] probe rc=$rc" >> "$log"
+  fi
+  sleep "$interval"
+done
+echo "[watch $(date -u +%H:%M:%S)] deadline reached (captures=$captures)" >> "$log"

@@ -53,6 +53,11 @@ if std:
     rb = min(std, key=lambda r: r["step_ms"])
     env.append(f"CHAINERMN_BENCH_RESNET_REMAT={rb['remat']}")
     env.append(f"CHAINERMN_BENCH_RESNET_BATCH={rb['batch']}")
+    # Adopt donate too: the sweep sweeps it, bench.py defaults it off —
+    # without this the re-run can quietly disagree with the winner row.
+    env.append(
+        "CHAINERMN_BENCH_RESNET_DONATE="
+        + ("true" if rb.get("donate", False) else "false"))
 tf_rows = rows_of(sys.argv[2])
 tb = min(tf_rows, key=lambda r: r["step_ms"]) if tf_rows else None
 if tb:

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Diagnostic TPU probe (round-5 VERDICT ask #1): record WHAT the probe
+sees, not just that it failed.
+
+The tunnelled-TPU init path (axon PJRT plugin, loopback relay) has two
+observable stages:
+
+1. **relay endpoint** — the plugin's RPCs dial ``127.0.0.1:8082`` (state
+   session) / ``:8083`` (device enumeration). When the tunnel is down
+   these refuse instantly, but the gRPC channel inside PJRT retries with
+   backoff until deadline — which is why a naive ``jax.devices()`` probe
+   *hangs* for its full timeout instead of failing fast. A 2-second TCP
+   connect tells us the truth immediately.
+2. **backend init** — only attempted when the relay accepts: subprocess
+   ``jax.devices()`` with a timeout, stderr captured, so a hang *past* a
+   live endpoint is distinguishable from a dead endpoint.
+
+Each invocation appends one JSON record to
+``tools/capture_logs/probes.jsonl`` and prints it; ``bench.py`` folds the
+latest record into ``BENCH_DETAILS.json`` so failed rounds still carry a
+diagnosis trail (round-4 verdict: "probe failure is endured, not
+diagnosed").
+
+Exit code: 0 = chip answered, 2 = relay down, 3 = relay up but init
+failed/hung.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG_DIR = os.path.join(REPO, "tools", "capture_logs")
+RELAY_PORTS = (8082, 8083)
+
+_FINGERPRINT_VARS = (
+    "JAX_PLATFORMS",
+    "PALLAS_AXON_TPU_GEN",
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "AXON_LOOPBACK_RELAY",
+    "TPU_SKIP_MDS_QUERY",
+    "PYTHONPATH",
+)
+
+
+def _env_fingerprint() -> dict:
+    fp = {k: os.environ.get(k) for k in _FINGERPRINT_VARS}
+    try:
+        import importlib.metadata as md
+
+        fp["jax"] = md.version("jax")
+        fp["libtpu"] = md.version("libtpu")
+    except Exception:  # pragma: no cover - metadata always present in image
+        pass
+    return fp
+
+
+def _tcp_check(port: int, timeout: float = 2.0) -> dict:
+    t0 = time.time()
+    s = socket.socket()
+    s.settimeout(timeout)
+    try:
+        s.connect(("127.0.0.1", port))
+        return {"port": port, "ok": True,
+                "elapsed_s": round(time.time() - t0, 3)}
+    except OSError as e:
+        return {"port": port, "ok": False, "error": type(e).__name__,
+                "detail": str(e)[:120],
+                "elapsed_s": round(time.time() - t0, 3)}
+    finally:
+        s.close()
+
+
+def _init_check(timeout: float) -> dict:
+    """Subprocess jax.devices() with captured stderr — only worth paying
+    for when the relay endpoint accepts connections."""
+    code = (
+        "import json, time, jax; t0 = time.time(); d = jax.devices(); "
+        "print(json.dumps({'devices': [str(x) for x in d], "
+        "'platform': d[0].platform, 'kind': d[0].device_kind, "
+        "'n': len(d), 'elapsed_s': round(time.time() - t0, 1)}))"
+    )
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        return {
+            "stage": "backend_init", "ok": False, "hung": True,
+            "timeout_s": timeout,
+            "stderr_tail": ((e.stderr or b"").decode("utf-8", "replace")
+                            if isinstance(e.stderr, bytes)
+                            else (e.stderr or ""))[-2000:],
+        }
+    out: dict = {"stage": "backend_init", "ok": p.returncode == 0,
+                 "elapsed_s": round(time.time() - t0, 1)}
+    if p.returncode == 0:
+        try:
+            out.update(json.loads(p.stdout.strip().splitlines()[-1]))
+        except Exception:
+            out["stdout_tail"] = p.stdout[-500:]
+    else:
+        out["returncode"] = p.returncode
+        out["stderr_tail"] = p.stderr[-2000:]
+    return out
+
+
+def probe(init_timeout: float = 180.0) -> dict:
+    """Run the staged probe; returns the record (also appended to the
+    probes log). Cheap when the relay is down (~2 s, no JAX import)."""
+    rec: dict = {
+        "at": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+        "env": _env_fingerprint(),
+    }
+    # The TCP short-circuit only applies when this process is actually
+    # behind the loopback tunnel — on a direct-libtpu TPU VM or any
+    # other accelerator host those ports mean nothing and init must be
+    # attempted regardless.
+    tunnel_env = bool(os.environ.get("AXON_LOOPBACK_RELAY")
+                      or os.environ.get("PALLAS_AXON_POOL_IPS"))
+    if tunnel_env:
+        rec["relay"] = [_tcp_check(p) for p in RELAY_PORTS]
+    relay_down = tunnel_env and not any(r["ok"] for r in rec["relay"])
+    if relay_down:
+        rec["diagnosis"] = (
+            "relay endpoints 127.0.0.1:8082/:8083 refuse connections — "
+            "tunnel down; PJRT gRPC channel would retry-with-backoff "
+            "(the observed jax.devices() hang), no point attempting init"
+        )
+        rec["verdict"] = "relay_down"
+    else:
+        rec["init"] = _init_check(init_timeout)
+        if rec["init"].get("ok") and rec["init"].get("platform") == "cpu":
+            # Init "succeeding" onto the CPU backend is NOT a live chip —
+            # chip_watch.sh keys a full capture off exit code 0.
+            rec["verdict"] = "cpu_only"
+            rec["diagnosis"] = (
+                "backend init reached only the CPU backend — no "
+                "accelerator visible to this process"
+            )
+        elif rec["init"].get("ok"):
+            rec["verdict"] = "chip_up"
+            rec["diagnosis"] = "chip answered"
+        elif rec["init"].get("hung"):
+            rec["verdict"] = "init_hang"
+            rec["diagnosis"] = (
+                "relay endpoint accepts TCP but backend init hung past "
+                f"{init_timeout:.0f}s — wedge is past the tunnel "
+                "(claim/grant or device enumeration); see stderr_tail"
+            )
+        else:
+            rec["verdict"] = "init_error"
+            rec["diagnosis"] = "backend init failed; see stderr_tail"
+    try:
+        # Best-effort side channel: a logging failure (read-only
+        # checkout, full disk) must never veto a chip_up result.
+        os.makedirs(LOG_DIR, exist_ok=True)
+        with open(os.path.join(LOG_DIR, "probes.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    return rec
+
+
+def tail_records(n: int) -> list[dict]:
+    """Newest ``n`` probe records (oldest first) — the single owner of
+    the probes.jsonl location and format; bench.py folds these into
+    BENCH_DETAILS.json as the probe-diagnosis trail."""
+    path = os.path.join(LOG_DIR, "probes.jsonl")
+    try:
+        lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
+        return [json.loads(ln) for ln in lines[-n:]]
+    except (OSError, json.JSONDecodeError):
+        return []
+
+
+def latest_record() -> dict | None:
+    """Most recent probe record, or None."""
+    recs = tail_records(1)
+    return recs[-1] if recs else None
+
+
+if __name__ == "__main__":
+    timeout = float(sys.argv[1]) if len(sys.argv) > 1 else 180.0
+    record = probe(timeout)
+    print(json.dumps(record, indent=2))
+    sys.exit({"chip_up": 0, "relay_down": 2}.get(record["verdict"], 3))
