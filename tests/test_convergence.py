@@ -1,0 +1,197 @@
+"""End-to-end wire-dtype convergence drill (round-5 VERDICT ask #5).
+
+The unit invariants (``test_optimizer.py``: cumulative-error bounds,
+per-rank residuals) say the int8/EF machinery is wired right; THIS file
+says it matters at training level: the same model trained on the
+8-device mesh through the STANDARD trainer under each gradient wire —
+f32, bf16, int8, int8+EF (+ the topology-aware int8 wire on a 2-axis
+mesh) — and the loss curves compared.
+
+The task is deliberately quantization-hostile via DATA HETEROGENEITY,
+the realistic failure mode for a quantized wire: every rank's batch
+carries one adversarial sample whose huge residual (sign alternating
+across ranks, exactly cancelling in the mean) pins that rank's stage-1
+quantization amax ~130x above the honest gradient signal. The honest
+gradients are sub-quantum once training has halved their error, so
+deterministic round-to-nearest kills them EVERY step (the data is fixed
+→ the rounding repeats exactly): bare int8 stalls at a loss floor f32
+never sees, while error feedback accumulates exactly what rounding
+dropped and releases it every few steps — the EF curve must track f32.
+
+Upstream capability analog: the reference's compressed allreduce
+(``allreduce_grad_dtype='float16'``, ``pure_nccl_communicator.py`` †)
+shipped with convergence evidence on MNIST; int8 is beyond-reference and
+gets the sharper drill. Guidance on when the int8 wire pays (DCN-bound
+deployments, with EF) lives in docs/parallelism.md.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from chainermn_tpu import create_communicator, create_multi_node_optimizer
+from chainermn_tpu.training.train_step import (
+    create_train_state,
+    make_train_step,
+)
+
+N = 8
+DIM = 16          # coord 0 is the adversarial channel; 1..15 are honest
+PER_RANK = 9      # 8 honest samples + 1 adversarial per rank
+STEPS = 240
+LR = 0.2
+B_ADV = 3.0       # adversarial feature magnitude (keeps curvature tame)
+S_ADV = 100.0     # adversarial target magnitude (sets the amax)
+
+
+def _per_rank_data():
+    """Fixed per-rank batches, rank-major [N*PER_RANK, DIM].
+
+    Honest samples: x ~ N(0,1) on coords 1..15 (coord 0 dead), target
+    x @ w* with w* = (0, 1, ..., 1). Adversarial sample per rank:
+    x = B_ADV * e0, target eps_r * S_ADV with eps = +1 on the first
+    half of the ranks and -1 on the second (total sum 0, but each
+    CONTIGUOUS half sums to +-4 — so on the 2-axis (inter=2, intra=4)
+    mesh the exact intra stage does NOT cancel it and the int8 inter
+    leg still faces the heterogeneity-pinned amax). Its per-rank
+    gradient lives only on coord 0, magnitude ~B_ADV*S_ADV/PER_RANK
+    ≈ 33 — the persistent amax — while its MEAN over all ranks is
+    exactly 0: no optimum shift, no trainable escape."""
+    rng = np.random.RandomState(11)
+    xs, ys = [], []
+    eps = np.array([+1] * (N // 2) + [-1] * (N // 2), np.float32)
+    for r in range(N):
+        xh = np.zeros((PER_RANK - 1, DIM), np.float32)
+        xh[:, 1:] = rng.randn(PER_RANK - 1, DIM - 1)
+        yh = xh[:, 1:].sum(axis=1)  # w* = 1 on honest coords
+        xa = np.zeros((1, DIM), np.float32)
+        xa[0, 0] = B_ADV
+        ya = np.array([eps[r] * S_ADV], np.float32)
+        xs.append(np.concatenate([xh, xa]))
+        ys.append(np.concatenate([yh, ya]))
+    return jnp.asarray(np.concatenate(xs)), jnp.asarray(np.concatenate(ys))
+
+
+def _train(comm, *, wire, error_feedback=False, steps=STEPS):
+    """Train through the standard trainer under one wire config; returns
+    (loss curve, final weight vector)."""
+    x, y = _per_rank_data()
+
+    def loss_fn(params, batch, model_state):
+        xb, yb = batch
+        pred = xb @ params["w"]
+        return 0.5 * jnp.mean((pred - yb) ** 2), ({}, model_state)
+
+    opt = create_multi_node_optimizer(
+        optax.sgd(LR), comm,
+        allreduce_grad_dtype=wire,
+        error_feedback=error_feedback,
+    )
+    params = {"w": jnp.zeros((DIM,), jnp.float32)}
+    state = create_train_state(params, opt, comm, model_state={})
+    step = make_train_step(loss_fn, opt, comm)
+    losses = []
+    for _ in range(steps):
+        state, metrics = step(state, (x, y))
+        losses.append(float(metrics["loss"]))
+    return np.asarray(losses), np.asarray(jax.tree.leaves(state.params)[0])
+
+
+# Every wire pays the same irreducible floor: the adversarial residuals
+# (+-S_ADV at w0=0) contribute S_ADV^2/(2*PER_RANK) to each rank's batch
+# loss. Comparisons below therefore use EXCESS loss over the f32 curve.
+_FLOOR = S_ADV**2 / (2 * PER_RANK)
+
+
+@pytest.fixture(scope="module")
+def curves():
+    comm = create_communicator("naive")
+    return {
+        "f32": _train(comm, wire=None),
+        "bf16": _train(comm, wire=jnp.bfloat16),
+        "int8": _train(comm, wire=jnp.int8),
+        "int8_ef": _train(comm, wire=jnp.int8, error_feedback=True),
+    }
+
+
+class TestWireConvergence:
+    def test_f32_converges_to_the_floor(self, curves):
+        losses, w = curves["f32"]
+        assert abs(losses[-1] - _FLOOR) < 0.05, (losses[-1], _FLOOR)
+        np.testing.assert_allclose(w[1:], np.ones(DIM - 1), atol=3e-2)
+        assert abs(w[0]) < 0.05  # the adversarial channel stays put
+
+    def test_bf16_tracks_f32(self, curves):
+        # bf16 covers the task's dynamic range: indistinguishable from
+        # f32 at curve level (the reference's fp16 claim, sharper).
+        excess = curves["bf16"][0][-1] - curves["f32"][0][-1]
+        assert abs(excess) < 0.05, excess
+
+    def test_ef_tracks_f32(self, curves):
+        """The headline: EF's whole TAIL tracks f32 — not just the
+        final point."""
+        f32, ef = curves["f32"][0], curves["int8_ef"][0]
+        tail = slice(STEPS - 50, STEPS)
+        excess = ef[tail] - f32[tail]
+        assert np.max(np.abs(excess)) < 0.1, np.max(np.abs(excess))
+
+    def test_bare_int8_stalls_above_ef(self, curves):
+        """Deterministic rounding against the heterogeneity-pinned amax
+        kills the honest gradients: bare int8 plateaus at an excess
+        loss orders of magnitude above EF's."""
+        f32 = curves["f32"][0][-1]
+        ex_int8 = curves["int8"][0][-1] - f32
+        ex_ef = abs(curves["int8_ef"][0][-1] - f32)
+        assert ex_int8 > 50 * max(ex_ef, 1e-4), (ex_int8, ex_ef)
+
+    def test_int8_stall_is_the_honest_coordinates(self, curves):
+        """Mechanism check, not just outcome: int8's shortfall is the
+        honest coordinates stuck ~one quantum from the optimum, and EF
+        recovered exactly those."""
+        quantum = (B_ADV * S_ADV / PER_RANK) / 127.0  # ~0.26
+        w = curves["int8"][1]
+        stall = np.abs(w[1:] - 1.0)
+        assert stall.max() > quantum / 4, stall.max()
+        w_ef = curves["int8_ef"][1]
+        assert np.abs(w_ef[1:] - 1.0).max() < quantum / 4
+
+
+class TestTopologyAwareWireConvergence:
+    def test_two_level_int8_trains_on_two_axis_mesh(self):
+        """The topology-aware wire (exact intra reduction, int8 only on
+        the inter axis) through the same drill on a REAL (2, 4) mesh —
+        the default single-process two_dimensional factorisation is the
+        degenerate (1, 8), whose inter leg never quantizes anything.
+        Each intra group carries one sign of the adversarial eps (the
+        block pattern is chosen for exactly this grouping), so the int8
+        inter leg faces the full heterogeneity-pinned amax: it trains
+        the super-quantum part of the signal AND shows the same
+        sub-quantum stall as the flat wire — the measured reason the
+        docs say 'pair int8 with EF'."""
+        from jax.sharding import Mesh
+
+        from chainermn_tpu.communicators.xla_communicator import (
+            TwoDimensionalCommunicator,
+        )
+
+        devs = np.array(jax.devices("cpu")[:N]).reshape(2, 4)
+        comm2 = TwoDimensionalCommunicator(
+            mesh=Mesh(devs, ("inter", "intra"))
+        )
+        losses, w = _train(comm2, wire=jnp.int8, steps=120)
+        f32_losses, w_f32 = _train(comm2, wire=None, steps=120)
+        # Real progress: nearly all of the trainable loss (the part
+        # above the irreducible adversarial floor) is gone...
+        trainable0 = losses[0] - _FLOOR
+        ex = losses[-1] - f32_losses[-1]
+        assert trainable0 > 1.0  # the task starts with real signal
+        assert ex < 0.05 * trainable0, (ex, trainable0)
+        # ...f32 on the same mesh fully converges (sanity)...
+        np.testing.assert_allclose(w_f32[1:], 1.0, atol=3e-2)
+        # ...and the inter leg genuinely quantized: the sub-quantum
+        # stall is present, unlike the degenerate (1, 8) mesh where the
+        # int8 stage is a no-op and w would match f32 exactly.
+        quantum = (B_ADV * S_ADV / PER_RANK) / 127.0
+        assert np.abs(w[1:] - 1.0).max() > quantum / 8
