@@ -1,0 +1,146 @@
+"""Genuinely concurrent host-plane gradient reduction (round-5 VERDICT
+ask #6: a MEASURED case where double buffering pays).
+
+The in-jit ``double_buffering`` flag removes the data dependency between
+step *t*'s parameter update and step *t*'s collective (certified
+structurally in ``tests/test_optimizer.py``), but whether that turns
+into wall-clock speedup is the RUNTIME's call: XLA:TPU's async
+collectives can exploit it on a multi-chip mesh; XLA:CPU emits
+synchronous ``all-reduce`` and a single chip's psum is a no-op — neither
+can show the win (see docs/benchmarks.md "when to enable it").
+
+This module is the overlap made explicit, on the plane where this
+environment HAS real communication latency: the C++ framed-TCP host mesh
+(the reference's MPI role — ``communicators/_host_comm.py``,
+``native/src/host_comm.cpp``). A background thread runs the host-plane
+allreduce of step *t*'s gradients while the main thread computes step
+*t+1*; the caller applies the reduced gradients one step stale — exactly
+the reference ``_DoubleBufferingOptimizer``'s staleness-1 semantics
+(``optimizers.py`` †) with the side-stream overlap made literal (thread
+instead of CUDA stream; socket I/O and the XLA compute both release the
+GIL, so the overlap is real parallelism, not cooperative scheduling).
+
+Measured: ``tests/test_multiprocess.py::test_mp_async_double_buffer_overlap``
+runs the sequential (compute → blocking allreduce) and double-buffered
+(compute ∥ previous allreduce) loops over 4 real processes — identical
+compute and identical wire bytes in both variants by construction — and
+asserts the overlap speedup.
+
+When to use WHICH double buffering:
+
+- multi-chip TPU mesh, gradient allreduce in-program → the in-jit flag
+  (``create_multi_node_optimizer(double_buffering=True)``); XLA overlaps.
+- gradients crossing a host-plane/DCN wire outside the jitted program
+  (parameter-server-ish deployments, the mp harness, debugging rigs) →
+  this reducer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["AsyncHostGradReducer"]
+
+
+def _tree_sum(a: Any, b: Any) -> Any:
+    return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+class AsyncHostGradReducer:
+    """Staleness-1 gradient reduction over the host plane, with the
+    collective running on a background thread.
+
+    Usage (the double-buffered loop)::
+
+        reducer = AsyncHostGradReducer(comm)
+        for batch in data:
+            grads = compute_grads(params, batch)       # step t
+            stale = reducer.exchange(grads)            # t-1's mean, or
+            if stale is not None:                      # None on step 0
+                params = apply(params, stale)
+
+    ``exchange`` submits this step's gradients and returns the PREVIOUS
+    step's reduced mean — collecting it first, so at most one reduction
+    is ever in flight. ``flush()`` drains the pipeline (returns the last
+    submitted reduction; call once after the loop so no gradient is
+    dropped).
+    """
+
+    def __init__(self, comm, *, average: bool = True,
+                 simulated_dcn_latency_s: float = 0.0) -> None:
+        self._host = comm.host
+        self._n = comm.host.size
+        self._average = average
+        self._latency = simulated_dcn_latency_s
+        self._thread: threading.Thread | None = None
+        self._result: Any = None
+        self._error: BaseException | None = None
+
+    # -- internals -----------------------------------------------------
+
+    def _run(self, grads_np) -> None:
+        try:
+            import time
+
+            t_floor = time.perf_counter() + self._latency
+            total = self._host.allreduce_obj(grads_np, op=_tree_sum)
+            if self._average:
+                total = jax.tree.map(lambda x: x / self._n, total)
+            if self._latency > 0.0:
+                # RTT floor: on loopback the framed-TCP round trip is
+                # CPU-cheap; a DCN hop is a genuine in-flight WAIT. The
+                # floor models that wait (GIL released, like a socket
+                # block), letting single-core hosts exhibit the overlap
+                # a real cross-host wire would show. Applied to the
+                # sync baseline identically (reduce_sync shares this
+                # path), so the comparison stays like-for-like.
+                remaining = t_floor - time.perf_counter()
+                if remaining > 0:
+                    time.sleep(remaining)
+            self._result = total
+        except BaseException as e:  # surfaced on the caller's thread
+            self._error = e
+
+    def _submit(self, grads) -> None:
+        assert self._thread is None, "a reduction is already in flight"
+        # Host-side snapshot BEFORE the thread starts: the caller is free
+        # to donate/overwrite the device buffers afterwards.
+        grads_np = jax.tree.map(lambda g: np.asarray(g), grads)
+        self._thread = threading.Thread(
+            target=self._run, args=(grads_np,), daemon=True
+        )
+        self._thread.start()
+
+    def _collect(self) -> Any:
+        if self._thread is None:
+            return None
+        self._thread.join()
+        self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        out, self._result = self._result, None
+        return out
+
+    # -- public --------------------------------------------------------
+
+    def exchange(self, grads) -> Any:
+        """Collect step *t-1*'s reduced mean (None on the first call),
+        then launch step *t*'s reduction in the background."""
+        prev = self._collect()
+        self._submit(grads)
+        return prev
+
+    def flush(self) -> Any:
+        """Drain the in-flight reduction (the final step's mean)."""
+        return self._collect()
+
+    def reduce_sync(self, grads) -> Any:
+        """The sequential baseline: same wire, same bytes, blocking —
+        what the double-buffered loop is measured against."""
+        self._submit(grads)
+        return self._collect()

@@ -767,6 +767,172 @@ def case_scaling_imagenet():
     assert np.isfinite(step_ms) and hostplane_ms < 10_000
 
 
+def case_async_double_buffer():
+    """Double buffering MEASURED paying (round-5 VERDICT ask #6): the
+    staleness-1 loop with the host-plane allreduce on a background
+    thread (``parallel/async_host.py``) vs the sequential
+    compute-then-blocking-allreduce loop, over real processes and the
+    native framed-TCP wire. Both variants run IDENTICAL jitted compute
+    and IDENTICAL wire bytes (same reducer path, same payload, same
+    count) — the honesty check is by construction; only the schedule
+    differs. Prints one MP_METRIC line; asserts the overlap pays."""
+    import time
+
+    from chainermn_tpu import create_communicator
+    from chainermn_tpu.parallel.async_host import AsyncHostGradReducer
+
+    comm = create_communicator("xla")
+    assert comm.host.tcp is not None, "case needs the native TCP plane"
+
+    # The win is bounded by (C + A) / max(C, A): a badly unbalanced
+    # compute-vs-wire ratio measures nothing. Wire time is whatever the
+    # host plane + this machine deliver (measured below), so the drill
+    # SELF-BALANCES: scale the compute batch until C ~ A, the regime the
+    # staleness-1 trade targets (docs/benchmarks.md "when to enable it").
+    # ~1 MB payload: the loopback wire's own CPU cost (pickle + linear
+    # gather) stays ~tens of ms, so the reduction is dominated by the
+    # RTT floor below — i.e. by genuine in-flight wait, the only thing
+    # a single core can overlap.
+    D, H = 1024, 128
+    rng = np.random.default_rng(0)  # identical params on every rank
+    params = {
+        "w1": jnp.asarray(rng.standard_normal((D, H)) * 0.05, jnp.float32),
+        "w2": jnp.asarray(rng.standard_normal((H, D)) * 0.05, jnp.float32),
+    }
+
+    @jax.jit
+    def grad_step(params, x):
+        def loss(p):
+            h = jnp.tanh(x @ p["w1"])
+            return jnp.sum(jnp.tanh(h @ p["w2"]) ** 2)
+
+        return jax.grad(loss)(params)
+
+    @jax.jit
+    def apply_(params, g):
+        return jax.tree.map(lambda p, gg: p - 1e-4 * gg, params, g)
+
+    # This box has ONE core: CPU-bound wire work (pickle/sum) can never
+    # overlap CPU-bound compute — only a genuine in-flight WAIT can.
+    # The 0.4 s simulated DCN RTT supplies that wait (the VERDICT's
+    # sanctioned 'inflated-latency collective'), modelling the
+    # cross-host regime the staleness-1 trade exists for; both variants
+    # pay it identically.
+    reducer = AsyncHostGradReducer(comm, simulated_dcn_latency_s=0.4)
+    steps = 8
+
+    def make_x(batch):
+        return jnp.asarray(
+            np.random.default_rng(RANK + 1).standard_normal((batch, D)),
+            jnp.float32,
+        )
+
+    # CORRECTNESS first (the suite's core invariant — distributed ==
+    # single-process values, here vs the host-gathered numpy mean), then
+    # staleness-1 sequencing: exchanges return None, m0, m1, ... and
+    # flush returns the last mean — each step's reduction exactly once.
+    x = make_x(16)
+    g = jax.tree.map(lambda a: np.asarray(a), grad_step(params, x))
+    expected = jax.tree.map(
+        lambda *leaves: np.mean(leaves, axis=0),
+        *comm.host.allgather_obj(g),
+    )
+    red = reducer.reduce_sync(g)
+    for got, want in zip(jax.tree.leaves(red), jax.tree.leaves(expected)):
+        # fold-left f32 sum vs numpy's stacked mean: order noise only
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    seq = [jax.tree.map(lambda a, s=s: a * (s + 1.0), g) for s in range(3)]
+    means = [reducer.exchange(m) for m in seq] + [reducer.flush()]
+    assert means[0] is None
+    for s, m in enumerate(means[1:]):
+        np.testing.assert_allclose(
+            jax.tree.leaves(m)[0], jax.tree.leaves(expected)[0] * (s + 1.0),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    # Measure the wire (sockets already warm from the checks above).
+    t0 = time.perf_counter()
+    for _ in range(3):
+        reducer.reduce_sync(g)
+    a_ms = (time.perf_counter() - t0) / 3 * 1e3
+    # One wire-time for everyone: the stop rule below must be COLLECTIVE
+    # — the TCP plane's untagged per-pair FIFOs deadlock if ranks make
+    # divergent break decisions and issue different collective sequences.
+    a_ms = comm.host.allreduce_obj(a_ms, op=max)
+
+    # Scale the batch until compute ~ wire; every rank measures under
+    # full contention (all ranks time the same candidate together) and
+    # the break tests the collective MAX, so all ranks stop together.
+    for cand in (64, 128, 256, 512, 1024, 2048):
+        x = make_x(cand)
+        jax.block_until_ready(grad_step(params, x))  # compile
+        comm.host.barrier()
+        t0 = time.perf_counter()
+        for _ in range(2):
+            jax.block_until_ready(grad_step(params, x))
+        c_ms = comm.host.allreduce_obj(
+            (time.perf_counter() - t0) / 2 * 1e3, op=max)
+        if c_ms >= 0.7 * a_ms:
+            break
+    B = cand
+    x = make_x(B)
+
+    def sync_loop(params):
+        for _ in range(steps):
+            g = grad_step(params, x)
+            red = reducer.reduce_sync(g)
+            params = apply_(params, red)
+        jax.block_until_ready(params)
+        return params
+
+    def async_loop(params):
+        for _ in range(steps):
+            g = grad_step(params, x)
+            stale = reducer.exchange(g)
+            if stale is not None:
+                params = apply_(params, stale)
+        params = apply_(params, reducer.flush())
+        jax.block_until_ready(params)
+        return params
+
+    # Warm both paths: jit compiles + first TCP round (socket setup).
+    sync_loop(params)
+    async_loop(params)
+
+    comm.host.barrier()
+    t0 = time.perf_counter()
+    sync_loop(params)
+    comm.host.barrier()
+    sync_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    async_loop(params)
+    comm.host.barrier()
+    async_s = time.perf_counter() - t0
+
+    speedup = sync_s / async_s
+    # The ranks are coupled by the collective, but take the
+    # whole-job view anyway: max total over ranks for each variant.
+    totals = comm.host.allreduce_obj(
+        {"sync": sync_s, "async": async_s},
+        op=lambda a, b: {k: max(a[k], b[k]) for k in a},
+    )
+    job_speedup = totals["sync"] / totals["async"]
+    print(
+        f"MP_METRIC dbuf sync_ms={sync_s * 1e3:.0f} "
+        f"async_ms={async_s * 1e3:.0f} speedup={speedup:.2f} "
+        f"job_speedup={job_speedup:.2f} steps={steps} batch={B} "
+        f"compute_ms={c_ms:.0f} wire_ms={a_ms:.0f} "
+        f"payload_mb={sum(v.size for v in params.values()) * 4 / 1e6:.0f}",
+        flush=True,
+    )
+    # Generous bound for a contended CI box; the typical reading is
+    # well above it when compute and wire are comparable (theoretical
+    # ceiling 2.0). A reading below 1.0 would mean the overlap path
+    # COSTS time — the one outcome this drill exists to rule out.
+    assert job_speedup > 1.1, (sync_s, async_s, totals)
+
+
 CASES = {
     name[len("case_"):]: fn
     for name, fn in list(globals().items())

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Roofline byte audit for the bench workloads (round-5 VERDICT ask #3).
+
+The ResNet roofline (docs/benchmarks.md) was grounded in two numbers per
+config: XLA ``cost_analysis`` FLOPs and the compiled module's byte
+traffic — this tool produces the same pair for the TRANSFORMER bench
+step (and, for cross-checking, the ResNet one), so the MFU targets are
+mechanistic instead of aspirational.
+
+Usage::
+
+    python tools/byte_audit.py transformer [--remat dots|nothing|none]
+        [--batch 16] [--chunks 16]
+    python tools/byte_audit.py resnet [--remat none|conv|full] [--batch 128]
+
+Prints one JSON object: per-step FLOPs, XLA "bytes accessed" (post-fusion
+HBM traffic estimate of the partitioned module), peak/temp memory from
+``memory_analysis``, and the derived compute/bandwidth floors for the
+device (or the v5e reference numbers when compiling on CPU — the compile
+is backend-honest for FLOPs; bytes-accessed on CPU reflects CPU fusion
+and is labelled as such).
+
+The bench's own workload definitions are reused (``bench._resnet_setup``
+and the same transformer construction as ``bench._bench_transformer``)
+so the audit cannot drift from what the bench times.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _HERE)
+
+V5E_PEAK_FLOPS = 197e12  # bf16
+V5E_HBM_GBPS = 819e9
+
+
+def _analyses(compiled) -> dict:
+    out: dict = {}
+    try:
+        a = compiled.cost_analysis()
+        a = a[0] if isinstance(a, (list, tuple)) else a
+        out["flops"] = float(a.get("flops", 0.0))
+        out["bytes_accessed"] = float(a.get("bytes accessed", 0.0))
+    except Exception as e:
+        out["cost_analysis_error"] = f"{type(e).__name__}: {e}"[:160]
+    try:
+        m = compiled.memory_analysis()
+        for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(m, k, None)
+            if v is not None:
+                out[k] = int(v)
+    except Exception as e:
+        out["memory_analysis_error"] = f"{type(e).__name__}: {e}"[:160]
+    return out
+
+
+def _floors(rec: dict, steps_in_program: int) -> None:
+    """Derive per-step floors; on a non-TPU backend the v5e peaks are
+    used and labelled."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rec["device_kind"] = kind
+    rec["floors_vs"] = kind if on_tpu else "v5e (reference; CPU compile)"
+    flops = rec.get("flops")
+    nbytes = rec.get("bytes_accessed")
+    if flops:
+        rec["flops_per_step"] = flops / steps_in_program
+        rec["compute_floor_ms"] = round(
+            flops / steps_in_program / V5E_PEAK_FLOPS * 1e3, 1)
+    if nbytes:
+        rec["bytes_per_step"] = nbytes / steps_in_program
+        rec["bandwidth_floor_ms"] = round(
+            nbytes / steps_in_program / V5E_HBM_GBPS * 1e3, 1)
+        if not on_tpu:
+            rec["bytes_note"] = (
+                "bytes accessed from the CPU-compiled module: CPU fusion "
+                "differs from TPU; treat as an upper-ish bound and "
+                "re-audit on chip (tools/on_chip_capture.sh logs this)"
+            )
+
+
+def audit_transformer(remat: str, batch: int, chunks: int) -> dict:
+    """AOT-compile the LM-scale bench transformer step (the exact
+    construction of ``bench._bench_transformer`` on-accel: flash
+    attention, double-buffered bf16 allreduce, adam, fused chunked LM
+    head) and pull its analyses. One scan step inside the program so
+    per-step numbers need no trip-count division."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu import create_communicator, create_multi_node_optimizer
+    from chainermn_tpu.models import TransformerLM, lm_loss_fused
+    from chainermn_tpu.ops.flash_attention import flash_attention
+
+    comm = create_communicator("xla")
+    T = 2048
+    interpret = jax.devices()[0].platform != "tpu"
+
+    def attn(q, k, v, *, causal, scale):
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               interpret=interpret)
+
+    model = TransformerLM(
+        num_layers=8, d_model=1024, num_heads=16, d_ff=4096,
+        max_len=2048, remat=remat != "none",
+        remat_policy="dots" if remat == "dots" else "nothing",
+        return_hidden=True, attention_fn=attn,
+    )
+    B = batch * comm.size
+    tokens = jax.numpy.zeros((B, T), jnp.int32)
+    params = jax.eval_shape(
+        lambda k, t: model.init(k, t, train=True),
+        jax.random.PRNGKey(1), tokens[:2],
+    )
+    params = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), params)
+    opt = create_multi_node_optimizer(
+        optax.adam(1e-4), comm, double_buffering=True,
+        allreduce_grad_dtype=jnp.bfloat16,
+    )
+
+    def loss_fn(p, tok):
+        hidden = model.apply(p, tok, train=True)
+        emb = p["params"]["tok_emb"]["embedding"]
+        return lm_loss_fused(hidden, emb, tok, n_chunks=chunks)
+
+    def local(params, opt_state, tok):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tok)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    fn = jax.jit(
+        shard_map(local, mesh=comm.mesh,
+                  in_specs=(P(), P(), P(comm.grad_axes)),
+                  out_specs=(P(), P(), P()), check_vma=False)
+    )
+    opt_state = opt.init(params)
+    compiled = fn.lower(params, opt_state, tokens).compile()
+    rec = {"workload": "transformer",
+           "config": f"8L-d1024-ff4096-v32k B{B}xT{T} "
+                     f"remat={remat} chunks={chunks}"}
+    rec.update(_analyses(compiled))
+    _floors(rec, steps_in_program=1)
+    n_params = sum(
+        x.size for x in jax.tree.leaves(params))
+    rec["params_m"] = round(n_params / 1e6, 1)
+    # The bench's MODEL-flops convention (6P/token + causal attention),
+    # for MFU-target math independent of remat recompute.
+    model_flops = (6 * n_params + 6 * 8 * T * 1024) * B * T
+    rec["model_flops_per_step"] = model_flops
+    rec["model_compute_floor_ms"] = round(
+        model_flops / V5E_PEAK_FLOPS * 1e3, 1)
+    return rec
+
+
+def audit_resnet(remat: str, batch: int) -> dict:
+    import bench
+
+    from chainermn_tpu import create_communicator
+
+    os.environ["CHAINERMN_BENCH_RESNET_BATCH"] = str(batch)
+    comm = create_communicator("xla")
+    import jax
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    step, state, (x, y), b, _, _ = bench._resnet_setup(
+        comm, on_accel, force_remat=remat if on_accel else None)
+    rec = {"workload": "resnet50" if on_accel else "resnet18-proxy",
+           "config": f"b{b} remat={remat}"}
+    try:
+        compiled = step.lower(state, (x, y)).compile()
+        rec.update(_analyses(compiled))
+        _floors(rec, steps_in_program=1)
+    except Exception as e:
+        rec["error"] = f"{type(e).__name__}: {e}"[:200]
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workload", choices=["transformer", "resnet"])
+    ap.add_argument("--remat", default="dots")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--chunks", type=int, default=16)
+    args = ap.parse_args()
+    if args.workload == "transformer":
+        rec = audit_transformer(
+            args.remat, args.batch or 16, args.chunks)
+    else:
+        rec = audit_resnet(
+            args.remat if args.remat != "dots" else "none",
+            args.batch or 128)
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()

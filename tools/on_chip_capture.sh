@@ -15,6 +15,16 @@ echo "[capture $stamp] stage 1: bench.py"
 timeout 1800 python bench.py > "tools/capture_logs/bench_$stamp.log" 2>&1
 echo "[capture] bench rc=$? last line:"; tail -1 "tools/capture_logs/bench_$stamp.log" | cut -c1-400
 
+echo "[capture] stage 1b: roofline byte audits (AOT compile + analyses)"
+timeout 900 python tools/byte_audit.py transformer --remat dots \
+  > "tools/capture_logs/byte_audit_tf_$stamp.json" \
+  2> "tools/capture_logs/byte_audit_tf_$stamp.log"
+echo "[capture] tf audit rc=$?"
+timeout 900 python tools/byte_audit.py resnet --remat none \
+  > "tools/capture_logs/byte_audit_resnet_$stamp.json" \
+  2> "tools/capture_logs/byte_audit_resnet_$stamp.log"
+echo "[capture] resnet audit rc=$?"
+
 echo "[capture] stage 2: resnet sweep"
 timeout 2400 python examples/imagenet/sweep_mfu.py \
   > "tools/capture_logs/resnet_sweep_$stamp.log" 2>&1
