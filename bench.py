@@ -60,6 +60,22 @@ _PEAK_BF16_FLOPS = {
     "v6e": 918e12,
 }
 
+# HBM bytes/s per chip, SAME keys and ordering rule as the flops table
+# (public spec sheets). Kept adjacent so a new device kind is added to
+# both in one place — tools/byte_audit.py derives its roofline floors
+# from these via _peak_lookup.
+_PEAK_HBM_BYTES = {
+    "v2": 700e9,
+    "v3": 900e9,
+    "v4": 1228e9,
+    "v5 lite": 819e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v5": 2765e9,
+    "v6 lite": 1640e9,
+    "v6e": 1640e9,
+}
+
 # Env-tunable so the probe schedule can be compressed when driving the
 # orchestration in tests (the defaults fit the driver's real budget).
 PROBE_TIMEOUT = int(os.environ.get("CHAINERMN_BENCH_PROBE_TIMEOUT", 120))
@@ -494,12 +510,18 @@ def _repeat_median(sample, repeats: int):
     return med, round(spread, 1)
 
 
-def _peak_flops(device_kind: str):
+def _peak_lookup(device_kind: str, table: dict):
+    """Order-sensitive substring match over a per-kind peak table (the
+    single matcher for _PEAK_BF16_FLOPS and _PEAK_HBM_BYTES)."""
     kind = device_kind.lower()
-    for sub, peak in _PEAK_BF16_FLOPS.items():
+    for sub, peak in table.items():
         if sub in kind:
             return peak
     return None
+
+
+def _peak_flops(device_kind: str):
+    return _peak_lookup(device_kind, _PEAK_BF16_FLOPS)
 
 
 def _fetch_scalar(x) -> float:
@@ -1086,15 +1108,22 @@ def _run_native_loop() -> None:
         loader.close()
 
 
-def _bench_transformer(comm, on_accel: bool):
-    """Transformer LM tokens/sec + MFU — the remaining BASELINE.json config
-    ("Transformer-base LM — large embedding grads, double-buffered
-    allreduce"): full train step (fwd + bwd + bf16 grad pmean + adam) with
-    the flash-attention kernel, double buffering, per-block remat
-    (dots-saveable policy) and the fused chunked LM head
-    (``lm_loss_fused`` — the [B,T,vocab] logits tensor never hits HBM).
-    MFU uses MODEL flops (6P/token + attention), not cost analysis —
-    see the note at the bottom of this function."""
+def _transformer_setup(comm, on_accel: bool, steps: int | None = None,
+                       interpret: bool | None = None,
+                       abstract_params: bool = False):
+    """Shared transformer workload definition (bench + byte audit): one
+    place owns the model config, knobs, loss, and jitted step so the
+    roofline audit (``tools/byte_audit.py``) cannot drift from what the
+    bench times — the same rule `_resnet_setup` enforces for the ResNet
+    variants. Returns ``(fn, args, B, T, steps, model, cfg,
+    knob_fields, n_chunks)`` with ``fn`` the un-lowered jitted step and
+    ``args = (params, opt_state, tokens)``. ``interpret`` overrides the
+    flash-kernel interpret mode (default: interpret off accelerator) —
+    the audit compiles the LM-SCALE config on CPU and needs both.
+    ``abstract_params=True`` builds zero params from ``eval_shape`` (no
+    forward executed) — for AOT-compile-only consumers like the byte
+    audit, where a real interpret-mode init at LM scale would dominate
+    wall time producing values nobody reads."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -1121,7 +1150,9 @@ def _bench_transformer(comm, on_accel: bool):
             )
         B = int(os.environ.get("CHAINERMN_BENCH_TF_BATCH", "16"))
         n_chunks = int(os.environ.get("CHAINERMN_BENCH_TF_CHUNKS", "16"))
-        T, steps = 2048, 10
+        T = 2048
+        if steps is None:
+            steps = 10
         model = TransformerLM(
             num_layers=8, d_model=1024, num_heads=16, d_ff=4096,
             max_len=2048, remat=remat_mode != "none",
@@ -1135,12 +1166,15 @@ def _bench_transformer(comm, on_accel: bool):
         knob_fields = {"tf_remat": remat_mode, "tf_batch": B,
                        "tf_chunks": n_chunks}
     else:
-        B, T, steps = 2, 128, 2
+        B, T = 2, 128
+        if steps is None:
+            steps = 2
         model = TransformerLM(vocab_size=512, num_layers=2, d_model=64,
                               d_ff=128, max_len=256, return_hidden=True)
         n_chunks = 2
         cfg = "tiny-cpu-proxy"
-    interpret = not on_accel
+    if interpret is None:
+        interpret = not on_accel
 
     def attn(q, k, v, *, causal, scale):
         return flash_attention(q, k, v, causal=causal, scale=scale,
@@ -1157,9 +1191,18 @@ def _bench_transformer(comm, on_accel: bool):
         tokens = multihost_utils.host_local_array_to_global_array(
             tokens, comm.mesh, P()
         )
-    params = jax.jit(
-        lambda k, t: model.init(k, t, train=True)
-    )(jax.random.PRNGKey(1), tokens[:2])
+    if abstract_params:
+        params = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                lambda k, t: model.init(k, t, train=True),
+                jax.random.PRNGKey(1), tokens[:2],
+            ),
+        )
+    else:
+        params = jax.jit(
+            lambda k, t: model.init(k, t, train=True)
+        )(jax.random.PRNGKey(1), tokens[:2])
     opt = create_multi_node_optimizer(
         optax.adam(1e-4), comm, double_buffering=True,
         allreduce_grad_dtype=jnp.bfloat16,
@@ -1190,6 +1233,23 @@ def _bench_transformer(comm, on_accel: bool):
                   out_specs=P(), check_vma=False)
     )
     opt_state = opt.init(params)
+    return (fn, (params, opt_state, tokens), B, T, steps, model, cfg,
+            knob_fields, n_chunks)
+
+
+def _bench_transformer(comm, on_accel: bool):
+    """Transformer LM tokens/sec + MFU — the remaining BASELINE.json config
+    ("Transformer-base LM — large embedding grads, double-buffered
+    allreduce"): full train step (fwd + bwd + bf16 grad pmean + adam) with
+    the flash-attention kernel, double buffering, per-block remat
+    (dots-saveable policy) and the fused chunked LM head
+    (``lm_loss_fused`` — the [B,T,vocab] logits tensor never hits HBM).
+    MFU uses MODEL flops (6P/token + attention), not cost analysis —
+    see the note at the bottom of this function."""
+    import jax
+
+    (fn, (params, opt_state, tokens), B, T, steps, model, cfg,
+     knob_fields, n_chunks) = _transformer_setup(comm, on_accel)
 
     try:
         fn = fn.lower(params, opt_state, tokens).compile()
@@ -1585,6 +1645,37 @@ def _bench_kernel_sweep(on_accel: bool):
         ("cross_len_fwd", fwd(lambda q, k, v: flash_attention(
             q, k, v, causal=False, interpret=False)), (q, k_long, k_long)),
     ]
+
+    # The sliding-window SP entry (round-4 grid-collapse fix changed this
+    # geometry): flash_block_fwd with an ODD extended-K length (even
+    # window), q_offset=prefix, wrap-sentinel kv ids, tile-padded by the
+    # SAME helper the SP path uses — the exact shape Mosaic must accept.
+    from chainermn_tpu.parallel.local_attention import (
+        _WRAP_SENTINEL,
+        _pad_ext_to_block,
+    )
+    from chainermn_tpu.ops.flash_attention import flash_block_fwd
+
+    W = 1024
+    tail = W - 1
+    k_pre, v_pre = q[:, -tail:], q[:, -tail:]
+    k_ext = jnp.concatenate([k_pre, q], axis=1)  # odd length T + W - 1
+    v_ext = jnp.concatenate([v_pre, q], axis=1)
+    seg_q = jnp.zeros((B, T), jnp.int32)
+    seg_k = jnp.concatenate(
+        [jnp.full((B, tail), _WRAP_SENTINEL, jnp.int32), seg_q], axis=1
+    )
+    k_ext, v_ext, seg_k = _pad_ext_to_block(k_ext, v_ext, seg_k, 1024)
+
+    def sp_ext(qq, kk, vv):
+        out, _ = flash_block_fwd(
+            qq, kk, vv, causal=True, scale=D**-0.5, window=W,
+            q_offset=tail, seg_q=seg_q, seg_kv=seg_k,
+            block_q=512, block_k=1024, interpret=False,
+        )
+        return jnp.sum(out.astype(jnp.float32))
+
+    variants.append(("sp_window_ext_fwd", sp_ext, (q, k_ext, v_ext)))
 
     rows = []
     for name, fn, args in variants:

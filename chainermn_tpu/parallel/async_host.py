@@ -68,6 +68,18 @@ class AsyncHostGradReducer:
     is ever in flight. ``flush()`` drains the pipeline (returns the last
     submitted reduction; call once after the loop so no gradient is
     dropped).
+
+    **Host-plane exclusivity (hard constraint):** while a reduction is
+    in flight (``in_flight`` is True — between ``exchange``/``_submit``
+    and the next collect), NO other host-plane traffic may be issued
+    from any thread on any rank: the framed-TCP channels are untagged
+    per-pair FIFOs, so a concurrent ``allreduce_obj``/``barrier`` from
+    the main thread interleaves frames with the background reduction
+    and deadlocks or mis-delivers (the same wildcard-vs-collective
+    ordering constraint the eager p2p API documents). Do host-plane
+    logging/metrics either before ``exchange`` or after ``flush`` —
+    never between. The drill in ``tests/mp_worker.py`` follows this
+    discipline.
     """
 
     def __init__(self, comm, *, average: bool = True,
@@ -127,6 +139,12 @@ class AsyncHostGradReducer:
         return out
 
     # -- public --------------------------------------------------------
+
+    @property
+    def in_flight(self) -> bool:
+        """True while a background reduction owns the host plane — see
+        the exclusivity constraint in the class docstring."""
+        return self._thread is not None
 
     def exchange(self, grads) -> Any:
         """Collect step *t-1*'s reduced mean (None on the first call),

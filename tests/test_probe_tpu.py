@@ -1,0 +1,144 @@
+"""The staged accelerator probe (tools/probe_tpu.py — round-5 VERDICT
+ask #1: diagnose probe failures instead of enduring them).
+
+The probe's value is its VERDICT taxonomy: relay_down (tunnel endpoint
+refuses — the round-4 wedge), cpu_only (init succeeded but no
+accelerator — must NOT count as chip_up, or the watcher burns the
+round's budget capturing CPU numbers), chip_up, init_hang. These tests
+pin the taxonomy against controlled endpoints; no accelerator needed."""
+
+import json
+import os
+import socket
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+)
+import probe_tpu  # noqa: E402
+
+
+@pytest.fixture
+def log_dir(tmp_path, monkeypatch):
+    monkeypatch.setattr(probe_tpu, "LOG_DIR", str(tmp_path))
+    return tmp_path
+
+
+def test_relay_down_is_fast_and_diagnosed(log_dir, monkeypatch):
+    """Nothing listening on the relay ports: verdict relay_down, no
+    backend-init attempt (the probe must stay ~2 s when the tunnel is
+    dead), record appended to probes.jsonl."""
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    # Ports chosen free-by-construction: bind-then-close.
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free = s.getsockname()[1]
+    monkeypatch.setattr(probe_tpu, "RELAY_PORTS", (free,))
+    called = []
+    monkeypatch.setattr(probe_tpu, "_init_check",
+                        lambda t: called.append(t) or {})
+    rec = probe_tpu.probe(5)
+    assert rec["verdict"] == "relay_down"
+    assert "refuse" in rec["diagnosis"]
+    assert not called, "init must not be attempted past a dead relay"
+    lines = open(os.path.join(str(log_dir), "probes.jsonl")).readlines()
+    assert json.loads(lines[-1])["verdict"] == "relay_down"
+
+
+def test_relay_up_attempts_init_and_cpu_is_not_a_chip(log_dir, monkeypatch):
+    """A live endpoint moves the probe to the init stage; an init that
+    reaches only the CPU backend is classified cpu_only (exit 2-vs-0
+    taxonomy the chip watcher keys on)."""
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    stop = threading.Event()
+
+    def accept_loop():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+                c.close()
+            except OSError:
+                pass
+
+    t = threading.Thread(target=accept_loop, daemon=True)
+    t.start()
+    try:
+        monkeypatch.setattr(
+            probe_tpu, "RELAY_PORTS", (srv.getsockname()[1],))
+        monkeypatch.setattr(
+            probe_tpu, "_init_check",
+            lambda timeout: {"stage": "backend_init", "ok": True,
+                             "platform": "cpu", "kind": "cpu", "n": 8},
+        )
+        rec = probe_tpu.probe(5)
+        assert rec["verdict"] == "cpu_only"
+
+        monkeypatch.setattr(
+            probe_tpu, "_init_check",
+            lambda timeout: {"stage": "backend_init", "ok": True,
+                             "platform": "tpu", "kind": "TPU v5e", "n": 1},
+        )
+        rec = probe_tpu.probe(5)
+        assert rec["verdict"] == "chip_up"
+
+        monkeypatch.setattr(
+            probe_tpu, "_init_check",
+            lambda timeout: {"stage": "backend_init", "ok": False,
+                             "hung": True, "timeout_s": 5},
+        )
+        rec = probe_tpu.probe(5)
+        assert rec["verdict"] == "init_hang"
+        assert "past the tunnel" in rec["diagnosis"]
+    finally:
+        stop.set()
+        srv.close()
+
+
+def test_no_tunnel_env_goes_straight_to_init(log_dir, monkeypatch):
+    """Without the tunnel fingerprint (a direct-libtpu TPU VM, a GPU
+    box) the TCP short-circuit must NOT gate init — the code-review
+    finding that the relay check only applies behind the loopback
+    tunnel."""
+    monkeypatch.delenv("AXON_LOOPBACK_RELAY", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setattr(
+        probe_tpu, "_init_check",
+        lambda timeout: {"stage": "backend_init", "ok": True,
+                         "platform": "tpu", "kind": "TPU v4", "n": 4},
+    )
+    rec = probe_tpu.probe(5)
+    assert rec["verdict"] == "chip_up"
+    assert "relay" not in rec
+
+
+def test_tail_records_and_latest(log_dir, monkeypatch):
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free = s.getsockname()[1]
+    monkeypatch.setattr(probe_tpu, "RELAY_PORTS", (free,))
+    for _ in range(3):
+        probe_tpu.probe(5)
+    assert len(probe_tpu.tail_records(2)) == 2
+    assert probe_tpu.latest_record()["verdict"] == "relay_down"
+
+
+def test_log_write_failure_never_vetoes_the_result(monkeypatch):
+    """The diagnostic side channel is best-effort: an unwritable log dir
+    must not turn a chip_up into an exception (code-review finding)."""
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    monkeypatch.setattr(probe_tpu, "LOG_DIR", "/proc/definitely/not/writable")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free = s.getsockname()[1]
+    monkeypatch.setattr(probe_tpu, "RELAY_PORTS", (free,))
+    rec = probe_tpu.probe(5)
+    assert rec["verdict"] == "relay_down"

@@ -39,6 +39,30 @@ V5E_PEAK_FLOPS = 197e12  # bf16
 V5E_HBM_GBPS = 819e9
 
 
+def _device_peaks() -> tuple[float, float, str]:
+    """(flops, hbm_bytes_per_s, label) for the actual device, from
+    bench's adjacent per-kind tables (one matcher, one place to add a
+    kind) — the v5e reference numbers, labelled as such, when unknown
+    or on CPU."""
+    import jax
+
+    import bench
+
+    kind = jax.devices()[0].device_kind
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        flops = bench._peak_lookup(kind, bench._PEAK_BF16_FLOPS)
+        hbm = bench._peak_lookup(kind, bench._PEAK_HBM_BYTES)
+        if flops and hbm:
+            return flops, hbm, kind
+        if on_tpu and (flops or hbm):  # half-known: fill, label honestly
+            return (flops or V5E_PEAK_FLOPS, hbm or V5E_HBM_GBPS,
+                    f"{kind} (missing table entry filled with v5e)")
+    return V5E_PEAK_FLOPS, V5E_HBM_GBPS, (
+        f"v5e (reference; {'unknown kind ' + kind if on_tpu else 'CPU compile'})"
+    )
+
+
 def _analyses(compiled) -> dict:
     out: dict = {}
     try:
@@ -61,24 +85,25 @@ def _analyses(compiled) -> dict:
 
 
 def _floors(rec: dict, steps_in_program: int) -> None:
-    """Derive per-step floors; on a non-TPU backend the v5e peaks are
-    used and labelled."""
+    """Derive per-step floors against the ACTUAL device's peaks (per
+    bench's kind table); the v5e reference numbers, labelled as such,
+    when the kind is unknown or the compile ran on CPU."""
     import jax
 
-    kind = jax.devices()[0].device_kind
+    peak_flops, peak_hbm, label = _device_peaks()
     on_tpu = jax.devices()[0].platform == "tpu"
-    rec["device_kind"] = kind
-    rec["floors_vs"] = kind if on_tpu else "v5e (reference; CPU compile)"
+    rec["device_kind"] = jax.devices()[0].device_kind
+    rec["floors_vs"] = label
     flops = rec.get("flops")
     nbytes = rec.get("bytes_accessed")
     if flops:
         rec["flops_per_step"] = flops / steps_in_program
         rec["compute_floor_ms"] = round(
-            flops / steps_in_program / V5E_PEAK_FLOPS * 1e3, 1)
+            flops / steps_in_program / peak_flops * 1e3, 1)
     if nbytes:
         rec["bytes_per_step"] = nbytes / steps_in_program
         rec["bandwidth_floor_ms"] = round(
-            nbytes / steps_in_program / V5E_HBM_GBPS * 1e3, 1)
+            nbytes / steps_in_program / peak_hbm * 1e3, 1)
         if not on_tpu:
             rec["bytes_note"] = (
                 "bytes accessed from the CPU-compiled module: CPU fusion "
@@ -88,80 +113,52 @@ def _floors(rec: dict, steps_in_program: int) -> None:
 
 
 def audit_transformer(remat: str, batch: int, chunks: int) -> dict:
-    """AOT-compile the LM-scale bench transformer step (the exact
-    construction of ``bench._bench_transformer`` on-accel: flash
-    attention, double-buffered bf16 allreduce, adam, fused chunked LM
-    head) and pull its analyses. One scan step inside the program so
-    per-step numbers need no trip-count division."""
+    """AOT-compile the LM-scale bench transformer step — the VERY
+    workload ``bench._bench_transformer`` times, via the shared
+    ``bench._transformer_setup`` (knobs flow through the same
+    CHAINERMN_BENCH_TF_* env surface the bench and capture script use),
+    with one scan step in the program so per-step numbers need no
+    trip-count division."""
     import jax
-    import jax.numpy as jnp
-    import optax
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
 
-    from chainermn_tpu import create_communicator, create_multi_node_optimizer
-    from chainermn_tpu.models import TransformerLM, lm_loss_fused
-    from chainermn_tpu.ops.flash_attention import flash_attention
+    import bench
 
+    from chainermn_tpu import create_communicator
+
+    os.environ["CHAINERMN_BENCH_TF_REMAT"] = remat
+    os.environ["CHAINERMN_BENCH_TF_BATCH"] = str(batch)
+    os.environ["CHAINERMN_BENCH_TF_CHUNKS"] = str(chunks)
     comm = create_communicator("xla")
-    T = 2048
-    interpret = jax.devices()[0].platform != "tpu"
-
-    def attn(q, k, v, *, causal, scale):
-        return flash_attention(q, k, v, causal=causal, scale=scale,
-                               interpret=interpret)
-
-    model = TransformerLM(
-        num_layers=8, d_model=1024, num_heads=16, d_ff=4096,
-        max_len=2048, remat=remat != "none",
-        remat_policy="dots" if remat == "dots" else "nothing",
-        return_hidden=True, attention_fn=attn,
-    )
-    B = batch * comm.size
-    tokens = jax.numpy.zeros((B, T), jnp.int32)
-    params = jax.eval_shape(
-        lambda k, t: model.init(k, t, train=True),
-        jax.random.PRNGKey(1), tokens[:2],
-    )
-    params = jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), params)
-    opt = create_multi_node_optimizer(
-        optax.adam(1e-4), comm, double_buffering=True,
-        allreduce_grad_dtype=jnp.bfloat16,
-    )
-
-    def loss_fn(p, tok):
-        hidden = model.apply(p, tok, train=True)
-        emb = p["params"]["tok_emb"]["embedding"]
-        return lm_loss_fused(hidden, emb, tok, n_chunks=chunks)
-
-    def local(params, opt_state, tok):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tok)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    fn = jax.jit(
-        shard_map(local, mesh=comm.mesh,
-                  in_specs=(P(), P(), P(comm.grad_axes)),
-                  out_specs=(P(), P(), P()), check_vma=False)
-    )
-    opt_state = opt.init(params)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    (fn, (params, opt_state, tokens), B, T, _steps, model, cfg, _kf,
+     _nc) = bench._transformer_setup(
+        comm, on_accel=True, steps=1, interpret=not on_tpu,
+        abstract_params=True)
     compiled = fn.lower(params, opt_state, tokens).compile()
     rec = {"workload": "transformer",
-           "config": f"8L-d1024-ff4096-v32k B{B}xT{T} "
-                     f"remat={remat} chunks={chunks}"}
+           "config": f"{cfg} B{B}xT{T} remat={remat} chunks={chunks}",
+           "cost_analysis_note": (
+               "the bench step body sits inside lax.scan (and the fused "
+               "LM head scans over chunks); XLA cost_analysis does not "
+               "multiply through scan regions (see bench.py's MFU note), "
+               "so flops/bytes_accessed under-count — "
+               "model_flops_per_step is the grounded compute number"
+           )}
     rec.update(_analyses(compiled))
     _floors(rec, steps_in_program=1)
-    n_params = sum(
-        x.size for x in jax.tree.leaves(params))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
     rec["params_m"] = round(n_params / 1e6, 1)
     # The bench's MODEL-flops convention (6P/token + causal attention),
     # for MFU-target math independent of remat recompute.
-    model_flops = (6 * n_params + 6 * 8 * T * 1024) * B * T
+    peak_flops, _, _ = _device_peaks()
+    # Per DEVICE (cost_analysis also describes the per-device
+    # partitioned module) — same division as the bench's MFU.
+    model_flops = (
+        6 * n_params + 6 * model.num_layers * T * model.d_model
+    ) * B * T / comm.size
     rec["model_flops_per_step"] = model_flops
     rec["model_compute_floor_ms"] = round(
-        model_flops / V5E_PEAK_FLOPS * 1e3, 1)
+        model_flops / peak_flops * 1e3, 1)
     return rec
 
 

@@ -59,6 +59,28 @@ def _env_fingerprint() -> dict:
         fp["libtpu"] = md.version("libtpu")
     except Exception:  # pragma: no cover - metadata always present in image
         pass
+    # Is a relay/tunnel process even present in this container? (Round-5
+    # finding: during the multi-round outage NO relay process existed —
+    # the tunnel is provided from outside the container and was simply
+    # absent, so nothing in-container can revive it.)
+    try:
+        n = 0
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    argv0 = f.read().split(b"\0", 1)[0]
+            except OSError:
+                continue
+            # argv[0] basename only: a grep/driver process whose
+            # ARGUMENTS mention the tunnel must not count as the tunnel.
+            name = os.path.basename(argv0.decode("utf-8", "replace"))
+            if any(s in name for s in ("relay", "axon", "tunnel")):
+                n += 1
+        fp["relay_processes_in_container"] = n
+    except OSError:  # pragma: no cover
+        pass
     return fp
 
 
