@@ -1,0 +1,97 @@
+"""The BASELINE north-star topology, rehearsed literally (round 5).
+
+BASELINE.json's target is ≥90% scaling efficiency at 32 chips (8 hosts
+x 4 chips). Real multi-chip hardware is unreachable from this
+environment, so this is the closest executable rehearsal: a
+32-virtual-device CPU mesh factorised (inter=8, intra=4) — the exact
+member count and (dcn, ici) shape — driving the TwoDimensionalCommunicator
+trainer end-to-end, with the suite's core invariant applied at that
+scale: the 32-member step equals the single-device step (values), and
+the topology-aware int8 wire executes on the same mesh.
+
+The session-wide conftest pins an 8-device platform, so the 32-device
+mesh runs in a scrubbed subprocess (same pattern as dryrun_multichip).
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SNIPPET = r"""
+import numpy as np
+import jax, jax.numpy as jnp, optax
+from jax.sharding import Mesh
+from chainermn_tpu.communicators.xla_communicator import (
+    TwoDimensionalCommunicator,
+)
+from chainermn_tpu.models import MLP
+from chainermn_tpu.optimizers import create_multi_node_optimizer
+from chainermn_tpu.training.train_step import (
+    create_train_state, make_train_step,
+)
+
+devs = np.array(jax.devices()[:32]).reshape(8, 4)  # 8 hosts x 4 chips
+comm = TwoDimensionalCommunicator(mesh=Mesh(devs, ("inter", "intra")))
+# inter_size/intra_size report PROCESS topology (1 process here); the
+# reduction pipeline follows the MESH axes, which carry the 8x4 shape.
+assert comm.size == 32
+assert comm.mesh.shape["inter"] == 8 and comm.mesh.shape["intra"] == 4
+
+model = MLP(n_units=16, n_out=4)
+rng = np.random.default_rng(5)
+x = jnp.asarray(rng.standard_normal((64, 10)), jnp.float32)
+y = jnp.asarray(rng.integers(0, 4, 64), jnp.int32)
+params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+def loss_fn(p, batch, ms):
+    xb, yb = batch
+    logits = model.apply({"params": p}, xb)
+    return (optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+            .mean(), ({}, ms))
+
+# (1) Equivalence at 32 members: f32 wire == the single-device step.
+opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+state = create_train_state(params, opt, comm, model_state={})
+step = make_train_step(loss_fn, opt, comm, donate=False)
+state, m = step(state, (x, y))
+
+def single_device_step(p):
+    loss, grads = jax.value_and_grad(
+        lambda pp: loss_fn(pp, (x, y), {})[0])(p)
+    return jax.tree.map(lambda a, g: a - 0.1 * g, p, grads), loss
+
+ref_params, ref_loss = jax.jit(single_device_step)(params)
+np.testing.assert_allclose(float(m["loss"]), float(ref_loss), rtol=1e-5)
+for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(ref_params)):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-6)
+
+# (2) The topology-aware int8 wire executes at the north-star shape.
+opt_q = create_multi_node_optimizer(
+    optax.sgd(0.1), comm, allreduce_grad_dtype=jnp.int8)
+state_q = create_train_state(params, opt_q, comm, model_state={})
+step_q = make_train_step(loss_fn, opt_q, comm, donate=False)
+state_q, mq = step_q(state_q, (x, y))
+assert np.isfinite(float(mq["loss"]))
+print("NORTH_STAR_OK")
+"""
+
+
+def test_32_member_north_star_shape():
+    sys.path.insert(0, _REPO)
+    try:
+        from _driver_env import cpu_scrubbed_env
+    finally:
+        sys.path.pop(0)
+
+    env = cpu_scrubbed_env(
+        32, cache_dir=os.path.join(_REPO, ".jax_cache"))
+    proc = subprocess.run(
+        [sys.executable, "-c", _SNIPPET], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0 and "NORTH_STAR_OK" in proc.stdout, (
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    )
