@@ -169,6 +169,17 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
                 f"{tuple(self.mesh.axis_names)!r}"
             )
 
+    @property
+    def two_level_axes(self):
+        """``(intra_axis, inter_axis)`` names of the pinned two-level
+        reduction — the capability flag the shard-level EF path keys on
+        (``MultiNodeOptimizer._reduce_with_feedback``): quantization
+        happens only at the inter stage here, so the EF residual is
+        kept at shard shape and fed back exactly where the error
+        arises."""
+        inter_ax, intra_ax = self.grad_axes
+        return intra_ax, inter_ax
+
     def reduce_gradients_in_jit(
         self, grads: PyTree, *, compress_dtype=None
     ) -> PyTree:

@@ -138,9 +138,36 @@ class _DoubleBufferState(NamedTuple):
 
 class _ErrorFeedbackState(NamedTuple):
     inner: Any
-    #: per-rank residual of the int8 wire's stage-1 quantization,
-    #: added into the next step's message (EF-SGD)
+    #: per-rank residual of the int8 wire's quantization, added into the
+    #: next step's message (EF-SGD). Flat wire: mirrors the params tree
+    #: (full-param f32). Topology-aware wire: a tuple of SHARD-shaped f32
+    #: buffers, one per ~64 MB bucket — the error arises only at the
+    #: inter stage, on the intra-summed shard, and is stored there.
     residual: PyTree
+
+
+_EF_BUCKET_BYTES = 64 << 20
+
+
+def _float_bucket_partition(float_idx, sizes):
+    """Deterministic ~64 MB (f32) bucket partition of the float leaves
+    — ONE function used by both ``MultiNodeOptimizer.init`` (residual
+    allocation) and ``_reduce_with_feedback`` (the reduction), so the
+    two can never disagree about the layout. A single leaf larger than
+    the bucket gets its own bucket, unsplit."""
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i in float_idx:
+        nbytes = sizes[i] * 4
+        if cur and cur_bytes + nbytes > _EF_BUCKET_BYTES:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 class MultiNodeOptimizer:
@@ -198,47 +225,80 @@ class MultiNodeOptimizer:
             # Residual lives in float32 regardless of param dtype: with
             # bf16 params a bf16 residual would itself drop ~2/3 of the
             # quantization error being fed back each step, weakening the
-            # cumulative-bias-removal guarantee EF exists for. One
-            # params-sized f32 buffer of optimizer state.
-            state = _ErrorFeedbackState(
-                inner=state,
-                residual=jax.tree.map(
+            # cumulative-bias-removal guarantee EF exists for.
+            axes2 = getattr(self.communicator, "two_level_axes", None)
+            if axes2 is not None:
+                # Topology-aware wire: the only lossy stage quantizes
+                # the intra-summed SHARD per bucket, so the residual is
+                # one shard-shaped f32 buffer per bucket — 1/n_intra
+                # the flat-wire residual's footprint. Bucket layout is
+                # static (param sizes + mesh shape), shared with the
+                # update path via _float_bucket_partition.
+                from chainermn_tpu.parallel.collectives import (
+                    two_level_shard_len,
+                )
+
+                intra_ax, _ = axes2
+                n_intra = self.communicator.mesh.shape[intra_ax]
+                leaves = jax.tree.leaves(params)
+                sizes = [leaf.size for leaf in leaves]
+                float_idx = [
+                    i for i, leaf in enumerate(leaves)
+                    if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+                ]
+                residual = tuple(
+                    jnp.zeros(
+                        (two_level_shard_len(
+                            sum(sizes[i] for i in bidx), n_intra),),
+                        jnp.float32,
+                    )
+                    for bidx in _float_bucket_partition(float_idx, sizes)
+                )
+            else:
+                # Flat wire: one params-sized f32 buffer.
+                residual = jax.tree.map(
                     lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
-                ),
-            )
+                )
+            state = _ErrorFeedbackState(inner=state, residual=residual)
         return state
 
     def _reduce_with_feedback(self, grads: PyTree, residual: PyTree):
-        """EF-SGD over the int8 wire: the message is grads + residual;
-        the NEW residual is what stage-1 quantization dropped from it —
-        deterministic rounding bias is fed back instead of lost.
+        """EF-SGD over the int8 wire: the NEW residual is exactly what
+        quantization dropped this step — deterministic rounding bias is
+        fed back instead of lost.
 
         Float leaves ride ~64 MB flat f32 buckets (the same packing
         discipline as the two-dimensional communicator's pipeline —
-        tiny bias/scale leaves must not each pay their own collective);
+        tiny bias/scale leaves must not each pay their own collective;
+        layout shared with ``init`` via ``_float_bucket_partition``);
         non-float leaves take the exact pmean, matching the non-EF
         path's reference-parity behaviour.
 
-        Known trade-off: EF uses the FLAT int8 wire over all grad axes
-        even on hierarchical meshes (the topology-aware two-level
-        scheme quantizes the intra-summed SHARD, whose error lives at
-        shard shape — feeding it back would need shard-shaped residual
-        state or an extra f32 gather, both worse than the noise saved);
-        the non-EF int8 path on TwoDimensionalCommunicator IS
-        topology-aware."""
+        Two forms, keyed on the communicator's ``two_level_axes``
+        capability:
+
+        - flat wire (any communicator): message = grads + residual at
+          full param shape; residual mirrors the params tree.
+        - TOPOLOGY-AWARE wire (``TwoDimensionalCommunicator``, round 5):
+          the intra reduction is exact, so feedback happens at the ONLY
+          lossy stage — the int8 wire on the intra-summed shard crossing
+          inter/DCN. The residual is shard-shaped per bucket (1/n_intra
+          the flat footprint), see
+          :func:`chainermn_tpu.parallel.collectives.int8_two_level_allreduce_mean_with_feedback`.
+        """
         from chainermn_tpu.parallel.collectives import (
             axes_bound,
             int8_allreduce_mean_with_feedback,
+            int8_two_level_allreduce_mean_with_feedback,
         )
 
         axes = self.communicator.grad_axes
         if not axes_bound(axes):
             return grads, residual  # pjit/eager: identity, residual kept
 
+        axes2 = getattr(self.communicator, "two_level_axes", None)
         leaves, treedef = jax.tree.flatten(grads)
-        e_leaves = jax.tree.leaves(residual)
         out: list = [None] * len(leaves)
-        new_e: list = list(e_leaves)
 
         float_idx = [i for i, g in enumerate(leaves)
                      if jnp.issubdtype(g.dtype, jnp.floating)]
@@ -246,20 +306,42 @@ class MultiNodeOptimizer:
             if i not in float_idx:
                 out[i] = _pmean_if_in_axis(g, axes).astype(g.dtype)
 
-        bucket_bytes = 64 << 20
-        buckets: list[list[int]] = []
-        cur: list[int] = []
-        cur_bytes = 0
-        for i in float_idx:
-            nbytes = leaves[i].size * 4
-            if cur and cur_bytes + nbytes > bucket_bytes:
-                buckets.append(cur)
-                cur, cur_bytes = [], 0
-            cur.append(i)
-            cur_bytes += nbytes
-        if cur:
-            buckets.append(cur)
+        sizes = [g.size for g in leaves]
+        buckets = _float_bucket_partition(float_idx, sizes)
 
+        if axes2 is not None:
+            # Shard-level EF: residual is a tuple of per-bucket shard
+            # buffers (the layout init allocated).
+            intra_ax, inter_ax = axes2
+            e_shards = jax.tree.leaves(residual)
+            if len(e_shards) != len(buckets):
+                raise ValueError(
+                    f"shard-level EF residual has {len(e_shards)} "
+                    f"buckets but these gradients need {len(buckets)} — "
+                    "the opt_state was built for different params "
+                    "(restore mismatch?); rebuild it with "
+                    "optimizer.init(params) / create_train_state(...)"
+                )
+            new_shards = []
+            for bidx, e_shard in zip(buckets, e_shards):
+                m = jnp.concatenate([
+                    leaves[i].astype(jnp.float32).ravel() for i in bidx
+                ])
+                mean, new_shard = int8_two_level_allreduce_mean_with_feedback(
+                    m, e_shard, intra_ax, inter_ax
+                )
+                new_shards.append(new_shard)
+                off = 0
+                for i in bidx:
+                    n = leaves[i].size
+                    out[i] = (mean[off:off + n]
+                              .reshape(leaves[i].shape)
+                              .astype(leaves[i].dtype))
+                    off += n
+            return jax.tree.unflatten(treedef, out), tuple(new_shards)
+
+        e_leaves = jax.tree.leaves(residual)
+        new_e: list = list(e_leaves)
         for bidx in buckets:
             m = jnp.concatenate([
                 (leaves[i].astype(jnp.float32)

@@ -335,6 +335,48 @@ def int8_two_level_allreduce_mean(
     return _int8_two_level_allreduce_mean(x, intra_axis, inter_axis)
 
 
+def two_level_shard_len(size: int, n_intra: int) -> int:
+    """Per-member intra-shard length for a flat buffer of ``size``
+    elements — the ceil-padded row length of the two-level frame, and
+    therefore the shape of the shard-level EF residual."""
+    return -(-size // n_intra)
+
+
+def int8_two_level_allreduce_mean_with_feedback(
+    x: jax.Array, residual: jax.Array, intra_axis: str, inter_axis: str
+):
+    """Shard-level error feedback for the TOPOLOGY-AWARE wire (round 5 —
+    closes the 'EF forces the flat wire' trade-off the round-4 docstring
+    recorded): the intra ``psum_scatter`` is exact, so the ONLY lossy
+    stage is the int8 wire on the shard crossing inter/DCN — and that is
+    where the feedback belongs. The inter message is
+    ``intra_shard + residual``; the new residual is
+    ``message - D(C(message))`` (this member's stage-1 roundtrip error),
+    a per-member f32 buffer of shape
+    ``[two_level_shard_len(x.size, n_intra)]`` — 1/n_intra the size of
+    the flat-wire EF residual, stored exactly where the error arises.
+    Returns ``(mean, new_residual)`` with ``mean`` shaped like ``x``
+    (mean over the full inter x intra product, residual mass entering
+    the average the standard EF-SGD way).
+
+    NOT differentiable (optimizer-internal, same contract as
+    :func:`int8_allreduce_mean_with_feedback`); degenerate inter axis
+    (size 1) pays no quantization and returns a zero residual."""
+    n_intra = lax.axis_size(intra_axis)
+    captured = []
+
+    def inter(shard):
+        msg = shard + residual.astype(jnp.float32)
+        mean_shard, local_rt = _int8_core(msg, (inter_axis,))
+        captured.append(msg - local_rt)  # this member's new residual
+        return mean_shard / n_intra
+
+    mean = _two_level_frame(
+        x.astype(jnp.float32), intra_axis, inter
+    ).astype(x.dtype)
+    return mean, captured[0]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def _int8_two_level_allreduce_mean(x, intra_axis, inter_axis):
     def inter(shard):

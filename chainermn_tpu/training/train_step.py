@@ -293,29 +293,42 @@ def make_train_step(
     if not ef:
         return jitted
 
+    template_cache: dict = {}
+
     def step_with_residual_check(state, batch):
         # Host-side shape gate BEFORE shard_map applies its specs: a
         # bare optimizer.init() state (unstacked residual) would
         # otherwise die in a generic divisibility/rank sharding error
-        # that never names the real mistake.
+        # that never names the real mistake. The expected per-slot
+        # shapes come from the OPTIMIZER's own residual template
+        # (eval_shape of init — abstract, no allocation): full-param
+        # leaves for the flat wire, per-bucket shard buffers for the
+        # topology-aware wire. Cached per params-structure.
+        key = (
+            jax.tree.structure(state.params),
+            tuple((np.shape(p), str(getattr(p, "dtype", "?")))
+                  for p in jax.tree.leaves(state.params)),
+        )
+        if key not in template_cache:
+            template_cache[key] = jax.tree.leaves(
+                jax.eval_shape(optimizer.init, state.params).residual
+            )
+        t_leaves = template_cache[key]
         e_leaves = jax.tree.leaves(state.opt_state.residual)
-        p_leaves = jax.tree.leaves(state.params)
-        if len(e_leaves) != len(p_leaves):
+        if len(e_leaves) != len(t_leaves):
             raise ValueError(
                 "error-feedback residual has "
-                f"{len(e_leaves)} leaves but params has {len(p_leaves)} "
-                "— a partially restored or hand-edited opt_state cannot "
-                "be carried by make_train_step; rebuild it with "
-                "create_train_state(...)"
+                f"{len(e_leaves)} leaves but this optimizer's residual "
+                f"template has {len(t_leaves)} — a partially restored or "
+                "hand-edited opt_state cannot be carried by "
+                "make_train_step; rebuild it with create_train_state(...)"
             )
-        for e, p_leaf in zip(e_leaves, p_leaves):
+        for e, t in zip(e_leaves, t_leaves):
             eshape = np.shape(e)
-            if not (len(eshape) == np.ndim(p_leaf) + 1
-                    and eshape[0] == comm.size
-                    and eshape[1:] == np.shape(p_leaf)):
+            if eshape != (comm.size,) + t.shape:
                 raise ValueError(
                     "error-feedback residual leaf has shape "
-                    f"{eshape}, expected {(comm.size,) + np.shape(p_leaf)} "
+                    f"{eshape}, expected {(comm.size,) + t.shape} "
                     "(stacked per mesh slot) — build the state with "
                     "create_train_state(...); a bare "
                     "optimizer.init(params) state cannot be carried by "

@@ -949,3 +949,125 @@ class TestInt8TwoLevel:
             v, "intra", "inter"))
         g_exact = grad_of(lambda v: jax.lax.pmean(v, ("inter", "intra")))
         np.testing.assert_allclose(g_quant, g_exact, rtol=1e-6)
+
+
+class TestShardLevelEF:
+    """Round-5 shard-level error feedback for the TOPOLOGY-AWARE wire
+    (``int8_two_level_allreduce_mean_with_feedback``): the intra stage
+    is exact, so the residual lives at the int8 inter stage's shard
+    shape. Same invariants as the flat-wire ``TestErrorFeedback``,
+    applied at the stage where the error actually arises."""
+
+    def _mesh_comm(self):
+        from jax.sharding import Mesh
+
+        from chainermn_tpu.communicators.xla_communicator import (
+            TwoDimensionalCommunicator,
+        )
+
+        devs = np.array(jax.devices("cpu")[:N]).reshape(2, 4)
+        return TwoDimensionalCommunicator(
+            mesh=Mesh(devs, ("inter", "intra"))
+        )
+
+    def test_zero_residual_matches_bare_two_level(self):
+        """With a zero residual the feedback form must equal the bare
+        topology-aware wire EXACTLY (same frame, same rounding), and
+        return a shard-shaped residual."""
+        from chainermn_tpu.parallel.collectives import (
+            int8_two_level_allreduce_mean,
+            int8_two_level_allreduce_mean_with_feedback,
+            two_level_shard_len,
+        )
+
+        comm = self._mesh_comm()
+        L = 33  # deliberately not divisible by intra=4
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(N, L).astype(np.float32))
+        shard_len = two_level_shard_len(L, 4)
+        spec = P(("inter", "intra"))
+
+        def body(xl):
+            v = xl[0]
+            bare = int8_two_level_allreduce_mean(v, "intra", "inter")
+            mean, res = int8_two_level_allreduce_mean_with_feedback(
+                v, jnp.zeros((shard_len,), jnp.float32),
+                "intra", "inter",
+            )
+            return bare[None], mean[None], res[None]
+
+        bare, mean, res = jax.jit(shard_map(
+            body, mesh=comm.mesh, in_specs=spec,
+            out_specs=(spec, spec, spec), check_vma=False,
+        ))(x)
+        np.testing.assert_array_equal(np.asarray(bare), np.asarray(mean))
+        assert res.shape == (N, shard_len)
+
+    def _grads(self):
+        """Per-member grads whose INTER-stage message is
+        quantization-hostile: coordinate 0 carries an adversarial
+        component (sign flipping between the two inter groups, exactly
+        cancelling in the mean) that pins the j=0 shard message's amax;
+        coordinate 1 (same shard slice) carries a persistent
+        sub-half-quantum signal that plain deterministic rounding kills
+        every step."""
+        g = np.zeros((N, 6), np.float32)
+        g[:4, 0], g[4:, 0] = 0.225, -0.225  # intra sums +-0.9, mean 0
+        g[:, 1] = 0.003 / 4                 # inter msg 0.003 < q/2
+        g[:, 2:] = 0.05                     # healthy super-quantum coords
+        return g
+
+    def _cumulative(self, error_feedback, steps=30):
+        from chainermn_tpu.training.train_step import (
+            create_train_state,
+            make_train_step,
+        )
+
+        comm = self._mesh_comm()
+        grads_np = self._grads()
+        params = {"w": jnp.zeros((6,), jnp.float32)}
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8,
+            error_feedback=error_feedback,
+        )
+
+        def loss_fn(p, batch):
+            return jnp.sum(p["w"] * batch[0])
+
+        state = create_train_state(params, opt, comm)
+        step = make_train_step(loss_fn, opt, comm, donate=False)
+        batch = jnp.asarray(grads_np)
+        for _ in range(steps):
+            state, _ = step(state, batch)
+        exact = -steps * grads_np.mean(0)
+        return (np.abs(np.asarray(state.params["w"]) - exact).max(),
+                state, grads_np)
+
+    def test_cumulative_bias_removed_at_the_inter_stage(self):
+        err_plain, _, grads_np = self._cumulative(False)
+        err_ef, state, _ = self._cumulative(True)
+        # message-level quantum at the pinned shard: intra-sum amax 0.9
+        msg_quantum = 0.9 / 127.0
+        # output-level: /(n_inter * n_intra)... but the telescoping
+        # bound is at message level divided by the inter mean only.
+        assert err_ef < 4 * msg_quantum, (err_ef, msg_quantum)
+        assert err_ef < err_plain / 3, (err_ef, err_plain)
+        # the per-member shard residuals are genuinely distinct state
+        stacked = np.asarray(
+            jax.tree.leaves(state.opt_state.residual)[0]
+        )
+        assert stacked.shape[0] == N
+        assert not all(
+            np.allclose(stacked[r], stacked[0]) for r in range(1, N)
+        )
+
+    def test_plain_two_level_kills_the_subquantum_coordinate(self):
+        """The mechanism the EF exists for, asserted directly: without
+        feedback the persistent sub-half-quantum coordinate never
+        trains."""
+        err_plain, state, grads_np = self._cumulative(False)
+        w = np.asarray(state.params["w"])
+        # coordinate 1's exact target moved; plain int8 left it at ~0
+        assert abs(w[1]) < 1e-6, w[1]
+        assert abs(30 * grads_np[:, 1].mean()) > 0.02
