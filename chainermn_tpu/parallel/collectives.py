@@ -176,7 +176,9 @@ def _two_level_frame(x, intra_axis, inter_reduce):
     ``all_gather``, un-pad."""
     n_intra = lax.axis_size(intra_axis)
     flat = x.reshape(-1)
-    c = -(-flat.size // n_intra)  # ceil: pad so rows split evenly
+    # two_level_shard_len IS this padding rule (the EF residual is
+    # allocated from it at init time) — one definition, two users.
+    c = two_level_shard_len(flat.size, n_intra)
     rows = jnp.pad(flat, (0, n_intra * c - flat.size)).reshape(n_intra, c)
     shard = lax.psum_scatter(
         rows, intra_axis, scatter_dimension=0, tiled=False

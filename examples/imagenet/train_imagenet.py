@@ -68,6 +68,10 @@ def main(argv=None):
                         "lived in (arXiv:1711.04325)")
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--allreduce-grad-dtype", default="bfloat16")
+    p.add_argument("--error-feedback", action="store_true",
+                   help="EF-SGD for the int8 quantized wire (requires "
+                        "--allreduce-grad-dtype int8); shard-level on "
+                        "the two_dimensional communicator")
     p.add_argument("--stem", default="standard",
                    choices=["standard", "space_to_depth"],
                    help="resnet50 input stem; space_to_depth trades the "
@@ -192,6 +196,7 @@ def main(argv=None):
         inner_opt,
         comm,
         double_buffering=args.double_buffering,
+        error_feedback=args.error_feedback,
     )
     state = create_train_state(
         variables["params"], optimizer, comm, model_state=batch_stats

@@ -60,6 +60,10 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--allreduce-grad-dtype", default="bfloat16")
+    p.add_argument("--error-feedback", action="store_true",
+                   help="EF-SGD for the int8 quantized wire (requires "
+                        "--allreduce-grad-dtype int8); shard-level on "
+                        "the two_dimensional communicator")
     p.add_argument("--sequence-parallel", action="store_true",
                    help="shard the sequence over the mesh (ring attention)")
     p.add_argument("--packed", action="store_true",
@@ -180,6 +184,7 @@ def run_packed(args, comm, compute_dtype, rng):
     optimizer = chainermn_tpu.create_multi_node_optimizer(
         optax.adamw(args.lr), comm,
         double_buffering=args.double_buffering,
+        error_feedback=args.error_feedback,
     )
     state = create_train_state(params, optimizer, comm)
     step = make_train_step(loss_fn, optimizer, comm)
@@ -237,6 +242,7 @@ def run_data_parallel(args, comm, compute_dtype, rng):
     optimizer = chainermn_tpu.create_multi_node_optimizer(
         optax.adamw(args.lr), comm,
         double_buffering=args.double_buffering,
+        error_feedback=args.error_feedback,
     )
     state = create_train_state(params, optimizer, comm)
     step = make_train_step(loss_fn, optimizer, comm)

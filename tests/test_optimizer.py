@@ -1071,3 +1071,62 @@ class TestShardLevelEF:
         # coordinate 1's exact target moved; plain int8 left it at ~0
         assert abs(w[1]) < 1e-6, w[1]
         assert abs(30 * grads_np[:, 1].mean()) > 0.02
+
+    def test_topology_structure_with_feedback(self):
+        """Structural certificate for the EF form (CLAUDE.md: measured,
+        not asserted in prose): adding the residual must not move any
+        collective — the exact reduce_scatter and the f32 payload
+        all_gather ride INTRA; every int8 collective (all_to_all +
+        payload gathers) rides INTER only. A refactor routing f32
+        across inter (or int8 across intra) fails here even if every
+        numeric test still passes."""
+        from jax.extend import core as jex_core
+
+        from chainermn_tpu.parallel.collectives import (
+            int8_two_level_allreduce_mean_with_feedback,
+            two_level_shard_len,
+        )
+        from chainermn_tpu.testing import _subjaxprs
+
+        L = 1024
+        closed = jax.make_jaxpr(
+            lambda g, e: int8_two_level_allreduce_mean_with_feedback(
+                g, e, "intra", "inter"),
+            axis_env=[("inter", 2), ("intra", 4)],
+        )(jnp.zeros((L,), jnp.float32),
+          jnp.zeros((two_level_shard_len(L, 4),), jnp.float32))
+
+        seen = []
+
+        def walk(jaxpr):
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name in ("reduce_scatter", "all_gather",
+                                          "all_to_all"):
+                    axes = eqn.params.get("axis_name")
+                    dt = (eqn.invars[0].aval.dtype
+                          if not isinstance(eqn.invars[0], jex_core.Literal)
+                          else eqn.invars[0].val.dtype)
+                    seen.append((eqn.primitive.name, axes, str(dt)))
+                for _, sub in _subjaxprs(eqn.params):
+                    walk(sub)
+
+        walk(closed.jaxpr)
+
+        def axes_of(entry):
+            a = entry[1]
+            return a if isinstance(a, tuple) else (a,)
+
+        a2a = [e for e in seen if e[0] == "all_to_all"]
+        assert a2a and all(axes_of(e) == ("inter",) and e[2] == "int8"
+                           for e in a2a), seen
+        rs = [e for e in seen if e[0] == "reduce_scatter"]
+        assert rs and all(axes_of(e) == ("intra",) and e[2] == "float32"
+                          for e in rs), seen
+        int8_gathers = [e for e in seen
+                        if e[0] == "all_gather" and e[2] == "int8"]
+        assert int8_gathers and all(axes_of(e) == ("inter",)
+                                    for e in int8_gathers), seen
+        # the residual path adds NO intra-axis traffic beyond the f32
+        # scatter/gather pair of the exact frame
+        intra_ops = [e for e in seen if "intra" in axes_of(e)]
+        assert all(e[2] == "float32" for e in intra_ops), seen
