@@ -341,6 +341,37 @@ def count_primitives(fn, *args, axis_env=()):
     return dict(counts)
 
 
+def collect_collectives(fn, *args, axis_env=(),
+                        primitives=("reduce_scatter", "all_gather",
+                                    "all_to_all", "ppermute", "psum")):
+    """Trace ``fn(*args)`` and collect ``(primitive, axis_names, dtype)``
+    for every matching collective, recursing into subjaxprs —
+    ``axis_names`` normalised to a tuple. The shared scaffolding of the
+    which-dtype-rides-which-axis structural certificates (the
+    topology-aware wire tests)."""
+    import jax
+    from jax.extend import core as jex_core
+
+    closed = jax.make_jaxpr(fn, axis_env=list(axis_env))(*args)
+    seen: list = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in primitives:
+                axes = eqn.params.get("axis_name")
+                if not isinstance(axes, tuple):
+                    axes = (axes,)
+                dt = (eqn.invars[0].aval.dtype
+                      if not isinstance(eqn.invars[0], jex_core.Literal)
+                      else eqn.invars[0].val.dtype)
+                seen.append((eqn.primitive.name, axes, str(dt)))
+            for _, sub in _subjaxprs(eqn.params):
+                walk(sub)
+
+    walk(closed.jaxpr)
+    return seen
+
+
 __all__ = [
     "ensure_virtual_devices",
     "make_test_communicator",
@@ -349,5 +380,6 @@ __all__ = [
     "seeded_batch",
     "collective_taint",
     "count_primitives",
+    "collect_collectives",
     "COLLECTIVE_PRIMITIVES",
 ]

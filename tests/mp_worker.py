@@ -128,6 +128,77 @@ def case_hierarchical():
     assert int(state.step) == 1
 
 
+def case_shard_ef():
+    """Round-5 shard-level EF across REAL process boundaries: the
+    two_dimensional communicator's (inter=processes, intra=local
+    devices) mesh with the int8 wire + shard-shaped residual state
+    through the standard trainer — the inter/DCN leg (where the EF
+    quantization lives) rides gloo between processes here. Several
+    steps, finite loss, residual carried and per-slot distinct."""
+    import optax
+    from chainermn_tpu.communicators.xla_communicator import (
+        TwoDimensionalCommunicator,
+    )
+    from chainermn_tpu.models import MLP
+    from chainermn_tpu.optimizers import create_multi_node_optimizer
+    from chainermn_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    comm = TwoDimensionalCommunicator()
+    assert comm.mesh.shape["inter"] == SIZE
+    intra_ax, inter_ax = comm.two_level_axes
+    assert (intra_ax, inter_ax) == ("intra", "inter")
+
+    model = MLP(n_units=8, n_out=4)
+    batch = 2 * comm.size
+    rng = np.random.default_rng(3)
+    xl = rng.standard_normal((batch, 10)).astype(np.float32)
+    yl = (np.arange(batch) % 4).astype(np.int32)
+    x, y = multihost_utils.host_local_array_to_global_array(
+        (jnp.asarray(xl), jnp.asarray(yl)), comm.mesh, P()
+    )
+    variables = model.init(jax.random.PRNGKey(0), xl[:1])
+
+    def loss_fn(params, batch_):
+        xb, yb = batch_
+        logits = model.apply({"params": params}, xb)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+
+    opt = create_multi_node_optimizer(
+        optax.sgd(0.1), comm,
+        allreduce_grad_dtype=jnp.int8, error_feedback=True,
+    )
+    state = create_train_state(variables["params"], opt, comm)
+    res0 = jax.tree.leaves(state.opt_state.residual)[0]
+    assert res0.shape[0] == comm.size  # stacked per mesh slot
+    step = make_train_step(loss_fn, opt, comm, donate=False)
+    first = None
+    for _ in range(6):
+        state, metrics = step(state, (x, y))
+        loss = float(jax.device_get(metrics["loss"]))
+        first = loss if first is None else first
+    assert np.isfinite(loss)
+    assert loss < first, (loss, first)  # it actually trains
+    # residual evolved away from the zero init (quantization happened
+    # on the inter leg and was captured), and the slots this process
+    # addresses hold DISTINCT per-slot values — a replication regression
+    # (every slot carrying slot 0's residual) fails here.
+    shards = [
+        np.asarray(s.data).reshape(-1)
+        for s in jax.tree.leaves(
+            state.opt_state.residual)[0].addressable_shards
+    ]
+    assert max(np.abs(v).max() for v in shards) > 0.0
+    assert len(shards) >= 2 and not all(
+        np.array_equal(v, shards[0]) for v in shards[1:]
+    ), [v[:4] for v in shards]
+
+
 def case_iterator():
     """Multihost master-broadcast iterator: identical batches everywhere."""
     from chainermn_tpu import create_communicator

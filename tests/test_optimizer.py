@@ -872,47 +872,17 @@ class TestInt8TwoLevel:
     def test_topology_structure(self):
         """Structural certificate: exact reduce_scatter + all_gather ride
         INTRA; the int8 all_to_all + payload gather ride INTER only."""
-        from jax.extend import core as jex_core
-
         from chainermn_tpu.parallel.collectives import (
             int8_two_level_allreduce_mean,
         )
-        from chainermn_tpu.testing import _subjaxprs
+        from chainermn_tpu.testing import collect_collectives
 
-        closed = jax.make_jaxpr(
+        seen = collect_collectives(
             lambda g: int8_two_level_allreduce_mean(g, "intra", "inter"),
+            jnp.zeros((1024,), jnp.float32),
             axis_env=[("inter", 2), ("intra", 4)],
-        )(jnp.zeros((1024,), jnp.float32))
-
-        seen = []
-
-        def walk(jaxpr):
-            for eqn in jaxpr.eqns:
-                if eqn.primitive.name in ("reduce_scatter", "all_gather",
-                                          "all_to_all"):
-                    axes = eqn.params.get("axis_name")
-                    dt = (eqn.invars[0].aval.dtype
-                          if not isinstance(eqn.invars[0], jex_core.Literal)
-                          else eqn.invars[0].val.dtype)
-                    seen.append((eqn.primitive.name, axes, str(dt)))
-                for _, sub in _subjaxprs(eqn.params):
-                    walk(sub)
-
-        walk(closed.jaxpr)
-        def axes_of(entry):
-            a = entry[1]
-            return a if isinstance(a, tuple) else (a,)
-
-        a2a = [e for e in seen if e[0] == "all_to_all"]
-        assert a2a and all(axes_of(e) == ("inter",) and e[2] == "int8"
-                           for e in a2a), seen
-        rs = [e for e in seen if e[0] == "reduce_scatter"]
-        assert rs and all(axes_of(e) == ("intra",) and e[2] == "float32"
-                          for e in rs), seen
-        int8_gathers = [e for e in seen
-                        if e[0] == "all_gather" and e[2] == "int8"]
-        assert int8_gathers and all(axes_of(e) == ("inter",)
-                                    for e in int8_gathers), seen
+        )
+        _assert_int8_rides_inter_only(seen)
 
     def test_gradient_is_straight_through(self):
         """CLAUDE.md values-AND-gradients invariant: jax.grad through
@@ -1080,53 +1050,39 @@ class TestShardLevelEF:
         payload gathers) rides INTER only. A refactor routing f32
         across inter (or int8 across intra) fails here even if every
         numeric test still passes."""
-        from jax.extend import core as jex_core
-
         from chainermn_tpu.parallel.collectives import (
             int8_two_level_allreduce_mean_with_feedback,
             two_level_shard_len,
         )
-        from chainermn_tpu.testing import _subjaxprs
+        from chainermn_tpu.testing import collect_collectives
 
         L = 1024
-        closed = jax.make_jaxpr(
+        seen = collect_collectives(
             lambda g, e: int8_two_level_allreduce_mean_with_feedback(
                 g, e, "intra", "inter"),
+            jnp.zeros((L,), jnp.float32),
+            jnp.zeros((two_level_shard_len(L, 4),), jnp.float32),
             axis_env=[("inter", 2), ("intra", 4)],
-        )(jnp.zeros((L,), jnp.float32),
-          jnp.zeros((two_level_shard_len(L, 4),), jnp.float32))
-
-        seen = []
-
-        def walk(jaxpr):
-            for eqn in jaxpr.eqns:
-                if eqn.primitive.name in ("reduce_scatter", "all_gather",
-                                          "all_to_all"):
-                    axes = eqn.params.get("axis_name")
-                    dt = (eqn.invars[0].aval.dtype
-                          if not isinstance(eqn.invars[0], jex_core.Literal)
-                          else eqn.invars[0].val.dtype)
-                    seen.append((eqn.primitive.name, axes, str(dt)))
-                for _, sub in _subjaxprs(eqn.params):
-                    walk(sub)
-
-        walk(closed.jaxpr)
-
-        def axes_of(entry):
-            a = entry[1]
-            return a if isinstance(a, tuple) else (a,)
-
-        a2a = [e for e in seen if e[0] == "all_to_all"]
-        assert a2a and all(axes_of(e) == ("inter",) and e[2] == "int8"
-                           for e in a2a), seen
-        rs = [e for e in seen if e[0] == "reduce_scatter"]
-        assert rs and all(axes_of(e) == ("intra",) and e[2] == "float32"
-                          for e in rs), seen
-        int8_gathers = [e for e in seen
-                        if e[0] == "all_gather" and e[2] == "int8"]
-        assert int8_gathers and all(axes_of(e) == ("inter",)
-                                    for e in int8_gathers), seen
+        )
+        _assert_int8_rides_inter_only(seen)
         # the residual path adds NO intra-axis traffic beyond the f32
         # scatter/gather pair of the exact frame
-        intra_ops = [e for e in seen if "intra" in axes_of(e)]
+        intra_ops = [e for e in seen if "intra" in e[1]]
         assert all(e[2] == "float32" for e in intra_ops), seen
+
+
+def _assert_int8_rides_inter_only(seen):
+    """Shared assertions of the topology-aware wire's structural
+    certificates (bare and EF forms): int8 all_to_all + int8 payload
+    gathers on INTER only; the exact f32 reduce_scatter on INTRA only.
+    ``seen`` is ``chainermn_tpu.testing.collect_collectives`` output."""
+    a2a = [e for e in seen if e[0] == "all_to_all"]
+    assert a2a and all(e[1] == ("inter",) and e[2] == "int8"
+                       for e in a2a), seen
+    rs = [e for e in seen if e[0] == "reduce_scatter"]
+    assert rs and all(e[1] == ("intra",) and e[2] == "float32"
+                      for e in rs), seen
+    int8_gathers = [e for e in seen
+                    if e[0] == "all_gather" and e[2] == "int8"]
+    assert int8_gathers and all(e[1] == ("inter",)
+                                for e in int8_gathers), seen
