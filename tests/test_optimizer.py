@@ -1070,6 +1070,50 @@ class TestShardLevelEF:
         intra_ops = [e for e in seen if "intra" in e[1]]
         assert all(e[2] == "float32" for e in intra_ops), seen
 
+    def test_composes_with_double_buffering_on_two_level_mesh(self):
+        """Shard-level EF + double buffering on the (2,4) mesh through
+        the standard trainer: staleness-1 intact (step 0 applies
+        zeros; two steps apply one reduced grad) with the shard-shaped
+        residual carried alongside the banked grads."""
+        from chainermn_tpu.optimizers import (
+            _DoubleBufferState,
+            _ErrorFeedbackState,
+        )
+        from chainermn_tpu.training.train_step import (
+            create_train_state,
+            make_train_step,
+        )
+
+        comm = self._mesh_comm()
+        grads_np = self._grads()
+        params = {"w": jnp.zeros((6,), jnp.float32)}
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8,
+            double_buffering=True, error_feedback=True,
+        )
+        st = opt.init(params)
+        assert isinstance(st, _ErrorFeedbackState)
+        assert isinstance(st.inner, _DoubleBufferState)
+
+        def loss_fn(p, batch):
+            return jnp.sum(p["w"] * batch[0])
+
+        state = create_train_state(params, opt, comm)
+        step = make_train_step(loss_fn, opt, comm, donate=False)
+        batch = jnp.asarray(grads_np)
+        state, _ = step(state, batch)
+        np.testing.assert_allclose(
+            np.asarray(state.params["w"]), np.zeros(6), atol=1e-7)
+        state, _ = step(state, batch)
+        # exactly one (quantized) mean applied; the healthy coords are
+        # super-quantum so they land within one message quantum
+        msg_quantum = 0.9 / 127.0
+        np.testing.assert_allclose(
+            np.asarray(state.params["w"])[2:], -grads_np.mean(0)[2:],
+            atol=msg_quantum,
+        )
+
 
 def _assert_int8_rides_inter_only(seen):
     """Shared assertions of the topology-aware wire's structural
