@@ -987,7 +987,10 @@ class TestShardLevelEF:
         g[:, 2:] = 0.05                     # healthy super-quantum coords
         return g
 
-    def _cumulative(self, error_feedback, steps=30):
+    def _trainer(self, **opt_kwargs):
+        """Shared trainer setup over the (2,4) mesh with the
+        quantization-hostile grads: returns (state, step, batch,
+        grads_np, opt)."""
         from chainermn_tpu.training.train_step import (
             create_train_state,
             make_train_step,
@@ -998,8 +1001,7 @@ class TestShardLevelEF:
         params = {"w": jnp.zeros((6,), jnp.float32)}
         opt = create_multi_node_optimizer(
             optax.sgd(1.0), comm,
-            allreduce_grad_dtype=jnp.int8,
-            error_feedback=error_feedback,
+            allreduce_grad_dtype=jnp.int8, **opt_kwargs,
         )
 
         def loss_fn(p, batch):
@@ -1007,7 +1009,11 @@ class TestShardLevelEF:
 
         state = create_train_state(params, opt, comm)
         step = make_train_step(loss_fn, opt, comm, donate=False)
-        batch = jnp.asarray(grads_np)
+        return state, step, jnp.asarray(grads_np), grads_np, opt
+
+    def _cumulative(self, error_feedback, steps=30):
+        state, step, batch, grads_np, _ = self._trainer(
+            error_feedback=error_feedback)
         for _ in range(steps):
             state, _ = step(state, batch)
         exact = -steps * grads_np.mean(0)
@@ -1079,29 +1085,11 @@ class TestShardLevelEF:
             _DoubleBufferState,
             _ErrorFeedbackState,
         )
-        from chainermn_tpu.training.train_step import (
-            create_train_state,
-            make_train_step,
-        )
 
-        comm = self._mesh_comm()
-        grads_np = self._grads()
-        params = {"w": jnp.zeros((6,), jnp.float32)}
-        opt = create_multi_node_optimizer(
-            optax.sgd(1.0), comm,
-            allreduce_grad_dtype=jnp.int8,
-            double_buffering=True, error_feedback=True,
-        )
-        st = opt.init(params)
-        assert isinstance(st, _ErrorFeedbackState)
-        assert isinstance(st.inner, _DoubleBufferState)
-
-        def loss_fn(p, batch):
-            return jnp.sum(p["w"] * batch[0])
-
-        state = create_train_state(params, opt, comm)
-        step = make_train_step(loss_fn, opt, comm, donate=False)
-        batch = jnp.asarray(grads_np)
+        state, step, batch, grads_np, opt = self._trainer(
+            double_buffering=True, error_feedback=True)
+        assert isinstance(state.opt_state, _ErrorFeedbackState)
+        assert isinstance(state.opt_state.inner, _DoubleBufferState)
         state, _ = step(state, batch)
         np.testing.assert_allclose(
             np.asarray(state.params["w"]), np.zeros(6), atol=1e-7)
