@@ -928,14 +928,14 @@ class TestShardLevelEF:
     shape. Same invariants as the flat-wire ``TestErrorFeedback``,
     applied at the stage where the error actually arises."""
 
-    def _mesh_comm(self):
+    def _mesh_comm(self, shape=(2, 4)):
         from jax.sharding import Mesh
 
         from chainermn_tpu.communicators.xla_communicator import (
             TwoDimensionalCommunicator,
         )
 
-        devs = np.array(jax.devices("cpu")[:N]).reshape(2, 4)
+        devs = np.array(jax.devices("cpu")[:N]).reshape(shape)
         return TwoDimensionalCommunicator(
             mesh=Mesh(devs, ("inter", "intra"))
         )
@@ -1153,6 +1153,51 @@ class TestShardLevelEF:
         # bounded by a few message-level quanta in EVERY bucket
         msg_quantum = 4 * 0.9 / 127.0
         assert np.abs(got - exact).max() < 4 * msg_quantum
+
+    @pytest.mark.parametrize("shape", [(1, 8), (8, 1), (4, 2)])
+    def test_degenerate_and_alternate_factorisations(self, shape):
+        """Shard-EF across mesh factorisations: (1,8) has a degenerate
+        inter axis — the wire quantizes NOTHING, the mean is exact and
+        the residual stays zero; (8,1) has a degenerate intra axis —
+        the full buffer is the 'shard' and everything is quantized
+        (flat-wire-equivalent); (4,2) is the transposed split. One
+        trainer step each, mean within one message quantum, residual
+        shaped by two_level_shard_len."""
+        from chainermn_tpu.parallel.collectives import two_level_shard_len
+        from chainermn_tpu.training.train_step import (
+            create_train_state,
+            make_train_step,
+        )
+
+        comm = self._mesh_comm(shape)
+        params = {"w": jnp.zeros((10,), jnp.float32)}
+        opt = create_multi_node_optimizer(
+            optax.sgd(1.0), comm,
+            allreduce_grad_dtype=jnp.int8, error_feedback=True,
+        )
+        state = create_train_state(params, opt, comm, model_state={})
+        g = np.random.RandomState(1).randn(N, 10).astype(np.float32)
+
+        def loss_fn(p, b, ms):
+            return jnp.sum(p["w"] * b[0]), ({}, ms)
+
+        step = make_train_step(loss_fn, opt, comm, donate=False)
+        state, _ = step(state, (jnp.asarray(g), jnp.zeros(N)))
+        w = np.asarray(state.params["w"])
+        res = np.asarray(jax.tree.leaves(state.opt_state.residual)[0])
+        n_intra = shape[1]
+        assert res.shape == (N, two_level_shard_len(10, n_intra))
+        err = np.abs(w + g.mean(0)).max()
+        if shape[0] == 1:
+            # degenerate inter: nothing was quantized
+            assert err == 0.0 and np.abs(res).max() == 0.0
+        else:
+            # quantized inter leg: within ~one message quantum, and the
+            # dropped error was captured in the residual
+            intra_amax = np.abs(
+                g.reshape(shape[0], shape[1], 10).sum(1)).max()
+            assert err < 2 * intra_amax / 127.0, (err, intra_amax)
+            assert np.abs(res).max() > 0.0
 
 
 def _assert_int8_rides_inter_only(seen):
