@@ -83,6 +83,12 @@ TOTAL_BUDGET = int(os.environ.get("CHAINERMN_BENCH_BUDGET", 1500))
 PROBE_RETRY_SLEEP = int(os.environ.get("CHAINERMN_BENCH_PROBE_SLEEP", 45))
 PROBE_RETRIES = int(os.environ.get("CHAINERMN_BENCH_PROBE_RETRIES", 5))
 CPU_BENCH_RESERVE = 330  # budget to keep for the CPU fallback + margin
+# What the FULL CPU fallback actually needs (primary + supplementary
+# phases, ~8-10 min measured on this contended 1-core box) + the
+# parent's 180 s margin. The probe window is capped so this much budget
+# survives probing — the single constant both the window cap and the
+# probe give-up guard derive from.
+CPU_FALLBACK_NEED = int(os.environ.get("CHAINERMN_BENCH_CPU_NEED", 630))
 
 
 def _cpu_env(n_devices: int = 8) -> dict:
@@ -305,14 +311,17 @@ def _probe_with_retries(deadline: float, errors: list) -> dict | None:
     # count would concede the chip in ~3 min where the old hanging probe
     # spent ~13 — and the round-2 lesson is that the tunnel flaps on
     # minutes timescales. Keep probing for the window the old schedule
-    # implied, as budget allows.
-    window = PROBE_RETRIES * (PROBE_TIMEOUT + PROBE_RETRY_SLEEP)
+    # implied — but always leave CPU_FALLBACK_NEED (+ the parent's
+    # 180 s margin) for the CPU fallback, so it is not squeezed into
+    # its timeout-salvage path.
+    window = max(60, min(PROBE_RETRIES * (PROBE_TIMEOUT + PROBE_RETRY_SLEEP),
+                         TOTAL_BUDGET - CPU_FALLBACK_NEED - 180))
     probe_deadline = time.monotonic() + window
     attempt = 0
     while True:
         attempt += 1
         remaining = deadline - time.monotonic()
-        if remaining < CPU_BENCH_RESERVE + 60:
+        if remaining < CPU_FALLBACK_NEED + 60:
             errors.append(
                 f"accelerator probe gave up after {attempt - 1} attempts "
                 "(budget exhausted)"
