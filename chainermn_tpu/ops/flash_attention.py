@@ -159,11 +159,20 @@ def _pick_block(requested: int, T: int) -> int:
 # ---------------------------------------------------------------------------
 
 def _seg_mask(sq_ref, sk_ref):
-    """Segment mask from the per-block segment-id refs ([1, block] each):
-    attention is allowed only within the same packed segment."""
-    sq = sq_ref[0]  # [block_q]
-    sk = sk_ref[0]  # [block_k]
-    return sq[:, None] == sk[None, :]
+    """Segment mask from the per-block segment-id refs: attention is
+    allowed only within the same packed segment.
+
+    The q-ids ref is ``[1, block_q, 1]`` and the kv-ids ref
+    ``[1, 1, block_k]`` — the host side stores ids as ``[B, T, 1]`` /
+    ``[B, 1, T]`` so every Mosaic tile is (major divisible-by-8-or-full,
+    minor 1-or-divisible-by-128)-legal AND arrives already column/row
+    shaped: the mask is one VPU broadcast-compare, no in-kernel
+    transpose. A flat ``[B, T]`` layout with ``(1, block)`` tiles is
+    rejected by the Mosaic lowering (sublane dim 1 ≠ B) — caught on
+    hardware by the bench kernel sweep; interpret mode accepts it."""
+    sq = sq_ref[0]  # [block_q, 1]
+    sk = sk_ref[0]  # [1, block_k]
+    return sq == sk
 
 
 def _fwd_body(q_ref, k_ref, v_ref, seg_refs, bias_ref, o_ref, lse_ref,
@@ -333,11 +342,11 @@ def _flash_fwd_bhtd(q, k, v, seg_q=None, seg_k=None, bias=None, *, causal,
     args = (q, k, v)
     if has_segments:
         in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, h, iq, ik: (b, iq)),
-            pl.BlockSpec((1, block_k),
-                         lambda b, h, iq, j: (b, k_block(iq, j))),
+            pl.BlockSpec((1, block_q, 1), lambda b, h, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, h, iq, j: (b, 0, k_block(iq, j))),
         ]
-        args += (seg_q, seg_k)
+        args += (seg_q[:, :, None], seg_k[:, None, :])
     if has_bias:
         in_specs.append(
             _bias_spec(bias, block_q, block_k, k_of=k_block)
@@ -581,10 +590,11 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
     dq_args = (q, k, v, do, lse, delta)
     if has_segments:
         dq_in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i)),
-            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, k_block(i, j))),
+            pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, h, i, j: (b, 0, k_block(i, j))),
         ]
-        dq_args += (seg_q, seg_k)
+        dq_args += (seg_q[:, :, None], seg_k[:, None, :])
     if has_bias:
         dq_in_specs.append(_bias_spec(bias, block_q, block_k, k_of=k_block))
         dq_args += (bias,)
@@ -633,10 +643,11 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, seg_q=None, seg_k=None,
     dkv_args = (q, k, v, do, lse, delta)
     if has_segments:
         dkv_in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, q_block(i, j))),
-            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, h, i, j: (b, q_block(i, j), 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, i)),
         ]
-        dkv_args += (seg_q, seg_k)
+        dkv_args += (seg_q[:, :, None], seg_k[:, None, :])
     if has_bias:
         dkv_in_specs.append(
             _bias_spec(bias, block_q, block_k, swap=True, q_of=q_block)
