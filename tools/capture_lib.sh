@@ -1,0 +1,17 @@
+# Shared freshness predicate for chip_watch.sh / on_chip_capture.sh.
+#
+# fresh_artifact <glob> <success-token> <marker>: true iff some file in
+# tools/capture_logs matching <glob>, newer than <marker>, contains
+# <success-token>. The explicit loop matters: `find -exec grep -l {} +`
+# exits 0 when find matches ZERO files (grep never runs), which read as
+# "capture complete" on a fresh watch and silently disabled the whole
+# capture — caught in review 2026-08-01.
+fresh_artifact() {
+  local glob=$1 token=$2 marker=$3 f
+  [ -n "$marker" ] && [ -e "$marker" ] || return 1
+  for f in $(find tools/capture_logs -name "$glob" \
+               -newer "$marker" 2>/dev/null); do
+    grep -q "$token" "$f" && return 0
+  done
+  return 1
+}

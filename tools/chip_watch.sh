@@ -13,19 +13,51 @@ max_hours=${2:-11}
 deadline=$(( $(date +%s) + max_hours * 3600 ))
 mkdir -p tools/capture_logs
 log=tools/capture_logs/watch.log
+# Freshness marker: capture_logs is git-tracked and accumulates
+# artifacts ACROSS rounds, so "sweep rows exist" must mean "landed
+# since THIS watch started" — a stale log from a previous round
+# otherwise silently disables the round's whole capture.
+marker="tools/capture_logs/.watch_start"
+# Persist across watcher RESTARTS within a round: re-touching on every
+# start would mark the round's already-landed artifacts stale and re-run
+# completed 30-min stages. The marker is untracked, so a fresh checkout
+# (next round) starts clean.
+[ -e "$marker" ] || touch "$marker"
+. tools/capture_lib.sh
 echo "[watch $(date -u +%H:%M:%S)] start: interval=${interval}s max=${max_hours}h" >> "$log"
 captures=0
+max_captures=6
 while [ "$(date +%s)" -lt "$deadline" ]; do
   python tools/probe_tpu.py 180 > /dev/null 2>&1
   rc=$?
   if [ "$rc" -eq 0 ]; then
-    echo "[watch $(date -u +%H:%M:%S)] CHIP UP — launching capture" >> "$log"
-    bash tools/on_chip_capture.sh >> "$log" 2>&1
-    captures=$((captures + 1))
-    echo "[watch $(date -u +%H:%M:%S)] capture #$captures done" >> "$log"
-    # One full capture is the round's goal; keep a slow heartbeat after
-    # so a later flap is still recorded, but don't re-run the capture.
-    interval=1800
+    # A capture is COMPLETE once a LIVE bench and BOTH sweeps have
+    # landed in THIS watch run (the 2026-08-01 wedge: stage 1 landed,
+    # then the relay's compile leg died mid-stage-2 — a one-shot policy
+    # would have left the sweeps unrun for the rest of the round;
+    # checking only one stage, or counting a previous round's logs,
+    # re-creates the same silent failure). Re-fire on chip-up until
+    # complete — the capture script skips stages whose artifacts are
+    # already fresh (same marker), so a re-fire redoes only what
+    # failed. The stage-5 TPU byte audit is deliberately NOT part of
+    # completeness: it is known to wedge behind the relay, and holding
+    # the heartbeat hostage to it would spend every chip-up window on a
+    # 600 s timeout. Cap the re-fires so a persistently failing stage
+    # can't eat the round.
+    if fresh_artifact 'bench_2*.log' '"source": "live"' "$marker" \
+        && fresh_artifact 'resnet_sweep_*.log' n_variants "$marker" \
+        && fresh_artifact 'transformer_sweep_*.log' n_variants "$marker"; then
+      echo "[watch $(date -u +%H:%M:%S)] chip up; capture complete (live bench + both sweeps) — heartbeat" >> "$log"
+      interval=1800
+    elif [ "$captures" -ge "$max_captures" ]; then
+      echo "[watch $(date -u +%H:%M:%S)] chip up; capture INCOMPLETE but re-fire cap ($max_captures) reached — heartbeat" >> "$log"
+      interval=1800
+    else
+      echo "[watch $(date -u +%H:%M:%S)] CHIP UP — launching capture (attempt $((captures + 1)))" >> "$log"
+      CAPTURE_SINCE="$marker" bash tools/on_chip_capture.sh >> "$log" 2>&1
+      captures=$((captures + 1))
+      echo "[watch $(date -u +%H:%M:%S)] capture #$captures done" >> "$log"
+    fi
   else
     echo "[watch $(date -u +%H:%M:%S)] probe rc=$rc" >> "$log"
   fi

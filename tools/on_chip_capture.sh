@@ -11,34 +11,81 @@ cd "$(dirname "$0")/.."
 mkdir -p tools/capture_logs
 stamp=$(date -u +%Y%m%dT%H%M%SZ)
 
-echo "[capture $stamp] stage 1: bench.py"
-timeout 1800 python bench.py > "tools/capture_logs/bench_$stamp.log" 2>&1
-echo "[capture] bench rc=$? last line:"; tail -1 "tools/capture_logs/bench_$stamp.log" | cut -c1-400
+# Stage gating: when the watcher re-fires after a mid-capture relay
+# death, redo only what FAILED. An artifact satisfies a stage if it is
+# newer than $CAPTURE_SINCE (the watcher's watch-start marker) and
+# carries the stage's success token. Without CAPTURE_SINCE (manual
+# runs) every stage runs.
+. "$(dirname "$0")/capture_lib.sh"
+_fresh() { fresh_artifact "$1" "$2" "${CAPTURE_SINCE:-}"; }
 
-echo "[capture] stage 1b: roofline byte audits (AOT compile + analyses)"
-timeout 900 python tools/byte_audit.py transformer --remat dots \
-  > "tools/capture_logs/byte_audit_tf_$stamp.json" \
-  2> "tools/capture_logs/byte_audit_tf_$stamp.log"
-echo "[capture] tf audit rc=$?"
-timeout 900 python tools/byte_audit.py resnet --remat none \
-  > "tools/capture_logs/byte_audit_resnet_$stamp.json" \
-  2> "tools/capture_logs/byte_audit_resnet_$stamp.log"
-echo "[capture] resnet audit rc=$?"
+# bench_2* (not bench_*): stage 4 writes bench_best_<stamp>.log, whose
+# live best-config rows must not suppress the default-config stage-1
+# bench the README/docs numbers are drawn from.
+if _fresh 'bench_2*.log' '"source": "live"'; then
+  echo "[capture $stamp] stage 1: skipped (fresh live bench exists)"
+else
+  echo "[capture $stamp] stage 1: bench.py"
+  timeout 1800 python bench.py > "tools/capture_logs/bench_$stamp.log" 2>&1
+  echo "[capture] bench rc=$? last line:"; tail -1 "tools/capture_logs/bench_$stamp.log" | cut -c1-400
+fi
 
-echo "[capture] stage 2: resnet sweep"
-timeout 2400 python examples/imagenet/sweep_mfu.py \
-  > "tools/capture_logs/resnet_sweep_$stamp.log" 2>&1
-echo "[capture] resnet sweep rc=$?"; tail -2 "tools/capture_logs/resnet_sweep_$stamp.log"
+if _fresh 'byte_audit_tf_2*.json' '"flops":' \
+    && _fresh 'byte_audit_resnet_2*.json' '"flops":'; then
+  echo "[capture] stage 1b: skipped (fresh audits exist)"
+else
+  echo "[capture] stage 1b: roofline byte audits (CPU-target: FLOPs are"
+  echo "  backend-honest, and the TPU-target AOT compile wedged >900s"
+  echo "  behind the remote-compile relay on 2026-08-01 — chip time goes"
+  echo "  to the sweeps instead; a bounded TPU-target attempt runs last)"
+  timeout 600 python tools/byte_audit.py transformer --remat dots --target cpu \
+    > "tools/capture_logs/byte_audit_tf_$stamp.json" \
+    2> "tools/capture_logs/byte_audit_tf_$stamp.log"
+  echo "[capture] tf audit rc=$?"
+  timeout 600 python tools/byte_audit.py resnet --remat none --target cpu \
+    > "tools/capture_logs/byte_audit_resnet_$stamp.json" \
+    2> "tools/capture_logs/byte_audit_resnet_$stamp.log"
+  echo "[capture] resnet audit rc=$?"
+fi
 
-echo "[capture] stage 3: transformer sweep"
-timeout 2400 python examples/transformer/sweep_mfu.py \
-  --remat dots,nothing --chunks 16,32 --blocks 512x1024,512x512 --batch 16,32 \
-  > "tools/capture_logs/transformer_sweep_$stamp.log" 2>&1
-echo "[capture] transformer sweep rc=$?"; tail -2 "tools/capture_logs/transformer_sweep_$stamp.log"
+if _fresh 'resnet_sweep_*.log' 'n_variants'; then
+  echo "[capture] stage 2: skipped (fresh resnet sweep rows exist)"
+else
+  echo "[capture] stage 2: resnet sweep"
+  timeout 2400 python examples/imagenet/sweep_mfu.py \
+    > "tools/capture_logs/resnet_sweep_$stamp.log" 2>&1
+  echo "[capture] resnet sweep rc=$?"; tail -2 "tools/capture_logs/resnet_sweep_$stamp.log"
+fi
 
+if _fresh 'transformer_sweep_*.log' 'n_variants'; then
+  echo "[capture] stage 3: skipped (fresh transformer sweep rows exist)"
+else
+  echo "[capture] stage 3: transformer sweep"
+  timeout 2400 python examples/transformer/sweep_mfu.py \
+    --remat dots,nothing --chunks 8,16 --blocks 512x1024,512x512 --batch 16,32 \
+    > "tools/capture_logs/transformer_sweep_$stamp.log" 2>&1
+  echo "[capture] transformer sweep rc=$?"; tail -2 "tools/capture_logs/transformer_sweep_$stamp.log"
+fi
+
+_newest_sweep() {  # newest COMPLETE sweep log (n_variants line), else
+                   # newest row-bearing one (partial grid, labelled below)
+  local f
+  for f in $(ls -t tools/capture_logs/$1 2>/dev/null); do
+    grep -q n_variants "$f" && { echo "$f"; return; }
+  done
+  ls -t tools/capture_logs/$1 2>/dev/null | head -1
+}
+
+if _fresh 'bench_best_*.log' '"source": "live"'; then
+  echo "[capture] stage 4: skipped (fresh best-config bench exists)"
+else
 echo "[capture] stage 4: adopt winners -> fresh bench at best config"
-knobs=$(python - "tools/capture_logs/resnet_sweep_$stamp.log" \
-               "tools/capture_logs/transformer_sweep_$stamp.log" <<'PYEOF'
+# Stage 2/3 may have been skip-gated, so this stamp's files need not
+# exist; prefer a COMPLETE grid over a newer partial one.
+rs_log=$(_newest_sweep 'resnet_sweep_*.log')
+tf_log=$(_newest_sweep 'transformer_sweep_*.log')
+echo "[capture] winners from: ${rs_log:-none} ${tf_log:-none}"
+knobs=$(python - "${rs_log:-/dev/null}" "${tf_log:-/dev/null}" <<'PYEOF'
 import json, sys
 
 def rows_of(path):
@@ -83,5 +130,18 @@ if [ -n "${knobs:-}" ]; then
     > "tools/capture_logs/bench_best_$stamp.log" 2>&1
   echo "[capture] best-config bench rc=$?"
   tail -1 "tools/capture_logs/bench_best_$stamp.log" | cut -c1-400
+fi
+fi
+if _fresh 'byte_audit_tf_tpu_*.json' '"flops":'; then
+  echo "[capture] stage 5: skipped (fresh TPU-target audit exists)"
+else
+  echo "[capture] stage 5: bounded TPU-target byte audit (the on-chip"
+  echo "  bytes-accessed number; progress trail shows the wedge phase if"
+  echo "  the remote compile hangs again)"
+  timeout 600 python tools/byte_audit.py transformer --remat dots \
+    > "tools/capture_logs/byte_audit_tf_tpu_$stamp.json" \
+    2> "tools/capture_logs/byte_audit_tf_tpu_$stamp.log"
+  echo "[capture] tf tpu-audit rc=$? trail:"
+  tail -2 "tools/capture_logs/byte_audit_tf_tpu_$stamp.log"
 fi
 echo "[capture $stamp] done"
