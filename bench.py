@@ -1177,11 +1177,22 @@ def _transformer_setup(comm, on_accel: bool, steps: int | None = None,
             )
         B = int(os.environ.get("CHAINERMN_BENCH_TF_BATCH", "16"))
         n_chunks = int(os.environ.get("CHAINERMN_BENCH_TF_CHUNKS", "16"))
+        # Head GEOMETRY at fixed d_model: H16xD64 (the classic -base
+        # split) vs H8xD128. Identical params and model FLOPs — the qkv
+        # projections are d_model x d_model either way — but D=64 head
+        # tiles fill only half the 128-wide MXU contraction / VMEM lane
+        # dim, so D=128 is the hardware-shaped split. Sweepable so the
+        # capture measures rather than asserts the difference.
+        n_heads = int(os.environ.get("CHAINERMN_BENCH_TF_HEADS", "16"))
+        if n_heads < 1 or 1024 % n_heads:
+            raise ValueError(
+                f"CHAINERMN_BENCH_TF_HEADS must divide 1024, got {n_heads}"
+            )
         T = 2048
         if steps is None:
             steps = 10
         model = TransformerLM(
-            num_layers=8, d_model=1024, num_heads=16, d_ff=4096,
+            num_layers=8, d_model=1024, num_heads=n_heads, d_ff=4096,
             max_len=2048, remat=remat_mode != "none",
             remat_policy="dots" if remat_mode != "nothing" else "nothing",
             return_hidden=True,
@@ -1191,7 +1202,7 @@ def _transformer_setup(comm, on_accel: bool, steps: int | None = None,
         # machinery compares like with like — same rule as the ResNet
         # knobs.
         knob_fields = {"tf_remat": remat_mode, "tf_batch": B,
-                       "tf_chunks": n_chunks}
+                       "tf_chunks": n_chunks, "tf_heads": n_heads}
     else:
         B, T = 2, 128
         if steps is None:

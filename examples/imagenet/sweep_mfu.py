@@ -186,7 +186,15 @@ def main(argv=None):
         results.append(r)
 
     ok = [r for r in results if "step_ms" in r]
-    ok.sort(key=lambda r: r["step_ms"])
+    # Best by MFU (fallback throughput): batch is a grid dimension, so
+    # step_ms ordering would rank the smallest batch first regardless of
+    # efficiency. The fallback is PER-RUN, not per-row — mixing mfu
+    # (<=1) with raw throughput (thousands) would rank any mfu-less row
+    # first; a row missing mfu in an mfu-bearing run ranks last (0).
+    if any("mfu" in r for r in ok):
+        ok.sort(key=lambda r: -r.get("mfu", 0))
+    else:
+        ok.sort(key=lambda r: -r.get("images_per_sec", 0))
     if ok:
         print(json.dumps({"best": ok[0], "n_variants": len(results)}))
     return ok

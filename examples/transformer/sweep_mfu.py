@@ -41,7 +41,8 @@ from chainermn_tpu.ops.flash_attention import flash_attention
 
 
 def time_variant(comm, args, *, remat: str, n_chunks: int,
-                 block_q: int, block_k: int, batch: int) -> dict:
+                 block_q: int, block_k: int, batch: int,
+                 n_heads: int) -> dict:
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -52,9 +53,13 @@ def time_variant(comm, args, *, remat: str, n_chunks: int,
                                block_q=block_q, block_k=block_k,
                                interpret=interpret)
 
+    # Head geometry at fixed d_model: identical params/model-FLOPs,
+    # but D = d_model/heads is the MXU contraction depth and the VMEM
+    # lane width in the flash kernel — D=64 fills half of each.
+    heads = n_heads
     model = TransformerLM(
         num_layers=args.layers, d_model=args.d_model,
-        num_heads=args.heads, d_ff=args.d_ff, max_len=args.seq_len,
+        num_heads=heads, d_ff=args.d_ff, max_len=args.seq_len,
         remat=remat != "none",
         remat_policy="dots" if remat != "nothing" else "nothing",
         return_hidden=True, attention_fn=attn,
@@ -114,7 +119,7 @@ def time_variant(comm, args, *, remat: str, n_chunks: int,
     )
     out = {
         "remat": remat, "n_chunks": n_chunks, "batch": batch,
-        "block_q": block_q, "block_k": block_k,
+        "block_q": block_q, "block_k": block_k, "heads": heads,
         "step_ms": round(dt * 1e3, 2),
         "tokens_per_sec": round(B * T / dt, 1),
         "compile_s": round(compile_s, 1),
@@ -130,7 +135,10 @@ def main(argv=None):
     p.add_argument("--communicator", default="xla")
     p.add_argument("--layers", type=int, default=8)
     p.add_argument("--d-model", type=int, default=1024)
-    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--heads", type=str, default="16,8",
+                   help="comma list of head counts at fixed d_model "
+                        "(same params/FLOPs; head dim = d_model/heads "
+                        "sets MXU contraction depth)")
     p.add_argument("--d-ff", type=int, default=4096)
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--batch", type=str, default="16",
@@ -156,23 +164,36 @@ def main(argv=None):
     blocks = [tuple(int(v) for v in b.split("x"))
               for b in args.blocks.split(",")]
     batches = [int(v) for v in args.batch.split(",")]
+    head_counts = [int(v) for v in str(args.heads).split(",")]
+    for h in head_counts:
+        if h < 1 or args.d_model % h:
+            p.error(f"--heads values must divide d_model, got {h}")
 
     results = []
-    for remat, n_chunks, (bq, bk), batch in itertools.product(
-        remats, chunks, blocks, batches
+    for remat, n_chunks, (bq, bk), batch, heads in itertools.product(
+        remats, chunks, blocks, batches, head_counts
     ):
         try:
             r = time_variant(comm, args, remat=remat, n_chunks=n_chunks,
-                             block_q=bq, block_k=bk, batch=batch)
+                             block_q=bq, block_k=bk, batch=batch,
+                             n_heads=heads)
         except Exception as e:  # OOM / Mosaic layout reject: keep sweeping
             r = {"remat": remat, "n_chunks": n_chunks, "block_q": bq,
-                 "block_k": bk, "batch": batch,
+                 "block_k": bk, "batch": batch, "heads": heads,
                  "error": f"{type(e).__name__}: {e}"[:160]}
         print(json.dumps(r), flush=True)
         results.append(r)
 
     ok = [r for r in results if "step_ms" in r]
-    ok.sort(key=lambda r: r["step_ms"])
+    # Best by MFU (fallback throughput): batch is a grid dimension, so
+    # step_ms ordering would rank the smallest batch first regardless of
+    # efficiency. The fallback is PER-RUN, not per-row — mixing mfu
+    # (<=1) with raw throughput (thousands) would rank any mfu-less row
+    # first; a row missing mfu in an mfu-bearing run ranks last (0).
+    if any("mfu" in r for r in ok):
+        ok.sort(key=lambda r: -r.get("mfu", 0))
+    else:
+        ok.sort(key=lambda r: -r.get("tokens_per_sec", 0))
     if ok:
         print(json.dumps({"best": ok[0], "n_variants": len(results)}))
     return ok

@@ -62,7 +62,8 @@ if _fresh 'transformer_sweep_*.log' 'n_variants'; then
 else
   echo "[capture] stage 3: transformer sweep"
   timeout 2400 python examples/transformer/sweep_mfu.py \
-    --remat dots,nothing --chunks 8,16 --blocks 512x1024,512x512 --batch 16,32 \
+    --remat dots,nothing --chunks 8,16 --blocks 512x1024 --batch 16,32 \
+    --heads 16,8 \
     > "tools/capture_logs/transformer_sweep_$stamp.log" 2>&1
   echo "[capture] transformer sweep rc=$?"; tail -2 "tools/capture_logs/transformer_sweep_$stamp.log"
 fi
@@ -107,7 +108,9 @@ env = []
 # even when a space_to_depth variant is globally fastest.
 std = [r for r in rows_of(sys.argv[1]) if r.get("stem") == "standard"]
 if std:
-    rb = min(std, key=lambda r: r["step_ms"])
+    # Winner by THROUGHPUT: batch is part of the grid, and min(step_ms)
+    # would just pick the smallest batch.
+    rb = max(std, key=lambda r: r.get("images_per_sec", 0))
     env.append(f"CHAINERMN_BENCH_RESNET_REMAT={rb['remat']}")
     env.append(f"CHAINERMN_BENCH_RESNET_BATCH={rb['batch']}")
     # Adopt donate too: the sweep sweeps it, bench.py defaults it off —
@@ -116,11 +119,18 @@ if std:
         "CHAINERMN_BENCH_RESNET_DONATE="
         + ("true" if rb.get("donate", False) else "false"))
 tf_rows = rows_of(sys.argv[2])
-tb = min(tf_rows, key=lambda r: r["step_ms"]) if tf_rows else None
+if any("mfu" in r for r in tf_rows):
+    tb = max(tf_rows, key=lambda r: r.get("mfu", 0))
+elif tf_rows:
+    tb = max(tf_rows, key=lambda r: r.get("tokens_per_sec", 0))
+else:
+    tb = None
 if tb:
     env.append(f"CHAINERMN_BENCH_TF_REMAT={tb['remat']}")
     env.append(f"CHAINERMN_BENCH_TF_BATCH={tb['batch']}")
     env.append(f"CHAINERMN_BENCH_TF_CHUNKS={tb['n_chunks']}")
+    if "heads" in tb:
+        env.append(f"CHAINERMN_BENCH_TF_HEADS={tb['heads']}")
 print(" ".join(env))
 PYEOF
 )
