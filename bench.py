@@ -1162,6 +1162,7 @@ def _transformer_setup(comm, on_accel: bool, steps: int | None = None,
     from chainermn_tpu.ops.flash_attention import flash_attention
 
     knob_fields = {}
+    use_db = True  # CPU-proxy config keeps the baseline-faithful default
     if on_accel:
         # LM-scale config (VERDICT r2 item 3): 8L / d1024 / 16H / ff4096,
         # T=2048 — ~134M params incl. the 32k tied embedding. Perf knobs
@@ -1188,6 +1189,17 @@ def _transformer_setup(comm, on_accel: bool, steps: int | None = None,
             raise ValueError(
                 f"CHAINERMN_BENCH_TF_HEADS must divide 1024, got {n_heads}"
             )
+        # Double buffering is part of the BASELINE workload identity
+        # ("Transformer-base LM, double-buffered allreduce"), hence the
+        # default — but on ONE chip there is no collective to overlap
+        # and the bank carry is pure cost (micro row: 0.85x), so the
+        # sweep measures both and the knob records which ran.
+        db_env = os.environ.get("CHAINERMN_BENCH_TF_DB", "true").lower()
+        if db_env not in ("true", "false"):
+            raise ValueError(
+                f"CHAINERMN_BENCH_TF_DB must be true|false, got {db_env!r}"
+            )
+        use_db = db_env == "true"
         T = 2048
         if steps is None:
             steps = 10
@@ -1202,7 +1214,8 @@ def _transformer_setup(comm, on_accel: bool, steps: int | None = None,
         # machinery compares like with like — same rule as the ResNet
         # knobs.
         knob_fields = {"tf_remat": remat_mode, "tf_batch": B,
-                       "tf_chunks": n_chunks, "tf_heads": n_heads}
+                       "tf_chunks": n_chunks, "tf_heads": n_heads,
+                       "tf_db": use_db}
     else:
         B, T = 2, 128
         if steps is None:
@@ -1242,7 +1255,7 @@ def _transformer_setup(comm, on_accel: bool, steps: int | None = None,
             lambda k, t: model.init(k, t, train=True)
         )(jax.random.PRNGKey(1), tokens[:2])
     opt = create_multi_node_optimizer(
-        optax.adam(1e-4), comm, double_buffering=True,
+        optax.adam(1e-4), comm, double_buffering=use_db,
         allreduce_grad_dtype=jnp.bfloat16,
     )
     axes = comm.grad_axes
@@ -1320,7 +1333,8 @@ def _bench_transformer(comm, on_accel: bool):
         "transformer_step_ms": round(dt * 1e3, 2),
         "transformer_params_m": round(n_params / 1e6, 1),
         "transformer_config": (
-            f"{cfg} B{B}xT{T} flash+double-buffer"
+            f"{cfg} B{B}xT{T} flash"
+            + ("+double-buffer" if knob_fields.get("tf_db", True) else "")
             + (f"+remat[{model.remat_policy}]" if model.remat else "")
             + "+fused-head"
         ),

@@ -3,8 +3,11 @@
 
 Times the full train step (fwd + bwd + grad allreduce + adam) across a
 small grid of the knobs that actually move single-chip MFU — remat
-policy, fused-LM-head chunk count, flash block sizes — and prints one
-JSON line per variant plus a ranked summary. Run on the real chip:
+policy, fused-LM-head chunk count, flash block sizes, and head count at
+fixed d_model (H16×D64 vs H8×D128: identical params and model FLOPs,
+but head dim is the MXU contraction depth and the flash kernel's VMEM
+lane width — D=64 fills half of each) — and prints one JSON line per
+variant plus a ranked summary. Run on the real chip:
 
     python examples/transformer/sweep_mfu.py
     python examples/transformer/sweep_mfu.py --layers 8 --d-model 1024 \
@@ -42,7 +45,7 @@ from chainermn_tpu.ops.flash_attention import flash_attention
 
 def time_variant(comm, args, *, remat: str, n_chunks: int,
                  block_q: int, block_k: int, batch: int,
-                 n_heads: int) -> dict:
+                 n_heads: int, db: bool = True) -> dict:
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -53,13 +56,12 @@ def time_variant(comm, args, *, remat: str, n_chunks: int,
                                block_q=block_q, block_k=block_k,
                                interpret=interpret)
 
-    # Head geometry at fixed d_model: identical params/model-FLOPs,
-    # but D = d_model/heads is the MXU contraction depth and the VMEM
-    # lane width in the flash kernel — D=64 fills half of each.
-    heads = n_heads
+    # n_heads at fixed d_model: identical params/model-FLOPs, but
+    # D = d_model/heads is the MXU contraction depth and the VMEM lane
+    # width in the flash kernel — D=64 fills half of each.
     model = TransformerLM(
         num_layers=args.layers, d_model=args.d_model,
-        num_heads=heads, d_ff=args.d_ff, max_len=args.seq_len,
+        num_heads=n_heads, d_ff=args.d_ff, max_len=args.seq_len,
         remat=remat != "none",
         remat_policy="dots" if remat != "nothing" else "nothing",
         return_hidden=True, attention_fn=attn,
@@ -78,7 +80,7 @@ def time_variant(comm, args, *, remat: str, n_chunks: int,
         jax.random.PRNGKey(1), tokens[:2]
     )
     opt = create_multi_node_optimizer(
-        optax.adam(1e-4), comm, double_buffering=True,
+        optax.adam(1e-4), comm, double_buffering=db,
         allreduce_grad_dtype=jnp.bfloat16,
     )
 
@@ -119,7 +121,8 @@ def time_variant(comm, args, *, remat: str, n_chunks: int,
     )
     out = {
         "remat": remat, "n_chunks": n_chunks, "batch": batch,
-        "block_q": block_q, "block_k": block_k, "heads": heads,
+        "block_q": block_q, "block_k": block_k, "heads": n_heads,
+        "db": db,
         "step_ms": round(dt * 1e3, 2),
         "tokens_per_sec": round(B * T / dt, 1),
         "compile_s": round(compile_s, 1),
@@ -135,6 +138,10 @@ def main(argv=None):
     p.add_argument("--communicator", default="xla")
     p.add_argument("--layers", type=int, default=8)
     p.add_argument("--d-model", type=int, default=1024)
+    p.add_argument("--db", type=str, default="true",
+                   help="comma list of true/false: double-buffered "
+                        "allreduce (baseline-identity default true; on "
+                        "one chip the bank carry is pure cost)")
     p.add_argument("--heads", type=str, default="16,8",
                    help="comma list of head counts at fixed d_model "
                         "(same params/FLOPs; head dim = d_model/heads "
@@ -168,18 +175,24 @@ def main(argv=None):
     for h in head_counts:
         if h < 1 or args.d_model % h:
             p.error(f"--heads values must divide d_model, got {h}")
+    dbs = []
+    for v in args.db.split(","):
+        v = v.strip().lower()
+        if v not in ("true", "false"):
+            p.error(f"--db values must be true/false, got {v!r}")
+        dbs.append(v == "true")
 
     results = []
-    for remat, n_chunks, (bq, bk), batch, heads in itertools.product(
-        remats, chunks, blocks, batches, head_counts
+    for remat, n_chunks, (bq, bk), batch, heads, db in itertools.product(
+        remats, chunks, blocks, batches, head_counts, dbs
     ):
         try:
             r = time_variant(comm, args, remat=remat, n_chunks=n_chunks,
                              block_q=bq, block_k=bk, batch=batch,
-                             n_heads=heads)
+                             n_heads=heads, db=db)
         except Exception as e:  # OOM / Mosaic layout reject: keep sweeping
             r = {"remat": remat, "n_chunks": n_chunks, "block_q": bq,
-                 "block_k": bk, "batch": batch, "heads": heads,
+                 "block_k": bk, "batch": batch, "heads": heads, "db": db,
                  "error": f"{type(e).__name__}: {e}"[:160]}
         print(json.dumps(r), flush=True)
         results.append(r)

@@ -48,3 +48,31 @@ def test_compact_line_fits_tail_window(tmp_path, monkeypatch):
     # the full details file holds everything
     full = json.load(open(tmp_path / "details.json"))
     assert "allreduce_curve" in full and "kernel_sweep" in full
+
+
+def test_purge_retired_methodology_rows():
+    """Rows measured under a repudiated method must not be carried
+    forward under their (unchanged) names: the long-context attention
+    rows moved to the chained-scan harness in r5 (the single-dispatch
+    values measured kernel + tunnel dispatch latency), keyed off the
+    ``flash_32k_method`` marker — same pattern as the native-input
+    rows' ``native_input_method``."""
+    old = {
+        "flash_32k_fwd_ms": 104.9,
+        "flash_32k_window2k_fwd_ms": 72.4,
+        "xla_32k_fwd_ms": 1.0,
+        "xla_32k_error": "OOM (34.4 GB)",  # method-independent: kept
+        "mfu": 0.299,
+        "transformer_hw_util": 0.02,  # always-retired key
+    }
+    bench._purge_retired(old)
+    for k in bench._OLD_METHOD_32K_KEYS:
+        assert k not in old, k
+    assert "transformer_hw_util" not in old
+    assert old["xla_32k_error"].startswith("OOM")
+    assert old["mfu"] == 0.299
+
+    # marker present -> new-method rows survive the merge untouched
+    new = {"flash_32k_fwd_ms": 40.0, "flash_32k_method": "chained-scan"}
+    bench._purge_retired(new)
+    assert new["flash_32k_fwd_ms"] == 40.0
