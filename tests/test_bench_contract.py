@@ -76,3 +76,25 @@ def test_purge_retired_methodology_rows():
     new = {"flash_32k_fwd_ms": 40.0, "flash_32k_method": "chained-scan"}
     bench._purge_retired(new)
     assert new["flash_32k_fwd_ms"] == 40.0
+
+
+def test_transformer_knob_env_validation(monkeypatch):
+    """The accel transformer knobs reject malformed env values with a
+    message naming the variable (a bare ZeroDivisionError from
+    CHAINERMN_BENCH_TF_HEADS=0 once leaked through review)."""
+    import pytest
+
+    class _Comm:  # knob validation happens before any communicator use
+        size = 1
+
+    cases = {
+        "CHAINERMN_BENCH_TF_HEADS": ["0", "-8", "7"],
+        "CHAINERMN_BENCH_TF_DB": ["yes", "1"],
+        "CHAINERMN_BENCH_TF_REMAT": ["conv", "all"],
+    }
+    for var, bads in cases.items():
+        for bad in bads:
+            monkeypatch.setenv(var, bad)
+            with pytest.raises(ValueError, match=var.rsplit("_", 1)[-1]):
+                bench._transformer_setup(_Comm(), on_accel=True)
+            monkeypatch.delenv(var)
