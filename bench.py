@@ -455,7 +455,21 @@ def main() -> None:
 
     accel = _probe_with_retries(deadline, errors)
     if accel is not None:
-        budget = min(900.0, deadline - time.monotonic() - CPU_BENCH_RESERVE)
+        # All remaining budget minus the CPU-fallback reserve: the fixed
+        # 900 s cap made the 2026-08-01 live run drop its last phase
+        # (native input) with ~4 min still on the clock. The child prints
+        # a cumulative line after every phase, so even a timeout only
+        # costs the unfinished phase; a child that wedges before its
+        # FIRST line still leaves the reserve for the CPU fallback's own
+        # early-primary-line salvage.
+        remaining = deadline - time.monotonic()
+        budget = remaining - CPU_BENCH_RESERVE
+        if budget < 300.0:
+            # Degenerate tail (probe retries ate the window): give the
+            # accel child a bare slice WITHOUT silently eating the CPU
+            # reserve past it — both children print their primary line
+            # early, so each still salvages a headline.
+            budget = min(300.0, max(60.0, remaining - 180.0))
         result, err = _run_child("accel", budget)
         if result is not None:
             result["source"] = "live"
