@@ -465,11 +465,12 @@ def main() -> None:
         remaining = deadline - time.monotonic()
         budget = remaining - CPU_BENCH_RESERVE
         if budget < 300.0:
-            # Degenerate tail (probe retries ate the window): give the
-            # accel child a bare slice WITHOUT silently eating the CPU
-            # reserve past it — both children print their primary line
-            # early, so each still salvages a headline.
-            budget = min(300.0, max(60.0, remaining - 180.0))
+            # Degenerate tail (probe retries ate the window): a bare
+            # slice, still respecting the CPU reserve — the reserve is
+            # what lets a wedged-before-first-line accel child be
+            # followed by a CPU fallback that has time to print its own
+            # primary line.
+            budget = min(300.0, max(60.0, remaining - CPU_BENCH_RESERVE))
         result, err = _run_child("accel", budget)
         if result is not None:
             result["source"] = "live"
@@ -1690,7 +1691,11 @@ def _bench_kernel_sweep(on_accel: bool):
     def fwdbwd(fn):
         def f(*a):
             return jnp.sum(fn(*a).astype(jnp.float32))
-        return jax.grad(f, argnums=0)
+        # argnums=(0,1,2), NOT 0: grad wrt q alone needs only the dq
+        # kernel — the dkv kernel would be dead-code-eliminated and
+        # never face Mosaic (the gap that let the dkv segment specs go
+        # unchecked until r5).
+        return jax.grad(f, argnums=(0, 1, 2))
 
     variants = [
         ("causal_fwd", fwd(lambda q, k, v: flash_attention(
@@ -1706,6 +1711,9 @@ def _bench_kernel_sweep(on_accel: bool):
         ("gqa4_fwdbwd", fwdbwd(lambda q, k, v: flash_attention(
             q, k, v, causal=True, interpret=False)), (q, kv2, kv2)),
         ("segments_fwd", fwd(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, segment_ids=seg, interpret=False)),
+         (q, q, q)),
+        ("segments_fwdbwd", fwdbwd(lambda q, k, v: flash_attention(
             q, k, v, causal=True, segment_ids=seg, interpret=False)),
          (q, q, q)),
         ("cross_len_fwd", fwd(lambda q, k, v: flash_attention(
@@ -1742,6 +1750,32 @@ def _bench_kernel_sweep(on_accel: bool):
         return jnp.sum(out.astype(jnp.float32))
 
     variants.append(("sp_window_ext_fwd", sp_ext, (q, k_ext, v_ext)))
+
+    from chainermn_tpu.ops.flash_attention import flash_block_bwd
+
+    def sp_ext_bwd(qq, kk, vv):
+        # The SP ring's backward entry with the same extended-K banded
+        # geometry: lse/delta derived from the fwd, do = ones. Compiles
+        # the dq AND dkv kernels with wrap-sentinel segment ids.
+        out, lse = flash_block_fwd(
+            qq, kk, vv, causal=True, scale=D**-0.5, window=W,
+            q_offset=tail, seg_q=seg_q, seg_kv=seg_k,
+            block_q=512, block_k=1024, interpret=False,
+        )
+        do = jnp.ones_like(out)
+        delta = jnp.sum(
+            (do * out).astype(jnp.float32), axis=-1
+        ).transpose(0, 2, 1)
+        dq, dk, dv = flash_block_bwd(
+            qq, kk, vv, do, lse, delta, causal=True, scale=D**-0.5,
+            window=W, q_offset=tail, seg_q=seg_q, seg_kv=seg_k,
+            block_q=512, block_k=1024, interpret=False,
+        )
+        return (jnp.sum(dq.astype(jnp.float32))
+                + jnp.sum(dk.astype(jnp.float32))
+                + jnp.sum(dv.astype(jnp.float32)))
+
+    variants.append(("sp_window_ext_bwd", sp_ext_bwd, (q, k_ext, v_ext)))
 
     rows = []
     for name, fn, args in variants:
