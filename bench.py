@@ -1674,6 +1674,7 @@ def _bench_kernel_sweep(on_accel: bool):
     import jax
     import jax.numpy as jnp
 
+    from chainermn_tpu.ops.attention import dot_product_attention
     from chainermn_tpu.ops.flash_attention import flash_attention
 
     B, T, H, D = 2, 2048, 8, 128
@@ -1682,6 +1683,52 @@ def _bench_kernel_sweep(on_accel: bool):
     kv2 = jax.random.normal(ks[1], (B, T, 2, D), jnp.bfloat16)
     seg = (jnp.arange(T)[None, :] // 512).astype(jnp.int32).repeat(B, 0)
     k_long = jax.random.normal(ks[2], (B, 3072, H, D), jnp.bfloat16)
+
+    # Sliding-window reference: the materialised comparator has no window
+    # arg, but an additive band bias reproduces it exactly.
+    def band_bias(W):
+        qpos = jnp.arange(T)[:, None]
+        kpos = jnp.arange(T)[None, :]
+        return jnp.where(
+            qpos - kpos < W, 0.0, -1e9
+        )[None, None, :, :].astype(jnp.float32)
+
+    # Numerics references for the fwd variants: compile/run alone cannot
+    # catch a SILENTLY wrong Mosaic schedule (e.g. a misdeclared parallel
+    # grid dim) — compare each flash output against the materialised
+    # reference on the chip itself. bf16 accumulate-order differences sit
+    # well under the 0.05 gate; a scheduling bug blows past it.
+    numerics = {
+        "causal_fwd": (
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=True, interpret=False),
+            lambda q_, k_, v_: dot_product_attention(
+                q_, k_, v_, causal=True),
+        ),
+        "window_odd_fwd": (
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=True, window=1023, interpret=False),
+            lambda q_, k_, v_: dot_product_attention(
+                q_, k_, v_, causal=True, bias=band_bias(1023)),
+        ),
+        "segments_fwd": (
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=True, segment_ids=seg, interpret=False),
+            lambda q_, k_, v_: dot_product_attention(
+                q_, k_, v_, causal=True, segment_ids=seg),
+        ),
+        "gqa4_fwdbwd": (
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=True, interpret=False),
+            lambda q_, k_, v_: dot_product_attention(
+                q_, k_, v_, causal=True),
+        ),
+        "cross_len_fwd": (
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=False, interpret=False),
+            lambda q_, k_, v_: dot_product_attention(q_, k_, v_),
+        ),
+    }
 
     def fwd(fn):
         def f(*a):
@@ -1790,6 +1837,21 @@ def _bench_kernel_sweep(on_accel: bool):
             _fetch_scalar(jax.tree.leaves(out)[0].ravel()[:1])
             row["ms"] = round((time.perf_counter() - t0) / 3 * 1e3, 2)
             row["ok"] = True
+            if name in numerics:
+                try:
+                    flash_t, ref_t = numerics[name]
+                    fa = jax.jit(
+                        lambda *a, _f=flash_t: _f(*a).astype(jnp.float32)
+                    )(*args)
+                    rf = jax.jit(
+                        lambda *a, _r=ref_t: _r(*a).astype(jnp.float32)
+                    )(*args)
+                    err = jnp.max(jnp.abs(fa - rf))
+                    den = jnp.max(jnp.abs(rf)) + 1e-6
+                    row["rel_err"] = round(_fetch_scalar(err / den), 5)
+                    row["numerics_ok"] = row["rel_err"] < 0.05
+                except Exception as e:
+                    row["numerics_error"] = f"{type(e).__name__}: {e}"[:120]
         except Exception as e:
             row["ok"] = False
             row["error"] = f"{type(e).__name__}: {e}"[:160]
@@ -1797,6 +1859,9 @@ def _bench_kernel_sweep(on_accel: bool):
     return {
         "kernel_sweep": rows,
         "kernel_sweep_failures": sum(1 for r in rows if not r["ok"]),
+        "kernel_sweep_numeric_failures": sum(
+            1 for r in rows if not r.get("numerics_ok", True)
+        ),
     }
 
 
