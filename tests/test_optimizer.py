@@ -31,13 +31,16 @@ def _per_rank_grads(comm):
     return rng.randn(N, 4).astype(np.float32)
 
 
-def _run_sharded_update(comm, opt, grads_stacked, params, n_steps=1):
+def _run_sharded_update(comm, opt, grads_stacked, params, n_steps=1,
+                        state=None):
     """Run `opt.update` inside shard_map over the comm's mesh: the production
-    usage pattern (gradient reduction happens in-program)."""
+    usage pattern (gradient reduction happens in-program). ``state``
+    threads a prior run's optimizer state (default: fresh init)."""
     mesh = comm.mesh
     axes = comm.grad_axes
 
-    state = opt.init(params)
+    if state is None:
+        state = opt.init(params)
 
     @jax.jit
     def step(params, state, gstack):
@@ -1215,3 +1218,33 @@ def _assert_int8_rides_inter_only(seen):
                     if e[0] == "all_gather" and e[2] == "int8"]
     assert int8_gathers and all(e[1] == ("inter",)
                                 for e in int8_gathers), seen
+
+
+def test_nonfinite_skip_via_optax_composition(comm):
+    """``optax.apply_if_finite`` composes with the multi-node wrapper out
+    of the box: the finiteness check runs on the REDUCED gradients, so
+    every rank sees the same verdict and skips in lockstep (no parameter
+    divergence across the mesh). One poisoned rank therefore poisons —
+    and skips — the whole step, and the next clean step applies
+    normally. Documented in docs/fault_tolerance.md."""
+    inner = optax.apply_if_finite(optax.sgd(1.0), max_consecutive_errors=3)
+    opt = create_multi_node_optimizer(inner, comm)
+    params = jnp.zeros((4,), jnp.float32)
+
+    grads = _per_rank_grads(comm).copy()
+    grads[3, 2] = np.nan  # ONE rank contributes a NaN
+    poisoned, state = _run_sharded_update(comm, opt, grads, params)
+    # allreduce-mean spreads the NaN to every rank; apply_if_finite skips
+    # the whole update — params unchanged everywhere.
+    np.testing.assert_array_equal(np.asarray(poisoned), np.asarray(params))
+
+    # Recovery is tested THROUGH the post-skip state (a fresh init would
+    # only re-test the clean path): notfinite bookkeeping must reset and
+    # the inner state must still be valid.
+    clean = _per_rank_grads(comm)
+    recovered, _ = _run_sharded_update(
+        comm, opt, clean, params, state=state
+    )
+    np.testing.assert_allclose(
+        np.asarray(recovered), -clean.mean(0), rtol=1e-5, atol=1e-6
+    )
