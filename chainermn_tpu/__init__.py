@@ -34,7 +34,10 @@ survey time, so citations are to the public upstream layout.
 
 from chainermn_tpu.communicators import create_communicator
 from chainermn_tpu.communicators.base import ANY_SOURCE, CommunicatorBase
-from chainermn_tpu.optimizers import create_multi_node_optimizer
+from chainermn_tpu.optimizers import (
+    create_local_sgd,
+    create_multi_node_optimizer,
+)
 from chainermn_tpu.datasets import scatter_dataset, create_empty_dataset
 from chainermn_tpu.iterators import (
     create_multi_node_iterator,
@@ -50,6 +53,7 @@ __all__ = [
     "create_communicator",
     "ANY_SOURCE",
     "CommunicatorBase",
+    "create_local_sgd",
     "create_multi_node_optimizer",
     "scatter_dataset",
     "create_empty_dataset",

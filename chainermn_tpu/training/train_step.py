@@ -184,7 +184,8 @@ def make_train_step(
     axes = axis_name if axis_name is not None else comm.grad_axes
     if batch_spec is None:
         batch_spec = P(axes)
-    reduce_in_step = not isinstance(optimizer, MultiNodeOptimizer)
+    reduce_in_step = not getattr(optimizer, "handles_cross_rank_sync",
+                                 False)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     # The EF residual is PER-RANK state: carry it with an honest

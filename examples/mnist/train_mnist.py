@@ -53,6 +53,15 @@ def main(argv=None):
     p.add_argument("--iterations", type=int, default=200)
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--double-buffering", action="store_true")
+    p.add_argument("--local-sgd", type=int, default=0, metavar="H",
+                   help="periodic parameter averaging every H steps "
+                        "instead of the per-step gradient allreduce; "
+                        "0 = off")
+    p.add_argument("--outer-momentum", type=float, default=0.0,
+                   help="DiLoCo outer heavy-ball momentum on the sync "
+                        "deltas (try 0.6-0.9 with a reduced inner lr; "
+                        "stacking it on an aggressive inner momentum "
+                        "can diverge)")
     p.add_argument("--allreduce-grad-dtype", default=None)
     p.add_argument("--error-feedback", action="store_true",
                    help="EF-SGD residual feedback over the int8 wire "
@@ -87,12 +96,27 @@ def main(argv=None):
     model = MLP()
     params = model.init(jax.random.key(0), jnp.zeros((1, 784)))["params"]
 
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(args.lr, momentum=0.9),
-        comm,
-        double_buffering=args.double_buffering,
-        error_feedback=args.error_feedback,
-    )
+    if args.local_sgd:
+        bad = [f for f, on in (
+            ("--double-buffering", args.double_buffering),
+            ("--error-feedback", args.error_feedback),
+            ("--allreduce-grad-dtype", args.allreduce_grad_dtype),
+        ) if on]
+        if bad:
+            p.error(f"--local-sgd replaces the per-step gradient wire; "
+                    f"{', '.join(bad)} would be silently ignored")
+        optimizer = chainermn_tpu.create_local_sgd(
+            optax.sgd(args.lr, momentum=0.9), comm,
+            sync_every=args.local_sgd,
+            outer_momentum=args.outer_momentum,
+        )
+    else:
+        optimizer = chainermn_tpu.create_multi_node_optimizer(
+            optax.sgd(args.lr, momentum=0.9),
+            comm,
+            double_buffering=args.double_buffering,
+            error_feedback=args.error_feedback,
+        )
     state = create_train_state(params, optimizer, comm)
 
     def loss_fn(params, batch):

@@ -275,3 +275,45 @@ def test_trainer_prefetch_accepts_nondivisible_batches(comm):
                  log_interval=100, out=io.StringIO(), prefetch=2)
     state = tr.run(2)
     assert np.isfinite(float(jax.device_get(state[0]["w"])[0]))
+
+
+def test_train_step_local_sgd_true_local_evolution(comm):
+    """THROUGH make_train_step (not opt.update directly): with
+    ``create_local_sgd`` the trainer must NOT pre-reduce gradients — the
+    inner adam evolves on each member's LOCAL gradients and members only
+    meet at the sync. The oracle is a per-member optax simulation over
+    the member's own batch shard. This pins the
+    ``handles_cross_rank_sync`` protocol: an isinstance-style dispatch
+    regression in make_train_step (which once silently kept the
+    per-step wire for this wrapper) fails the oracle equality."""
+    from chainermn_tpu import create_local_sgd
+
+    x, y = _data(n=N * 4)
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    opt = create_local_sgd(optax.adam(0.1), comm, sync_every=2)
+    state = create_train_state(params, opt, comm)
+    step = make_train_step(_linreg_loss, opt, comm, donate=False)
+    batch = (jnp.asarray(x), jnp.asarray(y))
+    for _ in range(2):
+        state, _ = step(state, batch)
+
+    # Oracle: each member adams on ITS shard for 2 steps; then average.
+    finals = []
+    for r in range(N):
+        shard = (jnp.asarray(x[r * 4:(r + 1) * 4]),
+                 jnp.asarray(y[r * 4:(r + 1) * 4]))
+        p = params
+        inner = optax.adam(0.1)
+        s = inner.init(p)
+        for _ in range(2):
+            g = jax.grad(lambda pp: _linreg_loss(pp, shard)[0])(p)
+            u, s = inner.update(g, s, p)
+            p = optax.apply_updates(p, u)
+        finals.append(p)
+    expect = jax.tree.map(
+        lambda *leaves: np.mean([np.asarray(v) for v in leaves], axis=0),
+        *finals,
+    )
+    got = jax.tree.map(np.asarray, state.params)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(got[k], expect[k], rtol=1e-5, atol=1e-6)
