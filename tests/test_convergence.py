@@ -270,3 +270,27 @@ class TestLocalSGDConvergence:
         # synced mean stays near zero (per-rank drift cancels).
         np.testing.assert_allclose(w[1:], np.ones(DIM - 1), atol=0.05)
         assert abs(w[0]) < 0.5, w[0]
+
+    def test_local_sgd_on_two_axis_mesh(self):
+        """Local SGD's cond'd pmean over a TUPLE of axes: on the real
+        (inter=2, intra=4) mesh the sync means over both axes at once —
+        sync_every=1 with a linear inner must equal the per-step f32
+        wire on the same mesh."""
+        from jax.sharding import Mesh
+
+        from chainermn_tpu import create_local_sgd
+        from chainermn_tpu.communicators.xla_communicator import (
+            TwoDimensionalCommunicator,
+        )
+
+        devs = np.array(jax.devices("cpu")[:N]).reshape(2, 4)
+        comm2 = TwoDimensionalCommunicator(
+            mesh=Mesh(devs, ("inter", "intra"))
+        )
+        local, w = _drill(
+            comm2, create_local_sgd(optax.sgd(LR), comm2, sync_every=1),
+            steps=120,
+        )
+        f32, w_f32 = _train(comm2, wire=None, steps=120)
+        np.testing.assert_allclose(local, f32, rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(w, w_f32, rtol=1e-4, atol=1e-4)
