@@ -1,8 +1,11 @@
 """Model zoo matching the reference's example models (SURVEY.md section 2.8):
 MNIST MLP, ImageNet family (AlexNet / GoogLeNet / ResNet-50), seq2seq LSTM —
-plus the Transformer LM the benchmark configs add (BASELINE.json)."""
+plus the Transformer LM the benchmark configs add (BASELINE.json) and the
+ViT-S/16 encoder family (beyond the reference: the MXU-natural ImageNet
+model, built on the LM's TransformerBlock with ``causal=False``)."""
 
 from chainermn_tpu.models.mlp import MLP
+from chainermn_tpu.models.vit import VisionTransformer
 from chainermn_tpu.models.imagenet import AlexNet, GoogLeNet
 from chainermn_tpu.models.seq2seq import (
     Seq2Seq,
@@ -34,6 +37,7 @@ from chainermn_tpu.models.detection import (
 )
 
 __all__ = [
+    "VisionTransformer",
     "MLP",
     "AlexNet",
     "GoogLeNet",

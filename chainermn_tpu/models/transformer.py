@@ -65,6 +65,9 @@ class TransformerBlock(nn.Module):
     #: KV-cache mask directly, keeping inference consistent with the
     #: windowed training distribution.
     window: Optional[int] = None
+    #: bidirectional attention when False (encoder blocks — ViT, BERT
+    #: style). Decode/window paths are causal-only and reject it.
+    causal: bool = True
 
     def _decode_attend(self, qh, kh_new, vh_new, head_dim):
         """One-token attention against the mutable KV cache.
@@ -159,6 +162,8 @@ class TransformerBlock(nn.Module):
                 raise ValueError(
                     f"decode=True expects one token per step, got T={T}"
                 )
+            if not self.causal:
+                raise ValueError("decode=True requires a causal block")
             o = self._decode_attend(qh, kh, heads(v, kv_heads), head_dim)
         else:
             if self.window is not None and self.attention_fn is None:
@@ -167,10 +172,12 @@ class TransformerBlock(nn.Module):
                     "flash_attention(..., window=W)) — the default "
                     "blockwise reference has no window support"
                 )
+            if self.window is not None and not self.causal:
+                raise ValueError("window requires a causal block")
             kw = {} if segment_ids is None else {"segment_ids": segment_ids}
             o = attn(qh, kh,
-                     heads(v, kv_heads), causal=True, scale=head_dim**-0.5,
-                     **kw)
+                     heads(v, kv_heads), causal=self.causal,
+                     scale=head_dim**-0.5, **kw)
         o = nn.Dense(
             D, use_bias=False,
             dtype=self.compute_dtype, param_dtype=jnp.float32, name="proj",
@@ -191,6 +198,27 @@ class TransformerBlock(nn.Module):
         if self.dropout_rate > 0.0:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         return x + h
+
+
+def _remat_block(remat_policy: str):
+    """``nn.remat``-wrapped :class:`TransformerBlock` for the given save
+    policy — ONE construction shared by :class:`TransformerLM` and
+    :class:`chainermn_tpu.models.vit.VisionTransformer` so the
+    policy-name surface cannot drift between the families."""
+    if remat_policy == "dots":
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif remat_policy == "nothing":
+        policy = None  # jax.checkpoint default: save nothing
+    else:
+        raise ValueError(
+            f"remat_policy must be 'dots' or 'nothing', got "
+            f"{remat_policy!r}"
+        )
+    return nn.remat(
+        TransformerBlock,
+        policy=policy,
+        static_argnums=(4, 5),  # (self, x, seg, rope_pos, train, dec)
+    )
 
 
 class TransformerLM(nn.Module):
@@ -285,24 +313,10 @@ class TransformerLM(nn.Module):
                     pos_emb, self.pos_offset, T, axis=0
                 )
             x = x + pos[None].astype(self.compute_dtype)
-        block_cls = TransformerBlock
-        if self.remat:
-            if self.remat_policy == "dots":
-                policy = (
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                )
-            elif self.remat_policy == "nothing":
-                policy = None  # jax.checkpoint default: save nothing
-            else:
-                raise ValueError(
-                    f"remat_policy must be 'dots' or 'nothing', got "
-                    f"{self.remat_policy!r}"
-                )
-            block_cls = nn.remat(
-                TransformerBlock,
-                policy=policy,
-                static_argnums=(4, 5),  # (self, x, seg, rope_pos, train, dec)
-            )
+        block_cls = (
+            _remat_block(self.remat_policy) if self.remat
+            else TransformerBlock
+        )
         for i in range(self.num_layers):
             x = block_cls(
                 num_heads=self.num_heads,

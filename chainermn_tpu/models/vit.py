@@ -1,0 +1,112 @@
+"""Vision Transformer — the modern TPU-shaped ImageNet family.
+
+Beyond the reference (2017-era CNNs only, ``examples/imagenet`` †): a
+ViT is the hardware-natural ImageNet model on TPU — the whole network is
+large dense matmuls (patch embedding + encoder blocks) with none of the
+small-channel convs that starve the 128-wide MXU in the ResNet stem
+(see the space-to-depth discussion in :mod:`chainermn_tpu.models.resnet`).
+
+Reuses :class:`chainermn_tpu.models.transformer.TransformerBlock` with
+``causal=False`` (bidirectional encoder) — the same pluggable-attention
+block that powers the LM, so flash kernels, GQA, and remat policies all
+apply unchanged. Pre-LN, learned position embeddings, mean-pool or CLS
+readout, bf16 compute / f32 params per the package convention.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chainermn_tpu.models.transformer import (
+    TransformerBlock,
+    _remat_block,
+)
+
+
+class VisionTransformer(nn.Module):
+    """ViT over ``[B, H, W, C]`` images → ``[B, num_classes]`` logits.
+
+    Defaults are ViT-S/16 (22M params at 224²): d_model 384, 12 layers,
+    6 heads, ff 1536.
+    """
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    num_layers: int = 12
+    num_heads: int = 6
+    d_model: int = 384
+    d_ff: int = 1536
+    compute_dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+    dropout_rate: float = 0.0
+    #: ``'mean'`` — global average pool of the final tokens (the simple,
+    #: shift-friendly readout); ``'cls'`` — prepend a learned class token
+    #: and read its final state (the original recipe).
+    pool: str = "mean"
+    #: rematerialize each encoder block (same policies as the LM).
+    remat: bool = False
+    remat_policy: str = "dots"
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        if self.pool not in ("mean", "cls"):
+            raise ValueError(f"pool must be mean|cls, got {self.pool!r}")
+        B, H, W, _ = images.shape
+        p = self.patch_size
+        if H % p or W % p:
+            raise ValueError(
+                f"image size {(H, W)} not divisible by patch {p}"
+            )
+        # Patch embedding: one strided conv == per-patch linear; its
+        # [p*p*C, d_model] matmul is MXU-shaped (768x384 at S/16).
+        x = nn.Conv(
+            self.d_model, kernel_size=(p, p), strides=(p, p),
+            padding="VALID", dtype=self.compute_dtype,
+            param_dtype=jnp.float32, name="patch_embed",
+        )(images.astype(self.compute_dtype))
+        x = x.reshape(B, -1, self.d_model)  # [B, N, D]
+        n_tokens = x.shape[1]
+
+        if self.pool == "cls":
+            cls = self.param(
+                "cls_token", nn.initializers.zeros, (1, 1, self.d_model),
+                jnp.float32,
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (B, 1, self.d_model)).astype(
+                    self.compute_dtype), x],
+                axis=1,
+            )
+            n_tokens += 1
+
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, n_tokens, self.d_model), jnp.float32,
+        )
+        x = x + pos.astype(self.compute_dtype)
+
+        block = (_remat_block(self.remat_policy) if self.remat
+                 else TransformerBlock)
+        for i in range(self.num_layers):
+            x = block(
+                num_heads=self.num_heads, d_ff=self.d_ff,
+                compute_dtype=self.compute_dtype,
+                attention_fn=self.attention_fn,
+                dropout_rate=self.dropout_rate,
+                causal=False, name=f"block_{i}",
+            )(x, None, None, train, False)
+
+        x = nn.LayerNorm(
+            dtype=self.compute_dtype, param_dtype=jnp.float32
+        )(x)
+        pooled = x[:, 0] if self.pool == "cls" else x.mean(axis=1)
+        # f32 head: the classification logits feed a softmax-CE whose
+        # numerics should not inherit bf16 rounding.
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="head",
+        )(pooled.astype(jnp.float32))

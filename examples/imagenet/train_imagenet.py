@@ -31,7 +31,7 @@ sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
 
 import chainermn_tpu
 from chainermn_tpu import global_except_hook
-from chainermn_tpu.models import AlexNet, GoogLeNet, ResNet50
+from chainermn_tpu.models import VisionTransformer, AlexNet, GoogLeNet, ResNet50
 from chainermn_tpu.training import make_train_step
 from chainermn_tpu.training.train_step import create_train_state
 
@@ -42,6 +42,9 @@ ARCHS = {
     "googlenet": lambda bn_ax, **kw: GoogLeNet(),
     "googlenetbn": lambda bn_ax, **kw: GoogLeNet(use_bn=True, bn_axis_name=bn_ax),
     "resnet50": lambda bn_ax, **kw: ResNet50(bn_axis_name=bn_ax, **kw),
+    # The TPU-natural ImageNet family (round 5): pure large matmuls, no
+    # MXU-starving small-channel convs, no BatchNorm cross-rank sync.
+    "vit_s16": lambda bn_ax, **kw: VisionTransformer(**kw),
 }
 
 
@@ -78,12 +81,15 @@ def main(argv=None):
                         "MXU-hostile 3-channel 7x7 conv for a 48-channel "
                         "3x3 (measured +16%% img/s on v5e)")
     p.add_argument("--remat", nargs="?", const="full",
-                   default=None, choices=["full", "conv"],
-                   help="rematerialize residual blocks: 'full' (save only "
+                   default=None,
+                   choices=["full", "conv", "dots", "nothing"],
+                   help="rematerialize blocks. resnet50: 'full' (save only "
                         "block inputs — max memory saving) or 'conv' (save "
                         "conv outputs, recompute the BN/relu chain — the "
                         "byte-cutting mode from the docs/benchmarks.md "
-                        "roofline). Bare --remat means 'full' (back-compat)")
+                        "roofline); bare --remat means 'full'. vit_s16: "
+                        "'dots' (keep matmul outputs) or 'nothing' (the "
+                        "LM policies)")
     p.add_argument("--profile", default=None,
                    help="directory for a jax.profiler trace of iters 10-20")
     p.add_argument("--train-root", default=None)
@@ -100,14 +106,20 @@ def main(argv=None):
     if comm.rank == 0:
         print(f"communicator: {comm}  arch: {args.arch}")
 
-    if (args.remat or args.stem != "standard") and args.arch != "resnet50":
-        p.error(f"--remat/--stem are only supported for --arch resnet50 "
+    _REMAT_OF = {"resnet50": ("full", "conv"),
+                 "vit_s16": ("dots", "nothing")}
+    if args.remat and args.remat not in _REMAT_OF.get(args.arch, ()):
+        p.error(
+            f"--remat {args.remat} is not a policy of --arch {args.arch} "
+            f"(valid: {dict(_REMAT_OF)})")
+    if args.stem != "standard" and args.arch != "resnet50":
+        p.error(f"--stem is only supported for --arch resnet50 "
                 f"(got {args.arch!r})")
     kw = {}
     if args.remat:
         kw["remat"] = True
-        if args.remat == "conv":
-            kw["remat_policy"] = "conv"
+        if args.remat != "full":
+            kw["remat_policy"] = args.remat
     if args.arch == "resnet50":
         kw["stem"] = args.stem
     model = ARCHS[args.arch](comm.bn_axis_name, **kw)
