@@ -15,6 +15,8 @@ from chainermn_tpu.models.seq2seq import (
 )
 from chainermn_tpu.models.transformer import (
     TransformerLM,
+    mlm_corrupt,
+    mlm_loss,
     beam_search,
     generate,
     init_cache,
@@ -46,6 +48,8 @@ __all__ = [
     "greedy_decode",
     "seq2seq_loss",
     "TransformerLM",
+    "mlm_corrupt",
+    "mlm_loss",
     "lm_loss",
     "lm_loss_fused",
     "generate",

@@ -265,6 +265,11 @@ class TransformerLM(nn.Module):
     #: residual dropout rate (see ``TransformerBlock.dropout_rate``);
     #: pass ``rngs={'dropout': key}`` to ``apply`` when training with it.
     dropout_rate: float = 0.0
+    #: bidirectional (BERT/MLM-style) encoder when False: every block
+    #: attends both directions, the weight-tied head scores each
+    #: position against the full vocabulary (pair with
+    #: :func:`mlm_loss`), and autoregressive decode is rejected.
+    causal: bool = True
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None,
@@ -287,6 +292,10 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 f"pos_encoding must be 'learned' or 'rope', got "
                 f"{self.pos_encoding!r}"
+            )
+        if decode and not self.causal:
+            raise ValueError(
+                "decode=True is autoregressive and requires causal=True"
             )
         B, T = tokens.shape
         emb = nn.Embed(
@@ -327,6 +336,7 @@ class TransformerLM(nn.Module):
                 decode_max_len=self.max_len,
                 window=self.window,
                 dropout_rate=self.dropout_rate,
+                causal=self.causal,
                 name=f"block_{i}",
             )(x, segment_ids, rope_positions, train, decode)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
@@ -348,6 +358,38 @@ def lm_loss(logits, tokens, mask=None):
         m = mask[:, 1:].astype(losses.dtype)
         return (losses * m).sum() / jnp.maximum(m.sum(), 1)
     return losses.mean()
+
+
+def mlm_loss(logits, targets, mask):
+    """Masked-LM cross-entropy: predict the ORIGINAL token at each masked
+    position (no shift — the encoder sees both directions). ``targets``
+    are the pre-masking tokens, ``mask`` is 1 where the input was
+    corrupted (the only positions scored, per the BERT recipe)."""
+    import optax
+
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets
+    )
+    m = mask.astype(losses.dtype)
+    return (losses * m).sum() / jnp.maximum(m.sum(), 1)
+
+
+def mlm_corrupt(rng, tokens, *, mask_id, vocab_size, rate=0.15):
+    """BERT-style corruption under jit: select ``rate`` of positions;
+    of those 80% → ``mask_id``, 10% → random REAL token, 10% →
+    unchanged. Returns ``(corrupted, selected_mask)``. Random draws
+    that would land on ``mask_id`` are shifted by one (mod vocab) so
+    the documented 80/10/10 mix holds even for small vocabularies."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    sel = jax.random.uniform(k1, tokens.shape) < rate
+    roll = jax.random.uniform(k2, tokens.shape)
+    rand_tok = jax.random.randint(k3, tokens.shape, 0, vocab_size)
+    rand_tok = jnp.where(rand_tok == mask_id,
+                         (rand_tok + 1) % vocab_size, rand_tok)
+    corrupted = jnp.where(sel & (roll < 0.8), mask_id, tokens)
+    corrupted = jnp.where(sel & (roll >= 0.8) & (roll < 0.9), rand_tok,
+                          corrupted)
+    return corrupted, sel
 
 
 def lm_loss_fused(hidden, emb_table, tokens, *, n_chunks=8,

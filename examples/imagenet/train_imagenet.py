@@ -111,7 +111,7 @@ def main(argv=None):
     if args.remat and args.remat not in _REMAT_OF.get(args.arch, ()):
         p.error(
             f"--remat {args.remat} is not a policy of --arch {args.arch} "
-            f"(valid: {dict(_REMAT_OF)})")
+            f"(valid for {args.arch}: {_REMAT_OF.get(args.arch, ())})")
     if args.stem != "standard" and args.arch != "resnet50":
         p.error(f"--stem is only supported for --arch resnet50 "
                 f"(got {args.arch!r})")
