@@ -47,7 +47,7 @@ from chainermn_tpu.extensions.evaluator import create_multi_node_evaluator
 from chainermn_tpu.extensions.checkpoint import create_multi_node_checkpointer
 from chainermn_tpu import global_except_hook  # noqa: F401  (import installs nothing)
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "create_communicator",
