@@ -398,7 +398,8 @@ _COMPACT_KEYS = (
     "resnet50_s2d_images_per_sec", "moe_dispatch_sort_speedup",
     "native_input_images_per_sec", "double_buffer_speedup",
     "flash_32k_fwd_ms", "flash_32k_window2k_fwd_ms",
-    "kernel_sweep_failures", "proxy_spread_pct",
+    "kernel_sweep_failures", "kernel_sweep_numeric_failures",
+    "proxy_spread_pct",
 )
 
 
