@@ -70,6 +70,10 @@ def main(argv=None):
                         "reference's 15-minute/32K-batch ImageNet runs "
                         "lived in (arXiv:1711.04325)")
     p.add_argument("--double-buffering", action="store_true")
+    p.add_argument("--local-sgd", type=int, default=0, metavar="H",
+                   help="periodic parameter averaging every H steps "
+                        "instead of the per-step gradient allreduce "
+                        "(composes with --optimizer); 0 = off")
     p.add_argument("--allreduce-grad-dtype", default="bfloat16")
     p.add_argument("--error-feedback", action="store_true",
                    help="EF-SGD for the int8 quantized wire (requires "
@@ -97,6 +101,10 @@ def main(argv=None):
                    help="fixed-record file read by the C++ threaded "
                         "prefetch loader (chainermn_tpu.native.data_loader)")
     args = p.parse_args(argv)
+    if args.local_sgd and (args.double_buffering or args.error_feedback):
+        p.error("--local-sgd replaces the per-step gradient wire; "
+                "--double-buffering/--error-feedback would be "
+                "silently ignored")
 
     comm = chainermn_tpu.create_communicator(
         args.communicator,
@@ -204,12 +212,17 @@ def main(argv=None):
         "lars": lambda: optax.lars(args.lr),
         "lamb": lambda: optax.lamb(args.lr),
     }[args.optimizer]()
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        inner_opt,
-        comm,
-        double_buffering=args.double_buffering,
-        error_feedback=args.error_feedback,
-    )
+    if args.local_sgd:
+        optimizer = chainermn_tpu.create_local_sgd(
+            inner_opt, comm, sync_every=args.local_sgd,
+        )
+    else:
+        optimizer = chainermn_tpu.create_multi_node_optimizer(
+            inner_opt,
+            comm,
+            double_buffering=args.double_buffering,
+            error_feedback=args.error_feedback,
+        )
     state = create_train_state(
         variables["params"], optimizer, comm, model_state=batch_stats
     )
