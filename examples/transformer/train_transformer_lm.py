@@ -17,6 +17,7 @@ parallelism for long context (``--sequence-parallel``).
 from __future__ import annotations
 
 import argparse
+import functools
 import sys
 import time
 
@@ -75,6 +76,12 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--allreduce-grad-dtype", default="bfloat16")
+    p.add_argument("--mlm", action="store_true",
+                   help="masked-LM pretraining on the BIDIRECTIONAL "
+                        "encoder form (causal=False): BERT-style 80/10/10 "
+                        "corruption, loss on masked positions only; "
+                        "excludes --generate/--beam (no autoregressive "
+                        "decode on an encoder)")
     p.add_argument("--local-sgd", type=int, default=0, metavar="H",
                    help="periodic parameter averaging every H steps "
                         "instead of the per-step gradient allreduce; "
@@ -117,6 +124,16 @@ def main(argv=None):
         p.error("--local-sgd replaces the per-step gradient wire; "
                 "--double-buffering/--error-feedback would be "
                 "silently ignored")
+    if args.mlm and (args.generate or args.beam):
+        p.error("--mlm is an encoder: no autoregressive decode "
+                "(--generate/--beam)")
+    if args.mlm and (args.window or args.sequence_parallel or args.packed):
+        p.error("--mlm composes with the plain data-parallel path only "
+                "(windows/SP/packing are causal-LM features here)")
+    if args.local_sgd and args.sequence_parallel:
+        p.error("--local-sgd is not wired into the sequence-parallel "
+                "path (it builds its own per-step pmean loop); drop one "
+                "of the flags")
 
     comm = chainermn_tpu.create_communicator(
         args.communicator,
@@ -251,6 +268,7 @@ def run_data_parallel(args, comm, compute_dtype, rng):
         pos_encoding=args.pos_encoding,
         attention_fn=attention_fn,
         window=args.window or None,
+        causal=not args.mlm,
     )
     global_batch = args.batchsize * comm.size
     tokens0 = synthetic_tokens(rng, global_batch, args.seq_len)
@@ -258,9 +276,37 @@ def run_data_parallel(args, comm, compute_dtype, rng):
         jax.random.key(0), jnp.asarray(tokens0[:1])
     )["params"]
 
-    def loss_fn(params, tokens):
-        logits = model.apply({"params": params}, tokens)
-        return lm_loss(logits, tokens)
+    if args.mlm:
+        from chainermn_tpu.models import mlm_corrupt, mlm_loss
+
+        MASK_ID = VOCAB - 1  # reserve the top id as [MASK]
+        corrupt = jax.jit(functools.partial(
+            mlm_corrupt, mask_id=MASK_ID, vocab_size=VOCAB, rate=0.15,
+        ))
+
+        def loss_fn(params, batch):
+            x, targets, sel = batch
+            logits = model.apply({"params": params}, x)
+            return mlm_loss(logits, targets, sel)
+
+        def make_batch(it):
+            # Data lives in [0, MASK_ID): real tokens must never equal
+            # the reserved [MASK] symbol or the 80/10/10 recipe muddies.
+            targets = jnp.asarray(
+                synthetic_tokens(rng, global_batch, args.seq_len)
+            ) % MASK_ID
+            x, sel = corrupt(jax.random.PRNGKey(it), targets)
+            return (x, targets, sel)
+    else:
+
+        def loss_fn(params, tokens):
+            logits = model.apply({"params": params}, tokens)
+            return lm_loss(logits, tokens)
+
+        def make_batch(it):
+            return jnp.asarray(
+                synthetic_tokens(rng, global_batch, args.seq_len)
+            )
 
     optimizer = _make_optimizer(args, comm)
     state = create_train_state(params, optimizer, comm)
@@ -268,8 +314,7 @@ def run_data_parallel(args, comm, compute_dtype, rng):
 
     t0 = time.perf_counter()
     for it in range(args.iterations):
-        tokens = synthetic_tokens(rng, global_batch, args.seq_len)
-        state, metrics = step(state, jnp.asarray(tokens))
+        state, metrics = step(state, make_batch(it))
         if comm.rank == 0 and (it + 1) % 10 == 0:
             jax.block_until_ready(metrics["loss"])
             tps = global_batch * args.seq_len * (it + 1) / (
@@ -308,7 +353,7 @@ def run_data_parallel(args, comm, compute_dtype, rng):
         print(f"generate: prompt {prompt.shape} -> {out.shape}; "
               f"continuations {np.asarray(out[:, prompt.shape[1]:]).tolist()}")
     if comm.rank == 0:
-        print("done (data-parallel)")
+        print("done (mlm)" if args.mlm else "done (data-parallel)")
 
 
 def run_sequence_parallel(args, comm, compute_dtype, rng):
