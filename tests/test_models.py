@@ -353,3 +353,28 @@ class TestVisionTransformer:
                 a, b, rtol=2e-4, atol=2e-5),
             new_state.params, expected,
         )
+
+
+def test_vit_with_flash_attention_matches_reference(comm):
+    """ViT + the flash kernel in its non-causal form (interpret mode):
+    the pluggable-attention contract across families — outputs must
+    match the materialised reference attention to bf16-accumulation
+    tolerance."""
+    from chainermn_tpu.models import VisionTransformer
+    from chainermn_tpu.ops.flash_attention import flash_attention
+
+    def flash(q, k, v, *, causal, scale):
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=8, block_k=16, interpret=True)
+
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 32, 32, 3))
+    kw = dict(num_classes=10, num_layers=2, d_model=64, num_heads=2,
+              d_ff=128, patch_size=8, compute_dtype=jnp.float32)
+    ref = VisionTransformer(**kw)
+    fl = VisionTransformer(**kw, attention_fn=flash)
+    p = ref.init(jax.random.PRNGKey(0), x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(fl.apply(p, x, train=False)),
+        np.asarray(ref.apply(p, x, train=False)),
+        rtol=2e-4, atol=2e-4,
+    )
