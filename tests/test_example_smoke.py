@@ -114,3 +114,22 @@ def test_resnet_sweep_tool_smoke():
     ])
     assert results and results[0]["images_per_sec"] > 0
     assert results[0]["remat"] == "conv"
+
+
+def test_transformer_example_mlm_smoke():
+    """--mlm: the bidirectional-encoder pretraining mode (round 5)."""
+    ex = _load_example("transformer", "train_transformer_lm.py")
+    ex.main([
+        "--iterations", "3", "--batchsize", "8", "--seq-len", "32",
+        "--num-layers", "1", "--d-model", "32", "--mlm",
+    ])
+
+
+def test_mnist_example_local_sgd_smoke():
+    """--local-sgd: periodic parameter averaging through the standard
+    trainer (round 5)."""
+    ex = _load_example("mnist", "train_mnist.py")
+    ex.main([
+        "--communicator", "naive", "--iterations", "12",
+        "--local-sgd", "3", "--batchsize", "64",
+    ])
