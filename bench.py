@@ -198,8 +198,8 @@ _LAST_TPU_CACHE = os.path.join(_HERE, ".bench_last_tpu.json")
 
 
 _CACHE_META_KEYS = (
-    "measured_at", "carried_keys", "source", "stale", "age_hours",
-    "bench_note", "error",
+    "measured_at", "carried_keys", "row_provenance", "source", "stale",
+    "age_hours", "bench_note", "error",
 )
 
 # Keys whose methodology was repudiated: never carried forward from a
@@ -237,6 +237,11 @@ def _purge_retired(old: dict) -> None:
     if "flash_32k_method" not in old:
         for k in _OLD_METHOD_32K_KEYS:
             old.pop(k, None)
+    # provenance rows must not outlive the data rows they describe
+    prov = old.get("row_provenance")
+    if isinstance(prov, dict):
+        for k in [k for k in prov if k not in old]:
+            prov.pop(k)
 
 
 def _save_last_tpu(result: dict) -> None:
@@ -268,6 +273,7 @@ def _save_last_tpu(result: dict) -> None:
         cached = dict(kept)
         cached.update(result)
         cached.pop("carried_keys", None)
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         if kept:
             # rows inherited from an older run, with that run's timestamp
             prev = old.get("carried_keys", {})
@@ -279,9 +285,32 @@ def _save_last_tpu(result: dict) -> None:
                 "keys": sorted(kept),
                 "stamps": {k: stamps.get(k) for k in kept},
             }
-        cached["measured_at"] = time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-        )
+        # Per-ROW provenance (round-5 VERDICT ask #7): every row names
+        # when it was measured and whether THIS save produced it live or
+        # inherited it — a stale overlay can never read as a fresh
+        # capture even row by row. Rows already carried keep their
+        # original stamp.
+        prev_prov = old.get("row_provenance", {})
+        prev_ck_stamps = (old.get("carried_keys") or {}).get("stamps", {})
+        prov = {}
+        for k in kept:
+            p = prev_prov.get(k) if isinstance(prev_prov, dict) else None
+            # Stamp priority: the row's own provenance, then the OLD
+            # blob's per-row carried_keys stamp (a pre-provenance blob
+            # may already have inherited this row from an even older
+            # run), then the blob-level stamp — never newer than the
+            # row's true measurement.
+            stamp_k = (
+                (p or {}).get("measured_at")
+                or prev_ck_stamps.get(k)
+                or old.get("measured_at")
+            )
+            prov[k] = {"measured_at": stamp_k, "source": "carried"}
+        for k in result:
+            if k not in _CACHE_META_KEYS:
+                prov[k] = {"measured_at": stamp, "source": "live"}
+        cached["row_provenance"] = prov
+        cached["measured_at"] = stamp
         with open(_LAST_TPU_CACHE, "w") as f:
             json.dump(cached, f)
     except OSError:
@@ -399,7 +428,7 @@ _COMPACT_KEYS = (
     "native_input_images_per_sec", "double_buffer_speedup",
     "flash_32k_fwd_ms", "flash_32k_window2k_fwd_ms",
     "kernel_sweep_failures", "kernel_sweep_numeric_failures",
-    "proxy_spread_pct",
+    "kernel_sweep_numeric_errors", "proxy_spread_pct", "autotune",
 )
 
 
@@ -443,6 +472,17 @@ def _emit_final(result: dict) -> None:
                 compact["last_good_tpu"]["oldest_row_measured_at"] = (
                     min(stamps)
                 )
+        # Per-row provenance rollup (VERDICT r5 ask #7): how many rows
+        # the newest save measured live vs inherited — the compact line
+        # can't pass a mostly-carried blob off as a fresh capture.
+        prov = carried.get("row_provenance")
+        if isinstance(prov, dict) and prov:
+            fresh = sum(
+                1 for p in prov.values()
+                if isinstance(p, dict) and p.get("source") == "live"
+            )
+            compact["last_good_tpu"]["fresh_rows"] = fresh
+            compact["last_good_tpu"]["carried_rows"] = len(prov) - fresh
     if wrote_details:
         compact["details"] = "BENCH_DETAILS.json"
     else:
@@ -465,20 +505,26 @@ def main() -> None:
         # early-primary-line salvage.
         remaining = deadline - time.monotonic()
         budget = remaining - CPU_BENCH_RESERVE
-        if budget < 300.0:
-            # Degenerate tail (probe retries ate the window): a bare
-            # slice, still respecting the CPU reserve — the reserve is
-            # what lets a wedged-before-first-line accel child be
-            # followed by a CPU fallback that has time to print its own
-            # primary line.
-            budget = min(300.0, max(60.0, remaining - CPU_BENCH_RESERVE))
-        result, err = _run_child("accel", budget)
-        if result is not None:
-            result["source"] = "live"
-            _save_last_tpu(result)
-            _emit_final(result)
-            return
-        errors.append(err)
+        if budget >= 60.0:
+            result, err = _run_child("accel", budget)
+            if result is not None:
+                result["source"] = "live"
+                _save_last_tpu(result)
+                _emit_final(result)
+                return
+            errors.append(err)
+        else:
+            # Degenerate tail (probe retries ate the window): the old
+            # max(60, ...) floor granted the accel child a slice carved
+            # OUT of the CPU-fallback reserve — the reserve is what
+            # lets a wedged-before-first-line accel child be followed
+            # by a CPU fallback with time to print its own primary
+            # line, so when it cannot be honoured the accel child is
+            # skipped, not squeezed in (ADVICE r5).
+            errors.append(
+                f"accel bench skipped: {remaining:.0f}s left cannot "
+                f"honour the {CPU_BENCH_RESERVE}s CPU-fallback reserve"
+            )
 
     budget = max(60.0, deadline - time.monotonic() - 180)
     result, err = _run_child("cpu", budget, env=_cpu_env())
@@ -655,6 +701,27 @@ def _bench_attention(on_accel: bool):
         # Worst per-measurement spread of the 4 medians-of-3 above: the
         # driver line can now tell proxy jitter from a real regression.
         out["attn_proxy_spread_pct"] = max(spreads)
+
+    # Adopt the fwd+bwd rows (the training-relevant comparison) as this
+    # (device, shape-bucket)'s attention-variant decision — the measured
+    # flash-vs-xla inversion (3.0x on chip, 0.56x CPU interpret) is
+    # exactly what ops.attention's 'auto' dispatch needs persisted.
+    try:
+        from chainermn_tpu import tuning
+
+        key = tuning.decision_key(shape=(T, H, D), dtype=jnp.bfloat16)
+        # spreads=None on accel: single-sample rows take the registry's
+        # 10% noise floor (see _bench_moe_dispatch).
+        tuning.record_measurement(
+            "attention", key, {"flash": f_bwd, "xla": x_bwd},
+            spreads=(None if on_accel
+                     else {"flash": spreads[2], "xla": spreads[3]}),
+        )
+        out["attention_selected"] = tuning.choice(
+            "attention", ("flash", "xla"), key
+        )
+    except Exception as e:
+        out["attention_autotune_error"] = f"{type(e).__name__}: {e}"[:120]
 
     if on_accel:
         # Long-context single-chip point: the VMEM-blocked kernel keeps
@@ -922,6 +989,28 @@ def _bench_moe_dispatch(on_accel: bool):
     }
     if not on_accel:
         out["moe_dispatch_spread_pct"] = max(spreads)
+    # Adopt the rows this phase ALREADY measured as the dispatch
+    # decision for this (device, shape-bucket): future runs route
+    # moe_layer_local's 'auto' through the persisted winner instead of
+    # re-measuring (chainermn_tpu.tuning).
+    try:
+        from chainermn_tpu import tuning
+
+        key = tuning.decision_key(shape=(T, E, D), dtype=jnp.bfloat16)
+        # On-accel rows are single samples (many chained iterations):
+        # pass spreads=None so adoption applies the registry's 10%
+        # single-sample noise floor instead of a fake spread of 0.
+        tuning.record_measurement(
+            "moe_dispatch", key,
+            {"einsum": einsum_ms, "sort": sort_ms},
+            spreads=(None if on_accel
+                     else {"einsum": spreads[0], "sort": spreads[1]}),
+        )
+        out["moe_dispatch_selected"] = tuning.choice(
+            "moe_dispatch", ("sort", "einsum"), key
+        )
+    except Exception as e:
+        out["moe_dispatch_autotune_error"] = f"{type(e).__name__}: {e}"[:120]
     return out
 
 
@@ -1484,6 +1573,24 @@ def _bench_double_buffering(comm, on_accel: bool):
         # 1.0 == both programs do the same work; the speedup is schedule,
         # not dead-code elimination.
         out["double_buffer_flops_ratio"] = round(flops_p / flops_b, 4)
+    # Adopt the on/off step times as this backend's double_buffering
+    # advisory record (the optimizer wrapper warns from it when the
+    # flag is enabled where it measures as a loss).
+    try:
+        from chainermn_tpu import tuning
+
+        key = tuning.decision_key(shape=(comm.size,), dtype="step")
+        tuning.record_measurement(
+            "double_buffering", key, {"on": buffered, "off": plain},
+            spreads={"on": spread_b, "off": spread_p},
+        )
+        out["double_buffering_selected"] = tuning.choice(
+            "double_buffering", ("on", "off"), key
+        )
+    except Exception as e:
+        out["double_buffer_autotune_error"] = (
+            f"{type(e).__name__}: {e}"[:120]
+        )
     return out
 
 
@@ -1657,7 +1764,38 @@ def _bench_allreduce_curve(comm, on_accel: bool):
             "algbw_gbps": round(algbw / 1e9, 2),
             "busbw_gbps": round(busbw / 1e9, 2),
         })
-    return {"allreduce_curve": rows}
+    out = {"allreduce_curve": rows}
+    # Adopt the curve as this topology's wire decision: best busbw per
+    # wire variant (bf16 fused vs the int8 two-phase wire), higher
+    # wins. The bucket-size decision keeps its ~64 MB table default
+    # unless the bucketed row is decisively slower than fused.
+    try:
+        from chainermn_tpu import tuning
+
+        best = {}
+        for row in rows:
+            if "busbw_gbps" not in row:
+                continue
+            wire = ("int8" if row.get("mode") == "int8"
+                    else {"bfloat16": "bf16", "float32": "f32"}.get(
+                        row.get("dtype")))
+            if wire:
+                best[wire] = max(best.get(wire, 0.0), row["busbw_gbps"])
+        # n > 1 only: at one device there IS no wire, and a dtype
+        # "comparison" would adopt loopback-bandwidth noise.
+        if len(best) > 1 and comm.size > 1:
+            key = tuning.decision_key(shape=(comm.size,), dtype="grad")
+            tuning.record_measurement(
+                "allreduce_wire", key, best, higher_is_better=True,
+            )
+            out["allreduce_wire_selected"] = tuning.choice(
+                "allreduce_wire", ("f32", "bf16", "int8"), key
+            )
+    except Exception as e:
+        out["allreduce_wire_autotune_error"] = (
+            f"{type(e).__name__}: {e}"[:120]
+        )
+    return out
 
 
 def _bench_kernel_sweep(on_accel: bool):
@@ -1857,11 +1995,23 @@ def _bench_kernel_sweep(on_accel: bool):
             row["ok"] = False
             row["error"] = f"{type(e).__name__}: {e}"[:160]
         rows.append(row)
+    return {"kernel_sweep": rows, **_kernel_sweep_counts(rows)}
+
+
+def _kernel_sweep_counts(rows) -> dict:
+    """Compact-line counts for the sweep rows. A CRASHED numerics
+    checker is not 0 numeric failures: rows whose checker raised
+    (``numerics_error`` set, so ``numerics_ok`` is absent and the
+    failure count can't see them) get their own
+    ``kernel_sweep_numeric_errors`` key, so the numerics gate cannot be
+    satisfied by the checker erroring out (ADVICE r5)."""
     return {
-        "kernel_sweep": rows,
         "kernel_sweep_failures": sum(1 for r in rows if not r["ok"]),
         "kernel_sweep_numeric_failures": sum(
             1 for r in rows if not r.get("numerics_ok", True)
+        ),
+        "kernel_sweep_numeric_errors": sum(
+            1 for r in rows if "numerics_error" in r
         ),
     }
 
@@ -2037,6 +2187,19 @@ def _run_bench(mode: str) -> None:
         out.update(_bench_native_input(comm, on_accel))
     except Exception as e:
         out["native_input_error"] = f"{type(e).__name__}: {e}"[:200]
+    print(json.dumps(out), flush=True)
+
+    # Dispatch provenance: every decision the autotune registry
+    # resolved during this run (full trail in the artifact, a compact
+    # name=winner(source) summary on the driver line) — each capture
+    # shows which path every tuned site took and why.
+    try:
+        from chainermn_tpu import tuning
+
+        out["autotune_decisions"] = tuning.decisions_taken()
+        out["autotune"] = tuning.decisions_summary(max_len=160)
+    except Exception as e:
+        out["autotune_error"] = f"{type(e).__name__}: {e}"[:120]
     print(json.dumps(out), flush=True)
 
 

@@ -1,0 +1,48 @@
+"""Compatibility gates for the baked-in jax version.
+
+The codebase targets the current jax surface (top-level
+``jax.shard_map`` with the ``check_vma`` kwarg); the image may carry an
+older jax (0.4.x) where ``shard_map`` lives in ``jax.experimental`` and
+the kwarg is ``check_rep``. Per the no-new-deps rule the gap is gated
+here, in one place: :func:`install` publishes a compatible
+``jax.shard_map`` so the 25+ ``from jax import shard_map`` sites (library,
+tests, examples, bench) keep one spelling whichever jax is present.
+
+Imported for its side effect by ``chainermn_tpu/__init__.py`` (and by
+``tests/conftest.py``, which imports jax before the package).
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def install() -> None:
+    """Idempotently ensure ``jax.shard_map(f, mesh=..., in_specs=...,
+    out_specs=..., check_vma=...)`` works on this jax."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _experimental
+
+    @functools.wraps(_experimental)
+    def shard_map(f, /, *args, **kwargs):
+        # Old spelling of the replication-check kwarg.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental(f, *args, **kwargs)
+
+    jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of a literal 1 constant-folds to the static axis size
+            # (and raises the same NameError on an unbound axis that the
+            # real ``lax.axis_size`` does — ``axes_bound`` relies on it).
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+
+install()

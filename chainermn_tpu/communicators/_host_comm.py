@@ -64,6 +64,12 @@ def _obj_to_padded(obj: Any, pad_to: int | None = None) -> np.ndarray:
 
 
 def _padded_to_obj(buf: np.ndarray) -> Any:
+    buf = np.asarray(buf)
+    if buf.dtype != np.uint8:
+        # Older jax host collectives (0.4.x gloo) upcast uint8 payloads
+        # to int32: the VALUES survive but ``bytes()`` would widen each
+        # to 4 bytes and the pickle stream would read as garbage.
+        buf = buf.astype(np.uint8)
     size = int(np.frombuffer(bytes(buf[:8]), dtype=np.uint64)[0])
     return pickle.loads(bytes(buf[8 : 8 + size]))
 

@@ -71,14 +71,28 @@ class CommunicatorBase:
             ),
         )
         self.host = _host if _host is not None else HostComm()
+        self._flat_axes = tuple(mesh.axis_names)
+        self._flat_spec = P(self._flat_axes)
         #: dtype for compressed gradient allreduce
         #: (reference: ``allreduce_grad_dtype='float16'`` on
-        #: ``PureNcclCommunicator`` (dagger); bf16 is the TPU-native choice).
+        #: ``PureNcclCommunicator`` (dagger); bf16 is the TPU-native
+        #: choice). ``"auto"`` resolves the wire variant device-aware
+        #: through the autotune registry (decision ``allreduce_wire``
+        #: keyed on this mesh's device kind + size — table default
+        #: bf16; an int8 cache entry must earn its rounding stages with
+        #: a measured busbw win; see chainermn_tpu.tuning).
+        if isinstance(allreduce_grad_dtype, str) \
+                and allreduce_grad_dtype == "auto":
+            from chainermn_tpu.parallel.collectives import (
+                resolve_allreduce_wire,
+            )
+
+            allreduce_grad_dtype = resolve_allreduce_wire(
+                self.device_kind, self.topology.size
+            )
         self.allreduce_grad_dtype = (
             jnp.dtype(allreduce_grad_dtype) if allreduce_grad_dtype else None
         )
-        self._flat_axes = tuple(mesh.axis_names)
-        self._flat_spec = P(self._flat_axes)
 
     @functools.cached_property
     def _intra(self) -> tuple[int, int]:
@@ -105,6 +119,16 @@ class CommunicatorBase:
     def size(self) -> int:
         """World size = number of mesh slots (reference: #MPI processes)."""
         return self.topology.size
+
+    @property
+    def device_kind(self) -> str:
+        """``device_kind`` of this mesh's devices (``"cpu"``,
+        ``"TPU v5 lite"``, ...) — the device-aware dispatch key the
+        autotune registry (chainermn_tpu.tuning) resolves against."""
+        try:
+            return next(iter(self.mesh.devices.flat)).device_kind
+        except Exception:
+            return "unknown"
 
     @property
     def rank(self) -> int:

@@ -13,6 +13,7 @@ kept as registry aliases so reference users find what they expect
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Sequence
 
 import jax
@@ -169,6 +170,15 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
                 f"{tuple(self.mesh.axis_names)!r}"
             )
 
+    @functools.cached_property
+    def bucket_bytes(self) -> int:
+        """Gradient-pack bucket size (autotuned, resolved once per
+        communicator so the pipeline's layout is stable for the
+        process lifetime)."""
+        from chainermn_tpu.parallel.collectives import tuned_bucket_bytes
+
+        return tuned_bucket_bytes(self.device_kind, self.size)
+
     @property
     def two_level_axes(self):
         """``(intra_axis, inter_axis)`` names of the pinned two-level
@@ -236,12 +246,15 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
         for i, g in enumerate(leaves):
             groups.setdefault(cast_dtype(g), []).append(i)
         out: list = [None] * len(leaves)
-        # Pack into ~64 MB buckets rather than one whole-model buffer: the
+        # Pack into buckets rather than one whole-model buffer: the
         # concatenated flat copy is a TRANSIENT extra full gradient in HBM;
         # bucketing bounds that transient while each bucket stays large
         # enough to keep the inter (DCN) level bandwidth-bound. (A single
         # leaf bigger than the bucket gets its own bucket, unsplit.)
-        bucket_bytes = 64 << 20
+        # Size via the autotune registry (~64 MB table default; a cache
+        # entry seeded from an on-chip busbw curve can move it — see
+        # chainermn_tpu.tuning).
+        bucket_bytes = self.bucket_bytes
         for dt, idxs in groups.items():
             itemsize = jnp.dtype(dt).itemsize
             buckets: list[list[int]] = []

@@ -10,9 +10,17 @@ flash attention locals that the sequence-parallel layer
 """
 
 from chainermn_tpu.ops.attention import (
+    attention,
     dot_product_attention,
     blockwise_attention,
+    resolve_attention_impl,
 )
 from chainermn_tpu.ops.flash_attention import flash_attention
 
-__all__ = ["dot_product_attention", "blockwise_attention", "flash_attention"]
+__all__ = [
+    "attention",
+    "dot_product_attention",
+    "blockwise_attention",
+    "flash_attention",
+    "resolve_attention_impl",
+]

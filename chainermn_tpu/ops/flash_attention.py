@@ -42,7 +42,9 @@ _LANES = 128
 # sequential. Consumed only by the Mosaic lowering; interpret mode
 # ignores it, so the bench kernel sweep's on-chip numerics gate is the
 # check that this declaration is honest.
-_GRID_SEMANTICS = pltpu.CompilerParams(
+_GRID_SEMANTICS = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
 )
 

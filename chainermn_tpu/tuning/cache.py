@@ -1,0 +1,254 @@
+"""Persistent autotune cache + offline seeding.
+
+Deliberately jax-free: the cache is plain JSON so the bench parent
+process (which never imports jax — bench.py's robustness contract) and
+the ``python -m chainermn_tpu.tuning`` CLI can read/seed it cheaply.
+
+File format (``.autotune_cache.json``)::
+
+    {"version": 1,
+     "decisions": {
+       "moe_dispatch|TPU v5 lite|16384x16x512|bfloat16": {
+         "winner": "sort",
+         "source": "seeded:BENCH_DETAILS.json",
+         "candidates_ms": {"einsum": 11.362, "sort": 6.981},
+         "spread_pct": 0.0,
+         "measured_at": "2026-08-01T08:46:00Z"}}}
+
+Keys are ``name|decision_key`` (see :func:`registry.decision_key`).
+Every entry carries its evidence (``candidates_ms`` or a free-form
+``evidence``) and provenance (``source`` + ``measured_at``) — a cache
+the next session can audit, not just obey.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+CACHE_ENV = "CHAINERMN_TPU_AUTOTUNE_CACHE"
+VERSION = 1
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_LOCK = threading.Lock()
+
+
+def default_cache_path() -> str:
+    """Cache file path: ``CHAINERMN_TPU_AUTOTUNE_CACHE`` or
+    ``<repo>/.autotune_cache.json``."""
+    return os.environ.get(CACHE_ENV) or os.path.join(
+        _REPO_ROOT, ".autotune_cache.json"
+    )
+
+
+#: path -> (mtime_ns, size, parsed doc) — choice() resolves on every
+#: auto-dispatched library call, so repeated full read+parse of the
+#: JSON would be per-call I/O; one stat per lookup keeps cross-process
+#: freshness (a bench child rewriting the file bumps the mtime).
+_LOAD_MEMO: dict = {}
+
+
+def load_cache(path: str | None = None) -> dict:
+    """Load the cache document (mtime-memoized); a missing or corrupt
+    file is an empty cache, never an error (the cache is an
+    accelerator, not a dependency)."""
+    path = path or default_cache_path()
+    try:
+        st = os.stat(path)
+        stamp = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        _LOAD_MEMO.pop(path, None)
+        return {"version": VERSION, "decisions": {}}
+    memo = _LOAD_MEMO.get(path)
+    if memo is not None and memo[0] == stamp:
+        return memo[1]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if not (isinstance(doc, dict)
+                and isinstance(doc.get("decisions"), dict)):
+            doc = {"version": VERSION, "decisions": {}}
+    except (OSError, json.JSONDecodeError):
+        doc = {"version": VERSION, "decisions": {}}
+    _LOAD_MEMO[path] = (stamp, doc)
+    return doc
+
+
+def lookup_entry(name: str, key: str, path: str | None = None):
+    """The cached entry for ``name|key``, or None."""
+    entry = load_cache(path)["decisions"].get(f"{name}|{key}")
+    return entry if isinstance(entry, dict) else None
+
+
+def store_entry(
+    name: str, key: str, entry: dict, path: str | None = None
+) -> bool:
+    """Read-modify-write one decision entry. Best-effort: an unwritable
+    location (read-only checkout, scrubbed env) loses the persistence,
+    never the decision. Returns whether the write landed."""
+    path = path or default_cache_path()
+    with _LOCK:
+        doc = load_cache(path)
+        # copy before mutating: load_cache memoizes the parsed doc and
+        # hands the same object to concurrent readers
+        doc = {**doc, "decisions": dict(doc["decisions"])}
+        doc["version"] = VERSION
+        entry = dict(entry)
+        entry.setdefault(
+            "measured_at", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        )
+        doc["decisions"][f"{name}|{key}"] = entry
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+            return True
+        except OSError:
+            return False
+
+
+# ---------------------------------------------------------------------------
+# Offline seeding from bench artifacts
+# ---------------------------------------------------------------------------
+
+_MOE_SHAPE = re.compile(r"T(\d+)xE(\d+)xD(\d+)")
+_ATTN_SHAPE = re.compile(r"B(\d+)xT(\d+)xH(\d+)xD(\d+)_(\w+?)_")
+
+
+def _bucketed_key(device_kind: str, dims, dtype_name: str) -> str:
+    # The ONE key builder (registry.decision_key), imported lazily to
+    # break the module cycle (registry imports this module at top).
+    # With an explicit device_kind and a string dtype the registry path
+    # is jax-free, so seeding stays usable without a backend.
+    from chainermn_tpu.tuning.registry import decision_key
+
+    return decision_key(device_kind, shape=[int(d) for d in dims],
+                        dtype=dtype_name)
+
+
+def _seed_one_result(result: dict, source: str, out: list,
+                     path: str | None) -> None:
+    kind = result.get("device_kind")
+    if not kind:
+        return
+    stamp = result.get("measured_at")
+
+    def put(name, key, winner, evidence):
+        entry = {"winner": winner, "source": source, **evidence}
+        if stamp:
+            entry["measured_at"] = stamp
+        if store_entry(name, key, entry, path):
+            out.append(f"{name}|{key} -> {winner}")
+
+    # MoE dispatch: einsum vs sort medians at the measured shape.
+    m = _MOE_SHAPE.search(result.get("moe_dispatch_shape", ""))
+    e_ms = result.get("moe_dispatch_einsum_ms")
+    s_ms = result.get("moe_dispatch_sort_ms")
+    if m and e_ms and s_ms:
+        key = _bucketed_key(kind, m.groups(), "bfloat16")
+        put("moe_dispatch", key,
+            "sort" if s_ms <= e_ms else "einsum",
+            {"candidates_ms": {"einsum": e_ms, "sort": s_ms},
+             "spread_pct": result.get("moe_dispatch_spread_pct", 0.0)})
+
+    # Attention variant: fwd+bwd medians (the training-relevant row).
+    m = _ATTN_SHAPE.search(result.get("attn_shape", ""))
+    f_ms = result.get("flash_fwdbwd_ms")
+    x_ms = result.get("xla_fwdbwd_ms")
+    if m and f_ms and x_ms:
+        _, t, h, d, dt = m.groups()
+        # normalise to numpy dtype names — the spelling runtime keys use
+        dt = {"bf16": "bfloat16", "f32": "float32",
+              "f16": "float16"}.get(dt, dt)
+        key = _bucketed_key(kind, (t, h, d), dt)
+        put("attention", key,
+            "flash" if f_ms <= x_ms else "xla",
+            {"candidates_ms": {"flash": f_ms, "xla": x_ms},
+             "spread_pct": result.get("attn_proxy_spread_pct", 0.0)})
+
+    # Allreduce wire: best busbw mode among the curve's rows. Only on a
+    # REAL multi-member axis — at n=1 there is no wire, and the dtype
+    # "comparison" would just adopt loopback memory-bandwidth noise.
+    curve = result.get("allreduce_curve")
+    n = result.get("n_devices", 1)
+    if isinstance(curve, list) and n > 1:
+        best: dict[str, float] = {}
+        for row in curve:
+            if not isinstance(row, dict) or "busbw_gbps" not in row:
+                continue
+            wire = ("int8" if row.get("mode") == "int8"
+                    else {"bfloat16": "bf16", "float32": "f32"}.get(
+                        row.get("dtype")))
+            if wire:
+                best[wire] = max(best.get(wire, 0.0), row["busbw_gbps"])
+        if best:
+            key = _bucketed_key(kind, (n,), "grad")
+            put("allreduce_wire", key,
+                max(best, key=best.get),
+                {"busbw_gbps": best})
+    if isinstance(curve, list):
+        # Bucket size: the ~64 MB packing discipline is adopted unless
+        # the curve shows the fused single buffer decisively faster.
+        # Only rows big enough to actually CARRY >= 64 MiB buckets count
+        # — the CPU proxy's shrunken-bucket rows measure per-collective
+        # latency at micro sizes, not the packing discipline.
+        by_mode = {
+            row.get("mode"): row["busbw_gbps"]
+            for row in curve
+            if isinstance(row, dict) and "busbw_gbps" in row
+            and row.get("dtype") == "bfloat16"
+            and row.get("mib", 0) >= 64
+        }
+        if "fused" in by_mode and "bucketed" in by_mode:
+            key = _bucketed_key(kind, (n,), "grad")
+            put("allreduce_bucket_mb", key,
+                "64" if by_mode["bucketed"] >= 0.9 * by_mode["fused"]
+                else "none",
+                {"busbw_gbps": by_mode})
+
+    # Double buffering: the measured on/off step-time ratio.
+    speedup = result.get("double_buffer_speedup")
+    if speedup:
+        n = result.get("n_devices", 1)
+        key = _bucketed_key(kind, (n,), "step")
+        put("double_buffering", key,
+            "on" if speedup > 1.02 else "off",
+            {"double_buffer_speedup": speedup,
+             "spread_pct": result.get("double_buffer_spread_pct", 0.0)})
+
+
+def seed_from_bench_details(
+    details_path: str | None = None, cache_path: str | None = None
+) -> list[str]:
+    """Seed the cache from a bench artifact (``BENCH_DETAILS.json`` by
+    default, or the carried ``.bench_last_tpu.json`` blob directly).
+
+    Seeds decisions from the artifact's top level (whatever backend that
+    run measured — often the CPU proxy) AND from its ``last_good_tpu``
+    carried blob, each under its own ``device_kind``, so on-chip sweep
+    winners are adopted for the chip without re-measuring while the CPU
+    entries keep describing the CPU. Returns the list of seeded
+    ``name|key -> winner`` strings."""
+    details_path = details_path or os.path.join(
+        _REPO_ROOT, "BENCH_DETAILS.json"
+    )
+    with open(details_path) as f:
+        result = json.load(f)
+    seeded: list[str] = []
+    _seed_one_result(result, f"seeded:{os.path.basename(details_path)}",
+                     seeded, cache_path)
+    carried = result.get("last_good_tpu")
+    if isinstance(carried, dict):
+        _seed_one_result(
+            carried,
+            f"seeded:{os.path.basename(details_path)}:last_good_tpu",
+            seeded, cache_path,
+        )
+    return seeded

@@ -1,0 +1,64 @@
+"""One-shot measured autotuning — bench.py's measurement discipline.
+
+CPU-proxy rows drifted round-to-round until bench.py adopted
+median-of-n>=3 with an explicit spread (round-5 VERDICT ask #8); a
+measured autotuner inherits exactly that rule, plus one more: when the
+spread SWALLOWS the gap between the two best candidates, the
+measurement cannot pick a winner and the deterministic table must
+(adopting noise as a cached "winner" would pin a coin flip for every
+future run).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+
+def repeat_median(sample: Callable[[], float], repeats: int = 3):
+    """Median + spread of ``repeats`` samples of a zero-arg measurement
+    returning a float (ms). ``spread = 100*(max-min)/median`` — the same
+    discipline as bench.py's ``_repeat_median``."""
+    vals = sorted(sample() for _ in range(max(1, repeats)))
+    n = len(vals)
+    med = (vals[n // 2] if n % 2
+           else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+    spread = 100.0 * (vals[-1] - vals[0]) / med if med else 0.0
+    return med, round(spread, 1)
+
+
+def decide(medians: Mapping[str, float], spreads: Mapping[str, float],
+           *, higher_is_better: bool = False):
+    """Pick a winner from per-candidate medians, or None when the
+    comparison is spread-dominated: the best two medians differ by less
+    than the larger of their spreads, so the difference is
+    indistinguishable from measurement noise."""
+    if not medians:
+        return None
+    ranked = sorted(medians, key=medians.get, reverse=higher_is_better)
+    best = ranked[0]
+    if len(ranked) > 1:
+        second = ranked[1]
+        gap = abs(medians[second] - medians[best])
+        noise = max(spreads.get(best, 0.0), spreads.get(second, 0.0))
+        if gap <= abs(medians[best]) * noise / 100.0:
+            return None
+    return best
+
+
+def measure_candidates(
+    measure_fns: Mapping[str, Callable[[], float]], repeats: int = 3
+):
+    """Run each candidate's zero-arg measurement ``repeats`` times
+    (n>=3 enforced) and return ``(winner_or_None, evidence)`` where
+    evidence is ``{"candidates_ms": ..., "spread_pct": worst}``.
+    Winner is None when spread-dominated (see :func:`decide`)."""
+    repeats = max(3, repeats)
+    medians: dict[str, float] = {}
+    spreads: dict[str, float] = {}
+    for cand, fn in measure_fns.items():
+        medians[cand], spreads[cand] = repeat_median(fn, repeats)
+    evidence = {
+        "candidates_ms": {k: round(v, 4) for k, v in medians.items()},
+        "spread_pct": max(spreads.values(), default=0.0),
+    }
+    return decide(medians, spreads), evidence

@@ -1,0 +1,288 @@
+"""The decision registry: ``choice(name, candidates, key)``.
+
+Resolution order (each step records its provenance):
+
+1. ``CHAINERMN_TPU_AUTOTUNE_FORCE`` override (``name=winner,...``);
+2. the persistent cache (measured on this machine, or seeded offline
+   from on-chip bench artifacts — :mod:`chainermn_tpu.tuning.cache`);
+3. one-shot measurement, when the call site supplies per-candidate
+   measurement callables, tracing is not active, and the mode allows it
+   (:mod:`chainermn_tpu.tuning.measure`); the winner is persisted;
+4. the deterministic per-device-class table below.
+
+Every resolution is appended to a process-local decision log so
+``bench.py`` / ``dryrun_multichip`` can report exactly which path each
+site took (dispatch provenance in every capture artifact).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Mapping, Optional, Sequence
+
+from chainermn_tpu.tuning import cache as _cache
+from chainermn_tpu.tuning import measure as _measure
+
+#: Deterministic fallbacks, keyed ``decision -> device class -> winner``
+#: (``*`` = any). Each winner cites the measurement it rests on
+#: (BENCH_DETAILS.json r5 + the carried v5e blob), so the table is the
+#: documented crossover, not an opinion:
+#:
+#: - ``moe_dispatch``: sort won BOTH measured points — 167.8x on the CPU
+#:   proxy (T2048xE8xD64) and 1.63x on TPU v5e at the production shape
+#:   (T16384xE16xD512, where the dense path is einsum-competitive); the
+#:   dense [T,E,C] einsum only ties at tiny shapes, so ``sort``
+#:   everywhere and let a cache entry flip shapes where a sweep shows
+#:   otherwise.
+#: - ``attention``: flash is 3.0x fwd+bwd on the chip but 0.56x under
+#:   CPU interpret mode — the inversion that motivated this package.
+#: - ``allreduce_wire``: bf16 is the measured default (halved bytes,
+#:   zero rounding risk); int8's two rounding stages pay only where DCN
+#:   bandwidth is scarce, which a cache entry (seeded from a multi-slice
+#:   curve) must demonstrate before it is chosen.
+#: - ``allreduce_bucket_mb``: ~64 MB keeps the inter level
+#:   bandwidth-bound while bounding the transient flat-copy in HBM
+#:   (docs/benchmarks.md curve); ``none`` = single fused buffer.
+#: - ``double_buffering``: measured 0.752x on the CPU proxy and 0.85x on
+#:   a single chip (no collective to overlap) — ``off`` until a
+#:   multi-slice capture shows the overlap paying.
+DEFAULT_TABLE: dict = {
+    "moe_dispatch": {"cpu": "sort", "tpu": "sort", "*": "sort"},
+    "attention": {"cpu": "xla", "tpu": "flash", "*": "flash"},
+    "attention_windowed": {"cpu": "xla", "tpu": "windowed", "*": "windowed"},
+    "allreduce_wire": {"*": "bf16"},
+    "allreduce_bucket_mb": {"*": "64"},
+    "double_buffering": {"*": "off"},
+}
+
+_MODE_ENV = "CHAINERMN_TPU_AUTOTUNE"
+_FORCE_ENV = "CHAINERMN_TPU_AUTOTUNE_FORCE"
+
+#: process-local decision log: (name, key) -> record, insertion-ordered
+_DECISIONS: dict = {}
+
+
+def _mode() -> str:
+    mode = os.environ.get(_MODE_ENV, "auto").lower()
+    return mode if mode in ("auto", "measure", "table", "off") else "auto"
+
+
+def _forced() -> dict:
+    out = {}
+    for part in os.environ.get(_FORCE_ENV, "").split(","):
+        if "=" in part:
+            name, _, winner = part.partition("=")
+            out[name.strip()] = winner.strip()
+    return out
+
+
+def current_device_kind() -> str:
+    """``device_kind`` of the default backend's first device (``"cpu"``,
+    ``"TPU v5 lite"``, ...); ``"unknown"`` when no backend is up. Call
+    sites resolving at trace time always have a live backend."""
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
+
+def device_class(device_kind: str) -> str:
+    """Coarse class for table lookup: ``cpu`` / ``tpu`` / ``*``."""
+    kind = (device_kind or "").lower()
+    if "cpu" in kind:
+        return "cpu"
+    if "tpu" in kind or kind.startswith("v"):
+        return "tpu"
+    return "*"
+
+
+def shape_bucket(shape: Sequence[int]) -> str:
+    """Bucket each dim up to the next power of two, joined with ``x`` —
+    nearby shapes share one decision (and one measurement) instead of
+    fragmenting the cache per exact shape."""
+
+    def bucket(d: int) -> int:
+        d = int(d)
+        if d < 1:
+            raise ValueError(f"shape dims must be >= 1, got {d}")
+        b = 1
+        while b < d:
+            b <<= 1
+        return b
+
+    return "x".join(str(bucket(d)) for d in shape)
+
+
+def decision_key(
+    device_kind: Optional[str] = None,
+    shape: Optional[Sequence[int]] = None,
+    dtype=None,
+) -> str:
+    """``"<device_kind>|<shape-bucket>|<dtype>"`` — the cache key a call
+    site's decision is stored under. ``device_kind`` defaults to the
+    live backend's; ``dtype`` accepts anything ``jnp.dtype`` does (or a
+    plain string tag for non-dtype keys)."""
+    kind = device_kind if device_kind is not None else current_device_kind()
+    shape_s = shape_bucket(shape) if shape else "-"
+    if dtype is None:
+        dtype_s = "-"
+    elif isinstance(dtype, str):
+        dtype_s = dtype
+    else:
+        import numpy as np
+
+        dtype_s = np.dtype(dtype).name
+    return f"{kind}|{shape_s}|{dtype_s}"
+
+
+def _record(name: str, key: str, winner: str, source: str,
+            evidence: Optional[dict] = None) -> None:
+    _DECISIONS[(name, key)] = {
+        "name": name, "key": key, "winner": winner, "source": source,
+        **({"evidence": evidence} if evidence else {}),
+    }
+
+
+def decisions_taken() -> list:
+    """The decisions this process resolved, in first-resolution order —
+    what bench.py / dryrun_multichip fold into their artifacts."""
+    return list(_DECISIONS.values())
+
+
+def decisions_summary(max_len: int = 200) -> str:
+    """Compact ``name=winner(source)`` summary for size-capped artifact
+    lines (bench's compact JSON line has a 2000-char budget)."""
+    parts = [
+        f"{d['name']}={d['winner']}({d['source'].split(':')[0]})"
+        for d in _DECISIONS.values()
+    ]
+    out = " ".join(parts)
+    return out[:max_len]
+
+
+def reset_decisions() -> None:
+    """Clear the process-local decision log (test isolation)."""
+    _DECISIONS.clear()
+
+
+def _trace_clean() -> bool:
+    """Whether we are OUTSIDE any jax trace — measurement runs real
+    device work and must never fire mid-trace (inside shard_map/jit the
+    table/cache answer is used instead)."""
+    try:
+        import jax.core
+
+        return bool(jax.core.trace_state_clean())
+    except Exception:
+        return False
+
+
+def _table_winner(name: str, key: str, candidates, table) -> str:
+    tab = table if table is not None else DEFAULT_TABLE.get(name, {})
+    cls = device_class(key.split("|", 1)[0])
+    winner = tab.get(cls) or tab.get("*")
+    if winner in candidates:
+        return winner
+    return candidates[0]
+
+
+def choice(
+    name: str,
+    candidates: Sequence[str],
+    key: str,
+    *,
+    measure: Optional[Mapping[str, Callable[[], float]]] = None,
+    table: Optional[dict] = None,
+    cache_path: Optional[str] = None,
+) -> str:
+    """Resolve decision ``name`` among ``candidates`` for ``key``.
+
+    ``measure`` (optional): per-candidate zero-arg callables returning a
+    cost in ms (lower wins) — supplied only by call sites that can
+    afford a one-shot measurement (bench, tests, offline sweeps); plain
+    library call sites omit it and get cache/table resolution, which is
+    pure Python and safe inside a trace.
+    """
+    if not candidates:
+        raise ValueError(f"decision {name!r}: no candidates")
+    forced = _forced().get(name)
+    if forced is not None:
+        if forced not in candidates:
+            raise ValueError(
+                f"{_FORCE_ENV} forces {name}={forced!r}, not one of "
+                f"{tuple(candidates)}"
+            )
+        _record(name, key, forced, "forced")
+        return forced
+
+    mode = _mode()
+    if mode != "off":
+        entry = _cache.lookup_entry(name, key, cache_path)
+        if entry and entry.get("winner") in candidates:
+            _record(name, key, entry["winner"],
+                    f"cache:{entry.get('source', '?')}",
+                    {k: entry[k] for k in ("candidates_ms", "spread_pct")
+                     if k in entry})
+            return entry["winner"]
+
+    if (measure and mode in ("auto", "measure") and _trace_clean()):
+        fns = {c: measure[c] for c in candidates if c in measure}
+        if fns:
+            winner, evidence = _measure.measure_candidates(fns)
+            if winner is not None:
+                _cache.store_entry(
+                    name, key, {"winner": winner, "source": "measured",
+                                **evidence}, cache_path,
+                )
+                _record(name, key, winner, "measured", evidence)
+                return winner
+            # spread-dominated: deterministic fallback, evidence kept
+            winner = _table_winner(name, key, candidates, table)
+            _record(name, key, winner, "table:spread-dominated", evidence)
+            return winner
+
+    winner = _table_winner(name, key, candidates, table)
+    _record(name, key, winner, "table")
+    return winner
+
+
+def record_measurement(
+    name: str,
+    key: str,
+    medians_ms: Mapping[str, float],
+    *,
+    spreads: Optional[Mapping[str, float]] = None,
+    higher_is_better: bool = False,
+    source: str = "measured:bench",
+    cache_path: Optional[str] = None,
+) -> Optional[str]:
+    """Adopt an ALREADY-measured comparison into the cache (bench.py's
+    phases measure the candidates anyway — this turns those rows into
+    dispatch decisions without re-running them). Returns the winner, or
+    None when spread-dominated (nothing stored).
+
+    ``spreads=None`` means the caller has NO repeat-derived noise
+    estimate (the on-chip bench runs one sample of many chained
+    iterations instead of n>=3 samples): a conservative 10% noise floor
+    is applied, so a single-sample comparison is adopted only when the
+    winner's margin is decisive — never a coin flip recorded as
+    spread_pct 0."""
+    floored = spreads is None
+    if floored:
+        spreads = {k: 10.0 for k in medians_ms}
+    winner = _measure.decide(medians_ms, spreads,
+                             higher_is_better=higher_is_better)
+    if winner is None:
+        return None
+    unit = "candidates_score" if higher_is_better else "candidates_ms"
+    entry = {
+        "winner": winner, "source": source,
+        unit: {k: round(float(v), 4) for k, v in medians_ms.items()},
+        "spread_pct": max(spreads.values(), default=0.0),
+    }
+    if floored:
+        entry["noise_floor_pct"] = 10.0  # single-sample caller
+    _cache.store_entry(name, key, entry, cache_path)
+    return winner

@@ -49,10 +49,12 @@ def main(argv=None):
     p.add_argument("--topk", type=int, default=1, choices=(1, 2),
                    help="1: Switch top-1 routing; 2: GShard top-2")
     p.add_argument("--capacity-factor", type=float, default=1.5)
-    p.add_argument("--dispatch-impl", default="sort",
-                   choices=("einsum", "sort"),
-                   help="queue assembly: dense one-hot einsum (reference) "
-                        "or index sort/scatter (scalable, default)")
+    p.add_argument("--dispatch-impl", default="auto",
+                   choices=("auto", "einsum", "sort"),
+                   help="queue assembly: dense one-hot einsum (reference), "
+                        "index sort/scatter (scalable), or auto (default: "
+                        "device-aware via the chainermn_tpu.tuning "
+                        "registry)")
     p.add_argument("--aux-weight", type=float, default=1e-2,
                    help="load-balancing auxiliary loss weight")
     args = p.parse_args(argv)

@@ -78,6 +78,116 @@ def test_purge_retired_methodology_rows():
     assert new["flash_32k_fwd_ms"] == 40.0
 
 
+def test_per_row_provenance_fresh_vs_carried(tmp_path, monkeypatch):
+    """Round-5 VERDICT ask #7: every carried-blob row names its own
+    measured_at + source (live / carried), and the compact line reports
+    fresh_rows/carried_rows so a stale overlay can't read as a fresh
+    capture."""
+    cache = tmp_path / "last_tpu.json"
+    monkeypatch.setattr(bench, "_LAST_TPU_CACHE", str(cache))
+    monkeypatch.setattr(bench, "_DETAILS_PATH",
+                        str(tmp_path / "details.json"))
+
+    # run 1: a full capture
+    bench._save_last_tpu({"device_kind": "TPU v5 lite", "value": 2452.0,
+                          "mfu": 0.299, "transformer_mfu": 0.35})
+    blob1 = json.load(open(cache))
+    assert all(p["source"] == "live"
+               for p in blob1["row_provenance"].values())
+
+    # run 2: a partial capture — value re-measured, mfu rows carried
+    bench._save_last_tpu({"device_kind": "TPU v5 lite", "value": 2500.0})
+    blob2 = json.load(open(cache))
+    prov = blob2["row_provenance"]
+    assert prov["value"]["source"] == "live"
+    assert prov["value"]["measured_at"] == blob2["measured_at"]
+    assert prov["mfu"]["source"] == "carried"
+    assert prov["mfu"]["measured_at"] == blob1["measured_at"]
+
+    # the compact line rolls the counts up
+    result = {"metric": "resnet50_images_per_sec", "value": 1.0,
+              "source": "cpu-fallback"}
+    bench._attach_last_tpu(result)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench._emit_final(result)
+    compact = json.loads(buf.getvalue().strip().splitlines()[-1])
+    lg = compact["last_good_tpu"]
+    assert lg["fresh_rows"] == 2  # value + device_kind re-measured
+    assert lg["carried_rows"] == 2  # mfu + transformer_mfu inherited
+
+
+def test_row_provenance_respects_pre_provenance_carried_stamps(
+    tmp_path, monkeypatch
+):
+    """A pre-provenance blob may ALREADY carry rows from an older run
+    (carried_keys.stamps); the new per-row provenance must inherit that
+    per-row stamp, not the blob-level measured_at (which would overstate
+    freshness — the exact dishonesty the feature prevents)."""
+    cache = tmp_path / "last_tpu.json"
+    monkeypatch.setattr(bench, "_LAST_TPU_CACHE", str(cache))
+    cache.write_text(json.dumps({
+        "device_kind": "TPU v5 lite", "value": 2452.0, "mfu": 0.299,
+        "measured_at": "2026-07-20T00:00:00Z",
+        "carried_keys": {"keys": ["mfu"],
+                         "stamps": {"mfu": "2026-07-01T00:00:00Z"}},
+    }))
+    bench._save_last_tpu({"device_kind": "TPU v5 lite", "value": 2500.0})
+    prov = json.load(open(cache))["row_provenance"]
+    assert prov["mfu"]["measured_at"] == "2026-07-01T00:00:00Z"
+    assert prov["mfu"]["source"] == "carried"
+
+
+def test_degenerate_tail_skips_accel_child_not_the_reserve(monkeypatch,
+                                                           tmp_path):
+    """ADVICE r5: when the remaining budget cannot honour the
+    CPU-fallback reserve, the accel child is SKIPPED (previously it was
+    granted a 60 s floor carved out of the reserve)."""
+    calls = []
+    monkeypatch.setattr(bench, "_DETAILS_PATH",
+                        str(tmp_path / "details.json"))
+    monkeypatch.setattr(bench, "_LAST_TPU_CACHE",
+                        str(tmp_path / "none.json"))
+    monkeypatch.setattr(bench, "TOTAL_BUDGET",
+                        bench.CPU_BENCH_RESERVE + 50)
+    monkeypatch.setattr(
+        bench, "_probe_with_retries",
+        lambda deadline, errors: {"platform": "tpu", "kind": "x", "n": 1},
+    )
+    monkeypatch.setattr(bench, "_probe_accelerator", lambda t: None)
+    monkeypatch.setattr(bench, "_cpu_env", lambda n_devices=8: None)
+    monkeypatch.setattr(bench, "_attach_probe_trail", lambda r: None)
+
+    def fake_child(mode, timeout, env=None):
+        calls.append(mode)
+        return {"metric": "m", "value": 1.0}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    assert calls == ["cpu"], calls  # no accel child on the eaten tail
+    compact = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert "reserve" in compact.get("error", "")
+
+
+def test_kernel_sweep_crashed_checker_counts_as_numeric_error():
+    """ADVICE r5: a row whose numerics checker RAISED must not read as
+    0 numeric failures."""
+    rows = [
+        {"kernel": "a", "ok": True, "numerics_ok": True},
+        {"kernel": "b", "ok": True, "numerics_ok": False},
+        {"kernel": "c", "ok": True,
+         "numerics_error": "ValueError: boom"},
+        {"kernel": "d", "ok": False, "error": "Mosaic"},
+    ]
+    counts = bench._kernel_sweep_counts(rows)
+    assert counts["kernel_sweep_failures"] == 1
+    assert counts["kernel_sweep_numeric_failures"] == 1
+    assert counts["kernel_sweep_numeric_errors"] == 1
+    assert "kernel_sweep_numeric_errors" in bench._COMPACT_KEYS
+
+
 def test_transformer_knob_env_validation(monkeypatch):
     """The accel transformer knobs reject malformed env values with a
     message naming the variable (a bare ZeroDivisionError from

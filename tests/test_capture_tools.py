@@ -77,6 +77,36 @@ def test_fresh_requires_token_and_recency(capture_root):
     assert _fresh(capture_root, "resnet_sweep_*.log", "n_variants", m)
 
 
+def test_whitespace_filename_is_handled(capture_root):
+    """ADVICE r5: the old `for f in $(find ...)` word-split paths; a log
+    name with whitespace must neither break the predicate nor hide a
+    fresh artifact."""
+    logs = capture_root / "tools" / "capture_logs"
+    marker = logs / ".watch_start"
+    marker.touch()
+    m = "tools/capture_logs/.watch_start"
+    spaced = logs / "resnet_sweep_two words.log"
+    spaced.write_text('{"n_variants": 12}\n')
+    future = time.time() + 60
+    os.utime(spaced, (future, future))
+    assert _fresh(capture_root, "resnet_sweep_*.log", "n_variants", m)
+
+
+def test_watch_capture_counter_persists_across_restarts():
+    """ADVICE r5: the re-fire cap must bound the ROUND, not the watcher
+    process — chip_watch.sh persists the attempt count beside
+    .watch_start (reset only when a fresh marker starts a new round)
+    and counts an attempt BEFORE launching the capture."""
+    src = open(os.path.join(_REPO, "tools", "chip_watch.sh")).read()
+    assert ".watch_captures" in src
+    assert 'captures=$(cat "$counter"' in src
+    # counter reset is tied to marker creation (fresh round)
+    assert 'touch "$marker"; echo 0 > "$counter"' in src
+    # the attempt is persisted before the capture launches
+    before = src.index('echo "$captures" > "$counter"')
+    assert before < src.index("on_chip_capture.sh")
+
+
 def test_missing_marker_is_never_fresh(capture_root):
     logs = capture_root / "tools" / "capture_logs"
     (logs / "bench_2.log").write_text('{"source": "live"}\n')

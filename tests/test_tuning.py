@@ -1,0 +1,544 @@
+"""Device-aware dispatch + autotune cache (chainermn_tpu.tuning).
+
+Covers the subsystem's contracts hermetically (no hardware):
+
+- cache round-trip / corrupt-file tolerance / shape-bucket keying;
+- offline seeding from a BENCH_DETAILS-shaped artifact — the on-chip
+  MoE entry (einsum-competitive, 1.63x) is adopted for the TPU device
+  kind while LIVE measurement on the CPU mesh picks sort (the 167.8x
+  side of the crossover) — the acceptance demo for the whole mechanism;
+- dist==single equivalence (values AND grads) for BOTH sides of every
+  tuned choice (MoE dispatch impls, attention variants, wire dtypes,
+  double-buffering semantics);
+- a structural assertion that the auto-selected MoE path on the CPU
+  mesh is the sort path (scatter in the lowering, decision recorded).
+
+Every test pins the cache to a tmp path — the repo's own seeded
+``.autotune_cache.json`` must never leak into hermetic assertions.
+"""
+
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu import tuning
+from chainermn_tpu.parallel.moe import (
+    dispatch_einsum,
+    dispatch_sort,
+    make_expert_params,
+    moe_layer_local,
+    top1_route,
+)
+
+D = 8
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    """Every test gets its own cache file and a clean decision log."""
+    monkeypatch.setenv(
+        "CHAINERMN_TPU_AUTOTUNE_CACHE", str(tmp_path / "cache.json")
+    )
+    monkeypatch.delenv("CHAINERMN_TPU_AUTOTUNE", raising=False)
+    monkeypatch.delenv("CHAINERMN_TPU_AUTOTUNE_FORCE", raising=False)
+    tuning.reset_decisions()
+    yield
+    tuning.reset_decisions()
+
+
+def expert_fn(params, x):
+    w1, w2 = params
+    return jnp.tanh(x @ w1) @ w2
+
+
+def _expert_init(rng):
+    k1, k2 = jax.random.split(rng)
+    return (
+        jax.random.normal(k1, (D, 16)) / 4.0,
+        jax.random.normal(k2, (16, D)) / 4.0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Registry + cache mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_cache_round_trip(self):
+        key = tuning.decision_key("TPU v5 lite", shape=(4096, 8), dtype="bf16")
+        tuning.store_entry(
+            "moe_dispatch", key,
+            {"winner": "einsum", "source": "test",
+             "candidates_ms": {"einsum": 1.0, "sort": 2.0}},
+        )
+        got = tuning.choice("moe_dispatch", ("sort", "einsum"), key)
+        assert got == "einsum"
+        d = {(r["name"], r["key"]): r for r in tuning.decisions_taken()}
+        assert d[("moe_dispatch", key)]["source"] == "cache:test"
+        # and the file itself is well-formed JSON with provenance
+        doc = tuning.load_cache()
+        entry = doc["decisions"][f"moe_dispatch|{key}"]
+        assert entry["source"] == "test" and "measured_at" in entry
+
+    def test_corrupt_cache_is_empty_not_fatal(self, tmp_path, monkeypatch):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{nope")
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_CACHE", str(bad))
+        key = tuning.decision_key("cpu", shape=(8,), dtype="grad")
+        # falls through to the table, never raises
+        assert tuning.choice("allreduce_wire", ("f32", "bf16", "int8"),
+                             key) == "bf16"
+
+    def test_shape_bucket_keying(self):
+        # nearby shapes share a bucket; far shapes do not
+        assert tuning.shape_bucket((2000, 8, 60)) == "2048x8x64"
+        assert tuning.shape_bucket((2048, 8, 64)) == "2048x8x64"
+        assert tuning.shape_bucket((16384, 16, 512)) != \
+            tuning.shape_bucket((2048, 8, 64))
+        k1 = tuning.decision_key("cpu", shape=(1500, 7, 33), dtype="bf16")
+        k2 = tuning.decision_key("cpu", shape=(2048, 8, 64), dtype="bf16")
+        assert k1 == k2
+        with pytest.raises(ValueError):
+            tuning.shape_bucket((0,))
+
+    def test_seeded_key_matches_registry_key(self):
+        # cache._bucketed_key (jax-free seeding) and registry.decision_key
+        # are duplicated-by-contract; they must produce the same string.
+        from chainermn_tpu.tuning.cache import _bucketed_key
+
+        assert _bucketed_key("TPU v5 lite", (16384, 16, 512), "bfloat16") \
+            == tuning.decision_key("TPU v5 lite", shape=(16384, 16, 512),
+                                   dtype=jnp.bfloat16)
+
+    def test_forced_override_wins_and_validates(self, monkeypatch):
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_FORCE",
+                           "moe_dispatch=einsum")
+        key = tuning.decision_key("cpu", shape=(64, 8, 8), dtype="float32")
+        assert tuning.choice("moe_dispatch", ("sort", "einsum"),
+                             key) == "einsum"
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_FORCE",
+                           "moe_dispatch=bogus")
+        with pytest.raises(ValueError, match="bogus"):
+            tuning.choice("moe_dispatch", ("sort", "einsum"), key)
+
+    def test_spread_dominated_measurement_falls_back_to_table(self):
+        # candidates whose medians differ by less than their spread:
+        # the autotuner must refuse to adopt noise as a winner.
+        a = iter([10.0, 10.5, 12.0])
+        b = iter([10.2, 10.4, 11.8])
+        key = tuning.decision_key("cpu", shape=(64, 2, 8), dtype="bf16")
+        winner = tuning.choice(
+            "attention", ("flash", "xla"), key,
+            measure={"flash": lambda: next(a), "xla": lambda: next(b)},
+        )
+        assert winner == "xla"  # the CPU table entry, not the coin flip
+        rec = tuning.decisions_taken()[-1]
+        assert rec["source"] == "table:spread-dominated"
+        # nothing was persisted: a later lookup still has no cache entry
+        assert tuning.load_cache()["decisions"] == {}
+
+    def test_one_shot_measurement_persists(self):
+        calls = {"fast": 0, "slow": 0}
+
+        def mk(name, ms):
+            def f():
+                calls[name] += 1
+                return ms
+            return f
+
+        key = tuning.decision_key("cpu", shape=(256,), dtype="bf16")
+        w1 = tuning.choice(
+            "attention", ("fast", "slow"), key,
+            measure={"fast": mk("fast", 1.0), "slow": mk("slow", 9.0)},
+        )
+        assert w1 == "fast" and calls == {"fast": 3, "slow": 3}
+        # second resolution: cache hit, measurement NOT re-run
+        w2 = tuning.choice(
+            "attention", ("fast", "slow"), key,
+            measure={"fast": mk("fast", 1.0), "slow": mk("slow", 9.0)},
+        )
+        assert w2 == "fast" and calls == {"fast": 3, "slow": 3}
+
+
+# ---------------------------------------------------------------------------
+# Offline seeding: the acceptance demo (no hardware)
+# ---------------------------------------------------------------------------
+
+
+_FAKE_DETAILS = {
+    # CPU-proxy top level (the r5 shape of BENCH_DETAILS.json)
+    "device_kind": "cpu", "n_devices": 8,
+    "moe_dispatch_shape": "T2048xE8xD64_cap320_top2",
+    "moe_dispatch_einsum_ms": 96.063, "moe_dispatch_sort_ms": 0.572,
+    "moe_dispatch_spread_pct": 12.4,
+    "attn_shape": "B1xT256xH2xD64_bf16_causal",
+    "flash_fwdbwd_ms": 4.893, "xla_fwdbwd_ms": 2.739,
+    "double_buffer_speedup": 0.752, "double_buffer_spread_pct": 19.4,
+    "last_good_tpu": {
+        # a 4-chip-shaped blob so the wire seeding (gated on a real
+        # multi-member axis) is exercised
+        "device_kind": "TPU v5 lite", "n_devices": 4,
+        "measured_at": "2026-08-01T08:46:00Z",
+        "moe_dispatch_shape": "T16384xE16xD512_cap1280_top2",
+        "moe_dispatch_einsum_ms": 11.362, "moe_dispatch_sort_ms": 6.981,
+        "attn_shape": "B4xT4096xH8xD128_bf16_causal",
+        "flash_fwdbwd_ms": 13.605, "xla_fwdbwd_ms": 41.08,
+        "double_buffer_speedup": 0.85,
+        "allreduce_curve": [
+            {"mib": 128, "dtype": "bfloat16", "mode": "fused",
+             "busbw_gbps": 101.6},
+            {"mib": 512, "dtype": "bfloat16", "mode": "bucketed",
+             "busbw_gbps": 99.0},
+            {"mib": 256, "dtype": "float32", "mode": "int8",
+             "busbw_gbps": 55.0},
+        ],
+    },
+}
+
+
+class TestSeeding:
+    def _seed(self, tmp_path, details=None):
+        p = tmp_path / "details.json"
+        p.write_text(json.dumps(details or _FAKE_DETAILS))
+        return tuning.seed_from_bench_details(str(p))
+
+    def test_seeding_adopts_onchip_choice_cpu_measurement_picks_sort(
+        self, tmp_path
+    ):
+        """THE acceptance demo: one cache, both backends, no hardware.
+
+        Seeded from the artifact, the TPU entry reproduces the on-chip
+        choice — sort, but einsum-COMPETITIVE (1.63x, vs 167.8x on the
+        proxy) — under the TPU device kind; a LIVE measurement of the
+        real dispatch impls on this CPU host picks sort by a margin no
+        spread can dominate."""
+        seeded = self._seed(tmp_path)
+        assert any("moe_dispatch|TPU v5 lite" in s for s in seeded)
+
+        # 1) the seeded cache answers for the TPU device kind without
+        #    re-measuring, and carries the einsum-competitive evidence
+        tpu_key = tuning.decision_key(
+            "TPU v5 lite", shape=(16384, 16, 512), dtype="bfloat16"
+        )
+        assert tuning.choice("moe_dispatch", ("sort", "einsum"),
+                             tpu_key) == "sort"
+        rec = [r for r in tuning.decisions_taken()
+               if r["key"] == tpu_key][-1]
+        assert rec["source"].startswith("cache:seeded")
+        ms = rec["evidence"]["candidates_ms"]
+        ratio = ms["einsum"] / ms["sort"]
+        assert 1.0 < ratio < 2.0, f"on-chip einsum not competitive: {ratio}"
+
+        # 2) live CPU measurement of the REAL impls picks sort
+        T, E, d = 512, 8, 32
+        capacity = int(T / E * 1.25)
+        x = jax.random.normal(jax.random.PRNGKey(0), (T, d), jnp.float32)
+        logits = jax.random.normal(jax.random.PRNGKey(1), (T, E))
+
+        def timed(fn):
+            @jax.jit
+            def run(x, logits):
+                q, combine = fn(x, logits, capacity, 2)
+                return jnp.sum(combine(q).astype(jnp.float32))
+
+            run(x, logits).block_until_ready()  # compile outside timing
+
+            def sample():
+                import time
+
+                t0 = time.perf_counter()
+                run(x, logits).block_until_ready()
+                return (time.perf_counter() - t0) * 1e3
+
+            return sample
+
+        cpu_key = tuning.decision_key(shape=(T, E, d), dtype=jnp.float32)
+        winner = tuning.choice(
+            "moe_dispatch", ("sort", "einsum"), cpu_key,
+            measure={"einsum": timed(dispatch_einsum),
+                     "sort": timed(dispatch_sort)},
+        )
+        assert winner == "sort"
+        rec = [r for r in tuning.decisions_taken()
+               if r["key"] == cpu_key][-1]
+        # measured decisively (the 100x+ side of the crossover), or —
+        # only if this box is pathologically noisy — the table, which
+        # ALSO says sort; either way the cpu choice is sort.
+        assert rec["source"] in ("measured", "table:spread-dominated")
+        # and both coexist in one cache file keyed by device kind
+        doc = tuning.load_cache()
+        assert f"moe_dispatch|{tpu_key}" in doc["decisions"]
+
+    def test_seeding_covers_attention_wire_and_double_buffering(
+        self, tmp_path
+    ):
+        self._seed(tmp_path)
+        doc = tuning.load_cache()["decisions"]
+        # attention: flash on chip (3.0x), xla on the cpu proxy (0.56x)
+        tpu_attn = tuning.decision_key("TPU v5 lite", shape=(4096, 8, 128),
+                                       dtype="bfloat16")
+        cpu_attn = tuning.decision_key("cpu", shape=(256, 2, 64),
+                                       dtype="bfloat16")
+        assert doc[f"attention|{tpu_attn}"]["winner"] == "flash"
+        assert doc[f"attention|{cpu_attn}"]["winner"] == "xla"
+        # wire: best busbw on the 4-chip curve is bf16 fused
+        wire_key = tuning.decision_key("TPU v5 lite", shape=(4,),
+                                       dtype="grad")
+        assert doc[f"allreduce_wire|{wire_key}"]["winner"] == "bf16"
+        # bucketed within 10% of fused -> keep the 64 MB discipline
+        assert doc[f"allreduce_bucket_mb|{wire_key}"]["winner"] == "64"
+        # ...but the CPU proxy's micro-bucket rows and n=1 curves must
+        # seed NEITHER a wire nor a bucket decision
+        assert not any(k.startswith("allreduce") and "|cpu|" in k
+                       for k in doc)
+        # double buffering measured a loss on both backends
+        for koff in (
+            tuning.decision_key("cpu", shape=(8,), dtype="step"),
+            tuning.decision_key("TPU v5 lite", shape=(4,), dtype="step"),
+        ):
+            assert doc[f"double_buffering|{koff}"]["winner"] == "off"
+
+    def test_seeding_from_repo_details_is_self_consistent(self):
+        """The REAL BENCH_DETAILS.json seeds without error and its
+        on-chip MoE row reproduces the einsum-competitive choice."""
+        import os
+
+        details = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_DETAILS.json")
+        seeded = tuning.seed_from_bench_details(details)
+        moe = [s for s in seeded if s.startswith("moe_dispatch|TPU")]
+        assert moe, seeded
+        assert moe[0].endswith("-> sort")
+
+
+# ---------------------------------------------------------------------------
+# Call-site wiring + structural selection
+# ---------------------------------------------------------------------------
+
+
+class TestCallSites:
+    def _moe_lowered(self, comm, impl):
+        ax = comm.axis_name
+
+        def local(x, rw, stacked):
+            params = jax.tree.map(lambda l: l[0], stacked)
+            return moe_layer_local(
+                x, rw, expert_fn, params, ax,
+                capacity_factor=2.0, dispatch_impl=impl,
+            )
+
+        n = comm.size
+        x = jnp.zeros((8 * n, D))
+        rw = jnp.zeros((D, n))
+        stacked = make_expert_params(_expert_init, jax.random.PRNGKey(0), n)
+        fn = jax.jit(shard_map(
+            local, mesh=comm.mesh, in_specs=(P(), P(), P(ax)),
+            out_specs=P(), check_vma=False,
+        ))
+        return fn.lower(x, rw, stacked).as_text()
+
+    def test_moe_auto_selects_sort_path_on_cpu_mesh(self, comm):
+        """STRUCTURAL: the auto-dispatched program on the CPU mesh IS
+        the sort program (index scatter present, and no decision other
+        than sort recorded), not the dense einsum one."""
+        auto_txt = self._moe_lowered(comm, "auto")
+        sort_txt = self._moe_lowered(comm, "sort")
+        einsum_txt = self._moe_lowered(comm, "einsum")
+        assert "scatter" in auto_txt  # the sort path's queue assembly
+        assert "scatter" not in einsum_txt
+        assert auto_txt == sort_txt
+        recs = [r for r in tuning.decisions_taken()
+                if r["name"] == "moe_dispatch"]
+        assert recs and all(r["winner"] == "sort" for r in recs)
+
+    def test_moe_dist_equals_single_for_both_sides(self, comm):
+        """dist==single (values AND grads) for BOTH tuned candidates:
+        the einsum and sort programs over the 8-way mesh each equal the
+        same single-device dense evaluation."""
+        n = comm.size
+        ax = comm.axis_name
+        tokens = 8 * n
+        x = jax.random.normal(jax.random.PRNGKey(0), (tokens, D))
+        rw = jax.random.normal(jax.random.PRNGKey(1), (D, n)) / 4.0
+        stacked = make_expert_params(_expert_init, jax.random.PRNGKey(2), n)
+        capacity = tokens  # generous: no drops
+
+        def single(x, rw, stacked):
+            # single-device dense evaluation of the same routing
+            logits = x @ rw
+            dispatch, combine = top1_route(logits, capacity)
+            queues = jnp.einsum("td,tec->ecd", x, dispatch)
+            outs = jax.vmap(expert_fn)(stacked, queues)
+            return jnp.einsum("ecd,tec->td", outs, combine)
+
+        def dist(impl):
+            def local(x, rw, stacked):
+                params = jax.tree.map(lambda l: l[0], stacked)
+                return moe_layer_local(
+                    x, rw, expert_fn, params, ax,
+                    capacity_factor=float(n), dispatch_impl=impl,
+                )
+
+            return jax.jit(shard_map(
+                local, mesh=comm.mesh, in_specs=(P(), P(), P(ax)),
+                out_specs=P(), check_vma=False,
+            ))
+
+        ref = single(x, rw, stacked)
+        g_ref = jax.grad(
+            lambda xx, rr, ss: (single(xx, rr, ss) ** 2).mean(),
+            argnums=(0, 1, 2),
+        )(x, rw, stacked)
+        for impl in ("einsum", "sort"):
+            out = dist(impl)(x, rw, stacked)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+            g = jax.grad(
+                lambda xx, rr, ss, i=impl: (dist(i)(xx, rr, ss) ** 2).mean(),
+                argnums=(0, 1, 2),
+            )(x, rw, stacked)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+                ),
+                g, g_ref,
+            )
+
+    def test_attention_both_sides_equal(self):
+        """Both sides of the attention choice (and of the windowed
+        choice) compute the same function — values AND grads."""
+        from chainermn_tpu.ops.attention import attention
+
+        q = jax.random.normal(jax.random.PRNGKey(3), (1, 64, 2, 8),
+                              jnp.float32)
+
+        for kwargs in ({"causal": True}, {"causal": True, "window": 16}):
+            o_x = attention(q, q, q, impl="xla", **kwargs)
+            flash_impl = "windowed" if "window" in kwargs else "flash"
+            o_f = attention(q, q, q, impl=flash_impl, interpret=True,
+                            **kwargs)
+            np.testing.assert_allclose(np.asarray(o_x), np.asarray(o_f),
+                                       rtol=2e-5, atol=2e-5)
+
+            def loss(fn_impl, interp):
+                def f(qq):
+                    return jnp.sum(
+                        attention(qq, qq, qq, impl=fn_impl,
+                                  interpret=interp, **kwargs) ** 2
+                    )
+                return jax.grad(f)(q)
+
+            np.testing.assert_allclose(
+                np.asarray(loss("xla", None)),
+                np.asarray(loss(flash_impl, True)),
+                rtol=2e-4, atol=2e-5,
+            )
+
+    def test_attention_auto_records_decision(self):
+        from chainermn_tpu.ops.attention import attention
+
+        q = jnp.zeros((1, 32, 2, 8), jnp.float32)
+        attention(q, q, q, causal=True)  # auto -> xla on cpu
+        recs = [r for r in tuning.decisions_taken()
+                if r["name"] == "attention"]
+        assert recs and recs[-1]["winner"] == "xla"
+
+    def test_wire_both_sides_dist_equals_single(self, comm):
+        """Both sides of the tuned wire (bf16 vs the f32 master wire,
+        plus the int8 wire the cache may adopt): the in-mesh mean of
+        per-shard grads equals the single-device numpy mean within each
+        wire's tolerance."""
+        from chainermn_tpu.optimizers import allreduce_gradients
+
+        n = comm.size
+        ax = comm.axis_name
+        g = jax.random.normal(jax.random.PRNGKey(4), (n, 64), jnp.float32)
+        expect = np.asarray(g).mean(axis=0)
+
+        def run(compress):
+            def local(gs):
+                return allreduce_gradients(
+                    gs[0], axis_names=(ax,), compress_dtype=compress
+                )[None]
+
+            return jax.jit(shard_map(
+                local, mesh=comm.mesh, in_specs=(P(ax),),
+                out_specs=P(ax), check_vma=False,
+            ))(g)
+
+        for compress, tol in ((None, 1e-6), (jnp.bfloat16, 2e-2),
+                              (jnp.int8, 6e-2)):
+            out = np.asarray(run(compress))
+            for i in range(n):
+                np.testing.assert_allclose(out[i], expect, rtol=tol,
+                                           atol=tol)
+
+    def test_auto_wire_resolution_and_bucket(self, comm):
+        from chainermn_tpu.communicators.xla_communicator import (
+            NaiveCommunicator,
+        )
+        from chainermn_tpu.parallel.collectives import tuned_bucket_bytes
+
+        c = NaiveCommunicator(allreduce_grad_dtype="auto")
+        assert c.allreduce_grad_dtype == jnp.dtype(jnp.bfloat16)
+        assert tuned_bucket_bytes(c.device_kind, c.size) == 64 << 20
+        # a cache entry flips the wire for this exact topology key
+        key = tuning.decision_key(c.device_kind, shape=(c.size,),
+                                  dtype="grad")
+        tuning.store_entry("allreduce_wire", key,
+                           {"winner": "int8", "source": "test"})
+        c2 = NaiveCommunicator(allreduce_grad_dtype="auto")
+        assert c2.allreduce_grad_dtype == jnp.dtype(jnp.int8)
+
+    def test_double_buffering_advisory_warns_not_overrides(self, comm):
+        """The advisory warns when the flag is enabled on a backend
+        where a cache/measured record says it loses — but NOT on the
+        blanket table fallback (an unmeasured topology has no evidence
+        to cite) — and semantics stay faithful staleness-1 (first
+        update applies the zero bank, banking this step's grads)."""
+        import optax
+
+        from chainermn_tpu import create_multi_node_optimizer
+
+        # empty cache -> table fallback: recorded, but NO warning
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            create_multi_node_optimizer(
+                optax.sgd(0.1), comm, double_buffering=True
+            )
+        assert not any("double_buffering" in str(x.message) for x in w)
+
+        # a measured record for THIS backend: the advisory fires
+        key = tuning.decision_key(comm.device_kind, shape=(comm.size,),
+                                  dtype="step")
+        tuning.store_entry(
+            "double_buffering", key,
+            {"winner": "off", "source": "measured:bench",
+             "double_buffer_speedup": 0.752},
+        )
+        tuning.reset_decisions()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            opt = create_multi_node_optimizer(
+                optax.sgd(0.1), comm, double_buffering=True
+            )
+        assert any("double_buffering" in str(x.message) for x in w)
+        params = {"w": jnp.ones((4,))}
+        state = opt.init(params)
+        grads = {"w": jnp.full((4,), 2.0)}
+        updates, state = opt.update(grads, state, params)
+        # staleness-1: the FIRST update applies the zero bank...
+        np.testing.assert_allclose(np.asarray(updates["w"]),
+                                   np.zeros(4), atol=0)
+        # ...and banks this step's (identity-reduced) grads
+        np.testing.assert_allclose(
+            np.asarray(state.communicated_grads["w"]),
+            np.asarray(grads["w"]), atol=1e-6,
+        )

@@ -9,9 +9,13 @@
 fresh_artifact() {
   local glob=$1 token=$2 marker=$3 f
   [ -n "$marker" ] && [ -e "$marker" ] || return 1
-  for f in $(find tools/capture_logs -name "$glob" \
-               -newer "$marker" 2>/dev/null); do
+  # NUL-delimited walk: a `for f in $(find ...)` word-splits paths, so a
+  # log name with whitespace would silently break the predicate. The
+  # while loop reads from process substitution (not a pipeline), so the
+  # early `return 0` happens in THIS shell.
+  while IFS= read -r -d '' f; do
     grep -q "$token" "$f" && return 0
-  done
+  done < <(find tools/capture_logs -name "$glob" \
+             -newer "$marker" -print0 2>/dev/null)
   return 1
 }

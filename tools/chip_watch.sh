@@ -21,11 +21,18 @@ marker="tools/capture_logs/.watch_start"
 # Persist across watcher RESTARTS within a round: re-touching on every
 # start would mark the round's already-landed artifacts stale and re-run
 # completed 30-min stages. The marker is untracked, so a fresh checkout
-# (next round) starts clean.
-[ -e "$marker" ] || touch "$marker"
+# (next round) starts clean. The capture-attempt COUNTER persists beside
+# it for the same reason: an in-process-only count let a restart-looping
+# watcher exceed the per-round cap (ADVICE r5) — a fresh marker resets
+# the counter, a surviving marker keeps the round's running total.
+counter="tools/capture_logs/.watch_captures"
+[ -e "$marker" ] || { touch "$marker"; echo 0 > "$counter"; }
 . tools/capture_lib.sh
 echo "[watch $(date -u +%H:%M:%S)] start: interval=${interval}s max=${max_hours}h" >> "$log"
-captures=0
+captures=$(cat "$counter" 2>/dev/null || echo 0)
+case "$captures" in
+  ''|*[!0-9]*) captures=0 ;;  # missing/garbled counter file
+esac
 max_captures=6
 while [ "$(date +%s)" -lt "$deadline" ]; do
   python tools/probe_tpu.py 180 > /dev/null 2>&1
@@ -54,8 +61,11 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       interval=1800
     else
       echo "[watch $(date -u +%H:%M:%S)] CHIP UP — launching capture (attempt $((captures + 1)))" >> "$log"
-      CAPTURE_SINCE="$marker" bash tools/on_chip_capture.sh >> "$log" 2>&1
+      # Persist the attempt BEFORE launching: a watcher killed
+      # mid-capture and restarted must still count it against the cap.
       captures=$((captures + 1))
+      echo "$captures" > "$counter"
+      CAPTURE_SINCE="$marker" bash tools/on_chip_capture.sh >> "$log" 2>&1
       echo "[watch $(date -u +%H:%M:%S)] capture #$captures done" >> "$log"
     fi
   else
