@@ -196,6 +196,28 @@ def _run_child(mode: str, timeout: float, env=None):
 
 _LAST_TPU_CACHE = os.path.join(_HERE, ".bench_last_tpu.json")
 
+# Observability trace (ISSUE 2): every bench child appends structured
+# wire/phase events here; tools/trace_report.py summarizes it. The
+# capture script points CHAINERMN_TPU_TRACE at a per-stamp file in
+# tools/capture_logs/ instead.
+_TRACE_PATH = os.environ.get(
+    "CHAINERMN_TPU_TRACE", os.path.join(_HERE, "BENCH_TRACE.jsonl")
+)
+
+
+def _truncate_trace() -> None:
+    """Start each DRIVER run with a fresh trace (children append within
+    the run — accel child, cpu fallback, native-loop children all land
+    in one file). Creates the directory like the child Recorders do: a
+    missing parent dir must not silently skip the truncation while the
+    children go on appending to a stale file."""
+    try:
+        parent = os.path.dirname(os.path.abspath(_TRACE_PATH))
+        os.makedirs(parent, exist_ok=True)
+        open(_TRACE_PATH, "w").close()
+    except OSError:
+        pass
+
 
 _CACHE_META_KEYS = (
     "measured_at", "carried_keys", "row_provenance", "source", "stale",
@@ -493,6 +515,7 @@ def _emit_final(result: dict) -> None:
 def main() -> None:
     deadline = time.monotonic() + TOTAL_BUDGET
     errors = []
+    _truncate_trace()
 
     accel = _probe_with_retries(deadline, errors)
     if accel is not None:
@@ -2018,8 +2041,16 @@ def _kernel_sweep_counts(rows) -> dict:
 
 def _run_bench(mode: str) -> None:
     import jax
+    import jax.numpy as jnp
 
     from chainermn_tpu import create_communicator
+    from chainermn_tpu.observability import trace as obs_trace
+
+    trace_path = _TRACE_PATH
+    try:
+        obs_trace.enable(trace_path, meta={"source": "bench", "mode": mode})
+    except OSError:
+        trace_path = None
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
@@ -2032,6 +2063,25 @@ def _run_bench(mode: str) -> None:
         # here even if an accelerator slipped through the env scrub.
         on_accel = False
     comm = create_communicator("xla")
+
+    # One tiny eager 'auto'-wire gradient allreduce through a separate
+    # communicator: every emitted trace then carries a REAL collective
+    # event whose wire dtype was resolved by the autotune registry, with
+    # the decision's provenance attached (ISSUE 2 acceptance). The
+    # headline workloads keep their explicit bf16 wire — this demo never
+    # touches their configuration.
+    auto_demo_err = None
+    try:
+        auto_comm = create_communicator("xla", allreduce_grad_dtype="auto")
+        auto_comm.allreduce_grad(
+            {"g": jnp.ones((auto_comm.size, 4), jnp.float32)}
+        )
+        del auto_comm
+    except Exception as e:
+        # Record (never raise): the demo exists so the trace carries an
+        # auto-provenance event — losing it silently would let a broken
+        # provenance path masquerade as "no auto sites ran".
+        auto_demo_err = f"{type(e).__name__}: {e}"[:160]
 
     steps, warmup = (20, 3) if on_accel else (5, 1)
     step, state, (x, y), batch, metric, knob_fields = _resnet_setup(
@@ -2115,6 +2165,8 @@ def _run_bench(mode: str) -> None:
         ),
         **knob_fields,
     }
+    if auto_demo_err:
+        out["trace_auto_demo_error"] = auto_demo_err
     if not on_accel:
         out["proxy_spread_pct"] = headline_spread
     peak = _peak_flops(devices[0].device_kind)
@@ -2129,65 +2181,43 @@ def _run_bench(mode: str) -> None:
     # stalls past the parent's budget, this line is what gets salvaged.
     print(json.dumps(out), flush=True)
 
-    try:
-        out.update(_bench_allreduce(comm, 100_000_000 if on_accel else 10_000_000))
-    except Exception as e:  # never lose the primary number
-        out["allreduce_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
+    def supp(name: str, err_key: str, fn) -> None:
+        """One supplementary phase: exception-isolated (never lose the
+        primary number), cumulative line after each, and a span in the
+        observability trace so the per-phase wall time is in the
+        artifact, not just the log ordering. The span sits INSIDE the
+        try so a failed phase records ok=False — catching inside the
+        span would stamp every failure ok=True."""
+        try:
+            with obs_trace.span(f"bench:{name}"):
+                out.update(fn())
+        except Exception as e:
+            out[err_key] = f"{type(e).__name__}: {e}"[:200]
+        print(json.dumps(out), flush=True)
 
-    try:
-        out.update(_bench_allreduce_curve(comm, on_accel))
-    except Exception as e:
-        out["allreduce_curve_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
-
-    try:
-        out.update(_bench_attention(on_accel))
-    except Exception as e:
-        out["attn_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
-
+    supp("allreduce", "allreduce_error",
+         lambda: _bench_allreduce(
+             comm, 100_000_000 if on_accel else 10_000_000))
+    supp("allreduce_curve", "allreduce_curve_error",
+         lambda: _bench_allreduce_curve(comm, on_accel))
+    supp("attention", "attn_error", lambda: _bench_attention(on_accel))
     # Early on purpose (round-4 VERDICT item 7): a Mosaic layout
     # rejection must reach the artifact even if the budget cuts the
     # expensive transformer/native phases below.
-    try:
-        out.update(_bench_kernel_sweep(on_accel))
-    except Exception as e:
-        out["kernel_sweep_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
-
-    try:
-        out.update(_bench_double_buffering(comm, on_accel))
-    except Exception as e:
-        out["double_buffer_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
-
-    try:
-        out.update(_bench_transformer(comm, on_accel))
-    except Exception as e:
-        out["transformer_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
-
-    try:
-        out.update(_bench_s2d_resnet(comm, on_accel))
-    except Exception as e:
-        out["s2d_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
-
-    try:
-        out.update(_bench_moe_dispatch(on_accel))
-    except Exception as e:
-        out["moe_dispatch_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
-
+    supp("kernel_sweep", "kernel_sweep_error",
+         lambda: _bench_kernel_sweep(on_accel))
+    supp("double_buffer", "double_buffer_error",
+         lambda: _bench_double_buffering(comm, on_accel))
+    supp("transformer", "transformer_error",
+         lambda: _bench_transformer(comm, on_accel))
+    supp("s2d_resnet", "s2d_error", lambda: _bench_s2d_resnet(comm, on_accel))
+    supp("moe_dispatch", "moe_dispatch_error",
+         lambda: _bench_moe_dispatch(on_accel))
     # Last on purpose: this one spawns fresh child processes whose backend
     # init rolls the tunnel-flap dice — a stall here must only ever cost
     # this row, not any of the above.
-    try:
-        out.update(_bench_native_input(comm, on_accel))
-    except Exception as e:
-        out["native_input_error"] = f"{type(e).__name__}: {e}"[:200]
-    print(json.dumps(out), flush=True)
+    supp("native_input", "native_input_error",
+         lambda: _bench_native_input(comm, on_accel))
 
     # Dispatch provenance: every decision the autotune registry
     # resolved during this run (full trail in the artifact, a compact
@@ -2200,6 +2230,11 @@ def _run_bench(mode: str) -> None:
         out["autotune"] = tuning.decisions_summary(max_len=160)
     except Exception as e:
         out["autotune_error"] = f"{type(e).__name__}: {e}"[:120]
+    if trace_path is not None:
+        out["trace"] = trace_path
+        rec = obs_trace.active()
+        if rec is not None:
+            rec.flush()
     print(json.dumps(out), flush=True)
 
 

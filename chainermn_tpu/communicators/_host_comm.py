@@ -18,12 +18,47 @@ fast path with no communication at all.
 
 from __future__ import annotations
 
+import functools
 import os
 import pickle
+import time
 from typing import Any, Callable, Sequence
 
 import jax
 import numpy as np
+
+from chainermn_tpu.observability import trace as _trace
+
+
+def _traced_obj(op: str, payload: str | None = "arg"):
+    """Wire-counter instrumentation for the obj-plane collectives: when
+    tracing is active, record op, pickled payload bytes, and the TRUE
+    blocking duration (host-plane calls complete synchronously — no
+    async-dispatch caveat here). ``payload``: ``"arg"`` measures the
+    first positional argument, ``"result"`` the return value (receives),
+    ``None`` skips bytes (barrier). Disabled cost: one global read."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            rec = _trace.active()
+            if rec is None:
+                return fn(self, *args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(self, *args, **kwargs)
+            obj = (args[0] if args else None) if payload == "arg" else (
+                out if payload == "result" else None
+            )
+            rec.collective(
+                op, plane="host",
+                nbytes=(_trace.obj_nbytes(obj) if payload else None),
+                dur_s=time.perf_counter() - t0, size=self.size,
+            )
+            return out
+
+        return wrapper
+
+    return deco
 
 
 def _is_multiprocess() -> bool:
@@ -135,6 +170,7 @@ class HostComm:
 
     # -- point-to-point (native transport only) ----------------------------
 
+    @_traced_obj("send_obj")
     def send_obj(self, obj: Any, dest: int) -> None:
         if self.tcp is None:
             raise NotImplementedError(
@@ -143,6 +179,7 @@ class HostComm:
             )
         self.tcp.send_obj(obj, dest)
 
+    @_traced_obj("recv_obj", payload="result")
     def recv_obj(self, source: int) -> Any:
         if self.tcp is None:
             raise NotImplementedError(
@@ -164,6 +201,7 @@ class HostComm:
 
     # -- collectives -------------------------------------------------------
 
+    @_traced_obj("barrier", payload=None)
     def barrier(self, tag: str = "barrier") -> None:
         if self.tcp is not None:
             return self.tcp.barrier()
@@ -173,7 +211,12 @@ class HostComm:
 
         multihost_utils.sync_global_devices(tag)
 
+    @_traced_obj("bcast_obj", payload="result")
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        # payload="result": the usual call shape is ``bcast_obj(obj if
+        # rank == 0 else None)`` — measuring the argument would record a
+        # few pickled-None bytes on every non-root rank; the RETURN is
+        # the broadcast payload on all ranks.
         if self.tcp is not None:
             return self.tcp.bcast_obj(obj, root)
         if not _is_multiprocess():
@@ -188,6 +231,7 @@ class HostComm:
         out = multihost_utils.broadcast_one_to_all(buf, is_source=(self.rank == root))
         return _padded_to_obj(np.asarray(out))
 
+    @_traced_obj("allgather_obj")
     def allgather_obj(self, obj: Any) -> list[Any]:
         if self.tcp is not None:
             return self.tcp.allgather_obj(obj)
@@ -206,6 +250,7 @@ class HostComm:
         everyone = self.allgather_obj(obj)
         return everyone if self.rank == root else None
 
+    @_traced_obj("scatter_obj", payload="result")
     def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
         if self.tcp is not None:
             return self.tcp.scatter_obj(objs, root)

@@ -24,6 +24,7 @@ execution model is TPU-native SPMD:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Sequence
 
 import jax
@@ -33,6 +34,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.communicators._host_comm import HostComm
+from chainermn_tpu.observability import trace as _trace
 from chainermn_tpu.parallel import collectives
 from chainermn_tpu.parallel.mesh import MeshTopology
 
@@ -42,6 +44,21 @@ PyTree = Any
 #: :meth:`CommunicatorBase.recv_obj` / :meth:`CommunicatorBase.probe`
 #: (reference parity: ``MPI.ANY_SOURCE``).
 ANY_SOURCE = -1
+
+
+def _latest_decision(name: str) -> dict | None:
+    """Most recent autotune decision record for ``name`` — the tuning
+    provenance a communicator attaches to the wire events of a
+    configuration it resolved via ``'auto'``."""
+    try:
+        from chainermn_tpu import tuning
+
+        for d in reversed(tuning.decisions_taken()):
+            if d.get("name") == name:
+                return d
+    except Exception:
+        pass
+    return None
 
 
 class CommunicatorBase:
@@ -81,6 +98,11 @@ class CommunicatorBase:
         #: keyed on this mesh's device kind + size — table default
         #: bf16; an int8 cache entry must earn its rounding stages with
         #: a measured busbw win; see chainermn_tpu.tuning).
+        #: autotune decision record behind an ``'auto'`` wire resolution
+        #: (name/winner/source/key) — attached to this communicator's
+        #: ``allreduce_grad`` wire events so every auto collective in a
+        #: trace carries its dispatch provenance. None for explicit dtypes.
+        self._wire_provenance: dict | None = None
         if isinstance(allreduce_grad_dtype, str) \
                 and allreduce_grad_dtype == "auto":
             from chainermn_tpu.parallel.collectives import (
@@ -90,6 +112,7 @@ class CommunicatorBase:
             allreduce_grad_dtype = resolve_allreduce_wire(
                 self.device_kind, self.topology.size
             )
+            self._wire_provenance = _latest_decision("allreduce_wire")
         self.allreduce_grad_dtype = (
             jnp.dtype(allreduce_grad_dtype) if allreduce_grad_dtype else None
         )
@@ -120,15 +143,39 @@ class CommunicatorBase:
         """World size = number of mesh slots (reference: #MPI processes)."""
         return self.topology.size
 
-    @property
+    @functools.cached_property
     def device_kind(self) -> str:
         """``device_kind`` of this mesh's devices (``"cpu"``,
         ``"TPU v5 lite"``, ...) — the device-aware dispatch key the
-        autotune registry (chainermn_tpu.tuning) resolves against."""
+        autotune registry (chainermn_tpu.tuning) resolves against.
+        Cached: the mesh is immutable, and the wire-trace layer stamps
+        this onto every collective event."""
         try:
             return next(iter(self.mesh.devices.flat)).device_kind
         except Exception:
             return "unknown"
+
+    def _wire_event(
+        self, op: str, t0: float, *, payload=None, nbytes=None,
+        result=None, **extra,
+    ) -> None:
+        """Record one collective-wire counter event (no-op when tracing
+        is off — one global read). Host-side only: never called from
+        inside a jitted program, so instrumentation cannot change the
+        lowered HLO (structural test in tests/test_trace.py).
+        ``result`` is blocked on only in the recorder's sync mode (true
+        wall durations); default durations are dispatch-to-return."""
+        rec = _trace.active()
+        if rec is None:
+            return
+        if result is not None:
+            _trace.sync_point(result)
+        if nbytes is None and payload is not None:
+            nbytes = _trace.tree_nbytes(payload)
+        rec.collective(
+            op, nbytes=nbytes, dur_s=time.perf_counter() - t0,
+            size=self.size, device=self.device_kind, **extra,
+        )
 
     @property
     def rank(self) -> int:
@@ -243,8 +290,11 @@ class CommunicatorBase:
     def allreduce(self, x: jax.Array, op: str = "sum") -> jax.Array:
         """Eager allreduce of stacked per-rank values ``x[size, ...]`` →
         reduced array ``[...]`` (replicated)."""
+        t0 = time.perf_counter()
         x = self._shard_stacked(x)
         out = self._jitted[op](x)
+        self._wire_event("allreduce", t0, nbytes=int(x.nbytes),
+                         result=out, reduce_op=op)
         return out[0]
 
     def _root_process(self, root: int) -> int:
@@ -288,6 +338,7 @@ class CommunicatorBase:
         the eager-parity form the stacked-collective tests use. Explicit flag
         rather than shape sniffing: a plain batch whose leading dim happens
         to equal world size must not be silently sliced."""
+        t0 = time.perf_counter()
         x = jnp.asarray(x)
         if stacked:
             if x.ndim < 1 or x.shape[0] != self.size:
@@ -299,15 +350,22 @@ class CommunicatorBase:
         # Cross-process agreement: every process must end up with the
         # *root process's* value, not its own local one.
         x = self._agree_value(x, self._root_process(root))
-        return jax.device_put(x, NamedSharding(self.mesh, P()))
+        out = jax.device_put(x, NamedSharding(self.mesh, P()))
+        self._wire_event("bcast", t0, nbytes=int(out.nbytes), result=out,
+                         root=root)
+        return out
 
     def allgather(self, x: jax.Array) -> jax.Array:
         """Identity on the stacked representation (every rank gets all
         contributions), placed replicated — mirrors ``allgather`` semantics."""
+        t0 = time.perf_counter()
         x = jnp.asarray(x)
         if x.shape[0] != self.size:
             raise ValueError("allgather expects stacked [size, ...] input")
-        return jax.device_put(x, NamedSharding(self.mesh, P()))
+        out = jax.device_put(x, NamedSharding(self.mesh, P()))
+        self._wire_event("allgather", t0, nbytes=int(out.nbytes),
+                         result=out)
+        return out
 
     def alltoall(self, x: jax.Array) -> jax.Array:
         """Eager all-to-all on ``x[size, size, ...]`` (rank i's row i is its
@@ -315,19 +373,26 @@ class CommunicatorBase:
         ``MPI_Alltoall`` on the stacked view. Shards the stack over the mesh
         and runs a real ``lax.all_to_all`` — the bytes move device-to-device
         over ICI, not through a host transpose."""
+        t0 = time.perf_counter()
         x = jnp.asarray(x)
         if x.ndim < 2 or x.shape[0] != self.size or x.shape[1] != self.size:
             raise ValueError("alltoall expects [size, size, ...] input")
         x = self._shard_stacked(x)
-        return self._jitted["alltoall"](x)
+        out = self._jitted["alltoall"](x)
+        self._wire_event("alltoall", t0, nbytes=int(x.nbytes), result=out)
+        return out
 
     def scatter(self, x: jax.Array, root: int = 0) -> jax.Array:
         """Scatter root's ``[size, ...]`` buffer: shard i receives ``x[i]``,
         returned as the stacked sharded array. Multihost: the root process's
         buffer is broadcast first so every process shards the same data."""
+        t0 = time.perf_counter()
         x = jnp.asarray(x)
         x = self._agree_value(x, self._root_process(root))
-        return self._shard_stacked(x)
+        out = self._shard_stacked(x)
+        self._wire_event("scatter", t0, nbytes=int(x.nbytes), result=out,
+                         root=root)
+        return out
 
     # ------------------------------------------------------------------
     # Model-level operations (the reference's hot pair)
@@ -338,9 +403,15 @@ class CommunicatorBase:
         processes when multihost), so all ranks start from rank-``root``'s
         weights — reference ``bcast_data(model)`` called on the first
         optimizer update (``optimizers.py`` (dagger))."""
+        t0 = time.perf_counter()
         params = self._agree_value(params, self._root_process(root))
         repl = NamedSharding(self.mesh, P())
-        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), repl), params)
+        out = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), repl), params
+        )
+        self._wire_event("bcast_data", t0, payload=out, result=out,
+                         root=root)
+        return out
 
     def reduce_gradients_in_jit(
         self, grads: PyTree, *, compress_dtype=None
@@ -412,7 +483,19 @@ class CommunicatorBase:
             out = self.allreduce(g, op=op)
             return out.astype(orig)
 
-        return jax.tree.map(reduce_leaf, grads)
+        t0 = time.perf_counter()
+        out = jax.tree.map(reduce_leaf, grads)
+        # The top-level wire event (the per-leaf allreduces above record
+        # their own nested events): payload bytes of the whole tree, the
+        # wire dtype, and — when this communicator's wire came from
+        # ``allreduce_grad_dtype='auto'`` — the autotune provenance.
+        self._wire_event(
+            "allreduce_grad", t0, payload=grads, result=out,
+            wire_dtype=(jnp.dtype(dtype).name if dtype is not None
+                        else "none"),
+            provenance=self._wire_provenance, reduce_op=op,
+        )
+        return out
 
     # ------------------------------------------------------------------
     # Host-plane object collectives (reference: *_obj via mpi4py)
@@ -447,6 +530,7 @@ class CommunicatorBase:
         :mod:`chainermn_tpu.functions.point_to_point` (ppermute); this eager
         form exists for parity and host-driven control flows, not the hot
         loop."""
+        t0 = time.perf_counter()
         is_tuple = isinstance(x, (tuple, list))
         parts = list(x) if is_tuple else [x]
         header = []
@@ -456,6 +540,8 @@ class CommunicatorBase:
             header.append((arr.shape, str(arr.dtype)))
             payloads.append(arr.tobytes())
         self.send_obj(("ndarray", is_tuple, header, payloads), dest, tag)
+        self._wire_event("send", t0, plane="host",
+                         nbytes=sum(len(b) for b in payloads), dest=dest)
 
     def recv(self, source: int, tag: int = 0):
         """Eager point-to-point ndarray receive; returns NumPy array(s)
@@ -463,12 +549,16 @@ class CommunicatorBase:
         ``jax.device_put`` would canonicalise int64→int32 under the default
         x64-off config, silently corrupting large values). Callers place on
         device with their own sharding/dtype choice."""
+        t0 = time.perf_counter()
         kind, is_tuple, header, payloads = self.recv_obj(source, tag)
         if kind != "ndarray":
             raise RuntimeError(
                 f"recv expected an ndarray message, got {kind!r} (interleaved "
                 "send_obj/send on one channel must match recv_obj/recv order)"
             )
+        self._wire_event("recv", t0, plane="host",
+                         nbytes=sum(len(b) for b in payloads),
+                         source=source)
         arrays = tuple(
             # .copy(): frombuffer views the wire bytes read-only; MPI recv
             # hands back a writable buffer, so match that contract.

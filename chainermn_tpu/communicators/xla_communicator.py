@@ -174,10 +174,14 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
     def bucket_bytes(self) -> int:
         """Gradient-pack bucket size (autotuned, resolved once per
         communicator so the pipeline's layout is stable for the
-        process lifetime)."""
+        process lifetime). The resolution's provenance is kept for the
+        observability layer's pack events."""
+        from chainermn_tpu.communicators.base import _latest_decision
         from chainermn_tpu.parallel.collectives import tuned_bucket_bytes
 
-        return tuned_bucket_bytes(self.device_kind, self.size)
+        out = tuned_bucket_bytes(self.device_kind, self.size)
+        self._bucket_provenance = _latest_decision("allreduce_bucket_mb")
+        return out
 
     @property
     def two_level_axes(self):
@@ -255,6 +259,7 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
         # entry seeded from an on-chip busbw curve can move it — see
         # chainermn_tpu.tuning).
         bucket_bytes = self.bucket_bytes
+        n_buckets_total = 0
         for dt, idxs in groups.items():
             itemsize = jnp.dtype(dt).itemsize
             buckets: list[list[int]] = []
@@ -269,6 +274,7 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
                 cur_bytes += nbytes
             if cur:
                 buckets.append(cur)
+            n_buckets_total += len(buckets)
             for bidx in buckets:
                 flat = jnp.concatenate(
                     [leaves[i].astype(dt).ravel() for i in bidx]
@@ -296,6 +302,34 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
                         .astype(leaves[i].dtype)
                     )
                     off += n
+        # Pack provenance into the trace (fires at TRACE time — once per
+        # compilation, pure host-side Python, so the lowered program is
+        # untouched): the bucket layout this program committed to and
+        # the autotune decision behind it.
+        from chainermn_tpu.observability import trace as _trace
+
+        rec = _trace.active()
+        if rec is not None:
+            def wire_itemsize(g):
+                # int8 wire: float buckets PACK in f32 but cross the
+                # inter wire as 1 byte/elem — nbytes must describe the
+                # wire the wire_dtype names, not the pack staging dtype
+                # (a 4x overstatement otherwise).
+                if int8_wire and jnp.issubdtype(g.dtype, jnp.floating):
+                    return 1
+                return jnp.dtype(cast_dtype(g)).itemsize
+
+            rec.event(
+                "pack", op="two_level_allreduce",
+                nbytes=sum(g.size * wire_itemsize(g) for g in leaves),
+                bucket_bytes=bucket_bytes,
+                n_buckets=n_buckets_total,
+                wire_dtype=("int8" if int8_wire else
+                            (jnp.dtype(compress_dtype).name
+                             if compress_dtype is not None else "none")),
+                provenance=getattr(self, "_bucket_provenance", None),
+                size=self.size,
+            )
         return jax.tree.unflatten(treedef, out)
 
 

@@ -41,14 +41,21 @@ class ObservationAggregator:
     def __call__(
         self, observation: Mapping[str, float]
     ) -> Optional[dict[str, float]]:
-        for k, v in observation.items():
-            self._sums[k] = self._sums.get(k, 0.0) + float(v)
-            self._counts[k] = self._counts.get(k, 0) + 1
-        self._calls += 1
+        self.add(observation)
         if self._calls < self.interval:
             return None
         # Window mean per rank, then ONE cross-rank averaging collective.
         return self.flush()
+
+    def add(self, observation: Mapping[str, float]) -> None:
+        """Buffer one observation into the current window WITHOUT any
+        collective — the accumulate half of ``__call__``, split out so
+        consumers that exchange per-rank summaries (the straggler
+        monitor) can share the window machinery."""
+        for k, v in observation.items():
+            self._sums[k] = self._sums.get(k, 0.0) + float(v)
+            self._counts[k] = self._counts.get(k, 0) + 1
+        self._calls += 1
 
     def flush(self) -> Optional[dict[str, float]]:
         """Aggregate whatever the current window holds (for end of training,
@@ -78,3 +85,20 @@ class ObservationAggregator:
         if not total:
             return None
         return {k: s / c for k, (s, c) in total.items()}
+
+    def flush_per_rank(self) -> list[dict[str, float]]:
+        """Exchange the window and return EVERY process's window-mean
+        dict, in host-plane rank order (``out[i]`` is process i's; an
+        empty window contributes ``{}``). The cross-rank comparison the
+        straggler monitor needs — a mean would hide exactly the
+        divergence it looks for. Same collective contract as
+        :meth:`flush`: one host-plane allgather, every process must
+        call at the same point."""
+        local = {
+            k: self._sums[k] / self._counts[k]
+            for k in self._sums if self._counts.get(k)
+        }
+        self._sums.clear()
+        self._counts.clear()
+        self._calls = 0
+        return self.comm.allgather_obj(local)

@@ -1,0 +1,60 @@
+"""Structured observability: collective-wire counters, step-time
+breakdown, and cross-rank straggler detection (ISSUE 2; see
+docs/observability.md).
+
+Three integrated layers, all host-side (an instrumented program lowers
+to exactly the same HLO — zero added device-plane collectives):
+
+- :mod:`~chainermn_tpu.observability.trace` — the event recorder. Wire
+  counters for every communicator collective (op, payload bytes, wire
+  dtype, duration, autotune provenance of any ``'auto'`` decision),
+  step-timeline events from the Trainer, JSONL + Chrome-trace export.
+  Enable with ``CHAINERMN_TPU_TRACE=<path.jsonl>`` or
+  :func:`~chainermn_tpu.observability.trace.enable`.
+- :mod:`~chainermn_tpu.observability.straggler` — cross-rank drift
+  detection over :class:`ObservationAggregator` windows.
+- ``tools/trace_report.py`` — per-op bytes/time tables (with roofline
+  floors where device peaks are known) from an emitted JSONL.
+
+The pre-existing ``jax.profiler`` wrappers stay in
+:mod:`chainermn_tpu.utils.observability`; ``profile()`` now records its
+start/stop into this event stream as well.
+"""
+
+from chainermn_tpu.observability.trace import (
+    TRACE_SCHEMA,
+    Recorder,
+    active,
+    chrome_trace,
+    disable,
+    enable,
+    read_jsonl,
+    span,
+    write_chrome_trace,
+)
+
+
+def __getattr__(name):
+    # Lazy: straggler pulls in ObservationAggregator -> communicators,
+    # while the communicators themselves import this package for the
+    # trace module — eager re-export here would be a circular import.
+    if name == "StragglerMonitor":
+        from chainermn_tpu.observability.straggler import StragglerMonitor
+
+        return StragglerMonitor
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+__all__ = [
+    "TRACE_SCHEMA",
+    "Recorder",
+    "StragglerMonitor",
+    "active",
+    "chrome_trace",
+    "disable",
+    "enable",
+    "read_jsonl",
+    "span",
+    "write_chrome_trace",
+]

@@ -1,0 +1,150 @@
+"""Cross-rank straggler/drift detection (ISSUE 2 tentpole, layer 3).
+
+A multihost data-parallel step runs at the pace of its slowest process;
+one slow host (thermal throttle, noisy neighbour, dying NIC) shows up
+only as a globally slower step — silently. This monitor turns that into
+a logged, testable signal: every ``interval`` updates the window's
+per-phase step-time summaries are exchanged in ONE host-plane
+collective (:meth:`ObservationAggregator.flush_per_rank` — an object
+allgather, the same wire the metrics aggregation already rides, zero
+device-plane collectives), and any process whose phase time diverges
+from the cross-rank median by more than ``threshold`` is flagged.
+
+Use standalone (:meth:`StragglerMonitor.update` with a phase-time dict)
+or as a :class:`~chainermn_tpu.training.trainer.Trainer` extension
+(:meth:`attach`), where it drains the trainer's per-phase window
+(data_wait / h2d / compute / logging / extensions).
+
+Collective contract: ``update``/``__call__`` must be invoked at the
+same point on every process of the communicator (the Trainer's
+fixed-interval extension trigger guarantees this).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Mapping, Optional
+
+from chainermn_tpu.extensions.observation_aggregator import (
+    ObservationAggregator,
+)
+from chainermn_tpu.observability import trace
+
+#: default ``out`` sentinel: resolve ``sys.stderr`` at PRINT time, not
+#: at class-definition time — a harness that redirects stderr after
+#: import (capsys, redirect_stderr) must still capture the warning.
+#: ``out=None`` keeps meaning "no printing".
+_STDERR = object()
+
+
+class StragglerMonitor:
+    """Flag processes whose step-phase times drift from the pack.
+
+    Args:
+      comm: communicator whose HOST plane the summaries ride (one entry
+        per process — the "1 slow host" granularity).
+      interval: updates per detection window (as a Trainer extension
+        this is the extension interval; see :meth:`attach`).
+      threshold: relative divergence that flags a rank:
+        ``(value - median) / median > threshold``. Only slower-than-
+        median ranks are flagged — a fast rank is not a straggler.
+      min_phase_s: phases whose cross-rank median is below this are
+        skipped (relative spread on a ~0 ms phase is noise).
+      out: stream for the rank-0 warning line (None = no printing).
+    """
+
+    def __init__(
+        self,
+        comm,
+        *,
+        interval: int = 50,
+        threshold: float = 0.3,
+        min_phase_s: float = 1e-4,
+        out=_STDERR,
+    ) -> None:
+        if interval < 1:
+            raise ValueError(f"interval must be >= 1, got {interval}")
+        if threshold <= 0:
+            raise ValueError(f"threshold must be > 0, got {threshold}")
+        self.comm = comm
+        self.interval = interval
+        self.threshold = threshold
+        self.min_phase_s = min_phase_s
+        self.out = out
+        self._agg = ObservationAggregator(comm, interval=1)
+        #: reports with at least one flagged rank, newest last
+        self.reports: list[dict] = []
+
+    # -- Trainer extension protocol ------------------------------------
+
+    def attach(self, trainer) -> "StragglerMonitor":
+        """Register on ``trainer`` at this monitor's interval."""
+        trainer.extend(self, interval=self.interval)
+        return self
+
+    def __call__(self, trainer) -> Optional[dict]:
+        return self.update(trainer.consume_phase_window())
+
+    # -- core ----------------------------------------------------------
+
+    def update(self, phases: Mapping[str, float]) -> Optional[dict]:
+        """Exchange one window's mean phase times and check divergence.
+        COLLECTIVE: every process must call at the same point. Returns
+        the report dict, or None when the window was empty everywhere."""
+        self._agg.add(dict(phases))
+        per_rank = self._agg.flush_per_rank()
+        if not any(per_rank):
+            return None
+        return self.check(per_rank)
+
+    def check(self, per_rank: list) -> dict:
+        """Pure detection over per-process summaries (separated from the
+        collective exchange so tests can feed synthetic rank data).
+        ``per_rank[i]`` is process i's ``{phase: mean_seconds}``."""
+        report: dict = {"n_ranks": len(per_rank), "phases": {},
+                        "flagged_ranks": []}
+        keys = sorted({k for r in per_rank if r for k in r})
+        flagged_all: set[int] = set()
+        for key in keys:
+            vals = [(i, float(r[key])) for i, r in enumerate(per_rank)
+                    if r and key in r]
+            if len(vals) < 2:
+                continue
+            xs = sorted(v for _, v in vals)
+            n = len(xs)
+            med = (xs[n // 2] if n % 2
+                   else 0.5 * (xs[n // 2 - 1] + xs[n // 2]))
+            if med < self.min_phase_s:
+                continue
+            devs = {i: (v - med) / med for i, v in vals}
+            flagged = sorted(i for i, d in devs.items()
+                             if d > self.threshold)
+            flagged_all.update(flagged)
+            worst = max(devs, key=lambda i: devs[i])
+            report["phases"][key] = {
+                "median_s": round(med, 6),
+                "worst_rank": worst,
+                "worst_rel_dev": round(devs[worst], 4),
+                "flagged": flagged,
+            }
+        report["flagged_ranks"] = sorted(flagged_all)
+        if flagged_all:
+            self.reports.append(report)
+            rec = trace.active()
+            if rec is not None:
+                rec.event("straggler", **report)
+            stream = sys.stderr if self.out is _STDERR else self.out
+            if stream is not None and self.comm.rank == 0:
+                detail = "; ".join(
+                    f"{k}: rank {v['worst_rank']} "
+                    f"+{v['worst_rel_dev'] * 100:.0f}% vs median "
+                    f"{v['median_s'] * 1e3:.1f} ms"
+                    for k, v in report["phases"].items() if v["flagged"]
+                )
+                print(
+                    f"[chainermn_tpu] straggler warning: rank(s) "
+                    f"{report['flagged_ranks']} diverge >"
+                    f"{self.threshold * 100:.0f}% — {detail}",
+                    file=stream, flush=True,
+                )
+        return report

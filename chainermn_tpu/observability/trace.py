@@ -1,0 +1,382 @@
+"""Structured trace/event recorder — the collective-wire and step-time
+telemetry layer (ISSUE 2 tentpole; docs/observability.md).
+
+SURVEY.md section 5 records that the reference had no observability
+beyond rank-0 ``print`` gating; this module measures the thing the
+framework exists to optimize: bytes and time on the collective wire,
+per step, per process. Three properties are load-bearing:
+
+- **Host-side timestamps only.** Instrumentation wraps the *eager* API
+  surface (communicator calls, trainer loop phases, host-plane object
+  collectives); it never enters a jitted program, so an instrumented
+  step lowers to EXACTLY the same HLO — zero added device-plane
+  collectives (structural test: ``tests/test_trace.py``). Durations of
+  eager device-plane calls are dispatch-to-return under JAX's async
+  dispatch; set ``CHAINERMN_TPU_TRACE_SYNC=1`` (or ``enable(sync=True)``)
+  to block on results for true wall durations — a measurement mode, not
+  the default, because the sync serialises pipelining.
+- **Near-zero overhead when off.** Every instrumentation site starts
+  with ``trace.active()``; disabled, that is one global read and the
+  site adds no timing, no allocation, no pickling.
+- **One schema, versioned.** Every event is one JSON object with
+  ``schema`` (:data:`TRACE_SCHEMA`), ``kind``, ``t`` (epoch seconds),
+  ``pid``, ``rank``; kinds: ``meta``, ``collective``, ``step``, ``span``,
+  ``dispatch`` (autotune provenance), ``straggler``, ``profile_start`` /
+  ``profile_stop``. ``tools/trace_report.py`` summarizes a JSONL file;
+  :func:`chrome_trace` converts to the ``chrome://tracing`` / Perfetto
+  format.
+
+Enable programmatically (:func:`enable`) or by environment:
+``CHAINERMN_TPU_TRACE=<path.jsonl>`` turns the recorder on at first use
+in any process — which is how ``bench.py``'s child processes and the
+chip-capture path inherit tracing without plumbing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Iterable, Mapping, Optional
+
+#: Version stamped into every event. Bump on any incompatible field
+#: change; consumers (tools/trace_report.py) key on it.
+TRACE_SCHEMA = 1
+
+_ENV_PATH = "CHAINERMN_TPU_TRACE"
+_ENV_SYNC = "CHAINERMN_TPU_TRACE_SYNC"
+
+#: In-memory event cap per recorder — a runaway loop must not eat the
+#: host; overflow increments ``dropped`` (file writes continue).
+MAX_BUFFERED_EVENTS = 200_000
+
+
+def _process_rank() -> int:
+    """Host-plane rank WITHOUT triggering jax backend discovery (the
+    recorder must be usable in processes that never import jax — the
+    bench parent — and before backend init): native-TCP env first, then
+    the jax distributed client state if someone initialised it."""
+    r = os.environ.get("CHAINERMN_TPU_RANK")
+    if r is not None:
+        try:
+            return int(r)
+        except ValueError:
+            pass
+    try:
+        from jax._src import distributed
+
+        state = distributed.global_state
+        if state.client is not None:
+            return int(state.process_id)
+    except Exception:
+        pass
+    return 0
+
+
+class Recorder:
+    """Append-only structured event stream, optionally write-through to
+    a JSONL file (append mode, line-buffered: a crash loses at most the
+    current line). Thread-safe: the trainer's prefetch generator and the
+    main loop may both record."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        *,
+        sync: bool = False,
+        mode: str = "a",
+        meta: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        self.path = path
+        self.sync = sync
+        self.events: list[dict] = []
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._rank = _process_rank()
+        self._file = None
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            self._file = open(path, mode, buffering=1)
+        self.event(
+            "meta",
+            started_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            sync=bool(sync),
+            **dict(meta or {}),
+        )
+
+    # ------------------------------------------------------------------
+
+    def event(self, kind: str, **fields: Any) -> dict:
+        """Record one event; returns the event dict (callers may inspect
+        it in tests). Non-JSON-serialisable field values are repr()'d
+        rather than ever raising out of an instrumentation site."""
+        ev = {
+            "schema": TRACE_SCHEMA,
+            "kind": kind,
+            "t": round(time.time(), 6),
+            "pid": os.getpid(),
+            "rank": self._rank,
+            **fields,
+        }
+        with self._lock:
+            if len(self.events) < MAX_BUFFERED_EVENTS:
+                self.events.append(ev)
+            else:
+                self.dropped += 1
+            if self._file is not None:
+                try:
+                    line = json.dumps(ev)
+                except (TypeError, ValueError):
+                    ev = {k: (v if _jsonable(v) else repr(v))
+                          for k, v in ev.items()}
+                    line = json.dumps(ev)
+                try:
+                    self._file.write(line + "\n")
+                except (OSError, ValueError):
+                    # full disk / closed file must never break training
+                    self._file = None
+        return ev
+
+    def collective(
+        self,
+        op: str,
+        *,
+        nbytes: Optional[int] = None,
+        dur_s: Optional[float] = None,
+        plane: str = "device",
+        wire_dtype: Optional[str] = None,
+        provenance: Optional[dict] = None,
+        **extra: Any,
+    ) -> dict:
+        """One collective-wire counter event. ``provenance`` is the
+        autotune decision record behind an ``'auto'``-resolved
+        configuration (name/winner/source/key), attached so every auto
+        collective in a trace names why it took the path it took."""
+        fields: dict = {"op": op, "plane": plane}
+        if nbytes is not None:
+            fields["nbytes"] = int(nbytes)
+        if dur_s is not None:
+            fields["dur_s"] = round(float(dur_s), 9)
+        if wire_dtype is not None:
+            fields["wire_dtype"] = str(wire_dtype)
+        if provenance is not None:
+            fields["provenance"] = provenance
+        fields.update(extra)
+        return self.event("collective", **fields)
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                except (OSError, ValueError):
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    if self.dropped:
+                        self._file.write(json.dumps({
+                            "schema": TRACE_SCHEMA, "kind": "meta",
+                            "t": round(time.time(), 6),
+                            "pid": os.getpid(), "rank": self._rank,
+                            "dropped_events": self.dropped,
+                        }) + "\n")
+                    self._file.close()
+                except (OSError, ValueError):
+                    pass
+                self._file = None
+
+
+def _jsonable(v: Any) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+# ----------------------------------------------------------------------
+# Global recorder
+# ----------------------------------------------------------------------
+
+_active: Optional[Recorder] = None
+_env_checked = False
+
+
+def enable(
+    path: Optional[str] = None,
+    *,
+    sync: Optional[bool] = None,
+    mode: str = "a",
+    meta: Optional[Mapping[str, Any]] = None,
+) -> Recorder:
+    """Install (and return) the process-global recorder. ``path=None``
+    keeps events in memory only (tests). Replaces any prior recorder
+    (closing its file)."""
+    global _active, _env_checked
+    if sync is None:
+        sync = bool(os.environ.get(_ENV_SYNC))
+    # Construct FIRST: if the path is unwritable this raises with the
+    # previous recorder still installed and functional — never leave a
+    # closed (file-less) recorder as the active one, silently buffering
+    # events nobody will ever see.
+    new = Recorder(path, sync=sync, mode=mode, meta=meta)
+    if _active is not None:
+        _active.close()
+    _env_checked = True
+    _active = new
+    return _active
+
+
+def disable() -> None:
+    """Tear down the global recorder (file closed; events discarded)."""
+    global _active
+    if _active is not None:
+        _active.close()
+        _active = None
+
+
+def active() -> Optional[Recorder]:
+    """The global recorder, or None when tracing is off. First call
+    honours ``CHAINERMN_TPU_TRACE=<path>`` — the env contract that lets
+    subprocesses (bench children, the capture script's stages) inherit
+    tracing."""
+    global _active, _env_checked
+    if _active is None and not _env_checked:
+        _env_checked = True
+        path = os.environ.get(_ENV_PATH)
+        if path:
+            try:
+                enable(path)
+            except OSError:
+                pass  # unwritable path must not break the workload
+    return _active
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "span", **fields: Any):
+    """Timed span event (recorded at exit, with ``dur_s`` and ``ok``).
+    Yields a mutable dict merged into the event — callers may attach
+    results discovered inside the block. No-op when tracing is off."""
+    rec = active()
+    if rec is None:
+        yield {}
+        return
+    extra: dict = {}
+    t0 = time.perf_counter()
+    try:
+        yield extra
+    except BaseException:
+        rec.event(kind, name=name, dur_s=round(time.perf_counter() - t0, 9),
+                  ok=False, **{**fields, **extra})
+        raise
+    rec.event(kind, name=name, dur_s=round(time.perf_counter() - t0, 9),
+              ok=True, **{**fields, **extra})
+
+
+def sync_point(x: Any) -> Any:
+    """Block on ``x`` when the recorder is in sync mode (true wall
+    durations for eager device-plane calls); identity otherwise."""
+    rec = _active
+    if rec is not None and rec.sync:
+        import jax
+
+        jax.block_until_ready(x)
+    return x
+
+
+def tree_nbytes(tree: Any) -> Optional[int]:
+    """Total payload bytes of an array pytree (None when unknowable) —
+    the byte counter behind the wire events. Never raises."""
+    try:
+        import jax
+
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            nb = getattr(leaf, "nbytes", None)
+            if nb is None:
+                import numpy as np
+
+                nb = np.asarray(leaf).nbytes
+            total += int(nb)
+        return total
+    except Exception:
+        return None
+
+
+def obj_nbytes(obj: Any) -> Optional[int]:
+    """Pickled size of a host-plane object payload. Only called when
+    tracing is active (it costs one pickle — host-plane objects are
+    metadata-sized by convention, never gradients)."""
+    try:
+        import pickle
+
+        return len(pickle.dumps(obj, protocol=4))
+    except Exception:
+        return None
+
+
+# ----------------------------------------------------------------------
+# Exports
+# ----------------------------------------------------------------------
+
+def read_jsonl(path: str) -> list[dict]:
+    """Parse a trace JSONL file, skipping unparseable lines (a crashed
+    writer may leave a torn tail)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def chrome_trace(events: Iterable[Mapping[str, Any]]) -> dict:
+    """Convert trace events to the Chrome trace-event format (load in
+    ``chrome://tracing`` or https://ui.perfetto.dev). Events with a
+    duration become complete ('X') slices; instants become 'i' marks.
+    pid = process rank, tid = event kind — one track per subsystem."""
+    out = []
+    for ev in events:
+        kind = ev.get("kind", "?")
+        if kind == "meta":
+            continue
+        dur = ev.get("dur_s")
+        name = ev.get("op") or ev.get("name") or kind
+        ts = float(ev.get("t", 0.0)) * 1e6
+        args = {k: v for k, v in ev.items()
+                if k not in ("kind", "t", "pid", "rank", "schema")}
+        base = {
+            "name": str(name),
+            "cat": kind,
+            "pid": ev.get("rank", 0),
+            "tid": kind,
+            "args": args,
+        }
+        if dur:
+            # 't' stamps event END for spans recorded at exit; chrome
+            # wants the start.
+            out.append({**base, "ph": "X",
+                        "ts": ts - float(dur) * 1e6,
+                        "dur": float(dur) * 1e6})
+        else:
+            out.append({**base, "ph": "i", "ts": ts, "s": "p"})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(jsonl_path: str, out_path: str) -> int:
+    """JSONL trace file -> Chrome trace JSON; returns the event count."""
+    trace = chrome_trace(read_jsonl(jsonl_path))
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    return len(trace["traceEvents"])

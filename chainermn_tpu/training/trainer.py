@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.observability import trace as _trace
 
 PyTree = Any
 
@@ -97,8 +98,24 @@ class Trainer:
         #: :func:`chainermn_tpu.training.prefetch.prefetch_to_device`.
         self.prefetch = prefetch
         self.iteration = 0
+        #: cross-rank aggregated host metrics at the last log point —
+        #: populated on EVERY rank (via :class:`ObservationAggregator`),
+        #: so non-zero ranks can drive extensions off metrics; rank 0
+        #: additionally pretty-prints its LOCAL metrics, unchanged.
         self.observation: dict[str, float] = {}
         self._extensions: list[tuple[int, Callable]] = []
+        # Step-phase window for the observability layer: per-phase
+        # second sums since the last consume_phase_window() (the
+        # straggler monitor's input) + the h2d handoff slot from the
+        # batch generator.
+        self._phase_sums: dict[str, float] = {}
+        self._phase_steps = 0
+        self._h2d_pending = 0.0
+        from chainermn_tpu.extensions.observation_aggregator import (
+            ObservationAggregator,
+        )
+
+        self._obs_agg = ObservationAggregator(comm)
 
     def extend(self, extension: Callable, *, interval: int = 1) -> None:
         self._extensions.append((interval, extension))
@@ -130,9 +147,19 @@ class Trainer:
                 fresh_epoch = True
                 continue
             produced += 1
-            yield host_local_batch_to_global(
-                self.collate(batch), self.comm, self.batch_spec
+            collated = self.collate(batch)
+            # Time the host→device/global-array assembly separately from
+            # the pull (the step-timeline's ``h2d`` phase). ACCUMULATED,
+            # not assigned: with ``prefetch`` on, one loop pull can
+            # drive several assemblies (queue fill) — they all belong to
+            # the step whose data interval paid for them, so the loop
+            # drains the accumulator once per step.
+            t_h2d = time.perf_counter()
+            out = host_local_batch_to_global(
+                collated, self.comm, self.batch_spec
             )
+            self._h2d_pending += time.perf_counter() - t_h2d
+            yield out
 
     def run(self, max_iterations: int) -> Any:
         t0 = time.perf_counter()
@@ -178,15 +205,48 @@ class Trainer:
                     yield jax.device_put(b, sharding) if fits else b
 
             batches = prefetch_to_device(_place(batches), self.prefetch)
-        for collated in batches:
+        it = iter(batches)
+        while True:
+            # --- data-wait: pulling the next collated global batch
+            # (collate + epoch restarts; with prefetch, also the queue
+            # wait). The generator accumulates its h2d sub-spans into
+            # ``_h2d_pending``; draining it here keeps the two phases
+            # disjoint even when one pull runs several assemblies
+            # (prefetch queue fill).
+            self._h2d_pending = 0.0
+            t_data = time.perf_counter()
+            try:
+                collated = next(it)
+            except StopIteration:
+                break
+            h2d = self._h2d_pending
+            data_wait = time.perf_counter() - t_data - h2d
+
+            # --- compute: the jitted step. Dispatch-to-return under
+            # async dispatch; a sync-mode recorder blocks on the metrics
+            # for true wall time (measurement mode — serialises overlap).
+            t_step = time.perf_counter()
             self.state, metrics = self.step_fn(self.state, collated)
+            rec = _trace.active()
+            if rec is not None and rec.sync:
+                jax.block_until_ready(metrics)
+            compute = time.perf_counter() - t_step
             self.iteration += 1
 
+            log_s = 0.0
             if self.iteration % self.log_interval == 0 or self.iteration == max_iterations:
+                t_log = time.perf_counter()
                 host_metrics = {
                     k: float(jax.device_get(v)) for k, v in metrics.items()
                 }
-                self.observation = host_metrics
+                # Cross-rank aggregation so EVERY rank holds the global
+                # metrics (one host collective per log point; all ranks
+                # reach this branch at the same iteration). Rank-0's
+                # pretty-print keeps its LOCAL values, unchanged.
+                agg = self._obs_agg(host_metrics)
+                self.observation = (
+                    agg if agg is not None else dict(host_metrics)
+                )
                 dt = time.perf_counter() - t0
                 rate = self.iteration / dt
                 pretty = " ".join(f"{k}={v:.4f}" for k, v in host_metrics.items())
@@ -194,8 +254,44 @@ class Trainer:
                     f"iter {self.iteration}/{max_iterations} {pretty} "
                     f"({rate:.1f} it/s)"
                 )
+                log_s = time.perf_counter() - t_log
 
+            # Window accumulation BEFORE extensions run, so a straggler
+            # monitor firing as an extension sees this step included.
+            phases = {
+                "data_wait": data_wait,
+                "h2d": h2d,
+                "compute": compute,
+                "logging": log_s,
+            }
+            for k, v in phases.items():
+                self._phase_sums[k] = self._phase_sums.get(k, 0.0) + v
+            self._phase_steps += 1
+
+            t_ext = time.perf_counter()
             for interval, ext in self._extensions:
                 if self.iteration % interval == 0:
                     ext(self)
+            ext_s = time.perf_counter() - t_ext
+            self._phase_sums["extensions"] = (
+                self._phase_sums.get("extensions", 0.0) + ext_s
+            )
+
+            if rec is not None:
+                rec.event(
+                    "step", iteration=self.iteration,
+                    phases={k: round(v, 6)
+                            for k, v in {**phases,
+                                         "extensions": ext_s}.items()},
+                )
         return self.state
+
+    def consume_phase_window(self) -> dict[str, float]:
+        """Mean seconds per step-timeline phase (data_wait / h2d /
+        compute / logging / extensions) since the last call, then reset —
+        the straggler monitor's per-window input. Local, no collective."""
+        n = max(1, self._phase_steps)
+        out = {k: v / n for k, v in self._phase_sums.items()}
+        self._phase_sums = {}
+        self._phase_steps = 0
+        return out

@@ -143,6 +143,17 @@ def _record(name: str, key: str, winner: str, source: str,
         "name": name, "key": key, "winner": winner, "source": source,
         **({"evidence": evidence} if evidence else {}),
     }
+    # Every resolution also lands in the structured trace (when one is
+    # active) as a ``dispatch`` event — the tuning-cache provenance the
+    # observability layer attaches to 'auto' decisions.
+    try:
+        from chainermn_tpu.observability import trace as _trace
+
+        rec = _trace.active()
+        if rec is not None:
+            rec.event("dispatch", **_DECISIONS[(name, key)])
+    except Exception:
+        pass
 
 
 def decisions_taken() -> list:

@@ -25,12 +25,42 @@ import jax
 def profile(logdir: str):
     """Trace everything inside the block into ``logdir`` (view with
     TensorBoard's profile plugin / xprof). Device memory events are part of
-    the standard trace; there is no separate toggle."""
+    the standard trace; there is no separate toggle.
+
+    Start/stop are also recorded into the structured event stream
+    (:mod:`chainermn_tpu.observability`) when a recorder is active, so a
+    JSONL trace shows where the xprof window sat in the step timeline.
+    A ``stop_trace`` failure while the block itself is raising must not
+    MASK the block's exception (the old bare ``finally`` did exactly
+    that); when the block succeeds, a stop failure propagates — the
+    trace really wasn't written."""
+    import time as _time
+
+    from chainermn_tpu.observability import trace as _trace
+
+    rec = _trace.active()
+    t0 = _time.perf_counter()
+    if rec is not None:
+        rec.event("profile_start", logdir=str(logdir))
     jax.profiler.start_trace(logdir)
     try:
         yield
-    finally:
+    except BaseException:
+        # The block's own exception is in flight: a failing stop_trace
+        # is secondary evidence, not the error the caller needs.
+        try:
+            jax.profiler.stop_trace()
+        except Exception as stop_err:
+            if rec is not None:
+                rec.event("profile_stop_error",
+                          error=f"{type(stop_err).__name__}: {stop_err}")
+        raise
+    else:
         jax.profiler.stop_trace()
+    finally:
+        if rec is not None:
+            rec.event("profile_stop", logdir=str(logdir),
+                      dur_s=round(_time.perf_counter() - t0, 9))
 
 
 def annotate(name: str):
@@ -79,12 +109,17 @@ def assert_same_on_all_hosts(value: Any, name: str = "value") -> None:
         arr = np.asarray([value], dtype=np.float64)
         multihost_utils.assert_equal(arr, f"chainermn_tpu:{name}")
         return
-    # Generic objects: compare a stable hash.
+    # Generic objects: compare a stable hash. int32 words, NOT int64:
+    # the comparison value round-trips through a device broadcast, and
+    # under the default x64-off config jax canonicalises int64 -> int32
+    # with silent truncation — the receiving side would then compare its
+    # full 64-bit words against truncated ones and "divergence"-fail on
+    # AGREEING hosts (caught by tests/mp_worker.py case_assert_same).
     import hashlib
     import pickle
 
     digest = hashlib.sha256(
         pickle.dumps(value, protocol=4)
     ).digest()[:8]
-    arr = np.frombuffer(digest, dtype=np.int64).copy()
+    arr = np.frombuffer(digest, dtype=np.int32).copy()
     multihost_utils.assert_equal(arr, f"chainermn_tpu:{name}")

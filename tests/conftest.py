@@ -37,6 +37,13 @@ os.environ.setdefault(
     os.path.join(tempfile.gettempdir(), f"autotune_test_{os.getpid()}.json"),
 )
 
+# Same hermeticity rule for the observability recorder: a developer
+# shell (or a capture-script run) exporting CHAINERMN_TPU_TRACE must not
+# make the suite write trace files — tests that need a recorder enable
+# one explicitly (tests/test_trace.py).
+os.environ.pop("CHAINERMN_TPU_TRACE", None)
+os.environ.pop("CHAINERMN_TPU_TRACE_SYNC", None)
+
 # The suite is CPU-mesh-only by design, but an externally injected
 # accelerator-plugin shim (sitecustomize on PYTHONPATH) can HANG jax
 # backend discovery outright when its tunnel is dead — observed live in

@@ -1004,6 +1004,43 @@ def case_async_double_buffer():
     assert job_speedup > 1.1, (sync_s, async_s, totals)
 
 
+def case_assert_same():
+    """``assert_same_on_all_hosts``'s generic-object (pickle-hash) path
+    under REAL processes (ISSUE 2 satellite): agreement passes for the
+    scalar AND object branches, and a deliberately divergent object
+    RAISES promptly instead of hanging at the next collective."""
+    from chainermn_tpu.utils.observability import assert_same_on_all_hosts
+
+    # scalar branch + generic-object (pickle-hash) branch, agreeing
+    assert_same_on_all_hosts(5, "resume-step")
+    assert_same_on_all_hosts(
+        {"batch_spec": (8, 224, 224, 3), "tag": "fingerprint"},
+        "program-shape",
+    )
+
+    # Deliberate divergence: each rank hashes a DIFFERENT object. The
+    # comparison is against the broadcast root value, so every rank
+    # whose value differs from rank 0's must raise; rank 0 itself
+    # compares equal by construction and may pass. Either way nothing
+    # may hang — the broadcast completes on all ranks before comparing.
+    raised = False
+    try:
+        assert_same_on_all_hosts({"resume_step": RANK}, "divergence-drill")
+    except AssertionError:
+        raised = True
+    print(f"MP_ASSERT_RAISED={raised}", flush=True)
+    if RANK != 0:
+        assert raised, (
+            "divergent object did not raise on a non-root rank — the "
+            "silent-hang failure mode assert_same_on_all_hosts exists "
+            "to prevent"
+        )
+
+    # The world must still be usable after the caught divergence (the
+    # collectives stayed balanced): one more agreeing check.
+    assert_same_on_all_hosts({"ok": True}, "post-divergence")
+
+
 CASES = {
     name[len("case_"):]: fn
     for name, fn in list(globals().items())

@@ -148,6 +148,8 @@ def test_degenerate_tail_skips_accel_child_not_the_reserve(monkeypatch,
                         str(tmp_path / "details.json"))
     monkeypatch.setattr(bench, "_LAST_TPU_CACHE",
                         str(tmp_path / "none.json"))
+    # main() truncates the trace artifact — keep that out of the repo
+    monkeypatch.setattr(bench, "_TRACE_PATH", str(tmp_path / "t.jsonl"))
     monkeypatch.setattr(bench, "TOTAL_BUDGET",
                         bench.CPU_BENCH_RESERVE + 50)
     monkeypatch.setattr(

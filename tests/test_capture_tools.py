@@ -107,6 +107,145 @@ def test_watch_capture_counter_persists_across_restarts():
     assert before < src.index("on_chip_capture.sh")
 
 
+def _golden_trace_lines():
+    """A small fixed trace: meta + auto/explicit collectives + steps +
+    dispatch + straggler + one torn line (crashed-writer tail)."""
+    import json as _json
+
+    evs = [
+        {"schema": 1, "kind": "meta", "t": 1.0, "pid": 1, "rank": 0,
+         "started_at": "2026-08-03T00:00:00Z", "sync": False,
+         "source": "bench"},
+        {"schema": 1, "kind": "collective", "t": 1.1, "pid": 1, "rank": 0,
+         "op": "allreduce_grad", "plane": "device", "nbytes": 1000,
+         "dur_s": 0.002, "wire_dtype": "bfloat16", "size": 8,
+         "device": "cpu",
+         "provenance": {"name": "allreduce_wire", "winner": "bf16",
+                        "source": "table", "key": "cpu|8|grad"}},
+        {"schema": 1, "kind": "collective", "t": 1.2, "pid": 1, "rank": 0,
+         "op": "allreduce_grad", "plane": "device", "nbytes": 1000,
+         "dur_s": 0.002, "wire_dtype": "bfloat16", "size": 8,
+         "device": "cpu"},
+        {"schema": 1, "kind": "collective", "t": 1.3, "pid": 1, "rank": 0,
+         "op": "bcast_obj", "plane": "host", "nbytes": 64,
+         "dur_s": 0.0005, "size": 2},
+        {"schema": 1, "kind": "step", "t": 1.4, "pid": 1, "rank": 0,
+         "iteration": 1,
+         "phases": {"data_wait": 0.001, "compute": 0.01,
+                    "logging": 0.0}},
+        {"schema": 1, "kind": "step", "t": 1.5, "pid": 1, "rank": 0,
+         "iteration": 2,
+         "phases": {"data_wait": 0.003, "compute": 0.02,
+                    "logging": 0.001}},
+        {"schema": 1, "kind": "dispatch", "t": 1.6, "pid": 1, "rank": 0,
+         "name": "allreduce_wire", "key": "cpu|8|grad", "winner": "bf16",
+         "source": "table"},
+        {"schema": 1, "kind": "straggler", "t": 1.7, "pid": 1, "rank": 0,
+         "flagged_ranks": [3],
+         "phases": {"compute": {"median_s": 0.01, "worst_rank": 3,
+                                "worst_rel_dev": 0.8, "flagged": [3]}}},
+    ]
+    return [_json.dumps(e) for e in evs] + ['{"torn']
+
+
+def test_trace_report_contract(tmp_path):
+    """Golden JSONL in -> stable summary out (ISSUE 2 satellite): the
+    machine-readable contract downstream consumers (capture logs,
+    future dashboards) parse. Full-dict equality so a field rename or
+    rounding change is a DELIBERATE contract bump, not drift."""
+    import json as _json
+    import sys
+
+    trace_file = tmp_path / "trace.jsonl"
+    trace_file.write_text("\n".join(_golden_trace_lines()) + "\n")
+    chrome_file = tmp_path / "chrome.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         str(trace_file), "--json", "--chrome", str(chrome_file)],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    summary = _json.loads(proc.stdout)
+    assert summary == {
+        "schema_versions": [1],
+        "meta": {"started_at": "2026-08-03T00:00:00Z", "sync": False,
+                 "source": "bench"},
+        "n_events": 8,  # torn tail line skipped, not fatal
+        "collectives": [
+            {"op": "allreduce_grad", "plane": "device", "n": 2,
+             "total_bytes": 2000, "total_s": 0.004, "mean_ms": 2.0,
+             "wire_dtypes": ["bfloat16"], "auto_events": 1,
+             "gbps": 0.0005},  # 2000 B / 4 ms
+            {"op": "bcast_obj", "plane": "host", "n": 1,
+             "total_bytes": 64, "total_s": 0.0005, "mean_ms": 0.5,
+             "wire_dtypes": [], "auto_events": 0, "gbps": 0.000128},
+        ],
+        "steps": {"n": 2, "phases": {
+            "compute": {"mean_ms": 15.0, "max_ms": 20.0, "n": 2},
+            "data_wait": {"mean_ms": 2.0, "max_ms": 3.0, "n": 2},
+            "logging": {"mean_ms": 0.5, "max_ms": 1.0, "n": 2},
+        }},
+        "dispatch": [{"name": "allreduce_wire", "key": "cpu|8|grad",
+                      "winner": "bf16", "source": "table"}],
+        "packs": [],
+        "stragglers": [{"flagged_ranks": [3], "phases": {
+            "compute": {"median_s": 0.01, "worst_rank": 3,
+                        "worst_rel_dev": 0.8, "flagged": [3]}}}],
+    }, summary
+    # chrome export emitted alongside
+    chrome = _json.loads(chrome_file.read_text())
+    assert len(chrome["traceEvents"]) == 7  # meta excluded
+    # and the human rendering mentions the essentials
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         str(trace_file)],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc2.returncode == 0
+    for token in ("allreduce_grad", "STRAGGLER", "allreduce_wire=bf16"):
+        assert token in proc2.stdout, (token, proc2.stdout)
+
+
+def test_trace_report_roofline_scoped_to_device_plane(tmp_path):
+    """Roofline floors apply only to device-plane ops, against the
+    device kinds they actually ran on — a host-plane pickle transfer
+    has no HBM roofline, and a mixed cpu+TPU trace (bench's accel child
+    + cpu fallback in one file) must not cross-product (code-review
+    finding)."""
+    import json as _json
+    import sys
+
+    evs = [
+        {"schema": 1, "kind": "collective", "t": 1.0, "pid": 1,
+         "rank": 0, "op": "allreduce", "plane": "device",
+         "nbytes": 1 << 30, "dur_s": 0.01, "size": 8,
+         "device": "TPU v5 lite"},
+        {"schema": 1, "kind": "collective", "t": 1.1, "pid": 1,
+         "rank": 0, "op": "bcast", "plane": "device",
+         "nbytes": 1 << 20, "dur_s": 0.001, "size": 8, "device": "cpu"},
+        {"schema": 1, "kind": "collective", "t": 1.2, "pid": 1,
+         "rank": 0, "op": "bcast_obj", "plane": "host", "nbytes": 4096,
+         "dur_s": 0.001, "size": 2},
+    ]
+    trace_file = tmp_path / "trace.jsonl"
+    trace_file.write_text("\n".join(_json.dumps(e) for e in evs) + "\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         str(trace_file), "--json"],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    summary = _json.loads(proc.stdout)
+    floors = summary.get("roofline", [])
+    # only the TPU-device op gets a floor, only under ITS device kind
+    assert [(f["op"], f["device"]) for f in floors] == [
+        ("allreduce", "TPU v5 lite")
+    ], floors
+    assert floors[0]["hbm_peak_gbps"] == 819.0  # v5e table via bench
+    # no internal bookkeeping leaks into the contract
+    assert all("_devices" not in c for c in summary["collectives"])
+
+
 def test_missing_marker_is_never_fresh(capture_root):
     logs = capture_root / "tools" / "capture_logs"
     (logs / "bench_2.log").write_text('{"source": "live"}\n')

@@ -56,6 +56,19 @@ def test_mp_scaling_rehearsal():
         assert int(kv["inter"]) == 4 and int(kv["intra"]) == 2
 
 
+def test_mp_assert_same_on_all_hosts():
+    """The pickle-hash (generic-object) branch of
+    ``assert_same_on_all_hosts`` with real processes, including the
+    deliberate-divergence drill: divergence must RAISE (on every rank
+    that differs from the root) rather than hang — ISSUE 2 satellite."""
+    outs = run_workers("assert_same", n_procs=2)
+    flags = [ln for o in outs for ln in (o or "").splitlines()
+             if ln.startswith("MP_ASSERT_RAISED=")]
+    assert len(flags) == 2, outs
+    # at least the non-root rank saw the divergence as an error
+    assert "MP_ASSERT_RAISED=True" in "\n".join(flags), flags
+
+
 def test_mp_checkpoint_agreement(tmp_path):
     run_workers(
         "checkpoint", n_procs=2, extra_env={"MP_CKPT_DIR": str(tmp_path)}

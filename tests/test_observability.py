@@ -79,6 +79,55 @@ def test_annotate_and_profile(tmp_path):
     assert written, "profile() wrote no trace files"
 
 
+def test_profile_records_into_event_stream(tmp_path):
+    """ISSUE 2 satellite: profile() start/stop land in the structured
+    trace so a JSONL shows where the xprof window sat in the timeline."""
+    from chainermn_tpu.observability import trace as obs_trace
+
+    rec = obs_trace.enable(None)
+    try:
+        with profile(str(tmp_path / "trace")):
+            jnp.ones((2,)).block_until_ready()
+        kinds = [e["kind"] for e in rec.events]
+        assert "profile_start" in kinds and "profile_stop" in kinds
+        stop = next(e for e in rec.events if e["kind"] == "profile_stop")
+        assert stop["dur_s"] >= 0
+    finally:
+        obs_trace.disable()
+
+
+def test_profile_stop_failure_does_not_mask_block_exception(monkeypatch):
+    """ISSUE 2 satellite: the old bare ``finally: stop_trace()`` masked
+    the block's own exception when stop_trace ALSO failed (the usual
+    case — a dead backend kills both). The block's error must win."""
+    calls = []
+
+    def failing_stop():
+        calls.append("stop")
+        raise RuntimeError("profiler teardown broke")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", failing_stop)
+    with pytest.raises(ValueError, match="the real failure"):
+        with profile("/tmp/nowhere"):
+            raise ValueError("the real failure")
+    assert calls == ["stop"]  # stop WAS attempted, its failure swallowed
+
+
+def test_profile_stop_failure_propagates_when_block_succeeds(monkeypatch):
+    """No block exception in flight -> a stop_trace failure is the
+    caller's signal that the trace was NOT written; it must propagate."""
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+
+    def failing_stop():
+        raise RuntimeError("no trace written")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", failing_stop)
+    with pytest.raises(RuntimeError, match="no trace written"):
+        with profile("/tmp/nowhere"):
+            pass
+
+
 def test_global_except_hook_formats_and_preserves_process(capsys):
     """Single-process: the hook prints the rank-tagged traceback and does
     NOT hard-exit (teardown is only for multi-process worlds)."""

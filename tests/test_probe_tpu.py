@@ -131,6 +131,23 @@ def test_tail_records_and_latest(log_dir, monkeypatch):
     assert probe_tpu.latest_record()["verdict"] == "relay_down"
 
 
+def test_probe_records_carry_schema_version(log_dir, monkeypatch):
+    """ISSUE 2 satellite: every probes.jsonl record names its schema
+    version so future consumers can evolve the format safely (records
+    predating the field are implicitly version 0)."""
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free = s.getsockname()[1]
+    monkeypatch.setattr(probe_tpu, "RELAY_PORTS", (free,))
+    rec = probe_tpu.probe(5)
+    assert rec["schema"] == probe_tpu.PROBE_SCHEMA == 1
+    persisted = json.loads(
+        open(os.path.join(str(log_dir), "probes.jsonl")).readlines()[-1]
+    )
+    assert persisted["schema"] == probe_tpu.PROBE_SCHEMA
+
+
 def test_log_write_failure_never_vetoes_the_result(monkeypatch):
     """The diagnostic side channel is best-effort: an unwritable log dir
     must not turn a chip_up into an exception (code-review finding)."""

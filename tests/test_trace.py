@@ -1,0 +1,526 @@
+"""The structured trace/metrics subsystem (ISSUE 2):
+
+- recorder mechanics (events, spans, JSONL round-trip, Chrome export,
+  env enablement, overhead-off contract);
+- collective-wire counters on the communicator surface, with tuning
+  provenance on 'auto'-resolved wires;
+- the STRUCTURAL guarantee: instrumentation adds ZERO device-plane
+  collectives (the repo's ppermute-count convention) and does not
+  perturb numerics (dist==single equivalence with the recorder on);
+- the Trainer step timeline and the straggler monitor.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu import create_communicator
+from chainermn_tpu.observability import StragglerMonitor, trace
+
+
+@pytest.fixture(autouse=True)
+def _isolated_recorder():
+    """Every test starts and ends with tracing OFF — the global recorder
+    must never leak into the rest of the suite."""
+    trace.disable()
+    yield
+    trace.disable()
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+# ----------------------------------------------------------------------
+# Recorder mechanics
+# ----------------------------------------------------------------------
+
+
+def test_disabled_recorder_is_inert(comm):
+    assert trace.active() is None
+    # instrumented calls run identically with tracing off
+    out = comm.allreduce(jnp.ones((comm.size, 2)))
+    assert out.shape == (2,)
+    assert trace.active() is None
+
+
+def test_event_schema_and_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    rec = trace.enable(path, meta={"source": "test"})
+    rec.event("step", iteration=3, phases={"compute": 0.01})
+    rec.collective("allreduce", nbytes=128, dur_s=0.002, wire_dtype="bf16")
+    rec.flush()
+    events = trace.read_jsonl(path)
+    assert [e["kind"] for e in events] == ["meta", "step", "collective"]
+    for e in events:
+        assert e["schema"] == trace.TRACE_SCHEMA
+        assert {"t", "pid", "rank"} <= set(e)
+    assert events[0]["source"] == "test"
+    assert events[2]["nbytes"] == 128 and events[2]["wire_dtype"] == "bf16"
+
+
+def test_span_records_duration_and_failure(tmp_path):
+    rec = trace.enable(None)
+    with trace.span("phase-a") as extra:
+        extra["rows"] = 3
+    with pytest.raises(ValueError):
+        with trace.span("phase-b"):
+            raise ValueError("boom")
+    spans = [e for e in rec.events if e["kind"] == "span"]
+    assert [s["name"] for s in spans] == ["phase-a", "phase-b"]
+    assert spans[0]["ok"] is True and spans[0]["rows"] == 3
+    assert spans[1]["ok"] is False
+    assert all(s["dur_s"] >= 0 for s in spans)
+
+
+def test_unserialisable_field_degrades_to_repr(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    rec = trace.enable(path)
+    rec.event("step", weird=object())
+    rec.flush()
+    events = trace.read_jsonl(path)
+    assert len(events) == 2 and "object object" in events[1]["weird"]
+
+
+def test_env_var_enables_on_first_use(tmp_path, monkeypatch):
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("CHAINERMN_TPU_TRACE", path)
+    monkeypatch.setattr(trace, "_active", None)
+    monkeypatch.setattr(trace, "_env_checked", False)
+    rec = trace.active()
+    assert rec is not None and rec.path == path
+    rec.flush()
+    assert trace.read_jsonl(path)[0]["kind"] == "meta"
+
+
+def test_enable_failure_keeps_prior_recorder_alive(tmp_path):
+    """A failing enable() (unwritable path) must raise WITHOUT
+    replacing the working recorder with a closed one — otherwise every
+    later instrumentation site pays full cost buffering events that are
+    never written (code-review finding)."""
+    rec = trace.enable(None)
+    with pytest.raises(OSError):
+        trace.enable("/proc/definitely/not/writable/t.jsonl")
+    assert trace.active() is rec
+    rec.event("step", still="alive")
+    assert rec.events[-1]["still"] == "alive"
+
+
+def test_chrome_trace_export(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    rec = trace.enable(path)
+    rec.collective("allreduce", nbytes=64, dur_s=0.001)
+    rec.event("straggler", flagged_ranks=[1])
+    rec.flush()
+    out = str(tmp_path / "chrome.json")
+    n = trace.write_chrome_trace(path, out)
+    assert n == 2  # meta excluded
+    ct = json.load(open(out))
+    phs = {e["ph"] for e in ct["traceEvents"]}
+    assert phs == {"X", "i"}  # duration slice + instant
+    slice_ = next(e for e in ct["traceEvents"] if e["ph"] == "X")
+    assert slice_["dur"] == pytest.approx(1000.0)  # 1 ms in us
+
+
+# ----------------------------------------------------------------------
+# Collective-wire counters
+# ----------------------------------------------------------------------
+
+
+def test_wire_counters_cover_the_collective_surface(comm):
+    rec = trace.enable(None)
+    n = comm.size
+    comm.allreduce(jnp.ones((n, 4)))
+    comm.bcast(jnp.ones((3,)))
+    comm.allgather(jnp.ones((n, 2)))
+    comm.alltoall(jnp.ones((n, n, 2)))
+    comm.scatter(jnp.ones((n, 2)))
+    comm.bcast_data({"w": jnp.ones((5,))})
+    comm.allreduce_grad({"w": jnp.ones((n, 5))})
+    comm.bcast_obj({"meta": 1})
+    comm.allgather_obj(7)
+    comm.barrier()
+    ops = [e["op"] for e in rec.events if e["kind"] == "collective"]
+    for op in ("allreduce", "bcast", "allgather", "alltoall", "scatter",
+               "bcast_data", "allreduce_grad", "bcast_obj",
+               "allgather_obj", "barrier"):
+        assert op in ops, (op, ops)
+    for e in rec.events:
+        if e["kind"] != "collective":
+            continue
+        assert e["dur_s"] >= 0
+        assert e["size"] == (comm.host.size if e["plane"] == "host" else n)
+    ar = next(e for e in rec.events if e.get("op") == "allreduce")
+    assert ar["nbytes"] == n * 4 * 4  # [n, 4] f32
+    assert ar["plane"] == "device" and "device" in ar
+    # bcast_obj measures the RESULT (the broadcast payload lands on
+    # every rank; the argument is None on non-root ranks by convention)
+    bo = next(e for e in rec.events if e.get("op") == "bcast_obj")
+    import pickle
+
+    assert bo["nbytes"] == len(pickle.dumps({"meta": 1}, protocol=4))
+
+
+def test_auto_wire_event_carries_tuning_provenance():
+    rec = trace.enable(None)
+    comm = create_communicator("naive", allreduce_grad_dtype="auto")
+    comm.allreduce_grad({"g": jnp.ones((comm.size, 3))})
+    ev = [e for e in rec.events if e.get("op") == "allreduce_grad"]
+    assert len(ev) == 1
+    prov = ev[0]["provenance"]
+    # the registry record behind the 'auto' resolution, verbatim
+    assert prov["name"] == "allreduce_wire"
+    assert prov["winner"] in ("f32", "bf16", "int8")
+    assert "source" in prov and "key" in prov
+    assert ev[0]["wire_dtype"] in ("float32", "bfloat16", "int8")
+    # the registry ALSO logged the resolution as a dispatch event
+    disp = [e for e in rec.events if e["kind"] == "dispatch"]
+    assert any(d["name"] == "allreduce_wire" for d in disp)
+
+
+def test_explicit_wire_has_no_provenance(comm):
+    rec = trace.enable(None)
+    comm2 = create_communicator(
+        "naive", allreduce_grad_dtype=jnp.bfloat16
+    )
+    comm2.allreduce_grad({"g": jnp.ones((comm2.size, 3))})
+    ev = [e for e in rec.events if e.get("op") == "allreduce_grad"]
+    assert ev and "provenance" not in ev[0]
+    assert ev[0]["wire_dtype"] == "bfloat16"
+
+
+def test_p2p_send_recv_events(comm):
+    rec = trace.enable(None)
+    comm.send(np.arange(6, dtype=np.float32), dest=0, tag=9)
+    got = comm.recv(source=0, tag=9)
+    np.testing.assert_array_equal(got, np.arange(6, dtype=np.float32))
+    ops = {e["op"]: e for e in rec.events if e["kind"] == "collective"}
+    assert ops["send"]["nbytes"] == 24 and ops["send"]["dest"] == 0
+    assert ops["recv"]["nbytes"] == 24 and ops["recv"]["source"] == 0
+
+
+# ----------------------------------------------------------------------
+# Structural: zero added device-plane collectives, numerics untouched
+# ----------------------------------------------------------------------
+
+
+def _two_dim_comm():
+    from jax.sharding import Mesh
+
+    from chainermn_tpu.communicators.xla_communicator import (
+        TwoDimensionalCommunicator,
+    )
+
+    devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    return TwoDimensionalCommunicator(mesh=Mesh(devs, ("inter", "intra")))
+
+
+def test_recorder_adds_zero_device_collectives():
+    """The ppermute-count certificate (ISSUE 2 acceptance): the traced
+    program of an instrumented gradient reduction is IDENTICAL with the
+    recorder on and off — instrumentation is host-side timestamps only,
+    so no primitive (collective or otherwise) is added or removed."""
+    from chainermn_tpu.testing import count_primitives
+
+    comm = _two_dim_comm()
+    tree = {"w": jnp.zeros((64, 32)), "b": jnp.zeros((32,))}
+    env = [("inter", 2), ("intra", 4)]
+
+    def counts():
+        return count_primitives(
+            lambda t: comm.reduce_gradients_in_jit(
+                t, compress_dtype=jnp.bfloat16
+            ),
+            tree, axis_env=env,
+        )
+
+    off = counts()
+    trace.enable(None)
+    on = counts()
+    assert on == off
+    # the reduction pipeline really is in there (not vacuous equality)
+    assert on.get("reduce_scatter") == 1
+    assert on.get("psum") == 1
+    assert on.get("all_gather") == 1
+
+
+def test_pack_event_records_bucket_layout_at_trace_time():
+    """The in-jit bucketed reduction can't time itself host-side, but it
+    CAN record — once per compilation trace — the pack layout and the
+    bucket decision's provenance."""
+    comm = _two_dim_comm()
+    rec = trace.enable(None)
+    from chainermn_tpu.testing import count_primitives
+
+    tree = {"w": jnp.zeros((64, 32)), "b": jnp.zeros((32,))}
+    count_primitives(
+        lambda t: comm.reduce_gradients_in_jit(
+            t, compress_dtype=jnp.bfloat16
+        ),
+        tree, axis_env=[("inter", 2), ("intra", 4)],
+    )
+    packs = [e for e in rec.events if e["kind"] == "pack"]
+    assert len(packs) == 1
+    p = packs[0]
+    assert p["n_buckets"] == 1
+    assert p["wire_dtype"] == "bfloat16"
+    assert p["nbytes"] == (64 * 32 + 32) * 2  # bf16 bytes on the wire
+    assert p["bucket_bytes"] >= 16 << 20
+
+    # int8 wire: floats PACK in f32 but cross the inter wire at
+    # 1 byte/elem — nbytes must describe the named wire, not the pack
+    # staging dtype (code-review finding: a 4x overstatement).
+    count_primitives(
+        lambda t: comm.reduce_gradients_in_jit(t, compress_dtype=jnp.int8),
+        tree, axis_env=[("inter", 2), ("intra", 4)],
+    )
+    p8 = [e for e in rec.events if e["kind"] == "pack"][-1]
+    assert p8["wire_dtype"] == "int8"
+    assert p8["nbytes"] == 64 * 32 + 32
+
+
+def test_instrumented_hlo_collective_counts(comm):
+    """Compiled-module certificate: a shard_map'd gradient reduction
+    compiled WITH the recorder active shows exactly the expected
+    collectives — one reduce-scatter, one all-reduce, one all-gather for
+    the packed two-level pipeline (same counts the uninstrumented test
+    in test_communicator.py pins)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    trace.enable(None)
+    comm2 = _two_dim_comm()
+    tree = {"w": jnp.ones((8, 16, 8)), "b": jnp.ones((8, 8))}
+
+    def local(t):
+        sq = jax.tree.map(lambda l: l[0], t)
+        out = comm2.reduce_gradients_in_jit(sq, compress_dtype=jnp.bfloat16)
+        return jax.tree.map(lambda l: l[None], out)
+
+    spec = jax.tree.map(
+        lambda l: P(("inter", "intra"), *([None] * (l.ndim - 1))), tree
+    )
+    f = jax.jit(shard_map(
+        local, mesh=comm2.mesh, in_specs=(spec,), out_specs=spec,
+        check_vma=False,
+    ))
+    txt = f.lower(tree).compile().as_text()
+    counts = {op: txt.count(op) for op in
+              ("reduce-scatter(", "all-gather(", "all-reduce(")}
+    assert counts == {
+        "reduce-scatter(": 1, "all-gather(": 1, "all-reduce(": 1
+    }, counts
+
+
+def test_dist_equals_single_with_recorder_enabled(comm):
+    """The suite's core invariant survives instrumentation: values AND
+    gradients agree between the distributed step and its single-device
+    equivalent while the recorder is running, and a recorder-on run is
+    bit-identical to a recorder-off run."""
+    import optax
+
+    from chainermn_tpu import create_multi_node_optimizer
+    from chainermn_tpu.models import MLP
+    from chainermn_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    # Eager value equivalence: stacked allreduce_grad == numpy mean.
+    trace.enable(None)
+    rs = np.random.RandomState(3)
+    stacked = {"w": jnp.asarray(rs.randn(comm.size, 3, 2), jnp.float32)}
+    out = comm.allreduce_grad(stacked)
+    np.testing.assert_allclose(
+        np.asarray(out["w"]), np.asarray(stacked["w"]).mean(0),
+        rtol=1e-6, atol=1e-6,
+    )
+
+    # Gradient path: identical training trajectories recorder-on vs
+    # recorder-off, and dist == single-slot on the same global batch.
+    model = MLP(n_units=8, n_out=3)
+    x = jnp.asarray(rs.randn(16, 5), jnp.float32)
+    y = jnp.asarray(np.arange(16) % 3, jnp.int32)
+    params = model.init(jax.random.key(0), x[:1])["params"]
+
+    def loss_fn(p, batch):
+        import optax as _o
+
+        xb, yb = batch
+        return _o.softmax_cross_entropy_with_integer_labels(
+            model.apply({"params": p}, xb), yb
+        ).mean()
+
+    def run(c):
+        opt = create_multi_node_optimizer(optax.sgd(0.1), c)
+        state = create_train_state(params, opt, c)
+        step = make_train_step(loss_fn, opt, c, donate=False)
+        for _ in range(2):
+            state, m = step(state, (x, y))
+        return jax.tree.leaves(jax.device_get(state.params)), float(m["loss"])
+
+    on_leaves, on_loss = run(comm)
+    single_leaves, single_loss = run(comm.sub_communicator([0]))
+    trace.disable()
+    off_leaves, off_loss = run(comm)
+
+    for a, b in zip(on_leaves, off_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert on_loss == off_loss
+    for a, b in zip(on_leaves, single_leaves):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert abs(on_loss - single_loss) < 1e-6
+
+
+# ----------------------------------------------------------------------
+# Trainer step timeline + straggler monitor
+# ----------------------------------------------------------------------
+
+
+def _tiny_trainer(comm, n_batches=6, log_interval=2):
+    from chainermn_tpu.training.trainer import Trainer
+
+    def step_fn(state, batch):
+        xb, _ = batch
+        return state + 1, {"loss": jnp.mean(xb) + state}
+
+    data = [
+        [(np.ones((4,), np.float32), np.int32(0)) for _ in range(8)]
+        for _ in range(n_batches)
+    ]
+
+    class It:
+        def __iter__(self):
+            return iter(data)
+
+    return Trainer(step_fn, jnp.float32(0), It(), comm,
+                   log_interval=log_interval, out=open(os.devnull, "w"))
+
+
+def test_trainer_emits_step_timeline(comm):
+    rec = trace.enable(None)
+    tr = _tiny_trainer(comm)
+    tr.run(6)
+    steps = [e for e in rec.events if e["kind"] == "step"]
+    assert [s["iteration"] for s in steps] == [1, 2, 3, 4, 5, 6]
+    for s in steps:
+        assert set(s["phases"]) == {
+            "data_wait", "h2d", "compute", "logging", "extensions"
+        }
+        assert all(v >= 0 for v in s["phases"].values())
+    # logging fires only on the log interval
+    assert steps[0]["phases"]["logging"] == 0.0
+    assert steps[1]["phases"]["logging"] > 0.0
+
+
+def test_trainer_observation_on_every_rank_via_aggregator(comm):
+    """ISSUE 2 satellite: ``trainer.observation`` is the aggregated
+    host-metrics dict (ObservationAggregator — on a single process the
+    aggregate equals the local mean), populated at every log point,
+    while rank-0 printing is unchanged."""
+    import io
+
+    from chainermn_tpu.training.trainer import Trainer
+
+    def step_fn(state, batch):
+        return state + 1, {"loss": jnp.float32(2.5)}
+
+    data = [[(np.zeros((2,), np.float32), np.int32(0))] for _ in range(4)]
+
+    class It:
+        def __iter__(self):
+            return iter(data)
+
+    buf = io.StringIO()
+    tr = Trainer(step_fn, jnp.float32(0), It(), comm, log_interval=2,
+                 out=buf)
+    tr.run(4)
+    assert tr.observation == {"loss": 2.5}
+    printed = buf.getvalue()
+    assert "loss=2.5000" in printed  # rank-0 pretty print unchanged
+
+
+def test_trainer_sync_mode_blocks_for_true_compute(comm, monkeypatch):
+    rec = trace.enable(None, sync=True)
+    assert rec.sync
+    tr = _tiny_trainer(comm, n_batches=2)
+    tr.run(2)
+    steps = [e for e in rec.events if e["kind"] == "step"]
+    assert len(steps) == 2  # loop completed under sync mode
+
+
+def test_consume_phase_window_resets(comm):
+    tr = _tiny_trainer(comm, n_batches=3, log_interval=10)
+    tr.run(3)
+    win = tr.consume_phase_window()
+    assert win["compute"] > 0
+    assert set(win) == {"data_wait", "h2d", "compute", "logging",
+                        "extensions"}
+    again = tr.consume_phase_window()
+    assert again == {}
+
+
+def test_observation_aggregator_flush_per_rank(comm):
+    from chainermn_tpu.extensions.observation_aggregator import (
+        ObservationAggregator,
+    )
+
+    agg = ObservationAggregator(comm)
+    agg.add({"compute": 1.0})
+    agg.add({"compute": 3.0})
+    per_rank = agg.flush_per_rank()
+    assert per_rank == [{"compute": 2.0}]  # single process: one entry
+    assert agg.flush_per_rank() == [{}]  # window cleared
+
+
+def test_straggler_monitor_flags_divergent_rank(comm, capsys):
+    mon = StragglerMonitor(comm, interval=1, threshold=0.3, out=None)
+    rec = trace.enable(None)
+    report = mon.check([
+        {"compute": 0.100, "data_wait": 0.00005},
+        {"compute": 0.180, "data_wait": 0.00005},
+        {"compute": 0.100, "data_wait": 0.00005},
+        {"compute": 0.101, "data_wait": 0.00005},
+    ])
+    assert report["flagged_ranks"] == [1]
+    assert report["phases"]["compute"]["worst_rank"] == 1
+    assert report["phases"]["compute"]["flagged"] == [1]
+    # data_wait is under min_phase_s -> skipped, not flagged as noise
+    assert "data_wait" not in report["phases"]
+    # the flag landed in the trace
+    assert any(e["kind"] == "straggler" for e in rec.events)
+    assert mon.reports and mon.reports[-1] is report
+
+
+def test_straggler_monitor_fast_rank_not_flagged(comm):
+    mon = StragglerMonitor(comm, interval=1, threshold=0.3, out=None)
+    report = mon.check([
+        {"compute": 0.05},  # faster than the pack: not a straggler
+        {"compute": 0.100},
+        {"compute": 0.100},
+    ])
+    assert report["flagged_ranks"] == []
+
+
+def test_straggler_monitor_as_trainer_extension(comm):
+    tr = _tiny_trainer(comm, n_batches=4, log_interval=10)
+    mon = StragglerMonitor(comm, interval=2, out=None).attach(tr)
+    tr.run(4)
+    # single process: exchanges happened (2 windows), nothing flagged
+    assert mon.reports == []
+    # the window was drained by the extension; only the extension-time
+    # accounting that lands AFTER extensions run may remain
+    assert set(tr.consume_phase_window()) <= {"extensions"}
+
+
+def test_straggler_monitor_validates_args(comm):
+    with pytest.raises(ValueError):
+        StragglerMonitor(comm, interval=0)
+    with pytest.raises(ValueError):
+        StragglerMonitor(comm, threshold=0.0)

@@ -25,9 +25,22 @@ _fresh() { fresh_artifact "$1" "$2" "${CAPTURE_SINCE:-}"; }
 if _fresh 'bench_2*.log' '"source": "live"'; then
   echo "[capture $stamp] stage 1: skipped (fresh live bench exists)"
 else
-  echo "[capture $stamp] stage 1: bench.py"
-  timeout 1800 python bench.py > "tools/capture_logs/bench_$stamp.log" 2>&1
+  echo "[capture $stamp] stage 1: bench.py (+ structured trace)"
+  # Observability trace artifact (ISSUE 2): the bench children append
+  # wire/phase events here; the report summarizes per-op bytes/time.
+  CHAINERMN_TPU_TRACE="tools/capture_logs/trace_bench_$stamp.jsonl" \
+    timeout 1800 python bench.py > "tools/capture_logs/bench_$stamp.log" 2>&1
   echo "[capture] bench rc=$? last line:"; tail -1 "tools/capture_logs/bench_$stamp.log" | cut -c1-400
+  if [ -s "tools/capture_logs/trace_bench_$stamp.jsonl" ]; then
+    timeout 300 python tools/trace_report.py \
+      "tools/capture_logs/trace_bench_$stamp.jsonl" \
+      --chrome "tools/capture_logs/trace_bench_$stamp.chrome.json" \
+      > "tools/capture_logs/trace_report_$stamp.txt" 2>&1
+    echo "[capture] trace report rc=$?:"
+    head -3 "tools/capture_logs/trace_report_$stamp.txt"
+  else
+    echo "[capture] no trace emitted (bench wrote no events)"
+  fi
 fi
 
 if _fresh 'byte_audit_tf_2*.json' '"flops":' \

@@ -39,6 +39,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG_DIR = os.path.join(REPO, "tools", "capture_logs")
 RELAY_PORTS = (8082, 8083)
 
+#: probes.jsonl record schema version (records before this field
+#: existed are implicitly version 0).
+PROBE_SCHEMA = 1
+
 _FINGERPRINT_VARS = (
     "JAX_PLATFORMS",
     "PALLAS_AXON_TPU_GEN",
@@ -140,6 +144,11 @@ def probe(init_timeout: float = 180.0) -> dict:
     """Run the staged probe; returns the record (also appended to the
     probes log). Cheap when the relay is down (~2 s, no JAX import)."""
     rec: dict = {
+        # Versioned record shape (ISSUE 2 satellite): consumers
+        # (bench.py's probe trail, chip_watch.sh, future dashboards) key
+        # on this to evolve the format without guessing. Bump on any
+        # incompatible field change.
+        "schema": PROBE_SCHEMA,
         "at": datetime.now(timezone.utc).isoformat(timespec="seconds"),
         "env": _env_fingerprint(),
     }

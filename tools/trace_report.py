@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""Summarize a chainermn_tpu observability trace (JSONL) into per-op
+byte/time tables (ISSUE 2: the consumer side of the wire counters).
+
+Usage::
+
+    python tools/trace_report.py TRACE.jsonl [--json] [--chrome OUT.json]
+
+Sections:
+
+- **collectives** — per (op, plane): count, total payload bytes, total
+  and mean duration, achieved GB/s where both are known, the wire
+  dtypes seen, and how many events carry 'auto' dispatch provenance.
+  ``allreduce_grad`` events SUBSUME their per-leaf ``allreduce``
+  children (nested spans — don't sum the two rows).
+- **steps** — per-phase mean/max milliseconds over the Trainer's
+  step-timeline events (data_wait / h2d / compute / logging /
+  extensions).
+- **dispatch** — every autotune decision the traced processes resolved
+  (name=winner(source), keyed).
+- **stragglers** — flagged divergence reports, if any.
+- **roofline** — where a device kind with a known HBM peak appears
+  (bench.py's per-kind tables, the same floors tools/byte_audit.py
+  uses), collective GB/s is floored against it: an eager-plane number
+  near the HBM peak is copy-bound, far below it is latency/dispatch
+  -bound. Skipped silently when bench.py is unimportable.
+
+``--json`` prints the machine-readable summary (the contract tested in
+tests/test_capture_tools.py); default output is a human table.
+``--chrome`` additionally writes a Chrome-trace/Perfetto file.
+
+Durations caveat: device-plane events record dispatch-to-return unless
+the trace was captured with ``CHAINERMN_TPU_TRACE_SYNC=1`` (the meta
+event's ``sync`` field says which); host-plane (obj) events are true
+blocking durations either way. See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
+
+def _trace_mod():
+    """The observability trace module, loaded by FILE PATH: one owner of
+    the JSONL parser and the Chrome exporter (no drift), without paying
+    for ``import chainermn_tpu`` (which pulls jax) in a report tool."""
+    import importlib.util
+
+    path = os.path.join(_HERE, "chainermn_tpu", "observability", "trace.py")
+    spec = importlib.util.spec_from_file_location("_obs_trace", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read_events(path: str) -> list[dict]:
+    return _trace_mod().read_jsonl(path)
+
+
+def _hbm_peak(device_kind: str):
+    """Per-kind HBM peak via bench.py's table (the single place device
+    peaks live — byte_audit.py derives its floors the same way)."""
+    try:
+        import bench
+
+        return bench._peak_lookup(device_kind, bench._PEAK_HBM_BYTES)
+    except Exception:
+        return None
+
+
+def summarize(events: list[dict]) -> dict:
+    """The machine-readable summary: stable keys, deterministic ordering
+    (tests/test_capture_tools.py pins this contract)."""
+    coll: dict = {}
+    steps: list[dict] = []
+    dispatch: list[dict] = []
+    stragglers: list[dict] = []
+    packs: list[dict] = []
+    schemas: set[int] = set()
+    meta: dict = {}
+
+    for ev in events:
+        if "schema" in ev:
+            schemas.add(ev["schema"])
+        kind = ev.get("kind")
+        if kind == "meta":
+            # first meta wins for top-level fields; sync=True anywhere
+            # means at least part of the trace has true durations
+            for k in ("started_at", "sync", "source", "mode"):
+                if k in ev and k not in meta:
+                    meta[k] = ev[k]
+            continue
+        if kind == "collective":
+            key = (ev.get("op", "?"), ev.get("plane", "?"))
+            row = coll.setdefault(key, {
+                "n": 0, "nbytes": 0, "dur_s": 0.0, "n_with_bytes": 0,
+                "n_with_dur": 0, "wire_dtypes": set(), "n_auto": 0,
+                "devices": set(),
+            })
+            row["n"] += 1
+            if ev.get("nbytes") is not None:
+                row["nbytes"] += int(ev["nbytes"])
+                row["n_with_bytes"] += 1
+            if ev.get("dur_s") is not None:
+                row["dur_s"] += float(ev["dur_s"])
+                row["n_with_dur"] += 1
+            if ev.get("wire_dtype"):
+                row["wire_dtypes"].add(str(ev["wire_dtype"]))
+            if ev.get("provenance"):
+                row["n_auto"] += 1
+            if ev.get("device"):
+                row["devices"].add(str(ev["device"]))
+        elif kind == "step":
+            steps.append(ev)
+        elif kind == "dispatch":
+            dispatch.append(ev)
+        elif kind == "straggler":
+            stragglers.append(ev)
+        elif kind == "pack":
+            packs.append(ev)
+
+    ops = []
+    for (op, plane) in sorted(coll):
+        row = coll[(op, plane)]
+        entry = {
+            "op": op,
+            "plane": plane,
+            "n": row["n"],
+            "total_bytes": row["nbytes"],
+            "total_s": round(row["dur_s"], 6),
+            "mean_ms": (round(row["dur_s"] / row["n_with_dur"] * 1e3, 4)
+                        if row["n_with_dur"] else None),
+            "wire_dtypes": sorted(row["wire_dtypes"]),
+            "auto_events": row["n_auto"],
+        }
+        if row["nbytes"] and row["dur_s"] > 0 and row["n_with_bytes"]:
+            # 6 decimals: host-plane obj collectives run at KB/ms scales
+            # where 3 would round every row to 0.0
+            entry["gbps"] = round(row["nbytes"] / row["dur_s"] / 1e9, 6)
+        entry["_devices"] = sorted(row["devices"])  # stripped before emit
+        ops.append(entry)
+
+    phase_stats: dict = {}
+    for ev in steps:
+        for k, v in (ev.get("phases") or {}).items():
+            s = phase_stats.setdefault(k, {"sum": 0.0, "max": 0.0, "n": 0})
+            s["sum"] += float(v)
+            s["max"] = max(s["max"], float(v))
+            s["n"] += 1
+    phases = {
+        k: {"mean_ms": round(s["sum"] / s["n"] * 1e3, 4),
+            "max_ms": round(s["max"] * 1e3, 4), "n": s["n"]}
+        for k, s in sorted(phase_stats.items()) if s["n"]
+    }
+
+    disp = [
+        {"name": d.get("name"), "key": d.get("key"),
+         "winner": d.get("winner"), "source": d.get("source")}
+        for d in dispatch
+    ]
+
+    out = {
+        "schema_versions": sorted(schemas),
+        "meta": meta,
+        "n_events": len(events),
+        "collectives": ops,
+        "steps": {"n": len(steps), "phases": phases},
+        "dispatch": disp,
+        "packs": [
+            {k: p.get(k) for k in
+             ("op", "nbytes", "bucket_bytes", "n_buckets", "wire_dtype")}
+            for p in packs
+        ],
+        "stragglers": [
+            {"flagged_ranks": s.get("flagged_ranks"),
+             "phases": s.get("phases")}
+            for s in stragglers
+        ],
+    }
+
+    # Roofline floors where the device kind names a known HBM peak:
+    # device-plane ops only, floored against the kinds THEY actually ran
+    # on (a multi-backend trace — bench's accel child + cpu fallback in
+    # one file — must not cross-product ops against foreign devices, and
+    # a host-plane pickle transfer has no HBM roofline at all).
+    floors = []
+    for entry in ops:
+        if entry["plane"] != "device" or not entry.get("gbps"):
+            continue
+        for kind in entry["_devices"]:
+            peak = _hbm_peak(kind)
+            if not peak:
+                continue
+            floors.append({
+                "device": kind, "op": entry["op"],
+                "achieved_gbps": entry["gbps"],
+                "hbm_peak_gbps": round(peak / 1e9, 1),
+                "fraction_of_peak": round(entry["gbps"] * 1e9 / peak, 4),
+            })
+    for entry in ops:
+        entry.pop("_devices")
+    if floors:
+        out["roofline"] = floors
+    return out
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n}"
+
+
+def render_text(s: dict) -> str:
+    lines = []
+    lines.append(
+        f"trace: {s['n_events']} events, schema {s['schema_versions']}, "
+        f"sync={s['meta'].get('sync', False)}"
+    )
+    if s["collectives"]:
+        lines.append("")
+        lines.append(f"{'op':<18} {'plane':<7} {'n':>6} {'bytes':>12} "
+                     f"{'total s':>9} {'mean ms':>9} {'GB/s':>7} "
+                     f"{'auto':>5}  wire")
+        for e in s["collectives"]:
+            lines.append(
+                f"{e['op']:<18} {e['plane']:<7} {e['n']:>6} "
+                f"{_fmt_bytes(e['total_bytes']):>12} "
+                f"{e['total_s']:>9.4f} "
+                f"{(e['mean_ms'] if e['mean_ms'] is not None else 0):>9.3f} "
+                f"{(str(e.get('gbps', '-'))):>7} "
+                f"{e['auto_events']:>5}  {','.join(e['wire_dtypes']) or '-'}"
+            )
+        lines.append("(allreduce_grad rows subsume their nested "
+                     "per-leaf allreduce rows; don't sum)")
+    if s["steps"]["n"]:
+        lines.append("")
+        lines.append(f"steps: {s['steps']['n']}")
+        for k, v in s["steps"]["phases"].items():
+            lines.append(f"  {k:<12} mean {v['mean_ms']:>9.3f} ms   "
+                         f"max {v['max_ms']:>9.3f} ms")
+    if s["dispatch"]:
+        lines.append("")
+        lines.append("dispatch decisions:")
+        for d in s["dispatch"]:
+            lines.append(f"  {d['name']}={d['winner']} ({d['source']}) "
+                         f"key={d['key']}")
+    if s["packs"]:
+        lines.append("")
+        lines.append("gradient packs (per compilation):")
+        for p in s["packs"]:
+            lines.append(
+                f"  {p['op']}: {p['n_buckets']} bucket(s) x "
+                f"<= {_fmt_bytes(p['bucket_bytes'] or 0)}, wire "
+                f"{p['wire_dtype']}, {_fmt_bytes(p['nbytes'] or 0)} total"
+            )
+    if s["stragglers"]:
+        lines.append("")
+        lines.append(f"STRAGGLER reports: {len(s['stragglers'])}")
+        for r in s["stragglers"]:
+            lines.append(f"  flagged ranks {r['flagged_ranks']}: "
+                         f"{json.dumps(r['phases'])}")
+    if s.get("roofline"):
+        lines.append("")
+        lines.append("roofline (eager-plane achieved vs HBM peak):")
+        for f in s["roofline"]:
+            lines.append(
+                f"  {f['op']} on {f['device']}: {f['achieved_gbps']} GB/s "
+                f"= {f['fraction_of_peak'] * 100:.1f}% of "
+                f"{f['hbm_peak_gbps']} GB/s"
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a chainermn_tpu observability JSONL trace"
+    )
+    ap.add_argument("trace", help="path to the JSONL trace")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="also write a Chrome-trace/Perfetto JSON file")
+    args = ap.parse_args(argv)
+
+    events = _read_events(args.trace)
+    summary = summarize(events)
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(_trace_mod().chrome_trace(events), f)
+        if not args.json:
+            print(f"chrome trace: {args.chrome}", file=sys.stderr)
+    try:
+        if args.json:
+            print(json.dumps(summary, sort_keys=True))
+        else:
+            print(render_text(summary))
+    except BrokenPipeError:
+        # piped into head/less that closed early — not an error
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
