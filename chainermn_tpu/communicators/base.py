@@ -414,17 +414,36 @@ class CommunicatorBase:
         return out
 
     def reduce_gradients_in_jit(
-        self, grads: PyTree, *, compress_dtype=None
+        self, grads: PyTree, *, compress_dtype=None, schedule: str | None = None
     ) -> PyTree:
         """The IN-JIT gradient reduction this communicator's strategy uses —
         called from the train step / optimizer wrapper inside the named-axis
         context. Base strategy: one fused ``pmean`` over ``grad_axes`` (XLA
         derives the topology-aware schedule). Subclasses may pin an explicit
-        algorithm (:class:`TwoDimensionalCommunicator`)."""
+        algorithm (:class:`TwoDimensionalCommunicator`).
+
+        ``schedule`` overrides the strategy with a pinned one from
+        :mod:`chainermn_tpu.parallel.reduction_schedule` (``'flat'`` =
+        bucketed packed allreduce, ``'two_level'`` = reduce-scatter ->
+        shard allreduce -> allgather per bucket); the optimizer wrapper's
+        ``reduction_schedule=`` is the normal front door — this knob
+        exists for hand-rolled steps that call the communicator directly.
+        Outside the named-axis context both forms degrade identically."""
         from chainermn_tpu.optimizers import allreduce_gradients
 
         if compress_dtype is None:
             compress_dtype = self.allreduce_grad_dtype
+        if schedule is not None:
+            from chainermn_tpu.parallel.collectives import axes_bound
+            from chainermn_tpu.parallel.reduction_schedule import (
+                reduce_tree,
+            )
+
+            if axes_bound(self.grad_axes):
+                return reduce_tree(
+                    grads, schedule=schedule, axes=self.grad_axes,
+                    compress_dtype=compress_dtype, size=self.size,
+                )
         return allreduce_gradients(
             grads, axis_names=self.grad_axes, compress_dtype=compress_dtype
         )

@@ -197,140 +197,44 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
     def reduce_gradients_in_jit(
         self, grads: PyTree, *, compress_dtype=None
     ) -> PyTree:
-        import jax.numpy as jnp
-
-        from chainermn_tpu.parallel.collectives import two_level_allreduce
+        """The pinned two-level pipeline, via the SHARED schedule layer
+        (:func:`chainermn_tpu.parallel.reduction_schedule.reduce_tree`,
+        ``schedule='two_level'``): the whole gradient tree packs into
+        ~``bucket_bytes`` flat buffers per dtype group (the reference's
+        ``_memory_utility.pack_params`` (dagger) discipline, in-jit so
+        XLA owns the copies — per-leaf collectives would leave the slow
+        inter/DCN level latency-bound on tiny bias/scale leaves), and
+        each bucket crosses as intra ``psum_scatter`` -> inter allreduce
+        of the shard -> intra ``all_gather``. An int8 compress dtype
+        selects the quantized wire at the ONLY stage where compression
+        pays — the shard crossing inter/DCN — with the intra reduction
+        exact. Trace-time ``pack`` + per-bucket ``wire`` events record
+        the layout and the bucket decision's provenance."""
+        from chainermn_tpu.parallel.collectives import axes_bound
+        from chainermn_tpu.parallel.reduction_schedule import reduce_tree
 
         if compress_dtype is None:
             compress_dtype = self.allreduce_grad_dtype
-        # int8 selects the quantized wire (summing int8 through the
-        # two-level pipeline would overflow): float buckets PACK in f32
-        # and reduce via int8_two_level_allreduce_mean — exact over
-        # intra, int8 only over inter — keeping the flat-buffer
-        # discipline, so tiny bias/scale leaves still ride one
-        # collective per ~64 MB bucket instead of one per leaf.
-        int8_wire = (compress_dtype is not None
-                     and jnp.dtype(compress_dtype) == jnp.dtype(jnp.int8))
-        # Axes come from the mesh (a custom mesh= names them differently).
-        inter_ax, intra_ax = self.grad_axes
-
         # Probe ONLY the axis-context question (unbound axis = auto-SPMD
-        # jit / single-device eager), then run the real reduction outside
-        # any try — a genuine error inside two_level_allreduce must
-        # propagate, not silently degrade to the fused-pmean fallback
-        # (which is numerically identical, so nothing would ever notice).
-        from chainermn_tpu.parallel.collectives import axes_bound
-
+        # jit / single-device eager) — a genuine error inside the
+        # pipeline must propagate, not silently degrade to the fused
+        # pmean fallback (numerically identical, nothing would notice).
+        inter_ax, intra_ax = self.grad_axes
         if not axes_bound((intra_ax, inter_ax)):
             return super().reduce_gradients_in_jit(
                 grads, compress_dtype=compress_dtype
             )
-
-        # Pack the whole gradient tree into one flat buffer per dtype group
-        # before reducing — the reference's ``_memory_utility.pack_params``
-        # flat-buffer discipline (dagger), here inside jit so XLA owns the
-        # copies. Per-leaf collectives would issue 3 ops per parameter
-        # tensor, leaving the slow inter (DCN) level latency-bound on tiny
-        # bias/scale leaves instead of bandwidth-bound on one big buffer.
-        leaves, treedef = jax.tree.flatten(grads)
-        if not leaves:
-            return grads
-
-        def cast_dtype(g):
-            if compress_dtype is not None and jnp.issubdtype(
-                g.dtype, jnp.floating
-            ):
-                # int8 wire: buckets pack in f32; quantization happens
-                # inside int8_two_level_allreduce_mean per bucket.
-                return (jnp.dtype(jnp.float32) if int8_wire
-                        else jnp.dtype(compress_dtype))
-            return jnp.dtype(g.dtype)
-
-        groups: dict = {}
-        for i, g in enumerate(leaves):
-            groups.setdefault(cast_dtype(g), []).append(i)
-        out: list = [None] * len(leaves)
-        # Pack into buckets rather than one whole-model buffer: the
-        # concatenated flat copy is a TRANSIENT extra full gradient in HBM;
-        # bucketing bounds that transient while each bucket stays large
-        # enough to keep the inter (DCN) level bandwidth-bound. (A single
-        # leaf bigger than the bucket gets its own bucket, unsplit.)
-        # Size via the autotune registry (~64 MB table default; a cache
-        # entry seeded from an on-chip busbw curve can move it — see
-        # chainermn_tpu.tuning).
-        bucket_bytes = self.bucket_bytes
-        n_buckets_total = 0
-        for dt, idxs in groups.items():
-            itemsize = jnp.dtype(dt).itemsize
-            buckets: list[list[int]] = []
-            cur: list[int] = []
-            cur_bytes = 0
-            for i in idxs:
-                nbytes = leaves[i].size * itemsize
-                if cur and cur_bytes + nbytes > bucket_bytes:
-                    buckets.append(cur)
-                    cur, cur_bytes = [], 0
-                cur.append(i)
-                cur_bytes += nbytes
-            if cur:
-                buckets.append(cur)
-            n_buckets_total += len(buckets)
-            for bidx in buckets:
-                flat = jnp.concatenate(
-                    [leaves[i].astype(dt).ravel() for i in bidx]
-                )
-                if int8_wire and jnp.issubdtype(dt, jnp.floating):
-                    # Topology-aware: exact over intra (ICI), the int8
-                    # wire's two rounding stages only over inter (DCN)
-                    # — compression where bandwidth is scarce, no
-                    # quantization noise from the intra reduction.
-                    from chainermn_tpu.parallel.collectives import (
-                        int8_two_level_allreduce_mean,
-                    )
-
-                    red = int8_two_level_allreduce_mean(
-                        flat, intra_ax, inter_ax
-                    )
-                else:
-                    red = two_level_allreduce(flat, intra_ax, inter_ax)
-                off = 0
-                for i in bidx:
-                    n = leaves[i].size
-                    out[i] = (
-                        red[off : off + n]
-                        .reshape(leaves[i].shape)
-                        .astype(leaves[i].dtype)
-                    )
-                    off += n
-        # Pack provenance into the trace (fires at TRACE time — once per
-        # compilation, pure host-side Python, so the lowered program is
-        # untouched): the bucket layout this program committed to and
-        # the autotune decision behind it.
-        from chainermn_tpu.observability import trace as _trace
-
-        rec = _trace.active()
-        if rec is not None:
-            def wire_itemsize(g):
-                # int8 wire: float buckets PACK in f32 but cross the
-                # inter wire as 1 byte/elem — nbytes must describe the
-                # wire the wire_dtype names, not the pack staging dtype
-                # (a 4x overstatement otherwise).
-                if int8_wire and jnp.issubdtype(g.dtype, jnp.floating):
-                    return 1
-                return jnp.dtype(cast_dtype(g)).itemsize
-
-            rec.event(
-                "pack", op="two_level_allreduce",
-                nbytes=sum(g.size * wire_itemsize(g) for g in leaves),
-                bucket_bytes=bucket_bytes,
-                n_buckets=n_buckets_total,
-                wire_dtype=("int8" if int8_wire else
-                            (jnp.dtype(compress_dtype).name
-                             if compress_dtype is not None else "none")),
-                provenance=getattr(self, "_bucket_provenance", None),
-                size=self.size,
-            )
-        return jax.tree.unflatten(treedef, out)
+        bucket_bytes = self.bucket_bytes  # resolves provenance too
+        return reduce_tree(
+            grads,
+            schedule="two_level",
+            axes=self.grad_axes,
+            compress_dtype=compress_dtype,
+            bucket_bytes=bucket_bytes,
+            provenance=getattr(self, "_bucket_provenance", None),
+            op="two_level_allreduce",
+            size=self.size,
+        )
 
 
 class SingleNodeCommunicator(XlaCommunicator):

@@ -30,6 +30,7 @@ from chainermn_tpu.observability.trace import (
     enable,
     read_jsonl,
     span,
+    summarize_overlap,
     write_chrome_trace,
 )
 
@@ -56,5 +57,6 @@ __all__ = [
     "enable",
     "read_jsonl",
     "span",
+    "summarize_overlap",
     "write_chrome_trace",
 ]

@@ -341,6 +341,74 @@ def read_jsonl(path: str) -> list[dict]:
     return out
 
 
+def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
+    """Comm/compute-overlap rollup from ``wire`` + ``overlap_config``
+    events (ISSUE 3: the consumer side of the per-bucket wire events;
+    one owner shared by ``tools/trace_report.py`` and bench).
+
+    Two wire-event flavours feed it:
+
+    - trace-time layout events (in-jit bucketed schedules; no
+      ``dur_s``): counted per schedule with their ``overlapped`` flag —
+      what the compiled program COMMITTED to;
+    - measured events (the eager ``OverlappedBucketReducer``; ``dur_s``
+      = dispatch->ready, ``blocked_s`` = wait actually paid at
+      collect): aggregated into comm time total vs comm time hidden
+      behind compute, and the ``hidden_fraction`` between them.
+
+    Returns None when the trace carries neither (section omitted)."""
+    configs: list[dict] = []
+    layout: dict = {}
+    n_measured = 0
+    comm_s = 0.0
+    blocked_s = 0.0
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "overlap_config":
+            configs.append({
+                k: ev.get(k)
+                for k in ("double_buffering", "staleness", "schedule",
+                          "donate")
+            })
+        elif kind == "wire":
+            dur = ev.get("dur_s")
+            if dur is None:
+                key = str(ev.get("schedule", "?"))
+                row = layout.setdefault(
+                    key, {"buckets": 0, "nbytes": 0, "overlapped": 0}
+                )
+                row["buckets"] += 1
+                row["nbytes"] += int(ev.get("nbytes") or 0)
+                row["overlapped"] += 1 if ev.get("overlapped") else 0
+            else:
+                n_measured += 1
+                comm_s += float(dur)
+                # None (absent) falls back to dur; an explicit 0.0 is a
+                # FULLY-HIDDEN bucket and must count as such.
+                b = ev.get("blocked_s")
+                blocked_s += float(dur if b is None else b)
+    if not configs and not layout and not n_measured:
+        return None
+    out: dict = {}
+    if configs:
+        out["config"] = configs
+    if layout:
+        out["schedules"] = {
+            k: layout[k] for k in sorted(layout)
+        }
+    if n_measured:
+        hidden_s = max(0.0, comm_s - blocked_s)
+        out["measured"] = {
+            "n": n_measured,
+            "comm_ms_total": round(comm_s * 1e3, 4),
+            "comm_ms_blocked": round(blocked_s * 1e3, 4),
+            "comm_ms_hidden": round(hidden_s * 1e3, 4),
+            "hidden_fraction": (round(hidden_s / comm_s, 4)
+                                if comm_s > 0 else 0.0),
+        }
+    return out
+
+
 def chrome_trace(events: Iterable[Mapping[str, Any]]) -> dict:
     """Convert trace events to the Chrome trace-event format (load in
     ``chrome://tracing`` or https://ui.perfetto.dev). Events with a

@@ -146,33 +146,40 @@ class _ErrorFeedbackState(NamedTuple):
     residual: PyTree
 
 
+class _ZeroShardState(NamedTuple):
+    """Optimizer state of the ``'zero'`` reduction schedule: EVERY inner
+    leaf is stacked ``[n_shards, ...]`` along a leading shard dim
+    (scalar counters tiled), so one prefix ``PartitionSpec`` shards the
+    whole subtree over the scatter axis — ZeRO-1 state sharding fused
+    into the gradient-reduction schedule (reduce-scatter -> sharded
+    update -> allgather, arXiv:2004.13336; the chunk layout of
+    :mod:`chainermn_tpu.parallel.zero`, optimizer-wrapped)."""
+
+    inner: Any
+
+
 _EF_BUCKET_BYTES = 64 << 20
 
 
 def _float_bucket_partition(float_idx, sizes, bucket_bytes=None):
     """Deterministic ~64 MB (f32) bucket partition of the float leaves
-    — ONE function used by both ``MultiNodeOptimizer.init`` (residual
-    allocation) and ``_reduce_with_feedback`` (the reduction), so the
-    two can never disagree about the layout. A single leaf larger than
-    the bucket gets its own bucket, unsplit. ``bucket_bytes`` comes
-    from the optimizer's autotuned resolution (decision
-    ``allreduce_bucket_mb``, resolved ONCE per optimizer instance so
-    init and update always see the same layout)."""
+    — ONE function used by ``MultiNodeOptimizer.init`` (residual
+    allocation), ``_reduce_with_feedback`` (the EF reduction), and the
+    schedule layer, so no two consumers can disagree about the layout.
+    Thin f32 wrapper over
+    :func:`chainermn_tpu.parallel.reduction_schedule.bucket_partition`,
+    which owns the edge contract: zero-size leaves are skipped (they
+    ride the exact per-leaf path), a payload smaller than one bucket
+    yields exactly one bucket, a single leaf larger than the bucket
+    gets its own bucket unsplit, and no bucket is ever empty.
+    ``bucket_bytes`` comes from the optimizer's autotuned resolution
+    (decision ``allreduce_bucket_mb``, resolved ONCE per optimizer
+    instance so init and update always see the same layout)."""
+    from chainermn_tpu.parallel.reduction_schedule import bucket_partition
+
     if bucket_bytes is None:
         bucket_bytes = _EF_BUCKET_BYTES
-    buckets: list[list[int]] = []
-    cur: list[int] = []
-    cur_bytes = 0
-    for i in float_idx:
-        nbytes = sizes[i] * 4
-        if cur and cur_bytes + nbytes > bucket_bytes:
-            buckets.append(cur)
-            cur, cur_bytes = [], 0
-        cur.append(i)
-        cur_bytes += nbytes
-    if cur:
-        buckets.append(cur)
-    return buckets
+    return bucket_partition(float_idx, sizes, 4, bucket_bytes)
 
 
 class MultiNodeOptimizer:
@@ -185,6 +192,39 @@ class MultiNodeOptimizer:
         gradients (staleness-1) — tested for exactly that semantic;
       - attribute delegation: unknown attributes forward to the wrapped
         optimizer (the reference delegated via ``__getattr__``).
+
+    ``reduction_schedule`` selects the gradient-reduction ALGORITHM
+    (:mod:`chainermn_tpu.parallel.reduction_schedule`; see
+    docs/parallelism.md "Gradient-reduction schedules"):
+
+    - ``None`` (default): the communicator's own strategy — base: fused
+      pmean; two_dimensional: its packed two-level pipeline. Exactly
+      the pre-schedule behaviour.
+    - ``'flat'``: the packed flat allreduce, pinned (the reference's
+      ``_memory_utility.pack_params`` (dagger) discipline).
+    - ``'two_level'``: intra reduce-scatter -> inter allreduce on the
+      shard -> allgather, per ~64 MB bucket (HiCCL-style composition,
+      arXiv:2408.05962).
+    - ``'zero'``: reduce-scatter + SHARDED update + allgather — the
+      inner optimizer runs on 1/n of the parameters with 1/n of its
+      state (arXiv:2004.13336), fused with
+      :mod:`chainermn_tpu.parallel.zero`'s chunk layout. The inner
+      transform must be elementwise (adam/sgd/...); carry the state
+      through ``shard_map`` with :meth:`opt_state_spec`
+      (``make_train_step`` does this automatically). Incompatible with
+      ``double_buffering``, ``error_feedback`` and the int8 wire.
+    - ``'auto'``: resolved once per optimizer instance through the
+      autotune registry (decision ``'reduction_schedule'``, keyed
+      device_kind x world-shape x payload-MB bucket), seedable offline
+      from bench's ``overlap`` phase rows.
+
+    ``double_buffering=True`` is the OVERLAPPED mode: the update
+    consumes the PREVIOUS step's banked buckets while this step's
+    reduction is dispatched with no data path into the current update
+    (certified structurally in tests/test_optimizer.py) — with an
+    explicit schedule (or the default's bucketed overlap form) each
+    bucket's trace-time ``wire`` event carries ``overlapped=True`` so
+    ``tools/trace_report.py`` reports the comm-hidden fraction.
     """
 
     #: protocol marker for make_train_step: this wrapper performs its own
@@ -201,6 +241,7 @@ class MultiNodeOptimizer:
         double_buffering: bool = False,
         compress_dtype=None,
         error_feedback: bool = False,
+        reduction_schedule: str | None = None,
     ) -> None:
         self.actual_optimizer = actual_optimizer
         self.communicator = communicator
@@ -230,6 +271,45 @@ class MultiNodeOptimizer:
                 "(allreduce_grad_dtype=jnp.int8) — other dtypes lose "
                 "nothing systematic to feed back"
             )
+        from chainermn_tpu.parallel.reduction_schedule import SCHEDULES
+
+        if reduction_schedule not in (None, "auto") + SCHEDULES:
+            raise ValueError(
+                f"reduction_schedule must be one of "
+                f"{(None, 'auto') + SCHEDULES}, got {reduction_schedule!r}"
+            )
+        if error_feedback and reduction_schedule not in (None, "flat"):
+            raise ValueError(
+                "error_feedback owns its reduction (the flat or the "
+                "communicator's topology-aware quantized wire) — "
+                f"reduction_schedule={reduction_schedule!r} cannot compose"
+            )
+        if reduction_schedule == "zero":
+            if double_buffering:
+                raise ValueError(
+                    "reduction_schedule='zero' cannot compose with "
+                    "double_buffering: the sharded update replaces the "
+                    "grads the staleness bank would carry"
+                )
+            if self._int8_wire():
+                raise ValueError(
+                    "reduction_schedule='zero' cannot ride the int8 wire "
+                    "(its reduce-scatter sums raw chunks; the two-phase "
+                    "quantized scheme has no scatter form) — use bf16 "
+                    "compression or the flat/two_level schedules"
+                )
+        self.reduction_schedule = reduction_schedule
+        #: candidates an ``'auto'`` resolution may pick: ``'zero'`` is
+        #: eligible only when nothing structurally incompatible is on.
+        self._auto_candidates = tuple(
+            s for s in SCHEDULES
+            if not (s == "zero" and (double_buffering or error_feedback
+                                     or self._int8_wire()))
+        )
+        #: the one-shot 'auto' resolution (first need wins — init and
+        #: update must agree on the state layout) + its registry record.
+        self._auto_resolved: str | None = None
+        self._schedule_provenance: dict | None = None
         # One resolution per optimizer instance: init's residual
         # allocation and update's reduction must see the same bucket
         # layout even if the autotune cache changes mid-process. The
@@ -293,9 +373,199 @@ class MultiNodeOptimizer:
         return (self.compress_dtype is not None
                 and jnp.dtype(self.compress_dtype) == jnp.dtype(jnp.int8))
 
+    # -- reduction-schedule plumbing ---------------------------------------
+
+    def _zero_axis(self) -> str:
+        """The scatter axis of the 'zero' schedule: the LAST grad axis
+        (mesh convention puts the fast/intra axis last — state shards
+        where the gather is cheapest)."""
+        return self.communicator.grad_axes[-1]
+
+    def _zero_n(self) -> int:
+        return int(self.communicator.mesh.shape[self._zero_axis()])
+
+    def _effective_schedule(self, tree: PyTree | None = None) -> str | None:
+        """The schedule this update runs: the explicit choice, the
+        one-shot ``'auto'`` resolution (payload taken from ``tree``),
+        or — for the default ``None`` — the communicator's own strategy,
+        EXCEPT under double buffering, where the overlapped mode runs
+        the bucketed pipeline so each in-flight bucket is a separately
+        schedulable (and separately traced) collective."""
+        s = self.reduction_schedule
+        if s == "auto":
+            if self._auto_resolved is None:
+                from chainermn_tpu.parallel.reduction_schedule import (
+                    resolve_schedule,
+                )
+
+                payload = sum(
+                    leaf.size * jnp.dtype(leaf.dtype).itemsize
+                    for leaf in jax.tree.leaves(tree)
+                ) if tree is not None else 0
+                comm = self.communicator
+                winner, rec = resolve_schedule(
+                    comm.device_kind, payload,
+                    tuple(int(v) for v in comm.mesh.shape.values()),
+                    candidates=self._auto_candidates,
+                )
+                self._auto_resolved = winner
+                self._schedule_provenance = rec
+            return self._auto_resolved
+        if s is None and self.double_buffering:
+            return ("two_level"
+                    if getattr(self.communicator, "two_level_axes", None)
+                    is not None else "flat")
+        return s
+
+    def _reduce_scheduled(self, grads: PyTree, schedule: str | None) -> PyTree:
+        """Reduce ``grads`` under ``schedule`` (never 'zero' — that is
+        structural, see ``_zero_update``). ``None`` and any
+        outside-axis-context call take the legacy communicator path, so
+        the degrade semantics (identity + compress-dtype roundtrip)
+        stay byte-identical to the pre-schedule behaviour."""
+        from chainermn_tpu.parallel.collectives import axes_bound
+        from chainermn_tpu.parallel.reduction_schedule import reduce_tree
+
+        comm = self.communicator
+        if schedule is None or not axes_bound(comm.grad_axes):
+            return allreduce_gradients(
+                grads, comm, compress_dtype=self.compress_dtype
+            )
+        return reduce_tree(
+            grads,
+            schedule=schedule,
+            axes=comm.grad_axes,
+            compress_dtype=self.compress_dtype,
+            bucket_bytes=self._bucket_bytes,
+            overlapped=self.double_buffering,
+            provenance=self._schedule_provenance,
+            size=comm.size,
+        )
+
+    def opt_state_spec(self):
+        """``PartitionSpec`` (prefix pytree) for carrying this
+        optimizer's state through ``shard_map``: the 'zero' schedule
+        shards every (stacked) state leaf over the scatter axis;
+        everything else is replicated. ``make_train_step`` consumes
+        this automatically; hand-rolled steps pass it as the state's
+        ``in_specs``/``out_specs`` entry.
+
+        An unresolved ``'auto'`` is resolved HERE (payload unknown —
+        the 1 MB key bucket) rather than silently reported replicated:
+        the resolution is one-shot, so whichever of init()/this runs
+        first fixes the schedule and the other agrees — never a spec
+        that contradicts the state layout. Call ``init`` (or
+        ``create_train_state``) first when the payload-keyed cache
+        entry should decide."""
+        from jax.sharding import PartitionSpec as P
+
+        sched = self.reduction_schedule
+        if sched == "auto":
+            sched = self._effective_schedule(None)
+        if sched == "zero":
+            return _ZeroShardState(inner=P(self._zero_axis()))
+        return P()
+
+    # -- the 'zero' schedule: reduce-scatter + sharded update + allgather --
+
+    def _zero_update(self, grads: PyTree, state, params: PyTree | None):
+        """Xu et al.'s reduce-scatter sharded update (arXiv:2004.13336),
+        fused with parallel/zero.py's chunk layout: each shard receives
+        the MEAN of its 1/n gradient chunk (half an allreduce's wire
+        bytes), updates 1/n of the optimizer state, and allgathers the
+        1/n parameter updates back (the other half). Outside any
+        named-axis context it degrades to a vectorised per-chunk update
+        over the full stacked state — elementwise inner transforms make
+        that exactly the full-parameter update, so eager/pjit callers
+        see identical numerics with zero collectives."""
+        from chainermn_tpu.parallel.collectives import axes_bound, axes_size
+        from chainermn_tpu.parallel.zero import _chunk_rows, _unchunk
+
+        inner = self.actual_optimizer
+        comm = self.communicator
+        names = comm.grad_axes
+        ax = names[-1]
+        rest = names[:-1]
+        n = self._zero_n()
+        compress = self.compress_dtype
+
+        if not axes_bound(names):
+            grows = jax.tree.map(lambda g: _chunk_rows(g, n), grads)
+            prows = (jax.tree.map(lambda p: _chunk_rows(p, n), params)
+                     if params is not None else None)
+            if prows is None:
+                urows, inner_state = jax.vmap(
+                    lambda g, s: inner.update(g, s)
+                )(grows, state.inner)
+            else:
+                urows, inner_state = jax.vmap(inner.update)(
+                    grows, state.inner, prows
+                )
+            updates = jax.tree.map(
+                lambda u, g: _unchunk(u, g.shape, g.dtype), urows, grads
+            )
+            return updates, _ZeroShardState(inner=inner_state)
+
+        lead = {int(jnp.shape(e)[0]) for e in jax.tree.leaves(state.inner)
+                if jnp.ndim(e) >= 1}
+        if lead and lead != {1}:
+            raise ValueError(
+                "the 'zero' schedule's opt_state reached update without "
+                f"being sharded (leading dims {sorted(lead)}, expected 1 "
+                "per shard) — carry it through shard_map with "
+                "optimizer.opt_state_spec() (make_train_step does this), "
+                "never closed over or replicated"
+            )
+        n_tot = axes_size(names)
+        idx = lax.axis_index(ax)
+
+        def rs(g):
+            rows = _chunk_rows(g, n)
+            if compress is not None and jnp.issubdtype(
+                g.dtype, jnp.floating
+            ):
+                rows = rows.astype(compress)
+            part = lax.psum_scatter(
+                rows, ax, scatter_dimension=0, tiled=False
+            )
+            if rest:
+                part = lax.psum(part, rest)
+            return (part / n_tot).astype(g.dtype)
+
+        gchunks = jax.tree.map(rs, grads)
+        pchunks = (jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(
+                _chunk_rows(p, n), idx, keepdims=False
+            ), params,
+        ) if params is not None else None)
+        schunk = jax.tree.map(lambda e: e[0], state.inner)
+        uchunks, schunk = inner.update(gchunks, schunk, pchunks)
+        inner_state = jax.tree.map(lambda e: e[None], schunk)
+
+        def ag(u, g):
+            rows = lax.all_gather(u, ax, axis=0, tiled=False)
+            return _unchunk(rows, g.shape, g.dtype)
+
+        updates = jax.tree.map(ag, uchunks, grads)
+        return updates, _ZeroShardState(inner=inner_state)
+
     # -- optax protocol ----------------------------------------------------
 
     def init(self, params: PyTree):
+        if self._effective_schedule(params) == "zero":
+            # 1/n state per shard, stacked [n, ...] (scalar counters
+            # tiled) so ONE prefix spec shards the whole subtree — the
+            # layout _zero_update and opt_state_spec() both key on.
+            # Works eagerly (create_train_state) and in-trace alike.
+            from chainermn_tpu.parallel.zero import _chunk_rows
+
+            n = self._zero_n()
+            rows = jax.tree.map(
+                lambda p: _chunk_rows(jnp.asarray(p), n), params
+            )
+            return _ZeroShardState(
+                inner=jax.vmap(self.actual_optimizer.init)(rows)
+            )
         state = self.actual_optimizer.init(params)
         if self.double_buffering:
             state = _DoubleBufferState(
@@ -383,8 +653,11 @@ class MultiNodeOptimizer:
         leaves, treedef = jax.tree.flatten(grads)
         out: list = [None] * len(leaves)
 
+        # Zero-size float leaves ride the exact per-leaf path with the
+        # non-floats: an empty buffer has no max-abs for the int8 scale
+        # (and bucket_partition skips them — see its edge contract).
         float_idx = [i for i, g in enumerate(leaves)
-                     if jnp.issubdtype(g.dtype, jnp.floating)]
+                     if jnp.issubdtype(g.dtype, jnp.floating) and g.size > 0]
         for i, g in enumerate(leaves):
             if i not in float_idx:
                 out[i] = _pmean_if_in_axis(g, axes).astype(g.dtype)
@@ -450,29 +723,38 @@ class MultiNodeOptimizer:
 
     def update(self, grads: PyTree, state, params: PyTree | None = None):
         ef_state = None
+        reduced = None
         if self.error_feedback:
             ef_state, state = state, state.inner
             reduced, new_residual = self._reduce_with_feedback(
                 grads, ef_state.residual
             )
         else:
-            reduced = allreduce_gradients(
-                grads, self.communicator, compress_dtype=self.compress_dtype
-            )
+            schedule = self._effective_schedule(grads)
+            if schedule == "zero":
+                return self._zero_update(grads, state, params)
 
         if not self.double_buffering:
+            if reduced is None:
+                reduced = self._reduce_scheduled(grads, schedule)
             updates, inner = self.actual_optimizer.update(
                 reduced, state, params
             )
         else:
-            # Apply last step's reduced grads; bank this step's. XLA is
-            # free to overlap the collective producing `reduced` with the
-            # inner-optimizer math consuming `state.communicated_grads` —
-            # the dependency graph is exactly the reference's
-            # two-buffer/side-stream overlap.
+            # OVERLAPPED mode (reference staleness-1, made explicit):
+            # apply last step's BANKED buckets first, then dispatch this
+            # step's reduction — the update has no data path into the
+            # same step's collective (certified in tests/test_optimizer
+            # .py), so XLA's async scheduler (and, across a scan, step
+            # t+1's backward) runs the wire concurrently with compute;
+            # with donation (make_train_step's default) the bank buffer
+            # is reused in place. Per-bucket wire events carry
+            # overlapped=True for trace_report's comm-hidden fraction.
             updates, inner_inner = self.actual_optimizer.update(
                 state.communicated_grads, state.inner, params
             )
+            if reduced is None:
+                reduced = self._reduce_scheduled(grads, schedule)
             inner = _DoubleBufferState(
                 inner=inner_inner, communicated_grads=reduced,
                 step=state.step + 1,
@@ -629,6 +911,7 @@ def create_multi_node_optimizer(
     double_buffering: bool = False,
     allreduce_grad_dtype=None,
     error_feedback: bool = False,
+    reduction_schedule: str | None = None,
 ) -> MultiNodeOptimizer:
     """Factory mirroring the reference signature
     (``create_multi_node_optimizer(opt, comm, double_buffering)``,
@@ -637,13 +920,17 @@ def create_multi_node_optimizer(
     wire: each rank's stage-1 quantization error is carried in the
     optimizer state and added to the next step's message, removing the
     systematic rounding bias (the cumulative applied gradient tracks the
-    exact mean to one-step noise instead of drifting linearly)."""
+    exact mean to one-step noise instead of drifting linearly).
+    ``reduction_schedule`` picks the reduction algorithm
+    ('flat'/'two_level'/'zero'/'auto'; see
+    :class:`MultiNodeOptimizer` and docs/parallelism.md)."""
     return MultiNodeOptimizer(
         actual_optimizer,
         communicator,
         double_buffering=double_buffering,
         compress_dtype=allreduce_grad_dtype,
         error_feedback=error_feedback,
+        reduction_schedule=reduction_schedule,
     )
 
 

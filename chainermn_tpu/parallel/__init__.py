@@ -46,6 +46,11 @@ def __getattr__(name):
         from chainermn_tpu.parallel import zero as _z
 
         return getattr(_z, name)
+    if name in ("reduce_tree", "resolve_schedule", "bucket_partition",
+                "OverlappedBucketReducer", "SCHEDULES"):
+        from chainermn_tpu.parallel import reduction_schedule as _rs
+
+        return getattr(_rs, name)
     if name in ("moe_layer_local", "top1_route", "topk_route",
                 "load_balancing_loss", "make_expert_params"):
         from chainermn_tpu.parallel import moe as _m
@@ -89,6 +94,11 @@ __all__ = [
     "make_pipeline_hetero",
     "zero_shard_optimizer",
     "zero_state_specs",
+    "reduce_tree",
+    "resolve_schedule",
+    "bucket_partition",
+    "OverlappedBucketReducer",
+    "SCHEDULES",
     "moe_layer_local",
     "top1_route",
     "topk_route",

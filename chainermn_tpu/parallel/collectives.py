@@ -298,6 +298,65 @@ def _names_tuple(axis_names):
             else (axis_names,))
 
 
+def axes_size(axis_names) -> int:
+    """Product of the sizes of ``axis_names`` (a name or name-sequence) —
+    the logical world size of a reduction over the flattened axes."""
+    n = 1
+    for a in _names_tuple(axis_names):
+        n *= lax.axis_size(a)
+    return n
+
+
+def axes_index(axis_names):
+    """Row-major ravelled index of this shard over the flattened
+    ``axis_names`` — the in-program rank of a multi-axis group (the
+    single-axis :func:`axis_index`, generalised)."""
+    idx = 0
+    for a in _names_tuple(axis_names):
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def decomposed_allreduce(x: jax.Array, axes, *, op: str = "mean") -> jax.Array:
+    """Allreduce written out as its bandwidth-optimal decomposition:
+    ``psum_scatter`` over the LAST axis of ``axes`` (the mesh convention
+    puts the fast/intra axis last), allreduce of the 1/n shard over the
+    remaining axes (none on a flat mesh), ``all_gather`` back. On a
+    2-axis ``('inter', 'intra')`` mesh this IS the reference's
+    ``TwoDimensionalCommunicator`` pipeline
+    (``two_dimensional_communicator.py`` (dagger)); on a flat mesh it
+    pins the reduce-scatter -> all-gather schedule XLA would otherwise
+    be free to fuse back into one all-reduce — the explicit form the
+    ``'two_level'`` reduction schedule
+    (:mod:`chainermn_tpu.parallel.reduction_schedule`) compiles to,
+    HiCCL-style hierarchy-aware composition (arXiv:2408.05962)."""
+    if op not in ("sum", "mean"):
+        raise ValueError(f"op must be 'sum' or 'mean', got {op!r}")
+    names = _names_tuple(axes)
+    scatter_ax, rest = names[-1], names[:-1]
+
+    def inter(shard):
+        if rest:
+            shard = lax.psum(shard, rest)
+        if op == "mean":
+            shard = shard / axes_size(names)
+        return shard
+
+    return _two_level_frame(x, scatter_ax, inter)
+
+
+def int8_decomposed_allreduce_mean(x: jax.Array, axes) -> jax.Array:
+    """The quantized rendering of :func:`decomposed_allreduce`: exact
+    ``psum_scatter`` over the last (fast) axis, the int8 two-phase wire
+    only over the remaining axes, exact ``all_gather`` back. Flat mesh:
+    the flat int8 wire (:func:`int8_allreduce_mean`) already IS the
+    reduce-scatter -> all-gather decomposition, so it is used directly."""
+    names = _names_tuple(axes)
+    if len(names) == 1:
+        return int8_allreduce_mean(x, names)
+    return int8_two_level_allreduce_mean(x, names[-1], names[:-1])
+
+
 def _int8_core(x: jax.Array, names):
     """Shared two-phase quantized reduction. Returns ``(mean,
     local_roundtrip)`` where ``local_roundtrip`` is THIS member's
@@ -423,9 +482,12 @@ def int8_two_level_allreduce_mean_with_feedback(
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def _int8_two_level_allreduce_mean(x, intra_axis, inter_axis):
+    # inter_axis may be a single name or a tuple of names (the
+    # decomposed form over a >2-axis mesh quantizes over ALL non-scatter
+    # axes as one logical inter ring).
     def inter(shard):
         # inter MEAN on the int8 wire, then /n_intra for the total mean.
-        return (_int8_core(shard, (inter_axis,))[0]
+        return (_int8_core(shard, _names_tuple(inter_axis))[0]
                 / lax.axis_size(intra_axis))
 
     return _two_level_frame(x, intra_axis, inter).astype(x.dtype)
@@ -436,7 +498,7 @@ def _int8_2l_fwd(x, intra_axis, inter_axis):
 
 
 def _int8_2l_bwd(intra_axis, inter_axis, _, ct):
-    return (lax.pmean(ct, (inter_axis, intra_axis)),)
+    return (lax.pmean(ct, _names_tuple(inter_axis) + (intra_axis,)),)
 
 
 _int8_two_level_allreduce_mean.defvjp(_int8_2l_fwd, _int8_2l_bwd)

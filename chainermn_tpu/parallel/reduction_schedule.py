@@ -1,0 +1,415 @@
+"""Interchangeable gradient-reduction schedules — the hot-path abstraction.
+
+The one collective every data-parallel workload shares is the gradient
+reduction, and the right ALGORITHM for it depends on the topology:
+HiCCL (arXiv:2408.05962) shows hierarchy-aware collective composition
+(intra reduce-scatter -> inter allreduce -> allgather) beating a flat
+allreduce on multi-chip meshes, and Xu et al. (arXiv:2004.13336) show a
+reduce-scatter + sharded weight update strictly dominating replicated
+allreduce+update at data-parallel scale. This module gives the
+framework ONE schedule abstraction with three interchangeable,
+equivalence-tested strategies (``tests/test_reduction_schedule.py``):
+
+- ``'flat'`` — the existing packed allreduce: float leaves ride ~64 MB
+  flat buckets (the reference's ``_memory_utility.pack_params`` (dagger)
+  flat-buffer discipline, in-jit so XLA owns the copies), one fused
+  ``pmean`` per bucket.
+- ``'two_level'`` — the pinned hierarchical pipeline per bucket:
+  ``psum_scatter`` over the last (fast/intra) mesh axis, allreduce of
+  the 1/n shard over the remaining axes, ``all_gather`` back — the
+  reference's ``TwoDimensionalCommunicator`` algorithm
+  (``two_dimensional_communicator.py`` (dagger)) generalised to any
+  mesh (on a flat mesh it pins the reduce-scatter/all-gather
+  decomposition).
+- ``'zero'`` — reduce-scatter + SHARDED update + allgather, fusing with
+  :mod:`chainermn_tpu.parallel.zero`: the optimizer update itself runs
+  on 1/n of the parameters (1/n optimizer state, 1/n update FLOPs,
+  same wire bytes as the allreduce it replaces). Structural — lives in
+  :class:`chainermn_tpu.optimizers.MultiNodeOptimizer`, which calls the
+  chunk/scatter/gather building blocks here.
+
+Schedule choice is a first-class decision in the autotune registry
+(:mod:`chainermn_tpu.tuning`, decision ``'reduction_schedule'``), keyed
+(device_kind x world-shape x payload-MB bucket) and seedable offline
+from ``bench.py``'s ``overlap`` phase rows — :func:`resolve_schedule`.
+
+Double buffering (the reference's ``double_buffering_optimizer.py``
+(dagger) staleness-1 semantics) composes with the bucketed schedules:
+an overlapped reduction tags its per-bucket ``wire`` trace events with
+``overlapped=True`` so ``tools/trace_report.py`` can report the
+comm-hidden fraction; :class:`OverlappedBucketReducer` is the eager
+per-bucket driver that MEASURES the overlap (dispatch step N's bucket
+collectives without blocking, collect them after step N+1's compute).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.observability import trace as _trace
+
+PyTree = Any
+
+#: The interchangeable strategies (order = the registry's candidates).
+SCHEDULES = ("flat", "two_level", "zero")
+
+#: Registry decision name for the ``'auto'`` schedule resolution.
+DECISION = "reduction_schedule"
+
+#: ~64 MB (the tuned table default of ``allreduce_bucket_mb``) — the
+#: single fallback the bucket partition uses when no tuned size is
+#: pinned; large enough to keep the slow level bandwidth-bound, small
+#: enough to bound the transient flat copy in HBM.
+DEFAULT_BUCKET_BYTES = 64 << 20
+
+
+def bucket_partition(
+    idxs: Sequence[int],
+    sizes: Sequence[int],
+    itemsize: int = 4,
+    bucket_bytes: Optional[int] = None,
+) -> list[list[int]]:
+    """Deterministic greedy ~``bucket_bytes`` partition of the entries
+    ``idxs`` (element counts in ``sizes``) — the ONE bucket layout
+    shared by every schedule, the EF residual allocation, and the
+    overlapped reducer, so no two consumers can disagree.
+
+    Edge contract (ISSUE 3 satellite, unit-tested):
+
+    - zero-size entries are SKIPPED — they would otherwise produce
+      empty buckets whose concatenated payload has no max-abs for the
+      int8 wire's scale (callers reduce them on the exact per-leaf
+      path, where an empty array is trivially its own mean);
+    - a payload smaller than one bucket yields EXACTLY one bucket (no
+      degenerate empty tail);
+    - a single entry larger than the bucket gets its own bucket,
+      unsplit;
+    - no emitted bucket is ever empty.
+    """
+    if bucket_bytes is None:
+        bucket_bytes = DEFAULT_BUCKET_BYTES
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i in idxs:
+        nbytes = sizes[i] * itemsize
+        if nbytes == 0:
+            continue
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def resolve_schedule(
+    device_kind: Optional[str],
+    payload_bytes: int,
+    world_shape: Sequence[int],
+    *,
+    candidates: Sequence[str] = SCHEDULES,
+):
+    """The ``reduction_schedule='auto'`` resolution: winner through the
+    autotune registry, keyed ``device_kind x (world-shape, payload-MB)
+    x 'sched'`` (each dim power-of-two bucketed by ``decision_key``, so
+    nearby payloads share one decision). Returns ``(winner, record)``
+    with ``record`` the registry's decision provenance (name / winner /
+    source / key) for the observability layer. Table default is
+    ``'flat'``; a cache entry seeded from bench's ``overlap`` phase
+    rows (``python -m chainermn_tpu.tuning seed``) moves it where a
+    measured comparison shows another schedule paying."""
+    from chainermn_tpu import tuning
+
+    mb = max(1, int(payload_bytes) >> 20)
+    key = tuning.decision_key(
+        device_kind, shape=tuple(int(d) for d in world_shape) + (mb,),
+        dtype="sched",
+    )
+    winner = tuning.choice(DECISION, tuple(candidates), key)
+    rec = next(
+        (d for d in reversed(tuning.decisions_taken())
+         if d.get("name") == DECISION and d.get("key") == key),
+        None,
+    )
+    return winner, rec
+
+
+def reduce_tree(
+    grads: PyTree,
+    *,
+    schedule: str,
+    axes,
+    compress_dtype=None,
+    bucket_bytes: Optional[int] = None,
+    overlapped: bool = False,
+    provenance: Optional[dict] = None,
+    op: Optional[str] = None,
+    size: Optional[int] = None,
+) -> PyTree:
+    """Bucketed, schedule-pinned in-jit MEAN reduction of a gradient
+    pytree. Must run inside the named-axis context of ``axes`` (callers
+    probe ``collectives.axes_bound`` and fall back to their legacy
+    identity/pmean path outside it — this function does not degrade).
+
+    Leaves are grouped by wire dtype and packed into ~``bucket_bytes``
+    flat buffers (:func:`bucket_partition`); each bucket crosses the
+    wire as ONE collective pipeline chosen by ``schedule``:
+
+    - ``'flat'``: fused ``pmean`` (or the int8 two-phase wire);
+    - ``'two_level'``: :func:`~chainermn_tpu.parallel.collectives.decomposed_allreduce`
+      (reduce-scatter over the last axis -> shard allreduce over the
+      rest -> all-gather), int8 riding only the non-scatter stage.
+
+    Zero-size leaves take the exact per-leaf path (see
+    :func:`bucket_partition`'s edge contract). At TRACE time (host-side
+    Python, once per compilation — the lowered HLO is untouched) one
+    ``pack`` event plus one ``wire`` event PER BUCKET are recorded:
+    the wire events carry ``overlapped`` (true under the
+    double-buffered mode, whose update consumes the PREVIOUS step's
+    buckets — the dependency break that lets the runtime run these
+    collectives concurrently with compute) so ``tools/trace_report.py``
+    can attribute comm time to the overlap.
+    """
+    if schedule not in ("flat", "two_level"):
+        raise ValueError(
+            f"reduce_tree handles 'flat'/'two_level', got {schedule!r} "
+            "('zero' is structural — see MultiNodeOptimizer)"
+        )
+    from chainermn_tpu.parallel.collectives import (
+        decomposed_allreduce,
+        int8_allreduce_mean,
+        int8_decomposed_allreduce_mean,
+        _names_tuple,
+    )
+
+    names = _names_tuple(axes)
+    int8_wire = (compress_dtype is not None
+                 and jnp.dtype(compress_dtype) == jnp.dtype(jnp.int8))
+    leaves, treedef = jax.tree.flatten(grads)
+    if not leaves:
+        return grads
+
+    def cast_dtype(g):
+        if compress_dtype is not None and jnp.issubdtype(
+            g.dtype, jnp.floating
+        ):
+            # int8 wire: buckets pack in f32; quantization happens
+            # inside the wire per bucket.
+            return (jnp.dtype(jnp.float32) if int8_wire
+                    else jnp.dtype(compress_dtype))
+        return jnp.dtype(g.dtype)
+
+    out: list = [None] * len(leaves)
+    sizes = [g.size for g in leaves]
+    groups: dict = {}
+    for i, g in enumerate(leaves):
+        groups.setdefault(cast_dtype(g), []).append(i)
+
+    def exact_mean(g):
+        # Per-leaf exact path (zero-size leaves): pmean keeps the
+        # reference-parity dtype contract.
+        return lax.pmean(g, names).astype(g.dtype)
+
+    def reduce_bucket(flat, dt):
+        if int8_wire and jnp.issubdtype(dt, jnp.floating):
+            if schedule == "two_level":
+                return int8_decomposed_allreduce_mean(flat, names)
+            return int8_allreduce_mean(flat, names)
+        if schedule == "two_level":
+            return decomposed_allreduce(flat, names, op="mean")
+        return lax.pmean(flat, names)
+
+    rec = _trace.active()
+    n_buckets_total = 0
+    bucket_meta: list[tuple[int, str]] = []  # (wire nbytes, dtype name)
+    for dt, idxs in groups.items():
+        itemsize = jnp.dtype(dt).itemsize
+        wire_item = (1 if int8_wire and jnp.issubdtype(dt, jnp.floating)
+                     else itemsize)
+        buckets = bucket_partition(idxs, sizes, itemsize, bucket_bytes)
+        bucketed = {i for b in buckets for i in b}
+        for i in idxs:
+            if i not in bucketed:  # zero-size leaf: exact per-leaf path
+                out[i] = exact_mean(leaves[i])
+        n_buckets_total += len(buckets)
+        for bidx in buckets:
+            flat = jnp.concatenate(
+                [leaves[i].astype(dt).ravel() for i in bidx]
+            )
+            red = reduce_bucket(flat, dt)
+            off = 0
+            for i in bidx:
+                n = leaves[i].size
+                out[i] = (
+                    red[off: off + n]
+                    .reshape(leaves[i].shape)
+                    .astype(leaves[i].dtype)
+                )
+                off += n
+            bucket_meta.append((flat.size * wire_item, jnp.dtype(dt).name))
+
+    if rec is not None:
+        def wire_itemsize(g):
+            if int8_wire and jnp.issubdtype(g.dtype, jnp.floating):
+                return 1
+            return jnp.dtype(cast_dtype(g)).itemsize
+
+        wire_name = ("int8" if int8_wire else
+                     (jnp.dtype(compress_dtype).name
+                      if compress_dtype is not None else "none"))
+        rec.event(
+            "pack", op=(op or f"scheduled_reduce[{schedule}]"),
+            nbytes=sum(g.size * wire_itemsize(g) for g in leaves),
+            bucket_bytes=(bucket_bytes if bucket_bytes is not None
+                          else DEFAULT_BUCKET_BYTES),
+            n_buckets=n_buckets_total,
+            wire_dtype=wire_name,
+            provenance=provenance,
+            **({"size": size} if size is not None else {}),
+        )
+        for b_i, (nbytes, dt_name) in enumerate(bucket_meta):
+            rec.event(
+                "wire", schedule=schedule, bucket=b_i,
+                n_buckets=n_buckets_total, nbytes=nbytes,
+                wire_dtype=("int8" if int8_wire and "float" in dt_name
+                            else dt_name),
+                overlapped=bool(overlapped),
+            )
+    return jax.tree.unflatten(treedef, out)
+
+
+class OverlappedBucketReducer:
+    """Eager double-buffered per-bucket gradient reduction — the
+    MEASURED side of the overlap story (the in-jit double-buffered mode
+    relies on XLA's async scheduler; this driver makes the overlap an
+    explicit host-side pipeline, and its wire events carry true
+    durations).
+
+    Usage (the staleness-1 loop, reference
+    ``double_buffering_optimizer.py`` (dagger) semantics)::
+
+        red = OverlappedBucketReducer(comm)
+        red.dispatch(stacked_grads_t)       # per-bucket collectives fly
+        ...compute step t+1's backward...   # overlaps the wire
+        mean_t = red.collect()              # blocks only on what's left
+
+    ``dispatch`` partitions the stacked gradient tree (leaves
+    ``[size, ...]``, the eager-communicator convention) into the tuned
+    ~64 MB buckets and launches one jitted mean-allreduce per bucket
+    WITHOUT blocking — JAX's async dispatch keeps them in flight while
+    the caller computes. ``collect`` blocks on each bucket and records
+    one ``wire`` trace event per bucket with ``dur_s`` (dispatch ->
+    ready) and ``blocked_s`` (time actually spent waiting inside
+    collect): the difference is the comm time HIDDEN behind compute,
+    which ``tools/trace_report.py``'s overlap section aggregates into
+    the comm-hidden fraction.
+    """
+
+    def __init__(self, comm, *, bucket_bytes: Optional[int] = None) -> None:
+        self.comm = comm
+        if bucket_bytes is None:
+            from chainermn_tpu.parallel.collectives import tuned_bucket_bytes
+
+            bucket_bytes = tuned_bucket_bytes(comm.device_kind, comm.size)
+        self.bucket_bytes = bucket_bytes
+        self._inflight: list = []
+        self._layout = None
+
+    @property
+    def in_flight(self) -> bool:
+        return bool(self._inflight)
+
+    def dispatch(self, grads_stacked: PyTree) -> int:
+        """Launch this step's per-bucket mean-allreduces (leaves are
+        stacked ``[size, ...]`` per-rank contributions); returns the
+        bucket count. A previous step's reduction must have been
+        collected first."""
+        if self._inflight:
+            raise RuntimeError(
+                "a bucketed reduction is already in flight — collect() "
+                "the previous step before dispatching the next"
+            )
+        n = self.comm.size
+        leaves, treedef = jax.tree.flatten(grads_stacked)
+        for leaf in leaves:
+            if leaf.shape[0] != n:
+                raise ValueError(
+                    f"stacked leaves must have leading dim == size ({n}), "
+                    f"got {leaf.shape}"
+                )
+        sizes = [leaf[0].size for leaf in leaves]
+        # itemsize 4: every bucket packs (and crosses the wire) in f32.
+        buckets = bucket_partition(
+            list(range(len(leaves))), sizes, 4, self.bucket_bytes,
+        )
+        self._layout = (treedef, leaves, buckets)
+        mean = self.comm._jitted["mean"]
+        for b_i, bidx in enumerate(buckets):
+            flat = jnp.concatenate(
+                [jnp.asarray(leaves[i]).astype(jnp.float32).reshape(n, -1)
+                 for i in bidx],
+                axis=1,
+            )
+            t0 = time.perf_counter()
+            out = mean(flat)  # async dispatch: returns before the wire
+            self._inflight.append((b_i, bidx, out, t0, int(flat.nbytes)))
+        return len(buckets)
+
+    def collect(self) -> PyTree:
+        """Block on the in-flight buckets and return the reduced mean
+        tree (leaves ``[...]``, un-stacked). Records one ``wire`` event
+        per bucket: ``dur_s`` is dispatch->ready, ``blocked_s`` the
+        wait actually paid here — ``dur_s - blocked_s`` is comm hidden
+        behind whatever the caller computed in between."""
+        if not self._inflight:
+            raise RuntimeError("collect() with no dispatched reduction")
+        treedef, leaves, buckets = self._layout
+        rec = _trace.active()
+        out: list = [None] * len(leaves)
+        bucketed = {i for b in buckets for i in b}
+        for i, leaf in enumerate(leaves):
+            if i not in bucketed:  # zero-size leaves: mean is identity
+                out[i] = jnp.asarray(leaf)[0]
+        for b_i, bidx, red, t0, nbytes in self._inflight:
+            t_c = time.perf_counter()
+            red = jax.block_until_ready(red)
+            t_r = time.perf_counter()
+            if rec is not None:
+                dur = t_r - t0
+                blocked = t_r - t_c
+                rec.event(
+                    "wire", schedule="overlap_eager", bucket=b_i,
+                    n_buckets=len(buckets), nbytes=nbytes,
+                    dur_s=round(dur, 9), blocked_s=round(blocked, 9),
+                    overlapped=bool(dur - blocked > 0),
+                )
+            row = red[0]  # [k]: the replicated mean
+            off = 0
+            for i in bidx:
+                k = leaves[i][0].size
+                out[i] = (row[off: off + k]
+                          .reshape(leaves[i].shape[1:])
+                          .astype(leaves[i].dtype))
+                off += k
+        self._inflight = []
+        self._layout = None
+        return jax.tree.unflatten(treedef, out)
+
+
+__all__ = [
+    "DECISION",
+    "DEFAULT_BUCKET_BYTES",
+    "OverlappedBucketReducer",
+    "SCHEDULES",
+    "bucket_partition",
+    "reduce_tree",
+    "resolve_schedule",
+]

@@ -25,6 +25,14 @@ Usage (inside the shard_map'd train step, like every in-jit collective):
     state = opt.init(params)          # per-shard: holds 1/n of adam state
     updates, state = opt.update(grads, state, params)
     params = optax.apply_updates(params, updates)
+
+``axis_name`` may also be a TUPLE of mesh axes: the state then shards
+over their flattened product (ravelled index, product size) — the
+layout the data-parallel wrapper's ``reduction_schedule='zero'``
+(:mod:`chainermn_tpu.parallel.reduction_schedule`,
+:class:`chainermn_tpu.optimizers.MultiNodeOptimizer`) builds on, where
+the reduce-scatter, the 1/n update, and the allgather fuse into the
+gradient-reduction hot path itself (arXiv:2004.13336).
 """
 
 from __future__ import annotations
@@ -36,6 +44,16 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+# Multi-axis group helpers: ONE owner of the flattened ravelled-index
+# convention (collectives) — the 'zero' reduction schedule depends on
+# the scatter chunk index and the state shard index agreeing, so no
+# second copy of the axis-order rule may live here.
+from chainermn_tpu.parallel.collectives import (
+    _names_tuple as _names,
+    axes_index as _group_index,
+    axes_size as _group_size,
+)
+
 PyTree = Any
 
 
@@ -44,6 +62,8 @@ def _chunk_rows(x: jax.Array, n: int) -> jax.Array:
     flat = x.reshape(-1)
     c = -(-flat.size // n)  # ceil
     return jnp.pad(flat, (0, n * c - flat.size)).reshape(n, c)
+
+
 
 
 def _unchunk(rows: jax.Array, shape, dtype) -> jax.Array:
@@ -91,9 +111,11 @@ def zero_shard_optimizer(
     bf16-compressed-allreduce feature, applied to the scatter instead).
     """
 
+    names = _names(axis_name)
+
     def my_chunk(tree: PyTree) -> PyTree:
-        idx = lax.axis_index(axis_name)
-        n = lax.axis_size(axis_name)
+        idx = _group_index(names)
+        n = _group_size(names)
         return jax.tree.map(
             lambda x: lax.dynamic_index_in_dim(
                 _chunk_rows(x, n), idx, keepdims=False
@@ -104,31 +126,38 @@ def zero_shard_optimizer(
     def init_fn(params: PyTree):
         return inner.init(my_chunk(params))
 
+    def _scatter(rows):
+        # [n_total, c] -> this shard's [c] chunk-sum: one psum_scatter
+        # per axis (rows viewed [n_a, n_b, ..., c]; each stage scatters
+        # its leading axis) — a flattened multi-axis reduce-scatter.
+        dims = tuple(lax.axis_size(a) for a in names)
+        rows = rows.reshape(dims + rows.shape[1:])
+        for a in names:
+            rows = lax.psum_scatter(
+                rows, a, scatter_dimension=0, tiled=False
+            )
+        return rows
+
     def update_fn(grads: PyTree, state, params: Optional[PyTree] = None):
-        n = lax.axis_size(axis_name)
+        n = _group_size(names)
 
         def rs(g):
             rows = _chunk_rows(g, n)
             if compress_dtype is not None and jnp.issubdtype(
                 g.dtype, jnp.floating
             ):
-                return (
-                    lax.psum_scatter(
-                        rows.astype(compress_dtype), axis_name,
-                        scatter_dimension=0, tiled=False,
-                    ).astype(g.dtype)
-                    / n
-                )
-            return lax.psum_scatter(
-                rows, axis_name, scatter_dimension=0, tiled=False
-            ) / n
+                return (_scatter(rows.astype(compress_dtype))
+                        .astype(g.dtype) / n)
+            return _scatter(rows) / n
 
         grad_chunks = jax.tree.map(rs, grads)
         param_chunks = my_chunk(params) if params is not None else None
         update_chunks, state = inner.update(grad_chunks, state, param_chunks)
 
         def ag(u, g):
-            rows = lax.all_gather(u, axis_name, axis=0, tiled=False)
+            rows = u
+            for a in reversed(names):
+                rows = lax.all_gather(rows, a, axis=0, tiled=False)
             return _unchunk(rows, g.shape, g.dtype)
 
         updates = jax.tree.map(ag, update_chunks, grads)

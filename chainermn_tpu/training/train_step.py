@@ -206,6 +206,19 @@ def make_train_step(
             step=P(),
             model_state=P(),
         )
+    else:
+        # Schedule-aware state carry: a 'zero' reduction schedule's
+        # optimizer state is 1/n per shard (stacked [n, ...] leaves) —
+        # the optimizer publishes the prefix spec and the step threads
+        # it, the same honest-sharding pattern as the EF residual.
+        opt_spec = P()
+        spec_fn = getattr(optimizer, "opt_state_spec", None)
+        if spec_fn is not None:
+            opt_spec = spec_fn()
+        if opt_spec != P():
+            state_spec = TrainState(
+                params=P(), opt_state=opt_spec, step=P(), model_state=P()
+            )
 
     _loss_with_aux = normalize_loss_fn(loss_fn)
 
@@ -291,6 +304,22 @@ def make_train_step(
         check_vma=False,
     )
     jitted = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    # Overlap metadata for the observability layer: the Trainer emits
+    # this once as an ``overlap_config`` trace event, so a trace's
+    # comm-hidden numbers carry the mode that produced them (schedule,
+    # staleness, donation). Best-effort — the jit wrapper may refuse
+    # attributes on some jax versions.
+    db = bool(getattr(optimizer, "double_buffering", False))
+    overlap_info = {
+        "double_buffering": db,
+        "staleness": 1 if db else 0,
+        "schedule": getattr(optimizer, "reduction_schedule", None),
+        "donate": bool(donate),
+    }
+    try:
+        jitted.overlap_info = overlap_info
+    except (AttributeError, TypeError):
+        pass
     if not ef:
         return jitted
 
@@ -337,6 +366,7 @@ def make_train_step(
                 )
         return jitted(state, batch)
 
+    step_with_residual_check.overlap_info = overlap_info
     return step_with_residual_check
 
 

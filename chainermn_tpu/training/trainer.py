@@ -163,6 +163,16 @@ class Trainer:
 
     def run(self, max_iterations: int) -> Any:
         t0 = time.perf_counter()
+        rec0 = _trace.active()
+        if rec0 is not None:
+            # Comm/compute-overlap configuration of the step driving this
+            # loop (make_train_step attaches it): recorded once so the
+            # trace's wire events can be read against the mode —
+            # double-buffered staleness, reduction schedule, donation —
+            # that produced them (tools/trace_report.py "overlap").
+            info = getattr(self.step_fn, "overlap_info", None)
+            if info:
+                rec0.event("overlap_config", **dict(info))
         batches = self._collated_batches(max_iterations - self.iteration)
         if self.prefetch:
             import math

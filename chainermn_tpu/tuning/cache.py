@@ -213,6 +213,36 @@ def _seed_one_result(result: dict, source: str, out: list,
                 else "none",
                 {"busbw_gbps": by_mode})
 
+    # Reduction schedule: the overlap phase's per-schedule step-time
+    # medians (ISSUE 3 — bench's ``overlap`` rows, carried TPU blob
+    # included, become the 'auto' schedule's evidence). The key must
+    # reproduce resolve_schedule's exactly: world-shape + payload-MB
+    # bucket, dtype tag 'sched' — bench records both alongside the rows.
+    sched_ms = result.get("overlap_schedule_ms")
+    if isinstance(sched_ms, dict) and len(sched_ms) >= 2 and all(
+        isinstance(v, (int, float)) for v in sched_ms.values()
+    ):
+        # Spread-gated like the LIVE adoption path (measure.decide): a
+        # schedule "winner" inside the run's own noise band must not be
+        # pinned into the cache — the in-run record_measurement refused
+        # it, and the offline seeder must not resurrect it.
+        from chainermn_tpu.tuning.measure import decide
+
+        spread = float(result.get("overlap_schedule_spread_pct", 0.0))
+        winner = decide(sched_ms, {k: spread for k in sched_ms})
+        if winner is not None:
+            world = result.get("overlap_world_shape") or [
+                result.get("n_devices", 1)
+            ]
+            payload_mb = result.get("overlap_payload_mb", 1)
+            key = _bucketed_key(
+                kind, tuple(world) + (payload_mb,), "sched"
+            )
+            put("reduction_schedule", key, winner,
+                {"candidates_ms": {k: round(float(v), 4)
+                                   for k, v in sched_ms.items()},
+                 "spread_pct": spread})
+
     # Double buffering: the measured on/off step-time ratio.
     speedup = result.get("double_buffer_speedup")
     if speedup:

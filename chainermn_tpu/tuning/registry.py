@@ -46,6 +46,12 @@ from chainermn_tpu.tuning import measure as _measure
 #: - ``double_buffering``: measured 0.752x on the CPU proxy and 0.85x on
 #:   a single chip (no collective to overlap) — ``off`` until a
 #:   multi-slice capture shows the overlap paying.
+#: - ``reduction_schedule``: ``flat`` everywhere until measured — XLA
+#:   already derives a topology-aware schedule from the fused pmean,
+#:   so the pinned ``two_level``/``zero`` pipelines must EARN their
+#:   extra program structure with a bench ``overlap``-phase win
+#:   (seeded from BENCH_DETAILS.json ``overlap_schedule_ms`` rows; see
+#:   chainermn_tpu.parallel.reduction_schedule).
 DEFAULT_TABLE: dict = {
     "moe_dispatch": {"cpu": "sort", "tpu": "sort", "*": "sort"},
     "attention": {"cpu": "xla", "tpu": "flash", "*": "flash"},
@@ -53,6 +59,7 @@ DEFAULT_TABLE: dict = {
     "allreduce_wire": {"*": "bf16"},
     "allreduce_bucket_mb": {"*": "64"},
     "double_buffering": {"*": "off"},
+    "reduction_schedule": {"*": "flat"},
 }
 
 _MODE_ENV = "CHAINERMN_TPU_AUTOTUNE"

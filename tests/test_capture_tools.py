@@ -144,6 +144,24 @@ def _golden_trace_lines():
          "flagged_ranks": [3],
          "phases": {"compute": {"median_s": 0.01, "worst_rank": 3,
                                 "worst_rel_dev": 0.8, "flagged": [3]}}},
+        # ISSUE 3: overlap configuration + per-bucket wire events — one
+        # trace-time layout event (no dur) and two MEASURED eager-
+        # reducer events (dur = dispatch->ready, blocked = wait paid at
+        # collect; the 4 ms gap on bucket 0 is comm hidden by compute).
+        {"schema": 1, "kind": "overlap_config", "t": 1.8, "pid": 1,
+         "rank": 0, "double_buffering": True, "staleness": 1,
+         "schedule": "two_level", "donate": True},
+        {"schema": 1, "kind": "wire", "t": 1.9, "pid": 1, "rank": 0,
+         "schedule": "two_level", "bucket": 0, "n_buckets": 1,
+         "nbytes": 1000, "wire_dtype": "bfloat16", "overlapped": True},
+        {"schema": 1, "kind": "wire", "t": 2.0, "pid": 1, "rank": 0,
+         "schedule": "overlap_eager", "bucket": 0, "n_buckets": 2,
+         "nbytes": 4096, "dur_s": 0.005, "blocked_s": 0.001,
+         "overlapped": True},
+        {"schema": 1, "kind": "wire", "t": 2.1, "pid": 1, "rank": 0,
+         "schedule": "overlap_eager", "bucket": 1, "n_buckets": 2,
+         "nbytes": 4096, "dur_s": 0.003, "blocked_s": 0.003,
+         "overlapped": False},
     ]
     return [_json.dumps(e) for e in evs] + ['{"torn']
 
@@ -170,7 +188,7 @@ def test_trace_report_contract(tmp_path):
         "schema_versions": [1],
         "meta": {"started_at": "2026-08-03T00:00:00Z", "sync": False,
                  "source": "bench"},
-        "n_events": 8,  # torn tail line skipped, not fatal
+        "n_events": 12,  # torn tail line skipped, not fatal
         "collectives": [
             {"op": "allreduce_grad", "plane": "device", "n": 2,
              "total_bytes": 2000, "total_s": 0.004, "mean_ms": 2.0,
@@ -191,10 +209,22 @@ def test_trace_report_contract(tmp_path):
         "stragglers": [{"flagged_ranks": [3], "phases": {
             "compute": {"median_s": 0.01, "worst_rank": 3,
                         "worst_rel_dev": 0.8, "flagged": [3]}}}],
+        # ISSUE 3: per-step comm vs comm-overlapped-with-compute, from
+        # the per-bucket wire events. 8 ms of measured bucket comm, 4 ms
+        # of it waited on -> half the wire rode behind compute.
+        "overlap": {
+            "config": [{"double_buffering": True, "staleness": 1,
+                        "schedule": "two_level", "donate": True}],
+            "schedules": {"two_level": {"buckets": 1, "nbytes": 1000,
+                                        "overlapped": 1}},
+            "measured": {"n": 2, "comm_ms_total": 8.0,
+                         "comm_ms_blocked": 4.0, "comm_ms_hidden": 4.0,
+                         "hidden_fraction": 0.5},
+        },
     }, summary
     # chrome export emitted alongside
     chrome = _json.loads(chrome_file.read_text())
-    assert len(chrome["traceEvents"]) == 7  # meta excluded
+    assert len(chrome["traceEvents"]) == 11  # meta excluded
     # and the human rendering mentions the essentials
     proc2 = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
@@ -202,7 +232,8 @@ def test_trace_report_contract(tmp_path):
         capture_output=True, text=True, cwd=_REPO,
     )
     assert proc2.returncode == 0
-    for token in ("allreduce_grad", "STRAGGLER", "allreduce_wire=bf16"):
+    for token in ("allreduce_grad", "STRAGGLER", "allreduce_wire=bf16",
+                  "comm/compute overlap", "50.0% hidden"):
         assert token in proc2.stdout, (token, proc2.stdout)
 
 

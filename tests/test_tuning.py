@@ -181,6 +181,10 @@ _FAKE_DETAILS = {
     "attn_shape": "B1xT256xH2xD64_bf16_causal",
     "flash_fwdbwd_ms": 4.893, "xla_fwdbwd_ms": 2.739,
     "double_buffer_speedup": 0.752, "double_buffer_spread_pct": 19.4,
+    # ISSUE 3: the overlap phase's per-schedule medians + key material
+    "overlap_schedule_ms": {"flat": 11.3, "two_level": 11.8, "zero": 9.4},
+    "overlap_schedule_spread_pct": 8.5,
+    "overlap_world_shape": [8], "overlap_payload_mb": 1,
     "last_good_tpu": {
         # a 4-chip-shaped blob so the wire seeding (gated on a real
         # multi-member axis) is exercised
@@ -191,6 +195,10 @@ _FAKE_DETAILS = {
         "attn_shape": "B4xT4096xH8xD128_bf16_causal",
         "flash_fwdbwd_ms": 13.605, "xla_fwdbwd_ms": 41.08,
         "double_buffer_speedup": 0.85,
+        "overlap_schedule_ms": {"flat": 5.0, "two_level": 3.9,
+                                "zero": 4.4},
+        "overlap_schedule_spread_pct": 2.0,
+        "overlap_world_shape": [4], "overlap_payload_mb": 128,
         "allreduce_curve": [
             {"mib": 128, "dtype": "bfloat16", "mode": "fused",
              "busbw_gbps": 101.6},
@@ -304,6 +312,28 @@ class TestSeeding:
             tuning.decision_key("TPU v5 lite", shape=(4,), dtype="step"),
         ):
             assert doc[f"double_buffering|{koff}"]["winner"] == "off"
+        # reduction schedule (ISSUE 3): each backend's overlap rows seed
+        # ITS winner under its own (world-shape, payload-MB) key — the
+        # exact key MultiNodeOptimizer's 'auto' resolution asks for.
+        cpu_sched = tuning.decision_key("cpu", shape=(8, 1), dtype="sched")
+        assert doc[f"reduction_schedule|{cpu_sched}"]["winner"] == "zero"
+        assert doc[f"reduction_schedule|{cpu_sched}"]["candidates_ms"][
+            "two_level"] == 11.8
+        tpu_sched = tuning.decision_key(
+            "TPU v5 lite", shape=(4, 128), dtype="sched"
+        )
+        assert doc[f"reduction_schedule|{tpu_sched}"]["winner"] == (
+            "two_level"
+        )
+        # and the seeded entry answers resolve_schedule without
+        # re-measuring (the 'auto' front door)
+        from chainermn_tpu.parallel.reduction_schedule import (
+            resolve_schedule,
+        )
+
+        winner, rec = resolve_schedule("cpu", 1 << 20, (8,))
+        assert winner == "zero"
+        assert rec["source"].startswith("cache:seeded")
 
     def test_seeding_from_repo_details_is_self_consistent(self):
         """The REAL BENCH_DETAILS.json seeds without error and its

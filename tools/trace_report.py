@@ -18,6 +18,15 @@ Sections:
   extensions).
 - **dispatch** — every autotune decision the traced processes resolved
   (name=winner(source), keyed).
+- **overlap** — comm/compute overlap (ISSUE 3): the step's overlap
+  configuration (``overlap_config`` events — double-buffering
+  staleness, reduction schedule, donation), the per-bucket ``wire``
+  layout the compiled schedules committed to, and — where measured
+  wire events exist (the eager ``OverlappedBucketReducer``; dur =
+  dispatch->ready, blocked = wait actually paid at collect) — per-step
+  comm time vs comm time hidden behind compute and the
+  ``hidden_fraction`` between them. Omitted when the trace carries no
+  overlap events.
 - **stragglers** — flagged divergence reports, if any.
 - **roofline** — where a device kind with a known HBM peak appears
   (bench.py's per-kind tables, the same floors tools/byte_audit.py
@@ -208,6 +217,12 @@ def summarize(events: list[dict]) -> dict:
         entry.pop("_devices")
     if floors:
         out["roofline"] = floors
+
+    # Overlap section (one owner of the rollup: the trace module's
+    # summarize_overlap — bench's overlap phase reads the same shape).
+    overlap = _trace_mod().summarize_overlap(events)
+    if overlap is not None:
+        out["overlap"] = overlap
     return out
 
 
@@ -261,6 +276,31 @@ def render_text(s: dict) -> str:
                 f"  {p['op']}: {p['n_buckets']} bucket(s) x "
                 f"<= {_fmt_bytes(p['bucket_bytes'] or 0)}, wire "
                 f"{p['wire_dtype']}, {_fmt_bytes(p['nbytes'] or 0)} total"
+            )
+    if s.get("overlap"):
+        ov = s["overlap"]
+        lines.append("")
+        lines.append("comm/compute overlap:")
+        for cfg in ov.get("config", []):
+            lines.append(
+                f"  mode: double_buffering={cfg.get('double_buffering')} "
+                f"staleness={cfg.get('staleness')} "
+                f"schedule={cfg.get('schedule') or 'communicator-default'} "
+                f"donate={cfg.get('donate')}"
+            )
+        for name, row in ov.get("schedules", {}).items():
+            lines.append(
+                f"  {name}: {row['buckets']} bucket(s), "
+                f"{_fmt_bytes(row['nbytes'])} wire, "
+                f"{row['overlapped']} overlapped"
+            )
+        m = ov.get("measured")
+        if m:
+            lines.append(
+                f"  measured: comm {m['comm_ms_total']:.3f} ms total, "
+                f"{m['comm_ms_hidden']:.3f} ms hidden behind compute "
+                f"({m['hidden_fraction'] * 100:.1f}% hidden, "
+                f"{m['n']} bucket events)"
             )
     if s["stragglers"]:
         lines.append("")
