@@ -28,13 +28,19 @@ def apply_rope(x, positions, base: float = 10000.0):
 
     ``positions``: ``[T]`` GLOBAL positions — sequence-parallel shards pass
     their own offsets, so rotations agree across shards (rotation commutes
-    with the ring/Ulysses resharding because it is per-position).
+    with the ring/Ulysses resharding because it is per-position). A
+    ``[B, T]`` array gives each batch row its OWN positions — the serving
+    engine's slot array, where every slot sits at a different depth.
     """
     half = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None]  # [T, half]
-    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # [..., T, half]
+    if ang.ndim == 2:  # [T, half]: shared across the batch
+        cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+        sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    else:  # [B, T, half]: per-row slot positions
+        cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+        sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
                            axis=-1)
@@ -68,6 +74,28 @@ class TransformerBlock(nn.Module):
     #: bidirectional attention when False (encoder blocks — ViT, BERT
     #: style). Decode/window paths are causal-only and reject it.
     causal: bool = True
+    #: decode KV-cache layout: ``'dense'`` (``[B, decode_max_len, ...]``
+    #: per slot — the classic fixed ring) or ``'paged'`` (shared block
+    #: pool + per-slot block tables, :mod:`chainermn_tpu.ops.paged_kv` —
+    #: the serving engine's HBM-shared layout). Paged requires the
+    #: per-row decode path (``decode_positions`` + ``block_tables``).
+    kv_layout: str = "dense"
+    #: tokens per pool block (paged layout; tuned via the
+    #: ``kv_block_size`` autotune decision).
+    kv_block_size: int = 64
+    #: pool capacity in blocks (paged layout; block 0 is scratch).
+    kv_num_blocks: int = 0
+    #: mesh axis name for tensor-parallel decode: the block then holds
+    #: LOCAL heads/kv-heads/d_ff (set ``head_dim`` explicitly) and
+    #: inserts exactly one ``psum`` per column→row pair (attention
+    #: output projection, FFN down projection) via
+    #: :mod:`chainermn_tpu.parallel.tensor`'s adjoint ops. Row-parallel
+    #: biases must be pre-divided by the axis size (the engine's param
+    #: sharder does this).
+    tp_axis: Optional[str] = None
+    #: per-head width override; required under ``tp_axis`` where
+    #: ``d_model // num_heads`` no longer holds (num_heads is local).
+    head_dim: Optional[int] = None
 
     def _decode_attend(self, qh, kh_new, vh_new, head_dim):
         """One-token attention against the mutable KV cache.
@@ -128,17 +156,128 @@ class TransformerBlock(nn.Module):
             self.compute_dtype
         )
 
+    def _slot_decode_attend(self, qh, kh_new, vh_new, head_dim, positions,
+                            block_tables, slots):
+        """Slot-array cached attention (the serving engine's path).
+
+        Unlike :meth:`_decode_attend`'s shared scalar write index, every
+        batch row carries its OWN position (``positions[b]`` = where row
+        ``b``'s first new token is written), so a fixed slot array can
+        hold requests at arbitrary depths in one compiled program.
+        ``T >= 1`` tokens per row are written at ``positions[b] + t`` and
+        each query ``t`` attends with the causal mask ``pos <=
+        positions[b] + t`` — ``T == 1`` is the steady-state decode step,
+        ``T == bucket`` is prefill (pad-position writes land beyond the
+        row's true length and are re-written by later decode steps
+        before any mask ever admits them).
+
+        Two cache layouts behind one arithmetic: ``'dense'`` stores
+        ``[n_slots, decode_max_len, kvh, dh]`` directly (``slots`` maps
+        token rows onto cache rows — prefill passes one slot id, the
+        decode step passes None for the identity); ``'paged'`` scatters
+        into the shared block pool and gathers the row's blocks back
+        into the SAME dense view (:mod:`chainermn_tpu.ops.paged_kv`), so
+        the einsums/masks — and therefore the tokens — are identical
+        between the layouts.
+        """
+        B, T = qh.shape[:2]
+        kv_heads = kh_new.shape[2]
+        dt = self.compute_dtype
+        if self.kv_layout == "paged":
+            from chainermn_tpu.ops.paged_kv import paged_lookup, paged_update
+
+            if block_tables is None:
+                raise ValueError("kv_layout='paged' needs block_tables")
+            if self.kv_num_blocks < 2:
+                raise ValueError(
+                    "kv_layout='paged' needs kv_num_blocks >= 2 (block 0 "
+                    f"is scratch), got {self.kv_num_blocks}"
+                )
+            nb, bs = self.kv_num_blocks, self.kv_block_size
+            pk = self.variable(
+                "cache", "pool_key",
+                lambda: jnp.zeros((nb, bs, kv_heads, head_dim), dt),
+            )
+            pv = self.variable(
+                "cache", "pool_value",
+                lambda: jnp.zeros((nb, bs, kv_heads, head_dim), dt),
+            )
+            pk.value = paged_update(pk.value, block_tables, positions,
+                                    kh_new.astype(dt))
+            pv.value = paged_update(pv.value, block_tables, positions,
+                                    vh_new.astype(dt))
+            keys = paged_lookup(pk.value, block_tables)
+            vals = paged_lookup(pv.value, block_tables)
+        else:
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros(
+                    (B, self.decode_max_len, kv_heads, head_dim), dt
+                ),
+            )
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros(
+                    (B, self.decode_max_len, kv_heads, head_dim), dt
+                ),
+            )
+            rows = (jnp.arange(B, dtype=jnp.int32)
+                    if slots is None else slots)
+            cols = positions[:, None] + jnp.arange(T, dtype=positions.dtype)
+            ck.value = ck.value.at[rows[:, None], cols].set(
+                kh_new.astype(dt)
+            )
+            cv.value = cv.value.at[rows[:, None], cols].set(
+                vh_new.astype(dt)
+            )
+            if slots is None:
+                keys, vals = ck.value, cv.value
+            else:  # prefill view: gather just the written rows
+                keys = ck.value[slots]
+                vals = cv.value[slots]
+
+        L = keys.shape[1]
+        pos_l = jnp.arange(L)
+        qpos = positions[:, None] + jnp.arange(T, dtype=positions.dtype)
+        mask = pos_l[None, None, :] <= qpos[:, :, None]  # [B, T, L]
+        if self.window is not None:
+            mask &= pos_l[None, None, :] > qpos[:, :, None] - self.window
+        group = self.num_heads // kv_heads
+        q = qh.reshape(B, T, kv_heads, group, head_dim)
+        scores = jnp.einsum(
+            "btngd,blnd->btngl", q.astype(jnp.float32),
+            keys.astype(jnp.float32),
+        ) * (head_dim ** -0.5)
+        scores = jnp.where(mask[:, :, None, None, :], scores, -jnp.inf)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("btngl,blnd->btngd", w, vals.astype(jnp.float32))
+        return o.reshape(B, T, self.num_heads, head_dim).astype(dt)
+
     @nn.compact
     def __call__(self, x, segment_ids=None, rope_positions=None,
-                 train: bool = True, decode: bool = False):
+                 train: bool = True, decode: bool = False,
+                 decode_positions=None, block_tables=None,
+                 decode_slots=None):
         # ``train`` is positional so ``nn.remat(..., static_argnums=(4,))``
-        # can mark it static.
+        # can mark it static. ``decode_positions`` ([B] int32 first-new
+        # -token positions) selects the slot-array decode path
+        # (:meth:`_slot_decode_attend`); ``block_tables`` ([B, max_blocks]
+        # int32) feeds the paged layout; ``decode_slots`` ([B] int32) maps
+        # token rows onto dense-cache rows (prefill of one slot out of
+        # many).
         D = x.shape[-1]
-        head_dim = D // self.num_heads
+        head_dim = self.head_dim or D // self.num_heads
         kv_heads = self.num_kv_heads or self.num_heads
         attn = self.attention_fn or blockwise_attention
+        if self.tp_axis is not None:
+            from chainermn_tpu.parallel.tensor import (
+                copy_to_tp,
+                reduce_from_tp,
+            )
 
         h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
+        if self.tp_axis is not None:
+            h = copy_to_tp(h, self.tp_axis)
         qkv = nn.Dense(
             (self.num_heads + 2 * kv_heads) * head_dim, use_bias=False,
             dtype=self.compute_dtype, param_dtype=jnp.float32, name="qkv",
@@ -158,13 +297,19 @@ class TransformerBlock(nn.Module):
             qh = apply_rope(qh, rope_positions)
             kh = apply_rope(kh, rope_positions)
         if decode:
-            if T != 1:
-                raise ValueError(
-                    f"decode=True expects one token per step, got T={T}"
-                )
             if not self.causal:
                 raise ValueError("decode=True requires a causal block")
-            o = self._decode_attend(qh, kh, heads(v, kv_heads), head_dim)
+            if decode_positions is not None:
+                o = self._slot_decode_attend(
+                    qh, kh, heads(v, kv_heads), head_dim,
+                    decode_positions, block_tables, decode_slots,
+                )
+            else:
+                if T != 1:
+                    raise ValueError(
+                        f"decode=True expects one token per step, got T={T}"
+                    )
+                o = self._decode_attend(qh, kh, heads(v, kv_heads), head_dim)
         else:
             if self.window is not None and self.attention_fn is None:
                 raise ValueError(
@@ -181,12 +326,18 @@ class TransformerBlock(nn.Module):
         o = nn.Dense(
             D, use_bias=False,
             dtype=self.compute_dtype, param_dtype=jnp.float32, name="proj",
-        )(o.reshape(B, T, D))
+        )(o.reshape(B, T, self.num_heads * head_dim))
+        if self.tp_axis is not None:
+            # Row-parallel output projection: the ONE psum of the
+            # attention column→row pair.
+            o = reduce_from_tp(o, self.tp_axis)
         if self.dropout_rate > 0.0:
             o = nn.Dropout(self.dropout_rate, deterministic=not train)(o)
         x = x + o
 
         h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
+        if self.tp_axis is not None:
+            h = copy_to_tp(h, self.tp_axis)
         h = nn.Dense(
             self.d_ff, dtype=self.compute_dtype, param_dtype=jnp.float32,
             name="ff_up",
@@ -195,6 +346,11 @@ class TransformerBlock(nn.Module):
         h = nn.Dense(
             D, dtype=self.compute_dtype, param_dtype=jnp.float32, name="ff_down",
         )(h)
+        if self.tp_axis is not None:
+            # Row-parallel FFN down projection (psum #2 of the layer).
+            # ff_down's bias rides INSIDE the reduce: the sharder stores
+            # bias / axis_size so the psum reassembles it exactly.
+            h = reduce_from_tp(h, self.tp_axis)
         if self.dropout_rate > 0.0:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         return x + h
@@ -270,10 +426,34 @@ class TransformerLM(nn.Module):
     #: position against the full vocabulary (pair with
     #: :func:`mlm_loss`), and autoregressive decode is rejected.
     causal: bool = True
+    #: decode KV-cache layout (see ``TransformerBlock.kv_layout``):
+    #: ``'dense'`` or ``'paged'`` — the serving engine clones the model
+    #: with the resolved layout; :func:`generate` uses the legacy dense
+    #: ring either way.
+    kv_layout: str = "dense"
+    #: tokens per paged-pool block (``TransformerBlock.kv_block_size``).
+    kv_block_size: int = 64
+    #: paged-pool capacity in blocks (``TransformerBlock.kv_num_blocks``).
+    kv_num_blocks: int = 0
+    #: decode-cache capacity override: dense slot caches allocate
+    #: ``decode_cache_len`` rows instead of ``max_len`` (a serving
+    #: horizon shorter than the trained context — pos_emb stays at
+    #: ``max_len`` so trained params load unchanged). None → ``max_len``.
+    decode_cache_len: Optional[int] = None
+    #: tensor-parallel mesh axis (see ``TransformerBlock.tp_axis``);
+    #: set together with LOCAL ``num_heads``/``num_kv_heads``/``d_ff``
+    #: and an explicit ``head_dim`` (the serving engine's
+    #: ``shard_lm_params`` builds the matching param tree).
+    tp_axis: Optional[str] = None
+    #: per-head width override for the blocks (required under
+    #: ``tp_axis``).
+    head_dim: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None,
-                 train: bool = True, decode: bool = False):
+                 train: bool = True, decode: bool = False,
+                 decode_positions=None, block_tables=None,
+                 decode_slots=None):
         """``segment_ids`` (optional ``[B, T]``) confines attention to
         packed documents; requires a segment-capable ``attention_fn``
         (e.g. :func:`chainermn_tpu.ops.flash_attention.flash_attention`).
@@ -281,7 +461,12 @@ class TransformerLM(nn.Module):
         ``pos_offset + arange(T)`` — sequence-parallel shards pass
         ``axis_index * T_local + arange(T_local)``.
         ``decode=True`` runs one-token autoregressive steps (``T == 1``)
-        against the mutable ``'cache'`` collection; see :func:`generate`."""
+        against the mutable ``'cache'`` collection; see :func:`generate`.
+        ``decode_positions`` (optional ``[B]`` int32) switches decode to
+        the slot-array path — per-row write positions, ``T >= 1``
+        chunked prefill, paged/dense layouts, ``decode_slots`` row
+        mapping — the serving engine's contract
+        (:mod:`chainermn_tpu.serving`)."""
         if segment_ids is not None and self.attention_fn is None:
             raise ValueError(
                 "segment_ids needs a segment-capable attention_fn — pass "
@@ -297,7 +482,14 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 "decode=True is autoregressive and requires causal=True"
             )
+        if decode_positions is not None and not decode:
+            raise ValueError("decode_positions requires decode=True")
         B, T = tokens.shape
+        if decode_positions is not None and positions is None:
+            # Per-row global positions for rope / the learned table:
+            # row b's tokens sit at decode_positions[b] + [0, T).
+            positions = (decode_positions[:, None]
+                         + jnp.arange(T, dtype=jnp.int32)[None])
         emb = nn.Embed(
             self.vocab_size, self.d_model, param_dtype=jnp.float32,
             dtype=self.compute_dtype, name="tok_emb",
@@ -316,12 +508,14 @@ class TransformerLM(nn.Module):
                 jnp.float32,
             )
             if positions is not None:
-                pos = pos_emb[positions]
+                pos = pos_emb[positions]  # [T, D] or [B, T, D] (per-row)
             else:
                 pos = jax.lax.dynamic_slice_in_dim(
                     pos_emb, self.pos_offset, T, axis=0
                 )
-            x = x + pos[None].astype(self.compute_dtype)
+            if pos.ndim == 2:
+                pos = pos[None]
+            x = x + pos.astype(self.compute_dtype)
         block_cls = (
             _remat_block(self.remat_policy) if self.remat
             else TransformerBlock
@@ -333,12 +527,18 @@ class TransformerLM(nn.Module):
                 compute_dtype=self.compute_dtype,
                 attention_fn=self.attention_fn,
                 num_kv_heads=self.num_kv_heads,
-                decode_max_len=self.max_len,
+                decode_max_len=self.decode_cache_len or self.max_len,
                 window=self.window,
                 dropout_rate=self.dropout_rate,
                 causal=self.causal,
+                kv_layout=self.kv_layout,
+                kv_block_size=self.kv_block_size,
+                kv_num_blocks=self.kv_num_blocks,
+                tp_axis=self.tp_axis,
+                head_dim=self.head_dim,
                 name=f"block_{i}",
-            )(x, segment_ids, rope_positions, train, decode)
+            )(x, segment_ids, rope_positions, train, decode,
+              decode_positions, block_tables, decode_slots)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
         if self.return_hidden:
             return x

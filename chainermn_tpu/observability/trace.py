@@ -22,7 +22,10 @@ per step, per process. Three properties are load-bearing:
   ``schema`` (:data:`TRACE_SCHEMA`), ``kind``, ``t`` (epoch seconds),
   ``pid``, ``rank``; kinds: ``meta``, ``collective``, ``step``, ``span``,
   ``dispatch`` (autotune provenance), ``straggler``, ``profile_start`` /
-  ``profile_stop``. ``tools/trace_report.py`` summarizes a JSONL file;
+  ``profile_stop``, ``wire`` / ``overlap_config`` (ISSUE 3 per-bucket
+  reduction telemetry), ``serving`` (ISSUE 4 queue_wait / prefill /
+  decode_step / finish phases). ``tools/trace_report.py`` summarizes a
+  JSONL file;
   :func:`chrome_trace` converts to the ``chrome://tracing`` / Perfetto
   format.
 
@@ -406,6 +409,84 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
             "hidden_fraction": (round(hidden_s / comm_s, 4)
                                 if comm_s > 0 else 0.0),
         }
+    return out
+
+
+def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
+    """Serving rollup from ``serving`` events (ISSUE 4: the consumer
+    side of the scheduler's per-phase events; one owner shared by
+    ``tools/trace_report.py`` and bench's ``serving`` phase).
+
+    Definitions (deterministic — the report contract pins them):
+
+    - ``generated_tokens`` = one per prefill (its sampled first token)
+      plus each ``decode_step``'s ``tokens`` field;
+    - ``tokens_per_sec`` = generated tokens / (prefill + decode step
+      durations) — device-busy time, not wall (queue idle gaps are the
+      scheduler's property, not the engine's);
+    - ``token_ms_p50``/``p99`` = nearest-rank percentiles (ceil(q*n))
+      over ``decode_step`` durations — each active request gains one
+      token per step, so the step duration IS its per-token latency;
+    - ``occupancy_mean`` = mean of ``n_active / n_slots`` over decode
+      steps.
+
+    Returns None when the trace carries no serving events."""
+    import math
+
+    queue_waits: list[float] = []
+    prefills: list[float] = []
+    steps: list[float] = []
+    occupancy: list[float] = []
+    step_tokens = 0
+    finishes = 0
+    for ev in events:
+        if ev.get("kind") != "serving":
+            continue
+        phase = ev.get("phase")
+        dur = float(ev.get("dur_s") or 0.0)
+        if phase == "queue_wait":
+            queue_waits.append(dur)
+        elif phase == "prefill":
+            prefills.append(dur)
+        elif phase == "decode_step":
+            steps.append(dur)
+            step_tokens += int(ev.get("tokens") or 0)
+            n_slots = ev.get("n_slots")
+            if n_slots:
+                occupancy.append(float(ev.get("n_active") or 0)
+                                 / float(n_slots))
+        elif phase == "finish":
+            finishes += 1
+    if not (queue_waits or prefills or steps or finishes):
+        return None
+
+    def pct(vals: list, q: float):
+        if not vals:
+            return None
+        s = sorted(vals)
+        return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+    tokens = step_tokens + len(prefills)
+    busy_s = sum(prefills) + sum(steps)
+    out: dict = {
+        "requests": finishes,
+        "prefills": len(prefills),
+        "generated_tokens": tokens,
+        "decode_steps": len(steps),
+        "queue_wait_ms_mean": (
+            round(sum(queue_waits) / len(queue_waits) * 1e3, 4)
+            if queue_waits else None),
+        "prefill_ms_mean": (round(sum(prefills) / len(prefills) * 1e3, 4)
+                            if prefills else None),
+        "token_ms_p50": (round(pct(steps, 0.5) * 1e3, 4)
+                         if steps else None),
+        "token_ms_p99": (round(pct(steps, 0.99) * 1e3, 4)
+                         if steps else None),
+        "occupancy_mean": (round(sum(occupancy) / len(occupancy), 4)
+                           if occupancy else None),
+        "tokens_per_sec": (round(tokens / busy_s, 2) if busy_s > 0
+                           else None),
+    }
     return out
 
 

@@ -1,0 +1,72 @@
+"""Paged KV-cache primitives: a preallocated block pool + per-slot block
+tables (ISSUE 4 tentpole — the serving engine's cache layout).
+
+The dense decode cache reserves ``max_len`` rows for EVERY slot, so HBM
+scales with the worst case (``slots x max_len``) while typical requests
+use a fraction of it. The paged layout (the vLLM PagedAttention idea,
+PAPERS.md) carves one shared pool of fixed-size blocks and maps each
+slot's logical positions onto pool blocks through a small int32 table:
+HBM scales with the tokens actually resident, and a request join/leave
+is a host-side table edit — no device reallocation, no copy.
+
+Pure functions only (the model's decode path and the serving engine
+both call them); the host-side allocator that OWNS the tables lives in
+:mod:`chainermn_tpu.serving.kv_blocks`.
+
+Layout contract (shared with the allocator):
+
+- ``pool``: ``[num_blocks, block_size, kv_heads, head_dim]``; physical
+  block 0 is the SCRATCH block — never handed to a slot, the write
+  target for rows whose table has no block (inactive/released slots),
+  so a scatter is always in-bounds and collisions only ever trash
+  scratch.
+- ``block_tables``: ``[B, max_blocks]`` int32 physical ids; logical
+  block ``j`` of row ``b`` lives at ``pool[block_tables[b, j]]``.
+
+Both ops are local gathers/scatters — zero collectives, which the
+serving suite pins structurally on the tensor-parallel decode program.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def paged_update(pool, block_tables, positions, new):
+    """Scatter ``new`` token K/V rows into the pool.
+
+    Args:
+      pool: ``[num_blocks, block_size, kv_heads, head_dim]``.
+      block_tables: ``[B, max_blocks]`` int32.
+      positions: ``[B]`` int32 — position of row ``b``'s FIRST new token.
+      new: ``[B, T, kv_heads, head_dim]`` — ``T`` consecutive tokens per
+        row (``T=1`` steady-state decode, ``T=bucket`` prefill).
+
+    Returns the updated pool. Rows whose table entries are 0 write into
+    the scratch block (see module docstring) — duplicate scatter indices
+    there are harmless by construction.
+    """
+    block_size = pool.shape[1]
+    B, T = new.shape[:2]
+    pos = positions[:, None] + jnp.arange(T, dtype=positions.dtype)[None]
+    logical = pos // block_size
+    offset = pos % block_size
+    phys = jnp.take_along_axis(block_tables, logical, axis=1)  # [B, T]
+    return pool.at[phys.reshape(-1), offset.reshape(-1)].set(
+        new.reshape(B * T, *new.shape[2:])
+    )
+
+
+def paged_lookup(pool, block_tables):
+    """Gather each row's blocks into a contiguous dense view.
+
+    Returns ``[B, max_blocks * block_size, kv_heads, head_dim]`` — the
+    SAME layout the dense cache stores directly, so paged attention is
+    the dense attention over this view (identical einsums and masks:
+    the paged/dense equivalence the serving tests assert token-for
+    -token). Unallocated table entries gather the scratch block;
+    position masking excludes them.
+    """
+    g = pool[block_tables]  # [B, M, bs, kvh, dh]
+    B, M, bs = g.shape[:3]
+    return g.reshape(B, M * bs, *g.shape[3:])

@@ -146,6 +146,39 @@ def stack_tp_params(full: jax.Array, n: int, dim: int) -> jax.Array:
     return jnp.stack(parts, axis=0)
 
 
+def shard_qkv_columns(w: jax.Array, n_q_heads: int, n_kv_heads: int,
+                      head_dim: int, n: int) -> jax.Array:
+    """Head-shard a FUSED QKV kernel ``[d_in, (Hq + 2*Hkv) * dh]``.
+
+    The fused layout concatenates ``[q | k | v]`` column groups, so a
+    plain ``stack_tp_params`` column split would hand shard 0 all of q
+    and shard 1 the k/v tail. This splits each group by heads and
+    re-concatenates per shard: shard ``i`` gets its ``Hq/n`` query heads
+    plus its ``Hkv/n`` key and value heads, matching a block built with
+    LOCAL head counts (``TransformerBlock(tp_axis=...)``). Returns
+    ``[n, d_in, (Hq + 2*Hkv)//n * dh]``.
+    """
+    if n_q_heads % n or n_kv_heads % n:
+        raise ValueError(
+            f"heads ({n_q_heads} q, {n_kv_heads} kv) not divisible by "
+            f"axis size {n}"
+        )
+    q, k, v = jnp.split(
+        w, [n_q_heads * head_dim, (n_q_heads + n_kv_heads) * head_dim],
+        axis=-1,
+    )
+    shards = []
+    for i in range(n):
+        ql = n_q_heads // n * head_dim
+        kl = n_kv_heads // n * head_dim
+        shards.append(jnp.concatenate(
+            [q[:, i * ql:(i + 1) * ql],
+             k[:, i * kl:(i + 1) * kl],
+             v[:, i * kl:(i + 1) * kl]], axis=-1,
+        ))
+    return jnp.stack(shards, axis=0)
+
+
 # ---------------------------------------------------------------------------
 # Parallel layers (pure functions, shard_map-local)
 # ---------------------------------------------------------------------------
@@ -249,6 +282,7 @@ __all__ = [
     "gather_from_tp",
     "tp_slice",
     "stack_tp_params",
+    "shard_qkv_columns",
     "column_parallel_dense",
     "row_parallel_dense",
     "tp_mlp",

@@ -1,0 +1,35 @@
+"""Continuous-batching serving engine (ISSUE 4): slot-scheduled decode
+with a paged KV cache, a bucketed prefill/decode split, and tokens/s
+accounting. See docs/serving.md for the engine contract."""
+
+from chainermn_tpu.serving.engine import (
+    DECODE_IMPLS,
+    KV_BLOCK_SIZES,
+    ServingEngine,
+    resolve_decode_impl,
+    resolve_kv_block_size,
+    serving_decision_key,
+    shard_lm_params,
+)
+from chainermn_tpu.serving.kv_blocks import (
+    BlockAllocator,
+    default_num_blocks,
+    init_serving_cache,
+)
+from chainermn_tpu.serving.scheduler import POLICIES, Request, Scheduler
+
+__all__ = [
+    "ServingEngine",
+    "Scheduler",
+    "Request",
+    "BlockAllocator",
+    "DECODE_IMPLS",
+    "KV_BLOCK_SIZES",
+    "POLICIES",
+    "default_num_blocks",
+    "init_serving_cache",
+    "resolve_decode_impl",
+    "resolve_kv_block_size",
+    "serving_decision_key",
+    "shard_lm_params",
+]

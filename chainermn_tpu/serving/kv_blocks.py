@@ -1,0 +1,164 @@
+"""Host-side paged-KV bookkeeping: the block allocator and cache init.
+
+The device-plane half of paging (pool scatter/gather) lives in
+:mod:`chainermn_tpu.ops.paged_kv`; this module owns everything that
+may change per request without touching the compiled program:
+
+- :class:`BlockAllocator` — a free-list over physical pool blocks and
+  the per-slot block tables. Join/leave/growth mutate numpy state only;
+  the tables ride into the jitted step as a traced ``[slots,
+  max_blocks]`` int32 argument, so occupancy changes NEVER recompile
+  (the engine's structural no-recompile test pins this).
+- :func:`init_serving_cache` — allocate the engine's cache pytree by
+  shape evaluation of the model's slot-decode path (zero FLOPs), the
+  serving analog of ``models.transformer.init_cache``.
+
+Layout contract (shared with ``ops.paged_kv``): physical block 0 is
+SCRATCH — never owned by a slot; released or never-grown table entries
+point at it, so stale writes land in a garbage block instead of a
+block that may since belong to another request.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+
+class BlockAllocator:
+    """Free-list allocator over a paged KV pool.
+
+    ``num_blocks`` counts the WHOLE pool including scratch, matching
+    the device pool's leading dimension; ``num_blocks - 1`` blocks are
+    allocatable. Allocation failure returns False (the scheduler defers
+    admission) — never raises mid-stream.
+    """
+
+    SCRATCH = 0
+
+    def __init__(self, num_blocks: int, block_size: int, num_slots: int,
+                 max_len: int) -> None:
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is scratch), got "
+                f"{num_blocks}"
+            )
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_slots = int(num_slots)
+        self.max_blocks = math.ceil(max_len / block_size)
+        # LIFO free list: recently released blocks are reused first
+        # (warm HBM lines on chip; deterministic tables in tests).
+        self._free = list(range(self.num_blocks - 1, self.SCRATCH, -1))
+        self.tables = np.full((num_slots, self.max_blocks), self.SCRATCH,
+                              np.int32)
+        self._owned: list[list[int]] = [[] for _ in range(num_slots)]
+        #: bumped on every table mutation — the engine keys its cached
+        #: device copy of ``tables`` on it, so the steady-state decode
+        #: loop re-uploads only when an admit/grow/release actually
+        #: changed a row (H2D-after-D2H is the tunnelled-TPU latency
+        #: trap; see .claude/skills/verify/SKILL.md).
+        self.version = 0
+
+    # ------------------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def utilization(self) -> float:
+        """Fraction of the allocatable pool currently owned by slots."""
+        denom = self.num_blocks - 1
+        return self.blocks_in_use / denom if denom else 0.0
+
+    def blocks_for(self, n_positions: int) -> int:
+        """Blocks needed to cover positions ``[0, n_positions)``."""
+        return math.ceil(n_positions / self.block_size)
+
+    def can_cover(self, slot: int, n_positions: int) -> bool:
+        need = self.blocks_for(n_positions) - len(self._owned[slot])
+        return need <= len(self._free)
+
+    def ensure(self, slot: int, n_positions: int) -> bool:
+        """Grow ``slot``'s table to cover positions ``[0, n_positions)``.
+
+        Returns False (state unchanged) when the pool cannot supply the
+        missing blocks — all-or-nothing, so a deferred admission leaves
+        no half-grown table behind.
+        """
+        if n_positions > self.max_blocks * self.block_size:
+            raise ValueError(
+                f"slot {slot}: {n_positions} positions exceed the table "
+                f"horizon {self.max_blocks * self.block_size}"
+            )
+        owned = self._owned[slot]
+        need = self.blocks_for(n_positions) - len(owned)
+        if need > len(self._free):
+            return False
+        if need > 0:
+            self.version += 1
+        for _ in range(max(0, need)):
+            blk = self._free.pop()
+            self.tables[slot, len(owned)] = blk
+            owned.append(blk)
+        return True
+
+    def release(self, slot: int) -> None:
+        """Return ``slot``'s blocks to the pool and point its table back
+        at scratch (stale in-flight writes become harmless)."""
+        if self._owned[slot]:
+            self.version += 1
+        self._free.extend(reversed(self._owned[slot]))
+        self._owned[slot] = []
+        self.tables[slot] = self.SCRATCH
+
+
+def default_num_blocks(num_slots: int, block_size: int, max_len: int) -> int:
+    """Worst-case pool: every slot at ``max_len`` simultaneously, plus
+    scratch. Oversubscribe deliberately (smaller ``num_blocks``) when the
+    expected resident-token sum is below the worst case — admission then
+    defers on pool exhaustion instead of OOMing."""
+    return num_slots * math.ceil(max_len / block_size) + 1
+
+
+def init_serving_cache(model, params, num_slots: int,
+                       block_tables: Optional[np.ndarray] = None):
+    """Zero-initialised cache pytree for the slot-decode path.
+
+    Pure shape evaluation (``jax.eval_shape``) of one slot-array decode
+    step — dense layouts get ``[num_slots, decode_cache_len, kvh, dh]``
+    per block, paged layouts get the shared pools. Returns the ``cache``
+    collection dict the engine threads through its jitted step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dummy = jnp.zeros((num_slots, 1), jnp.int32)
+    pos = jnp.zeros((num_slots,), jnp.int32)
+    bt = None
+    if model.kv_layout == "paged":
+        if block_tables is not None:
+            bt = jnp.asarray(block_tables, jnp.int32)
+        else:
+            max_blocks = math.ceil(
+                (model.decode_cache_len or model.max_len)
+                / model.kv_block_size
+            )
+            bt = jnp.zeros((num_slots, max_blocks), jnp.int32)
+    variables = jax.eval_shape(
+        lambda: model.apply(
+            params, dummy, train=False, decode=True,
+            decode_positions=pos, block_tables=bt, mutable=["cache"],
+        )[1]
+    )
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), variables
+    )["cache"]

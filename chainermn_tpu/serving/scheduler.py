@@ -1,0 +1,259 @@
+"""Host-side admission loop over a :class:`ServingEngine` (ISSUE 4).
+
+FCFS by construction (the queue is arrival-ordered); the
+``prefill_priority`` policy additionally drains every admissible queued
+request into free slots BEFORE each decode step (prefill-priority in
+the continuous-batching sense: new requests never wait behind decode
+cadence when a slot is open), while plain ``fcfs`` admits at most one
+request per decode round so in-flight decode latency stays level.
+
+Every phase emits a schema-versioned ``serving`` trace event (the wire
+-event discipline of PR 2 — ``tools/trace_report.py`` grows a serving
+section from exactly these):
+
+- ``phase='queue_wait'`` — request, ``dur_s`` from submit to admission;
+- ``phase='prefill'`` — request, slot, bucket, prompt_len, ``dur_s``;
+- ``phase='decode_step'`` — ``n_active``/``n_slots`` (occupancy),
+  ``tokens`` produced, ``dur_s`` (the per-token latency sample: each
+  active request got exactly one token);
+- ``phase='finish'`` — request, generated count, ``dur_s`` from submit.
+
+:meth:`Scheduler.summary` rolls the same numbers up locally (tokens/s,
+p50/p99 per-token latency, mean occupancy) so callers without a trace
+recorder still get the accounting.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+POLICIES = ("fcfs", "prefill_priority")
+
+
+@dataclass
+class Request:
+    """One serving request: ``prompt`` tokens in, up to
+    ``max_new_tokens`` generated tokens out (generation also stops at
+    ``eos_id`` when given — the emitted EOS counts as generated, like
+    :func:`generate`'s fixed-horizon streams truncated at EOS)."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    request_id: Optional[str] = None
+    eos_id: Optional[int] = None
+    _arrival: float = field(default=0.0, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+
+
+@dataclass
+class _InFlight:
+    request: Request
+    slot: int
+    stream: list  # prompt + generated tokens
+    generated: int
+
+
+class Scheduler:
+    """Admission + completion loop; see module docstring."""
+
+    def __init__(self, engine, policy: str = "fcfs") -> None:
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, got "
+                             f"{policy!r}")
+        self.engine = engine
+        self.policy = policy
+        self._queue: deque[Request] = deque()
+        self._inflight: dict[int, _InFlight] = {}
+        self._ids = itertools.count()
+        #: request_id -> {'tokens': prompt+generated, 'generated': [...]}
+        self.results: dict = {}
+        #: local copy of every emitted serving event — summary() feeds
+        #: them to trace.summarize_serving (the ONE rollup owner) so the
+        #: accounting works with the recorder off and cannot drift from
+        #: what tools/trace_report.py computes. Reset per run() and
+        #: capped like the Recorder's buffer (a week-long stream must
+        #: not eat the host; ``events_dropped`` counts the overflow).
+        self._events: list[dict] = []
+        self.events_dropped = 0
+        self._wall: Optional[float] = None
+
+    # ------------------------------------------------------------------
+
+    def _event(self, **fields) -> None:
+        from chainermn_tpu.observability import trace
+
+        if len(self._events) < trace.MAX_BUFFERED_EVENTS:
+            self._events.append({"kind": "serving", **fields})
+        else:
+            self.events_dropped += 1
+        rec = trace.active()
+        if rec is not None:
+            rec.event("serving", **fields)
+
+    def submit(self, request: Request) -> str:
+        """Enqueue; returns the request id (assigned when absent).
+
+        Rejects a request that could never finish inside the engine's
+        horizon UP FRONT — ``prompt + max_new_tokens`` must fit in
+        ``max_len``. (Catching it here costs one comparison; catching it
+        mid-stream would abort every other in-flight request.)"""
+        total = len(request.prompt) + request.max_new_tokens
+        if total > self.engine.max_len:
+            raise ValueError(
+                f"request needs {total} positions (prompt "
+                f"{len(request.prompt)} + max_new_tokens "
+                f"{request.max_new_tokens}) but the engine horizon is "
+                f"max_len={self.engine.max_len}"
+            )
+        # Requests are mutable (the id is written onto them): the same
+        # OBJECT queued twice would alias one stream across two entries,
+        # and a stale id from a previous scheduler can collide with this
+        # scheduler's own sequence — both are caller bugs surfaced here,
+        # not silently-merged results.
+        if any(r is request for r in self._queue) or any(
+            fl.request is request for fl in self._inflight.values()
+        ):
+            raise ValueError("request object is already queued/in flight")
+        if request.request_id is None:
+            request.request_id = f"r{next(self._ids)}"
+        rid = request.request_id
+        if rid in self.results or any(
+            r.request_id == rid for r in self._queue
+        ) or any(fl.request.request_id == rid
+                 for fl in self._inflight.values()):
+            raise ValueError(
+                f"duplicate request_id {rid!r} (reusing a Request from "
+                f"another scheduler? pass a fresh request_id)"
+            )
+        request._arrival = time.perf_counter()
+        self._queue.append(request)
+        return request.request_id
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._inflight)
+
+    # ------------------------------------------------------------------
+
+    def _finish(self, fl: _InFlight) -> None:
+        self.engine.leave(fl.slot)
+        del self._inflight[fl.slot]
+        req = fl.request
+        dur = time.perf_counter() - req._arrival
+        self.results[req.request_id] = {
+            "tokens": list(fl.stream),
+            "generated": list(fl.stream[len(req.prompt):]),
+        }
+        self._event(phase="finish", request=req.request_id,
+                    generated=fl.generated, dur_s=round(dur, 9))
+
+    def _admit_one(self) -> bool:
+        """Try to admit the HEAD of the queue (strict arrival order —
+        a blocked head blocks the queue: FCFS, not best-fit)."""
+        if not self._queue:
+            return False
+        req = self._queue[0]
+        t0 = time.perf_counter()
+        res = self.engine.prefill_join(req.prompt)
+        if res is None:
+            return False
+        self._queue.popleft()
+        slot, tok, bucket = res
+        now = time.perf_counter()
+        self._event(phase="queue_wait", request=req.request_id,
+                    dur_s=round(t0 - req._arrival, 9))
+        self._event(phase="prefill", request=req.request_id, slot=slot,
+                    bucket=bucket, prompt_len=len(req.prompt),
+                    dur_s=round(now - t0, 9))
+        fl = _InFlight(req, slot, list(req.prompt) + [tok], 1)
+        self._inflight[slot] = fl
+        if fl.generated >= req.max_new_tokens or (
+            req.eos_id is not None and tok == req.eos_id
+        ):
+            self._finish(fl)
+        return True
+
+    def step(self) -> None:
+        """One decode round: every in-flight request advances one token."""
+        toks, dur = self.engine.decode_step()
+        n_active = len(self._inflight)
+        self._event(phase="decode_step", n_active=n_active,
+                    n_slots=self.engine.num_slots, tokens=n_active,
+                    dur_s=round(dur, 9))
+        for slot, fl in list(self._inflight.items()):
+            tok = int(toks[slot])
+            fl.stream.append(tok)
+            fl.generated += 1
+            req = fl.request
+            if fl.generated >= req.max_new_tokens or (
+                req.eos_id is not None and tok == req.eos_id
+            ):
+                self._finish(fl)
+
+    def run(self, max_steps: int = 100_000) -> dict:
+        """Drive admissions + decode until queue and slots drain;
+        returns :attr:`results` (request_id -> token streams). The
+        local accounting (:meth:`summary`) covers THIS run — each call
+        starts a fresh event window."""
+        self._events = []
+        self.events_dropped = 0
+        t0 = time.perf_counter()
+        steps = 0
+        while self._queue or self._inflight:
+            progressed = False
+            if self.policy == "prefill_priority":
+                while self._admit_one():
+                    progressed = True
+            else:
+                progressed = self._admit_one()
+            if not self._inflight:
+                if self._queue and not progressed:
+                    # nothing running AND the head cannot be admitted:
+                    # the request can never fit (slot/pool shortage)
+                    head = self._queue[0]
+                    raise RuntimeError(
+                        f"request {head.request_id!r} cannot be admitted "
+                        f"on an idle engine (prompt_len="
+                        f"{len(head.prompt)}, free_slots="
+                        f"{self.engine.free_slot_count})"
+                    )
+                continue
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"exceeded max_steps={max_steps} with "
+                                   f"{len(self._inflight)} in flight")
+        self._wall = time.perf_counter() - t0
+        return self.results
+
+    # ------------------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Tokens/s + latency accounting for the last :meth:`run` — the
+        locally-kept serving events rolled up by
+        :func:`chainermn_tpu.observability.trace.summarize_serving`,
+        the ONE owner of these definitions, so this summary, bench's
+        ``serving`` rows, and ``tools/trace_report.py``'s serving
+        section can never disagree. Adds ``wall_s`` (queue idle time
+        included; the rollup's ``tokens_per_sec`` is device-busy)."""
+        from chainermn_tpu.observability.trace import summarize_serving
+
+        out = summarize_serving(self._events) or {}
+        if self._wall is not None:
+            out["wall_s"] = round(self._wall, 4)
+        if self.events_dropped:
+            out["events_dropped"] = self.events_dropped
+        return out

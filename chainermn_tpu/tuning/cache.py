@@ -120,6 +120,7 @@ def store_entry(
 
 _MOE_SHAPE = re.compile(r"T(\d+)xE(\d+)xD(\d+)")
 _ATTN_SHAPE = re.compile(r"B(\d+)xT(\d+)xH(\d+)xD(\d+)_(\w+?)_")
+_SERVING_SHAPE = re.compile(r"D(\d+)xH(\d+)xL(\d+)")
 
 
 def _bucketed_key(device_kind: str, dims, dtype_name: str) -> str:
@@ -242,6 +243,45 @@ def _seed_one_result(result: dict, source: str, out: list,
                 {"candidates_ms": {k: round(float(v), 4)
                                    for k, v in sched_ms.items()},
                  "spread_pct": spread})
+
+    # Serving decode decisions (ISSUE 4): bench's ``serving`` phase
+    # records per-candidate step medians keyed by the engine's own
+    # decision key material (``serving_model_shape`` D..xH..xL..). Both
+    # adoptions are spread-gated through measure.decide, same as the
+    # overlap schedule rows above.
+    m = _SERVING_SHAPE.search(result.get("serving_model_shape", ""))
+    if m:
+        from chainermn_tpu.tuning.measure import decide
+
+        for row_key, spread_key, name in (
+            ("serving_decode_impl_ms", "serving_decode_spread_pct",
+             "decode_impl"),
+            ("serving_kv_block_ms", "serving_kv_block_spread_pct",
+             "kv_block_size"),
+        ):
+            rows = result.get(row_key)
+            if not (isinstance(rows, dict) and len(rows) >= 2 and all(
+                isinstance(v, (int, float)) for v in rows.values()
+            )):
+                continue
+            # A PRESENT spread key is a real multi-sample estimate and
+            # is used verbatim (0.0 = genuinely tied medians adopts,
+            # matching the in-run path); an ABSENT key marks an
+            # on-accel single-sample row, which takes the same 10%
+            # noise floor the live adoption applies (spreads=None in
+            # registry.record_measurement) — neither path can pin a
+            # margin the other would have refused.
+            if spread_key in result:
+                spread = float(result[spread_key])
+            else:
+                spread = 10.0
+            winner = decide(rows, {k: spread for k in rows})
+            if winner is not None:
+                key = _bucketed_key(kind, m.groups(), "decode")
+                put(name, key, winner,
+                    {"candidates_ms": {k: round(float(v), 4)
+                                       for k, v in rows.items()},
+                     "spread_pct": spread})
 
     # Double buffering: the measured on/off step-time ratio.
     speedup = result.get("double_buffer_speedup")

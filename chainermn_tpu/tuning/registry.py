@@ -52,6 +52,19 @@ from chainermn_tpu.tuning import measure as _measure
 #:   extra program structure with a bench ``overlap``-phase win
 #:   (seeded from BENCH_DETAILS.json ``overlap_schedule_ms`` rows; see
 #:   chainermn_tpu.parallel.reduction_schedule).
+#: - ``decode_impl`` (serving steady-state step): ``paged`` everywhere
+#:   — the idle-box CPU-proxy point measured paged 0.95 ms vs dense
+#:   1.38 ms/step (D64xH4xL64, gap outside the 17.5% spread), and on
+#:   chip paging additionally buys the HBM-capacity win that motivates
+#:   the layout; later proxy runs on a loaded box were SPREAD-DOMINATED
+#:   (impls within ~8%, noise ~16%) and correctly refused adoption, so
+#:   the table — not a coin-flip cache entry — decides until a decisive
+#:   per-shape capture (bench ``serving`` rows) seeds one.
+#: - ``kv_block_size``: ``64`` — big enough that table/gather overhead
+#:   amortises, small enough that a short request strands < 64 stale
+#:   rows per slot; the proxy's 16-vs-64 sweep was SPREAD-DOMINATED
+#:   (29% noise), so the table default stands until a decisive
+#:   ``serving_kv_block_ms`` capture seeds a winner.
 DEFAULT_TABLE: dict = {
     "moe_dispatch": {"cpu": "sort", "tpu": "sort", "*": "sort"},
     "attention": {"cpu": "xla", "tpu": "flash", "*": "flash"},
@@ -60,6 +73,8 @@ DEFAULT_TABLE: dict = {
     "allreduce_bucket_mb": {"*": "64"},
     "double_buffering": {"*": "off"},
     "reduction_schedule": {"*": "flat"},
+    "decode_impl": {"*": "paged"},
+    "kv_block_size": {"*": "64"},
 }
 
 _MODE_ENV = "CHAINERMN_TPU_AUTOTUNE"

@@ -190,6 +190,58 @@ def test_kernel_sweep_crashed_checker_counts_as_numeric_error():
     assert "kernel_sweep_numeric_errors" in bench._COMPACT_KEYS
 
 
+def test_serving_rows_contract_and_seeding(tmp_path):
+    """ISSUE 4 satellite: the ``serving`` phase's headline rows ride the
+    compact line (tokens/s + spread gate), and ``tuning seed`` learns
+    ``decode_impl``/``kv_block_size`` from the detail rows — spread-gated
+    exactly like the in-run adoption, so a noise-band "winner" is never
+    resurrected offline."""
+    assert "serving_tokens_per_sec" in bench._COMPACT_KEYS
+    assert "serving_spread_pct" in bench._COMPACT_KEYS
+
+    from chainermn_tpu.tuning.cache import seed_from_bench_details
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-03T00:00:00Z",
+        "serving_model_shape": "D512xH8xL512",
+        "serving_decode_impl_ms": {"dense": 4.0, "paged": 2.0},
+        "serving_decode_spread_pct": 5.0,
+        # 2.9 vs 2.95 inside an 8% spread: indistinguishable from noise
+        "serving_kv_block_ms": {"16": 3.0, "32": 2.9, "64": 2.95},
+        "serving_kv_block_spread_pct": 8.0,
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    # the engine's own key material (serving_decision_key) reproduced
+    assert "decode_impl|TPU v5 lite|512x8x512|decode -> paged" in seeded
+    assert "kv_block_size" not in seeded  # spread-dominated: refused
+
+    # a decisive sweep seeds the block size too
+    doc["serving_kv_block_ms"] = {"16": 4.0, "64": 2.0}
+    doc["serving_kv_block_spread_pct"] = 5.0
+    details.write_text(json.dumps(doc))
+    seeded2 = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert "kv_block_size|TPU v5 lite|512x8x512|decode -> 64" in seeded2
+
+    # ABSENT spread key = on-accel single-sample row: the 10% noise
+    # floor applies (the live adoption's spreads=None convention) — a
+    # 5% margin is refused, a decisive one still seeds.
+    doc.pop("serving_decode_spread_pct")
+    doc["serving_decode_impl_ms"] = {"dense": 4.0, "paged": 3.9}
+    details.write_text(json.dumps(doc))
+    assert "decode_impl" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache)))
+    # ...while a PRESENT 0.0 spread is a real three-tied-medians
+    # estimate and adopts verbatim, matching the in-run path.
+    doc["serving_decode_spread_pct"] = 0.0
+    details.write_text(json.dumps(doc))
+    assert "decode_impl|TPU v5 lite|512x8x512|decode -> paged" in "\n".join(
+        seed_from_bench_details(str(details), str(cache)))
+
+
 def test_transformer_knob_env_validation(monkeypatch):
     """The accel transformer knobs reject malformed env values with a
     message naming the variable (a bare ZeroDivisionError from

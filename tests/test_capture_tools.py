@@ -162,6 +162,26 @@ def _golden_trace_lines():
          "schedule": "overlap_eager", "bucket": 1, "n_buckets": 2,
          "nbytes": 4096, "dur_s": 0.003, "blocked_s": 0.003,
          "overlapped": False},
+        # ISSUE 4: one request through the serving scheduler — queue
+        # wait, bucketed prefill (its sampled token counts as generated),
+        # three decode steps at varying occupancy, finish.
+        {"schema": 1, "kind": "serving", "t": 2.2, "pid": 1, "rank": 0,
+         "phase": "queue_wait", "request": "r0", "dur_s": 0.002},
+        {"schema": 1, "kind": "serving", "t": 2.3, "pid": 1, "rank": 0,
+         "phase": "prefill", "request": "r0", "slot": 0, "prompt_len": 5,
+         "dur_s": 0.01},
+        {"schema": 1, "kind": "serving", "t": 2.4, "pid": 1, "rank": 0,
+         "phase": "decode_step", "n_active": 1, "n_slots": 4, "tokens": 1,
+         "dur_s": 0.004},
+        {"schema": 1, "kind": "serving", "t": 2.5, "pid": 1, "rank": 0,
+         "phase": "decode_step", "n_active": 2, "n_slots": 4, "tokens": 2,
+         "dur_s": 0.006},
+        {"schema": 1, "kind": "serving", "t": 2.6, "pid": 1, "rank": 0,
+         "phase": "decode_step", "n_active": 1, "n_slots": 4, "tokens": 1,
+         "dur_s": 0.002},
+        {"schema": 1, "kind": "serving", "t": 2.7, "pid": 1, "rank": 0,
+         "phase": "finish", "request": "r0", "generated": 4,
+         "dur_s": 0.03},
     ]
     return [_json.dumps(e) for e in evs] + ['{"torn']
 
@@ -188,7 +208,7 @@ def test_trace_report_contract(tmp_path):
         "schema_versions": [1],
         "meta": {"started_at": "2026-08-03T00:00:00Z", "sync": False,
                  "source": "bench"},
-        "n_events": 12,  # torn tail line skipped, not fatal
+        "n_events": 18,  # torn tail line skipped, not fatal
         "collectives": [
             {"op": "allreduce_grad", "plane": "device", "n": 2,
              "total_bytes": 2000, "total_s": 0.004, "mean_ms": 2.0,
@@ -221,10 +241,26 @@ def test_trace_report_contract(tmp_path):
                          "comm_ms_blocked": 4.0, "comm_ms_hidden": 4.0,
                          "hidden_fraction": 0.5},
         },
+        # ISSUE 4: the serving rollup — tokens/s over device-busy time
+        # (1 prefill token + 4 step tokens over 10 + 12 ms), nearest-rank
+        # p50/p99 over the three step durations, mean occupancy
+        # (0.25 + 0.5 + 0.25)/3.
+        "serving": {
+            "requests": 1,
+            "prefills": 1,
+            "generated_tokens": 5,
+            "decode_steps": 3,
+            "queue_wait_ms_mean": 2.0,
+            "prefill_ms_mean": 10.0,
+            "token_ms_p50": 4.0,
+            "token_ms_p99": 6.0,
+            "occupancy_mean": 0.3333,
+            "tokens_per_sec": 227.27,
+        },
     }, summary
     # chrome export emitted alongside
     chrome = _json.loads(chrome_file.read_text())
-    assert len(chrome["traceEvents"]) == 11  # meta excluded
+    assert len(chrome["traceEvents"]) == 17  # meta excluded
     # and the human rendering mentions the essentials
     proc2 = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
@@ -233,7 +269,9 @@ def test_trace_report_contract(tmp_path):
     )
     assert proc2.returncode == 0
     for token in ("allreduce_grad", "STRAGGLER", "allreduce_wire=bf16",
-                  "comm/compute overlap", "50.0% hidden"):
+                  "comm/compute overlap", "50.0% hidden",
+                  "serving (continuous batching)", "tokens/s: 227.27",
+                  "p50 4.000 ms, p99 6.000 ms", "33.3% mean"):
         assert token in proc2.stdout, (token, proc2.stdout)
 
 

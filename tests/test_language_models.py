@@ -963,6 +963,93 @@ class TestSamplingFilters:
                      top_k=VOCAB + 1, rng=key)
 
 
+class TestFilterLogitsEdges:
+    """ISSUE 4 satellite: ``_filter_logits`` is now shared by
+    ``generate`` AND the serving engine's sampling tail — its edges are
+    pinned against a literal numpy reference (HF semantics: top_k first,
+    the nucleus renormalized AFTER top_k; ties at the k-th/threshold
+    logit survive, matching the strict ``<`` masking)."""
+
+    @staticmethod
+    def _np_reference(logits, top_k, top_p):
+        out = np.array(logits, np.float32)
+        V = out.shape[-1]
+        for b in range(out.shape[0]):
+            row = np.array(logits[b], np.float64)
+            keep = np.ones(V, bool)
+            if top_k is not None:
+                kth = np.sort(row)[::-1][top_k - 1]
+                keep &= row >= kth
+            if top_p is not None:
+                r = np.sort(row)[::-1]
+                if top_k is not None:
+                    r[top_k:] = -np.inf
+                e = np.exp(r - np.max(r))
+                cum = np.cumsum(e / e.sum())
+                keep_sorted = np.concatenate(([True], cum[:-1] < top_p))
+                thresh = np.min(r[keep_sorted])
+                keep &= row >= thresh
+            out[b, ~keep] = -np.inf
+        return out
+
+    @pytest.mark.parametrize("top_k,top_p", [
+        (1, None),           # greedy-degenerate k
+        (VOCAB, None),       # k == vocab: no-op
+        (None, 1.0),         # full nucleus: no-op
+        (3, 0.7),            # combined: nucleus within the k survivors
+        (1, 0.5),            # combined degenerate
+        (VOCAB, 0.9),        # k no-op, p active
+        (4, 1.0),            # p no-op, k active
+        (None, 0.3),
+    ])
+    def test_matches_numpy_reference(self, top_k, top_p):
+        from chainermn_tpu.models.transformer import _filter_logits
+
+        rng = np.random.RandomState(0)
+        logits = (rng.randn(4, VOCAB) * 2).astype(np.float32)
+        got = np.asarray(_filter_logits(jnp.asarray(logits), top_k, top_p))
+        want = self._np_reference(logits, top_k, top_p)
+        np.testing.assert_array_equal(np.isneginf(got), np.isneginf(want))
+        # surviving logits pass through untouched
+        m = np.isfinite(want)
+        np.testing.assert_array_equal(got[m], logits[m])
+
+    def test_top_k_1_keeps_exactly_the_argmax(self):
+        from chainermn_tpu.models.transformer import _filter_logits
+
+        rng = np.random.RandomState(1)
+        logits = (rng.randn(5, VOCAB)).astype(np.float32)
+        got = np.asarray(_filter_logits(jnp.asarray(logits), 1, None))
+        assert (np.isfinite(got).sum(axis=-1) == 1).all()
+        np.testing.assert_array_equal(np.argmax(got, -1),
+                                      np.argmax(logits, -1))
+
+    def test_top_k_vocab_and_top_p_1_are_no_ops(self):
+        from chainermn_tpu.models.transformer import _filter_logits
+
+        rng = np.random.RandomState(2)
+        logits = (rng.randn(3, VOCAB)).astype(np.float32)
+        for k, p in ((VOCAB, None), (None, 1.0), (VOCAB, 1.0)):
+            np.testing.assert_array_equal(
+                np.asarray(_filter_logits(jnp.asarray(logits), k, p)),
+                logits,
+            )
+
+    def test_top_p_0_keeps_one_token_never_an_empty_set(self):
+        """generate() rejects top_p=0 at the API, but the filter itself
+        must stay total: the first sorted token is ALWAYS kept, so a
+        zero-mass nucleus degrades to the argmax, not to a row of
+        -inf that categorical() would turn into NaN."""
+        from chainermn_tpu.models.transformer import _filter_logits
+
+        rng = np.random.RandomState(3)
+        logits = (rng.randn(4, VOCAB)).astype(np.float32)
+        got = np.asarray(_filter_logits(jnp.asarray(logits), None, 0.0))
+        assert (np.isfinite(got).sum(axis=-1) == 1).all()
+        np.testing.assert_array_equal(np.argmax(got, -1),
+                                      np.argmax(logits, -1))
+
+
 class TestWindowedBeam:
     def test_beam1_on_windowed_model_equals_windowed_greedy(self):
         """Beam decoding shares _decode_attend, so the window band must

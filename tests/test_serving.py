@@ -1,0 +1,494 @@
+"""Continuous-batching serving engine invariants (ISSUE 4).
+
+The two load-bearing acceptance pins, asserted structurally:
+
+- **Stream equivalence** — N requests through the engine (staggered
+  joins/leaves forced by a slot count smaller than the request count)
+  produce token streams identical to N sequential ``generate`` calls,
+  and tensor-parallel decode == single-device for the same stream (the
+  repo's distributed == single-device values convention extended to
+  serving).
+- **No recompile** — the steady-state decode step compiles exactly once
+  across occupancy churn (jit cache size pinned: a second compile is a
+  FAILURE, not a slowdown), and prefill compiles are bounded by the
+  bucket ladder.
+
+Plus the TP efficiency contract (one psum per column→row pair, zero
+collectives in the paged-cache bookkeeping), allocator/scheduler units,
+and the serving trace-event rollup.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from chainermn_tpu.models.transformer import TransformerLM, generate
+from chainermn_tpu.serving import (
+    BlockAllocator,
+    Request,
+    Scheduler,
+    ServingEngine,
+    default_num_blocks,
+)
+
+VOCAB = 32
+
+
+def tiny_lm(**kw):
+    cfg = dict(vocab_size=VOCAB, num_layers=2, num_heads=4, d_model=16,
+               d_ff=32, max_len=32, compute_dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    return model, params
+
+
+def _requests(n, seed=0, max_prompt=7, max_new=6):
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        p_len = int(rs.randint(1, max_prompt))
+        out.append((rs.randint(1, VOCAB, size=p_len).tolist(),
+                    int(rs.randint(1, max_new))))
+    return out
+
+def _generate_ref(model, params, prompt, n_new):
+    return np.asarray(generate(
+        model, params, jnp.asarray([prompt], jnp.int32),
+        len(prompt) + n_new,
+    ))[0].tolist()
+
+
+def _run_stream(engine, reqs, policy="fcfs"):
+    sched = Scheduler(engine, policy=policy)
+    ids = [sched.submit(Request(prompt=p, max_new_tokens=g))
+           for p, g in reqs]
+    results = sched.run()
+    return [results[rid]["tokens"] for rid in ids], sched
+
+
+class TestStreamEquivalence:
+    """The serving acceptance invariant: engine streams == sequential
+    ``generate`` streams, join/leave churn and cache layout
+    notwithstanding."""
+
+    @pytest.mark.parametrize("impl", ["dense", "paged"])
+    def test_staggered_stream_matches_sequential_generate(self, lm, impl):
+        model, params = lm
+        # 2 slots x 6 requests: the scheduler is FORCED to stagger
+        # joins/leaves mid-decode of other requests.
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl=impl,
+            kv_block_size=8, prefill_buckets=(4, 8, 16),
+        )
+        reqs = _requests(6, seed=0)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_rope_positions_stream_matches(self, lm):
+        model = tiny_lm(pos_encoding="rope")
+        params = model.init(
+            jax.random.PRNGKey(2), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4, 8),
+        )
+        reqs = _requests(4, seed=3)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_windowed_model_stream_matches(self):
+        # window is not a parameter: init through the windowless twin
+        # (the training path demands a window-honouring attention_fn the
+        # decode-only serving engine never calls).
+        model = tiny_lm(window=6)
+        params = tiny_lm().init(
+            jax.random.PRNGKey(4), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="dense",
+            prefill_buckets=(4, 8, 16),
+        )
+        reqs = _requests(3, seed=5, max_prompt=10, max_new=8)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_gqa_model_stream_matches(self):
+        model = tiny_lm(num_kv_heads=2)
+        params = model.init(
+            jax.random.PRNGKey(6), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        engine = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl="paged",
+            kv_block_size=16, prefill_buckets=(4, 8),
+        )
+        reqs = _requests(5, seed=7)
+        streams, _ = _run_stream(engine, reqs, policy="prefill_priority")
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_sampling_reproducible_across_engines(self, lm):
+        model, params = lm
+        def stream(seed):
+            engine = ServingEngine(
+                model, params, num_slots=2, max_len=32,
+                decode_impl="dense", prefill_buckets=(4, 8),
+                temperature=0.8, top_k=8, rng=jax.random.PRNGKey(seed),
+            )
+            streams, _ = _run_stream(engine, _requests(3, seed=9))
+            return streams
+        assert stream(42) == stream(42)
+        assert stream(42) != stream(43)  # rng actually reaches sampling
+
+
+class TestTensorParallel:
+    """dist == single for the same stream + the structural collective
+    pins (HLO-count convention)."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return Mesh(np.array(jax.devices("cpu")[:2]), ("model",))
+
+    @pytest.mark.parametrize("impl", ["dense", "paged"])
+    def test_tp_stream_matches_single_device(self, lm, mesh, impl):
+        model, params = lm
+        reqs = _requests(5, seed=11)
+        single = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl=impl,
+            kv_block_size=8, prefill_buckets=(4, 8),
+        )
+        tp = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl=impl,
+            kv_block_size=8, prefill_buckets=(4, 8), mesh=mesh,
+        )
+        s_streams, _ = _run_stream(single, reqs)
+        t_streams, _ = _run_stream(tp, reqs)
+        assert t_streams == s_streams
+        # ...and both equal the sequential generate reference.
+        for (prompt, n_new), got in zip(reqs, t_streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_tp_decode_collective_counts(self, lm, mesh):
+        """One all-reduce per column→row pair — 2 per layer (attention
+        proj + FFN down), nothing else on the wire: zero collectives in
+        the paged-cache bookkeeping (scatter/gather are slot-local by
+        construction)."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4,), mesh=mesh,
+        )
+        args = (
+            engine._cache, engine._vars,
+            jnp.zeros((3,), jnp.int32), jnp.zeros((3,), jnp.int32),
+            jnp.asarray(engine._dummy_tables()), engine._key,
+        )
+        txt = engine._decode_step_jit.lower(*args).compile().as_text()
+        n_ar = txt.count("all-reduce(")
+        assert n_ar == 2 * model.num_layers, (
+            f"expected {2 * model.num_layers} all-reduces "
+            f"(2 per layer), got {n_ar}"
+        )
+        for op in ("all-gather(", "collective-permute(", "all-to-all(",
+                   "reduce-scatter("):
+            assert txt.count(op) == 0, f"unexpected {op} in decode step"
+
+
+class TestNoRecompile:
+    def test_decode_step_compiles_exactly_once_across_churn(self, lm):
+        """The tentpole's shape discipline, pinned: joins/leaves/ragged
+        prompts churn the slot array through a full stream, and the
+        steady-state step still shows ONE jit cache entry — a second
+        compile is a failure, not a slowdown."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4, 8, 16),
+        )
+        streams, _ = _run_stream(engine, _requests(6, seed=13))
+        assert len(streams) == 6
+        assert engine.decode_compile_count() == 1
+
+    def test_prefill_compiles_bounded_by_buckets(self, lm):
+        model, params = lm
+        buckets = (4, 8, 16)
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="dense",
+            prefill_buckets=buckets,
+        )
+        # prompt lengths spanning every bucket, several per bucket
+        reqs = [([1 + i] * p, 2) for i, p in enumerate(
+            (1, 3, 4, 5, 7, 8, 9, 15, 16, 2)
+        )]
+        _run_stream(engine, reqs)
+        assert engine.prefill_compile_count() <= len(buckets)
+
+
+class TestBlockAllocator:
+    def test_alloc_grow_release_cycle(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, num_slots=2,
+                           max_len=16)
+        assert a.free_blocks == 8 and a.max_blocks == 4
+        assert a.ensure(0, 5)  # 2 blocks
+        assert a.blocks_in_use == 2
+        assert a.ensure(0, 5)  # idempotent
+        assert a.blocks_in_use == 2
+        assert (a.tables[0][:2] > 0).all()  # scratch never handed out
+        assert (a.tables[1] == 0).all()
+        a.release(0)
+        assert a.blocks_in_use == 0
+        assert (a.tables[0] == 0).all()  # row points back at scratch
+
+    def test_exhaustion_is_all_or_nothing(self):
+        a = BlockAllocator(num_blocks=4, block_size=4, num_slots=2,
+                           max_len=16)
+        assert a.ensure(0, 12)  # 3 blocks: pool drained
+        assert not a.ensure(1, 5)  # needs 2, has 0
+        assert (a.tables[1] == 0).all()  # nothing half-granted
+        a.release(0)
+        assert a.ensure(1, 5)
+
+    def test_horizon_and_ctor_validation(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, num_slots=1,
+                           max_len=16)
+        with pytest.raises(ValueError, match="horizon"):
+            a.ensure(0, 17)
+        with pytest.raises(ValueError, match="scratch"):
+            BlockAllocator(num_blocks=1, block_size=4, num_slots=1,
+                           max_len=16)
+
+    def test_default_num_blocks_covers_worst_case(self):
+        assert default_num_blocks(4, 8, 32) == 4 * 4 + 1
+
+
+class TestSchedulerAndAccounting:
+    def test_oversubscribed_pool_defers_admission(self, lm):
+        """A pool that fits ~one request at a time still serves the
+        whole queue (admission defers instead of failing) — the paged
+        oversubscription contract."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, num_blocks=3, prefill_buckets=(4, 8),
+        )
+        reqs = _requests(4, seed=17, max_prompt=6, max_new=4)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_prefill_reserves_real_tokens_not_the_padded_bucket(self, lm):
+        """A prompt that falls back to the max_len bucket must reserve
+        blocks for its REAL tokens only — pad writes ride the scratch
+        block and decode grows incrementally, so bucket-width
+        reservation would defeat oversubscription (review finding)."""
+        model, params = lm
+        # ladder (4,) + appended max_len=32: a 6-token prompt buckets
+        # to 32, but with block_size=8 it must claim only ONE block.
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, num_blocks=3,  # 2 allocatable << bucket 32
+            prefill_buckets=(4,),
+        )
+        prompt = [3, 1, 4, 1, 5, 9]
+        res = engine.prefill_join(prompt)
+        assert res is not None and res[2] == 32  # admitted at bucket 32
+        assert engine._alloc.blocks_in_use == 1
+        # ...and the stream still matches generate (pad writes landed in
+        # scratch, decode grew the second block on demand).
+        slot, tok, _ = res
+        stream = list(prompt) + [tok]
+        for _ in range(9):
+            toks, _dur = engine.decode_step()
+            stream.append(int(toks[slot]))
+        assert stream == _generate_ref(model, params, prompt, 10)
+        assert engine._alloc.blocks_in_use == 2
+
+    def test_impossible_request_raises_not_hangs(self, lm):
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=1, max_len=32, decode_impl="paged",
+            kv_block_size=4, num_blocks=2,  # 1 allocatable block: 4 slots
+            prefill_buckets=(8,),
+        )
+        sched = Scheduler(engine)
+        # 5 real tokens need 2 blocks — more than the pool will EVER have
+        sched.submit(Request(prompt=[1, 2, 3, 4, 5], max_new_tokens=2))
+        with pytest.raises(RuntimeError, match="cannot be admitted"):
+            sched.run()
+
+    def test_eos_finishes_early_and_frees_the_slot(self, lm):
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=1, max_len=32, decode_impl="dense",
+            prefill_buckets=(4,),
+        )
+        prompt = [3, 5, 7]
+        full = _generate_ref(model, params, prompt, 8)
+        eos = full[len(prompt) + 2]  # third generated token
+        sched = Scheduler(engine)
+        rid = sched.submit(Request(prompt=prompt, max_new_tokens=8,
+                                   eos_id=eos))
+        results = sched.run()
+        gen = results[rid]["generated"]
+        assert gen == full[len(prompt):len(prompt) + 3]  # stops AT eos
+        assert gen[-1] == eos
+        assert engine.n_active == 0 and engine.free_slot_count == 1
+
+    def test_serving_trace_events_and_rollup(self, lm):
+        from chainermn_tpu.observability import trace as obs_trace
+
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4, 8),
+        )
+        rec = obs_trace.enable(None)  # in-memory recorder
+        try:
+            reqs = _requests(4, seed=19)
+            streams, sched = _run_stream(engine, reqs)
+            events = list(rec.events)
+        finally:
+            obs_trace.disable()
+        serving = [e for e in events if e.get("kind") == "serving"]
+        assert serving, "scheduler emitted no serving events"
+        assert all(e["schema"] == obs_trace.TRACE_SCHEMA for e in serving)
+        phases = {e["phase"] for e in serving}
+        assert phases == {"queue_wait", "prefill", "decode_step", "finish"}
+        n_fin = sum(1 for e in serving if e["phase"] == "finish")
+        assert n_fin == len(reqs)
+        # rollup (the trace_report serving-section owner) agrees with
+        # the scheduler's own accounting
+        roll = obs_trace.summarize_serving(events)
+        summ = sched.summary()
+        assert roll["requests"] == len(reqs)
+        assert roll["generated_tokens"] == summ["generated_tokens"]
+        assert roll["generated_tokens"] == sum(
+            len(s) for s in streams
+        ) - sum(len(p) for p, _ in reqs)
+        assert roll["decode_steps"] == summ["decode_steps"]
+        assert roll["occupancy_mean"] == summ["occupancy_mean"]
+        assert roll["tokens_per_sec"] is not None
+        assert roll["token_ms_p50"] is not None
+        assert roll["token_ms_p99"] >= roll["token_ms_p50"]
+        # no serving events -> section omitted, not empty
+        assert obs_trace.summarize_serving(
+            [e for e in events if e.get("kind") != "serving"]
+        ) is None
+
+    def test_fcfs_preserves_arrival_order_of_admission(self, lm):
+        from chainermn_tpu.observability import trace as obs_trace
+
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=1, max_len=32, decode_impl="dense",
+            prefill_buckets=(4,),
+        )
+        rec = obs_trace.enable(None)
+        try:
+            sched = Scheduler(engine, policy="fcfs")
+            ids = [sched.submit(Request(prompt=[i + 1, i + 2],
+                                        max_new_tokens=3))
+                   for i in range(3)]
+            sched.run()
+            order = [e["request"] for e in rec.events
+                     if e.get("kind") == "serving"
+                     and e.get("phase") == "prefill"]
+        finally:
+            obs_trace.disable()
+        assert order == ids
+
+
+class TestValidation:
+    def test_engine_rejects_bad_configs(self, lm):
+        model, params = lm
+        with pytest.raises(ValueError, match="num_slots"):
+            ServingEngine(model, params, num_slots=0)
+        with pytest.raises(ValueError, match="max_len"):
+            ServingEngine(model, params, num_slots=1, max_len=64)
+        with pytest.raises(ValueError, match="decode_impl"):
+            ServingEngine(model, params, num_slots=1, decode_impl="magic")
+        with pytest.raises(ValueError, match="top_k/top_p"):
+            ServingEngine(model, params, num_slots=1, top_k=4)
+        with pytest.raises(ValueError, match="return_hidden"):
+            ServingEngine(tiny_lm(return_hidden=True), params, num_slots=1)
+
+    def test_submit_rejects_over_horizon_request_up_front(self, lm):
+        """prompt + max_new_tokens beyond the engine horizon is refused
+        AT SUBMIT — caught mid-stream it would abort every other
+        in-flight request (review finding)."""
+        model, params = lm
+        engine = ServingEngine(model, params, num_slots=2, max_len=32,
+                               decode_impl="dense", prefill_buckets=(4,))
+        sched = Scheduler(engine)
+        with pytest.raises(ValueError, match="horizon"):
+            sched.submit(Request(prompt=[1, 2, 3], max_new_tokens=30))
+        # a legal request still serves normally afterwards
+        rid = sched.submit(Request(prompt=[1, 2, 3], max_new_tokens=29))
+        results = sched.run()
+        assert len(results[rid]["generated"]) == 29
+
+    def test_submit_rejects_duplicate_requests_and_ids(self, lm):
+        """Requests are mutable (submit writes the id onto them): the
+        same object twice, or a stale id colliding with another
+        scheduler's sequence, must raise instead of silently merging
+        results (review finding)."""
+        model, params = lm
+        engine = ServingEngine(model, params, num_slots=2, max_len=32,
+                               decode_impl="dense", prefill_buckets=(4,))
+        sched = Scheduler(engine)
+        req = Request(prompt=[1, 2], max_new_tokens=2)
+        sched.submit(req)
+        with pytest.raises(ValueError, match="already queued"):
+            sched.submit(req)
+        sched.run()
+        # carried over to a SECOND scheduler, the stale 'r0' collides
+        # with its own sequence either way round
+        engine2 = ServingEngine(model, params, num_slots=2, max_len=32,
+                                decode_impl="dense", prefill_buckets=(4,))
+        sched2 = Scheduler(engine2)
+        sched2.submit(req)  # stale id 'r0' rides along
+        with pytest.raises(ValueError, match="duplicate request_id"):
+            sched2.submit(Request(prompt=[3, 4], max_new_tokens=2))
+
+    def test_prompt_bounds(self, lm):
+        model, params = lm
+        engine = ServingEngine(model, params, num_slots=1, max_len=32,
+                               decode_impl="dense", prefill_buckets=(4,))
+        with pytest.raises(ValueError, match="empty"):
+            engine.prefill_join([])
+        with pytest.raises(ValueError, match="no room"):
+            engine.prefill_join(list(range(1, 33)))
+
+    def test_tp_divisibility_checked(self, lm):
+        model, params = lm
+        mesh = Mesh(np.array(jax.devices("cpu")[:3]), ("model",))
+        with pytest.raises(ValueError, match="divide"):
+            ServingEngine(model, params, num_slots=1, mesh=mesh)
+
+    def test_slot_decode_guards(self, lm):
+        model, params = lm
+        with pytest.raises(ValueError, match="decode=True"):
+            model.apply(params, jnp.zeros((1, 1), jnp.int32), train=False,
+                        decode_positions=jnp.zeros((1,), jnp.int32))
+        paged = tiny_lm(kv_layout="paged", kv_num_blocks=4)
+        with pytest.raises(ValueError, match="block_tables"):
+            paged.apply(params, jnp.zeros((1, 1), jnp.int32), train=False,
+                        decode=True,
+                        decode_positions=jnp.zeros((1,), jnp.int32),
+                        mutable=["cache"])

@@ -27,6 +27,12 @@ Sections:
   comm time vs comm time hidden behind compute and the
   ``hidden_fraction`` between them. Omitted when the trace carries no
   overlap events.
+- **serving** — continuous-batching accounting (ISSUE 4) from the
+  scheduler's ``serving`` events: requests/tokens served, tokens/s over
+  device-busy time, nearest-rank p50/p99 per-token latency (one decode
+  step = one token for every active request), mean slot occupancy, and
+  queue-wait/prefill means. Omitted when the trace has no serving
+  events.
 - **stragglers** — flagged divergence reports, if any.
 - **roofline** — where a device kind with a known HBM peak appears
   (bench.py's per-kind tables, the same floors tools/byte_audit.py
@@ -223,6 +229,11 @@ def summarize(events: list[dict]) -> dict:
     overlap = _trace_mod().summarize_overlap(events)
     if overlap is not None:
         out["overlap"] = overlap
+    # Serving section (ISSUE 4: same one-owner discipline —
+    # summarize_serving feeds this report AND bench's serving phase).
+    serving = _trace_mod().summarize_serving(events)
+    if serving is not None:
+        out["serving"] = serving
     return out
 
 
@@ -301,6 +312,36 @@ def render_text(s: dict) -> str:
                 f"{m['comm_ms_hidden']:.3f} ms hidden behind compute "
                 f"({m['hidden_fraction'] * 100:.1f}% hidden, "
                 f"{m['n']} bucket events)"
+            )
+    if s.get("serving"):
+        sv = s["serving"]
+        lines.append("")
+        lines.append("serving (continuous batching):")
+        lines.append(
+            f"  {sv['requests']} request(s), {sv['generated_tokens']} "
+            f"token(s) over {sv['prefills']} prefill(s) + "
+            f"{sv['decode_steps']} decode step(s)"
+        )
+        if sv.get("tokens_per_sec") is not None:
+            lines.append(f"  tokens/s: {sv['tokens_per_sec']}")
+        if sv.get("token_ms_p50") is not None:
+            lines.append(
+                f"  per-token latency: p50 {sv['token_ms_p50']:.3f} ms, "
+                f"p99 {sv['token_ms_p99']:.3f} ms"
+            )
+        if sv.get("occupancy_mean") is not None:
+            lines.append(
+                f"  slot occupancy: {sv['occupancy_mean'] * 100:.1f}% mean"
+            )
+        # queue_wait and prefill are separate events: a truncated trace
+        # may carry one without the other — guard each independently.
+        if sv.get("queue_wait_ms_mean") is not None:
+            lines.append(
+                f"  queue wait: {sv['queue_wait_ms_mean']:.3f} ms mean"
+            )
+        if sv.get("prefill_ms_mean") is not None:
+            lines.append(
+                f"  prefill: {sv['prefill_ms_mean']:.3f} ms mean"
             )
     if s["stragglers"]:
         lines.append("")
