@@ -453,6 +453,8 @@ _COMPACT_KEYS = (
     "kernel_sweep_numeric_errors", "proxy_spread_pct", "autotune",
     "hidden_comm_fraction", "reduction_schedule_selected",
     "overlap_spread_pct", "serving_tokens_per_sec", "serving_spread_pct",
+    "serving_spec_selected", "serving_spec_speedup",
+    "serving_spec_accept_rate",
 )
 
 
@@ -1055,7 +1057,14 @@ def _bench_serving(comm, on_accel: bool):
        ``prefill_priority`` admission, ``decode_impl='auto'`` so the
        freshly recorded decision is exercised with provenance):
        tokens/s + nearest-rank p50/p99 per-token latency + mean slot
-       occupancy from ``Scheduler.summary()``.
+       occupancy from ``Scheduler.summary()``;
+    4. speculative spec-vs-plain (ISSUE 5): the same stream at every
+       ``spec_tokens`` candidate K (n-gram drafting over each request's
+       own history, greedy) — per-K tokens/s medians, ms-per-GENERATED-
+       token rows (``serving_spec_ms``: acceptance rate priced in) and
+       per-K acceptance rates, adopted as this shape's ``spec_tokens``
+       decision via ``record_measurement`` (spread-gated: a noise-band
+       "winner" is honestly refused and the table default stands).
 
     ``serving_model_shape`` (DxHxL) is the key material
     ``tuning seed`` uses to rebuild ``serving_decision_key`` offline.
@@ -1069,6 +1078,7 @@ def _bench_serving(comm, on_accel: bool):
     from chainermn_tpu.models.transformer import TransformerLM
     from chainermn_tpu.serving import (
         DECODE_IMPLS,
+        SPEC_TOKENS,
         Request,
         Scheduler,
         ServingEngine,
@@ -1100,9 +1110,13 @@ def _bench_serving(comm, on_accel: bool):
     }
 
     def step_median(impl, bs):
+        # spec_tokens pinned to 0: these are the PLAIN decode rows — on
+        # a box whose cache carries an adopted spec_tokens>0 an 'auto'
+        # here would silently turn the baseline speculative.
         eng = ServingEngine(
             model, params, num_slots=slots, max_len=max_len,
             decode_impl=impl, kv_block_size=bs, prefill_buckets=(8, 16),
+            spec_tokens=0,
         )
         for i in range(slots):  # full occupancy: the steady-state shape
             eng.prefill_join([1 + i % (vocab - 1)] * 4)
@@ -1159,15 +1173,18 @@ def _bench_serving(comm, on_accel: bool):
     except Exception as e:
         out["serving_autotune_error"] = f"{type(e).__name__}: {e}"[:120]
 
-    # --- full scheduler stream at 'auto' (provenance exercised); one
-    # engine reused so repeats measure serving, not recompiles.
+    # --- full scheduler stream at 'auto' decode/block (provenance
+    # exercised) but PLAIN decode (spec_tokens=0): this is the headline
+    # baseline the spec sweep below compares against; one engine reused
+    # so repeats measure serving, not recompiles.
     eng = ServingEngine(
         model, params, num_slots=slots, max_len=max_len,
         decode_impl="auto", kv_block_size="auto", prefill_buckets=(8, 16),
+        spec_tokens=0,
     )
 
-    def run_stream():
-        sched = Scheduler(eng, policy="prefill_priority")
+    def run_stream(engine):
+        sched = Scheduler(engine, policy="prefill_priority")
         rs = np.random.RandomState(0)
         for _ in range(stream_requests):
             p_len = int(rs.randint(3, 13))
@@ -1178,20 +1195,92 @@ def _bench_serving(comm, on_accel: bool):
         sched.run()
         return sched.summary()
 
-    run_stream()  # compile + warm every bucket
-    summaries = [run_stream() for _ in range(1 if on_accel else 3)]
-    summaries.sort(key=lambda s: s["tokens_per_sec"])
-    med = summaries[len(summaries) // 2]
-    tps = [s["tokens_per_sec"] for s in summaries]
+    def stream_medians(engine):
+        """Median summary + tokens/s spread over repeats (one engine:
+        repeats measure serving, not recompiles)."""
+        run_stream(engine)  # compile + warm every bucket
+        summaries = [run_stream(engine)
+                     for _ in range(1 if on_accel else 3)]
+        summaries.sort(key=lambda s: s["tokens_per_sec"])
+        med = summaries[len(summaries) // 2]
+        tps = [s["tokens_per_sec"] for s in summaries]
+        spread = None
+        if len(summaries) > 1 and med["tokens_per_sec"]:
+            spread = round(
+                100.0 * (tps[-1] - tps[0]) / med["tokens_per_sec"], 1
+            )
+        return med, spread
+
+    med, spread = stream_medians(eng)
     out["serving_tokens_per_sec"] = med["tokens_per_sec"]
-    if len(summaries) > 1 and med["tokens_per_sec"]:
-        out["serving_spread_pct"] = round(
-            100.0 * (tps[-1] - tps[0]) / med["tokens_per_sec"], 1
-        )
+    if spread is not None:
+        out["serving_spread_pct"] = spread
     out["serving_token_ms_p50"] = med["token_ms_p50"]
     out["serving_token_ms_p99"] = med["token_ms_p99"]
+    out["serving_ttft_ms_p50"] = med.get("ttft_ms_p50")
     out["serving_occupancy_mean"] = med["occupancy_mean"]
     out["serving_requests"] = med["requests"]
+
+    # --- speculative spec-vs-plain (ISSUE 5): identical stream at every
+    # spec_tokens candidate, greedy n-gram drafting. ms per GENERATED
+    # token (1000 / tokens-per-sec) is the adoption row — acceptance
+    # rate is priced into it, and `tuning seed` rebuilds the decision
+    # from exactly these keys offline.
+    try:
+        spec_ms, spec_tps, spec_spreads, spec_rates = {}, {}, {}, {}
+        for k_str in SPEC_TOKENS:
+            k = int(k_str)
+            if k == 0:
+                # the headline baseline above IS the K=0 row (identical
+                # engine args and request stream, and the registry was
+                # last mutated before it was built, so 'auto' resolved
+                # the same) — reuse its medians instead of paying
+                # another warm-up plus repeat streams.
+                med_k, spread_k = med, spread
+            else:
+                eng_k = ServingEngine(
+                    model, params, num_slots=slots, max_len=max_len,
+                    decode_impl="auto", kv_block_size="auto",
+                    prefill_buckets=(8, 16), spec_tokens=k,
+                )
+                med_k, spread_k = stream_medians(eng_k)
+                del eng_k
+            tps_k = med_k["tokens_per_sec"]
+            spec_tps[k_str] = tps_k
+            spec_ms[k_str] = round(1000.0 / tps_k, 4) if tps_k else None
+            spec_spreads[k_str] = spread_k if spread_k is not None else 0.0
+            sp = med_k.get("speculation") or {}
+            if sp.get("accept_rate") is not None:
+                spec_rates[k_str] = sp["accept_rate"]
+        out["serving_spec_tokens_per_sec"] = spec_tps
+        if all(v is not None for v in spec_ms.values()):
+            out["serving_spec_ms"] = spec_ms
+        if not on_accel:
+            # same convention as the decode rows above: spread keys only
+            # for real multi-sample runs; absent = 10% seeding floor.
+            out["serving_spec_spread_pct"] = max(spec_spreads.values())
+        if spec_rates:
+            out["serving_spec_accept_rates"] = spec_rates
+        sel = None
+        if "serving_spec_ms" in out:
+            from chainermn_tpu import tuning
+
+            key = serving_decision_key(d_model, heads, max_len)
+            tuning.record_measurement(
+                "spec_tokens", key, spec_ms,
+                spreads=None if on_accel else spec_spreads,
+            )
+            sel = tuning.choice("spec_tokens", SPEC_TOKENS, key)
+            out["serving_spec_selected"] = sel
+            if spec_tps.get("0"):
+                best = sel if spec_tps.get(sel) else "0"
+                out["serving_spec_speedup"] = round(
+                    spec_tps[best] / spec_tps["0"], 3
+                )
+            if sel in spec_rates:
+                out["serving_spec_accept_rate"] = spec_rates[sel]
+    except Exception as e:  # never lose the phase's plain rows
+        out["serving_spec_error"] = f"{type(e).__name__}: {e}"[:160]
     if not on_accel:
         out["serving_note"] = (
             "CPU-proxy honest floor: tiny LM on the loopback mesh — the "

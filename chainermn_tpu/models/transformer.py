@@ -169,7 +169,17 @@ class TransformerBlock(nn.Module):
         positions[b] + t`` — ``T == 1`` is the steady-state decode step,
         ``T == bucket`` is prefill (pad-position writes land beyond the
         row's true length and are re-written by later decode steps
-        before any mask ever admits them).
+        before any mask ever admits them), and ``T == K+1`` is the
+        speculative verify span (:mod:`chainermn_tpu.serving.speculate`):
+        rejected-draft writes are stale by the same argument — the
+        engine rewinds positions on the HOST only, so the next span
+        starts at the accept point and re-writes every stale row before
+        its position is ever admitted. Writes that overhang the cache
+        horizon (a verify span near ``max_len``) are dropped by the
+        scatter (dense rows out of bounds) or redirected to the scratch
+        block (paged, :func:`~chainermn_tpu.ops.paged_kv.paged_update`);
+        the engine caps ACCEPTANCE inside the horizon, so committed
+        tokens always have real cache rows.
 
         Two cache layouts behind one arithmetic: ``'dense'`` stores
         ``[n_slots, decode_max_len, kvh, dh]`` directly (``slots`` maps

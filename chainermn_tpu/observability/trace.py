@@ -24,7 +24,8 @@ per step, per process. Three properties are load-bearing:
   ``dispatch`` (autotune provenance), ``straggler``, ``profile_start`` /
   ``profile_stop``, ``wire`` / ``overlap_config`` (ISSUE 3 per-bucket
   reduction telemetry), ``serving`` (ISSUE 4 queue_wait / prefill /
-  decode_step / finish phases). ``tools/trace_report.py`` summarizes a
+  decode_step / finish phases), ``speculate`` (ISSUE 5 per-tick
+  drafted/accepted counts). ``tools/trace_report.py`` summarizes a
   JSONL file;
   :func:`chrome_trace` converts to the ``chrome://tracing`` / Perfetto
   format.
@@ -413,9 +414,10 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
 
 
 def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
-    """Serving rollup from ``serving`` events (ISSUE 4: the consumer
-    side of the scheduler's per-phase events; one owner shared by
-    ``tools/trace_report.py`` and bench's ``serving`` phase).
+    """Serving rollup from ``serving`` (+ ``speculate``) events (ISSUE
+    4/5: the consumer side of the scheduler's per-phase events; one
+    owner shared by ``tools/trace_report.py`` and bench's ``serving``
+    phase).
 
     Definitions (deterministic — the report contract pins them):
 
@@ -425,22 +427,46 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
       durations) — device-busy time, not wall (queue idle gaps are the
       scheduler's property, not the engine's);
     - ``token_ms_p50``/``p99`` = nearest-rank percentiles (ceil(q*n))
-      over ``decode_step`` durations — each active request gains one
-      token per step, so the step duration IS its per-token latency;
+      over ``decode_step`` durations — under plain decode each active
+      request gains one token per step, so the step duration IS its
+      per-token latency (under speculation it is the TICK latency for
+      1..K+1 tokens per request — divide by ``generated_tokens /
+      decode_steps`` for an amortized per-token figure);
+    - ``ttft_ms_p50``/``p99`` = nearest-rank percentiles over the
+      prefill events' ``ttft_s`` (submit → first token; None for
+      traces predating the field);
     - ``occupancy_mean`` = mean of ``n_active / n_slots`` over decode
-      steps.
+      steps;
+    - ``speculation`` (present only when ``speculate`` events exist) =
+      drafted/accepted token totals, ``accept_rate`` = accepted /
+      drafted, and ``accept_len_hist`` — accept-length counts keyed by
+      stringified length (JSON-stable), the trace_report histogram.
 
     Returns None when the trace carries no serving events."""
     import math
 
     queue_waits: list[float] = []
     prefills: list[float] = []
+    ttfts: list[float] = []
     steps: list[float] = []
     occupancy: list[float] = []
     step_tokens = 0
     finishes = 0
+    spec_ticks = 0
+    spec_drafted = 0
+    spec_accepted = 0
+    accept_hist: dict = {}
     for ev in events:
-        if ev.get("kind") != "serving":
+        kind = ev.get("kind")
+        if kind == "speculate":
+            spec_ticks += 1
+            spec_drafted += int(ev.get("drafted") or 0)
+            spec_accepted += int(ev.get("accepted") or 0)
+            for a in (ev.get("accept_lens") or ()):
+                k = str(int(a))
+                accept_hist[k] = accept_hist.get(k, 0) + 1
+            continue
+        if kind != "serving":
             continue
         phase = ev.get("phase")
         dur = float(ev.get("dur_s") or 0.0)
@@ -448,6 +474,8 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
             queue_waits.append(dur)
         elif phase == "prefill":
             prefills.append(dur)
+            if ev.get("ttft_s") is not None:
+                ttfts.append(float(ev["ttft_s"]))
         elif phase == "decode_step":
             steps.append(dur)
             step_tokens += int(ev.get("tokens") or 0)
@@ -457,7 +485,7 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
                                  / float(n_slots))
         elif phase == "finish":
             finishes += 1
-    if not (queue_waits or prefills or steps or finishes):
+    if not (queue_waits or prefills or steps or finishes or spec_ticks):
         return None
 
     def pct(vals: list, q: float):
@@ -482,11 +510,27 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
                          if steps else None),
         "token_ms_p99": (round(pct(steps, 0.99) * 1e3, 4)
                          if steps else None),
+        "ttft_ms_p50": (round(pct(ttfts, 0.5) * 1e3, 4)
+                        if ttfts else None),
+        "ttft_ms_p99": (round(pct(ttfts, 0.99) * 1e3, 4)
+                        if ttfts else None),
         "occupancy_mean": (round(sum(occupancy) / len(occupancy), 4)
                            if occupancy else None),
         "tokens_per_sec": (round(tokens / busy_s, 2) if busy_s > 0
                            else None),
     }
+    if spec_ticks:
+        out["speculation"] = {
+            "ticks": spec_ticks,
+            "drafted": spec_drafted,
+            "accepted": spec_accepted,
+            "accept_rate": (round(spec_accepted / spec_drafted, 4)
+                            if spec_drafted else None),
+            "accept_len_hist": {
+                k: accept_hist[k]
+                for k in sorted(accept_hist, key=int)
+            },
+        }
     return out
 
 

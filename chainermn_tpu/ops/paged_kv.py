@@ -40,18 +40,28 @@ def paged_update(pool, block_tables, positions, new):
       block_tables: ``[B, max_blocks]`` int32.
       positions: ``[B]`` int32 — position of row ``b``'s FIRST new token.
       new: ``[B, T, kv_heads, head_dim]`` — ``T`` consecutive tokens per
-        row (``T=1`` steady-state decode, ``T=bucket`` prefill).
+        row (``T=1`` steady-state decode, ``T=K+1`` speculative verify
+        spans, ``T=bucket`` prefill).
 
     Returns the updated pool. Rows whose table entries are 0 write into
     the scratch block (see module docstring) — duplicate scatter indices
-    there are harmless by construction.
+    there are harmless by construction. Positions BEYOND the table
+    horizon (a per-row verify span overhanging ``max_blocks *
+    block_size`` — e.g. a slot near the serving horizon, or a released
+    slot's stale span) are redirected to scratch explicitly: the naive
+    gather would clamp the logical index into the row's LAST table
+    entry, which may be a live block.
     """
     block_size = pool.shape[1]
     B, T = new.shape[:2]
+    max_blocks = block_tables.shape[1]
     pos = positions[:, None] + jnp.arange(T, dtype=positions.dtype)[None]
     logical = pos // block_size
     offset = pos % block_size
-    phys = jnp.take_along_axis(block_tables, logical, axis=1)  # [B, T]
+    phys = jnp.take_along_axis(
+        block_tables, jnp.minimum(logical, max_blocks - 1), axis=1
+    )  # [B, T]
+    phys = jnp.where(logical < max_blocks, phys, 0)
     return pool.at[phys.reshape(-1), offset.reshape(-1)].set(
         new.reshape(B * T, *new.shape[2:])
     )

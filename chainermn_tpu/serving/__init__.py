@@ -1,13 +1,17 @@
 """Continuous-batching serving engine (ISSUE 4): slot-scheduled decode
 with a paged KV cache, a bucketed prefill/decode split, and tokens/s
-accounting. See docs/serving.md for the engine contract."""
+accounting — plus speculative draft-and-verify decoding (ISSUE 5):
+per-tick n-gram/model drafting, one jitted multi-token verify step,
+host-metadata rollback. See docs/serving.md for the engine contract."""
 
 from chainermn_tpu.serving.engine import (
     DECODE_IMPLS,
     KV_BLOCK_SIZES,
+    SPEC_TOKENS,
     ServingEngine,
     resolve_decode_impl,
     resolve_kv_block_size,
+    resolve_spec_tokens,
     serving_decision_key,
     shard_lm_params,
 )
@@ -17,6 +21,11 @@ from chainermn_tpu.serving.kv_blocks import (
     init_serving_cache,
 )
 from chainermn_tpu.serving.scheduler import POLICIES, Request, Scheduler
+from chainermn_tpu.serving.speculate import (
+    ModelDrafter,
+    NgramDrafter,
+    accept_length,
+)
 
 __all__ = [
     "ServingEngine",
@@ -25,11 +34,16 @@ __all__ = [
     "BlockAllocator",
     "DECODE_IMPLS",
     "KV_BLOCK_SIZES",
+    "SPEC_TOKENS",
     "POLICIES",
+    "ModelDrafter",
+    "NgramDrafter",
+    "accept_length",
     "default_num_blocks",
     "init_serving_cache",
     "resolve_decode_impl",
     "resolve_kv_block_size",
+    "resolve_spec_tokens",
     "serving_decision_key",
     "shard_lm_params",
 ]

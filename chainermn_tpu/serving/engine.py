@@ -52,9 +52,13 @@ from chainermn_tpu.serving.kv_blocks import (
     init_serving_cache,
 )
 
-#: tuning-registry candidates for the two serving decisions.
+#: tuning-registry candidates for the serving decisions.
 DECODE_IMPLS = ("dense", "paged")
 KV_BLOCK_SIZES = ("16", "32", "64", "128")
+#: speculation lengths the ``spec_tokens`` decision chooses among
+#: (ISSUE 5): 0 = plain one-token decode; K > 0 = draft-and-verify with
+#: K drafted tokens per slot per tick.
+SPEC_TOKENS = ("0", "2", "4", "8")
 
 
 def serving_decision_key(d_model: int, num_heads: int, max_len: int,
@@ -86,6 +90,18 @@ def resolve_kv_block_size(d_model: int, num_heads: int, max_len: int) -> int:
 
     return int(tuning.choice(
         "kv_block_size", KV_BLOCK_SIZES,
+        serving_decision_key(d_model, num_heads, max_len),
+    ))
+
+
+def resolve_spec_tokens(d_model: int, num_heads: int, max_len: int) -> int:
+    """Resolve the speculation length K via the registry (decision
+    ``spec_tokens``, same key as the other serving decisions — bench's
+    ``serving`` phase measures spec-vs-plain per shape and seeds it)."""
+    from chainermn_tpu import tuning
+
+    return int(tuning.choice(
+        "spec_tokens", SPEC_TOKENS,
         serving_decision_key(d_model, num_heads, max_len),
     ))
 
@@ -158,6 +174,17 @@ class ServingEngine:
       pad_id: prompt right-padding token for the bucketed prefill.
       mesh: optional ``Mesh`` with a ``'model'`` axis → tensor-parallel
         decode (weights sharded via :func:`shard_lm_params`).
+      spec_tokens: speculative draft length K per tick (ISSUE 5):
+        ``0`` = plain one-token decode; ``K > 0`` = each tick drafts up
+        to K tokens per slot and ONE jitted verify forward scores
+        ``[slots, K+1]`` positions, committing the longest greedy-
+        matching prefix plus the model's own next token (1..K+1 tokens
+        per tick, bit-identical to the plain stream). ``'auto'``
+        resolves through the registry (decision ``spec_tokens``).
+        Greedy-only: combining it with ``temperature > 0`` is rejected.
+      drafter: proposal source for ``spec_tokens > 0`` — any object with
+        ``propose(history, k)`` (:mod:`chainermn_tpu.serving.speculate`;
+        default :class:`~chainermn_tpu.serving.speculate.NgramDrafter`).
     """
 
     def __init__(self, model, params, *, num_slots: int,
@@ -169,7 +196,8 @@ class ServingEngine:
                  temperature: float = 0.0,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
-                 rng=None, pad_id: int = 0, mesh=None) -> None:
+                 rng=None, pad_id: int = 0, mesh=None,
+                 spec_tokens="auto", drafter=None) -> None:
         import jax
 
         from chainermn_tpu.models.transformer import TransformerLM
@@ -257,6 +285,48 @@ class ServingEngine:
                 else 64
             self._alloc = None
 
+        # ---- speculation length (ISSUE 5): K drafted tokens per tick,
+        # verified in one forward. Resolved like the other serving
+        # decisions; greedy-only by definition (acceptance compares
+        # drafts against the model's argmax — under sampling there is
+        # no single "the model's token" to match, so the combination is
+        # rejected up front rather than silently de-speculated).
+        if spec_tokens == "auto":
+            spec_tokens = resolve_spec_tokens(
+                model.d_model, model.num_heads, max_len
+            )
+            self._adopt_decision("spec_tokens", key)
+        else:
+            spec_tokens = int(spec_tokens)
+            self.decisions.append({"name": "spec_tokens", "key": key,
+                                   "winner": str(spec_tokens),
+                                   "source": "explicit"})
+        if spec_tokens < 0 or spec_tokens >= max_len:
+            raise ValueError(
+                f"spec_tokens must be in [0, max_len={max_len}), got "
+                f"{spec_tokens}"
+            )
+        if spec_tokens > 0 and self.temperature > 0.0:
+            raise ValueError(
+                "speculative decoding is greedy-only: spec_tokens="
+                f"{spec_tokens} with temperature={self.temperature} has no "
+                "defined acceptance rule here — set temperature=0 or "
+                "spec_tokens=0"
+            )
+        self.spec_tokens = spec_tokens
+        if drafter is not None and not callable(
+            getattr(drafter, "propose", None)
+        ):
+            raise TypeError(
+                "drafter must have a propose(history, k) method "
+                "(see chainermn_tpu.serving.speculate)"
+            )
+        if drafter is None and spec_tokens > 0:
+            from chainermn_tpu.serving.speculate import NgramDrafter
+
+            drafter = NgramDrafter()
+        self._drafter = drafter
+
         # ---- decode-path model (and its TP shard form)
         self._mesh = mesh
         clone_kw: dict[str, Any] = dict(
@@ -314,9 +384,15 @@ class ServingEngine:
         self._last_tok = np.zeros(num_slots, np.int64)
         self._active = np.zeros(num_slots, bool)
         self._free = list(range(num_slots - 1, -1, -1))
+        #: per-slot committed token history (prompt + generated incl.
+        #: the pending last token) — what the drafter proposes from.
+        self._history: list[list[int]] = [[] for _ in range(num_slots)]
         self._tables_dev = None  # device copy of the block tables...
         self._tables_ver = -1    # ...valid while allocator.version holds
         self._decode_step_jit = self._build_decode_step()
+        self._verify_step_jit = (
+            self._build_verify_step() if self.spec_tokens > 0 else None
+        )
         self._prefill_jits: dict[int, Any] = {}
 
     # ------------------------------------------------------------------
@@ -362,6 +438,47 @@ class ServingEngine:
             self._tables_ver = version
         return self._tables_dev
 
+    def _tp_jit(self, inner, n_plain_args: int):
+        """The ONE jit(+shard_map) wrapper all three serving programs
+        (decode / verify / prefill) share: donate the cache, and under
+        TP unstack the ``[n, ...]`` cache/param stacks around the local
+        program so the psum hooks see per-shard leaves.
+
+        ``inner(cache, variables, *rest) -> (cache, out)``;
+        ``n_plain_args`` counts ``rest`` (replicated under TP)."""
+        import jax
+
+        if self._mesh is None:
+            return jax.jit(inner, donate_argnums=(0,))
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def local(cache_st, vars_st, *rest):
+            cache = jax.tree.map(lambda a: a[0], cache_st)
+            variables = jax.tree.map(lambda a: a[0], vars_st)
+            cache2, out = inner(cache, variables, *rest)
+            return jax.tree.map(lambda a: a[None], cache2), out
+
+        return jax.jit(
+            shard_map(
+                local, mesh=self._mesh,
+                in_specs=(P("model"), P("model")) + (P(),) * n_plain_args,
+                out_specs=(P("model"), P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def _pool_exhausted_error(self) -> RuntimeError:
+        return RuntimeError(
+            "paged KV pool exhausted mid-stream: "
+            f"{self._alloc.blocks_in_use}/"
+            f"{self._alloc.num_blocks - 1} blocks in use — size "
+            "num_blocks for the resident-token worst case or admit "
+            "fewer concurrent requests"
+        )
+
     def _split_key(self):
         import jax
 
@@ -388,8 +505,6 @@ class ServingEngine:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def _build_decode_step(self):
-        import jax
-
         model = self._decode_model
 
         def inner(cache, variables, tokens, positions, tables, key):
@@ -400,34 +515,38 @@ class ServingEngine:
             )
             return mutated["cache"], self._sample(logits[:, 0], key)
 
-        if self._mesh is None:
-            return jax.jit(inner, donate_argnums=(0,))
+        return self._tp_jit(inner, 4)
 
-        from jax import shard_map
-        from jax.sharding import PartitionSpec as P
+    def _build_verify_step(self):
+        """The speculative verify program: ONE forward scores
+        ``[slots, K+1]`` positions — the pending last token plus K
+        drafts per row, written/attended at per-row position spans
+        (``_slot_decode_attend`` with ``T = K+1``) — and returns the
+        model's greedy token at every position. Acceptance, rollback,
+        and padding are HOST decisions (:meth:`verify_step`): the
+        compiled program is one fixed shape across request churn and
+        any acceptance outcome, and under TP it carries exactly the
+        same 2 all-reduces per layer as the one-token step (the
+        amortization the suite pins by HLO count)."""
+        import jax.numpy as jnp
 
-        def local(cache_st, vars_st, tokens, positions, tables, key):
-            cache = jax.tree.map(lambda a: a[0], cache_st)
-            variables = jax.tree.map(lambda a: a[0], vars_st)
-            cache2, nxt = inner(cache, variables, tokens, positions,
-                                tables, key)
-            return jax.tree.map(lambda a: a[None], cache2), nxt
+        model = self._decode_model
 
-        return jax.jit(
-            shard_map(
-                local, mesh=self._mesh,
-                in_specs=(P("model"), P("model"), P(), P(), P(), P()),
-                out_specs=(P("model"), P()),
-                check_vma=False,
-            ),
-            donate_argnums=(0,),
-        )
+        def inner(cache, variables, tokens, positions, tables):
+            logits, mutated = model.apply(
+                {**variables, "cache": cache}, tokens,  # [slots, K+1]
+                train=False, decode=True, decode_positions=positions,
+                block_tables=tables, mutable=["cache"],
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return mutated["cache"], greedy  # [slots, K+1]
+
+        return self._tp_jit(inner, 3)
 
     def _prefill_fn(self, bucket: int):
         """The (cached) prefill program for one bucket length."""
         if bucket in self._prefill_jits:
             return self._prefill_jits[bucket]
-        import jax
         import jax.numpy as jnp
 
         model = self._decode_model
@@ -443,30 +562,7 @@ class ServingEngine:
             last = jnp.take(logits[0], true_len - 1, axis=0)  # [V]
             return mutated["cache"], self._sample(last[None], key)[0]
 
-        if self._mesh is None:
-            fn = jax.jit(inner, donate_argnums=(0,))
-        else:
-            from jax import shard_map
-            from jax.sharding import PartitionSpec as P
-
-            def local(cache_st, vars_st, tokens, true_len, slot, table_row,
-                      key):
-                cache = jax.tree.map(lambda a: a[0], cache_st)
-                variables = jax.tree.map(lambda a: a[0], vars_st)
-                cache2, tok = inner(cache, variables, tokens, true_len,
-                                    slot, table_row, key)
-                return jax.tree.map(lambda a: a[None], cache2), tok
-
-            fn = jax.jit(
-                shard_map(
-                    local, mesh=self._mesh,
-                    in_specs=(P("model"), P("model"), P(), P(), P(), P(),
-                              P()),
-                    out_specs=(P("model"), P()),
-                    check_vma=False,
-                ),
-                donate_argnums=(0,),
-            )
+        fn = self._tp_jit(inner, 5)
         self._prefill_jits[bucket] = fn
         return fn
 
@@ -491,6 +587,15 @@ class ServingEngine:
         """Compilations of the steady-state step (the no-recompile pin:
         must stay 1 across any join/leave churn)."""
         size = getattr(self._decode_step_jit, "_cache_size", None)
+        return int(size()) if size else None
+
+    def verify_compile_count(self) -> Optional[int]:
+        """Compilations of the speculative verify step (same pin as the
+        plain step: must stay 1 across churn AND acceptance variation).
+        None when speculation is off or the runtime hides the cache."""
+        if self._verify_step_jit is None:
+            return None
+        size = getattr(self._verify_step_jit, "_cache_size", None)
         return int(size()) if size else None
 
     def prefill_compile_count(self) -> Optional[int]:
@@ -546,6 +651,7 @@ class ServingEngine:
         self._positions[slot] = P_len
         self._last_tok[slot] = tok
         self._active[slot] = True
+        self._history[slot] = [int(t) for t in prompt] + [tok]
         return slot, tok, bucket
 
     def decode_step(self):
@@ -566,13 +672,7 @@ class ServingEngine:
             if self._alloc is not None and not self._alloc.ensure(
                 int(s), p + 1
             ):
-                raise RuntimeError(
-                    "paged KV pool exhausted mid-stream: "
-                    f"{self._alloc.blocks_in_use}/"
-                    f"{self._alloc.num_blocks - 1} blocks in use — size "
-                    "num_blocks for the resident-token worst case or "
-                    "admit fewer concurrent requests"
-                )
+                raise self._pool_exhausted_error()
         t0 = time.perf_counter()
         self._cache, toks = self._decode_step_jit(
             self._cache, self._vars,
@@ -585,7 +685,128 @@ class ServingEngine:
         dur = time.perf_counter() - t0
         self._last_tok[active] = toks[active]
         self._positions[active] += 1
+        for s in active:
+            self._history[int(s)].append(int(toks[s]))
         return toks, dur
+
+    def verify_step(self):
+        """One speculative tick over ALL slots: draft up to K tokens per
+        active slot from its own history, score every draft in ONE
+        jitted verify forward, and commit the longest greedy-matching
+        prefix plus the model's own next token.
+
+        Returns ``(committed, dur_s, stats)``: ``committed[slot]`` is
+        the list of 1..K+1 tokens slot ``slot`` advanced by this tick
+        (every one of them an argmax the verify forward produced, so the
+        stream is bit-identical to the plain path); ``stats`` carries
+        ``drafted``/``accepted`` token counts and the per-slot
+        ``accept_lens`` — the scheduler's ``speculate`` trace event.
+
+        Rollback is HOST metadata only: rejected drafts leave their
+        (stale) cache writes in place — positions are explicit, so the
+        next tick's span ``[new_pos, new_pos+K]`` re-writes every stale
+        row before any causal mask can admit it, and the jit cache stays
+        pinned at one entry across churn and acceptance variation.
+        Near the horizon (or when an oversubscribed paged pool cannot
+        cover the whole span) acceptance is CAPPED, which costs
+        throughput, never correctness.
+        """
+        import jax.numpy as jnp
+
+        if self.spec_tokens <= 0:
+            raise RuntimeError("verify_step needs spec_tokens > 0 — use "
+                               "decode_step for the plain path")
+        K = self.spec_tokens
+        active = [int(s) for s in np.flatnonzero(self._active)]
+        # Speculative block reservations are per-tick LEASES, not
+        # commitments (review regression): an extension to p+K+1 holds
+        # blocks for draft positions that may never be committed, and
+        # letting those reservations accumulate across ticks — or
+        # letting an earlier slot's optional extension grab the pool's
+        # last blocks — would starve another slot of the plain-decode
+        # minimum it needs just to make progress, turning a pool that
+        # spec_tokens=0 serves fine into a crash. Three ordered passes
+        # pin the degrade contract (caps cost throughput, never an
+        # error plain decode would not raise):
+        #   1. trim every active slot back to its committed frontier
+        #      (p+1), returning earlier ticks' unused extensions;
+        #   2. guarantee every slot the plain minimum — only genuine
+        #      exhaustion (plain decode would also fail) raises;
+        #   3. extend to the K-span where the remainder allows; a
+        #      refused extension degrades that slot's room — drafted
+        #      writes beyond the covered span land in the scratch block
+        #      (unallocated table entries) and the acceptance cap keeps
+        #      every COMMITTED token inside real blocks.
+        if self._alloc is not None:
+            for s in active:
+                self._alloc.trim(s, int(self._positions[s]) + 1)
+        for s in active:
+            p = int(self._positions[s])
+            if p + 1 > self.max_len:
+                raise RuntimeError(
+                    f"slot {s} ran past the serving horizon "
+                    f"max_len={self.max_len}; bound max_new_tokens"
+                )
+            if self._alloc is not None and not self._alloc.ensure(
+                s, p + 1
+            ):
+                raise self._pool_exhausted_error()
+        room: dict[int, int] = {}
+        for s in active:
+            p = int(self._positions[s])
+            covered = min(p + K + 1, self.max_len)
+            if (self._alloc is not None and covered > p + 1
+                    and not self._alloc.ensure(s, covered)):
+                covered = p + 1
+            room[s] = min(K, covered - p - 1, self.max_len - 1 - p)
+
+        from chainermn_tpu.serving.speculate import accept_length
+
+        drafts = np.zeros((self.num_slots, K), np.int64)
+        prop_len: dict[int, int] = {}
+        n_drafted = 0
+        for s in active:
+            # ask only for what could be accepted (room): near the
+            # horizon a full-K proposal would be wasted drafter work
+            # (K jitted forwards for a ModelDrafter) and would deflate
+            # the accept-rate evidence the tuning cache stores.
+            prop = list(
+                self._drafter.propose(self._history[s], room[s])
+            )[:room[s]]
+            prop_len[s] = len(prop)
+            n_drafted += len(prop)
+            drafts[s, :len(prop)] = prop
+        tokens = np.concatenate([self._last_tok[:, None], drafts], axis=1)
+
+        t0 = time.perf_counter()
+        self._cache, greedy = self._verify_step_jit(
+            self._cache, self._vars, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(self._positions, jnp.int32),
+            self._tables_device(),
+        )
+        greedy = np.asarray(greedy)  # device sync: honest tick latency
+        dur = time.perf_counter() - t0
+
+        committed: dict[int, list[int]] = {}
+        accept_lens: list[int] = []
+        n_accepted = 0
+        for s in active:
+            # acceptance never extends past the drafter's TRUE proposal
+            # (a zero-padded verify column that happens to match argmax
+            # would be a correct token, but crediting it as "accepted
+            # speculation" would corrupt the tuning signal).
+            a = accept_length(drafts[s], greedy[s],
+                              min(room[s], prop_len[s]))
+            toks = [int(t) for t in greedy[s, :a + 1]]
+            committed[s] = toks
+            accept_lens.append(a)
+            n_accepted += a
+            self._history[s].extend(toks)
+            self._last_tok[s] = toks[-1]
+            self._positions[s] += a + 1
+        stats = {"drafted": n_drafted, "accepted": n_accepted,
+                 "accept_lens": accept_lens}
+        return committed, dur, stats
 
     def leave(self, slot: int) -> None:
         """Release a slot (host metadata + paged blocks only — the
@@ -595,5 +816,6 @@ class ServingEngine:
             raise ValueError(f"slot {slot} is not active")
         self._active[slot] = False
         self._free.append(int(slot))
+        self._history[int(slot)] = []
         if self._alloc is not None:
             self._alloc.release(int(slot))

@@ -111,6 +111,27 @@ class BlockAllocator:
             owned.append(blk)
         return True
 
+    def trim(self, slot: int, n_positions: int) -> None:
+        """Shrink ``slot``'s table to cover no more than positions
+        ``[0, n_positions)`` — :meth:`ensure`'s inverse for the tail.
+        Freed blocks return to the pool and their table entries point
+        back at scratch, so any stale writes they hold become
+        unreachable (the :meth:`release` guarantee, per block). The
+        engine uses this to make speculative span reservations per-tick
+        LEASES: trimming to the committed frontier each tick returns an
+        earlier tick's unused extension before it can starve another
+        slot. Trimming below the committed history would lose data —
+        callers trim to the frontier, never below."""
+        owned = self._owned[slot]
+        keep = self.blocks_for(n_positions)
+        if keep >= len(owned):
+            return
+        self.version += 1
+        while len(owned) > keep:
+            blk = owned.pop()
+            self.tables[slot, len(owned)] = self.SCRATCH
+            self._free.append(blk)
+
     def release(self, slot: int) -> None:
         """Return ``slot``'s blocks to the pool and point its table back
         at scratch (stale in-flight writes become harmless)."""

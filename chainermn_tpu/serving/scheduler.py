@@ -12,11 +12,19 @@ Every phase emits a schema-versioned ``serving`` trace event (the wire
 section from exactly these):
 
 - ``phase='queue_wait'`` — request, ``dur_s`` from submit to admission;
-- ``phase='prefill'`` — request, slot, bucket, prompt_len, ``dur_s``;
+- ``phase='prefill'`` — request, slot, bucket, prompt_len, ``dur_s``,
+  ``ttft_s`` (submit → first token: the TTFT sample — the prefill
+  samples the request's first token);
 - ``phase='decode_step'`` — ``n_active``/``n_slots`` (occupancy),
-  ``tokens`` produced, ``dur_s`` (the per-token latency sample: each
-  active request got exactly one token);
+  ``tokens`` produced, ``dur_s`` (the per-token latency sample under
+  plain decode: each active request got exactly one token; under
+  speculation it is the TICK latency for 1..K+1 tokens per request);
 - ``phase='finish'`` — request, generated count, ``dur_s`` from submit.
+
+Speculative ticks (``engine.spec_tokens > 0``) additionally emit one
+``speculate`` event per tick — ``drafted``/``accepted`` token counts
+and the per-slot ``accept_lens`` — the accounting behind the
+acceptance-rate rollup and trace_report's accept-length histogram.
 
 :meth:`Scheduler.summary` rolls the same numbers up locally (tokens/s,
 p50/p99 per-token latency, mean occupancy) so callers without a trace
@@ -88,16 +96,16 @@ class Scheduler:
 
     # ------------------------------------------------------------------
 
-    def _event(self, **fields) -> None:
+    def _event(self, _kind: str = "serving", **fields) -> None:
         from chainermn_tpu.observability import trace
 
         if len(self._events) < trace.MAX_BUFFERED_EVENTS:
-            self._events.append({"kind": "serving", **fields})
+            self._events.append({"kind": _kind, **fields})
         else:
             self.events_dropped += 1
         rec = trace.active()
         if rec is not None:
-            rec.event("serving", **fields)
+            rec.event(_kind, **fields)
 
     def submit(self, request: Request) -> str:
         """Enqueue; returns the request id (assigned when absent).
@@ -175,9 +183,14 @@ class Scheduler:
         now = time.perf_counter()
         self._event(phase="queue_wait", request=req.request_id,
                     dur_s=round(t0 - req._arrival, 9))
+        # ttft_s: submit -> first token. The prefill samples the
+        # request's first token, so TTFT = queue wait + prefill — kept
+        # as its own field (not derived downstream) because the two
+        # phase events may be split across truncated traces.
         self._event(phase="prefill", request=req.request_id, slot=slot,
                     bucket=bucket, prompt_len=len(req.prompt),
-                    dur_s=round(now - t0, 9))
+                    dur_s=round(now - t0, 9),
+                    ttft_s=round(now - req._arrival, 9))
         fl = _InFlight(req, slot, list(req.prompt) + [tok], 1)
         self._inflight[slot] = fl
         if fl.generated >= req.max_new_tokens or (
@@ -187,7 +200,17 @@ class Scheduler:
         return True
 
     def step(self) -> None:
-        """One decode round: every in-flight request advances one token."""
+        """One decode round. Plain engines advance every in-flight
+        request by one token; speculative engines
+        (``engine.spec_tokens > 0``) advance each by its accepted span
+        (1..K+1 tokens — same stream, fewer rounds). Tokens past a
+        request's ``max_new_tokens`` or EOS are truncated here (the
+        engine may legitimately overshoot: its committed span is a
+        property of acceptance, not of any one request's remaining
+        budget)."""
+        if getattr(self.engine, "spec_tokens", 0) > 0:
+            self._spec_step()
+            return
         toks, dur = self.engine.decode_step()
         n_active = len(self._inflight)
         self._event(phase="decode_step", n_active=n_active,
@@ -201,6 +224,45 @@ class Scheduler:
             if fl.generated >= req.max_new_tokens or (
                 req.eos_id is not None and tok == req.eos_id
             ):
+                self._finish(fl)
+
+    def _spec_step(self) -> None:
+        """One draft→verify→accept tick (see ``ServingEngine
+        .verify_step``); emits the same ``decode_step`` event (with the
+        REAL multi-token count) plus one ``speculate`` event."""
+        committed, dur, stats = self.engine.verify_step()
+        n_active = len(self._inflight)
+        # Per-request take (truncated at the request's remaining budget
+        # / EOS) computed ONCE — the decode_step event's token count and
+        # the committed streams come from the same pass, so they cannot
+        # diverge. `done` records whether the LAST taken token finished
+        # the request (the same predicate that cut the take).
+        takes: dict[int, tuple[list[int], bool]] = {}
+        for slot, fl in self._inflight.items():
+            req = fl.request
+            take: list[int] = []
+            done = False
+            for tok in committed.get(slot, ()):
+                take.append(int(tok))
+                done = fl.generated + len(take) >= req.max_new_tokens or (
+                    req.eos_id is not None and int(tok) == req.eos_id
+                )
+                if done:
+                    break
+            takes[slot] = (take, done)
+        self._event(phase="decode_step", n_active=n_active,
+                    n_slots=self.engine.num_slots,
+                    tokens=sum(len(t) for t, _ in takes.values()),
+                    dur_s=round(dur, 9))
+        self._event("speculate", drafted=stats["drafted"],
+                    accepted=stats["accepted"],
+                    accept_lens=list(stats["accept_lens"]),
+                    dur_s=round(dur, 9))
+        for slot, fl in list(self._inflight.items()):
+            take, done = takes[slot]
+            fl.stream.extend(take)
+            fl.generated += len(take)
+            if done:
                 self._finish(fl)
 
     def run(self, max_steps: int = 100_000) -> dict:

@@ -1,0 +1,180 @@
+"""Speculative draft-and-verify decoding: the drafter side (ISSUE 5
+tentpole).
+
+PR 4's engine decodes one token per jitted step per slot, so its
+steady-state throughput is bounded by per-step latency — and under
+tensor-parallel decode every step pays 2 tiny all-reduces per layer,
+exactly the small-collective latency regime the related transport work
+targets (HiCCL, arXiv:2408.05962; The Big Send-off, arXiv:2504.18658).
+Speculation attacks the same cost from the SCHEDULE side: draft K cheap
+token guesses per slot, score all of them in ONE jitted verify forward
+(``[slots, K+1]`` positions through
+``TransformerBlock._slot_decode_attend``'s per-row spans), and keep the
+longest prefix that matches the model's own greedy choices — the
+launch overhead and the per-token collectives amortize by the accepted
+length, and the output stream is bit-identical to sequential greedy
+decode by construction (every emitted token IS an argmax the verify
+forward produced).
+
+Drafter contract (the engine's ``drafter=`` argument): an object with
+
+    propose(history, k) -> sequence of at most k draft token ids
+
+where ``history`` is the slot's committed stream so far (prompt +
+generated, including the pending last token). Proposals are HINTS, not
+promises: a wrong draft costs one wasted verify column, never a wrong
+token — greedy acceptance filters everything through the model's own
+argmax (docs/serving.md "Speculative decoding"). Returning fewer than
+``k`` (or nothing) is fine; the engine pads the verify batch and caps
+acceptance at the true proposal length.
+
+Two dependency-free drafters ship here:
+
+- :class:`NgramDrafter` — prompt-lookup speculation over the request's
+  OWN token history (the assisted-generation idea of arXiv:2304.04487
+  /  HF ``prompt_lookup_num_tokens``, reduced to its no-second-model
+  core): propose the continuation of the most recent earlier occurrence
+  of the stream's tail n-gram. Zero state, zero FLOPs, surprisingly
+  strong on the repetitive tails LMs actually emit.
+- :class:`ModelDrafter` — the optional small-draft-model path reusing
+  :class:`~chainermn_tpu.models.transformer.TransformerLM`: greedy
+  continuations from a cheaper model, forwarded over the bucketed
+  history (compiles bounded by the bucket ladder, the prefill
+  discipline). Pay draft FLOPs only when a cheap model that imitates
+  the target well is actually available.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from chainermn_tpu.datasets.bucketing import DEFAULT_BUCKETS, bucket_length
+
+
+class NgramDrafter:
+    """Prompt-lookup drafter: match the stream's tail n-gram against its
+    own earlier history and propose what followed the MOST RECENT match.
+
+    Longer n-grams are tried first (``max_ngram`` down to 1) — a longer
+    match is more specific, so its continuation is a better guess; the
+    most recent occurrence wins because generation drifts (the tokens
+    right before the tail describe the current context best). The scan
+    only looks back ``max_scan`` tokens: proposing is on the per-slot
+    per-tick hot path, and an unbounded backward scan would grow each
+    tick linearly with the stream — a long-lived slot's miss (the
+    common case for a 1-gram tail that never repeats) must stay O(1)
+    -ish, and matches beyond the window are too far from the current
+    context to draft well anyway.
+    """
+
+    def __init__(self, max_ngram: int = 3, max_scan: int = 512) -> None:
+        if max_ngram < 1:
+            raise ValueError(f"max_ngram must be >= 1, got {max_ngram}")
+        if max_scan < 2:
+            raise ValueError(f"max_scan must be >= 2, got {max_scan}")
+        self.max_ngram = int(max_ngram)
+        self.max_scan = int(max_scan)
+
+    def propose(self, history: Sequence[int], k: int) -> list:
+        h = list(history)[-self.max_scan:]
+        L = len(h)
+        if k < 1 or L < 2:
+            return []
+        for n in range(min(self.max_ngram, L - 1), 0, -1):
+            tail = h[L - n:]
+            # scan for the most recent occurrence strictly before the tail
+            for i in range(L - n - 1, -1, -1):
+                if h[i:i + n] == tail:
+                    return h[i + n:i + n + k]
+        return []
+
+
+class ModelDrafter:
+    """Draft with a (smaller) ``TransformerLM``: greedy continuations of
+    the slot's history, one forward per drafted token.
+
+    The forward runs the plain (non-decode) causal path over the history
+    right-padded to the bucket ladder — causal attention makes trailing
+    pads invisible to the true last position, so one compiled program
+    per bucket covers every history length (the prefill discipline;
+    drafting never touches the TARGET model's jit cache). No KV cache is
+    kept: the drafter re-reads its whole context per token, which is the
+    deliberate trade — zero per-slot draft state to roll back, at draft
+    FLOPs that only pay off when the draft model is much cheaper than
+    the target.
+    """
+
+    def __init__(self, model, params, *,
+                 prefill_buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 pad_id: int = 0) -> None:
+        from chainermn_tpu.models.transformer import TransformerLM
+
+        if not isinstance(model, TransformerLM):
+            raise TypeError(
+                f"ModelDrafter drafts with a TransformerLM, got "
+                f"{type(model).__name__}"
+            )
+        if model.return_hidden or not model.causal:
+            raise ValueError("drafting needs a causal LM with logits "
+                             "(return_hidden=False, causal=True)")
+        self.model = model
+        self.params = params
+        self.pad_id = int(pad_id)
+        self._buckets = tuple(
+            b for b in sorted(set(prefill_buckets)) if b <= model.max_len
+        ) or (model.max_len,)
+        if self._buckets[-1] < model.max_len:
+            self._buckets = self._buckets + (model.max_len,)
+        self._jits: dict = {}
+
+    def _fwd(self, bucket: int):
+        if bucket in self._jits:
+            return self._jits[bucket]
+        import jax
+        import jax.numpy as jnp
+
+        model, params = self.model, self.params
+
+        def fn(tokens, true_len):
+            logits = model.apply(params, tokens, train=False)
+            last = jnp.take(logits[0], true_len - 1, axis=0)  # [V]
+            return jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+        self._jits[bucket] = jax.jit(fn)
+        return self._jits[bucket]
+
+    def propose(self, history: Sequence[int], k: int) -> list:
+        import jax.numpy as jnp
+        import numpy as np
+
+        toks = list(history)
+        out: list = []
+        for _ in range(max(0, k)):
+            if len(toks) >= self.model.max_len:
+                break  # the draft model's own context is exhausted
+            bucket = bucket_length(len(toks), self._buckets)
+            padded = np.full((1, bucket), self.pad_id, np.int32)
+            padded[0, :len(toks)] = toks
+            nxt = int(self._fwd(bucket)(
+                jnp.asarray(padded), jnp.int32(len(toks))
+            ))
+            out.append(nxt)
+            toks.append(nxt)
+        return out
+
+
+def accept_length(drafts: Sequence[int], greedy: Sequence[int],
+                  room: Optional[int] = None) -> int:
+    """Longest accepted draft prefix: ``drafts[t]`` is accepted while it
+    equals ``greedy[t]`` — the model's own argmax at the same position —
+    so the committed stream is exactly the greedy stream regardless of
+    what was drafted. ``room`` additionally caps acceptance (horizon or
+    paged-coverage limits); the cap costs throughput, never
+    correctness."""
+    limit = min(len(drafts), len(greedy))
+    if room is not None:
+        limit = min(limit, max(0, int(room)))
+    a = 0
+    while a < limit and int(drafts[a]) == int(greedy[a]):
+        a += 1
+    return a

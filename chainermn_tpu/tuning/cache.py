@@ -244,11 +244,13 @@ def _seed_one_result(result: dict, source: str, out: list,
                                    for k, v in sched_ms.items()},
                  "spread_pct": spread})
 
-    # Serving decode decisions (ISSUE 4): bench's ``serving`` phase
+    # Serving decode decisions (ISSUE 4/5): bench's ``serving`` phase
     # records per-candidate step medians keyed by the engine's own
-    # decision key material (``serving_model_shape`` D..xH..xL..). Both
-    # adoptions are spread-gated through measure.decide, same as the
-    # overlap schedule rows above.
+    # decision key material (``serving_model_shape`` D..xH..xL..) —
+    # decode impl, paged block size, and the speculative length K
+    # (``serving_spec_ms``: ms per GENERATED token per K, so the
+    # acceptance rate is priced in). All adoptions are spread-gated
+    # through measure.decide, same as the overlap schedule rows above.
     m = _SERVING_SHAPE.search(result.get("serving_model_shape", ""))
     if m:
         from chainermn_tpu.tuning.measure import decide
@@ -258,6 +260,8 @@ def _seed_one_result(result: dict, source: str, out: list,
              "decode_impl"),
             ("serving_kv_block_ms", "serving_kv_block_spread_pct",
              "kv_block_size"),
+            ("serving_spec_ms", "serving_spec_spread_pct",
+             "spec_tokens"),
         ):
             rows = result.get(row_key)
             if not (isinstance(rows, dict) and len(rows) >= 2 and all(
@@ -278,10 +282,17 @@ def _seed_one_result(result: dict, source: str, out: list,
             winner = decide(rows, {k: spread for k in rows})
             if winner is not None:
                 key = _bucketed_key(kind, m.groups(), "decode")
-                put(name, key, winner,
-                    {"candidates_ms": {k: round(float(v), 4)
-                                       for k, v in rows.items()},
-                     "spread_pct": spread})
+                evidence = {"candidates_ms": {k: round(float(v), 4)
+                                              for k, v in rows.items()},
+                            "spread_pct": spread}
+                if name == "spec_tokens":
+                    # acceptance rate rides as evidence: a cache entry
+                    # the next session can audit for WHY K won (high
+                    # accept rate) or lost (drafts were junk).
+                    rates = result.get("serving_spec_accept_rates")
+                    if isinstance(rates, dict):
+                        evidence["accept_rates"] = rates
+                put(name, key, winner, evidence)
 
     # Double buffering: the measured on/off step-time ratio.
     speedup = result.get("double_buffer_speedup")

@@ -65,6 +65,13 @@ from chainermn_tpu.tuning import measure as _measure
 #:   rows per slot; the proxy's 16-vs-64 sweep was SPREAD-DOMINATED
 #:   (29% noise), so the table default stands until a decisive
 #:   ``serving_kv_block_ms`` capture seeds a winner.
+#: - ``spec_tokens`` (speculative decode length K): ``0`` (off) — the
+#:   payoff is acceptance-dependent (draft hit rate is a property of
+#:   the WORKLOAD, not the device), and a K that drafts junk pays K
+#:   wasted verify columns plus draft overhead per tick, so speculation
+#:   must EARN adoption through a bench ``serving`` capture
+#:   (``serving_spec_ms`` rows + acceptance rate) before 'auto' turns
+#:   it on for a shape.
 DEFAULT_TABLE: dict = {
     "moe_dispatch": {"cpu": "sort", "tpu": "sort", "*": "sort"},
     "attention": {"cpu": "xla", "tpu": "flash", "*": "flash"},
@@ -75,6 +82,7 @@ DEFAULT_TABLE: dict = {
     "reduction_schedule": {"*": "flat"},
     "decode_impl": {"*": "paged"},
     "kv_block_size": {"*": "64"},
+    "spec_tokens": {"*": "0"},
 }
 
 _MODE_ENV = "CHAINERMN_TPU_AUTOTUNE"

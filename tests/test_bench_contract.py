@@ -242,6 +242,60 @@ def test_serving_rows_contract_and_seeding(tmp_path):
         seed_from_bench_details(str(details), str(cache)))
 
 
+def test_spec_tokens_rows_contract_and_seeding(tmp_path):
+    """ISSUE 5 satellite: the speculative rows ride the compact line
+    (selected K, spec-vs-plain speedup, acceptance rate) and ``tuning
+    seed`` learns ``spec_tokens`` from ``serving_spec_ms`` (ms per
+    GENERATED token: acceptance is priced in) under the same spread
+    gate and key material as the other serving decisions — with the
+    per-K acceptance rates carried as auditable evidence."""
+    for k in ("serving_spec_selected", "serving_spec_speedup",
+              "serving_spec_accept_rate"):
+        assert k in bench._COMPACT_KEYS, k
+
+    from chainermn_tpu.tuning.cache import (
+        load_cache,
+        seed_from_bench_details,
+    )
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-03T00:00:00Z",
+        "serving_model_shape": "D512xH8xL512",
+        "serving_spec_ms": {"0": 2.0, "2": 1.4, "4": 1.0, "8": 1.1},
+        "serving_spec_spread_pct": 6.0,
+        "serving_spec_accept_rates": {"2": 0.8, "4": 0.7, "8": 0.4},
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert "spec_tokens|TPU v5 lite|512x8x512|decode -> 4" in seeded
+    entry = load_cache(str(cache))["decisions"][
+        "spec_tokens|TPU v5 lite|512x8x512|decode"]
+    assert entry["accept_rates"] == {"2": 0.8, "4": 0.7, "8": 0.4}
+    assert entry["candidates_ms"]["4"] == 1.0
+
+    # spread-dominated spec rows are refused (noise-band "winner")
+    doc["serving_spec_ms"] = {"0": 1.0, "2": 0.98, "4": 0.99, "8": 1.01}
+    doc["serving_spec_spread_pct"] = 12.0
+    details.write_text(json.dumps(doc))
+    cache2 = tmp_path / "cache2.json"
+    assert "spec_tokens" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+    # ABSENT spread = on-accel single sample: the 10% floor applies
+    doc.pop("serving_spec_spread_pct")
+    doc["serving_spec_ms"] = {"0": 1.0, "4": 0.95}
+    details.write_text(json.dumps(doc))
+    assert "spec_tokens" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+    doc["serving_spec_ms"] = {"0": 2.0, "4": 0.9}
+    details.write_text(json.dumps(doc))
+    assert "spec_tokens|TPU v5 lite|512x8x512|decode -> 4" in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+
 def test_transformer_knob_env_validation(monkeypatch):
     """The accel transformer knobs reject malformed env values with a
     message naming the variable (a bare ZeroDivisionError from

@@ -163,13 +163,14 @@ def _golden_trace_lines():
          "nbytes": 4096, "dur_s": 0.003, "blocked_s": 0.003,
          "overlapped": False},
         # ISSUE 4: one request through the serving scheduler — queue
-        # wait, bucketed prefill (its sampled token counts as generated),
-        # three decode steps at varying occupancy, finish.
+        # wait, bucketed prefill (its sampled token counts as generated;
+        # ttft_s = submit -> first token, ISSUE 5), three decode steps
+        # at varying occupancy, finish.
         {"schema": 1, "kind": "serving", "t": 2.2, "pid": 1, "rank": 0,
          "phase": "queue_wait", "request": "r0", "dur_s": 0.002},
         {"schema": 1, "kind": "serving", "t": 2.3, "pid": 1, "rank": 0,
          "phase": "prefill", "request": "r0", "slot": 0, "prompt_len": 5,
-         "dur_s": 0.01},
+         "dur_s": 0.01, "ttft_s": 0.012},
         {"schema": 1, "kind": "serving", "t": 2.4, "pid": 1, "rank": 0,
          "phase": "decode_step", "n_active": 1, "n_slots": 4, "tokens": 1,
          "dur_s": 0.004},
@@ -182,6 +183,14 @@ def _golden_trace_lines():
         {"schema": 1, "kind": "serving", "t": 2.7, "pid": 1, "rank": 0,
          "phase": "finish", "request": "r0", "generated": 4,
          "dur_s": 0.03},
+        # ISSUE 5: two speculative ticks — per-tick drafted/accepted
+        # counts and per-slot accept lengths (8 drafted, 2 accepted ->
+        # 25% acceptance; histogram counts PER-SLOT accept lengths).
+        {"schema": 1, "kind": "speculate", "t": 2.8, "pid": 1, "rank": 0,
+         "drafted": 4, "accepted": 2, "accept_lens": [2], "dur_s": 0.004},
+        {"schema": 1, "kind": "speculate", "t": 2.9, "pid": 1, "rank": 0,
+         "drafted": 4, "accepted": 0, "accept_lens": [0, 0],
+         "dur_s": 0.006},
     ]
     return [_json.dumps(e) for e in evs] + ['{"torn']
 
@@ -208,7 +217,7 @@ def test_trace_report_contract(tmp_path):
         "schema_versions": [1],
         "meta": {"started_at": "2026-08-03T00:00:00Z", "sync": False,
                  "source": "bench"},
-        "n_events": 18,  # torn tail line skipped, not fatal
+        "n_events": 20,  # torn tail line skipped, not fatal
         "collectives": [
             {"op": "allreduce_grad", "plane": "device", "n": 2,
              "total_bytes": 2000, "total_s": 0.004, "mean_ms": 2.0,
@@ -241,10 +250,11 @@ def test_trace_report_contract(tmp_path):
                          "comm_ms_blocked": 4.0, "comm_ms_hidden": 4.0,
                          "hidden_fraction": 0.5},
         },
-        # ISSUE 4: the serving rollup — tokens/s over device-busy time
+        # ISSUE 4/5: the serving rollup — tokens/s over device-busy time
         # (1 prefill token + 4 step tokens over 10 + 12 ms), nearest-rank
-        # p50/p99 over the three step durations, mean occupancy
-        # (0.25 + 0.5 + 0.25)/3.
+        # p50/p99 over the three step durations, TTFT from the prefill's
+        # ttft_s, mean occupancy (0.25 + 0.5 + 0.25)/3, and the
+        # speculation totals from the two speculate events.
         "serving": {
             "requests": 1,
             "prefills": 1,
@@ -254,13 +264,22 @@ def test_trace_report_contract(tmp_path):
             "prefill_ms_mean": 10.0,
             "token_ms_p50": 4.0,
             "token_ms_p99": 6.0,
+            "ttft_ms_p50": 12.0,
+            "ttft_ms_p99": 12.0,
             "occupancy_mean": 0.3333,
             "tokens_per_sec": 227.27,
+            "speculation": {
+                "ticks": 2,
+                "drafted": 8,
+                "accepted": 2,
+                "accept_rate": 0.25,
+                "accept_len_hist": {"0": 2, "2": 1},
+            },
         },
     }, summary
     # chrome export emitted alongside
     chrome = _json.loads(chrome_file.read_text())
-    assert len(chrome["traceEvents"]) == 17  # meta excluded
+    assert len(chrome["traceEvents"]) == 19  # meta excluded
     # and the human rendering mentions the essentials
     proc2 = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
@@ -271,7 +290,10 @@ def test_trace_report_contract(tmp_path):
     for token in ("allreduce_grad", "STRAGGLER", "allreduce_wire=bf16",
                   "comm/compute overlap", "50.0% hidden",
                   "serving (continuous batching)", "tokens/s: 227.27",
-                  "p50 4.000 ms, p99 6.000 ms", "33.3% mean"):
+                  "p50 4.000 ms, p99 6.000 ms", "33.3% mean",
+                  "TTFT: p50 12.000 ms, p99 12.000 ms",
+                  "speculation: 8 drafted, 2 accepted (25.0% acceptance)",
+                  "accept-length histogram: 0:2 2:1"):
         assert token in proc2.stdout, (token, proc2.stdout)
 
 

@@ -276,6 +276,27 @@ class TestBlockAllocator:
     def test_default_num_blocks_covers_worst_case(self):
         assert default_num_blocks(4, 8, 32) == 4 * 4 + 1
 
+    def test_trim_returns_tail_blocks_and_repoints_scratch(self):
+        """ensure's inverse (the speculative per-tick lease): the tail
+        shrinks back to the pool, trimmed table entries repoint at
+        scratch, kept entries are untouched, and trimming at or above
+        current coverage is a no-op (no version churn)."""
+        a = BlockAllocator(num_blocks=9, block_size=4, num_slots=2,
+                           max_len=16)
+        assert a.ensure(0, 13)  # 4 blocks
+        kept = a.tables[0][:1].copy()
+        v = a.version
+        a.trim(0, 16)  # above coverage: no-op
+        a.trim(0, 13)  # exactly coverage: no-op
+        assert a.version == v and a.blocks_in_use == 4
+        a.trim(0, 3)  # back to 1 block
+        assert a.blocks_in_use == 1
+        assert a.version > v
+        assert (a.tables[0][:1] == kept).all()
+        assert (a.tables[0][1:] == 0).all()
+        # freed blocks are immediately reusable by another slot
+        assert a.ensure(1, 16)
+
 
 class TestSchedulerAndAccounting:
     def test_oversubscribed_pool_defers_admission(self, lm):
@@ -386,6 +407,11 @@ class TestSchedulerAndAccounting:
         assert roll["tokens_per_sec"] is not None
         assert roll["token_ms_p50"] is not None
         assert roll["token_ms_p99"] >= roll["token_ms_p50"]
+        # TTFT (ISSUE 5 satellite): submit -> first token percentiles
+        # ride the same rollup; queue wait + prefill bound it below.
+        assert roll["ttft_ms_p50"] is not None
+        assert roll["ttft_ms_p99"] >= roll["ttft_ms_p50"] >= 0.0
+        assert "speculation" not in roll  # plain engine: no spec keys
         # no serving events -> section omitted, not empty
         assert obs_trace.summarize_serving(
             [e for e in events if e.get("kind") != "serving"]

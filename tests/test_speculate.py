@@ -1,0 +1,495 @@
+"""Speculative draft-and-verify serving invariants (ISSUE 5).
+
+The acceptance pins, asserted structurally:
+
+- **Stream equivalence** — greedy speculative token streams are
+  bit-identical to sequential ``generate`` across dense == paged ==
+  tensor-parallel == single-device, for rope/learned positions, GQA and
+  windowed variants, under forced staggered slot churn AND forced-low
+  acceptance (an adversarial drafter whose every proposal is wrong):
+  speculation is a throughput lever, never a sampling change.
+- **One compiled verify program** — the verify-step jit cache stays at
+  ONE entry across request churn and acceptance variation, and the
+  compiled TP verify step carries exactly 2 all-reduces per layer
+  regardless of K (collectives amortized, not multiplied) — HLO
+  -counted.
+- **Host-only rollback** — rejected drafts rewind positions/tables on
+  the host; the paged pool redirects beyond-horizon span writes to
+  scratch, and a slot leave → same-slot rejoin with a shorter prompt
+  never reads a stale block (position-rewind guarantee).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from chainermn_tpu.models.transformer import TransformerLM, generate
+from chainermn_tpu.serving import (
+    ModelDrafter,
+    NgramDrafter,
+    Request,
+    Scheduler,
+    ServingEngine,
+    accept_length,
+)
+
+VOCAB = 32
+
+
+def tiny_lm(**kw):
+    cfg = dict(vocab_size=VOCAB, num_layers=2, num_heads=4, d_model=16,
+               d_ff=32, max_len=32, compute_dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    return model, params
+
+
+def _requests(n, seed=0, max_prompt=7, max_new=6):
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        p_len = int(rs.randint(1, max_prompt))
+        out.append((rs.randint(1, VOCAB, size=p_len).tolist(),
+                    int(rs.randint(1, max_new))))
+    return out
+
+
+def _generate_ref(model, params, prompt, n_new):
+    return np.asarray(generate(
+        model, params, jnp.asarray([prompt], jnp.int32),
+        len(prompt) + n_new,
+    ))[0].tolist()
+
+
+def _run_stream(engine, reqs, policy="fcfs"):
+    sched = Scheduler(engine, policy=policy)
+    ids = [sched.submit(Request(prompt=p, max_new_tokens=g))
+           for p, g in reqs]
+    results = sched.run()
+    return [results[rid]["tokens"] for rid in ids], sched
+
+
+class _AdversarialDrafter:
+    """Forced-low acceptance: knows each request's true greedy
+    continuation (precomputed reference streams) and proposes the WRONG
+    token at every position — acceptance must be exactly zero and the
+    output stream must still be exactly the greedy stream."""
+
+    def __init__(self, ref_streams):
+        self.refs = [list(r) for r in ref_streams]
+
+    def propose(self, history, k):
+        h = list(history)
+        for ref in self.refs:
+            if ref[:len(h)] == h and len(ref) > len(h):
+                nxt = ref[len(h):len(h) + k]
+                return [(int(t) + 1) % VOCAB for t in nxt]
+        return [0] * k
+
+
+class TestDrafters:
+    def test_ngram_proposes_continuation_of_most_recent_match(self):
+        d = NgramDrafter(max_ngram=3)
+        h = [1, 2, 3, 4, 1, 2, 3]
+        assert d.propose(h, 3) == [4, 1, 2]
+        assert d.propose(h, 2) == [4, 1]
+        # the MOST RECENT earlier match wins, not the first
+        h2 = [1, 2, 9, 1, 2, 7, 1, 2]
+        assert NgramDrafter(max_ngram=2).propose(h2, 2) == [7, 1]
+
+    def test_ngram_no_match_and_degenerate_inputs(self):
+        d = NgramDrafter(max_ngram=3)
+        assert d.propose([1, 2, 3, 4, 5], 4) == []
+        assert d.propose([7], 4) == []
+        assert d.propose([1, 2, 1], 0) == []
+        with pytest.raises(ValueError, match="max_ngram"):
+            NgramDrafter(max_ngram=0)
+        with pytest.raises(ValueError, match="max_scan"):
+            NgramDrafter(max_scan=1)
+
+    def test_ngram_scan_window_bounds_the_lookback(self):
+        """The hot-path scan must not grow with stream length: a match
+        that lies entirely outside the max_scan window is invisible,
+        while the same match inside the window is found."""
+        match = [1, 2, 3, 4]
+        h = match + [9] * 16 + [1, 2, 3]
+        assert NgramDrafter(max_ngram=3, max_scan=8).propose(h, 1) == []
+        assert NgramDrafter(max_ngram=3, max_scan=64).propose(h, 1) == [4]
+
+    def test_model_drafter_matches_greedy_continuation(self, lm):
+        model, params = lm
+        drafter = ModelDrafter(model, params, prefill_buckets=(4, 8, 16))
+        h = [3, 1, 4, 1, 5]
+        ref = _generate_ref(model, params, h, 4)
+        assert drafter.propose(h, 4) == ref[len(h):]
+        # bucketed forwards: one compile per bucket, not per length
+        h2 = [2, 7, 1]
+        ref2 = _generate_ref(model, params, h2, 2)
+        assert drafter.propose(h2, 2) == ref2[len(h2):]
+
+    def test_model_drafter_validation(self, lm):
+        model, params = lm
+        with pytest.raises(TypeError, match="TransformerLM"):
+            ModelDrafter(object(), params)
+        with pytest.raises(ValueError, match="return_hidden"):
+            ModelDrafter(tiny_lm(return_hidden=True), params)
+
+    def test_accept_length_prefix_and_room_cap(self):
+        assert accept_length([5, 6, 7], [5, 6, 8], None) == 2
+        assert accept_length([5, 6, 7], [5, 6, 7], None) == 3
+        assert accept_length([9], [5, 6], None) == 0
+        assert accept_length([5, 6, 7], [5, 6, 7], 1) == 1
+        assert accept_length([], [5], None) == 0
+
+
+class TestSpecStreamEquivalence:
+    """THE invariant: speculation changes throughput, never tokens."""
+
+    @pytest.mark.parametrize("impl", ["dense", "paged"])
+    @pytest.mark.parametrize("k", [2, 4])
+    def test_staggered_stream_matches_sequential_generate(self, lm, impl,
+                                                          k):
+        model, params = lm
+        # 2 slots x 6 requests: staggered joins/leaves mid-verify.
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl=impl,
+            kv_block_size=8, prefill_buckets=(4, 8, 16), spec_tokens=k,
+        )
+        reqs = _requests(6, seed=0)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        # ONE compiled verify program across all that churn/acceptance
+        assert engine.verify_compile_count() == 1
+
+    def test_rope_gqa_stream_matches(self):
+        model = tiny_lm(pos_encoding="rope", num_kv_heads=2)
+        params = model.init(
+            jax.random.PRNGKey(2), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4, 8), spec_tokens=4,
+        )
+        reqs = _requests(4, seed=3)
+        streams, _ = _run_stream(engine, reqs, policy="prefill_priority")
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_windowed_model_stream_matches(self):
+        model = tiny_lm(window=6)
+        params = tiny_lm().init(
+            jax.random.PRNGKey(4), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="dense",
+            prefill_buckets=(4, 8, 16), spec_tokens=2,
+        )
+        reqs = _requests(3, seed=5, max_prompt=10, max_new=8)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_adversarial_drafter_zero_acceptance_same_stream(self, lm):
+        """Forced-low acceptance: every proposal wrong -> zero accepted
+        drafts, one (bonus) token per tick, and the STREAM is still
+        bit-identical — the degenerate case is plain decode at verify
+        prices, never wrong tokens."""
+        model, params = lm
+        reqs = _requests(4, seed=7)
+        refs = [_generate_ref(model, params, p, g) for p, g in reqs]
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4, 8), spec_tokens=4,
+            drafter=_AdversarialDrafter(refs),
+        )
+        streams, sched = _run_stream(engine, reqs)
+        assert streams == refs
+        sp = sched.summary()["speculation"]
+        assert sp["accepted"] == 0
+        assert sp["drafted"] > 0
+        assert set(sp["accept_len_hist"]) == {"0"}
+
+    def test_repetitive_stream_actually_accepts(self, lm):
+        """The n-gram drafter must WIN on its home turf (repetitive
+        histories) — otherwise every speculation test is vacuous."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4, 8, 16), spec_tokens=4,
+        )
+        reqs = [([5, 6, 7, 5, 6, 7, 5, 6], 8), ([9, 3, 9, 3, 9], 6)]
+        streams, sched = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        sp = sched.summary()["speculation"]
+        assert sp["accepted"] > 0
+        assert sp["drafted"] >= sp["accepted"]
+
+    def test_model_drafter_end_to_end(self, lm):
+        """Draft model == target model -> near-total acceptance, same
+        stream (the small-draft-model path wired through the engine)."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="dense",
+            prefill_buckets=(4, 8), spec_tokens=2,
+            drafter=ModelDrafter(model, params, prefill_buckets=(4, 8, 16)),
+        )
+        reqs = _requests(3, seed=9, max_new=5)
+        streams, sched = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        sp = sched.summary()["speculation"]
+        # a perfect drafter is only ever cut short by request budgets
+        assert sp["accepted"] > 0
+
+    def test_near_horizon_span_is_capped_not_wrong(self, lm):
+        """A verify span overhanging max_len: acceptance is capped at
+        the horizon (dense writes drop, paged writes redirect to
+        scratch) and the stream still matches generate exactly."""
+        model, params = lm
+        for impl in ("dense", "paged"):
+            engine = ServingEngine(
+                model, params, num_slots=1, max_len=32, decode_impl=impl,
+                kv_block_size=8, prefill_buckets=(8,), spec_tokens=8,
+            )
+            prompt = list(range(1, 9))  # 8 tokens + 24 new == max_len
+            sched = Scheduler(engine)
+            rid = sched.submit(Request(prompt=prompt, max_new_tokens=24))
+            results = sched.run()
+            assert results[rid]["tokens"] == _generate_ref(
+                model, params, prompt, 24
+            )
+
+
+class TestSpecTensorParallel:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return Mesh(np.array(jax.devices("cpu")[:2]), ("model",))
+
+    def test_tp_spec_stream_matches_single_device(self, lm, mesh):
+        model, params = lm
+        reqs = _requests(5, seed=11)
+        single = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4, 8), spec_tokens=2,
+        )
+        tp = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4, 8), spec_tokens=2,
+            mesh=mesh,
+        )
+        s_streams, _ = _run_stream(single, reqs)
+        t_streams, _ = _run_stream(tp, reqs)
+        assert t_streams == s_streams
+        for (prompt, n_new), got in zip(reqs, t_streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        assert tp.verify_compile_count() == 1
+
+    @pytest.mark.parametrize("k", [2, 4])
+    def test_tp_verify_collective_counts_independent_of_k(self, lm, mesh,
+                                                          k):
+        """The amortization claim, HLO-counted: the K+1-token verify
+        step carries exactly the same 2 all-reduces per layer as the
+        one-token step — collectives per TICK are constant, so
+        collectives per TOKEN divide by the accepted length."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4,), mesh=mesh,
+            spec_tokens=k,
+        )
+        args = (
+            engine._cache, engine._vars,
+            jnp.zeros((3, k + 1), jnp.int32), jnp.zeros((3,), jnp.int32),
+            jnp.asarray(engine._dummy_tables()),
+        )
+        txt = engine._verify_step_jit.lower(*args).compile().as_text()
+        n_ar = txt.count("all-reduce(")
+        assert n_ar == 2 * model.num_layers, (
+            f"K={k}: expected {2 * model.num_layers} all-reduces "
+            f"(2 per layer), got {n_ar}"
+        )
+        for op in ("all-gather(", "collective-permute(", "all-to-all(",
+                   "reduce-scatter("):
+            assert txt.count(op) == 0, f"unexpected {op} in verify step"
+
+
+class TestRollbackAndPagedEdges:
+    def test_paged_update_overhang_redirects_to_scratch(self):
+        """A span write beyond the table horizon must land in the
+        SCRATCH block — the naive gather clamp would write into the
+        row's LAST table entry, which is a live block."""
+        from chainermn_tpu.ops.paged_kv import paged_update
+
+        pool = jnp.zeros((3, 2, 1, 1), jnp.float32)
+        tables = jnp.asarray([[1, 2]], jnp.int32)
+        new = jnp.asarray([[[[1.0]]], [[[2.0]]]], jnp.float32)[None]
+        new = new.reshape(1, 2, 1, 1)  # [B=1, T=2, kvh=1, dh=1]
+        # positions [3]: token 0 -> logical 1 offset 1 (block 2);
+        # token 1 -> logical 2 == beyond max_blocks -> scratch.
+        out = np.asarray(paged_update(
+            pool, tables, jnp.asarray([3], jnp.int32), new
+        ))
+        assert out[2, 1, 0, 0] == 1.0  # in-horizon write landed
+        assert out[0, 0, 0, 0] == 2.0  # overhang went to scratch...
+        assert out[2, 0, 0, 0] == 0.0  # ...NOT clamped into block 2
+        assert (out[1] == 0).all()
+
+    def test_oversubscribed_pool_degrades_to_plain_rate(self, lm):
+        """A pool too small for the full K-span: the engine reserves
+        the plain-decode minimum, caps acceptance, and the stream is
+        still exact — speculation degrades to decode_step throughput,
+        never to an error or a wrong token."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=1, max_len=32, decode_impl="paged",
+            kv_block_size=8, num_blocks=2,  # ONE allocatable block
+            prefill_buckets=(4,), spec_tokens=4,
+        )
+        prompt = [3, 1, 4]
+        sched = Scheduler(engine)
+        rid = sched.submit(Request(prompt=prompt, max_new_tokens=4))
+        results = sched.run()
+        assert results[rid]["tokens"] == _generate_ref(
+            model, params, prompt, 4
+        )
+        assert engine._alloc.num_blocks == 2  # never grew
+
+    def test_spec_span_reservation_never_starves_plain_minimum(self, lm):
+        """Review regression: speculative block reservations are made
+        slot by slot, so an earlier slot's optional K-span extension
+        could grab the pool's last free blocks and leave a later slot
+        unable to reserve even its PLAIN p+1 write — crashing a pool
+        that plain decode serves fine. The two-pass reservation pins
+        the contract: any workload that completes at spec_tokens=0
+        completes (identically) at spec_tokens>0."""
+        model, params = lm
+        reqs = [(list(range(1, 6)), 4), (list(range(2, 9)), 6)]
+        refs = [_generate_ref(model, params, p, g) for p, g in reqs]
+
+        def build(k):
+            return ServingEngine(
+                model, params, num_slots=2, max_len=32,
+                decode_impl="paged", kv_block_size=4, num_blocks=6,
+                prefill_buckets=(8,), spec_tokens=k,
+                drafter=_AdversarialDrafter(refs) if k else None,
+            )
+
+        plain_streams, _ = _run_stream(build(0), reqs)
+        assert plain_streams == refs
+        spec_streams, _ = _run_stream(build(4), reqs)  # raised pre-fix
+        assert spec_streams == refs
+
+    @pytest.mark.parametrize("impl", ["dense", "paged"])
+    def test_leave_rejoin_same_slot_shorter_prompt(self, lm, impl):
+        """ISSUE 5 satellite: slot leave -> rejoin at the SAME slot with
+        a SHORTER prompt must never read a stale row/block — the
+        position rewind is host metadata, so the proof is (a) values:
+        the rejoined stream matches generate exactly though the cache
+        still physically holds the deeper request's rows; (b)
+        structural: the paged table was rewound to scratch on release
+        and re-covers only the new request's real span."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=1, max_len=32, decode_impl=impl,
+            kv_block_size=4, prefill_buckets=(4, 8, 16), spec_tokens=4,
+        )
+        # Request A: long prompt, driven deep into the cache.
+        long_prompt = [7, 3, 7, 3, 7, 3, 7, 3, 7, 3]
+        res = engine.prefill_join(long_prompt)
+        assert res is not None and res[0] == 0
+        for _ in range(4):
+            engine.verify_step()
+        assert int(engine._positions[0]) > len(long_prompt)
+        engine.leave(0)
+        if impl == "paged":
+            assert engine._alloc.blocks_in_use == 0
+            assert (engine._alloc.tables[0] == 0).all()  # rewound
+        # Request B: SAME slot, much shorter prompt.
+        short_prompt = [9, 2]
+        res_b = engine.prefill_join(short_prompt)
+        assert res_b is not None and res_b[0] == 0  # same slot reused
+        assert int(engine._positions[0]) == len(short_prompt)  # rewound
+        if impl == "paged":
+            # re-covers only the new request's real span (P+1 tokens),
+            # not A's old depth
+            assert engine._alloc.blocks_in_use == \
+                engine._alloc.blocks_for(len(short_prompt) + 1)
+        stream = list(short_prompt) + [res_b[1]]
+        while len(stream) < len(short_prompt) + 8:
+            committed, _, _ = engine.verify_step()
+            stream.extend(committed[0])
+        ref = _generate_ref(model, params, short_prompt, 8)
+        assert stream[:len(ref)] == ref
+
+
+class TestValidationAndResolution:
+    def test_spec_with_sampling_rejected(self, lm):
+        """ISSUE 5 satellite: speculative acceptance is defined for
+        greedy only — the combination is refused with a clear error at
+        engine construction (where temperature and spec_tokens meet),
+        before any request can be submitted."""
+        model, params = lm
+        with pytest.raises(ValueError, match="greedy-only"):
+            ServingEngine(model, params, num_slots=1, max_len=32,
+                          decode_impl="dense", temperature=0.8,
+                          rng=jax.random.PRNGKey(0), spec_tokens=2)
+        # greedy + spec and sampling + no-spec both construct fine
+        ServingEngine(model, params, num_slots=1, max_len=32,
+                      decode_impl="dense", spec_tokens=2)
+        ServingEngine(model, params, num_slots=1, max_len=32,
+                      decode_impl="dense", temperature=0.8,
+                      rng=jax.random.PRNGKey(0), spec_tokens=0)
+
+    def test_spec_tokens_bounds_and_drafter_contract(self, lm):
+        model, params = lm
+        with pytest.raises(ValueError, match="spec_tokens"):
+            ServingEngine(model, params, num_slots=1, max_len=32,
+                          spec_tokens=-1)
+        with pytest.raises(ValueError, match="spec_tokens"):
+            ServingEngine(model, params, num_slots=1, max_len=32,
+                          spec_tokens=32)
+        with pytest.raises(TypeError, match="propose"):
+            ServingEngine(model, params, num_slots=1, max_len=32,
+                          spec_tokens=2, drafter=object())
+
+    def test_verify_step_requires_spec(self, lm):
+        model, params = lm
+        engine = ServingEngine(model, params, num_slots=1, max_len=32,
+                               decode_impl="dense", spec_tokens=0)
+        with pytest.raises(RuntimeError, match="spec_tokens"):
+            engine.verify_step()
+
+    def test_auto_resolves_through_registry_with_provenance(self, lm):
+        """Under the suite's table-only mode 'auto' resolves to the
+        documented default 0 (speculation must EARN adoption through a
+        bench capture) and the decision is recorded with provenance."""
+        model, params = lm
+        engine = ServingEngine(model, params, num_slots=1, max_len=32,
+                               decode_impl="dense", spec_tokens="auto")
+        assert engine.spec_tokens == 0
+        recs = [d for d in engine.decisions if d["name"] == "spec_tokens"]
+        assert recs and recs[-1]["winner"] == "0"
+        assert recs[-1]["source"] == "table"
+
+    def test_forced_resolution(self, lm, monkeypatch):
+        model, params = lm
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_FORCE", "spec_tokens=4")
+        engine = ServingEngine(model, params, num_slots=1, max_len=32,
+                               decode_impl="dense", spec_tokens="auto")
+        assert engine.spec_tokens == 4
+        recs = [d for d in engine.decisions if d["name"] == "spec_tokens"]
+        assert recs and recs[-1]["source"] == "forced"

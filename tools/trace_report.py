@@ -30,9 +30,12 @@ Sections:
 - **serving** — continuous-batching accounting (ISSUE 4) from the
   scheduler's ``serving`` events: requests/tokens served, tokens/s over
   device-busy time, nearest-rank p50/p99 per-token latency (one decode
-  step = one token for every active request), mean slot occupancy, and
-  queue-wait/prefill means. Omitted when the trace has no serving
-  events.
+  step = one token for every active request; under speculation, the
+  tick latency for 1..K+1 tokens), TTFT (submit → first token) p50/p99,
+  mean slot occupancy, and queue-wait/prefill means. When ``speculate``
+  events exist (ISSUE 5), adds drafted/accepted token counts, the
+  acceptance rate, and an accept-length histogram. Omitted when the
+  trace has no serving events.
 - **stragglers** — flagged divergence reports, if any.
 - **roofline** — where a device kind with a known HBM peak appears
   (bench.py's per-kind tables, the same floors tools/byte_audit.py
@@ -329,10 +332,33 @@ def render_text(s: dict) -> str:
                 f"  per-token latency: p50 {sv['token_ms_p50']:.3f} ms, "
                 f"p99 {sv['token_ms_p99']:.3f} ms"
             )
+        if sv.get("ttft_ms_p50") is not None:
+            lines.append(
+                f"  TTFT: p50 {sv['ttft_ms_p50']:.3f} ms, "
+                f"p99 {sv['ttft_ms_p99']:.3f} ms"
+            )
         if sv.get("occupancy_mean") is not None:
             lines.append(
                 f"  slot occupancy: {sv['occupancy_mean'] * 100:.1f}% mean"
             )
+        sp = sv.get("speculation")
+        if sp:
+            rate = sp.get("accept_rate")
+            lines.append(
+                f"  speculation: {sp['drafted']} drafted, "
+                f"{sp['accepted']} accepted"
+                + (f" ({rate * 100:.1f}% acceptance)"
+                   if rate is not None else "")
+                + f" over {sp['ticks']} tick(s)"
+            )
+            hist = " ".join(
+                f"{k}:{v}" for k, v in sorted(
+                    sp.get("accept_len_hist", {}).items(),
+                    key=lambda kv: int(kv[0]),
+                )
+            )
+            if hist:
+                lines.append(f"  accept-length histogram: {hist}")
         # queue_wait and prefill are separate events: a truncated trace
         # may carry one without the other — guard each independently.
         if sv.get("queue_wait_ms_mean") is not None:
