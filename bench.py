@@ -2512,6 +2512,17 @@ def _run_bench(mode: str) -> None:
         obs_trace.enable(trace_path, meta={"source": "bench", "mode": mode})
     except OSError:
         trace_path = None
+    # Live metrics plane (ISSUE 6): the recorder tap aggregates every
+    # wire/step/serving event this child emits into the registry; the
+    # snapshot lands in BENCH_DETAILS.json at the end, so each bench
+    # artifact carries the rolled-up counter/histogram view beside the
+    # raw trace.
+    try:
+        from chainermn_tpu.observability import metrics as obs_metrics
+
+        obs_metrics.install_tap()
+    except Exception:
+        obs_metrics = None
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
@@ -2700,6 +2711,16 @@ def _run_bench(mode: str) -> None:
         rec = obs_trace.active()
         if rec is not None:
             rec.flush()
+    # Metrics snapshot (ISSUE 6): counters/gauges + streaming histogram
+    # quantiles over the whole run — full blob to BENCH_DETAILS.json
+    # only (the compact stdout line keeps its whitelist).
+    if obs_metrics is not None:
+        try:
+            reg = obs_metrics.active_registry()
+            if reg is not None:
+                out["metrics_snapshot"] = reg.snapshot()
+        except Exception as e:
+            out["metrics_snapshot_error"] = f"{type(e).__name__}: {e}"[:120]
     print(json.dumps(out), flush=True)
 
 

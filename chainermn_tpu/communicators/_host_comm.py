@@ -27,6 +27,7 @@ from typing import Any, Callable, Sequence
 import jax
 import numpy as np
 
+from chainermn_tpu.observability import flight as _flight
 from chainermn_tpu.observability import trace as _trace
 
 
@@ -36,25 +37,36 @@ def _traced_obj(op: str, payload: str | None = "arg"):
     blocking duration (host-plane calls complete synchronously — no
     async-dispatch caveat here). ``payload``: ``"arg"`` measures the
     first positional argument, ``"result"`` the return value (receives),
-    ``None`` skips bytes (barrier). Disabled cost: one global read."""
+    ``None`` skips bytes (barrier). Disabled cost: one global read plus
+    the flight recorder's in-flight marker (ISSUE 6) — these BLOCKING
+    host collectives are exactly where a distributed hang parks (one
+    rank in a barrier whose peers never arrive), so the marker is
+    unconditional: the hang dump then names the op a wedged process was
+    inside, tracing on or off."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, *args, **kwargs):
-            rec = _trace.active()
-            if rec is None:
-                return fn(self, *args, **kwargs)
-            t0 = time.perf_counter()
-            out = fn(self, *args, **kwargs)
-            obj = (args[0] if args else None) if payload == "arg" else (
-                out if payload == "result" else None
+            token = _flight.collective_entered(
+                op, plane="host", size=self.size
             )
-            rec.collective(
-                op, plane="host",
-                nbytes=(_trace.obj_nbytes(obj) if payload else None),
-                dur_s=time.perf_counter() - t0, size=self.size,
-            )
-            return out
+            try:
+                rec = _trace.active()
+                if rec is None:
+                    return fn(self, *args, **kwargs)
+                t0 = time.perf_counter()
+                out = fn(self, *args, **kwargs)
+                obj = (args[0] if args else None) if payload == "arg" else (
+                    out if payload == "result" else None
+                )
+                rec.collective(
+                    op, plane="host",
+                    nbytes=(_trace.obj_nbytes(obj) if payload else None),
+                    dur_s=time.perf_counter() - t0, size=self.size,
+                )
+                return out
+            finally:
+                _flight.collective_exited(token)
 
         return wrapper
 

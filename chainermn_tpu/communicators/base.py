@@ -23,6 +23,7 @@ execution model is TPU-native SPMD:
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 from typing import Any, Callable, Sequence
@@ -34,6 +35,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.communicators._host_comm import HostComm
+from chainermn_tpu.observability import flight as _flight
 from chainermn_tpu.observability import trace as _trace
 from chainermn_tpu.parallel import collectives
 from chainermn_tpu.parallel.mesh import MeshTopology
@@ -155,6 +157,30 @@ class CommunicatorBase:
         except Exception:
             return "unknown"
 
+    @contextlib.contextmanager
+    def _mark(self, op: str, nbytes=None):
+        """Flight-recorder entry marker (ISSUE 6): one lock-free slot
+        store naming the collective this process is ABOUT to dispatch —
+        what the hang watchdog's dump reports when peers never arrive.
+        The sites call :meth:`_wire_event` INSIDE the marked region, so
+        the marker covers the full dispatch including any sync wait in
+        the event; the ``finally`` removes THIS entry by identity
+        exactly once whether the body returns, the body raises (a
+        caller that catches a bad-dtype/socket error and carries on
+        healthy must not leave a phantom marker for the fire-once
+        watchdog), or the event itself raises after recording (sync
+        mode surfacing a deferred XLA error must not pop an ENCLOSING
+        composite's marker — review finding). Always on (the cost is
+        one tuple build); host-side only, so the lowered HLO is
+        untouched (structural test in tests/test_metrics.py)."""
+        token = _flight.collective_entered(
+            op, nbytes=nbytes, axes=list(self._flat_axes), size=self.size,
+        )
+        try:
+            yield
+        finally:
+            _flight.collective_exited(token)
+
     def _wire_event(
         self, op: str, t0: float, *, payload=None, nbytes=None,
         result=None, **extra,
@@ -164,7 +190,10 @@ class CommunicatorBase:
         inside a jitted program, so instrumentation cannot change the
         lowered HLO (structural test in tests/test_trace.py).
         ``result`` is blocked on only in the recorder's sync mode (true
-        wall durations); default durations are dispatch-to-return."""
+        wall durations); default durations are dispatch-to-return. The
+        flight recorder's in-flight marker is NOT cleared here — the
+        enclosing :meth:`_mark` owns its entry and removes it by
+        identity on the way out."""
         rec = _trace.active()
         if rec is None:
             return
@@ -292,9 +321,10 @@ class CommunicatorBase:
         reduced array ``[...]`` (replicated)."""
         t0 = time.perf_counter()
         x = self._shard_stacked(x)
-        out = self._jitted[op](x)
-        self._wire_event("allreduce", t0, nbytes=int(x.nbytes),
-                         result=out, reduce_op=op)
+        with self._mark("allreduce", nbytes=int(x.nbytes)):
+            out = self._jitted[op](x)
+            self._wire_event("allreduce", t0, nbytes=int(x.nbytes),
+                             result=out, reduce_op=op)
         return out[0]
 
     def _root_process(self, root: int) -> int:
@@ -349,10 +379,11 @@ class CommunicatorBase:
             x = x[root]
         # Cross-process agreement: every process must end up with the
         # *root process's* value, not its own local one.
-        x = self._agree_value(x, self._root_process(root))
-        out = jax.device_put(x, NamedSharding(self.mesh, P()))
-        self._wire_event("bcast", t0, nbytes=int(out.nbytes), result=out,
-                         root=root)
+        with self._mark("bcast", nbytes=int(x.nbytes)):
+            x = self._agree_value(x, self._root_process(root))
+            out = jax.device_put(x, NamedSharding(self.mesh, P()))
+            self._wire_event("bcast", t0, nbytes=int(out.nbytes),
+                             result=out, root=root)
         return out
 
     def allgather(self, x: jax.Array) -> jax.Array:
@@ -362,9 +393,10 @@ class CommunicatorBase:
         x = jnp.asarray(x)
         if x.shape[0] != self.size:
             raise ValueError("allgather expects stacked [size, ...] input")
-        out = jax.device_put(x, NamedSharding(self.mesh, P()))
-        self._wire_event("allgather", t0, nbytes=int(out.nbytes),
-                         result=out)
+        with self._mark("allgather", nbytes=int(x.nbytes)):
+            out = jax.device_put(x, NamedSharding(self.mesh, P()))
+            self._wire_event("allgather", t0, nbytes=int(out.nbytes),
+                             result=out)
         return out
 
     def alltoall(self, x: jax.Array) -> jax.Array:
@@ -378,8 +410,10 @@ class CommunicatorBase:
         if x.ndim < 2 or x.shape[0] != self.size or x.shape[1] != self.size:
             raise ValueError("alltoall expects [size, size, ...] input")
         x = self._shard_stacked(x)
-        out = self._jitted["alltoall"](x)
-        self._wire_event("alltoall", t0, nbytes=int(x.nbytes), result=out)
+        with self._mark("alltoall", nbytes=int(x.nbytes)):
+            out = self._jitted["alltoall"](x)
+            self._wire_event("alltoall", t0, nbytes=int(x.nbytes),
+                             result=out)
         return out
 
     def scatter(self, x: jax.Array, root: int = 0) -> jax.Array:
@@ -388,10 +422,11 @@ class CommunicatorBase:
         buffer is broadcast first so every process shards the same data."""
         t0 = time.perf_counter()
         x = jnp.asarray(x)
-        x = self._agree_value(x, self._root_process(root))
-        out = self._shard_stacked(x)
-        self._wire_event("scatter", t0, nbytes=int(x.nbytes), result=out,
-                         root=root)
+        with self._mark("scatter", nbytes=int(x.nbytes)):
+            x = self._agree_value(x, self._root_process(root))
+            out = self._shard_stacked(x)
+            self._wire_event("scatter", t0, nbytes=int(x.nbytes),
+                             result=out, root=root)
         return out
 
     # ------------------------------------------------------------------
@@ -404,13 +439,14 @@ class CommunicatorBase:
         weights — reference ``bcast_data(model)`` called on the first
         optimizer update (``optimizers.py`` (dagger))."""
         t0 = time.perf_counter()
-        params = self._agree_value(params, self._root_process(root))
-        repl = NamedSharding(self.mesh, P())
-        out = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), repl), params
-        )
-        self._wire_event("bcast_data", t0, payload=out, result=out,
-                         root=root)
+        with self._mark("bcast_data"):
+            params = self._agree_value(params, self._root_process(root))
+            repl = NamedSharding(self.mesh, P())
+            out = jax.tree.map(
+                lambda x: jax.device_put(jnp.asarray(x), repl), params
+            )
+            self._wire_event("bcast_data", t0, payload=out, result=out,
+                             root=root)
         return out
 
     def reduce_gradients_in_jit(
@@ -503,17 +539,19 @@ class CommunicatorBase:
             return out.astype(orig)
 
         t0 = time.perf_counter()
-        out = jax.tree.map(reduce_leaf, grads)
-        # The top-level wire event (the per-leaf allreduces above record
-        # their own nested events): payload bytes of the whole tree, the
-        # wire dtype, and — when this communicator's wire came from
-        # ``allreduce_grad_dtype='auto'`` — the autotune provenance.
-        self._wire_event(
-            "allreduce_grad", t0, payload=grads, result=out,
-            wire_dtype=(jnp.dtype(dtype).name if dtype is not None
-                        else "none"),
-            provenance=self._wire_provenance, reduce_op=op,
-        )
+        with self._mark("allreduce_grad"):
+            out = jax.tree.map(reduce_leaf, grads)
+            # The top-level wire event (the per-leaf allreduces above
+            # record their own nested events): payload bytes of the whole
+            # tree, the wire dtype, and — when this communicator's wire
+            # came from ``allreduce_grad_dtype='auto'`` — the autotune
+            # provenance.
+            self._wire_event(
+                "allreduce_grad", t0, payload=grads, result=out,
+                wire_dtype=(jnp.dtype(dtype).name if dtype is not None
+                            else "none"),
+                provenance=self._wire_provenance, reduce_op=op,
+            )
         return out
 
     # ------------------------------------------------------------------
@@ -550,17 +588,22 @@ class CommunicatorBase:
         form exists for parity and host-driven control flows, not the hot
         loop."""
         t0 = time.perf_counter()
-        is_tuple = isinstance(x, (tuple, list))
-        parts = list(x) if is_tuple else [x]
-        header = []
-        payloads = []
-        for p in parts:
-            arr = np.asarray(p)
-            header.append((arr.shape, str(arr.dtype)))
-            payloads.append(arr.tobytes())
-        self.send_obj(("ndarray", is_tuple, header, payloads), dest, tag)
-        self._wire_event("send", t0, plane="host",
-                         nbytes=sum(len(b) for b in payloads), dest=dest)
+        # p2p counts for the flight marker too — a send into a
+        # vanished peer blocks exactly like a collective.
+        with self._mark("send"):
+            is_tuple = isinstance(x, (tuple, list))
+            parts = list(x) if is_tuple else [x]
+            header = []
+            payloads = []
+            for p in parts:
+                arr = np.asarray(p)
+                header.append((arr.shape, str(arr.dtype)))
+                payloads.append(arr.tobytes())
+            self.send_obj(("ndarray", is_tuple, header, payloads),
+                          dest, tag)
+            self._wire_event("send", t0, plane="host",
+                             nbytes=sum(len(b) for b in payloads),
+                             dest=dest)
 
     def recv(self, source: int, tag: int = 0):
         """Eager point-to-point ndarray receive; returns NumPy array(s)
@@ -569,15 +612,21 @@ class CommunicatorBase:
         x64-off config, silently corrupting large values). Callers place on
         device with their own sharding/dtype choice."""
         t0 = time.perf_counter()
-        kind, is_tuple, header, payloads = self.recv_obj(source, tag)
-        if kind != "ndarray":
-            raise RuntimeError(
-                f"recv expected an ndarray message, got {kind!r} (interleaved "
-                "send_obj/send on one channel must match recv_obj/recv order)"
-            )
-        self._wire_event("recv", t0, plane="host",
-                         nbytes=sum(len(b) for b in payloads),
-                         source=source)
+        # See send: a recv whose sender never shows is the canonical
+        # p2p hang — marked like the collectives.
+        with self._mark("recv"):
+            kind, is_tuple, header, payloads = self.recv_obj(source, tag)
+            if kind != "ndarray":
+                # Recoverable contract error; the _mark context balances
+                # the marker on the raise (callers may catch and carry on).
+                raise RuntimeError(
+                    f"recv expected an ndarray message, got {kind!r} "
+                    "(interleaved send_obj/send on one channel must match "
+                    "recv_obj/recv order)"
+                )
+            self._wire_event("recv", t0, plane="host",
+                             nbytes=sum(len(b) for b in payloads),
+                             source=source)
         arrays = tuple(
             # .copy(): frombuffer views the wire bytes read-only; MPI recv
             # hands back a writable buffer, so match that contract.

@@ -1,0 +1,234 @@
+"""HTTP exposition of the live metrics plane (ISSUE 6 tentpole;
+docs/observability.md "Live metrics").
+
+A stdlib ``http.server`` daemon thread (no new dependencies) serving:
+
+- ``GET /metrics`` — Prometheus text exposition (v0.0.4) of the
+  process registry; on rank 0, peer snapshots cached by
+  :meth:`MetricsExporter.merge_peer_snapshots` are appended with a
+  ``rank`` label.
+- ``GET /healthz`` — JSON liveness: rank, pid, trainer step (the
+  flight heartbeat), last-event age, uptime.
+- ``GET /trace/tail?n=N`` — the flight ring's most recent N events as
+  JSON (forensics without waiting for the JSONL file to flush).
+
+Port contract (``CHAINERMN_TPU_METRICS_PORT``): unset = no server;
+``0`` = ephemeral port (the bound port is on the returned exporter and
+in ``/healthz`` — tests and the dryrun self-scrape use this);
+``N > 0`` = ``N + rank`` per process, so a multi-process job exposes
+one endpoint per rank without coordination. The server binds loopback
+by default — metrics name workload internals; fronting them publicly
+is a deployment decision, not a library default.
+
+The peer merge deliberately does NOT run host collectives from the
+scrape thread: an HTTP GET arriving at rank 0 cannot make every other
+rank enter an allgather, and trying would deadlock the job on a
+monitoring request. Instead :meth:`~MetricsExporter.merge_peer_snapshots`
+is a COLLECTIVE the workload calls on every rank (e.g. as a trainer
+extension) over the existing ``_host_comm`` object plane; rank 0
+caches the gathered snapshots and ``/metrics`` serves own + cached
+peers.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import time
+import urllib.parse
+from typing import Optional
+
+from chainermn_tpu.observability import flight as _flight
+from chainermn_tpu.observability import metrics as _metrics
+from chainermn_tpu.observability import trace as _trace
+
+ENV_PORT = "CHAINERMN_TPU_METRICS_PORT"
+
+CONTENT_TYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """One bound, running exposition server; see module docstring."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None,
+                 port: int = 0, host: str = "127.0.0.1") -> None:
+        self.registry = (registry if registry is not None
+                         else _metrics.registry())
+        self.rank = _trace._process_rank()
+        self._t0 = time.time()
+        self._peer_snapshots: list = []  # [(rank, snapshot), ...]
+        exporter = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            # one scrape per line in a server log would drown the
+            # trainer's own output; exposition servers stay silent
+            def log_message(self, *_a):
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def do_GET(self):
+                try:
+                    parsed = urllib.parse.urlparse(self.path)
+                    if parsed.path == "/metrics":
+                        body = exporter.registry.exposition(
+                            extra_snapshots=tuple(exporter._peer_snapshots)
+                        ).encode()
+                        self._reply(200, body, CONTENT_TYPE_METRICS)
+                    elif parsed.path == "/healthz":
+                        body = (json.dumps(exporter.health())
+                                .encode() + b"\n")
+                        self._reply(200, body, "application/json")
+                    elif parsed.path == "/trace/tail":
+                        q = urllib.parse.parse_qs(parsed.query)
+                        try:
+                            n = int(q.get("n", ["100"])[0])
+                        except ValueError:
+                            n = 100
+                        body = (json.dumps(_flight.tail(n), default=repr)
+                                .encode() + b"\n")
+                        self._reply(200, body, "application/json")
+                    else:
+                        self._reply(404, b"not found\n", "text/plain")
+                except Exception as e:  # a scrape must never kill the job
+                    try:
+                        self._reply(
+                            500, f"{type(e).__name__}: {e}\n".encode(),
+                            "text/plain",
+                        )
+                    except Exception:
+                        pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"chainermn-metrics-exporter:{self.port}", daemon=True,
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+
+    def health(self) -> dict:
+        beat = _flight.last_beat()
+        rec = _trace.active()
+        last_ev_age = None
+        if rec is not None and getattr(rec, "last_event_t", None):
+            last_ev_age = round(time.time() - rec.last_event_t, 3)
+        return {
+            "ok": True,
+            "rank": self.rank,
+            "pid": os.getpid(),
+            "port": self.port,
+            "step": beat["step"] if beat else None,
+            "last_beat_age_s": beat["age_s"] if beat else None,
+            "last_event_age_s": last_ev_age,
+            "in_flight_collective": _flight.in_flight(),
+            "uptime_s": round(time.time() - self._t0, 3),
+            "peer_snapshots": len(self._peer_snapshots),
+        }
+
+    def merge_peer_snapshots(self, comm) -> int:
+        """COLLECTIVE over the host object plane — every process of
+        ``comm`` must call (trainer-extension cadence, NOT the scrape
+        thread; see module docstring). Gathers each rank's registry
+        snapshot; rank 0 caches peers for ``/metrics``. Returns the
+        number of peer snapshots this rank now serves."""
+        snaps = comm.allgather_obj(self.registry.snapshot())
+        my = comm.host.rank
+        self._peer_snapshots = [
+            (r, s) for r, s in enumerate(snaps) if r != my
+        ]
+        return len(self._peer_snapshots)
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=5)
+
+
+# ----------------------------------------------------------------------
+# Module-level lifecycle (env-gated autostart)
+# ----------------------------------------------------------------------
+
+_exporter: Optional[MetricsExporter] = None
+_exporter_lock = threading.Lock()
+_env_checked = False
+
+
+def start(port: int = 0,
+          registry: Optional[_metrics.MetricsRegistry] = None,
+          host: str = "127.0.0.1") -> MetricsExporter:
+    """Start an exposition server (explicit form; tests and dryrun).
+    Does not touch the module-global autostarted instance."""
+    return MetricsExporter(registry=registry, port=port, host=host)
+
+
+def active() -> Optional[MetricsExporter]:
+    """The env-autostarted exporter, or None."""
+    return _exporter
+
+
+def maybe_start_from_env() -> Optional[MetricsExporter]:
+    """Idempotent env-gated start (the trainer / scheduler front door):
+    honours ``CHAINERMN_TPU_METRICS_PORT`` (module docstring), installs
+    the recorder tap so the endpoint is actually populated, and arms
+    the hang watchdog when ITS env gate is set. Unset/unusable env
+    returns None and is never re-checked (one string lookup per call
+    after that)."""
+    global _exporter, _env_checked
+    if _exporter is not None:
+        return _exporter
+    if _env_checked:
+        return None
+    with _exporter_lock:
+        if _exporter is not None or _env_checked:
+            return _exporter
+        _env_checked = True
+        # The watchdog's env gate is independent of the metrics port:
+        # arm it FIRST, unconditionally — a serving process with
+        # HANG_DUMP_S set but no (or an unbindable) metrics port must
+        # still get hang forensics (review finding: the early returns
+        # below used to silently disarm it).
+        _flight.maybe_start_from_env()
+        v = os.environ.get(ENV_PORT)
+        if v is None or v == "":
+            return None
+        try:
+            base = int(v)
+        except ValueError:
+            return None
+        if base < 0:
+            return None
+        port = 0 if base == 0 else base + _trace._process_rank()
+        reg = _metrics.install_tap()
+        try:
+            _exporter = MetricsExporter(registry=reg, port=port)
+        except OSError:
+            return None  # port taken: telemetry must never kill the job
+        return _exporter
+
+
+def stop() -> None:
+    """Tear down the env-autostarted exporter (tests)."""
+    global _exporter, _env_checked
+    with _exporter_lock:
+        if _exporter is not None:
+            _exporter.close()
+        _exporter = None
+        _env_checked = False

@@ -1,0 +1,366 @@
+"""Flight recorder + distributed hang watchdog (ISSUE 6 tentpole;
+docs/observability.md "Hang forensics").
+
+The failure mode this exists for: one rank enters a collective whose
+peers never arrive, and the job stalls SILENTLY — no exception, no
+log line, nothing to attach a debugger to hours later. Three always-on,
+always-cheap host-side signals turn that into a diagnosable artifact:
+
+- **Event ring** — a bounded deque of recent trace events, fed by a
+  recorder sink (one deque append per event; only active while a trace
+  recorder is). The last ~512 events of context ride into every dump.
+- **In-flight collective marker** — ``collective_entered(op, ...)`` /
+  ``collective_exited(token)`` push/remove a (time, info) entry on the
+  calling THREAD's stack, because collectives nest: ``bcast`` runs a
+  host-plane ``bcast_obj`` inside it, ``allreduce_grad`` a per-leaf
+  ``allreduce`` — a one-slot cell would be cleared by the inner exit
+  and lose the outer marker exactly where composite multi-host hangs
+  park (review finding). Stacks are PER THREAD (the async
+  double-buffered host reducer completes its previous-step collectives
+  on a background thread while the main thread marks its own — one
+  shared stack would pop the wrong thread's marker and the dump would
+  name the wrong op), and exits remove their OWN entry by identity, so
+  an exception unwinding through nested markers can never over-pop an
+  enclosing one. One append/remove per call, no lock (CPython
+  list/dict single ops are atomic): the communicator surface marks
+  every eager collective's entry/exit, and the host object plane
+  (``_host_comm``) marks its blocking collectives — a hang INSIDE a
+  collective is named by op, payload bytes, axes, and age, innermost
+  first.
+- **Heartbeat** — ``beat(step)`` from the trainer loop (once per step)
+  and the serving scheduler (once per decode round); loops call
+  :func:`quiesce` when they END, so a process idling between runs is
+  never mistaken for a wedged one.
+
+:class:`HangWatchdog` is a daemon thread that polls those signals; when
+no progress lands for ``stall_s`` seconds (a beat or a collective exit
+both count) — or an in-flight collective alone exceeds ``stall_s`` —
+it writes ``hang_dump_<rank>.json``: all-thread stacks
+(``sys._current_frames``; the ``faulthandler`` module is the
+lower-level fallback when even the JSON writer could be wedged), the
+in-flight marker, the last beat, and the event ring. It fires ONCE and
+exits (the process is presumed wedged; a second dump would only
+overwrite the evidence), and it never fires in a process that has shown
+no activity at all (an idle import must not dump).
+
+Enable explicitly (:func:`start_watchdog`) or by environment —
+``CHAINERMN_TPU_HANG_DUMP_S=<seconds>`` (threshold) and optional
+``CHAINERMN_TPU_HANG_DUMP_DIR`` — checked by the trainer and the
+exporter via :func:`maybe_start_from_env`. ``tests/conftest.py`` pops
+the env vars: the suite never grows watchdog threads.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Optional
+
+from chainermn_tpu.observability import trace as _trace
+
+_ENV_STALL = "CHAINERMN_TPU_HANG_DUMP_S"
+_ENV_DIR = "CHAINERMN_TPU_HANG_DUMP_DIR"
+
+#: dump schema version (bump on incompatible field changes).
+HANG_DUMP_SCHEMA = 1
+
+RING_CAPACITY = 512
+
+_ring: collections.deque = collections.deque(maxlen=RING_CAPACITY)
+# Lock-free cells (list/dict single ops are atomic in CPython):
+#: thread-id -> STACK of (t_monotonic, {"op": ..., ...}) entries.
+_inflight: dict = {}
+_last_beat: list = [None]  # (t_monotonic, step) | None
+_progress: list = [None]   # monotonic time of the last progress signal
+
+
+def _ring_sink(ev: dict) -> None:
+    _ring.append(ev)
+
+
+# Installed at import: a deque append per trace event is the "always
+# cheap" budget, and the ring must predate any explicit setup — the
+# whole point is having context around when nobody planned for a hang.
+_trace.add_sink(_ring_sink)
+
+
+def collective_entered(op: str, **info: Any) -> tuple:
+    """Mark collective entry (communicator call sites). Cheap enough
+    for the eager hot path: one tuple build + one list append onto the
+    calling thread's stack. Returns the entry TOKEN: pass it back to
+    :func:`collective_exited` (the sites pair them in a ``finally``;
+    composites nest cleanly on the stack)."""
+    tid = threading.get_ident()
+    entry = (time.monotonic(),
+             {"op": op, "thread": threading.current_thread().name, **info})
+    _inflight.setdefault(tid, []).append(entry)
+    return entry
+
+
+def collective_exited(token: Optional[tuple] = None) -> None:
+    """Remove the calling thread's marker — ``token`` (the
+    :func:`collective_entered` return) by identity when given, else the
+    thread's innermost — and count progress. Identity removal makes
+    the exit idempotent, so an exception unwinding through nested
+    ``finally`` blocks can never over-pop an ENCLOSING collective's
+    marker. Progress only refreshes an already-armed chain (a beat
+    arms it): a one-off collective in an intentionally idle process
+    (post-:func:`quiesce` weight refresh, peer-snapshot merge) must
+    not re-arm the no-progress rule and spend the fire-once watchdog
+    on a healthy idle (review finding)."""
+    tid = threading.get_ident()
+    stack = _inflight.get(tid)
+    if stack:
+        try:
+            if token is None:
+                stack.pop()
+            else:
+                for i in range(len(stack) - 1, -1, -1):
+                    if stack[i] is token:
+                        del stack[i]
+                        break
+        except IndexError:
+            pass  # unbalanced exit must never take down the caller
+        if not stack:
+            _inflight.pop(tid, None)  # dead threads must not accrete
+    if _progress[0] is not None:
+        _progress[0] = time.monotonic()
+
+
+def beat(step: Optional[int] = None) -> None:
+    """Progress heartbeat: the trainer beats once per step, the serving
+    scheduler once per decode round."""
+    now = time.monotonic()
+    _last_beat[0] = (now, step)
+    _progress[0] = now
+
+
+def quiesce() -> None:
+    """Mark the process INTENTIONALLY idle (a training run returned, a
+    serving loop drained its queue): clears the beat/progress signals,
+    so the watchdog's no-progress rule stands down — a process waiting
+    for work is indistinguishable from a wedged one by silence alone
+    (review finding: without this, a drained serving replica dumped
+    after stall_s of legitimate quiet and the fire-once watchdog then
+    missed the real hang hours later). A genuinely stuck collective
+    still fires: the in-flight marker rule is independent of beats."""
+    _last_beat[0] = None
+    _progress[0] = None
+
+
+def _stacks_snapshot() -> list:
+    """All threads' live stacks, oldest outermost entry first."""
+    stacks = [list(s) for s in list(_inflight.values())]
+    stacks = [s for s in stacks if s]
+    stacks.sort(key=lambda s: s[0][0])
+    return stacks
+
+
+def in_flight() -> Optional[dict]:
+    """The most specific name for where a wedged process is parked:
+    the INNERMOST entry of the thread with the OLDEST outermost marker
+    (the longest-stuck nesting's deepest leg), with its age. None when
+    nothing is in flight."""
+    stacks = _stacks_snapshot()
+    if not stacks:
+        return None
+    t0, info = stacks[0][-1]
+    return {**info, "age_s": round(time.monotonic() - t0, 3)}
+
+
+def in_flight_stack() -> list:
+    """Every thread's nesting flattened oldest-first with ages — the
+    dump's view; e.g. ``bcast`` > ``bcast_obj`` when a composite wedges
+    on its host leg (entries carry ``thread`` to separate concurrent
+    collectives, e.g. the async host reducer's background thread)."""
+    now = time.monotonic()
+    entries = [e for s in _stacks_snapshot() for e in s]
+    entries.sort(key=lambda e: e[0])
+    return [
+        {**info, "age_s": round(now - t0, 3)} for t0, info in entries
+    ]
+
+
+def last_beat() -> Optional[dict]:
+    slot = _last_beat[0]
+    if slot is None:
+        return None
+    t0, step = slot
+    return {"step": step, "age_s": round(time.monotonic() - t0, 3)}
+
+
+def progress_age() -> Optional[float]:
+    """Seconds since the last progress signal (beat or collective
+    exit); None when the process has shown no activity yet."""
+    p = _progress[0]
+    return None if p is None else time.monotonic() - p
+
+
+def tail(n: int = 100) -> list:
+    """Most recent <= n ring events, oldest first. Lock-free snapshot:
+    CPython deques raise RuntimeError when another thread appends
+    mid-iteration (the exporter scrapes while the workload records) —
+    retry the copy a few times, and prefer an empty tail over taking
+    the scrape (or the hang dump) down."""
+    n = int(n)
+    if n <= 0:
+        return []  # a -0 slice would return EVERYTHING
+    for _ in range(5):
+        try:
+            return list(_ring)[-n:]
+        except RuntimeError:
+            continue
+    return []
+
+
+def reset() -> None:
+    """Clear ring/marker/beat state (tests)."""
+    _ring.clear()
+    _inflight.clear()
+    _last_beat[0] = None
+    _progress[0] = None
+
+
+def _thread_stacks() -> dict:
+    """{thread-name (id): [frame lines]} for every live thread."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        label = f"{names.get(tid, 'unknown')} ({tid})"
+        out[label] = [
+            line.rstrip("\n")
+            for line in traceback.format_stack(frame)
+        ]
+    return out
+
+
+def write_hang_dump(out_dir: str = ".", *, reason: str = "manual",
+                    stall_s: Optional[float] = None) -> str:
+    """Write ``hang_dump_<rank>.json`` and return its path: the
+    watchdog's payload, also callable directly (e.g. from a SIGTERM
+    handler). Never raises — forensics must not add a second failure;
+    returns "" when even the write fails."""
+    try:
+        rank = _trace._process_rank()
+        path = os.path.join(out_dir, f"hang_dump_{rank}.json")
+        payload = {
+            "schema": HANG_DUMP_SCHEMA,
+            "kind": "hang_dump",
+            "t": round(time.time(), 6),
+            "pid": os.getpid(),
+            "rank": rank,
+            "reason": reason,
+            "stall_s": stall_s,
+            "progress_age_s": (round(progress_age(), 3)
+                               if progress_age() is not None else None),
+            "in_flight": in_flight(),
+            "in_flight_stack": in_flight_stack(),
+            "last_beat": last_beat(),
+            "threads": _thread_stacks(),
+            "ring": tail(RING_CAPACITY),
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=repr)
+            f.write("\n")
+        return path
+    except Exception:
+        # The payload build races live threads by design (stacks, ring,
+        # markers): ANY failure here must not take the watchdog thread
+        # down with the forensics unwritten.
+        return ""
+
+
+class HangWatchdog(threading.Thread):
+    """Daemon thread; see module docstring. Fires at most once."""
+
+    def __init__(self, stall_s: float = 300.0, out_dir: str = ".",
+                 poll_s: Optional[float] = None) -> None:
+        if stall_s <= 0:
+            raise ValueError(f"stall_s must be > 0, got {stall_s}")
+        super().__init__(name="chainermn-hang-watchdog", daemon=True)
+        self.stall_s = float(stall_s)
+        self.out_dir = out_dir
+        self.poll_s = float(poll_s) if poll_s else max(
+            0.05, min(self.stall_s / 4.0, 10.0)
+        )
+        self.dump_path: Optional[str] = None
+        # NOT named _stop: threading.Thread has a private _stop METHOD
+        # that join() calls — shadowing it with an Event breaks join.
+        self._halt = threading.Event()
+
+    def _stalled(self) -> Optional[str]:
+        """Reason string when the process looks wedged, else None."""
+        now = time.monotonic()
+        # Oldest outermost entry across all threads: the true stall
+        # duration of a composite (the inner legs churn; the outer age
+        # is how long the whole collective has failed to come back).
+        stacks = _stacks_snapshot()
+        t0 = stacks[0][0][0] if stacks else None
+        if t0 is not None and now - t0 > self.stall_s:
+            return f"collective in flight > {self.stall_s}s"
+        p = _progress[0]
+        if p is not None and now - p > self.stall_s:
+            return f"no progress (beat/collective-exit) > {self.stall_s}s"
+        return None
+
+    def run(self) -> None:
+        while not self._halt.wait(self.poll_s):
+            reason = self._stalled()
+            if reason is not None:
+                self.dump_path = write_hang_dump(
+                    self.out_dir, reason=reason, stall_s=self.stall_s
+                )
+                if self.dump_path:
+                    print(
+                        f"[chainermn_tpu] HANG detected ({reason}); "
+                        f"flight dump: {self.dump_path}",
+                        file=sys.stderr, flush=True,
+                    )
+                return  # fire once; the process is presumed wedged
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+_watchdog: Optional[HangWatchdog] = None
+_watchdog_lock = threading.Lock()
+
+
+def start_watchdog(stall_s: float = 300.0, out_dir: str = ".",
+                   poll_s: Optional[float] = None) -> HangWatchdog:
+    """Start (or return the already-running) process watchdog."""
+    global _watchdog
+    with _watchdog_lock:
+        if _watchdog is not None and _watchdog.is_alive():
+            return _watchdog
+        _watchdog = HangWatchdog(stall_s, out_dir, poll_s)
+        _watchdog.start()
+        return _watchdog
+
+
+def stop_watchdog() -> None:
+    global _watchdog
+    with _watchdog_lock:
+        if _watchdog is not None:
+            _watchdog.stop()
+            _watchdog = None
+
+
+def maybe_start_from_env() -> Optional[HangWatchdog]:
+    """Env-gated start: ``CHAINERMN_TPU_HANG_DUMP_S=<seconds>`` (and
+    optional ``..._DIR``). No-op (None) when unset or unparsable."""
+    v = os.environ.get(_ENV_STALL)
+    if not v:
+        return None
+    try:
+        stall = float(v)
+    except ValueError:
+        return None
+    if stall <= 0:
+        return None
+    return start_watchdog(stall, os.environ.get(_ENV_DIR, "."))

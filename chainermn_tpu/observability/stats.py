@@ -1,0 +1,42 @@
+"""Shared order statistics for the observability plane (ISSUE 6
+satellite: ONE owner of the nearest-rank percentile rule).
+
+Before this module, the ceil(q*n) nearest-rank rule lived as a local
+``pct()`` closure inside :func:`trace.summarize_serving` (and every
+consumer of that rollup — ``Scheduler.summary``, bench's serving rows,
+``tools/trace_report.py`` — inherited the copy). The metrics plane's
+streaming histogram quantiles need the SAME rule, so it moves here:
+
+    nearest-rank percentile of q over n sorted samples = the sample at
+    1-based rank ceil(q * n)  (clamped into [1, n]).
+
+Deliberately dependency-free (stdlib ``math`` only): ``trace.py`` is
+loaded BY FILE PATH from ``tools/trace_report.py`` to avoid paying for
+a jax import in a report tool, and anything trace.py pulls in must
+honour the same constraint.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+
+def nearest_rank_index(n: int, q: float) -> int:
+    """0-based index of the nearest-rank percentile ``q`` in a sorted
+    sequence of length ``n``: ``ceil(q * n) - 1`` clamped into
+    ``[0, n - 1]``. The histogram quantile walks cumulative bucket
+    counts with exactly this rank."""
+    if n < 1:
+        raise ValueError(f"need n >= 1 samples, got {n}")
+    return min(n - 1, max(0, math.ceil(q * n) - 1))
+
+
+def nearest_rank(values: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of ``values`` (None when empty) — the
+    ceil(q*n) rule shared by the serving rollup and the metrics
+    histograms (pinned by tests/test_metrics.py)."""
+    if not values:
+        return None
+    s = sorted(values)
+    return s[nearest_rank_index(len(s), q)]

@@ -53,8 +53,49 @@ _ENV_PATH = "CHAINERMN_TPU_TRACE"
 _ENV_SYNC = "CHAINERMN_TPU_TRACE_SYNC"
 
 #: In-memory event cap per recorder — a runaway loop must not eat the
-#: host; overflow increments ``dropped`` (file writes continue).
+#: host; overflow increments ``dropped`` (file writes continue; the
+#: metrics plane exports the count live as ``trace_dropped_events``).
 MAX_BUFFERED_EVENTS = 200_000
+
+# The nearest-rank percentile rule, shared with the metrics histograms
+# (ISSUE 6 satellite: one owner in observability/stats.py). This module
+# is ALSO loaded by file path from tools/trace_report.py with no package
+# context (to avoid paying a jax import in a report tool) — load stats
+# the same way there.
+if __package__:
+    from chainermn_tpu.observability.stats import nearest_rank
+else:  # pragma: no cover - exercised via tools/trace_report.py
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "_obs_stats",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "stats.py"),
+    )
+    _mod = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_mod)
+    nearest_rank = _mod.nearest_rank
+
+#: Event sinks (ISSUE 6): callables ``sink(event_dict)`` invoked for
+#: every event ANY recorder emits — the metrics tap and the flight ring
+#: register here, so every already-instrumented site feeds the live
+#: plane with zero new call sites. Sinks fire only while a recorder is
+#: active; a raising sink is dropped from that event, never propagated
+#: into an instrumentation site.
+_sinks: list = []
+
+
+def add_sink(fn) -> None:
+    """Register an event sink (idempotent)."""
+    if fn not in _sinks:
+        _sinks.append(fn)
+
+
+def remove_sink(fn) -> None:
+    try:
+        _sinks.remove(fn)
+    except ValueError:
+        pass
 
 
 def _process_rank() -> int:
@@ -97,6 +138,9 @@ class Recorder:
         self.sync = sync
         self.events: list[dict] = []
         self.dropped = 0
+        #: epoch seconds of the most recent event — the exporter's
+        #: ``/healthz`` last-event-age signal.
+        self.last_event_t: float = 0.0
         self._lock = threading.Lock()
         self._rank = _process_rank()
         self._file = None
@@ -142,6 +186,15 @@ class Recorder:
                 except (OSError, ValueError):
                     # full disk / closed file must never break training
                     self._file = None
+        self.last_event_t = ev["t"]
+        # Sinks OUTSIDE the lock: a sink may inspect this recorder (the
+        # metrics health hook reads .dropped) without deadlocking, and a
+        # slow sink must not serialise other recording threads.
+        for sink in tuple(_sinks):
+            try:
+                sink(ev)
+            except Exception:
+                pass
         return ev
 
     def collective(
@@ -443,8 +496,6 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
       stringified length (JSON-stable), the trace_report histogram.
 
     Returns None when the trace carries no serving events."""
-    import math
-
     queue_waits: list[float] = []
     prefills: list[float] = []
     ttfts: list[float] = []
@@ -488,11 +539,7 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
     if not (queue_waits or prefills or steps or finishes or spec_ticks):
         return None
 
-    def pct(vals: list, q: float):
-        if not vals:
-            return None
-        s = sorted(vals)
-        return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+    pct = nearest_rank  # the shared ceil(q*n) rule (observability.stats)
 
     tokens = step_tokens + len(prefills)
     busy_s = sum(prefills) + sum(steps)

@@ -583,6 +583,23 @@ class ServingEngine:
     def pool_utilization(self) -> Optional[float]:
         return self._alloc.utilization() if self._alloc else None
 
+    def _publish_pool_gauges(self) -> None:
+        """Direct KV-pool gauges (ISSUE 6): the block allocator is
+        state with no trace events — refresh free/leased on every
+        mutation point (join / leave / per-step growth). One global
+        read when the metrics plane is off."""
+        from chainermn_tpu.observability import metrics
+
+        reg = metrics.active_registry()
+        if reg is None or self._alloc is None:
+            return
+        reg.gauge("kv_blocks_free",
+                  "allocatable KV pool blocks currently free").set(
+            self._alloc.free_blocks)
+        reg.gauge("kv_blocks_leased",
+                  "KV pool blocks owned by slots").set(
+            self._alloc.blocks_in_use)
+
     def decode_compile_count(self) -> Optional[int]:
         """Compilations of the steady-state step (the no-recompile pin:
         must stay 1 across any join/leave churn)."""
@@ -652,6 +669,7 @@ class ServingEngine:
         self._last_tok[slot] = tok
         self._active[slot] = True
         self._history[slot] = [int(t) for t in prompt] + [tok]
+        self._publish_pool_gauges()
         return slot, tok, bucket
 
     def decode_step(self):
@@ -683,6 +701,7 @@ class ServingEngine:
         )
         toks = np.asarray(toks)  # device sync: honest per-step latency
         dur = time.perf_counter() - t0
+        self._publish_pool_gauges()
         self._last_tok[active] = toks[active]
         self._positions[active] += 1
         for s in active:
@@ -806,6 +825,7 @@ class ServingEngine:
             self._positions[s] += a + 1
         stats = {"drafted": n_drafted, "accepted": n_accepted,
                  "accept_lens": accept_lens}
+        self._publish_pool_gauges()
         return committed, dur, stats
 
     def leave(self, slot: int) -> None:
@@ -819,3 +839,4 @@ class ServingEngine:
         self._history[int(slot)] = []
         if self._alloc is not None:
             self._alloc.release(int(slot))
+        self._publish_pool_gauges()

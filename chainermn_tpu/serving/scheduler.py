@@ -79,6 +79,15 @@ class Scheduler:
                              f"{policy!r}")
         self.engine = engine
         self.policy = policy
+        # Live-telemetry front door (ISSUE 6): a serving process driven
+        # only by the scheduler has no trainer loop to honour the
+        # metrics-port env gate — check it here too (no-op when unset).
+        try:
+            from chainermn_tpu.observability import exporter as _exporter
+
+            _exporter.maybe_start_from_env()
+        except Exception:
+            pass
         self._queue: deque[Request] = deque()
         self._inflight: dict[int, _InFlight] = {}
         self._ids = itertools.count()
@@ -106,6 +115,28 @@ class Scheduler:
         rec = trace.active()
         if rec is not None:
             rec.event(_kind, **fields)
+
+    def _publish_gauges(self) -> None:
+        """Direct queue/occupancy gauges (ISSUE 6): the admission
+        queue and the slot array are STATE, not events — the recorder
+        tap cannot see them, so every queue/in-flight mutation refreshes
+        the gauges here. One global read when the metrics plane is off
+        (the trace.active() discipline)."""
+        from chainermn_tpu.observability import metrics
+
+        reg = metrics.active_registry()
+        if reg is None:
+            return
+        reg.gauge("serving_queue_depth",
+                  "requests waiting for admission").set(len(self._queue))
+        reg.gauge("serving_inflight",
+                  "requests occupying a decode slot").set(
+            len(self._inflight))
+        eng = self.engine
+        reg.gauge("serving_slots", "decode slots in the compiled "
+                  "step").set(getattr(eng, "num_slots", 0))
+        reg.gauge("serving_active_slots", "decode slots currently "
+                  "occupied").set(getattr(eng, "n_active", 0))
 
     def submit(self, request: Request) -> str:
         """Enqueue; returns the request id (assigned when absent).
@@ -144,6 +175,7 @@ class Scheduler:
             )
         request._arrival = time.perf_counter()
         self._queue.append(request)
+        self._publish_gauges()
         return request.request_id
 
     @property
@@ -167,6 +199,7 @@ class Scheduler:
         }
         self._event(phase="finish", request=req.request_id,
                     generated=fl.generated, dur_s=round(dur, 9))
+        self._publish_gauges()
 
     def _admit_one(self) -> bool:
         """Try to admit the HEAD of the queue (strict arrival order —
@@ -193,6 +226,7 @@ class Scheduler:
                     ttft_s=round(now - req._arrival, 9))
         fl = _InFlight(req, slot, list(req.prompt) + [tok], 1)
         self._inflight[slot] = fl
+        self._publish_gauges()
         if fl.generated >= req.max_new_tokens or (
             req.eos_id is not None and tok == req.eos_id
         ):
@@ -270,34 +304,50 @@ class Scheduler:
         returns :attr:`results` (request_id -> token streams). The
         local accounting (:meth:`summary`) covers THIS run — each call
         starts a fresh event window."""
+        from chainermn_tpu.observability import flight as _flight
+
         self._events = []
         self.events_dropped = 0
         t0 = time.perf_counter()
         steps = 0
-        while self._queue or self._inflight:
-            progressed = False
-            if self.policy == "prefill_priority":
-                while self._admit_one():
-                    progressed = True
-            else:
-                progressed = self._admit_one()
-            if not self._inflight:
-                if self._queue and not progressed:
-                    # nothing running AND the head cannot be admitted:
-                    # the request can never fit (slot/pool shortage)
-                    head = self._queue[0]
+        try:
+            while self._queue or self._inflight:
+                # Hang-watchdog heartbeat: one per admission/decode
+                # round — the serving analog of the trainer's per-step
+                # beat.
+                _flight.beat(steps)
+                progressed = False
+                if self.policy == "prefill_priority":
+                    while self._admit_one():
+                        progressed = True
+                else:
+                    progressed = self._admit_one()
+                if not self._inflight:
+                    if self._queue and not progressed:
+                        # nothing running AND the head cannot be
+                        # admitted: the request can never fit
+                        # (slot/pool shortage)
+                        head = self._queue[0]
+                        raise RuntimeError(
+                            f"request {head.request_id!r} cannot be "
+                            f"admitted on an idle engine (prompt_len="
+                            f"{len(head.prompt)}, free_slots="
+                            f"{self.engine.free_slot_count})"
+                        )
+                    continue
+                self.step()
+                steps += 1
+                if steps > max_steps:
                     raise RuntimeError(
-                        f"request {head.request_id!r} cannot be admitted "
-                        f"on an idle engine (prompt_len="
-                        f"{len(head.prompt)}, free_slots="
-                        f"{self.engine.free_slot_count})"
-                    )
-                continue
-            self.step()
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError(f"exceeded max_steps={max_steps} with "
-                                   f"{len(self._inflight)} in flight")
+                        f"exceeded max_steps={max_steps} with "
+                        f"{len(self._inflight)} in flight")
+        finally:
+            # Drained OR raised (max_steps, admission failure — both
+            # catchable): stand the heartbeat down. A replica idling
+            # for the next burst, or a driver that caught the error,
+            # must not read as a hang — and must not waste the
+            # fire-once dump on a non-hang (review finding).
+            _flight.quiesce()
         self._wall = time.perf_counter() - t0
         return self.results
 

@@ -17,6 +17,8 @@ import jax
 import numpy as np
 
 from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.observability import flight as _flight
+from chainermn_tpu.observability import metrics as _metrics
 from chainermn_tpu.observability import trace as _trace
 
 PyTree = Any
@@ -162,7 +164,29 @@ class Trainer:
             yield out
 
     def run(self, max_iterations: int) -> Any:
+        try:
+            return self._run_impl(max_iterations)
+        finally:
+            # The run is OVER — returned OR raised: stand the heartbeat
+            # down so a process that lingers after training (eval,
+            # checkpointing, a driver that caught the exception) is not
+            # mistaken for a hang by the watchdog; its fire-once dump
+            # must stay in the barrel for a real stall (review finding:
+            # the raise path used to leave a stale beat).
+            _flight.quiesce()
+
+    def _run_impl(self, max_iterations: int) -> Any:
         t0 = time.perf_counter()
+        # Live-telemetry front door (ISSUE 6): honour the metrics-port
+        # and hang-watchdog env gates once per run. Both are no-ops
+        # (one env read) when unset — and must never break training.
+        try:
+            from chainermn_tpu.observability import exporter as _exporter
+
+            _exporter.maybe_start_from_env()
+            _flight.maybe_start_from_env()
+        except Exception:
+            pass
         rec0 = _trace.active()
         if rec0 is not None:
             # Comm/compute-overlap configuration of the step driving this
@@ -242,6 +266,17 @@ class Trainer:
                 jax.block_until_ready(metrics)
             compute = time.perf_counter() - t_step
             self.iteration += 1
+            # Hang-watchdog heartbeat + the direct step-counter gauge
+            # (ISSUE 6): the trainer's state plane has no trace event of
+            # its own until the step event below — the beat and gauge
+            # stay live even with tracing off. One slot store; the gauge
+            # guards on the registry existing at all.
+            _flight.beat(self.iteration)
+            reg = _metrics.active_registry()
+            if reg is not None:
+                reg.gauge(
+                    "train_iteration", "last completed trainer iteration"
+                ).set(float(self.iteration))
 
             log_s = 0.0
             if self.iteration % self.log_interval == 0 or self.iteration == max_iterations:

@@ -43,6 +43,14 @@ os.environ.setdefault(
 # one explicitly (tests/test_trace.py).
 os.environ.pop("CHAINERMN_TPU_TRACE", None)
 os.environ.pop("CHAINERMN_TPU_TRACE_SYNC", None)
+# ...and for the live telemetry plane (ISSUE 6): an exported metrics
+# port would make every Trainer.run/Scheduler construction in the suite
+# spawn an HTTP listener, and a hang-dump threshold would arm watchdog
+# threads that write hang_dump_*.json into the repo — tests that need
+# them start exporter/watchdog explicitly (tests/test_metrics.py).
+os.environ.pop("CHAINERMN_TPU_METRICS_PORT", None)
+os.environ.pop("CHAINERMN_TPU_HANG_DUMP_S", None)
+os.environ.pop("CHAINERMN_TPU_HANG_DUMP_DIR", None)
 
 # The suite is CPU-mesh-only by design, but an externally injected
 # accelerator-plugin shim (sitecustomize on PYTHONPATH) can HANG jax

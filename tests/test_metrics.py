@@ -1,0 +1,815 @@
+"""The live telemetry plane (ISSUE 6):
+
+- shared nearest-rank percentile rule (observability.stats) — the
+  ceil(q*n) pin;
+- metrics registry mechanics (counter/gauge/histogram, streaming
+  quantiles from fixed log buckets, snapshot/exposition round-trip);
+- the recorder tap: traced sites populate metrics with zero new call
+  sites, including the live ``trace_dropped_events`` counter;
+- exporter golden contract: scrape ``/metrics``, parse every line,
+  TYPE/HELP well-formedness, monotone counters across steps,
+  ``/healthz`` and ``/trace/tail``;
+- hang watchdog: a deliberately stalled fake collective produces a
+  dump naming the op; a healthy beating run does NOT fire;
+- the STRUCTURAL guarantee extended to the FULL plane: recorder tap +
+  metrics + exporter + flight markers active produce an identical
+  traced program (tests/test_trace.py pattern);
+- trace_report's loud warning on a lossy (dropped-events) trace.
+"""
+
+import json
+import os
+import re
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu import create_communicator
+from chainermn_tpu.observability import exporter, flight, metrics, trace
+from chainermn_tpu.observability.stats import (
+    nearest_rank,
+    nearest_rank_index,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_plane():
+    """Every test starts and ends with the whole plane torn down."""
+    trace.disable()
+    metrics.reset()
+    flight.reset()
+    exporter.stop()
+    yield
+    trace.disable()
+    metrics.reset()
+    flight.reset()
+    exporter.stop()
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+def _scrape(port, path="/metrics"):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.read().decode()
+
+
+# ----------------------------------------------------------------------
+# stats: the shared nearest-rank rule
+# ----------------------------------------------------------------------
+
+
+def test_nearest_rank_pins_ceil_rule():
+    """The ceil(q*n) 1-based-rank rule (ISSUE 6 satellite: ONE owner
+    for the serving rollup and the histogram quantiles)."""
+    vals = [40.0, 10.0, 30.0, 20.0]  # order-insensitive
+    assert nearest_rank(vals, 0.5) == 20.0   # ceil(0.5*4)=2 -> 2nd
+    assert nearest_rank(vals, 0.75) == 30.0  # ceil(3)=3 -> 3rd
+    assert nearest_rank(vals, 0.99) == 40.0  # ceil(3.96)=4 -> 4th
+    assert nearest_rank(vals, 0.0) == 10.0   # clamped to rank 1
+    assert nearest_rank([7.0], 0.99) == 7.0
+    assert nearest_rank([], 0.5) is None
+    assert nearest_rank_index(5, 0.5) == 2   # ceil(2.5)=3 -> index 2
+    with pytest.raises(ValueError):
+        nearest_rank_index(0, 0.5)
+
+
+def test_summarize_serving_uses_shared_rule():
+    """trace.summarize_serving's percentiles ARE the shared rule (the
+    dedup satellite: the local pct() closure is gone)."""
+    events = [
+        {"kind": "serving", "phase": "decode_step", "dur_s": d,
+         "tokens": 1, "n_active": 1, "n_slots": 2}
+        for d in (0.010, 0.020, 0.030, 0.040)
+    ]
+    s = trace.summarize_serving(events)
+    assert s["token_ms_p50"] == pytest.approx(20.0)
+    assert s["token_ms_p99"] == pytest.approx(40.0)
+
+
+# ----------------------------------------------------------------------
+# registry mechanics
+# ----------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("requests_total", "help text")
+    c.inc()
+    c.inc(2.0, op="a")  # distinct label set = independent series
+    assert c.value() == 1.0 and c.value(op="a") == 2.0
+    c.inc(1.0, op="a")
+    assert c.value(op="a") == 3.0
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+    g = reg.gauge("depth")
+    g.set(4)
+    g.inc(-1)
+    assert g.value() == 3.0
+    assert g.value(missing="x") is None
+    # same name, different kind -> loud failure, not silent sharing
+    with pytest.raises(ValueError):
+        reg.gauge("requests_total")
+
+    h = reg.histogram("lat_seconds", buckets=(0.001, 0.01, 0.1, 1.0))
+    for _ in range(3):
+        h.observe(0.0005)
+    h.observe(0.05)
+    assert h.count() == 4
+    # nearest-rank over cumulative counts, bucket UPPER bound reported
+    assert h.quantile(0.5) == 0.001   # rank 2 of 4 -> first bucket
+    assert h.quantile(0.99) == 0.1    # rank 4 -> the 0.05 sample's bucket
+    h.observe(50.0)  # overflow bucket
+    assert h.quantile(1.0) == float("inf")
+    assert h.quantile(0.5, other="label") is None  # unseen labels
+
+
+def test_log_buckets_fixed_ladder():
+    bs = metrics.log_buckets(1e-3, 1.0, per_decade=2)
+    assert bs[0] == pytest.approx(1e-3)
+    assert bs[-1] >= 1.0
+    assert all(b2 > b1 for b1, b2 in zip(bs, bs[1:]))
+    with pytest.raises(ValueError):
+        metrics.log_buckets(1.0, 0.1)
+
+
+def test_snapshot_and_exposition_roundtrip():
+    reg = metrics.MetricsRegistry()
+    reg.counter("c_total", "a counter").inc(5, op="x")
+    reg.gauge("g").set(2.5)
+    h = reg.histogram("h_seconds", "a histogram",
+                      buckets=(0.01, 0.1))
+    h.observe(0.005)
+    h.observe(42.0)
+    snap = reg.snapshot()
+    assert snap["c_total"]["type"] == "counter"
+    assert snap["c_total"]["values"][0] == {
+        "labels": {"op": "x"}, "value": 5.0
+    }
+    hrow = snap["h_seconds"]["values"][0]
+    assert hrow["count"] == 2
+    assert hrow["buckets"][-1] == ["+Inf", 2]
+    # inf quantiles sanitised for strict-JSON consumers
+    assert hrow["quantiles"]["p99"] is None
+    json.dumps(snap)  # JSON-able end to end
+
+    text = reg.exposition()
+    parsed = metrics.parse_exposition(text)
+    assert parsed[("c_total", (("op", "x"),))] == 5.0
+    assert parsed[("g", ())] == 2.5
+    assert parsed[("h_seconds_count", ())] == 2.0
+    assert parsed[("h_seconds_bucket", (("le", "+Inf"),))] == 2.0
+
+    # peer snapshots render with an added rank label
+    text2 = metrics.render_exposition(snap, extra_snapshots=[(1, snap)])
+    p2 = metrics.parse_exposition(text2)
+    assert p2[("c_total", (("op", "x"), ("rank", "1")))] == 5.0
+
+
+def test_label_escape_roundtrip():
+    """Escape-order pin: backslash+'n' in a label value must survive
+    render->parse (a sequential unescape chain turned its escaped form
+    into backslash+newline)."""
+    reg = metrics.MetricsRegistry()
+    hairy = 'back\\slash \\n quote" newline\n end'
+    reg.counter("c_total", "c").inc(3, path=hairy)
+    parsed = metrics.parse_exposition(reg.exposition())
+    assert parsed[("c_total", (("path", hairy),))] == 3.0
+
+
+# ----------------------------------------------------------------------
+# recorder tap: zero new call sites
+# ----------------------------------------------------------------------
+
+
+def test_tap_populates_from_traced_collectives(comm):
+    reg = metrics.install_tap()
+    trace.enable(None)
+    n = comm.size
+    comm.allreduce(jnp.ones((n, 4)))
+    c = reg.counter("wire_bytes_total")
+    assert c.value(op="allreduce", plane="device") == n * 4 * 4
+    assert reg.counter("wire_events_total").value(
+        op="allreduce", plane="device") == 1.0
+    assert reg.histogram("collective_seconds").count(
+        op="allreduce", plane="device") == 1
+
+    comm.bcast_obj({"meta": 1})
+    assert reg.counter("wire_events_total").value(
+        op="bcast_obj", plane="host") == 1.0
+
+
+def test_tap_serving_and_step_events():
+    reg = metrics.install_tap()
+    rec = trace.enable(None)
+    rec.event("step", iteration=7, phases={"compute": 0.01,
+                                           "data_wait": 0.002})
+    rec.event("serving", phase="prefill", dur_s=0.01, ttft_s=0.03)
+    rec.event("serving", phase="decode_step", dur_s=0.004, tokens=3,
+              n_active=3, n_slots=4)
+    rec.event("serving", phase="finish", dur_s=0.1)
+    rec.event("speculate", drafted=4, accepted=2, dur_s=0.002)
+    assert reg.counter("train_steps_total").value() == 1.0
+    assert reg.gauge("train_iteration").value() == 7.0
+    assert reg.histogram("step_phase_seconds").count(phase="compute") == 1
+    assert reg.counter("serving_tokens_total").value() == 4.0  # 1 + 3
+    assert reg.counter("serving_requests_total").value() == 1.0
+    assert reg.histogram("serving_ttft_seconds").count() == 1
+    assert reg.counter("speculate_drafted_total").value() == 4.0
+    assert reg.counter("speculate_accepted_total").value() == 2.0
+
+
+def test_trace_dropped_events_counter_is_live(monkeypatch):
+    """ISSUE 6 satellite: Recorder.dropped used to surface only in the
+    close() meta event — the collect hook exports it on every
+    snapshot/scrape while the run is still alive."""
+    monkeypatch.setattr(trace, "MAX_BUFFERED_EVENTS", 3)
+    reg = metrics.install_tap()
+    rec = trace.enable(None)
+    for i in range(6):
+        rec.event("step", iteration=i)
+    assert rec.dropped > 0
+    first = rec.dropped
+    snap = reg.snapshot()
+    assert snap["trace_dropped_events"]["values"][0]["value"] == first
+    assert snap["trace_buffered_events"]["values"][0]["value"] == 3
+    # ...and ACCUMULATES across recorder generations: a fresh recorder
+    # restarts its own `dropped` at 0 — a second lossy run must move
+    # the counter, not hide behind the first recorder's larger total.
+    trace.disable()
+    rec2 = trace.enable(None)
+    for i in range(4):
+        rec2.event("step", iteration=i)
+    assert 0 < rec2.dropped < first + rec2.dropped
+    snap2 = reg.snapshot()
+    assert snap2["trace_dropped_events"]["values"][0]["value"] == \
+        first + rec2.dropped
+
+
+def test_scheduler_direct_gauges_without_engine_events():
+    """Direct gauges (state planes with no events): a fake engine
+    drives the scheduler; queue depth / occupancy gauges move even
+    though this engine emits nothing itself."""
+    from chainermn_tpu.serving.scheduler import Request, Scheduler
+
+    class FakeEngine:
+        num_slots = 2
+        max_len = 64
+        spec_tokens = 0
+
+        def __init__(self):
+            self._active = {}
+            self._next = 0
+
+        @property
+        def n_active(self):
+            return len(self._active)
+
+        @property
+        def free_slot_count(self):
+            return self.num_slots - len(self._active)
+
+        def prefill_join(self, prompt):
+            if len(self._active) >= self.num_slots:
+                return None
+            slot = min(s for s in range(self.num_slots)
+                       if s not in self._active)
+            self._active[slot] = True
+            return slot, 1, 8
+
+        def decode_step(self):
+            return [2] * self.num_slots, 0.001
+
+        def leave(self, slot):
+            del self._active[slot]
+
+    reg = metrics.registry()
+    trace.enable(None)
+    metrics.install_tap()
+    sched = Scheduler(FakeEngine(), policy="prefill_priority")
+    for _ in range(3):
+        sched.submit(Request(prompt=[1, 2], max_new_tokens=2))
+    assert reg.gauge("serving_queue_depth").value() == 3.0
+    sched.run()
+    assert reg.gauge("serving_queue_depth").value() == 0.0
+    assert reg.gauge("serving_inflight").value() == 0.0
+    assert reg.gauge("serving_active_slots").value() == 0.0
+    assert reg.gauge("serving_slots").value() == 2.0
+    # the tap saw the scheduler's own phase events too
+    assert reg.counter("serving_requests_total").value() == 3.0
+
+
+def test_trainer_beat_and_iteration_gauge(comm):
+    from chainermn_tpu.training.trainer import Trainer
+
+    def step_fn(state, batch):
+        return state + 1, {"loss": jnp.float32(1.0)}
+
+    data = [[(np.zeros((2,), np.float32), np.int32(0))] for _ in range(3)]
+
+    class It:
+        def __iter__(self):
+            return iter(data)
+
+    reg = metrics.registry()
+    beats = []
+    tr = Trainer(step_fn, jnp.float32(0), It(), comm, log_interval=10,
+                 out=open(os.devnull, "w"))
+    tr.extend(lambda t: beats.append(flight.last_beat()))
+    tr.run(3)
+    assert reg.gauge("train_iteration").value() == 3.0
+    # beats landed during the run (one per step, carrying the iteration)...
+    assert [b["step"] for b in beats if b is not None] == [1, 2, 3]
+    # ...and run() quiesced on return: the finished loop's stale beat
+    # must not read as a hang to the watchdog.
+    assert flight.last_beat() is None
+    assert flight.progress_age() is None
+
+
+# ----------------------------------------------------------------------
+# exporter golden contract
+# ----------------------------------------------------------------------
+
+
+def test_exporter_metrics_contract():
+    reg = metrics.install_tap()
+    rec = trace.enable(None)
+    rec.collective("allreduce", nbytes=256, dur_s=0.002)
+    rec.event("step", iteration=1, phases={"compute": 0.01})
+    exp = exporter.start(port=0, registry=reg)
+    try:
+        body1 = _scrape(exp.port)
+        # every line parses (parse_exposition raises on malformed) ...
+        parsed1 = metrics.parse_exposition(body1)
+        assert parsed1
+        # ... and every sample's family carries a TYPE declaration
+        # BEFORE its first sample, with a legal kind
+        seen_types = {}
+        for line in body1.splitlines():
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                _, _, name, kind = line.split(" ", 3)
+                assert kind in ("counter", "gauge", "histogram"), line
+                seen_types[name] = kind
+            elif line.startswith("# HELP "):
+                assert line.split(" ", 3)[3]  # non-empty help text
+            elif not line.startswith("#"):
+                name = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)", line)[1]
+                family = re.sub(r"_(bucket|sum|count)$", "", name)
+                assert name in seen_types or family in seen_types, line
+        # histogram internal consistency: cumulative buckets end at
+        # _count, and the +Inf bucket equals it
+        cs = "collective_seconds"
+        labels = (("op", "allreduce"), ("plane", "device"))
+        count = parsed1[(cs + "_count", labels)]
+        inf_key = tuple(sorted(labels + (("le", "+Inf"),)))
+        assert parsed1[(cs + "_bucket", inf_key)] == count == 1.0
+        # monotone counters across two steps
+        rec.collective("allreduce", nbytes=256, dur_s=0.002)
+        rec.event("step", iteration=2, phases={"compute": 0.01})
+        parsed2 = metrics.parse_exposition(_scrape(exp.port))
+        key = ("wire_bytes_total", labels)
+        assert parsed2[key] == parsed1[key] + 256
+        assert parsed2[("train_steps_total", ())] == 2.0
+    finally:
+        exp.close()
+
+
+def test_exporter_healthz_and_trace_tail():
+    reg = metrics.registry()
+    rec = trace.enable(None)
+    flight.beat(41)
+    for i in range(7):
+        rec.event("step", iteration=i)
+    exp = exporter.start(port=0, registry=reg)
+    try:
+        health = json.loads(_scrape(exp.port, "/healthz"))
+        assert health["ok"] is True
+        assert health["rank"] == 0 and health["pid"] == os.getpid()
+        assert health["step"] == 41
+        assert health["last_beat_age_s"] >= 0
+        assert health["last_event_age_s"] >= 0
+        tail = json.loads(_scrape(exp.port, "/trace/tail?n=3"))
+        assert len(tail) == 3
+        assert [e["iteration"] for e in tail] == [4, 5, 6]
+        with pytest.raises(urllib.error.HTTPError):
+            _scrape(exp.port, "/nope")
+    finally:
+        exp.close()
+
+
+def test_exporter_env_gate(monkeypatch):
+    """Port env contract: unset -> None (and never re-probed); '0' ->
+    ephemeral port with the tap installed."""
+    monkeypatch.delenv("CHAINERMN_TPU_METRICS_PORT", raising=False)
+    exporter.stop()
+    assert exporter.maybe_start_from_env() is None
+    exporter.stop()
+    monkeypatch.setenv("CHAINERMN_TPU_METRICS_PORT", "0")
+    exp = exporter.maybe_start_from_env()
+    try:
+        assert exp is not None and exp.port > 0
+        assert exporter.maybe_start_from_env() is exp  # idempotent
+        # the autostart installed the tap: a traced event reaches the
+        # endpoint with no further setup
+        rec = trace.enable(None)
+        rec.collective("bcast", nbytes=64, dur_s=0.001)
+        parsed = metrics.parse_exposition(_scrape(exp.port))
+        assert parsed[("wire_bytes_total",
+                       (("op", "bcast"), ("plane", "device")))] == 64.0
+    finally:
+        exporter.stop()
+
+
+def test_exporter_peer_merge_single_process(comm):
+    reg = metrics.registry()
+    reg.counter("c_total").inc()
+    exp = exporter.start(port=0, registry=reg)
+    try:
+        # collective form: on a single process there are no peers
+        assert exp.merge_peer_snapshots(comm) == 0
+        assert json.loads(_scrape(exp.port, "/healthz"))[
+            "peer_snapshots"] == 0
+    finally:
+        exp.close()
+
+
+# ----------------------------------------------------------------------
+# hang watchdog
+# ----------------------------------------------------------------------
+
+
+def test_watchdog_dumps_on_stalled_collective(tmp_path):
+    flight.collective_entered("allreduce", nbytes=4096,
+                              axes=["inter", "intra"], plane="device")
+    wd = flight.HangWatchdog(stall_s=0.2, out_dir=str(tmp_path),
+                             poll_s=0.05)
+    wd.start()
+    deadline = time.time() + 5
+    while wd.dump_path is None and time.time() < deadline:
+        time.sleep(0.02)
+    wd.join(timeout=2)
+    assert wd.dump_path, "watchdog never fired on a stalled collective"
+    dump = json.load(open(wd.dump_path))
+    assert os.path.basename(wd.dump_path) == "hang_dump_0.json"
+    assert dump["schema"] == flight.HANG_DUMP_SCHEMA
+    assert dump["in_flight"]["op"] == "allreduce"
+    assert dump["in_flight"]["nbytes"] == 4096
+    assert dump["in_flight"]["age_s"] >= 0.2
+    # all-thread stacks present and non-trivial
+    assert dump["threads"]
+    assert any("test_metrics" in "".join(frames) or frames
+               for frames in dump["threads"].values())
+    flight.collective_exited()
+
+
+def test_watchdog_silent_on_healthy_run(tmp_path):
+    wd = flight.HangWatchdog(stall_s=0.3, out_dir=str(tmp_path),
+                             poll_s=0.05)
+    wd.start()
+    # steady beats + completing collectives: progress never ages out
+    for i in range(12):
+        flight.beat(i)
+        flight.collective_entered("allreduce")
+        flight.collective_exited()
+        time.sleep(0.05)
+    wd.stop()
+    wd.join(timeout=2)
+    assert wd.dump_path is None
+    assert not list(tmp_path.glob("hang_dump_*.json"))
+
+
+def test_inflight_marker_nests():
+    """Composite collectives nest (bcast runs a host bcast_obj inside
+    it; allreduce_grad a per-leaf allreduce): the inner exit must not
+    clear the outer marker — a wedge AFTER the inner leg still names
+    the outer op."""
+    flight.collective_entered("bcast", nbytes=64)
+    flight.collective_entered("bcast_obj", plane="host")
+    assert flight.in_flight()["op"] == "bcast_obj"  # innermost named
+    assert [e["op"] for e in flight.in_flight_stack()] == [
+        "bcast", "bcast_obj"]
+    flight.collective_exited()
+    got = flight.in_flight()
+    assert got is not None and got["op"] == "bcast", \
+        "inner exit cleared the outer marker"
+    flight.collective_exited()
+    assert flight.in_flight() is None
+    flight.collective_exited()  # unbalanced exit: tolerated, no raise
+    assert flight.in_flight() is None
+
+
+def test_inflight_marker_exception_safe(comm):
+    """A collective that RAISES must not leak its marker: the caller
+    may catch and carry on healthy, and a phantom in-flight entry would
+    spend the fire-once watchdog's single dump on a non-hang (review
+    finding). Every ``_mark`` site is a context manager that balances
+    on the raise."""
+    x = jnp.arange(comm.size * 2, dtype=jnp.float32).reshape(comm.size, 2)
+    comm.allreduce(x)  # prime: healthy path clears
+    assert flight.in_flight() is None
+    with pytest.raises(KeyError):
+        comm.allreduce(x, op="nope")  # raises inside the marked region
+    assert flight.in_flight() is None, "allreduce leaked its marker"
+    # recv's recoverable kind-mismatch branch balances through the same
+    # context (a well-formed non-ndarray message on the channel):
+    comm.send_obj(("pickle", False, [], []), comm.rank, tag=77)
+    with pytest.raises(RuntimeError, match="expected an ndarray"):
+        comm.recv(comm.rank, tag=77)
+    assert flight.in_flight() is None, "recv leaked its marker"
+    # and the channel still works after the recovered error:
+    comm.send(np.ones(3, np.float32), comm.rank, tag=78)
+    np.testing.assert_array_equal(
+        comm.recv(comm.rank, tag=78), np.ones(3, np.float32)
+    )
+    assert flight.in_flight() is None
+
+
+def test_watchdog_silent_after_quiesce(tmp_path):
+    """A loop that ENDED (Trainer.run returned, scheduler drained)
+    calls quiesce(): the stale last-beat must not read as a hang, but
+    a collective still in flight past the threshold must."""
+    flight.beat(7)
+    flight.quiesce()
+    wd = flight.HangWatchdog(stall_s=0.1, out_dir=str(tmp_path),
+                             poll_s=0.03)
+    wd.start()
+    time.sleep(0.3)
+    assert wd.dump_path is None, "quiesced process must not dump"
+    assert not list(tmp_path.glob("hang_dump_*.json"))
+    # the in-flight rule is independent of beats: still fires
+    flight.collective_entered("allgather", nbytes=128)
+    deadline = time.time() + 5
+    while wd.dump_path is None and time.time() < deadline:
+        time.sleep(0.02)
+    wd.join(timeout=2)
+    flight.collective_exited()
+    assert wd.dump_path, "in-flight rule must survive quiesce"
+    assert json.load(open(wd.dump_path))["in_flight"]["op"] == "allgather"
+
+
+def test_collective_after_quiesce_does_not_rearm(tmp_path):
+    """A one-off collective in an intentionally idle process (post-run
+    weight refresh, a peer-snapshot merge) completes and the process
+    goes back to waiting: its exit must not re-arm the no-progress
+    rule — the fire-once watchdog would spend its single dump on a
+    healthy idle and miss the real hang hours later (review finding)."""
+    flight.beat(3)
+    flight.quiesce()
+    flight.collective_exited(
+        flight.collective_entered("bcast_obj", plane="host")
+    )
+    assert flight.progress_age() is None, \
+        "collective exit re-armed a quiesced progress chain"
+    wd = flight.HangWatchdog(stall_s=0.1, out_dir=str(tmp_path),
+                             poll_s=0.03)
+    wd.start()
+    time.sleep(0.3)
+    wd.stop()
+    wd.join(timeout=2)
+    assert wd.dump_path is None, "idle process dumped after a one-off"
+    assert not list(tmp_path.glob("hang_dump_*.json"))
+
+
+def test_inflight_markers_are_per_thread():
+    """Concurrent collectives (the async double-buffered host reducer
+    completes the previous step's exchange on a background thread while
+    the main thread marks its own): each thread's exit removes its OWN
+    marker — one shared stack would pop whichever entry was pushed
+    last, and the dump would name the wrong op (review finding)."""
+    import threading
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def bg():
+        tok = flight.collective_entered("allgather_obj", plane="host")
+        entered.set()
+        release.wait(5)
+        flight.collective_exited(tok)
+
+    th = threading.Thread(target=bg, name="async-host-reducer")
+    th.start()
+    assert entered.wait(5)
+    main_tok = flight.collective_entered("allreduce", nbytes=256)
+    assert {e["op"] for e in flight.in_flight_stack()} == {
+        "allgather_obj", "allreduce"}
+    # Background thread finishes FIRST while the main thread's marker
+    # is globally newest: it must remove its own entry, not main's.
+    release.set()
+    th.join(5)
+    got = flight.in_flight()
+    assert got is not None and got["op"] == "allreduce", \
+        "background exit popped the main thread's marker"
+    assert [e["op"] for e in flight.in_flight_stack()] == ["allreduce"]
+    flight.collective_exited(main_tok)
+    assert flight.in_flight() is None
+
+
+def test_marker_exit_idempotent_by_token():
+    """Sync-mode ``_wire_event`` can raise AFTER its collective's
+    marker was already removed; the enclosing ``finally`` then exits
+    again with the same token — the second exit must be a no-op, never
+    popping an ENCLOSING composite's marker (review finding)."""
+    outer = flight.collective_entered("allreduce_grad")
+    inner = flight.collective_entered("allreduce")
+    flight.collective_exited(inner)
+    flight.collective_exited(inner)  # double exit: idempotent
+    got = flight.in_flight()
+    assert got is not None and got["op"] == "allreduce_grad", \
+        "double inner exit popped the outer marker"
+    flight.collective_exited(outer)
+    assert flight.in_flight() is None
+
+
+def test_watchdog_ignores_idle_process(tmp_path):
+    """A process that never trained and never entered a collective must
+    not dump on mere existence."""
+    wd = flight.HangWatchdog(stall_s=0.1, out_dir=str(tmp_path),
+                             poll_s=0.03)
+    wd.start()
+    time.sleep(0.3)
+    wd.stop()
+    wd.join(timeout=2)
+    assert wd.dump_path is None
+
+
+def test_watchdog_env_gate(monkeypatch, tmp_path):
+    monkeypatch.delenv("CHAINERMN_TPU_HANG_DUMP_S", raising=False)
+    assert flight.maybe_start_from_env() is None
+    monkeypatch.setenv("CHAINERMN_TPU_HANG_DUMP_S", "120")
+    monkeypatch.setenv("CHAINERMN_TPU_HANG_DUMP_DIR", str(tmp_path))
+    wd = flight.maybe_start_from_env()
+    try:
+        assert wd is not None and wd.stall_s == 120.0
+        assert wd.out_dir == str(tmp_path)
+        assert flight.maybe_start_from_env() is wd  # idempotent
+    finally:
+        flight.stop_watchdog()
+    with pytest.raises(ValueError):
+        flight.HangWatchdog(stall_s=0)
+
+
+def test_flight_ring_follows_recorder():
+    rec = trace.enable(None)
+    for i in range(5):
+        rec.event("step", iteration=i)
+    t = flight.tail(3)
+    assert [e["iteration"] for e in t] == [2, 3, 4]
+    assert flight.tail(0) == []
+
+
+# ----------------------------------------------------------------------
+# structural: the FULL plane adds zero device-plane collectives
+# ----------------------------------------------------------------------
+
+
+def _two_dim_comm():
+    from jax.sharding import Mesh
+
+    from chainermn_tpu.communicators.xla_communicator import (
+        TwoDimensionalCommunicator,
+    )
+
+    devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    return TwoDimensionalCommunicator(mesh=Mesh(devs, ("inter", "intra")))
+
+
+def test_full_plane_adds_zero_device_collectives():
+    """ISSUE 6 acceptance: recorder tap + metrics + live exporter +
+    flight markers all active produce an IDENTICAL traced program to
+    everything-off — the whole plane is host-side (the test_trace.py
+    certificate, extended)."""
+    from chainermn_tpu.testing import count_primitives
+
+    comm = _two_dim_comm()
+    tree = {"w": jnp.zeros((64, 32)), "b": jnp.zeros((32,))}
+    env = [("inter", 2), ("intra", 4)]
+
+    def counts():
+        return count_primitives(
+            lambda t: comm.reduce_gradients_in_jit(
+                t, compress_dtype=jnp.bfloat16
+            ),
+            tree, axis_env=env,
+        )
+
+    off = counts()
+    reg = metrics.install_tap()
+    trace.enable(None)
+    exp = exporter.start(port=0, registry=reg)
+    try:
+        on = counts()
+        _scrape(exp.port)  # a live scrape mid-compile changes nothing
+        on2 = counts()
+    finally:
+        exp.close()
+    assert on == off
+    assert on2 == off
+    # not vacuous: the reduction pipeline is in there
+    assert on.get("reduce_scatter") == 1
+    assert on.get("psum") == 1
+    assert on.get("all_gather") == 1
+
+
+def test_eager_collective_numerics_with_plane_on(comm):
+    """Values unchanged with the full plane enabled, and the flight
+    marker is cleared after every eager collective."""
+    reg = metrics.install_tap()
+    trace.enable(None)
+    exp = exporter.start(port=0, registry=reg)
+    try:
+        rs = np.random.RandomState(0)
+        stacked = jnp.asarray(rs.randn(comm.size, 3, 2), jnp.float32)
+        out = comm.allreduce(stacked)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(stacked).sum(0),
+            rtol=1e-6, atol=1e-6,
+        )
+        assert flight.in_flight() is None
+        assert reg.counter("wire_events_total").value(
+            op="allreduce", plane="device") == 1.0
+    finally:
+        exp.close()
+
+
+# ----------------------------------------------------------------------
+# trace_report: loud on lossy traces
+# ----------------------------------------------------------------------
+
+
+def _report_mod():
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "trace_report.py",
+    )
+    spec = importlib.util.spec_from_file_location("_trace_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_warns_on_dropped_events(tmp_path):
+    """ISSUE 6 satellite: a summarized file carrying dropped_events
+    meta events (recorder overflow at close()) produces a LOUD warning
+    — previously silently ignored."""
+    tr = _report_mod()
+    events = [
+        {"schema": 1, "kind": "meta", "t": 1.0, "pid": 1, "rank": 0},
+        {"schema": 1, "kind": "collective", "t": 2.0, "pid": 1,
+         "rank": 0, "op": "allreduce", "plane": "device", "nbytes": 64,
+         "dur_s": 0.001},
+        {"schema": 1, "kind": "meta", "t": 3.0, "pid": 1, "rank": 0,
+         "dropped_events": 17},
+        {"schema": 1, "kind": "meta", "t": 3.0, "pid": 2, "rank": 1,
+         "dropped_events": 5},
+    ]
+    s = tr.summarize(events)
+    assert s["meta"]["dropped_events"] == 22  # accumulates per recorder
+    text = tr.render_text(s)
+    assert "WARNING" in text and "22" in text
+    assert text.index("WARNING") < text.index("trace:")  # loud = first
+
+    # clean trace: no warning
+    s2 = tr.summarize(events[:2])
+    assert "dropped_events" not in s2["meta"]
+    assert "WARNING" not in tr.render_text(s2)
+
+
+def test_metrics_dump_formats_saved_scrape(tmp_path, capsys):
+    """tools/metrics_dump.py offline mode: format a saved exposition
+    without any endpoint (and without importing jax)."""
+    import importlib.util
+
+    reg = metrics.MetricsRegistry()
+    reg.counter("wire_bytes_total", "bytes").inc(512, op="allreduce")
+    reg.histogram("serving_ttft_seconds", "ttft",
+                  buckets=(0.01, 0.1)).observe(0.05)
+    prom = tmp_path / "saved.prom"
+    prom.write_text(reg.exposition())
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "metrics_dump.py",
+    )
+    spec = importlib.util.spec_from_file_location("_metrics_dump", path)
+    md = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(md)
+
+    assert md.main([str(prom)]) == 0
+    out = capsys.readouterr().out
+    assert "wire_bytes_total" in out and "512" in out
+    assert "serving_ttft_seconds" in out and "n=1" in out
+    # unreachable endpoint -> exit 1, quiet enough for the capture gate
+    assert md.main(["--port", "1", "--timeout", "0.2"]) == 1

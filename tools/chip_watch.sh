@@ -37,6 +37,15 @@ max_captures=6
 while [ "$(date +%s)" -lt "$deadline" ]; do
   python tools/probe_tpu.py 180 > /dev/null 2>&1
   rc=$?
+  # Live-telemetry heartbeat (ISSUE 6): when a metrics endpoint is
+  # exported, append one /healthz line per poll — a stalled run's
+  # last_beat_age then shows up in the watch trail even if the capture
+  # never fires. Quiet + cheap: 2 s fetch timeout, failures dropped.
+  if [ -n "${CHAINERMN_TPU_METRICS_PORT:-}" ] \
+      && [ "${CHAINERMN_TPU_METRICS_PORT}" != "0" ]; then
+    timeout 15 python tools/metrics_dump.py --health \
+      >> tools/capture_logs/healthz_watch.jsonl 2>/dev/null || true
+  fi
   if [ "$rc" -eq 0 ]; then
     # A capture is COMPLETE once a LIVE bench and BOTH sweeps have
     # landed in THIS watch run (the 2026-08-01 wedge: stage 1 landed,

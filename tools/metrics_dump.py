@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Snapshot/format a live chainermn_tpu metrics endpoint (ISSUE 6).
+
+Usage::
+
+    python tools/metrics_dump.py                 # scrape + format table
+    python tools/metrics_dump.py --port 9100     # explicit port
+    python tools/metrics_dump.py --raw           # verbatim exposition
+    python tools/metrics_dump.py --json          # parsed, one JSON line
+    python tools/metrics_dump.py --health        # /healthz, one JSON line
+    python tools/metrics_dump.py saved.prom      # format a saved scrape
+
+The port defaults to ``CHAINERMN_TPU_METRICS_PORT`` (the exporter's env
+contract; per-rank endpoints live at port+rank — pass ``--port``
+explicitly for a non-zero rank). Exit code 1 when the endpoint is
+unreachable — the capture scripts lean on that to make a down endpoint
+cost nothing.
+
+Like ``tools/trace_report.py``, the metrics module is loaded by FILE
+PATH: one owner of the exposition parser, without paying for
+``import chainermn_tpu`` (which pulls jax) in a snapshot tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _metrics_mod():
+    import importlib.util
+
+    path = os.path.join(
+        _HERE, "chainermn_tpu", "observability", "metrics.py"
+    )
+    spec = importlib.util.spec_from_file_location("_obs_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fetch(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def render_table(parsed: dict) -> str:
+    """Parsed exposition -> human table: histograms collapse to
+    count/sum per label set (the quantiles live server-side in the
+    snapshot; the exposition carries buckets), everything else one row
+    per series, sorted."""
+    lines = []
+    hist: dict = {}
+    plain: list = []
+    for (name, labels), value in sorted(parsed.items()):
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = (name[: -len(suffix)], suffix)
+                break
+        if base is not None:
+            root, suffix = base
+            key_labels = tuple(kv for kv in labels if kv[0] != "le")
+            row = hist.setdefault((root, key_labels),
+                                  {"count": 0, "sum": 0.0})
+            if suffix == "_count":
+                row["count"] = int(value)
+            elif suffix == "_sum":
+                row["sum"] = value
+        else:
+            plain.append((name, labels, value))
+    for name, labels, value in plain:
+        lab = ",".join(f"{k}={v}" for k, v in labels)
+        lines.append(f"{name:<34} {lab:<40} {value:g}")
+    for (root, labels), row in sorted(hist.items()):
+        lab = ",".join(f"{k}={v}" for k, v in labels)
+        mean = row["sum"] / row["count"] * 1e3 if row["count"] else 0.0
+        lines.append(
+            f"{root:<34} {lab:<40} n={row['count']} "
+            f"mean={mean:.3f} ms"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Snapshot/format a live chainermn_tpu /metrics "
+                    "endpoint"
+    )
+    ap.add_argument("file", nargs="?",
+                    help="saved exposition file to format offline "
+                         "(skips the HTTP fetch)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="endpoint port (default: "
+                         "$CHAINERMN_TPU_METRICS_PORT)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    ap.add_argument("--raw", action="store_true",
+                    help="print the exposition verbatim")
+    ap.add_argument("--json", action="store_true",
+                    help="parsed series as one JSON object")
+    ap.add_argument("--health", action="store_true",
+                    help="fetch /healthz instead of /metrics")
+    args = ap.parse_args(argv)
+
+    if args.file:
+        try:
+            text = open(args.file).read()
+        except OSError as e:
+            print(f"metrics_dump: {e}", file=sys.stderr)
+            return 1
+    else:
+        port = args.port
+        if port is None:
+            v = os.environ.get("CHAINERMN_TPU_METRICS_PORT")
+            if not v:
+                print("metrics_dump: no --port and "
+                      "CHAINERMN_TPU_METRICS_PORT unset", file=sys.stderr)
+                return 1
+            try:
+                port = int(v)
+            except ValueError:
+                print(f"metrics_dump: bad port {v!r}", file=sys.stderr)
+                return 1
+        path = "/healthz" if args.health else "/metrics"
+        url = f"http://{args.host}:{port}{path}"
+        try:
+            text = _fetch(url, args.timeout)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"metrics_dump: {url} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+
+    if args.health:
+        # already JSON from the endpoint; normalise to one line
+        try:
+            print(json.dumps(json.loads(text), sort_keys=True))
+        except json.JSONDecodeError:
+            print(text.strip())
+        return 0
+    if args.raw:
+        sys.stdout.write(text)
+        return 0
+    parsed = _metrics_mod().parse_exposition(text)
+    if args.json:
+        print(json.dumps(
+            {f"{name}{dict(labels) or ''}": v
+             for (name, labels), v in sorted(parsed.items())},
+            sort_keys=True, default=str,
+        ))
+    else:
+        print(render_table(parsed))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

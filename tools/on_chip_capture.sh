@@ -41,6 +41,22 @@ else
   else
     echo "[capture] no trace emitted (bench wrote no events)"
   fi
+  # Live-telemetry snapshot (ISSUE 6): when a long-running process on
+  # this host exposes /metrics (CHAINERMN_TPU_METRICS_PORT), archive one
+  # scrape + health probe beside the bench log. 2 s fetch timeout inside
+  # metrics_dump: a down endpoint costs nothing and fails quietly.
+  if [ -n "${CHAINERMN_TPU_METRICS_PORT:-}" ] \
+      && [ "${CHAINERMN_TPU_METRICS_PORT}" != "0" ]; then
+    if timeout 30 python tools/metrics_dump.py --raw \
+        > "tools/capture_logs/metrics_$stamp.prom" 2>/dev/null; then
+      timeout 30 python tools/metrics_dump.py --health \
+        > "tools/capture_logs/healthz_$stamp.json" 2>/dev/null
+      echo "[capture] metrics snapshot: metrics_$stamp.prom + healthz"
+    else
+      rm -f "tools/capture_logs/metrics_$stamp.prom"
+      echo "[capture] metrics endpoint down (port ${CHAINERMN_TPU_METRICS_PORT}) — skipped"
+    fi
+  fi
 fi
 
 if _fresh 'byte_audit_tf_2*.json' '"flops":' \

@@ -114,6 +114,15 @@ def summarize(events: list[dict]) -> dict:
             for k in ("started_at", "sync", "source", "mode"):
                 if k in ev and k not in meta:
                     meta[k] = ev[k]
+            # dropped_events ACCUMULATES (one close() meta per recorder;
+            # a multi-process trace file carries several) — a summary
+            # over a lossy trace must say so loudly, not silently
+            # under-count (ISSUE 6 satellite; previously ignored).
+            if ev.get("dropped_events"):
+                meta["dropped_events"] = (
+                    meta.get("dropped_events", 0)
+                    + int(ev["dropped_events"])
+                )
             continue
         if kind == "collective":
             key = (ev.get("op", "?"), ev.get("plane", "?"))
@@ -250,6 +259,14 @@ def _fmt_bytes(n: int) -> str:
 
 def render_text(s: dict) -> str:
     lines = []
+    dropped = s["meta"].get("dropped_events")
+    if dropped:
+        lines.append(
+            f"*** WARNING: the recorder DROPPED {dropped} event(s) "
+            f"(in-memory buffer overflow) — every count below "
+            f"undercounts; raise MAX_BUFFERED_EVENTS or shorten the "
+            f"capture ***"
+        )
     lines.append(
         f"trace: {s['n_events']} events, schema {s['schema_versions']}, "
         f"sync={s['meta'].get('sync', False)}"
@@ -400,6 +417,15 @@ def main(argv=None) -> int:
 
     events = _read_events(args.trace)
     summary = summarize(events)
+    # Loud on stderr too, so --json pipelines (and humans paging the
+    # table) cannot miss a lossy trace.
+    if summary["meta"].get("dropped_events"):
+        print(
+            f"WARNING: trace dropped "
+            f"{summary['meta']['dropped_events']} event(s) — summary "
+            f"undercounts",
+            file=sys.stderr,
+        )
     if args.chrome:
         with open(args.chrome, "w") as f:
             json.dump(_trace_mod().chrome_trace(events), f)
