@@ -597,6 +597,25 @@ def _tap_event(ev: Mapping[str, Any]) -> None:
         reg.counter("speculate_accepted_total",
                     "speculative tokens accepted").inc(
             float(ev.get("accepted") or 0))
+    elif kind == "prefix_cache":
+        reg.counter("kv_prefix_lookups_total",
+                    "prefix-trie lookups at admission").inc()
+        if int(ev.get("hit_blocks") or 0) > 0:
+            reg.counter("kv_prefix_hits_total",
+                        "admissions that adopted cached blocks").inc()
+        reg.counter(
+            "kv_prefix_hit_tokens_total",
+            "prompt tokens served from the prefix cache (not "
+            "re-prefilled)",
+        ).inc(float(ev.get("hit_tokens") or 0))
+        reg.counter(
+            "kv_prefix_prefill_tokens_total",
+            "prompt tokens actually prefilled (the unshared tails)",
+        ).inc(float(ev.get("prefill_tokens") or 0))
+        cow = float(ev.get("cow_blocks") or 0)
+        if cow:
+            reg.counter("kv_prefix_cow_blocks_total",
+                        "copy-on-write block copies").inc(cow)
     elif kind == "straggler":
         reg.counter("straggler_reports_total",
                     "straggler-monitor flag reports").inc()

@@ -25,8 +25,9 @@ per step, per process. Three properties are load-bearing:
   ``profile_stop``, ``wire`` / ``overlap_config`` (ISSUE 3 per-bucket
   reduction telemetry), ``serving`` (ISSUE 4 queue_wait / prefill /
   decode_step / finish phases), ``speculate`` (ISSUE 5 per-tick
-  drafted/accepted counts). ``tools/trace_report.py`` summarizes a
-  JSONL file;
+  drafted/accepted counts), ``prefix_cache`` (ISSUE 7 per-admission
+  prompt/hit/prefilled token counts + COW copies).
+  ``tools/trace_report.py`` summarizes a JSONL file;
   :func:`chrome_trace` converts to the ``chrome://tracing`` / Perfetto
   format.
 
@@ -493,7 +494,13 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
     - ``speculation`` (present only when ``speculate`` events exist) =
       drafted/accepted token totals, ``accept_rate`` = accepted /
       drafted, and ``accept_len_hist`` — accept-length counts keyed by
-      stringified length (JSON-stable), the trace_report histogram.
+      stringified length (JSON-stable), the trace_report histogram;
+    - ``prefix_cache`` (present only when ``prefix_cache`` events
+      exist, ISSUE 7) = admission lookups/hits, ``hit_rate`` = hits /
+      lookups, prompt vs prefilled vs cache-served token totals
+      (``prefilled_tokens`` is the MEASURED prefill work — the bench
+      acceptance reads it, not prose), ``hit_token_rate`` = hit tokens
+      / prompt tokens, and total ``cow_blocks`` copied.
 
     Returns None when the trace carries no serving events."""
     queue_waits: list[float] = []
@@ -507,6 +514,8 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
     spec_drafted = 0
     spec_accepted = 0
     accept_hist: dict = {}
+    px_lookups = px_hits = 0
+    px_hit_tokens = px_prompt_tokens = px_prefill_tokens = px_cow = 0
     for ev in events:
         kind = ev.get("kind")
         if kind == "speculate":
@@ -516,6 +525,15 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
             for a in (ev.get("accept_lens") or ()):
                 k = str(int(a))
                 accept_hist[k] = accept_hist.get(k, 0) + 1
+            continue
+        if kind == "prefix_cache":
+            px_lookups += 1
+            if int(ev.get("hit_blocks") or 0) > 0:
+                px_hits += 1
+            px_hit_tokens += int(ev.get("hit_tokens") or 0)
+            px_prompt_tokens += int(ev.get("prompt_tokens") or 0)
+            px_prefill_tokens += int(ev.get("prefill_tokens") or 0)
+            px_cow += int(ev.get("cow_blocks") or 0)
             continue
         if kind != "serving":
             continue
@@ -536,7 +554,8 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
                                  / float(n_slots))
         elif phase == "finish":
             finishes += 1
-    if not (queue_waits or prefills or steps or finishes or spec_ticks):
+    if not (queue_waits or prefills or steps or finishes or spec_ticks
+            or px_lookups):
         return None
 
     pct = nearest_rank  # the shared ceil(q*n) rule (observability.stats)
@@ -577,6 +596,18 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
                 k: accept_hist[k]
                 for k in sorted(accept_hist, key=int)
             },
+        }
+    if px_lookups:
+        out["prefix_cache"] = {
+            "lookups": px_lookups,
+            "hits": px_hits,
+            "hit_rate": round(px_hits / px_lookups, 4),
+            "prompt_tokens": px_prompt_tokens,
+            "hit_tokens": px_hit_tokens,
+            "prefilled_tokens": px_prefill_tokens,
+            "hit_token_rate": (round(px_hit_tokens / px_prompt_tokens, 4)
+                               if px_prompt_tokens else None),
+            "cow_blocks": px_cow,
         }
     return out
 

@@ -29,6 +29,7 @@ serving suite pins structurally on the tensor-parallel decode program.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -65,6 +66,24 @@ def paged_update(pool, block_tables, positions, new):
     return pool.at[phys.reshape(-1), offset.reshape(-1)].set(
         new.reshape(B * T, *new.shape[2:])
     )
+
+
+def copy_block(pool, src, dst):
+    """Copy one physical block: ``pool[dst] <- pool[src]`` along the
+    block axis (the copy-on-write primitive behind cross-request prefix
+    sharing, :mod:`chainermn_tpu.serving.kv_blocks`).
+
+    The block axis is addressed as ``ndim - 4`` (every pool leaf ends in
+    ``[num_blocks, block_size, kv_heads, head_dim]``), so the same call
+    serves the plain pool and the engine's tensor-parallel ``[shards,
+    num_blocks, ...]`` stacks — a leading-axis-wise copy introduces no
+    cross-shard traffic (zero collectives, like the scatter/gather).
+    ``src``/``dst`` are traced int32 scalars: one compiled program
+    copies any block pair.
+    """
+    axis = pool.ndim - 4
+    blk = jax.lax.dynamic_index_in_dim(pool, src, axis=axis, keepdims=True)
+    return jax.lax.dynamic_update_slice_in_dim(pool, blk, dst, axis=axis)
 
 
 def paged_lookup(pool, block_tables):

@@ -7,16 +7,21 @@ host-metadata rollback. See docs/serving.md for the engine contract."""
 from chainermn_tpu.serving.engine import (
     DECODE_IMPLS,
     KV_BLOCK_SIZES,
+    MIN_SHARED_BLOCKS,
+    PREFIX_CACHE,
     SPEC_TOKENS,
     ServingEngine,
     resolve_decode_impl,
     resolve_kv_block_size,
+    resolve_min_shared_blocks,
+    resolve_prefix_cache,
     resolve_spec_tokens,
     serving_decision_key,
     shard_lm_params,
 )
 from chainermn_tpu.serving.kv_blocks import (
     BlockAllocator,
+    PrefixCache,
     default_num_blocks,
     init_serving_cache,
 )
@@ -32,8 +37,11 @@ __all__ = [
     "Scheduler",
     "Request",
     "BlockAllocator",
+    "PrefixCache",
     "DECODE_IMPLS",
     "KV_BLOCK_SIZES",
+    "MIN_SHARED_BLOCKS",
+    "PREFIX_CACHE",
     "SPEC_TOKENS",
     "POLICIES",
     "ModelDrafter",
@@ -43,6 +51,8 @@ __all__ = [
     "init_serving_cache",
     "resolve_decode_impl",
     "resolve_kv_block_size",
+    "resolve_min_shared_blocks",
+    "resolve_prefix_cache",
     "resolve_spec_tokens",
     "serving_decision_key",
     "shard_lm_params",

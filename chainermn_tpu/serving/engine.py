@@ -48,6 +48,7 @@ import numpy as np
 from chainermn_tpu.datasets.bucketing import DEFAULT_BUCKETS, bucket_length
 from chainermn_tpu.serving.kv_blocks import (
     BlockAllocator,
+    PrefixCache,
     default_num_blocks,
     init_serving_cache,
 )
@@ -59,6 +60,14 @@ KV_BLOCK_SIZES = ("16", "32", "64", "128")
 #: (ISSUE 5): 0 = plain one-token decode; K > 0 = draft-and-verify with
 #: K drafted tokens per slot per tick.
 SPEC_TOKENS = ("0", "2", "4", "8")
+#: cross-request prefix sharing over the paged pool (ISSUE 7): the
+#: radix-trie block cache + copy-on-write; paged-only (dense rows are
+#: slot-private by layout).
+PREFIX_CACHE = ("off", "on")
+#: minimum matched FULL blocks before a trie hit is adopted — below it
+#: the join prefills from scratch (a one-block hit saves little prefill
+#: but still pays table/refcount churn and pins blocks in the cache).
+MIN_SHARED_BLOCKS = ("1", "2", "4")
 
 
 def serving_decision_key(d_model: int, num_heads: int, max_len: int,
@@ -102,6 +111,27 @@ def resolve_spec_tokens(d_model: int, num_heads: int, max_len: int) -> int:
 
     return int(tuning.choice(
         "spec_tokens", SPEC_TOKENS,
+        serving_decision_key(d_model, num_heads, max_len),
+    ))
+
+
+def resolve_prefix_cache(d_model: int, num_heads: int, max_len: int) -> str:
+    """Resolve ``prefix_cache`` ('off' | 'on') via the registry."""
+    from chainermn_tpu import tuning
+
+    return tuning.choice(
+        "prefix_cache", PREFIX_CACHE,
+        serving_decision_key(d_model, num_heads, max_len),
+    )
+
+
+def resolve_min_shared_blocks(d_model: int, num_heads: int,
+                              max_len: int) -> int:
+    """Resolve the trie-hit adoption threshold via the registry."""
+    from chainermn_tpu import tuning
+
+    return int(tuning.choice(
+        "min_shared_blocks", MIN_SHARED_BLOCKS,
         serving_decision_key(d_model, num_heads, max_len),
     ))
 
@@ -185,6 +215,18 @@ class ServingEngine:
       drafter: proposal source for ``spec_tokens > 0`` — any object with
         ``propose(history, k)`` (:mod:`chainermn_tpu.serving.speculate`;
         default :class:`~chainermn_tpu.serving.speculate.NgramDrafter`).
+      prefix_cache: cross-request prefix sharing (ISSUE 7): ``'on'``
+        keeps a block-granular radix trie over completed prefills so a
+        joining request adopts the already-filled blocks of its longest
+        matching full-block prefix and prefills only the unshared tail
+        (the TTFT lever under duplicate-prefix load). ``'auto'``
+        resolves through the registry (decision ``prefix_cache``);
+        paged-only — under ``decode_impl='dense'`` it is forced off.
+        Host metadata + one block-copy program only: the decode/verify
+        programs are untouched, and shared streams are bit-identical to
+        unshared ones (pinned in tests/test_prefix_cache.py).
+      min_shared_blocks: minimum matched FULL blocks before a trie hit
+        is adopted (decision ``min_shared_blocks`` under ``'auto'``).
     """
 
     def __init__(self, model, params, *, num_slots: int,
@@ -197,7 +239,8 @@ class ServingEngine:
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  rng=None, pad_id: int = 0, mesh=None,
-                 spec_tokens="auto", drafter=None) -> None:
+                 spec_tokens="auto", drafter=None,
+                 prefix_cache="auto", min_shared_blocks="auto") -> None:
         import jax
 
         from chainermn_tpu.models.transformer import TransformerLM
@@ -284,6 +327,65 @@ class ServingEngine:
             kv_block_size = int(kv_block_size) if kv_block_size != "auto" \
                 else 64
             self._alloc = None
+
+        # ---- prefix sharing (ISSUE 7): trie + COW over the paged pool.
+        # Dense rows are slot-private by layout — nothing to share, so
+        # the decision is forced off there without consulting the
+        # registry (an 'on' cache entry for a dense shape would be a
+        # lie about what ran). Validate BEFORE the dense force: a typo
+        # must raise identically whichever decode impl it rides with.
+        if prefix_cache != "auto" and prefix_cache not in PREFIX_CACHE:
+            raise ValueError(
+                f"prefix_cache must be one of {PREFIX_CACHE + ('auto',)}, "
+                f"got {prefix_cache!r}"
+            )
+        if self._alloc is None:
+            prefix_cache = "off"
+            self.decisions.append({"name": "prefix_cache", "key": key,
+                                   "winner": "off",
+                                   "source": "forced:dense"})
+        elif prefix_cache == "auto":
+            prefix_cache = resolve_prefix_cache(
+                model.d_model, model.num_heads, max_len
+            )
+            self._adopt_decision("prefix_cache", key)
+        else:
+            self.decisions.append({"name": "prefix_cache", "key": key,
+                                   "winner": prefix_cache,
+                                   "source": "explicit"})
+        self.prefix_cache_enabled = prefix_cache == "on"
+        if self.prefix_cache_enabled:
+            if min_shared_blocks == "auto":
+                min_shared_blocks = resolve_min_shared_blocks(
+                    model.d_model, model.num_heads, max_len
+                )
+                self._adopt_decision("min_shared_blocks", key)
+            else:
+                min_shared_blocks = int(min_shared_blocks)
+                self.decisions.append({"name": "min_shared_blocks",
+                                       "key": key,
+                                       "winner": str(min_shared_blocks),
+                                       "source": "explicit"})
+            if min_shared_blocks < 1:
+                raise ValueError(
+                    f"min_shared_blocks must be >= 1, got "
+                    f"{min_shared_blocks}"
+                )
+            self._prefix: Optional[PrefixCache] = PrefixCache(self._alloc)
+            self._min_shared_blocks = int(min_shared_blocks)
+        else:
+            self._prefix = None
+            self._min_shared_blocks = 0
+        #: lifetime prefix-cache accounting (the scheduler's hit-rate
+        #: gauge and dryrun/bench lines read it).
+        self.prefix_stats = {
+            "lookups": 0, "hits": 0, "hit_tokens": 0, "prompt_tokens": 0,
+            "prefill_tokens": 0, "cow_blocks": 0,
+        }
+        #: per-join event payload for the scheduler's ``prefix_cache``
+        #: trace event — set by every paged+cache-on prefill_join, None
+        #: otherwise.
+        self.last_prefix_info: Optional[dict] = None
 
         # ---- speculation length (ISSUE 5): K drafted tokens per tick,
         # verified in one forward. Resolved like the other serving
@@ -393,6 +495,9 @@ class ServingEngine:
         self._verify_step_jit = (
             self._build_verify_step() if self.spec_tokens > 0 else None
         )
+        self._cow_copy_jit = (
+            self._build_cow_copy() if self._prefix is not None else None
+        )
         self._prefill_jits: dict[int, Any] = {}
 
     # ------------------------------------------------------------------
@@ -471,12 +576,17 @@ class ServingEngine:
         )
 
     def _pool_exhausted_error(self) -> RuntimeError:
+        # blocks_in_use counts slot-referenced blocks only; cached
+        # (trie-held, refcount 0) blocks make the arithmetic add up —
+        # without them "20/32 in use" on a full pool reads like a lie.
+        cached = self._alloc.blocks_cached()
         return RuntimeError(
             "paged KV pool exhausted mid-stream: "
             f"{self._alloc.blocks_in_use}/"
-            f"{self._alloc.num_blocks - 1} blocks in use — size "
-            "num_blocks for the resident-token worst case or admit "
-            "fewer concurrent requests"
+            f"{self._alloc.num_blocks - 1} blocks in use"
+            + (f" (+{cached} trie-cached)" if cached else "")
+            + " — size num_blocks for the resident-token worst case "
+            "or admit fewer concurrent requests"
         )
 
     def _split_key(self):
@@ -543,26 +653,99 @@ class ServingEngine:
 
         return self._tp_jit(inner, 3)
 
+    def _build_cow_copy(self):
+        """The copy-on-write block copy: ONE jitted program copying one
+        physical block (src -> dst) in every layer's K and V pool
+        (:func:`chainermn_tpu.ops.paged_kv.copy_block`). Routed through
+        the same ``_tp_jit`` wrapper as the serving programs so the
+        cache stays donated and, under TP, each shard copies its own
+        slice — zero collectives, one compile for any block pair (the
+        jit-cache pin extends over COW churn)."""
+        import jax
+
+        from chainermn_tpu.ops.paged_kv import copy_block
+
+        def inner(cache, variables, src, dst):
+            del variables
+            cache2 = jax.tree.map(
+                lambda pool: copy_block(pool, src, dst), cache
+            )
+            return cache2, src
+
+        return self._tp_jit(inner, 2)
+
+    def _cow_protect(self, slot: int, start: int, n_positions: int,
+                     strict: bool = True) -> Optional[int]:
+        """Copy-on-write guard for a device write span ``[start, start +
+        n_positions)`` of ``slot``: any covered block that another slot
+        references — or the prefix trie caches — is copied to a fresh
+        block and the WRITER's table repointed before the write program
+        runs (host rewrite for this slot only; readers and the trie's
+        pristine copy untouched). Partial tail blocks are never shared,
+        so in practice this fires on the boundary block of a full-prefix
+        hit and is a no-op everywhere else. Returns blocks copied; on
+        genuine pool exhaustion raises when ``strict`` (the decode/
+        verify paths, where the slot already holds tokens) and returns
+        None when not (the join path defers the admission instead —
+        the copy needs ONE block beyond what ``ensure`` reserved)."""
+        if self._prefix is None or n_positions <= 0:
+            return 0
+        import jax.numpy as jnp
+
+        alloc = self._alloc
+        bs = alloc.block_size
+        # Read the live table row, no defensive copy: this guard runs
+        # per active slot per decode/verify tick and is a no-op outside
+        # the join boundary (partial tails are never shared).
+        owned = alloc._owned[slot]
+        first = start // bs
+        last = min(-(-(start + n_positions) // bs), len(owned))
+        copied = 0
+        for j in range(first, last):
+            blk = owned[j]
+            if not alloc.shared_for_write(blk):
+                continue
+            fresh = alloc.alloc_block()
+            if fresh is None:
+                if strict:
+                    raise self._pool_exhausted_error()
+                return None
+            self._cache, _ = self._cow_copy_jit(
+                self._cache, self._vars,
+                jnp.int32(blk), jnp.int32(fresh),
+            )
+            alloc.cow_replace(slot, j, fresh)
+            copied += 1
+        if copied:
+            self.prefix_stats["cow_blocks"] += copied
+        return copied
+
     def _prefill_fn(self, bucket: int):
-        """The (cached) prefill program for one bucket length."""
+        """The (cached) prefill program for one bucket length. ``start``
+        is a traced per-call scalar — position of the bucket's FIRST
+        token — so the same compiled program serves a from-scratch
+        prefill (start 0) and a prefix-cache tail prefill that begins
+        at the first unshared position (ISSUE 7): compile count stays
+        bounded by the bucket ladder either way."""
         if bucket in self._prefill_jits:
             return self._prefill_jits[bucket]
         import jax.numpy as jnp
 
         model = self._decode_model
 
-        def inner(cache, variables, tokens, true_len, slot, table_row, key):
+        def inner(cache, variables, tokens, true_len, start, slot,
+                  table_row, key):
             logits, mutated = model.apply(
                 {**variables, "cache": cache}, tokens,
                 train=False, decode=True,
-                decode_positions=jnp.zeros((1,), jnp.int32),
+                decode_positions=start,
                 block_tables=table_row, decode_slots=slot,
                 mutable=["cache"],
             )
             last = jnp.take(logits[0], true_len - 1, axis=0)  # [V]
             return mutated["cache"], self._sample(last[None], key)[0]
 
-        fn = self._tp_jit(inner, 5)
+        fn = self._tp_jit(inner, 6)
         self._prefill_jits[bucket] = fn
         return fn
 
@@ -599,6 +782,25 @@ class ServingEngine:
         reg.gauge("kv_blocks_leased",
                   "KV pool blocks owned by slots").set(
             self._alloc.blocks_in_use)
+        if self._prefix is not None:
+            reg.gauge("kv_blocks_shared",
+                      "KV pool blocks referenced by more than one "
+                      "slot's table (prefix sharing)").set(
+                self._alloc.blocks_shared())
+            reg.gauge("kv_blocks_cached",
+                      "trie-cached KV blocks no slot references (an "
+                      "upper bound on reclaimable — a live descendant "
+                      "pins its cached ancestors)").set(
+                self._alloc.blocks_cached())
+
+    def prefix_trie_blocks(self) -> Optional[int]:
+        """Blocks held by the prefix trie (None when sharing is off) —
+        the scheduler's trie-size gauge."""
+        return self._prefix.n_nodes if self._prefix is not None else None
+
+    def prefix_evictions(self) -> int:
+        """Lifetime trie evictions (0 when sharing is off)."""
+        return self._prefix.evictions if self._prefix is not None else 0
 
     def decode_compile_count(self) -> Optional[int]:
         """Compilations of the steady-state step (the no-recompile pin:
@@ -626,7 +828,20 @@ class ServingEngine:
         """Admit one request: claim a slot, run bucketed prefill, return
         ``(slot, first_token, bucket)`` — or None when no slot (or,
         paged, not enough pool blocks) is available right now (the
-        scheduler retries later; host state is untouched on refusal)."""
+        scheduler retries later; host state is untouched on refusal).
+
+        With the prefix cache on (ISSUE 7) the join first consults the
+        trie: the longest matching FULL-block chain is adopted into the
+        slot's table (refcounts, no copy) and the prefill runs only the
+        unshared tail at its true start position — bucketed by the TAIL
+        length, so a full-hit request's prefill shrinks to one token.
+        The bucket of the RUN prefill is returned (the scheduler's
+        event field measures exactly the work done). A full-block-exact
+        hit re-feeds the last prompt token (logits need a forward), and
+        the write at that boundary position triggers the copy-on-write
+        path (:meth:`_cow_protect`) — the one place a shared block is
+        ever written toward.
+        """
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -640,8 +855,21 @@ class ServingEngine:
             )
         if not self._free:
             return None
-        bucket = bucket_length(P_len, self._buckets)
         slot = self._free[-1]  # peek; commit only after alloc succeeds
+        self.last_prefix_info = None
+        matched: list[int] = []
+        if self._prefix is not None:
+            matched = self._prefix.lookup(prompt)
+            if len(matched) < self._min_shared_blocks:
+                matched = []
+        hit_tokens = len(matched) * (self._alloc.block_size
+                                     if self._alloc else 0)
+        # The tail must carry at least the LAST prompt token — its
+        # logits sample the first generated token — so a hit covering
+        # the whole prompt re-feeds one token into the boundary block.
+        tail_start = min(hit_tokens, P_len - 1)
+        tail_len = P_len - tail_start
+        bucket = bucket_length(tail_len, self._buckets)
         if self._alloc is not None:
             # Reserve only the REAL tokens plus the first decode write
             # (position P_len) — NOT the padded bucket: pad writes
@@ -650,17 +878,54 @@ class ServingEngine:
             # so reserving bucket-width here would silently defeat the
             # oversubscription the pool exists for (review finding:
             # a prompt that falls back to the max_len bucket would
-            # demand the whole horizon up front).
+            # demand the whole horizon up front). Adoption precedes the
+            # tail ensure (table order = position order); a refused
+            # ensure rolls the adoption back via release — all-or-
+            # nothing, as before.
+            # A free slot's table row is all-scratch, so a rolled-back
+            # deferral restores the EXACT prior table — restore the
+            # version too, or every scheduler retry would invalidate
+            # the engine's cached device tables and pay a full H2D
+            # re-upload right after the decode loop's D2H (the
+            # degradation trap the version key exists to avoid).
+            v0 = self._alloc.version
+            self._alloc.adopt(slot, matched)
             if not self._alloc.ensure(slot, P_len + 1):
+                self._alloc.release(slot)
+                self._alloc.version = v0
                 return None
+            # The boundary-block COW needs ONE block beyond ensure's
+            # reservation; under genuine exhaustion defer the admission
+            # (release rolls the adoption AND any copy back) — never an
+            # error a cache-off engine wouldn't have raised.
+            cow = self._cow_protect(slot, tail_start, tail_len,
+                                    strict=False)
+            if cow is None:
+                self._alloc.release(slot)
+                self._alloc.version = v0
+                return None
+        else:
+            cow = 0
         self._free.pop()
 
+        # Lifetime accounting covers ADMITTED requests only — a deferred
+        # admission is retried by the scheduler, and counting each retry
+        # would dilute the hit-rate gauge with duplicates.
+        if self._prefix is not None:
+            self.prefix_stats["lookups"] += 1
+            self.prefix_stats["prompt_tokens"] += P_len
+            self.prefix_stats["prefill_tokens"] += tail_len
+        if matched:
+            self.prefix_stats["hits"] += 1
+            self.prefix_stats["hit_tokens"] += hit_tokens
+
         padded = np.full((1, bucket), self.pad_id, np.int32)
-        padded[0, :P_len] = prompt
+        padded[0, :tail_len] = prompt[tail_start:]
         fn = self._prefill_fn(bucket)
         self._cache, tok = fn(
             self._cache, self._vars, jnp.asarray(padded),
-            jnp.int32(P_len), jnp.asarray([slot], jnp.int32),
+            jnp.int32(tail_len), jnp.full((1,), tail_start, jnp.int32),
+            jnp.asarray([slot], jnp.int32),
             jnp.asarray(self._dummy_tables()[slot:slot + 1]),
             self._split_key(),
         )
@@ -669,6 +934,24 @@ class ServingEngine:
         self._last_tok[slot] = tok
         self._active[slot] = True
         self._history[slot] = [int(t) for t in prompt] + [tok]
+        if self._prefix is not None:
+            # Completed prefill: cache the prompt's FULL blocks (the
+            # adopted prefix walks existing nodes; only fresh full
+            # blocks add nodes). The partial tail block is never
+            # inserted — the next decode write targets it.
+            full = P_len // self._alloc.block_size
+            if full:
+                self._prefix.insert(
+                    prompt[:full * self._alloc.block_size],
+                    self._alloc.owned_blocks(slot)[:full],
+                )
+            self.last_prefix_info = {
+                "prompt_tokens": P_len,
+                "hit_blocks": len(matched),
+                "hit_tokens": hit_tokens,
+                "prefill_tokens": tail_len,
+                "cow_blocks": cow,
+            }
         self._publish_pool_gauges()
         return slot, tok, bucket
 
@@ -691,6 +974,9 @@ class ServingEngine:
                 int(s), p + 1
             ):
                 raise self._pool_exhausted_error()
+            # COW guard (ISSUE 7): the write at position p must not land
+            # in a block another slot or the trie still reads.
+            self._cow_protect(int(s), p, 1)
         t0 = time.perf_counter()
         self._cache, toks = self._decode_step_jit(
             self._cache, self._vars,
@@ -778,6 +1064,11 @@ class ServingEngine:
                     and not self._alloc.ensure(s, covered)):
                 covered = p + 1
             room[s] = min(K, covered - p - 1, self.max_len - 1 - p)
+            # COW guard (ISSUE 7): the whole verify span [p, p+room+1)
+            # must write private blocks BEFORE the forward — a rejected
+            # draft's stale write must never corrupt a shared ancestor
+            # block (rollback stays host-metadata-only and composes).
+            self._cow_protect(s, p, room[s] + 1)
 
         from chainermn_tpu.serving.speculate import accept_length
 

@@ -26,6 +26,15 @@ Speculative ticks (``engine.spec_tokens > 0``) additionally emit one
 and the per-slot ``accept_lens`` — the accounting behind the
 acceptance-rate rollup and trace_report's accept-length histogram.
 
+Prefix-sharing admissions (``engine.prefix_cache_enabled``, ISSUE 7)
+emit one ``prefix_cache`` event per admitted request — prompt/hit/
+prefilled token counts and COW copies — the MEASURED record that a
+cache-hit request prefilled only its unshared tail (the bench
+acceptance reads exactly these), rolled up by
+``trace.summarize_serving`` and mirrored as live counters by the
+metrics tap. Admission also refreshes the ``kv_prefix_hit_rate`` and
+``kv_prefix_trie_blocks`` gauges (engine state, not events).
+
 :meth:`Scheduler.summary` rolls the same numbers up locally (tokens/s,
 p50/p99 per-token latency, mean occupancy) so callers without a trace
 recorder still get the accounting.
@@ -137,6 +146,21 @@ class Scheduler:
                   "step").set(getattr(eng, "num_slots", 0))
         reg.gauge("serving_active_slots", "decode slots currently "
                   "occupied").set(getattr(eng, "n_active", 0))
+        stats = getattr(eng, "prefix_stats", None)
+        if stats and stats.get("lookups"):
+            reg.gauge(
+                "kv_prefix_hit_rate",
+                "fraction of admitted prompt tokens served from the "
+                "prefix cache (lifetime)",
+            ).set(stats["hit_tokens"] / max(1, stats["prompt_tokens"]))
+        trie_blocks = getattr(eng, "prefix_trie_blocks", None)
+        if callable(trie_blocks):
+            n = trie_blocks()
+            if n is not None:
+                reg.gauge(
+                    "kv_prefix_trie_blocks",
+                    "KV blocks held by the prefix trie",
+                ).set(n)
 
     def submit(self, request: Request) -> str:
         """Enqueue; returns the request id (assigned when absent).
@@ -224,6 +248,15 @@ class Scheduler:
                     bucket=bucket, prompt_len=len(req.prompt),
                     dur_s=round(now - t0, 9),
                     ttft_s=round(now - req._arrival, 9))
+        # Prefix-sharing accounting (ISSUE 7): the engine fills
+        # last_prefix_info on every cache-on paged join — hit/miss,
+        # adopted vs prefilled token counts, COW copies. Emitted here
+        # (not in the engine) so it rides the scheduler's event window:
+        # summary(), bench rows and trace_report all see it.
+        info = getattr(self.engine, "last_prefix_info", None)
+        if info is not None:
+            self._event("prefix_cache", request=req.request_id,
+                        slot=slot, **info)
         fl = _InFlight(req, slot, list(req.prompt) + [tok], 1)
         self._inflight[slot] = fl
         self._publish_gauges()

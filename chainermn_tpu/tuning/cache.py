@@ -244,15 +244,24 @@ def _seed_one_result(result: dict, source: str, out: list,
                                    for k, v in sched_ms.items()},
                  "spread_pct": spread})
 
-    # Serving decode decisions (ISSUE 4/5): bench's ``serving`` phase
-    # records per-candidate step medians keyed by the engine's own
-    # decision key material (``serving_model_shape`` D..xH..xL..) —
-    # decode impl, paged block size, and the speculative length K
-    # (``serving_spec_ms``: ms per GENERATED token per K, so the
-    # acceptance rate is priced in). All adoptions are spread-gated
-    # through measure.decide, same as the overlap schedule rows above.
+    # Serving decode decisions (ISSUE 4/5/7): bench's ``serving`` and
+    # ``serving_prefix`` phases record per-candidate medians keyed by
+    # the engine's own decision key material (``serving_model_shape``
+    # D..xH..xL..) — decode impl, paged block size, the speculative
+    # length K (``serving_spec_ms``: ms per GENERATED token per K, so
+    # the acceptance rate is priced in), the prefix cache on/off
+    # (``serving_prefix_ttft_ms``: median TTFT under duplicate-prefix
+    # load — the metric sharing exists to move) and its adoption
+    # threshold (``serving_prefix_msb_ttft_ms``). All adoptions are
+    # spread-gated through measure.decide, same as the overlap schedule
+    # rows above.
     m = _SERVING_SHAPE.search(result.get("serving_model_shape", ""))
-    if m:
+    # The prefix rows carry their OWN shape key: the two phases share a
+    # model today, but last-writer-wins on one merged key would silently
+    # re-key the other phase's decisions if either shape ever diverges.
+    m_px = (_SERVING_SHAPE.search(
+        result.get("serving_prefix_model_shape", "")) or m)
+    if m or m_px:
         from chainermn_tpu.tuning.measure import decide
 
         for row_key, spread_key, name in (
@@ -262,6 +271,10 @@ def _seed_one_result(result: dict, source: str, out: list,
              "kv_block_size"),
             ("serving_spec_ms", "serving_spec_spread_pct",
              "spec_tokens"),
+            ("serving_prefix_ttft_ms", "serving_prefix_spread_pct",
+             "prefix_cache"),
+            ("serving_prefix_msb_ttft_ms",
+             "serving_prefix_msb_spread_pct", "min_shared_blocks"),
         ):
             rows = result.get(row_key)
             if not (isinstance(rows, dict) and len(rows) >= 2 and all(
@@ -281,7 +294,11 @@ def _seed_one_result(result: dict, source: str, out: list,
                 spread = 10.0
             winner = decide(rows, {k: spread for k in rows})
             if winner is not None:
-                key = _bucketed_key(kind, m.groups(), "decode")
+                m_row = (m_px if name in ("prefix_cache",
+                                          "min_shared_blocks") else m)
+                if m_row is None:
+                    continue
+                key = _bucketed_key(kind, m_row.groups(), "decode")
                 evidence = {"candidates_ms": {k: round(float(v), 4)
                                               for k, v in rows.items()},
                             "spread_pct": spread}
@@ -292,6 +309,12 @@ def _seed_one_result(result: dict, source: str, out: list,
                     rates = result.get("serving_spec_accept_rates")
                     if isinstance(rates, dict):
                         evidence["accept_rates"] = rates
+                if name == "prefix_cache":
+                    # the hit rate behind the TTFT comparison: 'on'
+                    # winning at 0% hits would be noise, not sharing.
+                    hr = result.get("serving_prefix_hit_rate")
+                    if hr is not None:
+                        evidence["hit_rate"] = hr
                 put(name, key, winner, evidence)
 
     # Double buffering: the measured on/off step-time ratio.

@@ -72,6 +72,20 @@ from chainermn_tpu.tuning import measure as _measure
 #:   must EARN adoption through a bench ``serving`` capture
 #:   (``serving_spec_ms`` rows + acceptance rate) before 'auto' turns
 #:   it on for a shape.
+#: - ``prefix_cache`` (cross-request KV prefix sharing): ``on`` — the
+#:   miss path costs host metadata only (one trie walk + refcounts per
+#:   join; the decode/verify programs are untouched and shared streams
+#:   are bit-identical, both pinned in tests/test_prefix_cache.py),
+#:   while a hit removes the shared prefix from prefill entirely —
+#:   bench's ``serving_prefix`` phase measured the CPU-proxy TTFT win
+#:   under duplicate-prefix load and unlike ``spec_tokens`` there is no
+#:   workload that pays a device-plane penalty for a junk hit (COW
+#:   copies one block, only ever on a full-prefix boundary). A cache
+#:   entry can still turn it off where a sweep shows the host walk
+#:   mattering.
+#: - ``min_shared_blocks``: ``1`` — adopt every full-block hit; raise
+#:   via a sweep only where table/refcount churn on tiny hits shows up
+#:   (``serving_prefix_msb_ttft_ms`` rows).
 DEFAULT_TABLE: dict = {
     "moe_dispatch": {"cpu": "sort", "tpu": "sort", "*": "sort"},
     "attention": {"cpu": "xla", "tpu": "flash", "*": "flash"},
@@ -83,6 +97,8 @@ DEFAULT_TABLE: dict = {
     "decode_impl": {"*": "paged"},
     "kv_block_size": {"*": "64"},
     "spec_tokens": {"*": "0"},
+    "prefix_cache": {"*": "on"},
+    "min_shared_blocks": {"*": "1"},
 }
 
 _MODE_ENV = "CHAINERMN_TPU_AUTOTUNE"

@@ -296,6 +296,62 @@ def test_spec_tokens_rows_contract_and_seeding(tmp_path):
         seed_from_bench_details(str(details), str(cache2)))
 
 
+def test_serving_prefix_rows_contract_and_seeding(tmp_path):
+    """ISSUE 7 satellite: the ``serving_prefix`` phase's headline rows
+    ride the compact line (TTFT speedup + hit rate + spread gate), and
+    ``tuning seed`` learns ``prefix_cache``/``min_shared_blocks`` from
+    the TTFT rows under the same spread gate and key material as the
+    other serving decisions — with the measured hit rate carried as
+    auditable evidence for WHY 'on' won."""
+    for k in ("serving_prefix_ttft_speedup", "serving_prefix_hit_rate",
+              "serving_prefix_spread_pct"):
+        assert k in bench._COMPACT_KEYS, k
+
+    from chainermn_tpu.tuning.cache import (
+        load_cache,
+        seed_from_bench_details,
+    )
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-03T00:00:00Z",
+        "serving_model_shape": "D512xH8xL512",
+        "serving_prefix_ttft_ms": {"off": 20.0, "on": 6.0},
+        "serving_prefix_spread_pct": 8.0,
+        "serving_prefix_hit_rate": 0.89,
+        "serving_prefix_msb_ttft_ms": {"1": 6.0, "2": 6.8, "4": 9.0},
+        "serving_prefix_msb_spread_pct": 7.0,
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert "prefix_cache|TPU v5 lite|512x8x512|decode -> on" in seeded
+    assert "min_shared_blocks|TPU v5 lite|512x8x512|decode -> 1" in seeded
+    entry = load_cache(str(cache))["decisions"][
+        "prefix_cache|TPU v5 lite|512x8x512|decode"]
+    assert entry["hit_rate"] == 0.89
+    assert entry["candidates_ms"]["on"] == 6.0
+
+    # spread-dominated rows are refused (noise-band "winner")
+    doc["serving_prefix_ttft_ms"] = {"off": 6.1, "on": 6.0}
+    doc["serving_prefix_spread_pct"] = 12.0
+    details.write_text(json.dumps(doc))
+    cache2 = tmp_path / "cache2.json"
+    assert "prefix_cache" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+    # ABSENT spread = on-accel single sample: the 10% floor applies
+    doc.pop("serving_prefix_spread_pct")
+    details.write_text(json.dumps(doc))
+    assert "prefix_cache" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+    doc["serving_prefix_ttft_ms"] = {"off": 20.0, "on": 6.0}
+    details.write_text(json.dumps(doc))
+    assert "prefix_cache|TPU v5 lite|512x8x512|decode -> on" in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+
 def test_transformer_knob_env_validation(monkeypatch):
     """The accel transformer knobs reject malformed env values with a
     message naming the variable (a bare ZeroDivisionError from

@@ -191,6 +191,18 @@ def _golden_trace_lines():
         {"schema": 1, "kind": "speculate", "t": 2.9, "pid": 1, "rank": 0,
          "drafted": 4, "accepted": 0, "accept_lens": [0, 0],
          "dur_s": 0.006},
+        # ISSUE 7: two prefix-cache admissions — a miss that prefilled
+        # the whole 5-token prompt, then a full-prefix hit that adopted
+        # 2 blocks (16 tokens), prefilled only the 1-token tail and
+        # copied the boundary block (COW).
+        {"schema": 1, "kind": "prefix_cache", "t": 3.0, "pid": 1,
+         "rank": 0, "request": "r0", "slot": 0, "prompt_tokens": 5,
+         "hit_blocks": 0, "hit_tokens": 0, "prefill_tokens": 5,
+         "cow_blocks": 0},
+        {"schema": 1, "kind": "prefix_cache", "t": 3.1, "pid": 1,
+         "rank": 0, "request": "r1", "slot": 1, "prompt_tokens": 16,
+         "hit_blocks": 2, "hit_tokens": 16, "prefill_tokens": 1,
+         "cow_blocks": 1},
     ]
     return [_json.dumps(e) for e in evs] + ['{"torn']
 
@@ -217,7 +229,7 @@ def test_trace_report_contract(tmp_path):
         "schema_versions": [1],
         "meta": {"started_at": "2026-08-03T00:00:00Z", "sync": False,
                  "source": "bench"},
-        "n_events": 20,  # torn tail line skipped, not fatal
+        "n_events": 22,  # torn tail line skipped, not fatal
         "collectives": [
             {"op": "allreduce_grad", "plane": "device", "n": 2,
              "total_bytes": 2000, "total_s": 0.004, "mean_ms": 2.0,
@@ -275,11 +287,24 @@ def test_trace_report_contract(tmp_path):
                 "accept_rate": 0.25,
                 "accept_len_hist": {"0": 2, "2": 1},
             },
+            # ISSUE 7: the prefix-sharing rollup — 1 of 2 admissions
+            # hit; 6 of 21 prompt tokens were actually prefilled (16
+            # rode the cache), one boundary-block COW copy.
+            "prefix_cache": {
+                "lookups": 2,
+                "hits": 1,
+                "hit_rate": 0.5,
+                "prompt_tokens": 21,
+                "hit_tokens": 16,
+                "prefilled_tokens": 6,
+                "hit_token_rate": 0.7619,
+                "cow_blocks": 1,
+            },
         },
     }, summary
     # chrome export emitted alongside
     chrome = _json.loads(chrome_file.read_text())
-    assert len(chrome["traceEvents"]) == 19  # meta excluded
+    assert len(chrome["traceEvents"]) == 21  # meta excluded
     # and the human rendering mentions the essentials
     proc2 = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
@@ -293,7 +318,10 @@ def test_trace_report_contract(tmp_path):
                   "p50 4.000 ms, p99 6.000 ms", "33.3% mean",
                   "TTFT: p50 12.000 ms, p99 12.000 ms",
                   "speculation: 8 drafted, 2 accepted (25.0% acceptance)",
-                  "accept-length histogram: 0:2 2:1"):
+                  "accept-length histogram: 0:2 2:1",
+                  "prefix cache: 1/2 admissions hit (50.0%), "
+                  "6/21 prompt tokens prefilled (16 served from cache), "
+                  "1 COW block copy"):
         assert token in proc2.stdout, (token, proc2.stdout)
 
 

@@ -1,0 +1,551 @@
+"""Prefix-sharing KV cache invariants (ISSUE 7).
+
+The acceptance pins, asserted structurally:
+
+- **Equivalence** — shared and unshared execution produce bit-identical
+  token streams: the same request set through ``prefix_cache='on'``
+  equals ``'off'``, equals ``decode_impl='dense'``, equals sequential
+  ``generate`` — across tensor-parallel decode, speculative decode
+  (including an adversarial always-wrong drafter: a rejected draft must
+  never COW-corrupt a shared ancestor block), a FORCED copy-on-write on
+  the boundary block (full-prefix hit), and eviction under pool
+  pressure.
+- **No recompile / no new collectives** — the decode and verify jit
+  caches stay at ONE entry across hit/miss/COW churn, and the compiled
+  decode/verify programs carry exactly the same collectives as before
+  sharing existed (2 all-reduces per layer under TP, nothing else):
+  sharing is host metadata plus one block-copy program.
+- **Measured prefill reduction** — the ``prefix_cache`` trace events
+  carry the prefilled-token counts (a hit prefills only the unshared
+  tail), the rollup/metrics planes aggregate them, and the allocator's
+  refcount edges (trim-to-zero, double release, ensure-after-release
+  hygiene) hold now that they are load-bearing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from chainermn_tpu.models.transformer import TransformerLM, generate
+from chainermn_tpu.serving import (
+    BlockAllocator,
+    PrefixCache,
+    Request,
+    Scheduler,
+    ServingEngine,
+)
+
+VOCAB = 32
+
+
+def tiny_lm(**kw):
+    cfg = dict(vocab_size=VOCAB, num_layers=2, num_heads=4, d_model=16,
+               d_ff=32, max_len=48, compute_dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    return model, params
+
+
+def _generate_ref(model, params, prompt, n_new):
+    return np.asarray(generate(
+        model, params, jnp.asarray([prompt], jnp.int32),
+        len(prompt) + n_new,
+    ))[0].tolist()
+
+
+def _shared_prefix_requests(n_tails=4, shared_len=16, seed=0):
+    """One shared full-block prefix (block_size 8 in these tests) +
+    short unique tails, plus one EXACT-prefix request (the forced-COW
+    case) and one unrelated miss."""
+    rs = np.random.RandomState(seed)
+    shared = rs.randint(1, VOCAB, size=shared_len).tolist()
+    reqs = [(shared + rs.randint(1, VOCAB, size=int(t)).tolist(), 4)
+            for t in rs.randint(2, 6, size=n_tails)]
+    reqs.append((list(shared), 4))          # full-block-exact hit: COW
+    reqs.append((rs.randint(1, VOCAB, size=5).tolist(), 3))  # miss
+    return reqs
+
+
+def _run_stream(engine, reqs, policy="prefill_priority"):
+    sched = Scheduler(engine, policy=policy)
+    ids = [sched.submit(Request(prompt=p, max_new_tokens=g))
+           for p, g in reqs]
+    results = sched.run()
+    return [results[rid]["tokens"] for rid in ids], sched
+
+
+def _engine(lm, *, prefix_cache, num_slots=2, spec_tokens=0,
+            decode_impl="paged", mesh=None, num_blocks=None, **kw):
+    model, params = lm
+    return ServingEngine(
+        model, params, num_slots=num_slots, max_len=48,
+        decode_impl=decode_impl, kv_block_size=8,
+        prefill_buckets=(4, 8, 16), spec_tokens=spec_tokens, mesh=mesh,
+        num_blocks=num_blocks, prefix_cache=prefix_cache, **kw,
+    )
+
+
+class _WrongDrafter:
+    """Adversarial drafter: every proposal is wrong (argmax can match a
+    constant only by accident on a random model) — maximal rollback
+    pressure on the shared blocks."""
+
+    def propose(self, history, k):
+        return [(history[-1] + 1) % (VOCAB - 1) + 1] * k
+
+
+class TestStreamEquivalence:
+    """Shared == unshared, pinned bitwise (the core invariant)."""
+
+    def test_shared_equals_unshared_equals_dense_equals_generate(self, lm):
+        model, params = lm
+        reqs = _shared_prefix_requests()
+        on, sched = _run_stream(_engine(lm, prefix_cache="on"), reqs)
+        off, _ = _run_stream(_engine(lm, prefix_cache="off"), reqs)
+        dense, _ = _run_stream(
+            _engine(lm, prefix_cache="auto", decode_impl="dense"), reqs
+        )
+        assert on == off == dense
+        for (prompt, n_new), got in zip(reqs, on):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_forced_cow_on_boundary_block_keeps_both_streams(self, lm):
+        """A full-prefix hit re-feeds the last prompt token into the
+        boundary block — the ONE write that targets a shared block. The
+        COW must fire (measured, not assumed), the adopter's stream
+        must match generate, and the original request decoding from the
+        SAME blocks must be unperturbed."""
+        model, params = lm
+        engine = _engine(lm, prefix_cache="on")
+        prompt = [(i % (VOCAB - 1)) + 1 for i in range(16)]  # 2 blocks
+        slot_a, tok_a, _ = engine.prefill_join(prompt)
+        slot_b, tok_b, bucket_b = engine.prefill_join(prompt)
+        info = engine.last_prefix_info
+        assert info["hit_blocks"] == 2 and info["hit_tokens"] == 16
+        assert info["prefill_tokens"] == 1 and info["cow_blocks"] == 1
+        assert bucket_b == 4  # one-token tail, smallest bucket
+        assert engine.prefix_stats["cow_blocks"] == 1
+        stream_a, stream_b = list(prompt) + [tok_a], list(prompt) + [tok_b]
+        for _ in range(6):
+            toks, _dur = engine.decode_step()
+            stream_a.append(int(toks[slot_a]))
+            stream_b.append(int(toks[slot_b]))
+        ref = _generate_ref(model, params, prompt, 7)
+        assert stream_a == ref
+        assert stream_b == ref
+
+    def test_tp_shared_stream_matches_single_device(self, lm):
+        model, params = lm
+        mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("model",))
+        reqs = _shared_prefix_requests(seed=5)
+        tp, _ = _run_stream(_engine(lm, prefix_cache="on", mesh=mesh,
+                                    num_slots=3), reqs)
+        single, _ = _run_stream(_engine(lm, prefix_cache="on",
+                                        num_slots=3), reqs)
+        assert tp == single
+        for (prompt, n_new), got in zip(reqs, tp):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    @pytest.mark.parametrize("drafter", [None, _WrongDrafter()],
+                             ids=["ngram", "always-wrong"])
+    def test_speculative_decode_composes(self, lm, drafter):
+        """Sharing + speculation: rollback is host-metadata-only, so a
+        rejected draft's stale writes land in COW'd/private blocks —
+        never in a shared ancestor. The always-wrong drafter maximises
+        rejected spans across the shared/private boundary."""
+        model, params = lm
+        reqs = _shared_prefix_requests(seed=9)
+        spec, _ = _run_stream(
+            _engine(lm, prefix_cache="on", spec_tokens=3,
+                    drafter=drafter), reqs
+        )
+        for (prompt, n_new), got in zip(reqs, spec):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_eviction_under_pressure_keeps_streams(self, lm):
+        """A pool too small to cache every prefix: ensure-would-fail
+        reclaims refcount-0 trie leaves (LRU) instead of deferring, the
+        evicted prefix re-prefills as a miss, and every stream still
+        matches generate — the cache can degrade, never corrupt."""
+        model, params = lm
+        rs = np.random.RandomState(11)
+        p1, p2, p3 = (rs.randint(1, VOCAB, size=16).tolist()
+                      for _ in range(3))
+        # 5 allocatable blocks; a live 16-token request needs 3, and
+        # each finished prefix caches 2 — the third distinct prefix can
+        # only be admitted by evicting an earlier one.
+        engine = _engine(lm, prefix_cache="on", num_slots=1,
+                         num_blocks=6)
+        reqs = [(p1, 4), (p2, 4), (p3, 4), (p1, 4)]
+        streams, _ = _run_stream(engine, reqs, policy="fcfs")
+        assert engine.prefix_evictions() > 0
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_full_hit_cow_exhaustion_defers_never_crashes(self, lm):
+        """``ensure`` reserves the prompt span but the boundary-block
+        COW needs one MORE block; at genuine exhaustion the join must
+        DEFER — full rollback, scheduler-retryable — not raise and leak
+        the slot (a stream a cache-off engine would have served)."""
+        prompt = [(i % (VOCAB - 1)) + 1 for i in range(16)]  # 2 blocks
+        # 3 allocatable blocks: request A takes all 3 (ensure 17) and
+        # leaves 2 cached + 1 free; B full-hits — adopt(2) + ensure
+        # takes the last free block, and the boundary COW has nothing
+        # left (the cached blocks are now ADOPTED, unreclaimable).
+        engine = _engine(lm, prefix_cache="on", num_slots=2,
+                         num_blocks=4)
+        join = engine.prefill_join(prompt)
+        assert join is not None
+        engine.leave(join[0])
+        alloc = engine._alloc
+        assert alloc.blocks_cached() == 2 and alloc.free_blocks == 1
+        free_slots = list(engine._free)
+        stats0 = dict(engine.prefix_stats)
+        v0 = alloc.version
+        assert engine.prefill_join(prompt) is None  # deferred
+        # full rollback: pool, slot list, accounting AND the table
+        # version untouched (a retry must not force an H2D re-upload
+        # of an identical table)
+        assert alloc.free_blocks == 1 and alloc.blocks_cached() == 2
+        assert int(alloc.refcounts.sum()) == 0
+        assert list(engine._free) == free_slots
+        assert engine.prefix_stats == stats0
+        assert alloc.version == v0
+        # the engine still serves: a no-hit prompt fits the last block
+        assert engine.prefill_join(prompt[:5]) is not None
+
+
+class TestStructural:
+    def test_jit_cache_pinned_across_hit_miss_cow_churn(self, lm):
+        engine = _engine(lm, prefix_cache="on")
+        streams, _ = _run_stream(engine, _shared_prefix_requests(seed=3))
+        assert len(streams) == 6
+        assert engine.prefix_stats["hits"] > 0
+        assert engine.prefix_stats["cow_blocks"] >= 1
+        assert engine.decode_compile_count() == 1
+        assert engine.prefill_compile_count() <= 3  # the bucket ladder
+
+        spec = _engine(lm, prefix_cache="on", spec_tokens=3)
+        _run_stream(spec, _shared_prefix_requests(seed=4))
+        assert spec.verify_compile_count() == 1
+
+    def test_no_new_collectives_in_decode_and_verify(self, lm):
+        """Sharing is host metadata + one block-copy program: the
+        compiled decode/verify steps must carry exactly the pre-sharing
+        collective set (2 all-reduces per layer, nothing else), and the
+        COW copy program itself must be collective-free."""
+        model, params = lm
+        mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("model",))
+        engine = _engine(lm, prefix_cache="on", num_slots=3, mesh=mesh,
+                         spec_tokens=2)
+        n = engine.num_slots
+        args = (
+            engine._cache, engine._vars,
+            jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
+            jnp.asarray(engine._dummy_tables()), engine._key,
+        )
+        txt = engine._decode_step_jit.lower(*args).compile().as_text()
+        assert txt.count("all-reduce(") == 2 * model.num_layers
+        vargs = (
+            engine._cache, engine._vars,
+            jnp.zeros((n, 3), jnp.int32), jnp.zeros((n,), jnp.int32),
+            jnp.asarray(engine._dummy_tables()),
+        )
+        vtxt = engine._verify_step_jit.lower(*vargs).compile().as_text()
+        assert vtxt.count("all-reduce(") == 2 * model.num_layers
+        ctxt = engine._cow_copy_jit.lower(
+            engine._cache, engine._vars, jnp.int32(1), jnp.int32(2)
+        ).compile().as_text()
+        for op in ("all-reduce(", "all-gather(", "collective-permute(",
+                   "all-to-all(", "reduce-scatter("):
+            assert ctxt.count(op) == 0, f"{op} in the COW copy"
+            assert txt.count(op) == 0 or op == "all-reduce("
+            assert vtxt.count(op) == 0 or op == "all-reduce("
+
+    def test_prefill_runs_only_the_unshared_tail_measured(self, lm):
+        """The acceptance criterion's number: prefix_cache trace events
+        carry the per-admission prefilled-token count, and for a hit it
+        is the TAIL length, not the prompt length."""
+        from chainermn_tpu.observability import trace as obs_trace
+
+        engine = _engine(lm, prefix_cache="on")
+        shared = [(i % (VOCAB - 1)) + 1 for i in range(16)]
+        rec = obs_trace.enable(None)
+        try:
+            reqs = [(shared + [3, 7, 5], 3), (shared + [9, 2], 3)]
+            _run_stream(engine, reqs)
+            evs = [e for e in rec.events if e["kind"] == "prefix_cache"]
+        finally:
+            obs_trace.disable()
+        assert [e["prefill_tokens"] for e in evs] == [19, 2]
+        assert [e["hit_tokens"] for e in evs] == [0, 16]
+        assert all(e["schema"] == obs_trace.TRACE_SCHEMA for e in evs)
+
+
+class TestAllocatorEdges:
+    """The refcount change makes these paths load-bearing (ISSUE 7
+    satellite): trim to zero, double release, ensure-after-release
+    hygiene, and the version (epoch) discipline."""
+
+    def test_trim_to_zero_positions_releases_everything(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, num_slots=2,
+                           max_len=16)
+        assert a.ensure(0, 13)  # 4 blocks
+        v = a.version
+        a.trim(0, 0)
+        assert a.version == v + 1  # one mutation, one epoch bump
+        assert a.owned_blocks(0) == []
+        assert (a.tables[0] == a.SCRATCH).all()
+        assert a.free_blocks == 8 and a.blocks_in_use == 0
+        a.trim(0, 0)  # already empty: no-op, no epoch churn
+        assert a.version == v + 1
+
+    def test_double_release_is_idempotent(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, num_slots=2,
+                           max_len=16)
+        assert a.ensure(1, 9)
+        a.release(1)
+        v = a.version
+        free = a.free_blocks
+        a.release(1)  # second release: no-op
+        assert a.version == v and a.free_blocks == free
+        assert (a.refcounts >= 0).all()
+
+    def test_ensure_after_release_table_hygiene(self):
+        """Released entries point at scratch, a re-ensure hands out
+        fresh refcount-1 blocks, and every mutation bumps the epoch
+        exactly once (the engine's H2D re-upload key)."""
+        a = BlockAllocator(num_blocks=9, block_size=4, num_slots=2,
+                           max_len=16)
+        assert a.ensure(0, 9)  # +1
+        v = a.version
+        a.release(0)  # +1
+        assert a.version == v + 1
+        assert (a.tables[0] == a.SCRATCH).all()
+        assert a.ensure(0, 5)  # +1: one bump for the whole grow
+        assert a.version == v + 2
+        assert a.ensure(0, 5)  # covered: no growth, no bump
+        assert a.version == v + 2
+        owned = a.owned_blocks(0)
+        assert len(owned) == 2
+        assert all(a.refcounts[b] == 1 for b in owned)
+        assert (a.tables[0][:2] > 0).all() and (a.tables[0][2:] == 0).all()
+
+    def test_shared_release_keeps_blocks_for_the_other_slot(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, num_slots=2,
+                           max_len=16)
+        assert a.ensure(0, 8)  # 2 blocks
+        shared = a.owned_blocks(0)
+        a.adopt(1, shared)
+        assert a.blocks_shared() == 2
+        a.release(0)
+        # still referenced by slot 1: not freed, tables intact
+        assert a.free_blocks == 6
+        assert a.owned_blocks(1) == shared
+        assert a.blocks_shared() == 0  # single reference each now
+        a.release(1)
+        assert a.free_blocks == 8
+
+    def test_cow_replace_and_adopt_guards(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, num_slots=2,
+                           max_len=16)
+        assert a.ensure(0, 4)
+        blk = a.owned_blocks(0)[0]
+        a.adopt(1, [blk])
+        assert a.shared_for_write(blk)
+        fresh = a.alloc_block()
+        v = a.version
+        old = a.cow_replace(1, 0, fresh)
+        assert old == blk and a.version == v + 1
+        assert a.tables[1, 0] == fresh and a.owned_blocks(1) == [fresh]
+        assert not a.shared_for_write(blk)  # back to one reference
+        with pytest.raises(ValueError, match="scratch"):
+            a.adopt(0, [a.SCRATCH])
+        with pytest.raises(ValueError, match="horizon"):
+            a.adopt(0, [fresh] * a.max_blocks)
+
+
+class TestPrefixTrie:
+    def _setup(self, num_blocks=10, bs=4):
+        a = BlockAllocator(num_blocks=num_blocks, block_size=bs,
+                           num_slots=2, max_len=32)
+        return a, PrefixCache(a)
+
+    def test_lookup_is_full_block_granular(self):
+        a, c = self._setup()
+        assert a.ensure(0, 11)  # 3 blocks, last partial
+        blocks = a.owned_blocks(0)
+        tokens = list(range(1, 12))
+        assert c.insert(tokens, blocks[:2]) == 2  # partial tail refused
+        assert c.n_nodes == 2
+        assert c.lookup(tokens) == blocks[:2]
+        assert c.lookup(tokens[:8]) == blocks[:2]
+        assert c.lookup(tokens[:7]) == blocks[:1]  # 7 < one full block*2
+        assert c.lookup(tokens[:3]) == []
+        # diverging second block: only the first matches
+        assert c.lookup(tokens[:4] + [30, 30, 30, 30]) == blocks[:1]
+
+    def test_insert_first_writer_wins(self):
+        a, c = self._setup()
+        assert a.ensure(0, 8) and a.ensure(1, 8)
+        tokens = [5, 6, 7, 8, 9, 10, 11, 12]
+        c.insert(tokens, a.owned_blocks(0))
+        first = c.lookup(tokens)
+        assert c.insert(tokens, a.owned_blocks(1)) == 0  # already cached
+        assert c.lookup(tokens) == first
+
+    def test_reclaim_evicts_lru_leaf_first_never_interior(self):
+        a, c = self._setup()
+        assert a.ensure(0, 12)  # chain of 3
+        blocks = a.owned_blocks(0)
+        tokens = list(range(1, 13))
+        c.insert(tokens, blocks)
+        a.release(0)
+        c.lookup(tokens[:4])  # touch the root chunk: LRU says leaf first
+        assert c.reclaim(1) == 1
+        assert c.evictions == 1
+        # deepest block went; the interior chain stays intact
+        assert c.lookup(tokens) == blocks[:2]
+        assert c.reclaim(5) == 2  # drains leaf-at-a-time until dry
+        assert c.n_nodes == 0 and a.free_blocks == 9
+
+    def test_referenced_leaves_are_not_evictable(self):
+        a, c = self._setup()
+        assert a.ensure(0, 8)
+        tokens = list(range(1, 9))
+        c.insert(tokens, a.owned_blocks(0))
+        assert c.reclaim(4) == 0  # slot 0 still references both
+        a.release(0)
+        assert c.reclaim(4) == 2
+
+    def test_can_cover_counts_only_freeable_subtrees(self):
+        """``can_cover`` promises only what ``reclaim`` can deliver: a
+        cached ancestor whose descendant is referenced by a live slot
+        never becomes an evictable leaf, so it must not be counted —
+        even though the ``blocks_cached`` gauge still includes it."""
+        a, c = self._setup(num_blocks=6)  # 5 allocatable
+        assert a.ensure(0, 8)  # chain of 2: ancestor -> deep
+        tokens = list(range(1, 9))
+        blocks = a.owned_blocks(0)
+        c.insert(tokens, blocks)
+        a.release(0)
+        # whole chain evictable: 3 free + 2 reclaimable covers 5 blocks
+        assert c.reclaimable() == 2
+        assert a.can_cover(1, 20)
+        # a live slot adopts the DEEP block: the cached ancestor is
+        # pinned (interior node over a referenced descendant)
+        a.adopt(1, [blocks[1]])
+        assert c.reclaimable() == 0
+        assert a.blocks_cached() == 1  # the gauge still counts it...
+        assert not a.can_cover(1, 20)  # ...but the promise must not
+        assert not a.ensure(1, 20)  # and ensure indeed fails
+
+    def test_hopeless_ensure_keeps_the_cache(self):
+        """An ensure that cannot succeed even after full eviction must
+        not flush the hot cache on its way to False — every follower
+        would re-prefill for an admission that deferred anyway."""
+        a, c = self._setup(num_blocks=6)  # 5 allocatable
+        assert a.ensure(0, 12)  # 3 blocks live
+        assert a.ensure(1, 8)   # 2 blocks
+        c.insert(list(range(1, 9)), a.owned_blocks(1))
+        a.release(1)            # 2 cached, 0 free
+        assert not a.ensure(1, 16)  # needs 4 > 0 free + 2 reclaimable
+        assert c.evictions == 0 and a.blocks_cached() == 2
+
+    def test_ensure_drives_reclaim_through_the_hook(self):
+        a, c = self._setup(num_blocks=6)  # 5 allocatable
+        assert a.ensure(0, 8)  # 2 blocks
+        c.insert(list(range(1, 9)), a.owned_blocks(0))
+        a.release(0)
+        assert a.blocks_cached() == 2 and a.free_blocks == 3
+        # needs 5 > 3 free: the hook evicts both cached blocks
+        assert a.ensure(1, 20)
+        assert c.evictions == 2 and a.blocks_cached() == 0
+
+
+class TestAccountingPlanes:
+    def test_rollup_and_summary_carry_the_prefix_section(self, lm):
+        engine = _engine(lm, prefix_cache="on")
+        shared = [(i % (VOCAB - 1)) + 1 for i in range(16)]
+        reqs = [(shared + [4, 4], 3), (shared + [5], 3), (list(shared), 3)]
+        _streams, sched = _run_stream(engine, reqs)
+        px = sched.summary().get("prefix_cache")
+        assert px is not None
+        assert px["lookups"] == 3 and px["hits"] == 2
+        assert px["hit_rate"] == round(2 / 3, 4)
+        assert px["prompt_tokens"] == 18 + 17 + 16
+        assert px["hit_tokens"] == 32
+        assert px["prefilled_tokens"] == 18 + 1 + 1
+        assert px["cow_blocks"] == 1
+        # off engines emit no prefix events -> section absent, not empty
+        off = _engine(lm, prefix_cache="off")
+        _streams2, sched2 = _run_stream(off, reqs)
+        assert "prefix_cache" not in sched2.summary()
+
+    def test_metrics_tap_and_gauges(self, lm):
+        from chainermn_tpu.observability import metrics
+        from chainermn_tpu.observability import trace as obs_trace
+
+        metrics.reset()
+        reg = metrics.install_tap()
+        rec = obs_trace.enable(None)
+        try:
+            engine = _engine(lm, prefix_cache="on")
+            shared = [(i % (VOCAB - 1)) + 1 for i in range(16)]
+            reqs = [(shared + [4, 4], 3), (list(shared), 3)]
+            _run_stream(engine, reqs)
+            assert reg.counter("kv_prefix_lookups_total").value() == 2.0
+            assert reg.counter("kv_prefix_hits_total").value() == 1.0
+            assert reg.counter(
+                "kv_prefix_hit_tokens_total").value() == 16.0
+            assert reg.counter(
+                "kv_prefix_prefill_tokens_total").value() == 19.0
+            assert reg.counter(
+                "kv_prefix_cow_blocks_total").value() == 1.0
+            # admit-time gauges (engine state, not events)
+            assert reg.gauge("kv_prefix_hit_rate").value() == \
+                pytest.approx(16.0 / 34.0)
+            assert reg.gauge("kv_prefix_trie_blocks").value() == 2.0
+            assert reg.gauge("kv_blocks_cached").value() is not None
+            assert reg.gauge("kv_blocks_shared").value() is not None
+        finally:
+            obs_trace.disable()
+            metrics.reset()
+
+    def test_dense_engine_forces_prefix_off(self, lm):
+        engine = _engine(lm, prefix_cache="auto", decode_impl="dense")
+        assert not engine.prefix_cache_enabled
+        assert engine.prefix_trie_blocks() is None
+        d = {x["name"]: x for x in engine.decisions}
+        assert d["prefix_cache"]["winner"] == "off"
+        assert d["prefix_cache"]["source"] == "forced:dense"
+
+    def test_validation(self, lm):
+        with pytest.raises(ValueError, match="prefix_cache"):
+            _engine(lm, prefix_cache="maybe")
+        # same typo, same error on a DENSE engine — the forced-off
+        # shortcut must not swallow validation
+        with pytest.raises(ValueError, match="prefix_cache"):
+            _engine(lm, prefix_cache="maybe", decode_impl="dense")
+        with pytest.raises(ValueError, match="min_shared_blocks"):
+            _engine(lm, prefix_cache="on", min_shared_blocks=0)
+
+    def test_min_shared_blocks_gates_adoption(self, lm):
+        engine = _engine(lm, prefix_cache="on", min_shared_blocks=2)
+        first = [(i % (VOCAB - 1)) + 1 for i in range(8)]  # ONE block
+        s0, _, _ = engine.prefill_join(first + [3])
+        engine.leave(s0)
+        _, _, _ = engine.prefill_join(first + [5, 6])
+        info = engine.last_prefix_info
+        assert info["hit_blocks"] == 0  # 1-block match < threshold
+        assert engine.prefix_stats["hits"] == 0

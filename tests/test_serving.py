@@ -412,10 +412,15 @@ class TestSchedulerAndAccounting:
         assert roll["ttft_ms_p50"] is not None
         assert roll["ttft_ms_p99"] >= roll["ttft_ms_p50"] >= 0.0
         assert "speculation" not in roll  # plain engine: no spec keys
-        # no serving events -> section omitted, not empty
+        # no serving-family events -> section omitted, not empty
+        # (prefix_cache/speculate are serving-family too, ISSUE 5/7)
         assert obs_trace.summarize_serving(
-            [e for e in events if e.get("kind") != "serving"]
+            [e for e in events if e.get("kind") not in
+             ("serving", "speculate", "prefix_cache")]
         ) is None
+        # ...and the paged default engine's prefix events roll up
+        px = roll.get("prefix_cache")
+        assert px is not None and px["lookups"] == len(reqs)
 
     def test_fcfs_preserves_arrival_order_of_admission(self, lm):
         from chainermn_tpu.observability import trace as obs_trace

@@ -34,8 +34,11 @@ Sections:
   tick latency for 1..K+1 tokens), TTFT (submit → first token) p50/p99,
   mean slot occupancy, and queue-wait/prefill means. When ``speculate``
   events exist (ISSUE 5), adds drafted/accepted token counts, the
-  acceptance rate, and an accept-length histogram. Omitted when the
-  trace has no serving events.
+  acceptance rate, and an accept-length histogram. When
+  ``prefix_cache`` events exist (ISSUE 7), adds the prefix-sharing
+  rollup: admission lookups/hits, prompt vs prefilled vs cache-served
+  token totals (the measured prefill-work reduction) and COW copies.
+  Omitted when the trace has no serving events.
 - **stragglers** — flagged divergence reports, if any.
 - **roofline** — where a device kind with a known HBM peak appears
   (bench.py's per-kind tables, the same floors tools/byte_audit.py
@@ -376,6 +379,16 @@ def render_text(s: dict) -> str:
             )
             if hist:
                 lines.append(f"  accept-length histogram: {hist}")
+        px = sv.get("prefix_cache")
+        if px:
+            lines.append(
+                f"  prefix cache: {px['hits']}/{px['lookups']} admissions "
+                f"hit ({px['hit_rate'] * 100:.1f}%), "
+                f"{px['prefilled_tokens']}/{px['prompt_tokens']} prompt "
+                f"tokens prefilled ({px['hit_tokens']} served from "
+                f"cache), {px['cow_blocks']} COW block cop"
+                f"{'y' if px['cow_blocks'] == 1 else 'ies'}"
+            )
         # queue_wait and prefill are separate events: a truncated trace
         # may carry one without the other — guard each independently.
         if sv.get("queue_wait_ms_mean") is not None:
