@@ -74,6 +74,27 @@ def recv(received: PyTree, *, delegate: Optional[PyTree] = None) -> PyTree:
     return received
 
 
+def stream_blocks(blocks: PyTree, src: int, dst: int,
+                  axis_name: str) -> PyTree:
+    """Move a whole KV-block pytree from shard ``src`` to shard ``dst``
+    — one :func:`send_recv` (``lax.ppermute``) per leaf, scheduled by
+    XLA as one program.
+
+    The in-mesh rehearsal of the cluster serving plane's KV handoff
+    (:mod:`chainermn_tpu.serving.cluster.kv_transfer`): when prefill
+    and decode replicas live on one mesh, the block payload can ride
+    ICI instead of the host TCP plane. The production handoff is
+    host-plane by contract (replicas own independent compiled
+    programs; a device collective would couple them) — this helper
+    exists so the device path is exercised and measured
+    (``tests/test_cluster.py``), not asserted in prose. Result: the
+    payload on shard ``dst``, zeros elsewhere (SPMD), differentiable
+    like every transfer here.
+    """
+    return jax.tree.map(lambda x: send_recv(x, src, dst, axis_name),
+                        blocks)
+
+
 def pseudo_connect(delegate: PyTree, actual: PyTree) -> PyTree:
     """Graft ``delegate``'s graph edges onto ``actual``.
 

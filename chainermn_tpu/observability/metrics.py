@@ -616,6 +616,34 @@ def _tap_event(ev: Mapping[str, Any]) -> None:
         if cow:
             reg.counter("kv_prefix_cow_blocks_total",
                         "copy-on-write block copies").inc(cow)
+    elif kind == "route":
+        reg.counter(
+            "cluster_routes_total",
+            "requests placed on a replica by the cluster router",
+        ).inc(rank=str(ev.get("replica")))
+        if ev.get("requeue"):
+            reg.counter(
+                "cluster_requeues_total",
+                "requests re-routed after a deferral or replica loss",
+            ).inc()
+    elif kind == "kv_transfer":
+        reg.counter(
+            "kv_transfer_total",
+            "cross-replica KV handoffs (disaggregated prefill/decode)",
+        ).inc()
+        reg.counter(
+            "kv_transfer_bytes_total",
+            "KV block bytes streamed between replicas",
+        ).inc(float(ev.get("nbytes") or 0))
+        reg.counter(
+            "kv_transfer_blocks_total",
+            "KV blocks streamed between replicas",
+        ).inc(float(ev.get("blocks") or 0))
+        if ev.get("dur_s") is not None:
+            reg.histogram(
+                "kv_transfer_seconds",
+                "export -> adoption latency of one KV handoff",
+            ).observe(float(ev["dur_s"]))
     elif kind == "straggler":
         reg.counter("straggler_reports_total",
                     "straggler-monitor flag reports").inc()

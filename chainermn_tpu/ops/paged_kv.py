@@ -68,6 +68,40 @@ def paged_update(pool, block_tables, positions, new):
     )
 
 
+def extract_block(pool, blk):
+    """Read one physical block out of the pool: ``pool[blk]`` with the
+    block axis kept (``[..., 1, block_size, kv_heads, head_dim]``) —
+    the device half of the cross-replica KV handoff
+    (:mod:`chainermn_tpu.serving.cluster.kv_transfer`): the serialized
+    form a prefill replica streams to a decode replica over the host
+    plane. Addressed like :func:`copy_block` at ``ndim - 4``, so one
+    program serves the plain pool and the tensor-parallel ``[shards,
+    num_blocks, ...]`` stacks (the per-shard slices travel together and
+    land shard-for-shard — no cross-shard traffic, zero collectives).
+    For a DENSE cache (``[slots, L, kvh, dh]``) axis ``ndim - 4`` is
+    the slot axis: the same call extracts a slot's whole row.
+    ``blk`` is a traced int32 scalar: one compiled program per engine.
+    """
+    axis = pool.ndim - 4
+    return jax.lax.dynamic_index_in_dim(pool, blk, axis=axis,
+                                        keepdims=True)
+
+
+def inject_block(pool, blk, payload):
+    """Write one serialized block back into the pool:
+    ``pool[blk] <- payload`` along the block axis (``ndim - 4``) — the
+    adopting side of the cross-replica KV handoff. ``payload`` is an
+    :func:`extract_block` result (block axis kept), possibly from a
+    DIFFERENT process's pool of the same layout. Pure dynamic-update:
+    zero collectives, one compiled program per engine (``blk``
+    traced); the engine donates the cache through its jit wrapper so
+    adoption never reallocates the pool.
+    """
+    axis = pool.ndim - 4
+    return jax.lax.dynamic_update_slice_in_dim(pool, payload, blk,
+                                               axis=axis)
+
+
 def copy_block(pool, src, dst):
     """Copy one physical block: ``pool[dst] <- pool[src]`` along the
     block axis (the copy-on-write primitive behind cross-request prefix

@@ -1,0 +1,41 @@
+"""Cluster serving plane (ISSUE 8): a multi-replica router with
+disaggregated prefill/decode and KV-block streaming over the host p2p
+plane. See docs/serving.md "Cluster serving" for the contract — the
+short form: N independent engines over a ``replica × model`` device
+partition, a front door doing least-loaded / prefix-cache-aware /
+sticky placement, and (disaggregated) prefill replicas streaming
+finished KV blocks to decode replicas so decode starts without
+re-prefilling — with every routed stream bit-identical to sequential
+``generate``."""
+
+from chainermn_tpu.serving.cluster.kv_transfer import (
+    LoopbackHub,
+    mesh_stream_blocks,
+    recv_kv,
+    send_kv,
+    transfer_kv,
+)
+from chainermn_tpu.serving.cluster.replica import (
+    ROLES,
+    Replica,
+    make_replicas,
+)
+from chainermn_tpu.serving.cluster.router import (
+    DISAGG_MODES,
+    ROUTE_POLICIES,
+    Router,
+)
+
+__all__ = [
+    "Replica",
+    "Router",
+    "LoopbackHub",
+    "DISAGG_MODES",
+    "ROLES",
+    "ROUTE_POLICIES",
+    "make_replicas",
+    "mesh_stream_blocks",
+    "recv_kv",
+    "send_kv",
+    "transfer_kv",
+]

@@ -1,0 +1,163 @@
+"""KV-block streaming between serving replicas (ISSUE 8 — the creative
+step of disaggregated prefill/decode).
+
+A prefill replica runs the bucketed prefill, then its slot's finished
+KV blocks are serialized (``ServingEngine.export_kv``: per-block device
+gathers — zero collectives — D2H'd to numpy) and streamed to a decode
+replica, whose ``import_kv`` allocates covering blocks from its OWN
+``BlockAllocator`` (fresh physical ids, refcount 1 — the source's block
+numbering never crosses the wire, so a release on either side can never
+corrupt the other) and injects the payload, and decode starts without
+re-prefilling. HiCCL (2408.05962) and The Big Send-off (2504.18658)
+argue exactly this: the cross-level transfer is a first-class,
+topology-aware plane — here it gets its own module, its own trace
+event, and its own byte accounting instead of being an engine side
+effect.
+
+Two planes:
+
+- **Host plane (production).** Any object with ``send_obj``/
+  ``recv_obj`` carries payloads — :class:`~chainermn_tpu.native
+  .tcp_comm.TcpHostComm`/``TcpGroupComm`` across processes (per-pair
+  FIFO, the property the pending-handoff queues lean on), or the
+  in-process :class:`LoopbackHub` for single-process clusters and
+  tests. Replicas keep independent compiled programs; the handoff adds
+  **no HLO collectives anywhere** (structural pin in
+  ``tests/test_cluster.py``).
+- **In-mesh rehearsal.** When replicas share one mesh, the same block
+  pytree can ride ICI: :func:`mesh_stream_blocks` wraps
+  :func:`chainermn_tpu.functions.point_to_point.stream_blocks` (one
+  ``lax.ppermute`` per leaf) so the device path is exercised and
+  measured, not asserted — it is NOT the production path (a device
+  collective would couple the replicas' programs).
+
+Every successful handoff is one ``kv_transfer`` trace event
+(``docs/observability.md``): request, src/dst replica, nbytes, block
+count, ``dur_s`` (export → adoption — the latency inside the
+disaggregated TTFT).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Optional
+
+
+class LoopbackHub:
+    """In-process transport hub mirroring the host-plane p2p surface:
+    ``endpoint(rank)`` returns an object with ``send_obj``/``recv_obj``/
+    ``probe`` over per-pair FIFO deques — the single-process cluster's
+    stand-in for ``TcpHostComm`` (same interface, same ordering
+    guarantee), so the router's transfer path is identical code
+    whether replicas share a process or not."""
+
+    def __init__(self) -> None:
+        self._chans: dict = {}
+
+    def _chan(self, src: int, dst: int) -> deque:
+        return self._chans.setdefault((int(src), int(dst)), deque())
+
+    def endpoint(self, rank: int) -> "LoopbackEndpoint":
+        return LoopbackEndpoint(self, int(rank))
+
+
+class LoopbackEndpoint:
+    def __init__(self, hub: LoopbackHub, rank: int) -> None:
+        self._hub = hub
+        self.rank = rank
+
+    def send_obj(self, obj: Any, dest: int) -> None:
+        self._hub._chan(self.rank, dest).append(obj)
+
+    def recv_obj(self, source: int) -> Any:
+        chan = self._hub._chan(source, self.rank)
+        if not chan:
+            # Same-process loopback: a blocking wait here would be a
+            # self-deadlock by construction — surface the protocol bug.
+            raise LookupError(
+                f"loopback recv from {source}: nothing pending "
+                "(send before recv on an in-process hub)"
+            )
+        return chan.popleft()
+
+    def probe(self, source: int) -> bool:
+        return bool(self._hub._chan(source, self.rank))
+
+
+def send_kv(transport, payload: dict, dest: int) -> int:
+    """Ship one ``export_kv`` payload over the host plane (pickled by
+    the transport — numpy blocks travel as-is). Returns the payload's
+    block bytes (the wire accounting the router rolls up)."""
+    transport.send_obj(payload, dest)
+    return int(payload["nbytes"])
+
+
+def recv_kv(transport, source: int) -> dict:
+    """Receive one payload from ``source`` (blocking on the TCP plane;
+    per-pair FIFO means it is the next one the peer sent)."""
+    payload = transport.recv_obj(source)
+    if not isinstance(payload, dict) or payload.get("schema") != 1:
+        raise ValueError(
+            f"kv_transfer: unexpected payload from rank {source}: "
+            f"{type(payload).__name__}"
+        )
+    return payload
+
+
+def transfer_kv(src_engine, dst_engine, slot: int, *,
+                transport_src=None, transport_dst=None,
+                src: int = 0, dst: int = 1,
+                release: bool = True) -> Optional[tuple]:
+    """One whole handoff, in-process: export ``slot`` from
+    ``src_engine``, optionally round-trip the payload through a
+    transport pair (loopback realism / byte accounting on the real
+    plane), adopt into ``dst_engine``. Returns ``(new_slot, last_tok,
+    nbytes, dur_s)`` or None when the destination cannot place it
+    right now (source slot is left UNRELEASED in that case so nothing
+    is lost — the caller retries or routes elsewhere).
+
+    The router uses the split halves (export → queue → adopt) so a
+    full destination defers instead of blocking; this fused form is
+    the unit-test / notebook surface.
+    """
+    t0 = time.perf_counter()
+    payload = src_engine.export_kv(slot)
+    if transport_src is not None:
+        send_kv(transport_src, payload, dst)
+        payload = recv_kv(transport_dst, src)
+    res = dst_engine.import_kv(payload)
+    if res is None:
+        return None
+    if release:
+        src_engine.leave(slot)
+    new_slot, tok = res
+    return new_slot, tok, int(payload["nbytes"]), time.perf_counter() - t0
+
+
+def mesh_stream_blocks(blocks, src: int, dst: int, mesh,
+                       axis_name: str = "replica"):
+    """The in-mesh rehearsal: move a ``[n, ...]``-stacked block pytree
+    from mesh shard ``src`` to shard ``dst`` in ONE jitted program
+    (``lax.ppermute`` per leaf via
+    :func:`~chainermn_tpu.functions.point_to_point.stream_blocks`).
+    Returns the stacked pytree with ``dst``'s slice holding ``src``'s
+    payload and zeros elsewhere — the caller slices its shard out.
+    Rehearsal-only (see module docstring): the production handoff is
+    host-plane by contract."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.functions.point_to_point import stream_blocks
+
+    def local(tree):
+        tree = jax.tree.map(lambda a: a[0], tree)
+        out = stream_blocks(tree, src, dst, axis_name)
+        return jax.tree.map(lambda a: a[None], out)
+
+    fn = jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P(axis_name),),
+        out_specs=P(axis_name), check_vma=False,
+    ))
+    return fn(blocks)

@@ -1,0 +1,139 @@
+"""Serving replicas: N independent ``ServingEngine`` + ``Scheduler``
+pairs over a ``replica × model`` device partition (ISSUE 8).
+
+The single-engine ceiling was one slot array; a replica set keeps the
+engine contract COMPLETELY unchanged — each replica owns its own
+compiled programs, its own paged pool, its own prefix trie — and
+scales by topology instead: replica ``r`` gets the device slice
+``devices[r*tp : (r+1)*tp]`` as its own ``('model',)`` mesh, so
+tensor-parallel decode inside a replica stays pinned at 2 all-reduces
+per layer (the PR 4 HLO-count test re-asserted on a cluster replica in
+``tests/test_cluster.py``) and NOTHING couples replicas on the device
+plane — cross-replica traffic is host-plane only (the router and
+``kv_transfer``).
+
+The reference's whole inference surface was a per-sentence loop
+(``examples/seq2seq/seq2seq.py`` †) — everything here is new-subsystem
+territory; the partition shape follows the ROADMAP's "millions of
+users is a topology question" framing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+#: what work a replica accepts from the router (disaggregated mode):
+#: ``both`` = colocated prefill+decode, ``prefill`` = runs bucketed
+#: prefills and streams the KV out, ``decode`` = adopts streamed KV
+#: and decodes.
+ROLES = ("both", "prefill", "decode")
+
+
+class Replica:
+    """One engine + scheduler under a router: identity (``replica_id``
+    — the ``rank`` label on its gauges/events), role, and the load /
+    cache signals the router's placement consults."""
+
+    def __init__(self, engine, scheduler, replica_id: int,
+                 role: str = "both") -> None:
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        self.engine = engine
+        self.scheduler = scheduler
+        self.replica_id = int(replica_id)
+        self.role = role
+        self.alive = True
+
+    # ---- routing signals --------------------------------------------
+
+    def load(self) -> int:
+        """Queued + in-flight requests on this replica's scheduler —
+        the least-loaded policy's primary signal."""
+        return self.scheduler.pending + self.scheduler.in_flight
+
+    def slots_free(self) -> int:
+        return self.engine.free_slot_count
+
+    def kv_blocks_free(self) -> Optional[int]:
+        """Free paged-pool blocks (None under dense) — the PR 6
+        ``kv_blocks_free`` gauge, read directly from engine state."""
+        return self.engine.kv_blocks_free()
+
+    def prefix_hit_blocks(self, prompt) -> int:
+        """FULL blocks of ``prompt`` this replica's prefix trie already
+        holds (read-only probe) — the cache-aware placement signal: a
+        deeper hit means less prefill work HERE than anywhere else."""
+        return self.engine.prefix_match_depth(prompt)
+
+    # ---- drive ------------------------------------------------------
+
+    def tick(self) -> bool:
+        return self.scheduler.tick()
+
+    @property
+    def drained(self) -> bool:
+        return self.scheduler.drained
+
+    def summary(self) -> dict:
+        return self.scheduler.summary()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return (f"Replica(id={self.replica_id}, role={self.role}, "
+                f"load={self.load()}, alive={self.alive})")
+
+
+def make_replicas(model, params, n_replicas: int, *, tp: int = 1,
+                  devices: Optional[Sequence] = None,
+                  policy: str = "prefill_priority",
+                  roles: Optional[Sequence[str]] = None,
+                  **engine_kw) -> list[Replica]:
+    """Build ``n_replicas`` engine+scheduler pairs over a ``replica ×
+    model`` partition of ``devices``.
+
+    ``tp >= 2``: replica ``r`` owns ``devices[r*tp:(r+1)*tp]`` as its
+    ``('model',)`` mesh — tensor-parallel decode inside the replica,
+    full device-plane isolation between replicas (raises when the
+    device pool cannot cover ``n_replicas * tp``). ``tp == 1``:
+    engines run unmeshed on the default device (same-process replicas
+    then overlap through async dispatch only — the CPU-proxy/bench
+    honest floor; give each replica real chips via ``tp``).
+
+    ``roles`` (optional, per replica — default all ``'both'``) feeds
+    the router's disaggregated mode. Remaining kwargs go to every
+    ``ServingEngine`` verbatim (one config, N replicas: ``import_kv``
+    refuses mismatched layouts loudly, so heterogeneous clusters must
+    be assembled by hand, eyes open).
+    """
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from chainermn_tpu.serving.engine import ServingEngine
+    from chainermn_tpu.serving.scheduler import Scheduler
+
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    if roles is not None and len(roles) != n_replicas:
+        raise ValueError(
+            f"roles covers {len(roles)} replicas, need {n_replicas}")
+    if tp > 1:
+        import jax
+
+        devices = list(devices) if devices is not None else jax.devices()
+        need = n_replicas * tp
+        if len(devices) < need:
+            raise ValueError(
+                f"replica × model partition needs {need} devices "
+                f"({n_replicas} replicas × tp={tp}), have {len(devices)}"
+            )
+    replicas = []
+    for r in range(n_replicas):
+        mesh = None
+        if tp > 1:
+            mesh = Mesh(np.array(devices[r * tp:(r + 1) * tp]),
+                        ("model",))
+        engine = ServingEngine(model, params, mesh=mesh, **engine_kw)
+        replicas.append(Replica(
+            engine, Scheduler(engine, policy=policy), r,
+            role=roles[r] if roles is not None else "both",
+        ))
+    return replicas

@@ -489,8 +489,16 @@ class ServingEngine:
         #: per-slot committed token history (prompt + generated incl.
         #: the pending last token) — what the drafter proposes from.
         self._history: list[list[int]] = [[] for _ in range(num_slots)]
+        #: the resolution key every serving decision rode (the cluster
+        #: router resolves its disaggregation decision under the same
+        #: key — one key per model shape, ISSUE 8).
+        self.decision_key = key
         self._tables_dev = None  # device copy of the block tables...
         self._tables_ver = -1    # ...valid while allocator.version holds
+        # Cross-replica KV handoff programs (ISSUE 8): built lazily on
+        # the first export/import — most engines never transfer.
+        self._kv_extract_jit = None
+        self._kv_inject_jit = None
         self._decode_step_jit = self._build_decode_step()
         self._verify_step_jit = (
             self._build_verify_step() if self.spec_tokens > 0 else None
@@ -1118,6 +1126,245 @@ class ServingEngine:
                  "accept_lens": accept_lens}
         self._publish_pool_gauges()
         return committed, dur, stats
+
+    # ------------------------------------------------------------------
+    # cross-replica KV handoff (ISSUE 8): the engine-side hooks behind
+    # chainermn_tpu.serving.cluster.kv_transfer — a prefill replica
+    # EXPORTS a slot's finished KV as host numpy blocks, a decode
+    # replica IMPORTS them into freshly-allocated blocks of its OWN
+    # pool and adopts the slot metadata, so decode starts without
+    # re-prefilling. Pure block slicing on the device plane (zero
+    # collectives, structurally pinned); everything else is host state.
+
+    def prefix_match_depth(self, prompt) -> int:
+        """FULL blocks of ``prompt`` this engine's prefix trie holds —
+        the router's cache-aware placement signal (read-only probe, no
+        LRU touch). 0 when prefix sharing is off."""
+        if self._prefix is None:
+            return 0
+        return self._prefix.match_depth(
+            [int(t) for t in np.asarray(prompt).reshape(-1)]
+        )
+
+    def kv_blocks_free(self) -> Optional[int]:
+        """Free paged-pool blocks (None under dense) — the same number
+        the ``kv_blocks_free`` gauge publishes; the router reads it
+        before placing work."""
+        return self._alloc.free_blocks if self._alloc is not None else None
+
+    def kv_signature(self) -> tuple:
+        """Layout fingerprint two engines must share for KV blocks to
+        be portable between their pools: decode impl, paged block
+        size, and every cache leaf's shape-minus-the-block-axis plus
+        dtype (the block axis is ``ndim - 4`` — the pool's block count
+        for paged, the slot axis for dense — and MAY differ between
+        replicas; a TP stack's leading shard axis is part of the shape,
+        so differing TP degrees refuse loudly)."""
+        import jax
+
+        leaves = jax.tree.leaves(self._cache)
+        axis_sig = tuple(
+            (leaf.shape[:leaf.ndim - 4] + leaf.shape[leaf.ndim - 3:],
+             str(leaf.dtype))
+            for leaf in leaves
+        )
+        return (self.decode_impl,
+                self._alloc.block_size if self._alloc else None,
+                self.max_len, axis_sig)
+
+    def _kv_io(self):
+        """The two (lazily built) handoff programs: ``extract(cache,
+        blk)`` gathers one block across every pool leaf, ``inject
+        (cache, blk, payload)`` scatters one serialized block back.
+        No axis primitive anywhere, so ZERO collectives (the
+        structural test compiles both and counts) — under TP they
+        still ride a ``shard_map`` so the cache keeps its mesh
+        sharding through the donation: a plain jit would return
+        default-sharded leaves and the next decode step would
+        RECOMPILE (caught live by dryrun phase J's compile-count pin);
+        each shard simply slices its own block piece. The inject
+        donates the cache: adoption never reallocates."""
+        if self._kv_extract_jit is None:
+            import jax
+
+            from chainermn_tpu.ops.paged_kv import extract_block, \
+                inject_block
+
+            if self._mesh is None:
+                self._kv_extract_jit = jax.jit(
+                    lambda cache, blk: jax.tree.map(
+                        lambda pool: extract_block(pool, blk), cache))
+                self._kv_inject_jit = jax.jit(
+                    lambda cache, blk, payload: jax.tree.map(
+                        lambda pool, p: inject_block(pool, blk, p),
+                        cache, payload),
+                    donate_argnums=(0,),
+                )
+            else:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                mesh = self._mesh
+
+                def ex_local(cache, blk):
+                    cache = jax.tree.map(lambda a: a[0], cache)
+                    out = jax.tree.map(
+                        lambda pool: extract_block(pool, blk), cache)
+                    return jax.tree.map(lambda a: a[None], out)
+
+                def in_local(cache, blk, payload):
+                    cache = jax.tree.map(lambda a: a[0], cache)
+                    payload = jax.tree.map(lambda a: a[0], payload)
+                    out = jax.tree.map(
+                        lambda pool, p: inject_block(pool, blk, p),
+                        cache, payload)
+                    return jax.tree.map(lambda a: a[None], out)
+
+                self._kv_extract_jit = jax.jit(shard_map(
+                    ex_local, mesh=mesh, in_specs=(P("model"), P()),
+                    out_specs=P("model"), check_vma=False,
+                ))
+                self._kv_inject_jit = jax.jit(
+                    shard_map(
+                        in_local, mesh=mesh,
+                        in_specs=(P("model"), P(), P("model")),
+                        out_specs=P("model"), check_vma=False,
+                    ),
+                    donate_argnums=(0,),
+                )
+        return self._kv_extract_jit, self._kv_inject_jit
+
+    def export_kv(self, slot: int) -> dict:
+        """Serialize ``slot``'s written KV + stream metadata for
+        adoption by another engine (:meth:`import_kv`). Paged engines
+        ship only the blocks covering the written positions ``[0,
+        position)``; dense engines ship the slot's whole ring row (one
+        "block" — the honest cost of disaggregating a dense layout,
+        and the reason the paged impl is the cluster default). The
+        export only READS (the slot stays live — callers that hand the
+        stream off ``leave()`` afterwards); trailing in-block garbage
+        travels as-is and stays masked by positions on both sides."""
+        import jax
+
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        extract, _ = self._kv_io()
+        import jax.numpy as jnp
+
+        pos = int(self._positions[slot])
+        if self._alloc is not None:
+            bs = self._alloc.block_size
+            phys = self._alloc.owned_blocks(slot)[:-(-pos // bs)]
+        else:
+            phys = [slot]
+        # Dispatch every block's extract asynchronously, then ONE
+        # device_get for the whole payload: a per-block np.asarray
+        # would be a blocking D2H per leaf per block — the exact
+        # tunnelled-TPU round-trip trap the version-keyed tables exist
+        # to avoid (review finding).
+        device_blocks = [
+            jax.tree.leaves(extract(self._cache, jnp.int32(b)))
+            for b in phys
+        ]
+        blocks = jax.device_get(device_blocks)
+        return {
+            "schema": 1,
+            "signature": self.kv_signature(),
+            "tokens": list(self._history[slot]),
+            "position": pos,
+            "last_tok": int(self._last_tok[slot]),
+            "blocks": blocks,
+            "nbytes": sum(a.nbytes for blk in blocks for a in blk),
+        }
+
+    def import_kv(self, payload: dict):
+        """Adopt an :meth:`export_kv` payload: claim a slot, allocate
+        covering blocks from THIS pool (fresh ids — the source's block
+        numbering never leaks across allocators; refcounts start at 1
+        here, so a release on either side can never corrupt the
+        other), inject the serialized blocks, and restore the stream
+        metadata so the next ``decode_step`` continues the stream
+        bit-identically. Returns ``(slot, last_tok)``, or None when no
+        slot / not enough pool right now (state untouched — the router
+        retries, the deferred-admission contract). A layout mismatch
+        raises: silently adopting foreign-shaped KV would corrupt
+        streams, not degrade them. With prefix sharing on, the
+        adopted FULL blocks are inserted into this engine's trie —
+        followers of the same prefix hit locally without their own
+        transfer."""
+        import jax
+
+        if payload.get("schema") != 1:
+            raise ValueError(
+                f"unknown kv payload schema {payload.get('schema')!r}")
+        if tuple(payload["signature"]) != self.kv_signature():
+            raise ValueError(
+                "kv payload layout mismatch: source "
+                f"{payload['signature']} vs target {self.kv_signature()} "
+                "— replicas must share decode_impl/kv_block_size/"
+                "max_len/model shape/TP degree"
+            )
+        pos = int(payload["position"])
+        if pos + 1 > self.max_len:
+            raise ValueError(
+                f"payload position {pos} leaves no room within "
+                f"max_len={self.max_len}"
+            )
+        if not self._free:
+            return None
+        slot = self._free[-1]  # peek; commit only after alloc succeeds
+        if self._alloc is not None:
+            if not self._alloc.ensure(slot, pos + 1):
+                return None  # all-or-nothing: nothing was adopted yet
+            bs = self._alloc.block_size
+            targets = self._alloc.owned_blocks(slot)[:-(-pos // bs)]
+        else:
+            targets = [slot]
+        if len(targets) != len(payload["blocks"]):
+            # structurally impossible when signatures match — guard
+            # against a truncated payload before touching the cache
+            if self._alloc is not None:
+                self._alloc.release(slot)
+            raise ValueError(
+                f"payload carries {len(payload['blocks'])} blocks, "
+                f"target needs {len(targets)}"
+            )
+        import jax.numpy as jnp
+
+        _, inject = self._kv_io()
+        treedef = jax.tree.structure(self._cache)
+        try:
+            for tgt, leaves in zip(targets, payload["blocks"]):
+                block_tree = jax.tree.unflatten(
+                    treedef, [jnp.asarray(a) for a in leaves]
+                )
+                self._cache = inject(self._cache, jnp.int32(tgt),
+                                     block_tree)
+        except Exception:
+            # Failed mid-injection (device OOM and kin): the slot was
+            # never committed — return its reserved blocks so the
+            # allocator stays consistent (written garbage is
+            # unreachable once the table points back at scratch).
+            if self._alloc is not None:
+                self._alloc.release(slot)
+            raise
+        self._free.pop()
+        self._positions[slot] = pos
+        self._last_tok[slot] = int(payload["last_tok"])
+        self._active[slot] = True
+        self._history[slot] = [int(t) for t in payload["tokens"]]
+        if self._prefix is not None:
+            # KV exists for tokens[:pos]; cache the FULL blocks (the
+            # prefill-completion rule — partial tails never inserted).
+            seq = self._history[slot][:pos]
+            full = len(seq) // self._alloc.block_size
+            if full:
+                self._prefix.insert(
+                    seq[:full * self._alloc.block_size],
+                    self._alloc.owned_blocks(slot)[:full],
+                )
+        self._publish_pool_gauges()
+        return slot, int(payload["last_tok"])
 
     def leave(self, slot: int) -> None:
         """Release a slot (host metadata + paged blocks only — the

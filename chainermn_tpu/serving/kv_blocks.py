@@ -378,6 +378,23 @@ class PrefixCache:
             node = child
         return out
 
+    def match_depth(self, tokens: Sequence[int]) -> int:
+        """How many FULL blocks of ``tokens`` the trie holds — a
+        READ-ONLY probe (no LRU stamp: the cluster router consults
+        every replica's trie per routing decision, and a probe that
+        touched stamps would let mere consideration pin chains a real
+        adoption never used). :meth:`lookup` remains the adopting
+        walk."""
+        node = self._root
+        depth = 0
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            depth += 1
+            node = child
+        return depth
+
     def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
         """Cache the FULL blocks of a completed prefill: ``blocks[j]``
         holds the KV of ``tokens[j*bs:(j+1)*bs]``. Chunks already cached

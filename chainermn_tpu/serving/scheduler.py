@@ -56,12 +56,19 @@ class Request:
     """One serving request: ``prompt`` tokens in, up to
     ``max_new_tokens`` generated tokens out (generation also stops at
     ``eos_id`` when given — the emitted EOS counts as generated, like
-    :func:`generate`'s fixed-horizon streams truncated at EOS)."""
+    :func:`generate`'s fixed-horizon streams truncated at EOS).
+
+    ``session_id`` (optional) marks a multi-turn conversation: the
+    cluster router (ISSUE 8) pins every request of a session to the
+    replica that served its first turn, so the per-replica prefix trie
+    stays warm across turns. The single-engine scheduler ignores it.
+    """
 
     prompt: Sequence[int]
     max_new_tokens: int
     request_id: Optional[str] = None
     eos_id: Optional[int] = None
+    session_id: Optional[str] = None
     _arrival: float = field(default=0.0, repr=False)
 
     def __post_init__(self) -> None:
@@ -197,7 +204,12 @@ class Scheduler:
                 f"duplicate request_id {rid!r} (reusing a Request from "
                 f"another scheduler? pass a fresh request_id)"
             )
-        request._arrival = time.perf_counter()
+        # Keep an existing arrival stamp (the cluster router stamps at
+        # ITS front door before placing — and re-places a dead
+        # replica's requests): queue-wait and TTFT then cover the whole
+        # journey, not just the last hop.
+        if not request._arrival:
+            request._arrival = time.perf_counter()
         self._queue.append(request)
         self._publish_gauges()
         return request.request_id
@@ -332,16 +344,125 @@ class Scheduler:
             if done:
                 self._finish(fl)
 
-    def run(self, max_steps: int = 100_000) -> dict:
+    def start_window(self) -> None:
+        """Begin a fresh accounting window: :meth:`summary` covers the
+        events from here to :meth:`close_window`. :meth:`run` calls
+        both; the cluster router (ISSUE 8) drives replicas through
+        :meth:`tick` and manages the windows itself."""
+        self._events = []
+        self.events_dropped = 0
+        self._window_t0 = time.perf_counter()
+
+    def close_window(self) -> None:
+        self._wall = time.perf_counter() - getattr(
+            self, "_window_t0", time.perf_counter())
+
+    @property
+    def event_window(self) -> list:
+        """The current window's locally-kept events (read-only use:
+        the cluster router aggregates cross-replica TTFT from them)."""
+        return self._events
+
+    @property
+    def drained(self) -> bool:
+        return not (self._queue or self._inflight)
+
+    def _admit_round(self) -> bool:
+        """One policy-shaped admission pass (the ONE implementation
+        :meth:`run` and :meth:`tick` share): prefill_priority drains
+        every admissible queued request, fcfs admits at most one."""
+        if self.policy == "prefill_priority":
+            progressed = False
+            while self._admit_one():
+                progressed = True
+            return progressed
+        return self._admit_one()
+
+    def tick(self) -> bool:
+        """One admission round + (when anything is in flight) one
+        decode step — the body of :meth:`run`'s loop, exposed so the
+        cluster router can interleave N replicas' progress in one host
+        loop. Returns whether anything progressed (an admission or a
+        decode step); a False on a non-drained scheduler means the
+        queue head is blocked on slots/pool — the caller decides
+        whether that is a deferral (other replicas will free capacity)
+        or a dead end."""
+        progressed = self._admit_round()
+        if self._inflight:
+            self.step()
+            progressed = True
+        return progressed
+
+    def admit_prefilled(self, request: Request, slot: int, first_tok: int,
+                        *, dur_s: Optional[float] = None) -> None:
+        """Register an in-flight entry for a slot the engine ALREADY
+        holds — the disaggregated-serving adoption path (ISSUE 8): a
+        prefill replica ran the bucketed prefill, its KV blocks were
+        streamed over the host plane, and this scheduler's engine
+        adopted them via ``import_kv``. Emits the same ``queue_wait`` /
+        ``prefill`` events an ordinary admission would (``ttft_s`` from
+        the request's original submit stamp, so the transfer cost is
+        inside the TTFT — honest disaggregation accounting; ``bucket``
+        is None: no prefill ran HERE), and finishes immediately when
+        the first token already satisfies the request."""
+        if not self.engine._active[slot]:
+            raise ValueError(f"slot {slot} is not active on this engine")
+        if slot in self._inflight:
+            raise ValueError(f"slot {slot} already tracked in flight")
+        if request.request_id is None:
+            request.request_id = f"r{next(self._ids)}"
+        now = time.perf_counter()
+        arrival = request._arrival or now
+        self._event(phase="queue_wait", request=request.request_id,
+                    dur_s=round(max(0.0, (now - arrival)
+                                    - (dur_s or 0.0)), 9))
+        self._event(phase="prefill", request=request.request_id,
+                    slot=slot, bucket=None,
+                    prompt_len=len(request.prompt),
+                    dur_s=round(dur_s or 0.0, 9),
+                    ttft_s=round(now - arrival, 9))
+        fl = _InFlight(request, slot,
+                       list(request.prompt) + [int(first_tok)], 1)
+        self._inflight[slot] = fl
+        self._publish_gauges()
+        if fl.generated >= request.max_new_tokens or (
+            request.eos_id is not None
+            and int(first_tok) == request.eos_id
+        ):
+            self._finish(fl)
+
+    def evacuate(self) -> list[Request]:
+        """Strip every queued AND in-flight request out of this
+        scheduler WITHOUT touching the engine (which may be dead — the
+        replica-loss path, ISSUE 8): returns the orphans in arrival
+        order so the router can re-route them. In-flight requests lose
+        their partial streams (greedy streams are deterministic, so a
+        re-prefill elsewhere reproduces the identical stream)."""
+        orphans = list(self._queue)
+        self._queue.clear()
+        inflight = sorted(self._inflight.values(),
+                          key=lambda fl: fl.request._arrival)
+        self._inflight.clear()
+        orphans.extend(fl.request for fl in inflight)
+        self._publish_gauges()
+        return orphans
+
+    def run(self, max_steps: int = 100_000,
+            max_seconds: Optional[float] = None) -> dict:
         """Drive admissions + decode until queue and slots drain;
         returns :attr:`results` (request_id -> token streams). The
         local accounting (:meth:`summary`) covers THIS run — each call
-        starts a fresh event window."""
+        starts a fresh event window.
+
+        ``max_seconds`` bounds the run by WALL CLOCK (checked once per
+        admission/decode round): on expiry the loop stops cleanly with
+        whatever is unfinished still queued/in flight — the open-loop
+        bench/dryrun bound (ISSUE 8 satellite), where ``max_steps``
+        stays the runaway guard and still raises."""
         from chainermn_tpu.observability import flight as _flight
 
-        self._events = []
-        self.events_dropped = 0
-        t0 = time.perf_counter()
+        self.start_window()
+        t0 = self._window_t0
         steps = 0
         try:
             while self._queue or self._inflight:
@@ -349,12 +470,11 @@ class Scheduler:
                 # round — the serving analog of the trainer's per-step
                 # beat.
                 _flight.beat(steps)
-                progressed = False
-                if self.policy == "prefill_priority":
-                    while self._admit_one():
-                        progressed = True
-                else:
-                    progressed = self._admit_one()
+                if max_seconds is not None and (
+                    time.perf_counter() - t0 >= max_seconds
+                ):
+                    break
+                progressed = self._admit_round()
                 if not self._inflight:
                     if self._queue and not progressed:
                         # nothing running AND the head cannot be
@@ -381,7 +501,7 @@ class Scheduler:
             # must not read as a hang — and must not waste the
             # fire-once dump on a non-hang (review finding).
             _flight.quiesce()
-        self._wall = time.perf_counter() - t0
+        self.close_window()
         return self.results
 
     # ------------------------------------------------------------------

@@ -261,7 +261,9 @@ def _seed_one_result(result: dict, source: str, out: list,
     # re-key the other phase's decisions if either shape ever diverges.
     m_px = (_SERVING_SHAPE.search(
         result.get("serving_prefix_model_shape", "")) or m)
-    if m or m_px:
+    m_cl = (_SERVING_SHAPE.search(
+        result.get("serving_cluster_model_shape", "")) or m)
+    if m or m_px or m_cl:
         from chainermn_tpu.tuning.measure import decide
 
         for row_key, spread_key, name in (
@@ -275,6 +277,8 @@ def _seed_one_result(result: dict, source: str, out: list,
              "prefix_cache"),
             ("serving_prefix_msb_ttft_ms",
              "serving_prefix_msb_spread_pct", "min_shared_blocks"),
+            ("serving_cluster_disagg_ttft_ms",
+             "serving_cluster_disagg_spread_pct", "cluster_disagg"),
         ):
             rows = result.get(row_key)
             if not (isinstance(rows, dict) and len(rows) >= 2 and all(
@@ -294,8 +298,12 @@ def _seed_one_result(result: dict, source: str, out: list,
                 spread = 10.0
             winner = decide(rows, {k: spread for k in rows})
             if winner is not None:
-                m_row = (m_px if name in ("prefix_cache",
-                                          "min_shared_blocks") else m)
+                if name in ("prefix_cache", "min_shared_blocks"):
+                    m_row = m_px
+                elif name == "cluster_disagg":
+                    m_row = m_cl
+                else:
+                    m_row = m
                 if m_row is None:
                     continue
                 key = _bucketed_key(kind, m_row.groups(), "decode")
@@ -315,6 +323,19 @@ def _seed_one_result(result: dict, source: str, out: list,
                     hr = result.get("serving_prefix_hit_rate")
                     if hr is not None:
                         evidence["hit_rate"] = hr
+                if name == "cluster_disagg":
+                    # the handoff's measured wire cost + the replica
+                    # scaling behind it — a 'disaggregated' entry the
+                    # next session can audit.
+                    for ev_key, row in (
+                        ("transfers", "serving_cluster_transfers"),
+                        ("transfer_bytes",
+                         "serving_cluster_transfer_bytes"),
+                        ("scaling", "serving_cluster_scaling"),
+                    ):
+                        v = result.get(row)
+                        if v is not None:
+                            evidence[ev_key] = v
                 put(name, key, winner, evidence)
 
     # Double buffering: the measured on/off step-time ratio.

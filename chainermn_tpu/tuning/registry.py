@@ -99,6 +99,10 @@ DEFAULT_TABLE: dict = {
     "spec_tokens": {"*": "0"},
     "prefix_cache": {"*": "on"},
     "min_shared_blocks": {"*": "1"},
+    # Cluster disaggregation (ISSUE 8): colocated until a bench capture
+    # shows the prefill/decode split wins TTFT on this shape — the
+    # transfer hop must EARN its place, like speculation.
+    "cluster_disagg": {"*": "colocated"},
 }
 
 _MODE_ENV = "CHAINERMN_TPU_AUTOTUNE"

@@ -1,0 +1,102 @@
+"""Worker for ``test_mp_disaggregated_handoff_over_tcp`` (ISSUE 8):
+rank 0 is a prefill replica, rank 1 a decode replica, KV payloads
+cross REAL process boundaries over the native TCP plane — the
+multi-process form of the handoff the in-process loopback tests
+rehearse. Both ranks init identical params (same seed, CPU backend),
+so rank 1 can check every adopted stream against its own sequential
+``generate`` reference."""
+
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from chainermn_tpu import _jax_compat  # noqa: E402,F401
+from chainermn_tpu.models.transformer import (  # noqa: E402
+    TransformerLM,
+    generate,
+)
+from chainermn_tpu.native.tcp_comm import TcpHostComm  # noqa: E402
+from chainermn_tpu.serving import (  # noqa: E402
+    Request,
+    Scheduler,
+    ServingEngine,
+)
+from chainermn_tpu.serving.cluster import recv_kv, send_kv  # noqa: E402
+
+VOCAB = 32
+N_REQUESTS = 4
+
+
+def build():
+    model = TransformerLM(
+        vocab_size=VOCAB, num_layers=2, num_heads=4, d_model=16,
+        d_ff=32, max_len=64, compute_dtype=jnp.float32,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    engine = ServingEngine(
+        model, params, num_slots=N_REQUESTS, max_len=64,
+        decode_impl="paged", kv_block_size=8, prefill_buckets=(4, 8, 16),
+    )
+    rs = np.random.RandomState(21)
+    shared = rs.randint(1, VOCAB, size=10).tolist()
+    reqs = [
+        (shared + rs.randint(1, VOCAB, size=int(rs.randint(2, 5))
+                             ).tolist(), int(rs.randint(2, 5)))
+        for _ in range(N_REQUESTS)
+    ]
+    return model, params, engine, reqs
+
+
+def main():
+    rank, size, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    assert size == 2
+    comm = TcpHostComm(rank, size, coord)
+    model, params, engine, reqs = build()
+
+    if rank == 0:
+        for prompt, _gen in reqs:
+            slot, _tok, _bucket = engine.prefill_join(prompt)
+            payload = engine.export_kv(slot)
+            engine.leave(slot)
+            send_kv(comm, payload, 1)
+        assert comm.recv_obj(1) == "adopted"
+    else:
+        sched = Scheduler(engine)
+        sched.start_window()
+        for i, (prompt, gen) in enumerate(reqs):
+            payload = recv_kv(comm, 0)
+            res = engine.import_kv(payload)
+            assert res is not None, "pool sized for the full burst"
+            slot, tok = res
+            sched.admit_prefilled(
+                Request(prompt=prompt, max_new_tokens=gen,
+                        request_id=f"mp{i}"),
+                slot, tok,
+            )
+        comm.send_obj("adopted", 0)
+        while not sched.drained:
+            sched.tick()
+        sched.close_window()
+        for i, (prompt, gen) in enumerate(reqs):
+            ref = np.asarray(generate(
+                model, params, jnp.asarray([prompt], jnp.int32),
+                len(prompt) + gen,
+            ))[0].tolist()
+            got = sched.results[f"mp{i}"]["tokens"]
+            assert got == ref, (i, got, ref)
+
+    comm.barrier()
+    comm.finalize()
+    print(f"CLUSTER_WORKER_OK {rank}")
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,30 @@ def main():
         gg = g.split(g.rank, key=0)
         assert gg.size == 1 and gg.allreduce_obj(rank) == rank
 
+        # Group-level probe on a peer that never sends (ISSUE 8: the
+        # router's health checks lean on this being BOUNDED): after a
+        # group barrier drains the pair channels, group-rank 1 blocks
+        # in recv and sends NOTHING until released — group-rank 0's
+        # probes must return False instantly, every time, and the
+        # translated reply must land on the right world-rank channel.
+        if g.size >= 2:
+            import time as _time
+
+            g.barrier()
+            if g.rank == 0:
+                for _ in range(5):
+                    assert g.probe(1) is False  # silent peer: no hang
+                g.send_obj("grp-go", 1)
+                deadline = _time.time() + 30
+                while not g.probe(1):
+                    assert _time.time() < deadline
+                    _time.sleep(0.002)
+                assert g.recv_obj(1) == "grp-reply"
+            elif g.rank == 1:
+                assert g.recv_obj(0) == "grp-go"
+                g.send_obj("grp-reply", 0)
+            g.barrier()
+
     # p2p ring with a large payload (exercises framing/chunked recv)
     big = bytes(range(256)) * 4096  # 1 MiB
     c.send_obj((rank, big), (rank + 1) % size)

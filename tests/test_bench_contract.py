@@ -372,3 +372,97 @@ def test_transformer_knob_env_validation(monkeypatch):
             with pytest.raises(ValueError, match=var.rsplit("_", 1)[-1]):
                 bench._transformer_setup(_Comm(), on_accel=True)
             monkeypatch.delenv(var)
+
+
+def test_serving_cluster_rows_contract_and_seeding(tmp_path):
+    """ISSUE 8 satellite: the ``serving_cluster`` phase's headline rows
+    ride the compact line (goodput at the top replica count, the
+    replica-scaling ratio, the disagg-vs-colocated TTFT speedup,
+    spread gate), and ``tuning seed`` learns ``cluster_disagg`` from
+    the TTFT rows — spread-gated under the phase's OWN shape key, with
+    the measured transfer accounting carried as evidence."""
+    for k in ("serving_cluster_goodput_tokens_per_sec",
+              "serving_cluster_scaling", "serving_cluster_disagg_speedup",
+              "serving_cluster_spread_pct"):
+        assert k in bench._COMPACT_KEYS, k
+
+    from chainermn_tpu.tuning.cache import (
+        load_cache,
+        seed_from_bench_details,
+    )
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-03T00:00:00Z",
+        "serving_cluster_model_shape": "D512xH8xL512",
+        "serving_cluster_disagg_ttft_ms": {"colocated": 20.0,
+                                           "disaggregated": 8.0},
+        "serving_cluster_disagg_spread_pct": 6.0,
+        "serving_cluster_transfers": 24,
+        "serving_cluster_transfer_bytes": 98304,
+        "serving_cluster_scaling": 3.1,
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert ("cluster_disagg|TPU v5 lite|512x8x512|decode -> "
+            "disaggregated") in seeded
+    entry = load_cache(str(cache))["decisions"][
+        "cluster_disagg|TPU v5 lite|512x8x512|decode"]
+    assert entry["transfer_bytes"] == 98304
+    assert entry["scaling"] == 3.1
+    assert entry["candidates_ms"]["disaggregated"] == 8.0
+
+    # spread-dominated rows are refused (noise-band "winner")
+    doc["serving_cluster_disagg_ttft_ms"] = {"colocated": 8.1,
+                                             "disaggregated": 8.0}
+    doc["serving_cluster_disagg_spread_pct"] = 12.0
+    details.write_text(json.dumps(doc))
+    cache2 = tmp_path / "cache2.json"
+    assert "cluster_disagg" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+    # ABSENT spread = on-accel single sample: the 10% floor applies
+    doc.pop("serving_cluster_disagg_spread_pct")
+    details.write_text(json.dumps(doc))
+    assert "cluster_disagg" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+    doc["serving_cluster_disagg_ttft_ms"] = {"colocated": 20.0,
+                                             "disaggregated": 8.0}
+    details.write_text(json.dumps(doc))
+    assert ("cluster_disagg|TPU v5 lite|512x8x512|decode -> "
+            "disaggregated") in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+
+def test_compact_overflow_sheds_newest_keys_with_marker(tmp_path,
+                                                        monkeypatch):
+    """The tail-window guard: a saturated line sheds NEWEST-declared
+    compact keys first, marks how many went, and never touches the
+    identity/provenance core — the driver sees valid JSON, the details
+    file keeps everything."""
+    monkeypatch.setattr(bench, "_DETAILS_PATH",
+                        str(tmp_path / "details.json"))
+    result = {k: 123456.789 for k in bench._COMPACT_KEYS}
+    result.update(metric="resnet50_images_per_sec", unit="images/sec",
+                  device_kind="TPU v5 lite", bench_note="x" * 500,
+                  error="y" * 500)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench._emit_final(result)
+    parsed = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert parsed.get("compact_keys_shed", 0) >= 1
+    # newest-declared keys go first; the core survives
+    assert "serving_cluster_spread_pct" not in parsed
+    for k in ("metric", "value", "unit", "device_kind", "details"):
+        assert k in parsed, k
+    # an unsaturated line sheds nothing and carries no marker
+    small = {"metric": "m", "value": 1.0,
+             "serving_cluster_spread_pct": 2.0}
+    buf2 = io.StringIO()
+    with contextlib.redirect_stdout(buf2):
+        bench._emit_final(small)
+    parsed2 = json.loads(buf2.getvalue().strip().splitlines()[-1])
+    assert "compact_keys_shed" not in parsed2
+    assert parsed2["serving_cluster_spread_pct"] == 2.0

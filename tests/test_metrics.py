@@ -813,3 +813,78 @@ def test_metrics_dump_formats_saved_scrape(tmp_path, capsys):
     assert "serving_ttft_seconds" in out and "n=1" in out
     # unreachable endpoint -> exit 1, quiet enough for the capture gate
     assert md.main(["--port", "1", "--timeout", "0.2"]) == 1
+
+
+def test_tap_route_and_kv_transfer_events():
+    """ISSUE 8: the cluster router's ``route``/``kv_transfer`` events
+    populate rank-labeled placement counters and transfer byte/block
+    accounting through the recorder tap — zero new call sites."""
+    reg = metrics.install_tap()
+    rec = trace.enable(None)
+    rec.event("route", request="c0", replica=1, policy="prefix_aware",
+              requeue=False)
+    rec.event("route", request="c1", replica=0, policy="prefix_aware",
+              requeue=True)
+    rec.event("kv_transfer", request="c0", src=0, dst=1, nbytes=4096,
+              blocks=3, dur_s=0.002)
+    assert reg.counter("cluster_routes_total").value(rank="1") == 1.0
+    assert reg.counter("cluster_routes_total").value(rank="0") == 1.0
+    assert reg.counter("cluster_requeues_total").value() == 1.0
+    assert reg.counter("kv_transfer_total").value() == 1.0
+    assert reg.counter("kv_transfer_bytes_total").value() == 4096.0
+    assert reg.counter("kv_transfer_blocks_total").value() == 3.0
+    assert reg.histogram("kv_transfer_seconds").count() == 1
+
+
+def test_metrics_dump_merges_replica_ports(capsys):
+    """ISSUE 8 satellite: ``--ports a,b,c`` fetches several replica
+    endpoints and merges them into ONE port-labeled table; endpoints
+    that are down are skipped with a stderr note and the exit code is
+    1 only when none answered."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "metrics_dump.py",
+    )
+    spec = importlib.util.spec_from_file_location("_metrics_dump2", path)
+    md = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(md)
+
+    r1 = metrics.MetricsRegistry()
+    r1.counter("serving_tokens_total", "tokens").inc(5)
+    r2 = metrics.MetricsRegistry()
+    r2.counter("serving_tokens_total", "tokens").inc(9)
+    e1 = exporter.start(port=0, registry=r1)
+    e2 = exporter.start(port=0, registry=r2)
+    try:
+        # one dead port in the list: merged output still lands, rc 0
+        rc = md.main(["--ports", f"{e1.port},{e2.port},1",
+                      "--timeout", "2"])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert f"port={e1.port}" in captured.out
+        assert f"port={e2.port}" in captured.out
+        assert "unreachable" in captured.err
+
+        rc = md.main(["--ports", f"{e1.port},{e2.port}", "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        parsed = json.loads(out)
+        vals = {v for k, v in parsed.items()
+                if k.startswith("serving_tokens_total")}
+        assert vals == {5.0, 9.0}
+
+        # merged health: one JSON object keyed by port
+        rc = md.main(["--ports", f"{e1.port},1", "--health",
+                      "--timeout", "2"])
+        health = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert health[str(e1.port)]["ok"]
+        assert health["1"] == {"error": "unreachable"}
+    finally:
+        e1.close()
+        e2.close()
+    # every listed endpoint down -> rc 1
+    assert md.main(["--ports", "1,2", "--timeout", "0.2"]) == 1
+    capsys.readouterr()

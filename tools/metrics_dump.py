@@ -5,10 +5,19 @@ Usage::
 
     python tools/metrics_dump.py                 # scrape + format table
     python tools/metrics_dump.py --port 9100     # explicit port
+    python tools/metrics_dump.py --ports 9100,9101,9102  # replica merge
     python tools/metrics_dump.py --raw           # verbatim exposition
     python tools/metrics_dump.py --json          # parsed, one JSON line
     python tools/metrics_dump.py --health        # /healthz, one JSON line
     python tools/metrics_dump.py saved.prom      # format a saved scrape
+
+``--ports a,b,c`` (ISSUE 8) fetches several replica endpoints and
+merges them into ONE labeled table/JSON object — every series gains a
+``port="<p>"`` label, so a cluster run (one exporter per replica
+process, the ``N + rank`` port contract) is inspectable with one
+command. Endpoints that don't answer are reported on stderr and
+skipped; the exit code is 1 only when NONE answered. With ``--health``
+it returns ``{port: healthz-or-error}`` as one JSON line instead.
 
 The port defaults to ``CHAINERMN_TPU_METRICS_PORT`` (the exporter's env
 contract; per-rank endpoints live at port+rank — pass ``--port``
@@ -99,6 +108,9 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=None,
                     help="endpoint port (default: "
                          "$CHAINERMN_TPU_METRICS_PORT)")
+    ap.add_argument("--ports", default=None,
+                    help="comma-separated replica ports to fetch and "
+                         "merge into one port-labeled table")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--timeout", type=float, default=2.0)
     ap.add_argument("--raw", action="store_true",
@@ -108,6 +120,61 @@ def main(argv=None) -> int:
     ap.add_argument("--health", action="store_true",
                     help="fetch /healthz instead of /metrics")
     args = ap.parse_args(argv)
+
+    if args.ports:
+        try:
+            ports = [int(p) for p in args.ports.split(",") if p.strip()]
+        except ValueError:
+            print(f"metrics_dump: bad --ports {args.ports!r}",
+                  file=sys.stderr)
+            return 1
+        if not ports:
+            print("metrics_dump: --ports named no ports", file=sys.stderr)
+            return 1
+        path = "/healthz" if args.health else "/metrics"
+        texts: dict = {}
+        for p in ports:
+            url = f"http://{args.host}:{p}{path}"
+            try:
+                texts[p] = _fetch(url, args.timeout)
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                print(f"metrics_dump: {url} unreachable: {e}",
+                      file=sys.stderr)
+        if not texts:
+            print("metrics_dump: no replica endpoint answered",
+                  file=sys.stderr)
+            return 1
+        if args.health:
+            merged_h = {}
+            for p in ports:
+                if p in texts:
+                    try:
+                        merged_h[str(p)] = json.loads(texts[p])
+                    except json.JSONDecodeError:
+                        merged_h[str(p)] = {"error": "bad json"}
+                else:
+                    merged_h[str(p)] = {"error": "unreachable"}
+            print(json.dumps(merged_h, sort_keys=True))
+            return 0
+        if args.raw:
+            for p, text in sorted(texts.items()):
+                sys.stdout.write(f"# replica port {p}\n{text}")
+            return 0
+        mod = _metrics_mod()
+        merged: dict = {}
+        for p, text in sorted(texts.items()):
+            for (name, labels), v in mod.parse_exposition(text).items():
+                merged[(name, tuple(sorted(
+                    labels + (("port", str(p)),))))] = v
+        if args.json:
+            print(json.dumps(
+                {f"{name}{dict(labels) or ''}": v
+                 for (name, labels), v in sorted(merged.items())},
+                sort_keys=True, default=str,
+            ))
+        else:
+            print(render_table(merged))
+        return 0
 
     if args.file:
         try:
