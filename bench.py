@@ -458,6 +458,7 @@ _COMPACT_KEYS = (
     "serving_prefix_hit_rate", "serving_prefix_spread_pct",
     "serving_cluster_goodput_tokens_per_sec", "serving_cluster_scaling",
     "serving_cluster_disagg_speedup", "serving_cluster_spread_pct",
+    "plan_vs_handwired", "plan_spread_pct",
 )
 
 
@@ -2470,6 +2471,109 @@ def _bench_overlap(comm, on_accel: bool):
     return out
 
 
+def _bench_plan(comm, on_accel: bool):
+    """ISSUE 10: hand-wired vs plan-compiled train step (CPU-proxy
+    convention: median-of-n>=3 + spread — a delta inside the spread is
+    noise).
+
+    One comm-heavy MLP workload, identical semantics both ways — ZeRO
+    data parallelism over every device (reduce-scatter -> 1/n sharded
+    update -> all-gather, adamw inner):
+
+    - hand-wired: ``make_train_step`` + ``MultiNodeOptimizer(
+      reduction_schedule='zero')`` over the communicator (the
+      call-site-wrapper composition this repo shipped in PR 3);
+    - plan: ``ParallelPlan({'zero': n})`` compiling the same step
+      global-view through the spec providers, donation on.
+
+    The ratio is the refactor's price tag (expected ~1.0x: same
+    collectives, pinned structurally in tests/test_plan.py); both rows
+    land in the compact line as ``plan_vs_handwired`` + spread."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.optimizers import create_multi_node_optimizer
+    from chainermn_tpu.parallel.plan import ParallelPlan
+    from chainermn_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    width = 1024 if on_accel else 128
+    layers = 3
+    n = comm.size
+    batch = 8 * n
+    steps = 16 if on_accel else 4
+    rng = jax.random.PRNGKey(0)
+    params = {
+        f"w{i}": jax.random.normal(jax.random.fold_in(rng, i),
+                                   (width, width), jnp.float32) * 0.02
+        for i in range(layers)
+    }
+    x = jax.random.normal(rng, (batch, width), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+
+    def loss_fn(p, batch_):
+        xb, yb = batch_
+        h = xb
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            h[:, :16], yb
+        ).mean()
+
+    inner = optax.adamw(1e-3)
+
+    def time_steps(step, state):
+        # Two warm calls: the hand-wired path's eager-built state has
+        # uncommitted shardings, so its SECOND call (committed outputs)
+        # compiles a fresh signature — the plan path stays at one
+        # compile because create_train_state places the state sharded.
+        state, m = step(state, (x, y))
+        state, m = step(state, (x, y))
+        _fetch_scalar(m["loss"])
+
+        def sample():
+            nonlocal state
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, m = step(state, (x, y))
+            _fetch_scalar(m["loss"])
+            return (time.perf_counter() - t0) / steps * 1000
+
+        med, spread = _repeat_median(sample, 1 if on_accel else 3)
+        return med, spread, state
+
+    opt = create_multi_node_optimizer(inner, comm,
+                                      reduction_schedule="zero")
+    # Copy: the donating hand-wired step would otherwise delete the
+    # shared template params the plan state is built from below.
+    hand_state = create_train_state(
+        jax.tree.map(lambda p: jnp.array(p, copy=True), params), opt, comm
+    )
+    hand_step = make_train_step(loss_fn, opt, comm)
+    hand_ms, hand_spread, _ = time_steps(hand_step, hand_state)
+
+    devices = list(comm.mesh.devices.flat)
+    plan = ParallelPlan({"zero": n}, devices=devices)
+    plan_state = plan.create_train_state(params, inner)
+    plan_step = plan.compile_train_step(loss_fn, inner, params)
+    plan_ms, plan_spread, _ = time_steps(plan_step, plan_state)
+
+    out = {
+        "plan_step_ms": round(plan_ms, 3),
+        "plan_handwired_ms": round(hand_ms, 3),
+        "plan_vs_handwired": round(hand_ms / plan_ms, 3),
+        "plan_spread_pct": max(hand_spread, plan_spread),
+        "plan_mesh": plan.describe()["mesh"],
+        "plan_compiles": plan_step.cache_size()
+        if hasattr(plan_step, "cache_size") else None,
+    }
+    return out
+
+
 def _bench_allreduce(comm, n_elems: int = 100_000_000):
     """The reference's ``allreduce_grad`` GB/s microbenchmark (BASELINE.json
     tracked metric): achieved bytes/s of a jitted psum over a flat bf16
@@ -3074,6 +3178,8 @@ def _run_bench(mode: str) -> None:
          lambda: _bench_double_buffering(comm, on_accel))
     supp("overlap", "overlap_error",
          lambda: _bench_overlap(comm, on_accel))
+    supp("plan", "plan_error",
+         lambda: _bench_plan(comm, on_accel))
     supp("transformer", "transformer_error",
          lambda: _bench_transformer(comm, on_accel))
     supp("s2d_resnet", "s2d_error", lambda: _bench_s2d_resnet(comm, on_accel))

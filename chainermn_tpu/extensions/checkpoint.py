@@ -42,14 +42,21 @@ _SHARD_SEP = "@@"
 
 
 def _index_str(index, shape) -> str:
-    """Canonical string for a shard's global index: ``start:stop`` per dim
-    (slices normalised against the global shape, so device numbering never
-    enters the format — restarts with renumbered devices restore fine)."""
+    """Canonical string for a shard's global index: ``start:stop`` per dim,
+    or ``start:stop:step`` for a STRIDED shard (some sharding layouts hand
+    a device an interleaved slice — e.g. a transposed mesh axis over a
+    stacked ``[n, ...]`` plan-ZeRO state). Slices are normalised against
+    the global shape, so device numbering never enters the format —
+    restarts with renumbered devices restore fine. The parse side
+    (``slice(*map(int, part.split(':')))`` in ``_global_from_shards`` /
+    the ``_assemble_sharded`` symmetric lookup) handles both forms."""
     parts = []
     for sl, dim in zip(index, shape):
         start, stop, step = sl.indices(dim)
-        assert step == 1, "strided shard indices are not supported"
-        parts.append(f"{start}:{stop}")
+        if step == 1:
+            parts.append(f"{start}:{stop}")
+        else:
+            parts.append(f"{start}:{stop}:{step}")
     return "|".join(parts)
 
 

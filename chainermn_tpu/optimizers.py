@@ -904,6 +904,44 @@ def create_local_sgd(
     )
 
 
+def inner_transform(optimizer) -> optax.GradientTransformation:
+    """The plain optax transform a :class:`~chainermn_tpu.parallel.plan.
+    ParallelPlan` composes, unwrapped from a communicator-style wrapper.
+
+    A plan owns the whole reduction (its spec providers say which
+    collective each axis owes the step), so a
+    :class:`MultiNodeOptimizer`'s own wire features cannot ride along:
+    the plain inner transform is extracted, and wrappers whose semantics
+    live in the wrapper itself (double buffering's staleness bank, the
+    EF residual, local-SGD's sync cadence) are refused loudly rather
+    than silently dropped. Plain optax transforms pass through.
+    """
+    if isinstance(optimizer, MultiNodeOptimizer):
+        if optimizer.double_buffering or optimizer.error_feedback:
+            raise ValueError(
+                "a ParallelPlan composes its own reduction; "
+                "double_buffering/error_feedback live in the wrapper's "
+                "wire and cannot ride a plan-compiled step — pass the "
+                "plain inner optimizer"
+            )
+        if optimizer.compress_dtype is not None:
+            raise ValueError(
+                "a ParallelPlan reduces in full precision; the wrapper's "
+                f"compressed wire (allreduce_grad_dtype="
+                f"{jnp.dtype(optimizer.compress_dtype).name}) would be "
+                "silently dropped — pass the plain inner optimizer, or "
+                "keep this call site on the communicator path"
+            )
+        return optimizer.actual_optimizer
+    if isinstance(optimizer, LocalSGDOptimizer):
+        raise ValueError(
+            "LocalSGDOptimizer's sync cadence is wrapper state; a "
+            "ParallelPlan cannot carry it — pass the plain inner "
+            "optimizer"
+        )
+    return optimizer
+
+
 def create_multi_node_optimizer(
     actual_optimizer: optax.GradientTransformation,
     communicator: CommunicatorBase,
@@ -941,4 +979,5 @@ __all__ = [
     "allreduce_grads_transform",
     "create_local_sgd",
     "create_multi_node_optimizer",
+    "inner_transform",
 ]

@@ -37,15 +37,26 @@ def __getattr__(name):
         "pipeline_local", "make_pipeline", "stack_stage_params",
         "stack_interleaved_stage_params", "pipeline_total_ticks",
         "pipeline_1f1b_local", "make_pipeline_1f1b",
-        "pipeline_hetero_local", "make_pipeline_hetero",
+        "pipeline_hetero_local", "make_pipeline_hetero", "pipe_plan_axis",
+        "unscale_replicated_grads",
     ):
         from chainermn_tpu.parallel import pipeline as _pp
 
         return getattr(_pp, name)
-    if name in ("zero_shard_optimizer", "zero_state_specs"):
+    if name in ("zero_shard_optimizer", "zero_state_specs",
+                "zero_plan_axis", "zero_stacked_init", "zero_grad_scatter",
+                "zero_param_chunk", "zero_gather_updates"):
         from chainermn_tpu.parallel import zero as _z
 
         return getattr(_z, name)
+    if name in ("ParallelPlan", "PipelinePlanSpec"):
+        from chainermn_tpu.parallel import plan as _plan
+
+        return getattr(_plan, name)
+    if name in ("AxisSpec", "CANONICAL_AXES"):
+        from chainermn_tpu.parallel import plan_specs as _pspec
+
+        return getattr(_pspec, name)
     if name in ("reduce_tree", "resolve_schedule", "bucket_partition",
                 "OverlappedBucketReducer", "SCHEDULES"):
         from chainermn_tpu.parallel import reduction_schedule as _rs
@@ -65,7 +76,7 @@ def __getattr__(name):
     if name in (
         "copy_to_tp", "reduce_from_tp", "gather_from_tp", "tp_slice", "stack_tp_params",
         "column_parallel_dense", "row_parallel_dense", "tp_mlp",
-        "tp_attention",
+        "tp_attention", "shard_qkv_columns", "tp_plan_axis",
     ):
         from chainermn_tpu.parallel import tensor as _t
 
@@ -94,6 +105,15 @@ __all__ = [
     "make_pipeline_hetero",
     "zero_shard_optimizer",
     "zero_state_specs",
+    "zero_plan_axis",
+    "zero_stacked_init",
+    "zero_grad_scatter",
+    "zero_param_chunk",
+    "zero_gather_updates",
+    "ParallelPlan",
+    "PipelinePlanSpec",
+    "AxisSpec",
+    "CANONICAL_AXES",
     "reduce_tree",
     "resolve_schedule",
     "bucket_partition",
@@ -116,4 +136,6 @@ __all__ = [
     "row_parallel_dense",
     "tp_mlp",
     "tp_attention",
+    "tp_plan_axis",
+    "pipe_plan_axis",
 ]

@@ -26,20 +26,36 @@ from jax.sharding import Mesh
 
 
 def best_mesh_shape(n: int, ndims: int = 2) -> tuple[int, ...]:
-    """Factor ``n`` devices into an ``ndims``-dim near-square mesh shape.
+    """Factor ``n`` devices into an ``ndims``-dim balanced mesh shape.
 
-    Prefers the most balanced factorisation with the larger factor first,
-    e.g. 8 -> (4, 2), 16 -> (4, 4), 6 -> (3, 2), primes -> (n, 1).
+    Most balanced factorisation, larger factors first: minimises the
+    largest factor, then the next-largest, and so on (lexicographic on the
+    descending-sorted tuple). E.g. 8 -> (4, 2), 16 -> (4, 4), 6 -> (3, 2),
+    primes -> (n, 1); 8 over 3 dims -> (2, 2, 2), 16 over 3 -> (4, 2, 2),
+    24 over 4 -> (3, 2, 2, 2). A 3-axis ``data x model x zero``
+    :class:`~chainermn_tpu.parallel.plan.ParallelPlan` relies on this for
+    its auto-factorised mesh (the largest factor lands on the first —
+    DCN-most — axis).
     """
+    if ndims < 1:
+        raise ValueError(f"ndims must be >= 1, got {ndims}")
+    if n < 1:
+        raise ValueError(f"need a positive device count, got {n}")
     if ndims == 1:
         return (n,)
-    if ndims != 2:
-        raise NotImplementedError("only 1- or 2-dim auto shapes supported")
-    best = (n, 1)
-    for a in range(2, int(math.isqrt(n)) + 1):
-        if n % a == 0:
-            best = (n // a, a)
-    return best
+
+    def factorisations(m: int, k: int):
+        if k == 1:
+            yield (m,)
+            return
+        for d in range(1, m + 1):
+            if m % d == 0:
+                for rest in factorisations(m // d, k - 1):
+                    yield tuple(sorted((d,) + rest, reverse=True))
+
+    # min() over descending-sorted tuples = smallest largest factor,
+    # ties broken by the next factor — the balanced choice.
+    return min(set(factorisations(n, ndims)))
 
 
 def _device_array(devices: Sequence[jax.Device], shape: tuple[int, ...]) -> np.ndarray:

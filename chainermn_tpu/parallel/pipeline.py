@@ -27,6 +27,7 @@ Design constraints (idiomatic-TPU, deliberate):
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -35,6 +36,52 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 PyTree = Any
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def unscale_replicated_grads(x: jax.Array, axis_name) -> jax.Array:
+    """Identity forward; cotangent divided by the axis size backward.
+
+    :func:`pipeline_local` replicates its outputs with a raw ``psum``
+    whose shard-local transpose is itself a psum — so when every stage
+    redundantly computes the same loss from the replicated outputs
+    INSIDE ``shard_map`` (the plan's pipe path), the cotangent arrives
+    scaled by ``n_stages``. Wrapping the pipeline output in this adjoint
+    restores exactness (measured: a 4-stage conveyor returns 4x grads
+    unwrapped). Differentiating from OUTSIDE the shard_map needs no
+    correction — the boundary transpose already accounts for the
+    replication.
+    """
+    return x
+
+
+def _unscale_fwd(x, axis_name):
+    return x, None
+
+
+def _unscale_bwd(axis_name, _, g):
+    return (g / lax.axis_size(axis_name),)
+
+
+unscale_replicated_grads.defvjp(_unscale_fwd, _unscale_bwd)
+
+
+def pipe_plan_axis(axis_name: str = "pipe") -> dict:
+    """Spec-provider descriptor for :class:`~chainermn_tpu.parallel.plan.
+    ParallelPlan` (ISSUE 10): stage parameters stack a leading
+    ``[n_stages, ...]`` dim over ``axis_name`` (the
+    :func:`stack_stage_params` layout, ``P(axis_name)`` on the stack
+    dim), and the axis owes the compiled step the conveyor's
+    ``ppermute`` (one collective-permute per schedule tick, forward and
+    transposed backward). Contract inherited from :func:`pipeline_local`:
+    leaves consumed INSIDE ``stage_fn`` must be pipe-stacked; replicated
+    leaves (embed/head) belong outside the pipelined region."""
+    return {
+        "name": axis_name,
+        "stacked": True,
+        "state_stacked": False,
+        "collectives": ("collective-permute",),
+    }
 
 
 def pipeline_total_ticks(n_stages: int, n_micro: int,

@@ -1,0 +1,560 @@
+"""ParallelPlan — one global-view mesh program for DP x TP x ZeRO x pipeline.
+
+The reference's training stack was per-process communicator-style: every
+parallel form was a wrapper at the call site (``communicators/`` (dagger),
+``optimizers.py`` (dagger) — SURVEY.md sections 2.1-2.3), so composing two
+of them meant composing wrappers and hoping their collectives interleaved.
+A :class:`ParallelPlan` inverts that: it lays out ONE named mesh
+(``data x zero x pipe x model``, any subset, device layout via
+:mod:`chainermn_tpu.parallel.mesh` — ICI-aware placement, balanced
+auto-factorisation through :func:`~chainermn_tpu.parallel.mesh.
+best_mesh_shape`) and compiles ONE ``shard_map`` train step in which the
+per-axis modules participate as *spec providers*
+(:mod:`chainermn_tpu.parallel.plan_specs`):
+
+- ``data`` — plain data parallelism: batch shards over it, gradients
+  ``pmean`` over it (one all-reduce);
+- ``zero`` — data parallelism with a ZeRO-1 sharded update
+  (:mod:`chainermn_tpu.parallel.zero`, arXiv:2004.13336): batch shards
+  over it too, but the gradient mean arrives as a reduce-scatter, the
+  inner optimizer updates a 1/n state chunk, and an all-gather returns
+  the parameter updates — same wire bytes as the allreduce it replaces;
+- ``model`` — Megatron-style tensor parallelism
+  (:mod:`chainermn_tpu.parallel.tensor`): marked leaves stack
+  ``[n, ...]`` shards, the loss is written with the ``copy_to_tp`` /
+  ``reduce_from_tp`` adjoint pairs, one psum per column->row pair;
+- ``pipe`` — GPipe micro-batch pipelining
+  (:mod:`chainermn_tpu.parallel.pipeline`): stage leaves stack
+  ``[n_stages, ...]``, the conveyor's ppermute rides the schedule.
+
+Buffer donation is threaded through the compiled step by construction
+(``donate_argnums=(0,)`` on the whole :class:`TrainState`): step ``t+1``
+reuses step ``t``'s buffers in place, so the H2D-after-D2H degradation the
+verify skill documents (a fetched metric followed by a state re-upload)
+cannot occur — there is no re-upload.
+
+Acceptance is structural, not prose (tests/test_plan.py): the compiled
+plan step carries exactly the hand-wired paths' HLO collective counts,
+dist == single values AND gradients for every composed plan, and the jit
+cache stays pinned at 1 across steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.parallel import plan_specs as _ps
+from chainermn_tpu.parallel.mesh import best_mesh_shape, make_mesh
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelinePlanSpec:
+    """How a plan with a ``pipe`` axis runs the pipelined region.
+
+    ``stage_fn(params_local, x_mb) -> y_mb`` is one homogeneous stage
+    (output shape == input shape) receiving the COLLAPSED param tree —
+    pipe-stacked leaves arrive as this stage's slice. Every TRAINABLE
+    leaf of a pipe plan must be pipe-stacked: a replicated leaf consumed
+    inside ``stage_fn`` would need a cross-stage gradient sum the
+    schedule does not owe (the same embed/head-outside contract as
+    :func:`~chainermn_tpu.parallel.pipeline.make_pipeline`).
+    ``loss_fn(y, batch) -> loss`` (or ``(loss, metrics_dict)``) maps the
+    reassembled pipeline output back to the local-batch-mean loss.
+    """
+
+    stage_fn: Callable
+    loss_fn: Callable
+    n_microbatches: Optional[int] = None
+    #: pull the pipeline input out of the batch (default: ``batch[0]``
+    #: for tuple/list batches, else the batch itself)
+    input_of: Optional[Callable] = None
+
+
+def _pipe_input(batch):
+    if isinstance(batch, (tuple, list)):
+        return batch[0]
+    return batch
+
+
+class ParallelPlan:
+    """One named mesh + the specs to compile a composed train step.
+
+    Args:
+      axes: either a mapping ``{axis: size}`` (at most one size may be
+        ``-1`` — inferred from the device count) or a sequence of axis
+        names, auto-factorised balanced with larger factors first
+        (:func:`~chainermn_tpu.parallel.mesh.best_mesh_shape`; the
+        largest factor lands on the first — DCN-most — axis). Axis names
+        come from :data:`~chainermn_tpu.parallel.plan_specs.
+        CANONICAL_AXES`; mesh order is canonical regardless of input
+        order (``model`` last — the ICI-fastest slot, the repo's mesh
+        convention).
+      devices: device list (default ``jax.devices()``). Layout is
+        ICI-topology-aware via :func:`~chainermn_tpu.parallel.mesh.
+        make_mesh` — on a pod slice the 2-D ``(dcn, ici)`` factorisation
+        falls out of the canonical order.
+    """
+
+    def __init__(
+        self,
+        axes: Mapping[str, int] | Sequence[str],
+        *,
+        devices=None,
+    ) -> None:
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        n = len(devices)
+        if isinstance(axes, Mapping):
+            sizes = dict(axes)
+            unknown = [a for a, s in sizes.items() if s == -1]
+            if len(unknown) > 1:
+                raise ValueError(
+                    f"at most one axis size may be -1, got {unknown}"
+                )
+            if unknown:
+                rest = math.prod(
+                    s for a, s in sizes.items() if a not in unknown
+                )
+                if rest == 0 or n % rest:
+                    raise ValueError(
+                        f"cannot infer {unknown[0]!r}: {n} devices do not "
+                        f"factor over the explicit sizes {sizes}"
+                    )
+                sizes[unknown[0]] = n // rest
+        else:
+            names = list(axes)
+            if len(set(names)) != len(names):
+                raise ValueError(f"duplicate plan axes: {names}")
+            # canonical order first, THEN factorise: the largest factor
+            # must land on the first (DCN-most) canonical axis, not on
+            # whatever order the caller spelled the names in.
+            ordered = [a for a in _ps.CANONICAL_AXES if a in names]
+            _ps.resolve_axes(dict.fromkeys(names, 1))  # name validation
+            shape = best_mesh_shape(n, len(ordered))
+            sizes = dict(zip(ordered, shape))
+        self.axes: dict[str, _ps.AxisSpec] = _ps.resolve_axes(sizes)
+        shape = tuple(s.size for s in self.axes.values())
+        if math.prod(shape) != n:
+            raise ValueError(
+                f"plan axes {dict((a, s.size) for a, s in self.axes.items())} "
+                f"cover {math.prod(shape)} mesh slots but {n} devices were "
+                f"given"
+            )
+        self.mesh = make_mesh(tuple(self.axes), shape, devices)
+
+    # -- topology accessors -------------------------------------------------
+
+    def axis_size(self, name: str) -> int:
+        return self.axes[name].size if name in self.axes else 1
+
+    @property
+    def dp_axes(self) -> tuple[str, ...]:
+        """Axes the batch shards (and gradients reduce) over."""
+        return tuple(a for a in ("data", "zero") if a in self.axes)
+
+    @property
+    def dp_size(self) -> int:
+        return math.prod(self.axis_size(a) for a in self.dp_axes) or 1
+
+    def batch_spec(self) -> P:
+        return P(self.dp_axes) if self.dp_axes else P()
+
+    def describe(self) -> dict:
+        """Axis sizes + the collectives each spec provider owes the step
+        (the dryrun/bench provenance line)."""
+        return {
+            "mesh": {a: s.size for a, s in self.axes.items()},
+            "collectives": _ps.owed_collectives(self.axes),
+            "batch_spec": str(self.batch_spec()),
+        }
+
+    # -- specs --------------------------------------------------------------
+
+    def param_specs(self, params: PyTree, specs: PyTree | None = None) -> PyTree:
+        """Full per-leaf ``PartitionSpec`` tree for ``params`` (validated
+        against this plan's axes; see :func:`~chainermn_tpu.parallel.
+        plan_specs.normalize_param_specs`)."""
+        return _ps.normalize_param_specs(params, specs, self.axes)
+
+    def _groups(self, flat_specs):
+        return _ps.partition_groups(flat_specs, self.axes)
+
+    @staticmethod
+    def _inner(optimizer):
+        """Accept a plain optax transform OR a communicator-style
+        wrapper: unwrapped through :func:`chainermn_tpu.optimizers.
+        inner_transform` so create_train_state / state_specs /
+        compile_train_step all agree on the state layout (a wrapper's
+        own ``init`` would chunk by the communicator's size, not this
+        plan's axes)."""
+        from chainermn_tpu.optimizers import inner_transform
+
+        return inner_transform(optimizer)
+
+    def _group_state_init(self, inner, group: str, leaves):
+        from chainermn_tpu.parallel.zero import zero_stacked_init
+
+        if group == "zero":
+            return zero_stacked_init(inner, leaves, self.axis_size("zero"))
+        if group in ("model", "pipe"):
+            return jax.vmap(inner.init)(leaves)
+        return inner.init(leaves)
+
+    def _group_state_spec_leaf(self, group: str) -> P:
+        if group in ("zero", "model", "pipe"):
+            return P(group)
+        return P()
+
+    def state_specs(self, params: PyTree, inner, specs: PyTree | None = None):
+        """The full :class:`TrainState` spec pytree the compiled step
+        carries — params per their specs, each opt-state group stacked
+        over its axis, step/model_state replicated."""
+        from chainermn_tpu.training.train_step import TrainState
+
+        inner = self._inner(inner)
+        spec_tree = self.param_specs(params, specs)
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_s = jax.tree.leaves(spec_tree)
+        groups = self._groups(flat_s)
+        opt_spec = {}
+        for grp, idx in groups.items():
+            template = jax.eval_shape(
+                lambda ls, g=grp: self._group_state_init(inner, g, ls),
+                [flat_p[i] for i in idx],
+            )
+            leaf_spec = self._group_state_spec_leaf(grp)
+            opt_spec[grp] = jax.tree.map(lambda _: leaf_spec, template)
+        return TrainState(
+            params=spec_tree, opt_state=opt_spec, step=P(), model_state=P()
+        )
+
+    # -- state --------------------------------------------------------------
+
+    def create_train_state(
+        self,
+        params: PyTree,
+        inner: optax.GradientTransformation,
+        *,
+        param_specs: PyTree | None = None,
+        model_state: PyTree = (),
+    ):
+        """Initialise the plan-sharded :class:`TrainState`: params placed
+        per their specs, each opt-state group created directly in its
+        stacked layout and placed sharded (``[n, ...]`` over its axis) —
+        no full-state replica ever materialises on one device."""
+        from chainermn_tpu.training.train_step import TrainState
+
+        inner = self._inner(inner)
+        spec_tree = self.param_specs(params, param_specs)
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_s = jax.tree.leaves(spec_tree)
+        groups = self._groups(flat_s)
+        mesh = self.mesh
+
+        def put(leaf, spec):
+            # A COPY, not the caller's buffer: device_put aliases when the
+            # sharding already matches, and the donating step would then
+            # delete the user's template params out from under them (the
+            # LocalSGD anchor lesson, measured here too).
+            return jax.device_put(
+                jnp.array(leaf, copy=True), NamedSharding(mesh, spec)
+            )
+
+        placed = jax.tree.unflatten(
+            treedef, [put(l, s) for l, s in zip(flat_p, flat_s)]
+        )
+        opt_state = {}
+        for grp, idx in groups.items():
+            st = self._group_state_init(inner, grp, [flat_p[i] for i in idx])
+            leaf_spec = self._group_state_spec_leaf(grp)
+            opt_state[grp] = jax.tree.map(
+                lambda e: put(e, leaf_spec), st
+            )
+        repl = NamedSharding(mesh, P())
+        if jax.tree.leaves(model_state):
+            model_state = jax.tree.map(
+                lambda x: jax.device_put(jnp.asarray(x), repl), model_state
+            )
+        return TrainState(
+            params=placed,
+            opt_state=opt_state,
+            step=jax.device_put(jnp.zeros((), jnp.int32), repl),
+            model_state=model_state,
+        )
+
+    # -- the compiled step --------------------------------------------------
+
+    def compile_train_step(
+        self,
+        loss_fn: Callable,
+        inner: optax.GradientTransformation,
+        params: PyTree | None = None,
+        *,
+        param_specs: PyTree | None = None,
+        donate: bool = True,
+        pipeline: PipelinePlanSpec | None = None,
+    ):
+        """Compile the ONE composed train step:
+        ``step(state, batch) -> (state, metrics)``.
+
+        ``loss_fn`` is the shard-local loss (local-batch mean) in any of
+        the :func:`~chainermn_tpu.training.train_step.normalize_loss_fn`
+        forms, written against the COLLAPSED param tree (stacked leaves
+        arrive as this shard's slice — use the
+        :mod:`~chainermn_tpu.parallel.tensor` helpers for model-axis
+        leaves). With a ``pipe`` axis pass ``pipeline=`` instead of
+        relying on ``loss_fn`` alone (see :class:`PipelinePlanSpec`; the
+        plan then calls ``loss_fn`` only if ``pipeline`` is ``None``).
+
+        ``inner`` is a plain optax transform (elementwise when a
+        ``zero`` axis is present — the ZeRO constraint); a
+        :class:`~chainermn_tpu.optimizers.MultiNodeOptimizer` is
+        auto-unwrapped via :func:`~chainermn_tpu.optimizers.
+        inner_transform` (wrapper-wire features refused loudly).
+
+        ``params`` is the template the specs compile against; omitting it
+        defers the build to the first call (same jit cache — still one
+        compile). ``donate=True`` (default) donates the whole state:
+        params and opt-state buffers are updated in place, a second step
+        re-uploads nothing (pinned structurally in tests/test_plan.py).
+        """
+        if "pipe" in self.axes and pipeline is None:
+            raise ValueError(
+                "this plan has a 'pipe' axis: pass pipeline="
+                "PipelinePlanSpec(stage_fn, loss_fn, ...)"
+            )
+        if pipeline is not None and "pipe" not in self.axes:
+            raise ValueError("pipeline= given but the plan has no 'pipe' axis")
+        inner = self._inner(inner)
+        if params is not None:
+            return self._build_step(
+                loss_fn, inner, params, param_specs, donate, pipeline
+            )
+
+        built: list = []
+
+        def step(state, batch):
+            if not built:
+                built.append(
+                    self._build_step(
+                        loss_fn, inner, state.params, param_specs, donate,
+                        pipeline,
+                    )
+                )
+            return built[0](state, batch)
+
+        step.cache_size = lambda: (
+            _jit_cache_size(built[0]) if built else 0
+        )
+        return step
+
+    def _build_step(self, loss_fn, inner, params, param_specs, donate,
+                    pipeline):
+        from jax import shard_map
+
+        from chainermn_tpu.parallel.zero import (
+            zero_gather_updates,
+            zero_grad_scatter,
+            zero_param_chunk,
+        )
+        from chainermn_tpu.training.train_step import (
+            TrainState,
+            normalize_loss_fn,
+        )
+
+        mesh = self.mesh
+        dp_axes = self.dp_axes
+        dp_total = self.dp_size
+        spec_tree = self.param_specs(params, param_specs)
+        treedef = jax.tree.structure(params)
+        flat_specs = jax.tree.leaves(spec_tree)
+        if pipeline is not None:
+            # Enforce the PipelinePlanSpec contract structurally, not by
+            # docstring: a replicated leaf consumed inside stage_fn would
+            # receive per-stage gradients with no cross-stage sum, and
+            # check_vma=False would mask the divergence as silently wrong
+            # params — reject anything not pipe-stacked up front.
+            bad = [
+                jax.tree_util.keystr(path)
+                for (path, _), spec in zip(
+                    jax.tree_util.tree_flatten_with_path(params)[0],
+                    flat_specs,
+                )
+                if tuple(spec) != ("pipe",)
+            ]
+            if bad:
+                raise ValueError(
+                    "every trainable leaf of a pipe plan must be "
+                    f"pipe-stacked (P('pipe')); got {bad[:8]} — stage "
+                    "leaves carry their own slice per stage, and "
+                    "replicated leaves have no cross-stage gradient sum "
+                    "(the embed/head-outside contract of make_pipeline)"
+                )
+        groups = self._groups(flat_specs)
+        stacked_idx = {
+            i for grp in ("model", "pipe") for i in groups.get(grp, ())
+        }
+        state_spec = self.state_specs(params, inner, param_specs)
+        batch_spec = self.batch_spec()
+        n_pipe = self.axis_size("pipe")
+        lfn = None if pipeline is not None else normalize_loss_fn(loss_fn)
+
+        def collapse(tree):
+            flat = treedef.flatten_up_to(tree)
+            return jax.tree.unflatten(
+                treedef,
+                [l[0] if i in stacked_idx else l for i, l in enumerate(flat)],
+            )
+
+        def expand(tree):
+            flat = treedef.flatten_up_to(tree)
+            return jax.tree.unflatten(
+                treedef,
+                [l[None] if i in stacked_idx else l
+                 for i, l in enumerate(flat)],
+            )
+
+        def pipe_loss(params_c, batch):
+            from chainermn_tpu.parallel.pipeline import (
+                pipeline_local,
+                unscale_replicated_grads,
+            )
+
+            x = (pipeline.input_of or _pipe_input)(batch)
+            n_micro = pipeline.n_microbatches or n_pipe
+            b = x.shape[0]
+            if b % n_micro:
+                raise ValueError(
+                    f"local batch {b} not divisible by n_microbatches "
+                    f"{n_micro}"
+                )
+            xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+            ym = pipeline_local(
+                lambda p, mb: pipeline.stage_fn(p, mb), params_c, xm, "pipe"
+            )
+            # every stage computes the same loss from the replicated
+            # outputs; the psum replication's shard-local transpose
+            # would scale the cotangent by n_stages — undo it exactly.
+            ym = unscale_replicated_grads(ym, "pipe")
+            y = ym.reshape((b,) + ym.shape[2:])
+            out = pipeline.loss_fn(y, batch)
+            if isinstance(out, tuple):
+                loss, metrics = out
+            else:
+                loss, metrics = out, {}
+            return loss, (metrics, ())
+
+        def local_step(state, batch):
+            params_c = collapse(state.params)
+            if pipeline is None:
+                grad_fn = jax.value_and_grad(lfn, has_aux=True)
+                (loss, (metrics, model_state)), grads_c = grad_fn(
+                    params_c, batch, state.model_state
+                )
+            else:
+                grad_fn = jax.value_and_grad(pipe_loss, has_aux=True)
+                (loss, (metrics, _)), grads_c = grad_fn(params_c, batch)
+                model_state = state.model_state
+
+            flat_p = treedef.flatten_up_to(params_c)
+            flat_g = treedef.flatten_up_to(grads_c)
+            flat_u: list = [None] * len(flat_p)
+            new_opt = {}
+
+            # Stacked groups + plain replicated: pmean over the dp axes
+            # (TP/pipe leaves included — those axes are extra data
+            # parallelism for them; the model/pipe axes themselves are
+            # never reduced, the tensor/pipeline composition rule).
+            for grp in ("model", "pipe", "rep"):
+                idx = groups.get(grp)
+                if not idx:
+                    continue
+                g = [flat_g[i] for i in idx]
+                if dp_axes:
+                    g = lax.pmean(g, dp_axes)
+                p_sub = [flat_p[i] for i in idx]
+                st = new_in = state.opt_state[grp]
+                if grp != "rep":
+                    new_in = jax.tree.map(lambda e: e[0], st)
+                u, st_out = inner.update(g, new_in, p_sub)
+                if grp != "rep":
+                    st_out = jax.tree.map(lambda e: e[None], st_out)
+                for i, ui in zip(idx, u):
+                    flat_u[i] = ui
+                new_opt[grp] = st_out
+
+            # ZeRO group: reduce-scatter in, sharded 1/n update,
+            # all-gather out (the zero provider's owed collectives).
+            idx = groups.get("zero")
+            if idx:
+                other_dp = tuple(a for a in dp_axes if a != "zero")
+                gch = [
+                    zero_grad_scatter(
+                        flat_g[i], "zero", extra_axes=other_dp,
+                        total=dp_total,
+                    )
+                    for i in idx
+                ]
+                pch = [zero_param_chunk(flat_p[i], "zero") for i in idx]
+                st = jax.tree.map(
+                    lambda e: e[0], state.opt_state["zero"]
+                )
+                uch, st_out = inner.update(gch, st, pch)
+                new_opt["zero"] = jax.tree.map(lambda e: e[None], st_out)
+                for i, uc in zip(idx, uch):
+                    flat_u[i] = zero_gather_updates(uc, flat_p[i], "zero")
+
+            updates_c = jax.tree.unflatten(treedef, flat_u)
+            params_c2 = optax.apply_updates(params_c, updates_c)
+            metrics = {"loss": loss, **metrics}
+            if dp_axes:
+                metrics = lax.pmean(metrics, dp_axes)
+                if jax.tree.leaves(model_state):
+                    model_state = lax.pmean(model_state, dp_axes)
+            new_state = TrainState(
+                params=expand(params_c2),
+                opt_state=new_opt,
+                step=state.step + 1,
+                model_state=model_state,
+            )
+            return new_state, metrics
+
+        sharded = shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(state_spec, batch_spec),
+            out_specs=(state_spec, P()),
+            check_vma=False,
+        )
+        jitted = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+        def cache_size():
+            return _jit_cache_size(jitted)
+
+        try:
+            jitted.cache_size = cache_size
+            jitted.plan_info = self.describe()
+        except (AttributeError, TypeError):
+            pass
+        return jitted
+
+
+def _jit_cache_size(jitted) -> Optional[int]:
+    try:
+        return jitted._cache_size()
+    except (AttributeError, TypeError):
+        return None
+
+
+__all__ = ["ParallelPlan", "PipelinePlanSpec"]

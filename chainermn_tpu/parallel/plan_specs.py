@@ -1,0 +1,198 @@
+"""Spec layer of the :class:`~chainermn_tpu.parallel.plan.ParallelPlan`.
+
+The reference expressed every parallel form as a *call-site wrapper* around
+a per-process communicator (``communicators/`` (dagger), SURVEY.md
+section 2.1); here the per-axis modules are **spec providers** instead:
+each publishes a small descriptor — how its parameter/opt-state leaves lay
+out over its mesh axis, and which HLO collectives it owes the compiled
+step — and this module turns those descriptors plus the user's per-leaf
+``PartitionSpec`` tree into the concrete shard_map specs and update groups
+one compiled train step composes.
+
+Provider contract (``{tensor,zero,pipeline}.{tp,zero,pipe}_plan_axis``):
+
+- ``name``: the mesh axis name;
+- ``stacked``: parameter leaves sharded by this axis stack a leading
+  ``[n, ...]`` shard dim (``stack_tp_params`` / ``stack_stage_params``
+  layout) carried with ``P(axis)`` and collapsed inside the program;
+- ``state_stacked``: the axis shards the *optimizer state* (ZeRO): state
+  leaves stack ``[n, ...]`` chunks over the axis, params stay replicated;
+- ``collectives``: the HLO collective ops the axis owes the step — the
+  vocabulary of the structural count tests (``all-reduce``,
+  ``reduce-scatter``, ``all-gather``, ``collective-permute``).
+
+The ``data`` axis is the plain data-parallel provider and lives here (it
+has no module of its own: its only artifact is the gradient ``pmean``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+PyTree = Any
+
+#: Canonical mesh-axis order: DCN-tolerant axes first, ICI-hungry last
+#: (the repo's mesh convention — the fast/intra axis sits last). ``data``
+#: tolerates DCN (one allreduce/step), ``model`` wants ICI (one psum per
+#: layer pair), ``zero``/``pipe`` sit between.
+CANONICAL_AXES = ("data", "zero", "pipe", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisSpec:
+    """One resolved plan axis: the provider descriptor plus its size."""
+
+    name: str
+    size: int
+    stacked: bool
+    state_stacked: bool
+    collectives: tuple[str, ...]
+
+
+def _provider(role: str) -> dict:
+    if role == "data":
+        return {
+            "name": "data",
+            "stacked": False,
+            "state_stacked": False,
+            "collectives": ("all-reduce",),
+        }
+    if role == "zero":
+        from chainermn_tpu.parallel.zero import zero_plan_axis
+
+        return zero_plan_axis()
+    if role == "model":
+        from chainermn_tpu.parallel.tensor import tp_plan_axis
+
+        return tp_plan_axis()
+    if role == "pipe":
+        from chainermn_tpu.parallel.pipeline import pipe_plan_axis
+
+        return pipe_plan_axis()
+    raise ValueError(
+        f"unknown plan axis {role!r}: a ParallelPlan composes "
+        f"{CANONICAL_AXES} (any subset)"
+    )
+
+
+def resolve_axes(sizes: Mapping[str, int]) -> dict[str, AxisSpec]:
+    """Resolve provider descriptors for ``sizes`` (name -> size), in
+    canonical mesh order."""
+    for name in sizes:
+        if name not in CANONICAL_AXES:
+            _provider(name)  # raises with the canonical list
+    out: dict[str, AxisSpec] = {}
+    for name in CANONICAL_AXES:
+        if name not in sizes:
+            continue
+        d = _provider(name)
+        out[name] = AxisSpec(
+            name=d["name"],
+            size=int(sizes[name]),
+            stacked=bool(d["stacked"]),
+            state_stacked=bool(d["state_stacked"]),
+            collectives=tuple(d["collectives"]),
+        )
+    return out
+
+
+def normalize_param_specs(
+    params: PyTree,
+    specs: PyTree | None,
+    axes: Mapping[str, AxisSpec],
+) -> PyTree:
+    """Expand the user's spec tree to a FULL per-leaf ``PartitionSpec``
+    tree over ``params`` and validate it against the plan's axes.
+
+    ``specs`` may be ``None`` (everything replicated), a single ``P``
+    (broadcast), or a prefix pytree of ``P`` leaves (each broadcast over
+    its params subtree). Each leaf spec must be ``P()`` or ``P(axis)``
+    for a *stacked* plan axis (``model``/``pipe``) — the leading-stack
+    convention of :func:`~chainermn_tpu.parallel.tensor.stack_tp_params`
+    / :func:`~chainermn_tpu.parallel.pipeline.stack_stage_params` — and
+    the leaf's leading dim must equal that axis's size.
+    """
+    if specs is None:
+        specs = P()
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+    if is_spec(specs):
+        full = jax.tree.map(lambda _: specs, params)
+    else:
+        full = jax.tree.map(
+            lambda s, sub: jax.tree.map(lambda _: s, sub),
+            specs,
+            params,
+            is_leaf=is_spec,
+        )
+
+    def check(spec, leaf):
+        if not isinstance(spec, P):
+            raise TypeError(
+                f"param specs must be jax.sharding.PartitionSpec leaves, "
+                f"got {type(spec).__name__}"
+            )
+        entries = tuple(spec)
+        if not entries:
+            return spec
+        if len(entries) != 1 or entries[0] is None:
+            raise ValueError(
+                f"plan param specs use the leading-stack convention: "
+                f"P() or P(<stacked axis>), got {spec}"
+            )
+        ax = entries[0]
+        if ax not in axes or not axes[ax].stacked:
+            stacked = [a for a, s in axes.items() if s.stacked]
+            raise ValueError(
+                f"param spec {spec} names {ax!r}, but this plan's "
+                f"stacked axes are {stacked} (zero/data shard state and "
+                f"batch, never parameter leaves)"
+            )
+        lead = jax.numpy.shape(leaf)[0] if jax.numpy.ndim(leaf) else None
+        if lead != axes[ax].size:
+            raise ValueError(
+                f"leaf sharded {spec} must stack [{axes[ax].size}, ...] "
+                f"over {ax!r}; got leading dim {lead} "
+                f"(use stack_tp_params / stack_stage_params)"
+            )
+        return spec
+
+    return jax.tree.map(check, full, params)
+
+
+def partition_groups(
+    flat_specs: Sequence[P],
+    axes: Mapping[str, AxisSpec],
+) -> dict[str, list[int]]:
+    """Split flattened param leaves into update groups by their spec.
+
+    - each stacked axis (``model``, ``pipe``) gets its own group: state
+      mirrors the stacked params (already factored ``1/n`` over that
+      axis), updated per shard;
+    - replicated leaves form the ``'zero'`` group when a
+      ``state_stacked`` axis is present (their state chunks over it), or
+      the plain ``'rep'`` group otherwise.
+
+    A leaf cannot belong to both a stacked axis AND the zero group: a
+    TP/pipe-sharded parameter's optimizer state is already sharded
+    ``n``-ways by construction, so ZeRO applies to the replicated
+    leaves — the spec-provider contract (docs/parallelism.md).
+    """
+    has_zero = any(s.state_stacked for s in axes.values())
+    groups: dict[str, list[int]] = {}
+    for i, spec in enumerate(flat_specs):
+        entries = tuple(spec)
+        if entries:
+            key = entries[0]
+        else:
+            key = "zero" if has_zero else "rep"
+        groups.setdefault(key, []).append(i)
+    return groups
+
+
+def owed_collectives(axes: Mapping[str, AxisSpec]) -> dict[str, tuple]:
+    """Per-axis collective vocabulary — what the structural tests count."""
+    return {name: spec.collectives for name, spec in axes.items()}

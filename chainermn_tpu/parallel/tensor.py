@@ -118,6 +118,21 @@ def _gather_bwd(axis_name, dim, local_size, g):
 gather_from_tp.defvjp(_gather_fwd, _gather_bwd)
 
 
+def tp_plan_axis(axis_name: str = "model") -> dict:
+    """Spec-provider descriptor for :class:`~chainermn_tpu.parallel.plan.
+    ParallelPlan` (ISSUE 10): tensor-parallel parameter leaves stack a
+    leading ``[n, ...]`` shard dim over ``axis_name`` (the
+    :func:`stack_tp_params` layout, ``P(axis_name)`` on the stack dim),
+    and the axis owes the compiled step one ``psum`` per column→row pair
+    — an all-reduce forward and its mirror backward, nothing else."""
+    return {
+        "name": axis_name,
+        "stacked": True,  # params stack [n, ...] over this axis
+        "state_stacked": False,
+        "collectives": ("all-reduce",),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Parameter sharding helpers
 # ---------------------------------------------------------------------------

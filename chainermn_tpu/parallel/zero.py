@@ -98,6 +98,79 @@ def zero_state_specs(
     )
 
 
+# ---------------------------------------------------------------------------
+# ParallelPlan spec-provider surface (ISSUE 10): the plan composes ZeRO
+# from these pieces instead of wrapping the optimizer at the call site —
+# this module owes the compiled step exactly one reduce-scatter in and
+# one all-gather out per float leaf, and publishes the stacked-state
+# layout the plan's shard_map carries with an honest P(axis) spec.
+# ---------------------------------------------------------------------------
+
+
+def zero_plan_axis(axis_name: str = "zero") -> dict:
+    """Spec-provider descriptor for :class:`~chainermn_tpu.parallel.plan.
+    ParallelPlan`: the ``zero`` axis shards the OPTIMIZER STATE (params
+    stay replicated over it — it is a data-parallel axis whose state is
+    chunked), and owes the compiled step one reduce-scatter + one
+    all-gather per parameter leaf."""
+    return {
+        "name": axis_name,
+        "stacked": False,  # params do NOT stack a leading dim over it
+        "state_stacked": True,  # opt state stacks [n, ...] over it
+        "collectives": ("reduce-scatter", "all-gather"),
+    }
+
+
+def zero_stacked_init(inner: optax.GradientTransformation, leaves, n: int):
+    """Initialise the plan's stacked ZeRO state over ``leaves`` (a list
+    pytree of param leaves): every state leaf comes back stacked
+    ``[n, ...]`` (scalar counters tiled), so one per-leaf ``P(axis)``
+    spec shards the whole subtree — the same layout
+    :class:`chainermn_tpu.optimizers.MultiNodeOptimizer`'s ``'zero'``
+    schedule uses."""
+    rows = [_chunk_rows(jnp.asarray(p), n) for p in leaves]
+    return jax.vmap(inner.init)(rows)
+
+
+def zero_grad_scatter(
+    g: jax.Array, axis_name: str, *, extra_axes=(), total: int | None = None
+) -> jax.Array:
+    """This shard's MEAN gradient chunk: one ``psum_scatter`` over
+    ``axis_name`` (half an allreduce's wire bytes) plus — when the plan
+    carries more data-parallel axes — one ``psum`` of the 1/n chunk over
+    ``extra_axes``. ``total`` is the full data-parallel degree the mean
+    divides by (defaults to the product of the named axes). Call inside
+    ``shard_map``."""
+    n = lax.axis_size(axis_name)
+    rows = _chunk_rows(g, n)
+    part = lax.psum_scatter(rows, axis_name, scatter_dimension=0, tiled=False)
+    if extra_axes:
+        part = lax.psum(part, tuple(extra_axes))
+    if total is None:
+        total = n
+        for a in extra_axes:
+            total = total * lax.axis_size(a)
+    return (part / total).astype(g.dtype)
+
+
+def zero_param_chunk(p: jax.Array, axis_name: str) -> jax.Array:
+    """This shard's 1/n chunk of a replicated parameter (the slice the
+    sharded update owns). Call inside ``shard_map``."""
+    n = lax.axis_size(axis_name)
+    return lax.dynamic_index_in_dim(
+        _chunk_rows(p, n), lax.axis_index(axis_name), keepdims=False
+    )
+
+
+def zero_gather_updates(u_chunk: jax.Array, like: jax.Array,
+                        axis_name: str) -> jax.Array:
+    """All-gather the per-shard update chunks back to ``like``'s full
+    shape — the other half of the allreduce the scatter replaced. Call
+    inside ``shard_map``."""
+    rows = lax.all_gather(u_chunk, axis_name, axis=0, tiled=False)
+    return _unchunk(rows, like.shape, like.dtype)
+
+
 def zero_shard_optimizer(
     inner: optax.GradientTransformation,
     axis_name: str,

@@ -144,12 +144,15 @@ def normalize_loss_fn(loss_fn: Callable) -> Callable:
 def make_train_step(
     loss_fn: Callable,
     optimizer,
-    comm: CommunicatorBase,
+    comm: Optional[CommunicatorBase] = None,
     *,
     axis_name: Optional[str] = None,
     batch_spec: P | None = None,
     donate: bool = True,
     accum_steps: int = 1,
+    plan=None,
+    param_specs=None,
+    pipeline=None,
 ):
     """Build the jitted data-parallel train step.
 
@@ -161,8 +164,21 @@ def make_train_step(
       optimizer: a :class:`MultiNodeOptimizer` (does its own reduction,
         honouring compression/double-buffering) or any plain optax transform
         (the step then reduces gradients itself).
+      comm: the communicator whose mesh the step compiles over. May be
+        omitted when ``plan`` is given.
       batch_spec: PartitionSpec for every batch leaf; defaults to sharding
         the leading dim over the communicator's grad axes.
+      plan: a :class:`~chainermn_tpu.parallel.plan.ParallelPlan` — the
+        global-view path: the step is compiled by the plan (one shard_map
+        over the plan's ``data x zero x pipe x model`` mesh, spec
+        providers instead of call-site wrappers, donation threaded
+        through). ``optimizer`` is unwrapped to its plain inner transform
+        via :func:`chainermn_tpu.optimizers.inner_transform`; build the
+        state with ``plan.create_train_state``. ``param_specs`` marks
+        model/pipe-stacked leaves and ``pipeline`` passes the
+        :class:`~chainermn_tpu.parallel.plan.PipelinePlanSpec` of a
+        ``pipe`` plan; ``axis_name``/``accum_steps``/``batch_spec`` do
+        not apply on this path.
       accum_steps: gradient accumulation — each shard's batch is split into
         this many microbatches, run through a ``lax.scan`` (one compiled
         program, activations live for ONE microbatch at a time), and the
@@ -178,8 +194,25 @@ def make_train_step(
         full-batch pass.
 
     Returns:
-      ``step(state, batch) -> (state, metrics)``, jitted over ``comm.mesh``.
+      ``step(state, batch) -> (state, metrics)``, jitted over ``comm.mesh``
+      (or the plan's mesh).
     """
+    if plan is not None:
+        if accum_steps != 1 or axis_name is not None or batch_spec is not None:
+            raise ValueError(
+                "plan= owns the batch/axis layout: axis_name, batch_spec "
+                "and accum_steps do not apply to a plan-compiled step"
+            )
+        return plan.compile_train_step(
+            loss_fn, optimizer,
+            param_specs=param_specs, donate=donate, pipeline=pipeline,
+        )
+    if comm is None:
+        raise ValueError("pass a communicator (or plan=)")
+    if param_specs is not None or pipeline is not None:
+        raise ValueError(
+            "param_specs/pipeline only apply to the plan= path"
+        )
     mesh = comm.mesh
     axes = axis_name if axis_name is not None else comm.grad_axes
     if batch_spec is None:

@@ -466,3 +466,17 @@ def test_compact_overflow_sheds_newest_keys_with_marker(tmp_path,
     parsed2 = json.loads(buf2.getvalue().strip().splitlines()[-1])
     assert "compact_keys_shed" not in parsed2
     assert parsed2["serving_cluster_spread_pct"] == 2.0
+
+
+def test_plan_rows_contract():
+    """ISSUE 10 satellite: the ``plan`` bench phase's headline rows ride
+    the compact line (hand-wired vs plan-compiled ratio + spread gate),
+    and the phase is wired into the supplementary chain so a plan
+    regression reaches the driver artifact."""
+    for k in ("plan_vs_handwired", "plan_spread_pct"):
+        assert k in bench._COMPACT_KEYS, k
+    assert callable(bench._bench_plan)
+    import inspect
+
+    src = inspect.getsource(bench._run_bench)
+    assert 'supp("plan", "plan_error"' in src
