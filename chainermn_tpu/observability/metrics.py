@@ -590,6 +590,33 @@ def _tap_event(ev: Mapping[str, Any]) -> None:
         elif phase == "finish":
             reg.counter("serving_requests_total",
                         "completed serving requests").inc()
+            # SLO verdicts (ISSUE 11): one violation count per missed
+            # target kind — a request can miss both.
+            if ev.get("slo_ttft_ok") is False:
+                reg.counter(
+                    "serving_slo_violations_total",
+                    "finished requests outside a stated SLO target",
+                ).inc(kind="ttft")
+            if ev.get("slo_tpot_ok") is False:
+                reg.counter(
+                    "serving_slo_violations_total",
+                    "finished requests outside a stated SLO target",
+                ).inc(kind="tpot")
+        elif phase == "preempt":
+            reg.counter(
+                "serving_preemptions_total",
+                "in-flight requests preempted back to the queue "
+                "(SLO scheduling)",
+            ).inc()
+    elif kind == "prefill_chunk":
+        reg.counter(
+            "serving_prefill_chunks_total",
+            "prompt chunks written through the mixed step",
+        ).inc()
+        reg.counter(
+            "serving_chunk_tokens_total",
+            "prompt tokens prefilled through mixed-step chunks",
+        ).inc(float(ev.get("tokens") or 0))
     elif kind == "speculate":
         reg.counter("speculate_drafted_total",
                     "speculative tokens drafted").inc(

@@ -24,9 +24,12 @@ per step, per process. Three properties are load-bearing:
   ``dispatch`` (autotune provenance), ``straggler``, ``profile_start`` /
   ``profile_stop``, ``wire`` / ``overlap_config`` (ISSUE 3 per-bucket
   reduction telemetry), ``serving`` (ISSUE 4 queue_wait / prefill /
-  decode_step / finish phases), ``speculate`` (ISSUE 5 per-tick
+  decode_step / finish phases, plus the ISSUE 11 ``preempt`` phase),
+  ``speculate`` (ISSUE 5 per-tick
   drafted/accepted counts), ``prefix_cache`` (ISSUE 7 per-admission
-  prompt/hit/prefilled token counts + COW copies).
+  prompt/hit/prefilled token counts + COW copies), ``prefill_chunk``
+  (ISSUE 11 per-advanced-fill-row chunk telemetry from the mixed
+  step).
   ``tools/trace_report.py`` summarizes a JSONL file;
   :func:`chrome_trace` converts to the ``chrome://tracing`` / Perfetto
   format.
@@ -488,7 +491,24 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
       decode_steps`` for an amortized per-token figure);
     - ``ttft_ms_p50``/``p99`` = nearest-rank percentiles over the
       prefill events' ``ttft_s`` (submit → first token; None for
-      traces predating the field);
+      traces predating the field; a preemption-resume's re-prefill
+      carries no ``ttft_s`` and never re-enters the percentile);
+    - ``tpot_ms_p50``/``p99`` (ISSUE 11 satellite) = nearest-rank
+      percentiles over PER-REQUEST mean inter-token latency — the
+      finish events' ``tpot_ms`` field (first token → finish over
+      ``generated - 1`` intervals; preemption gaps included), falling
+      back to ``(dur_s - ttft_s) / (generated - 1)`` for traces
+      predating the field;
+    - ``slo_attainment`` (present only when some finished request
+      carried TTFT/TPOT targets, ISSUE 11) = fraction of
+      target-bearing finished requests whose every stated target was
+      met (the finish events' ``slo_ttft_ok``/``slo_tpot_ok``
+      verdicts), with ``slo_requests`` the denominator;
+    - ``preemptions`` (present only when > 0, ISSUE 11) = count of
+      ``phase='preempt'`` events;
+    - ``chunked_prefill`` (present only when ``prefill_chunk`` events
+      exist, ISSUE 11) = chunk count and prompt tokens written through
+      the mixed step's fill rows;
     - ``occupancy_mean`` = mean of ``n_active / n_slots`` over decode
       steps;
     - ``speculation`` (present only when ``speculate`` events exist) =
@@ -506,10 +526,15 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
     queue_waits: list[float] = []
     prefills: list[float] = []
     ttfts: list[float] = []
+    ttft_by_req: dict = {}
+    tpots: list[float] = []
     steps: list[float] = []
     occupancy: list[float] = []
     step_tokens = 0
     finishes = 0
+    finish_evs: list = []
+    preemptions = 0
+    chunks = chunk_tokens = 0
     spec_ticks = 0
     spec_drafted = 0
     spec_accepted = 0
@@ -518,6 +543,10 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
     px_hit_tokens = px_prompt_tokens = px_prefill_tokens = px_cow = 0
     for ev in events:
         kind = ev.get("kind")
+        if kind == "prefill_chunk":
+            chunks += 1
+            chunk_tokens += int(ev.get("tokens") or 0)
+            continue
         if kind == "speculate":
             spec_ticks += 1
             spec_drafted += int(ev.get("drafted") or 0)
@@ -545,6 +574,9 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
             prefills.append(dur)
             if ev.get("ttft_s") is not None:
                 ttfts.append(float(ev["ttft_s"]))
+                rid = ev.get("request")
+                if rid is not None and rid not in ttft_by_req:
+                    ttft_by_req[rid] = float(ev["ttft_s"])
         elif phase == "decode_step":
             steps.append(dur)
             step_tokens += int(ev.get("tokens") or 0)
@@ -552,10 +584,33 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
             if n_slots:
                 occupancy.append(float(ev.get("n_active") or 0)
                                  / float(n_slots))
+        elif phase == "preempt":
+            preemptions += 1
         elif phase == "finish":
             finishes += 1
+            finish_evs.append(ev)
+    # Per-request TPOT: the finish event's own tpot_ms when present
+    # (preferred — the scheduler's first-token clock survives
+    # preemption), else derived from dur - ttft over generated - 1.
+    slo_total = slo_ok = 0
+    for ev in finish_evs:
+        tpot = ev.get("tpot_ms")
+        if tpot is None:
+            gen = int(ev.get("generated") or 0)
+            rid = ev.get("request")
+            ttft = ttft_by_req.get(rid)
+            if gen > 1 and ttft is not None and ev.get("dur_s"):
+                tpot = (float(ev["dur_s"]) - ttft) / (gen - 1) * 1e3
+        if tpot is not None:
+            tpots.append(float(tpot))
+        verdicts = [ev.get(k) for k in ("slo_ttft_ok", "slo_tpot_ok")
+                    if ev.get(k) is not None]
+        if verdicts:
+            slo_total += 1
+            if all(verdicts):
+                slo_ok += 1
     if not (queue_waits or prefills or steps or finishes or spec_ticks
-            or px_lookups):
+            or px_lookups or preemptions or chunks):
         return None
 
     pct = nearest_rank  # the shared ceil(q*n) rule (observability.stats)
@@ -580,11 +635,21 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
                         if ttfts else None),
         "ttft_ms_p99": (round(pct(ttfts, 0.99) * 1e3, 4)
                         if ttfts else None),
+        "tpot_ms_p50": (round(pct(tpots, 0.5), 4) if tpots else None),
+        "tpot_ms_p99": (round(pct(tpots, 0.99), 4) if tpots else None),
         "occupancy_mean": (round(sum(occupancy) / len(occupancy), 4)
                            if occupancy else None),
         "tokens_per_sec": (round(tokens / busy_s, 2) if busy_s > 0
                            else None),
     }
+    if slo_total:
+        out["slo_requests"] = slo_total
+        out["slo_attainment"] = round(slo_ok / slo_total, 4)
+    if preemptions:
+        out["preemptions"] = preemptions
+    if chunks:
+        out["chunked_prefill"] = {"chunks": chunks,
+                                  "chunk_tokens": chunk_tokens}
     if spec_ticks:
         out["speculation"] = {
             "ticks": spec_ticks,

@@ -8,12 +8,14 @@ from chainermn_tpu.serving.engine import (
     DECODE_IMPLS,
     KV_BLOCK_SIZES,
     MIN_SHARED_BLOCKS,
+    PREFILL_CHUNKS,
     PREFIX_CACHE,
     SPEC_TOKENS,
     ServingEngine,
     resolve_decode_impl,
     resolve_kv_block_size,
     resolve_min_shared_blocks,
+    resolve_prefill_chunk,
     resolve_prefix_cache,
     resolve_spec_tokens,
     serving_decision_key,
@@ -41,6 +43,7 @@ __all__ = [
     "DECODE_IMPLS",
     "KV_BLOCK_SIZES",
     "MIN_SHARED_BLOCKS",
+    "PREFILL_CHUNKS",
     "PREFIX_CACHE",
     "SPEC_TOKENS",
     "POLICIES",
@@ -52,6 +55,7 @@ __all__ = [
     "resolve_decode_impl",
     "resolve_kv_block_size",
     "resolve_min_shared_blocks",
+    "resolve_prefill_chunk",
     "resolve_prefix_cache",
     "resolve_spec_tokens",
     "serving_decision_key",

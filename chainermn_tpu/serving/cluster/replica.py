@@ -47,9 +47,11 @@ class Replica:
     # ---- routing signals --------------------------------------------
 
     def load(self) -> int:
-        """Queued + in-flight requests on this replica's scheduler —
-        the least-loaded policy's primary signal."""
-        return self.scheduler.pending + self.scheduler.in_flight
+        """Queued + in-flight + mid-fill requests on this replica's
+        scheduler — the least-loaded policy's primary signal (chunked
+        admissions occupy a slot before their first token, ISSUE 11)."""
+        return (self.scheduler.pending + self.scheduler.in_flight
+                + getattr(self.scheduler, "filling", 0))
 
     def slots_free(self) -> int:
         return self.engine.free_slot_count

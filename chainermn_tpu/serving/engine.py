@@ -68,6 +68,12 @@ PREFIX_CACHE = ("off", "on")
 #: the join prefills from scratch (a one-block hit saves little prefill
 #: but still pays table/refcount churn and pins blocks in the cache).
 MIN_SHARED_BLOCKS = ("1", "2", "4")
+#: chunked-prefill widths the ``prefill_chunk`` decision chooses among
+#: (ISSUE 11): 0 = monolithic bucketed prefill (``prefill_join``); C > 0
+#: = admitted prompts write C tokens of KV per tick INSIDE the mixed
+#: step while the remaining active slots decode — the long-prompt
+#: TPOT-freeze fix, priced by the bench's bursty goodput-under-SLO rows.
+PREFILL_CHUNKS = ("0", "16", "32", "64", "128")
 
 
 def serving_decision_key(d_model: int, num_heads: int, max_len: int,
@@ -132,6 +138,20 @@ def resolve_min_shared_blocks(d_model: int, num_heads: int,
 
     return int(tuning.choice(
         "min_shared_blocks", MIN_SHARED_BLOCKS,
+        serving_decision_key(d_model, num_heads, max_len),
+    ))
+
+
+def resolve_prefill_chunk(d_model: int, num_heads: int,
+                          max_len: int) -> int:
+    """Resolve the chunked-prefill width via the registry (decision
+    ``prefill_chunk``, same key as the other serving decisions — table
+    default 0: chunking must EARN adoption through the bench's bursty
+    goodput-under-SLO rows, the spec_tokens precedent)."""
+    from chainermn_tpu import tuning
+
+    return int(tuning.choice(
+        "prefill_chunk", PREFILL_CHUNKS,
         serving_decision_key(d_model, num_heads, max_len),
     ))
 
@@ -227,6 +247,23 @@ class ServingEngine:
         unshared ones (pinned in tests/test_prefix_cache.py).
       min_shared_blocks: minimum matched FULL blocks before a trie hit
         is adopted (decision ``min_shared_blocks`` under ``'auto'``).
+      prefill_chunk: chunked-prefill width in tokens per tick (ISSUE
+        11): ``0`` = monolithic bucketed prefill (``prefill_join`` runs
+        the whole prompt in one forward, freezing every active slot's
+        decode for its duration — the long-prompt p99 killer); ``C >
+        0`` = admission reserves the slot without a forward
+        (``chunked_join``) and each :meth:`mixed_step` tick writes up
+        to C prompt tokens of KV at their true positions for the
+        filling slots WHILE the remaining active slots decode (or, with
+        ``spec_tokens > 0``, draft-and-verify) — ONE jitted program of
+        fixed width ``max(C, spec_tokens + 1)`` whose jit cache stays
+        at 1 across every chunk/decode occupancy mix. Chunked streams
+        are bit-identical to monolithic ones (every emitted token is
+        still the model's own argmax at its true position); greedy-only
+        like ``spec_tokens`` — combining it with ``temperature > 0`` is
+        rejected. ``'auto'`` resolves through the registry (decision
+        ``prefill_chunk``, table default 0 — chunking must earn
+        adoption via the bursty bench rows).
     """
 
     def __init__(self, model, params, *, num_slots: int,
@@ -240,7 +277,8 @@ class ServingEngine:
                  top_p: Optional[float] = None,
                  rng=None, pad_id: int = 0, mesh=None,
                  spec_tokens="auto", drafter=None,
-                 prefix_cache="auto", min_shared_blocks="auto") -> None:
+                 prefix_cache="auto", min_shared_blocks="auto",
+                 prefill_chunk="auto") -> None:
         import jax
 
         from chainermn_tpu.models.transformer import TransformerLM
@@ -429,6 +467,45 @@ class ServingEngine:
             drafter = NgramDrafter()
         self._drafter = drafter
 
+        # ---- chunked prefill (ISSUE 11): C prompt tokens of KV written
+        # per tick inside the mixed step, interleaved with decode.
+        if prefill_chunk == "auto":
+            prefill_chunk = resolve_prefill_chunk(
+                model.d_model, model.num_heads, max_len
+            )
+            self._adopt_decision("prefill_chunk", key)
+        else:
+            prefill_chunk = int(prefill_chunk)
+            self.decisions.append({"name": "prefill_chunk", "key": key,
+                                   "winner": str(prefill_chunk),
+                                   "source": "explicit"})
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}"
+            )
+        if prefill_chunk > 0 and self.temperature > 0.0:
+            # The spec_tokens precedent: the chunked==monolithic stream
+            # guarantee is a GREEDY property (the mixed step consumes
+            # one key per grid, monolithic one per program call —
+            # sampled streams would silently diverge between the two
+            # schedules with the same seed).
+            raise ValueError(
+                "chunked prefill is greedy-only: prefill_chunk="
+                f"{prefill_chunk} with temperature={self.temperature} "
+                "breaks the chunked==monolithic stream guarantee — set "
+                "temperature=0 or prefill_chunk=0"
+            )
+        self.prefill_chunk = int(prefill_chunk)
+        #: width of the mixed step's token grid — the chunk columns and
+        #: the verify span share ONE program, so chunk and draft rows
+        #: coexist in the same tick at the wider of the two.
+        self._mixed_T = (max(self.prefill_chunk, self.spec_tokens + 1)
+                         if self.prefill_chunk > 0 else 0)
+        #: slots admitted by chunked_join whose prompt KV is still being
+        #: written (insertion order = admission order, the fill-row FIFO
+        #: mixed_step advances). NOT active: decode masks exclude them.
+        self._pending_fill: dict[int, dict] = {}
+
         # ---- decode-path model (and its TP shard form)
         self._mesh = mesh
         clone_kw: dict[str, Any] = dict(
@@ -476,9 +553,22 @@ class ServingEngine:
         )
         if mesh is not None:
             import jax.numpy as jnp
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
 
+            # Placed with the mesh sharding the step programs RETURN
+            # (out_specs P('model')): the first program to touch the
+            # cache must see the canonical sharding, or its jit entry
+            # compiles against the default placement and the second
+            # call recompiles — monolithic engines never noticed
+            # (prefill always ran first and canonicalised it), but a
+            # chunked engine's FIRST forward is the mixed step itself.
+            sh = NamedSharding(mesh, P("model"))
             cache = jax.tree.map(
-                lambda c: jnp.broadcast_to(c[None], (self._tp_n,) + c.shape),
+                lambda c: jax.device_put(
+                    jnp.broadcast_to(c[None], (self._tp_n,) + c.shape),
+                    sh,
+                ),
                 cache,
             )
         self._cache = cache
@@ -502,6 +592,9 @@ class ServingEngine:
         self._decode_step_jit = self._build_decode_step()
         self._verify_step_jit = (
             self._build_verify_step() if self.spec_tokens > 0 else None
+        )
+        self._mixed_step_jit = (
+            self._build_mixed_step() if self.prefill_chunk > 0 else None
         )
         self._cow_copy_jit = (
             self._build_cow_copy() if self._prefix is not None else None
@@ -661,6 +754,40 @@ class ServingEngine:
 
         return self._tp_jit(inner, 3)
 
+    def _build_mixed_step(self):
+        """The chunked-prefill MIXED step (ISSUE 11 tentpole): ONE
+        forward over a fixed ``[slots, T]`` grid, ``T = max(chunk,
+        K+1)``, through the same per-row position spans as the verify
+        step (``_slot_decode_attend``) — fill rows write up to
+        ``chunk`` REAL prompt tokens at their true positions, decode
+        rows carry ``[last_tok, drafts..., pad]``, inactive/stalled
+        rows carry pads whose writes land in scratch or in blocks the
+        next real write re-covers before any causal mask admits them
+        (the speculative-rollback staleness argument, reused). Which
+        rows chunk vs decode is HOST metadata, so the jit cache stays
+        at one entry across every chunk/decode occupancy mix — and
+        under TP the program carries exactly the same 2 all-reduces
+        per layer as the one-token step (pinned by HLO count).
+        Sampling runs per grid position (one key, independent gumbel
+        noise per cell): at temperature 0 that is the verify step's
+        greedy-argmax grid, which is what acceptance and the chunk
+        boundary token both read."""
+        model = self._decode_model
+
+        def inner(cache, variables, tokens, positions, tables, key):
+            logits, mutated = model.apply(
+                {**variables, "cache": cache}, tokens,  # [slots, T]
+                train=False, decode=True, decode_positions=positions,
+                block_tables=tables, mutable=["cache"],
+            )
+            S, T = tokens.shape
+            toks = self._sample(
+                logits.reshape(S * T, -1), key
+            ).reshape(S, T)
+            return mutated["cache"], toks  # [slots, T]
+
+        return self._tp_jit(inner, 4)
+
     def _build_cow_copy(self):
         """The copy-on-write block copy: ONE jitted program copying one
         physical block (src -> dst) in every layer's K and V pool
@@ -768,6 +895,11 @@ class ServingEngine:
     def free_slot_count(self) -> int:
         return len(self._free)
 
+    @property
+    def n_filling(self) -> int:
+        """Slots admitted by ``chunked_join`` still writing prompt KV."""
+        return len(self._pending_fill)
+
     def occupancy(self) -> float:
         return self.n_active / self.num_slots
 
@@ -825,6 +957,15 @@ class ServingEngine:
         size = getattr(self._verify_step_jit, "_cache_size", None)
         return int(size()) if size else None
 
+    def mixed_compile_count(self) -> Optional[int]:
+        """Compilations of the chunked-prefill mixed step (the ISSUE 11
+        pin: must stay 1 across every chunk/decode occupancy mix).
+        None when chunking is off or the runtime hides the cache."""
+        if self._mixed_step_jit is None:
+            return None
+        size = getattr(self._mixed_step_jit, "_cache_size", None)
+        return int(size()) if size else None
+
     def prefill_compile_count(self) -> Optional[int]:
         sizes = [getattr(f, "_cache_size", None)
                  for f in self._prefill_jits.values()]
@@ -852,6 +993,61 @@ class ServingEngine:
         """
         import jax.numpy as jnp
 
+        res = self._admit_common(prompt)
+        if res is None:
+            return None
+        slot, prompt, P_len, tail_start, tail_len, _matched, _cow = res
+        bucket = bucket_length(tail_len, self._buckets)
+
+        padded = np.full((1, bucket), self.pad_id, np.int32)
+        padded[0, :tail_len] = prompt[tail_start:]
+        fn = self._prefill_fn(bucket)
+        self._cache, tok = fn(
+            self._cache, self._vars, jnp.asarray(padded),
+            jnp.int32(tail_len), jnp.full((1,), tail_start, jnp.int32),
+            jnp.asarray([slot], jnp.int32),
+            jnp.asarray(self._dummy_tables()[slot:slot + 1]),
+            self._split_key(),
+        )
+        tok = int(tok)
+        self._positions[slot] = P_len
+        self._last_tok[slot] = tok
+        self._active[slot] = True
+        self._history[slot] = [int(t) for t in prompt] + [tok]
+        self._publish_full_blocks(slot, prompt, P_len)
+        self._publish_pool_gauges()
+        return slot, tok, bucket
+
+    def _publish_full_blocks(self, slot: int, tokens,
+                             n_positions: int) -> None:
+        """Insert ``slot``'s FULL blocks covering the WRITTEN positions
+        ``[0, n_positions)`` into the prefix trie — the ONE publish
+        rule every path shares (prefill/fill completion, import_kv
+        adoption, preemption): an adopted prefix walks existing nodes,
+        only fresh full blocks add nodes, and the partial tail block is
+        never inserted (the next write targets it). No-op with sharing
+        off."""
+        if self._prefix is None:
+            return
+        bs = self._alloc.block_size
+        full = int(n_positions) // bs
+        if full:
+            self._prefix.insert(
+                [int(t) for t in tokens[:full * bs]],
+                self._alloc.owned_blocks(slot)[:full],
+            )
+
+    def _admit_common(self, prompt):
+        """Shared admission front half of :meth:`prefill_join` and
+        :meth:`chunked_join`: validate the prompt, consult the prefix
+        trie, reserve the slot's pool blocks for the whole prompt plus
+        the first decode write, COW-protect the unshared tail's
+        boundary, commit the slot and account the admission. Returns
+        ``(slot, prompt, P_len, tail_start, tail_len, matched, cow)``
+        with the slot POPPED from the free list, or None to defer (host
+        state untouched — the scheduler retries). ``last_prefix_info``
+        is (re)set here, so both join flavours feed the same
+        ``prefix_cache`` event."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         P_len = int(prompt.shape[0])
         if P_len < 1:
@@ -877,7 +1073,6 @@ class ServingEngine:
         # the whole prompt re-feeds one token into the boundary block.
         tail_start = min(hit_tokens, P_len - 1)
         tail_len = P_len - tail_start
-        bucket = bucket_length(tail_len, self._buckets)
         if self._alloc is not None:
             # Reserve only the REAL tokens plus the first decode write
             # (position P_len) — NOT the padded bucket: pad writes
@@ -926,33 +1121,7 @@ class ServingEngine:
         if matched:
             self.prefix_stats["hits"] += 1
             self.prefix_stats["hit_tokens"] += hit_tokens
-
-        padded = np.full((1, bucket), self.pad_id, np.int32)
-        padded[0, :tail_len] = prompt[tail_start:]
-        fn = self._prefill_fn(bucket)
-        self._cache, tok = fn(
-            self._cache, self._vars, jnp.asarray(padded),
-            jnp.int32(tail_len), jnp.full((1,), tail_start, jnp.int32),
-            jnp.asarray([slot], jnp.int32),
-            jnp.asarray(self._dummy_tables()[slot:slot + 1]),
-            self._split_key(),
-        )
-        tok = int(tok)
-        self._positions[slot] = P_len
-        self._last_tok[slot] = tok
-        self._active[slot] = True
-        self._history[slot] = [int(t) for t in prompt] + [tok]
         if self._prefix is not None:
-            # Completed prefill: cache the prompt's FULL blocks (the
-            # adopted prefix walks existing nodes; only fresh full
-            # blocks add nodes). The partial tail block is never
-            # inserted — the next decode write targets it.
-            full = P_len // self._alloc.block_size
-            if full:
-                self._prefix.insert(
-                    prompt[:full * self._alloc.block_size],
-                    self._alloc.owned_blocks(slot)[:full],
-                )
             self.last_prefix_info = {
                 "prompt_tokens": P_len,
                 "hit_blocks": len(matched),
@@ -960,8 +1129,33 @@ class ServingEngine:
                 "prefill_tokens": tail_len,
                 "cow_blocks": cow,
             }
+        return slot, prompt, P_len, tail_start, tail_len, matched, cow
+
+    def chunked_join(self, prompt):
+        """Admit one request for CHUNKED prefill (``prefill_chunk > 0``,
+        ISSUE 11): claim the slot and reserve its blocks EXACTLY like
+        :meth:`prefill_join` — trie adoption, whole-prompt ensure,
+        boundary-block COW — but run no forward here. The prompt's
+        unshared tail is written ``prefill_chunk`` tokens per
+        :meth:`mixed_step` tick while the remaining slots decode; the
+        final chunk samples the first generated token and activates the
+        slot. Returns the slot, or None to defer (host state untouched
+        — the scheduler retries; same deferral contract as the
+        monolithic join)."""
+        if self.prefill_chunk <= 0:
+            raise RuntimeError(
+                "chunked_join needs prefill_chunk > 0 — use prefill_join"
+            )
+        res = self._admit_common(prompt)
+        if res is None:
+            return None
+        slot, prompt, P_len, tail_start, tail_len, _matched, _cow = res
+        self._pending_fill[slot] = {
+            "prompt": prompt, "pos": tail_start, "P_len": P_len,
+            "chunks": 0,
+        }
         self._publish_pool_gauges()
-        return slot, tok, bucket
+        return slot
 
     def decode_step(self):
         """One fused decode step over ALL slots. Returns ``(tokens,
@@ -1126,6 +1320,181 @@ class ServingEngine:
                  "accept_lens": accept_lens}
         self._publish_pool_gauges()
         return committed, dur, stats
+
+    def mixed_step(self, max_fill_rows: Optional[int] = None):
+        """One fused chunk+decode tick over ALL slots (ISSUE 11
+        tentpole). Fill rows (:meth:`chunked_join` admissions, FIFO)
+        write their next ``prefill_chunk`` prompt tokens of KV at their
+        true positions; active rows decode one token — or, with
+        ``spec_tokens > 0``, draft-and-verify their span — in the SAME
+        jitted forward (:meth:`_build_mixed_step`), so a long prompt's
+        prefill no longer freezes every in-flight stream for a whole
+        monolithic forward: per-tick interference is bounded by the
+        chunk width.
+
+        ``max_fill_rows`` caps how many fill rows advance this tick
+        (the SLO scheduler's interference bound — host selection only,
+        the compiled program never changes); stalled fill rows ride the
+        grid as pad rows whose garbage writes land in their own
+        reserved blocks and are re-written by the real chunk before
+        any causal mask admits them (the speculative-rollback staleness
+        argument).
+
+        Returns ``(committed, fills, dur_s, spec_stats)``:
+        ``committed[slot]`` = the decode tokens slot advanced by
+        (1..K+1, every one a verify-grid argmax — bit-identical to the
+        plain stream); ``fills`` = one record per ADVANCED fill row
+        (``slot``/``chunk`` index/``tokens`` written/``done`` and, on
+        the final chunk, ``first_tok`` — the request's first generated
+        token, sampled at the last prompt position exactly as the
+        monolithic prefill would); ``spec_stats`` = the ``speculate``
+        accounting (None when ``spec_tokens == 0``)."""
+        import jax.numpy as jnp
+
+        if self._mixed_step_jit is None:
+            raise RuntimeError("mixed_step needs prefill_chunk > 0 — "
+                               "use decode_step/verify_step")
+        T, K, C = self._mixed_T, self.spec_tokens, self.prefill_chunk
+        active = [int(s) for s in np.flatnonzero(self._active)]
+        # Decode-side block discipline: verify_step's per-tick lease
+        # rules verbatim at K > 0; the plain ensure at K == 0. (Fill
+        # rows reserved their whole span at admission.)
+        if self._alloc is not None and K > 0:
+            for s in active:
+                self._alloc.trim(s, int(self._positions[s]) + 1)
+        for s in active:
+            p = int(self._positions[s])
+            if p + 1 > self.max_len:
+                raise RuntimeError(
+                    f"slot {s} ran past the serving horizon "
+                    f"max_len={self.max_len}; bound max_new_tokens"
+                )
+            if self._alloc is not None and not self._alloc.ensure(
+                s, p + 1
+            ):
+                raise self._pool_exhausted_error()
+        room: dict[int, int] = {}
+        for s in active:
+            p = int(self._positions[s])
+            if K > 0:
+                covered = min(p + K + 1, self.max_len)
+                if (self._alloc is not None and covered > p + 1
+                        and not self._alloc.ensure(s, covered)):
+                    covered = p + 1
+                room[s] = min(K, covered - p - 1, self.max_len - 1 - p)
+            else:
+                room[s] = 0
+            self._cow_protect(s, p, room[s] + 1)
+
+        fill_slots = list(self._pending_fill)
+        if max_fill_rows is not None:
+            fill_slots = fill_slots[:max(0, int(max_fill_rows))]
+
+        tokens = np.full((self.num_slots, T), self.pad_id, np.int64)
+        positions = np.zeros(self.num_slots, np.int64)
+        drafts = np.zeros((self.num_slots, max(K, 1)), np.int64)
+        prop_len: dict[int, int] = {}
+        n_drafted = 0
+        for s in active:
+            positions[s] = self._positions[s]
+            tokens[s, 0] = self._last_tok[s]
+            if K > 0:
+                prop = list(
+                    self._drafter.propose(self._history[s], room[s])
+                )[:room[s]]
+                prop_len[s] = len(prop)
+                n_drafted += len(prop)
+                for j, t in enumerate(prop):
+                    drafts[s, j] = t
+                    tokens[s, 1 + j] = t
+        chunk_len: dict[int, int] = {}
+        for s, st in self._pending_fill.items():
+            # Stalled rows keep position = frontier with all-pad tokens:
+            # their garbage lands in blocks the real chunk re-writes.
+            positions[s] = st["pos"]
+            if s in fill_slots:
+                n = min(C, st["P_len"] - st["pos"])
+                tokens[s, :n] = st["prompt"][st["pos"]:st["pos"] + n]
+                chunk_len[s] = n
+
+        t0 = time.perf_counter()
+        self._cache, toks = self._mixed_step_jit(
+            self._cache, self._vars, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            self._tables_device(),
+            self._split_key(),
+        )
+        toks = np.asarray(toks)  # device sync: honest tick latency
+        dur = time.perf_counter() - t0
+
+        from chainermn_tpu.serving.speculate import accept_length
+
+        committed: dict[int, list[int]] = {}
+        accept_lens: list[int] = []
+        n_accepted = 0
+        for s in active:
+            a = accept_length(
+                drafts[s], toks[s], min(room[s], prop_len[s])
+            ) if K > 0 else 0
+            take = [int(t) for t in toks[s, :a + 1]]
+            committed[s] = take
+            if K > 0:
+                accept_lens.append(a)
+                n_accepted += a
+            self._history[s].extend(take)
+            self._last_tok[s] = take[-1]
+            self._positions[s] += a + 1
+
+        fills: list[dict] = []
+        for s in fill_slots:
+            st = self._pending_fill[s]
+            n = chunk_len[s]
+            st["pos"] += n
+            st["chunks"] += 1
+            done = st["pos"] >= st["P_len"]
+            rec = {"slot": s, "chunk": st["chunks"] - 1, "tokens": n,
+                   "done": done, "first_tok": None}
+            if done:
+                # The final chunk's last REAL column sits at position
+                # P_len - 1: its grid token is the first generated
+                # token, exactly what the monolithic prefill samples.
+                first = int(toks[s, n - 1])
+                prompt, P_len = st["prompt"], st["P_len"]
+                del self._pending_fill[s]
+                self._positions[s] = P_len
+                self._last_tok[s] = first
+                self._active[s] = True
+                self._history[s] = [int(t) for t in prompt] + [first]
+                self._publish_full_blocks(s, prompt, P_len)
+                rec["first_tok"] = first
+            fills.append(rec)
+        stats = ({"drafted": n_drafted, "accepted": n_accepted,
+                  "accept_lens": accept_lens} if K > 0 else None)
+        self._publish_pool_gauges()
+        return committed, fills, dur, stats
+
+    def preempt(self, slot: int) -> None:
+        """Release ``slot`` mid-stream (the SLO scheduler's preemption
+        hook, ISSUE 11), first publishing its WRITTEN full blocks into
+        the prefix trie (when sharing is on) so a resumed request
+        re-adopts its OWN KV through the ordinary trie-hit path and
+        re-prefills only the partial tail block — resume costs one
+        short prefill, not the whole history. Covers active slots AND
+        in-progress chunked fills (their written chunks are cached
+        too). Without the prefix cache the resume re-prefills the full
+        history — slower, still bit-identical (greedy streams are
+        deterministic)."""
+        pend = self._pending_fill.pop(slot, None)
+        if pend is not None:
+            self._publish_full_blocks(slot, pend["prompt"],
+                                      int(pend["pos"]))
+            self._release_slot(slot)
+            return
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        self._publish_full_blocks(slot, self._history[slot],
+                                  int(self._positions[slot]))
+        self.leave(slot)
 
     # ------------------------------------------------------------------
     # cross-replica KV handoff (ISSUE 8): the engine-side hooks behind
@@ -1353,16 +1722,9 @@ class ServingEngine:
         self._last_tok[slot] = int(payload["last_tok"])
         self._active[slot] = True
         self._history[slot] = [int(t) for t in payload["tokens"]]
-        if self._prefix is not None:
-            # KV exists for tokens[:pos]; cache the FULL blocks (the
-            # prefill-completion rule — partial tails never inserted).
-            seq = self._history[slot][:pos]
-            full = len(seq) // self._alloc.block_size
-            if full:
-                self._prefix.insert(
-                    seq[:full * self._alloc.block_size],
-                    self._alloc.owned_blocks(slot)[:full],
-                )
+        # KV exists for tokens[:pos]; cache the FULL blocks (the shared
+        # publish rule — partial tails never inserted).
+        self._publish_full_blocks(slot, self._history[slot], pos)
         self._publish_pool_gauges()
         return slot, int(payload["last_tok"])
 
@@ -1372,6 +1734,13 @@ class ServingEngine:
         writes land in the slot's own rows or the scratch block)."""
         if not self._active[slot]:
             raise ValueError(f"slot {slot} is not active")
+        self._release_slot(slot)
+
+    def _release_slot(self, slot: int) -> None:
+        """The ONE slot-release body :meth:`leave` and the mid-fill
+        branch of :meth:`preempt` share (free list, history, paged
+        blocks, gauges) — release-side accounting added here reaches
+        both paths."""
         self._active[slot] = False
         self._free.append(int(slot))
         self._history[int(slot)] = []

@@ -6,6 +6,30 @@ request into free slots BEFORE each decode step (prefill-priority in
 the continuous-batching sense: new requests never wait behind decode
 cadence when a slot is open), while plain ``fcfs`` admits at most one
 request per decode round so in-flight decode latency stays level.
+The ``slo`` policy (ISSUE 11) admits like ``prefill_priority`` but
+schedules against per-request TTFT/TPOT targets
+(:class:`Request.ttft_target_ms` / ``tpot_target_ms``): chunk rows per
+mixed tick are capped while any in-flight stream is over its TPOT
+budget (decode-interference bound), and a queue head whose TTFT target
+is at risk may PREEMPT the in-flight request deepest over its own TPOT
+budget — the victim's partial stream parks as resume state on the
+Request and it re-enters the BACK of the queue with its ORIGINAL
+arrival stamp, so queue_wait/TTFT keep measuring the whole journey
+(:func:`keep_arrival`, the one stamp rule all three submission paths
+share). Resume re-prefills only what the prefix trie cannot serve —
+the engine's :meth:`~ServingEngine.preempt` publishes the victim's
+written blocks into the trie first — and the resumed stream is
+bit-identical to the uninterrupted one (greedy determinism, pinned in
+tests/test_chunked_prefill.py).
+
+With a CHUNKED engine (``engine.prefill_chunk > 0``, ISSUE 11) the
+scheduler admits through ``chunked_join`` (no forward at admission) and
+drives ``mixed_step`` instead of decode/verify steps: each tick
+advances up to ``prefill_chunk`` prompt tokens per filling slot while
+active slots decode in the same compiled program, emitting one
+``prefill_chunk`` event per advanced fill row; the ``prefill`` event
+(TTFT sample) is emitted when the fill COMPLETES and the first token is
+sampled.
 
 Every phase emits a schema-versioned ``serving`` trace event (the wire
 -event discipline of PR 2 — ``tools/trace_report.py`` grows a serving
@@ -48,7 +72,18 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
-POLICIES = ("fcfs", "prefill_priority")
+POLICIES = ("fcfs", "prefill_priority", "slo")
+
+
+def keep_arrival(request) -> None:
+    """Stamp ``request._arrival`` ONLY when unset — the ONE rule every
+    (re)submission path shares (ISSUE 11 satellite): the scheduler's
+    :meth:`Scheduler.submit`, the cluster router's front door, and the
+    preemption requeue all route through it, so queue_wait and TTFT
+    always measure the WHOLE journey from first arrival — a requeue or
+    evacuation can never silently reset the clock."""
+    if not request._arrival:
+        request._arrival = time.perf_counter()
 
 
 @dataclass
@@ -62,6 +97,13 @@ class Request:
     cluster router (ISSUE 8) pins every request of a session to the
     replica that served its first turn, so the per-replica prefix trie
     stays warm across turns. The single-engine scheduler ignores it.
+
+    ``ttft_target_ms`` / ``tpot_target_ms`` (optional, ISSUE 11) are
+    the request's SLO targets — submit-to-first-token and mean
+    inter-token latency. The ``slo`` policy schedules against them
+    (chunk-interference cap, preemption of over-budget streams), every
+    policy reports against them (``slo_ttft_ok``/``slo_tpot_ok`` on
+    the finish event → the ``slo_attainment`` rollup).
     """
 
     prompt: Sequence[int]
@@ -69,13 +111,27 @@ class Request:
     request_id: Optional[str] = None
     eos_id: Optional[int] = None
     session_id: Optional[str] = None
+    ttft_target_ms: Optional[float] = None
+    tpot_target_ms: Optional[float] = None
     _arrival: float = field(default=0.0, repr=False)
+    #: preemption resume state (stream so far / generated count / first
+    #: -token stamp) — parked ON the request so a requeue OR a cross-
+    #: replica re-route resumes identically; cleared at re-admission.
+    _resume: Optional[dict] = field(default=None, repr=False)
+    #: set by preemption: the request was admitted once already, so a
+    #: re-admission must not emit a second whole-journey queue_wait
+    #: sample (a mid-fill preemption has no _resume to signal it).
+    _requeued: bool = field(default=False, repr=False)
 
     def __post_init__(self) -> None:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
             )
+        for name in ("ttft_target_ms", "tpot_target_ms"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0, got {v}")
 
 
 @dataclass
@@ -84,6 +140,21 @@ class _InFlight:
     slot: int
     stream: list  # prompt + generated tokens
     generated: int
+    #: perf_counter stamp of the request's FIRST token (original
+    #: admission — survives preemption/resume) — the TPOT clock.
+    first_token_t: float = 0.0
+
+
+@dataclass
+class _Filling:
+    """A chunked admission still writing prompt KV (ISSUE 11): holds
+    the request between ``chunked_join`` and the mixed tick whose final
+    chunk samples its first token."""
+
+    request: Request
+    slot: int
+    t_admit: float
+    resume: Optional[dict] = None
 
 
 class Scheduler:
@@ -106,6 +177,11 @@ class Scheduler:
             pass
         self._queue: deque[Request] = deque()
         self._inflight: dict[int, _InFlight] = {}
+        #: chunked admissions mid-fill, keyed by slot (ISSUE 11).
+        self._filling: dict[int, _Filling] = {}
+        #: lifetime preemption count (the ``preempt`` events carry the
+        #: per-request detail; this is the cheap gauge read).
+        self.preemptions = 0
         self._ids = itertools.count()
         #: request_id -> {'tokens': prompt+generated, 'generated': [...]}
         self.results: dict = {}
@@ -191,7 +267,7 @@ class Scheduler:
         # not silently-merged results.
         if any(r is request for r in self._queue) or any(
             fl.request is request for fl in self._inflight.values()
-        ):
+        ) or any(f.request is request for f in self._filling.values()):
             raise ValueError("request object is already queued/in flight")
         if request.request_id is None:
             request.request_id = f"r{next(self._ids)}"
@@ -199,17 +275,20 @@ class Scheduler:
         if rid in self.results or any(
             r.request_id == rid for r in self._queue
         ) or any(fl.request.request_id == rid
-                 for fl in self._inflight.values()):
+                 for fl in self._inflight.values()) or any(
+            f.request.request_id == rid for f in self._filling.values()
+        ):
             raise ValueError(
                 f"duplicate request_id {rid!r} (reusing a Request from "
                 f"another scheduler? pass a fresh request_id)"
             )
         # Keep an existing arrival stamp (the cluster router stamps at
         # ITS front door before placing — and re-places a dead
-        # replica's requests): queue-wait and TTFT then cover the whole
-        # journey, not just the last hop.
-        if not request._arrival:
-            request._arrival = time.perf_counter()
+        # replica's requests; preemption requeues the same way):
+        # queue-wait and TTFT then cover the whole journey, not just
+        # the last hop. keep_arrival is the ONE rule all three paths
+        # share (ISSUE 11 satellite).
+        keep_arrival(request)
         self._queue.append(request)
         self._publish_gauges()
         return request.request_id
@@ -222,44 +301,132 @@ class Scheduler:
     def in_flight(self) -> int:
         return len(self._inflight)
 
+    @property
+    def filling(self) -> int:
+        """Chunked admissions still writing prompt KV (ISSUE 11)."""
+        return len(self._filling)
+
+    def slot_of(self, request_id: str) -> Optional[int]:
+        """The slot ``request_id`` currently occupies — in flight or
+        mid-fill — or None. The lookup the cluster router's preemption
+        path uses, so it never reaches into this scheduler's private
+        bookkeeping."""
+        for slot, fl in self._inflight.items():
+            if fl.request.request_id == request_id:
+                return slot
+        for slot, f in self._filling.items():
+            if f.request.request_id == request_id:
+                return slot
+        return None
+
     # ------------------------------------------------------------------
 
     def _finish(self, fl: _InFlight) -> None:
         self.engine.leave(fl.slot)
         del self._inflight[fl.slot]
         req = fl.request
-        dur = time.perf_counter() - req._arrival
+        now = time.perf_counter()
+        dur = now - req._arrival
         self.results[req.request_id] = {
             "tokens": list(fl.stream),
             "generated": list(fl.stream[len(req.prompt):]),
         }
-        self._event(phase="finish", request=req.request_id,
-                    generated=fl.generated, dur_s=round(dur, 9))
+        ev: dict = dict(phase="finish", request=req.request_id,
+                        generated=fl.generated, dur_s=round(dur, 9))
+        # TPOT (ISSUE 11 satellite): mean inter-token latency of THIS
+        # request, first token -> finish over generated-1 intervals.
+        # Preemption gaps are inside it by construction — the whole-
+        # journey rule again.
+        tpot_ms = None
+        if fl.generated > 1 and fl.first_token_t:
+            tpot_ms = ((now - fl.first_token_t)
+                       / (fl.generated - 1) * 1e3)
+            ev["tpot_ms"] = round(tpot_ms, 6)
+        # SLO verdicts ride the finish event so the rollup (and the
+        # metrics tap's violation counters) need no target plumbing.
+        if req.ttft_target_ms is not None and fl.first_token_t:
+            ttft_ms = (fl.first_token_t - req._arrival) * 1e3
+            ev["slo_ttft_ok"] = bool(ttft_ms <= req.ttft_target_ms)
+        if req.tpot_target_ms is not None and tpot_ms is not None:
+            ev["slo_tpot_ok"] = bool(tpot_ms <= req.tpot_target_ms)
+        self._event(**ev)
         self._publish_gauges()
+
+    def _begin_stream(self, req: Request, slot: int, tok: int, *,
+                      bucket, dur_s: float, resume: Optional[dict],
+                      chunks: Optional[int] = None) -> None:
+        """Register the in-flight entry for a freshly sampled first
+        token — the ONE tail both admission flavours (monolithic
+        ``prefill_join``, chunked fill completion) and both journeys
+        (fresh, preemption resume) share. Fresh admissions emit the
+        ``prefill`` event with its ``ttft_s`` sample; resumes emit it
+        with ``resumed=True`` and NO ttft (the first token was already
+        delivered before the preemption — re-sampling it must not
+        re-enter the TTFT percentile)."""
+        now = time.perf_counter()
+        ev: dict = dict(phase="prefill", request=req.request_id,
+                        slot=slot, bucket=bucket,
+                        prompt_len=len(req.prompt),
+                        dur_s=round(dur_s, 9))
+        if chunks is not None:
+            ev["chunks"] = chunks
+        if resume is None:
+            ev["ttft_s"] = round(now - req._arrival, 9)
+            fl = _InFlight(req, slot, list(req.prompt) + [int(tok)], 1,
+                           first_token_t=now)
+        else:
+            ev["resumed"] = True
+            fl = _InFlight(req, slot, list(resume["stream"]) + [int(tok)],
+                           int(resume["generated"]) + 1,
+                           first_token_t=resume["first_token_t"] or now)
+            req._resume = None
+        self._event(**ev)
+        self._inflight[slot] = fl
+        self._publish_gauges()
+        if fl.generated >= req.max_new_tokens or (
+            req.eos_id is not None and int(tok) == req.eos_id
+        ):
+            self._finish(fl)
 
     def _admit_one(self) -> bool:
         """Try to admit the HEAD of the queue (strict arrival order —
-        a blocked head blocks the queue: FCFS, not best-fit)."""
+        a blocked head blocks the queue: FCFS, not best-fit). Chunked
+        engines admit through ``chunked_join`` (slot + block
+        reservation only; the prompt KV is written by later mixed
+        ticks); a parked ``_resume`` state makes the join re-prefill
+        the preempted stream instead of the original prompt."""
         if not self._queue:
             return False
         req = self._queue[0]
         t0 = time.perf_counter()
-        res = self.engine.prefill_join(req.prompt)
+        resume = req._resume
+        first_admission = resume is None and not req._requeued
+        join_prompt = resume["stream"] if resume is not None else req.prompt
+        if getattr(self.engine, "prefill_chunk", 0) > 0:
+            slot = self.engine.chunked_join(join_prompt)
+            if slot is None:
+                return False
+            self._queue.popleft()
+            if first_admission:
+                self._event(phase="queue_wait", request=req.request_id,
+                            dur_s=round(t0 - req._arrival, 9))
+            info = getattr(self.engine, "last_prefix_info", None)
+            if info is not None:
+                self._event("prefix_cache", request=req.request_id,
+                            slot=slot, **info)
+            self._filling[slot] = _Filling(req, slot, t_admit=t0,
+                                           resume=resume)
+            self._publish_gauges()
+            return True
+        res = self.engine.prefill_join(join_prompt)
         if res is None:
             return False
         self._queue.popleft()
         slot, tok, bucket = res
         now = time.perf_counter()
-        self._event(phase="queue_wait", request=req.request_id,
-                    dur_s=round(t0 - req._arrival, 9))
-        # ttft_s: submit -> first token. The prefill samples the
-        # request's first token, so TTFT = queue wait + prefill — kept
-        # as its own field (not derived downstream) because the two
-        # phase events may be split across truncated traces.
-        self._event(phase="prefill", request=req.request_id, slot=slot,
-                    bucket=bucket, prompt_len=len(req.prompt),
-                    dur_s=round(now - t0, 9),
-                    ttft_s=round(now - req._arrival, 9))
+        if first_admission:
+            self._event(phase="queue_wait", request=req.request_id,
+                        dur_s=round(t0 - req._arrival, 9))
         # Prefix-sharing accounting (ISSUE 7): the engine fills
         # last_prefix_info on every cache-on paged join — hit/miss,
         # adopted vs prefilled token counts, COW copies. Emitted here
@@ -269,13 +436,12 @@ class Scheduler:
         if info is not None:
             self._event("prefix_cache", request=req.request_id,
                         slot=slot, **info)
-        fl = _InFlight(req, slot, list(req.prompt) + [tok], 1)
-        self._inflight[slot] = fl
-        self._publish_gauges()
-        if fl.generated >= req.max_new_tokens or (
-            req.eos_id is not None and tok == req.eos_id
-        ):
-            self._finish(fl)
+        # ttft_s: submit -> first token. The prefill samples the
+        # request's first token, so TTFT = queue wait + prefill — kept
+        # as its own field (not derived downstream) because the two
+        # phase events may be split across truncated traces.
+        self._begin_stream(req, slot, tok, bucket=bucket,
+                           dur_s=now - t0, resume=resume)
         return True
 
     def step(self) -> None:
@@ -287,6 +453,14 @@ class Scheduler:
         engine may legitimately overshoot: its committed span is a
         property of acceptance, not of any one request's remaining
         budget)."""
+        # Chunked engines ride the mixed program only while a fill is
+        # actually in progress: a steady-state tick with no fill rows
+        # would pay the T-wide grid for nothing — the plain decode /
+        # verify step costs exactly what a monolithic engine's does.
+        # (Both programs stay compiled-once; the pin is per-program.)
+        if getattr(self.engine, "prefill_chunk", 0) > 0 and self._filling:
+            self._mixed_tick()
+            return
         if getattr(self.engine, "spec_tokens", 0) > 0:
             self._spec_step()
             return
@@ -316,19 +490,7 @@ class Scheduler:
         # the committed streams come from the same pass, so they cannot
         # diverge. `done` records whether the LAST taken token finished
         # the request (the same predicate that cut the take).
-        takes: dict[int, tuple[list[int], bool]] = {}
-        for slot, fl in self._inflight.items():
-            req = fl.request
-            take: list[int] = []
-            done = False
-            for tok in committed.get(slot, ()):
-                take.append(int(tok))
-                done = fl.generated + len(take) >= req.max_new_tokens or (
-                    req.eos_id is not None and int(tok) == req.eos_id
-                )
-                if done:
-                    break
-            takes[slot] = (take, done)
+        takes = self._takes(committed)
         self._event(phase="decode_step", n_active=n_active,
                     n_slots=self.engine.num_slots,
                     tokens=sum(len(t) for t, _ in takes.values()),
@@ -343,6 +505,172 @@ class Scheduler:
             fl.generated += len(take)
             if done:
                 self._finish(fl)
+
+    def _takes(self, committed: dict) -> dict:
+        """Per-request take from an engine-committed span, truncated at
+        the request's remaining budget / EOS (the one pass both the
+        speculative and mixed ticks share — token counts and streams
+        come from the same loop, so they cannot diverge)."""
+        takes: dict[int, tuple[list[int], bool]] = {}
+        for slot, fl in self._inflight.items():
+            req = fl.request
+            take: list[int] = []
+            done = False
+            for tok in committed.get(slot, ()):
+                take.append(int(tok))
+                done = (fl.generated + len(take) >= req.max_new_tokens
+                        or (req.eos_id is not None
+                            and int(tok) == req.eos_id))
+                if done:
+                    break
+            takes[slot] = (take, done)
+        return takes
+
+    def _mixed_tick(self) -> None:
+        """One chunk+decode tick (ISSUE 11): drive the engine's mixed
+        step — SLO policy caps the chunk rows while TPOT debt is
+        outstanding — then commit decode takes, emit one
+        ``prefill_chunk`` event per advanced fill row, and promote
+        completed fills to in-flight streams (their ``prefill`` event
+        carries the TTFT sample, exactly like a monolithic
+        admission)."""
+        cap = self._chunk_row_cap() if self.policy == "slo" else None
+        committed, fills, dur, stats = self.engine.mixed_step(
+            max_fill_rows=cap)
+        n_active = len(self._inflight)
+        takes = self._takes(committed)
+        self._event(phase="decode_step", n_active=n_active,
+                    n_slots=self.engine.num_slots,
+                    tokens=sum(len(t) for t, _ in takes.values()),
+                    dur_s=round(dur, 9))
+        if stats is not None:
+            self._event("speculate", drafted=stats["drafted"],
+                        accepted=stats["accepted"],
+                        accept_lens=list(stats["accept_lens"]),
+                        dur_s=round(dur, 9))
+        now = time.perf_counter()
+        for f in fills:
+            fill = self._filling.get(f["slot"])
+            self._event("prefill_chunk",
+                        request=(fill.request.request_id
+                                 if fill is not None else None),
+                        slot=f["slot"], chunk=f["chunk"],
+                        tokens=f["tokens"], dur_s=round(dur, 9))
+        from chainermn_tpu.observability import metrics
+
+        reg = metrics.active_registry()
+        if reg is not None:
+            reg.gauge("serving_chunk_rows",
+                      "fill rows advanced by the last mixed tick").set(
+                len(fills))
+        for f in fills:
+            if not f["done"]:
+                continue
+            fill = self._filling.pop(f["slot"])
+            self._begin_stream(fill.request, f["slot"], f["first_tok"],
+                               bucket=None, dur_s=now - fill.t_admit,
+                               resume=fill.resume, chunks=f["chunk"] + 1)
+        # Commit over the TICK-START in-flight set (takes' keys): a
+        # fill promoted above joined after the forward ran and has no
+        # decode take this tick.
+        for slot, (take, done) in takes.items():
+            fl = self._inflight[slot]
+            fl.stream.extend(take)
+            fl.generated += len(take)
+            if done:
+                self._finish(fl)
+
+    # ------------------------------------------------------------------
+    # SLO policy (ISSUE 11)
+
+    def _tpot_ratio(self, fl: _InFlight, now: float) -> Optional[float]:
+        """Measured-over-target TPOT for one in-flight request; None
+        when it has no target or too few tokens to measure."""
+        t = fl.request.tpot_target_ms
+        if t is None or fl.generated < 2 or not fl.first_token_t:
+            return None
+        tpot_ms = (now - fl.first_token_t) / (fl.generated - 1) * 1e3
+        return tpot_ms / t
+
+    def _chunk_row_cap(self) -> Optional[int]:
+        """Decode-interference bound: while ANY in-flight request is
+        over its TPOT budget, only one fill row advances per tick —
+        prefill keeps progressing (never starves) but chunk
+        interference shrinks until the debt clears. None = no cap."""
+        now = time.perf_counter()
+        for fl in self._inflight.values():
+            r = self._tpot_ratio(fl, now)
+            if r is not None and r > 1.0:
+                return 1
+        return None
+
+    def _maybe_preempt(self) -> bool:
+        """SLO preemption rule: the queue head could not be admitted
+        and has burned half its TTFT budget waiting — preempt the ONE
+        in-flight request DEEPEST over its own TPOT budget (its SLO is
+        already lost; the head's is still winnable). Requests without
+        targets are never preempted; at most one preemption per round
+        bounds the thrash; no over-budget victim = no preemption (a
+        healthy set is never sacrificed)."""
+        head = self._queue[0]
+        tt = head.ttft_target_ms
+        if tt is None:
+            return False
+        if (time.perf_counter() - head._arrival) * 1e3 < 0.5 * tt:
+            return False
+        now = time.perf_counter()
+        worst, worst_ratio = None, 1.0
+        for slot, fl in self._inflight.items():
+            r = self._tpot_ratio(fl, now)
+            if r is not None and r > worst_ratio:
+                worst, worst_ratio = slot, r
+        if worst is None:
+            return False
+        self.preempt(worst)
+        return True
+
+    def preempt(self, slot: int, requeue: bool = True) -> Request:
+        """Preempt the request on ``slot`` (in flight or mid-fill,
+        ISSUE 11): the engine releases the slot — publishing its
+        written blocks into the prefix trie first, so the resume
+        re-adopts its OWN KV — the partial stream parks on the Request
+        as resume state, and the request re-enters the BACK of the
+        queue with its ORIGINAL arrival stamp (whole-journey TTFT; the
+        back, not arrival order, or the freed slot would re-admit the
+        victim forever). ``requeue=False`` returns the Request
+        un-queued instead — the cluster router's re-route path: resume
+        state travels ON the request, so a second replica resumes the
+        stream identically (bit-identical by greedy determinism)."""
+        fl = self._inflight.pop(slot, None)
+        if fl is not None:
+            req = fl.request
+            req._resume = {"stream": list(fl.stream),
+                           "generated": fl.generated,
+                           "first_token_t": fl.first_token_t}
+            generated = fl.generated
+        else:
+            fill = self._filling.pop(slot, None)
+            if fill is None:
+                raise ValueError(
+                    f"slot {slot} holds no preemptible request")
+            # Mid-fill: no new tokens were sampled — any EARLIER resume
+            # state on the request stays authoritative.
+            req = fill.request
+            if fill.resume is not None:
+                req._resume = fill.resume
+            generated = (int(fill.resume["generated"])
+                         if fill.resume is not None else 0)
+        req._requeued = True
+        self.engine.preempt(slot)
+        self.preemptions += 1
+        self._event(phase="preempt", request=req.request_id,
+                    generated=generated,
+                    dur_s=round(time.perf_counter() - req._arrival, 9))
+        if requeue:
+            keep_arrival(req)  # the unified stamp rule: no-op, by design
+            self._queue.append(req)
+        self._publish_gauges()
+        return req
 
     def start_window(self) -> None:
         """Begin a fresh accounting window: :meth:`summary` covers the
@@ -365,30 +693,38 @@ class Scheduler:
 
     @property
     def drained(self) -> bool:
-        return not (self._queue or self._inflight)
+        return not (self._queue or self._inflight or self._filling)
 
     def _admit_round(self) -> bool:
         """One policy-shaped admission pass (the ONE implementation
-        :meth:`run` and :meth:`tick` share): prefill_priority drains
-        every admissible queued request, fcfs admits at most one."""
-        if self.policy == "prefill_priority":
+        :meth:`run` and :meth:`tick` share): prefill_priority — and the
+        slo policy, whose extra discipline lives in the tick, not the
+        admission order — drains every admissible queued request, fcfs
+        admits at most one. Under slo, a blocked head whose TTFT target
+        is at risk may preempt an over-budget in-flight stream and
+        retry (:meth:`_maybe_preempt`)."""
+        if self.policy in ("prefill_priority", "slo"):
             progressed = False
             while self._admit_one():
                 progressed = True
+            if (self.policy == "slo" and not progressed and self._queue
+                    and self._maybe_preempt()):
+                while self._admit_one():
+                    progressed = True
             return progressed
         return self._admit_one()
 
     def tick(self) -> bool:
-        """One admission round + (when anything is in flight) one
-        decode step — the body of :meth:`run`'s loop, exposed so the
-        cluster router can interleave N replicas' progress in one host
-        loop. Returns whether anything progressed (an admission or a
-        decode step); a False on a non-drained scheduler means the
-        queue head is blocked on slots/pool — the caller decides
-        whether that is a deferral (other replicas will free capacity)
-        or a dead end."""
+        """One admission round + (when anything is in flight or
+        mid-fill) one decode/mixed step — the body of :meth:`run`'s
+        loop, exposed so the cluster router can interleave N replicas'
+        progress in one host loop. Returns whether anything progressed
+        (an admission or a step); a False on a non-drained scheduler
+        means the queue head is blocked on slots/pool — the caller
+        decides whether that is a deferral (other replicas will free
+        capacity) or a dead end."""
         progressed = self._admit_round()
-        if self._inflight:
+        if self._inflight or self._filling:
             self.step()
             progressed = True
         return progressed
@@ -422,7 +758,8 @@ class Scheduler:
                     dur_s=round(dur_s or 0.0, 9),
                     ttft_s=round(now - arrival, 9))
         fl = _InFlight(request, slot,
-                       list(request.prompt) + [int(first_tok)], 1)
+                       list(request.prompt) + [int(first_tok)], 1,
+                       first_token_t=now)
         self._inflight[slot] = fl
         self._publish_gauges()
         if fl.generated >= request.max_new_tokens or (
@@ -437,13 +774,19 @@ class Scheduler:
         replica-loss path, ISSUE 8): returns the orphans in arrival
         order so the router can re-route them. In-flight requests lose
         their partial streams (greedy streams are deterministic, so a
-        re-prefill elsewhere reproduces the identical stream)."""
+        re-prefill elsewhere reproduces the identical stream); mid-fill
+        chunked admissions (ISSUE 11) are orphaned the same way —
+        their arrival stamps travel, the unified keep_arrival rule."""
         orphans = list(self._queue)
         self._queue.clear()
-        inflight = sorted(self._inflight.values(),
-                          key=lambda fl: fl.request._arrival)
+        live = sorted(
+            [fl.request for fl in self._inflight.values()]
+            + [f.request for f in self._filling.values()],
+            key=lambda r: r._arrival,
+        )
         self._inflight.clear()
-        orphans.extend(fl.request for fl in inflight)
+        self._filling.clear()
+        orphans.extend(live)
         self._publish_gauges()
         return orphans
 
@@ -465,7 +808,7 @@ class Scheduler:
         t0 = self._window_t0
         steps = 0
         try:
-            while self._queue or self._inflight:
+            while self._queue or self._inflight or self._filling:
                 # Hang-watchdog heartbeat: one per admission/decode
                 # round — the serving analog of the trainer's per-step
                 # beat.
@@ -475,7 +818,7 @@ class Scheduler:
                 ):
                     break
                 progressed = self._admit_round()
-                if not self._inflight:
+                if not (self._inflight or self._filling):
                     if self._queue and not progressed:
                         # nothing running AND the head cannot be
                         # admitted: the request can never fit

@@ -263,7 +263,9 @@ def _seed_one_result(result: dict, source: str, out: list,
         result.get("serving_prefix_model_shape", "")) or m)
     m_cl = (_SERVING_SHAPE.search(
         result.get("serving_cluster_model_shape", "")) or m)
-    if m or m_px or m_cl:
+    m_bu = (_SERVING_SHAPE.search(
+        result.get("serving_burst_model_shape", "")) or m)
+    if m or m_px or m_cl or m_bu:
         from chainermn_tpu.tuning.measure import decide
 
         for row_key, spread_key, name in (
@@ -279,6 +281,8 @@ def _seed_one_result(result: dict, source: str, out: list,
              "serving_prefix_msb_spread_pct", "min_shared_blocks"),
             ("serving_cluster_disagg_ttft_ms",
              "serving_cluster_disagg_spread_pct", "cluster_disagg"),
+            ("serving_burst_chunk_ms",
+             "serving_burst_spread_pct", "prefill_chunk"),
         ):
             rows = result.get(row_key)
             if not (isinstance(rows, dict) and len(rows) >= 2 and all(
@@ -302,6 +306,8 @@ def _seed_one_result(result: dict, source: str, out: list,
                     m_row = m_px
                 elif name == "cluster_disagg":
                     m_row = m_cl
+                elif name == "prefill_chunk":
+                    m_row = m_bu
                 else:
                     m_row = m
                 if m_row is None:
@@ -332,6 +338,17 @@ def _seed_one_result(result: dict, source: str, out: list,
                         ("transfer_bytes",
                          "serving_cluster_transfer_bytes"),
                         ("scaling", "serving_cluster_scaling"),
+                    ):
+                        v = result.get(row)
+                        if v is not None:
+                            evidence[ev_key] = v
+                if name == "prefill_chunk":
+                    # the bursty goodput-under-SLO and p99 TTFT behind
+                    # the ms ranking — WHY chunking won (or lost) on
+                    # this shape, auditable next session.
+                    for ev_key, row in (
+                        ("goodput", "serving_burst_goodput"),
+                        ("ttft_p99_ms", "serving_burst_ttft_p99_ms"),
                     ):
                         v = result.get(row)
                         if v is not None:

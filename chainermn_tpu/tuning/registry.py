@@ -103,6 +103,13 @@ DEFAULT_TABLE: dict = {
     # shows the prefill/decode split wins TTFT on this shape — the
     # transfer hop must EARN its place, like speculation.
     "cluster_disagg": {"*": "colocated"},
+    # Chunked prefill (ISSUE 11): tokens of prompt prefilled per decode
+    # tick inside the mixed step; 0 = monolithic prefill. Default 0 —
+    # chunking trades peak prefill throughput for decode-tick latency
+    # (every tick pays the chunk-width forward), so it must earn
+    # adoption through the bench's bursty goodput-under-SLO rows
+    # (spread-gated, the spec_tokens/cluster_disagg precedent).
+    "prefill_chunk": {"*": "0"},
 }
 
 _MODE_ENV = "CHAINERMN_TPU_AUTOTUNE"

@@ -352,6 +352,61 @@ def test_serving_prefix_rows_contract_and_seeding(tmp_path):
         seed_from_bench_details(str(details), str(cache2)))
 
 
+def test_serving_burst_rows_contract_and_seeding(tmp_path):
+    """ISSUE 11 satellite: the ``serving_burst`` phase's headline rows
+    ride the compact line (per-arm goodput-under-SLO + p99 TTFT +
+    spread gate + the adopted decision), and ``tuning seed`` learns
+    ``prefill_chunk`` from the ms-per-SLO-good-token rows — spread-
+    gated under the phase's OWN shape key, with the measured goodput
+    and p99 TTFT carried as evidence."""
+    for k in ("serving_burst_goodput", "serving_burst_ttft_p99_ms",
+              "serving_burst_spread_pct", "serving_burst_selected"):
+        assert k in bench._COMPACT_KEYS, k
+
+    from chainermn_tpu.tuning.cache import (
+        load_cache,
+        seed_from_bench_details,
+    )
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-03T00:00:00Z",
+        "serving_burst_model_shape": "D512xH8xL512",
+        "serving_burst_chunk_ms": {"0": 2.4, "64": 1.2},
+        "serving_burst_spread_pct": 6.0,
+        "serving_burst_goodput": {"monolithic": 410.0, "chunked": 830.0,
+                                  "chunked_slo": 870.0},
+        "serving_burst_ttft_p99_ms": {"monolithic": 90.0,
+                                      "chunked": 22.0,
+                                      "chunked_slo": 18.0},
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert "prefill_chunk|TPU v5 lite|512x8x512|decode -> 64" in seeded
+    entry = load_cache(str(cache))["decisions"][
+        "prefill_chunk|TPU v5 lite|512x8x512|decode"]
+    assert entry["candidates_ms"]["64"] == 1.2
+    assert entry["goodput"]["chunked"] == 830.0
+    assert entry["ttft_p99_ms"]["monolithic"] == 90.0
+
+    # spread-dominated rows are refused (noise-band "winner") — the
+    # table default 0 stands, the honest-refusal precedent
+    doc["serving_burst_chunk_ms"] = {"0": 1.25, "64": 1.2}
+    doc["serving_burst_spread_pct"] = 15.0
+    details.write_text(json.dumps(doc))
+    cache2 = tmp_path / "cache2.json"
+    assert "prefill_chunk" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+    # ABSENT spread = on-accel single sample: the 10% floor applies
+    doc.pop("serving_burst_spread_pct")
+    details.write_text(json.dumps(doc))
+    assert "prefill_chunk" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+
 def test_transformer_knob_env_validation(monkeypatch):
     """The accel transformer knobs reject malformed env values with a
     message naming the variable (a bare ZeroDivisionError from

@@ -203,6 +203,24 @@ def _golden_trace_lines():
          "rank": 0, "request": "r1", "slot": 1, "prompt_tokens": 16,
          "hit_blocks": 2, "hit_tokens": 16, "prefill_tokens": 1,
          "cow_blocks": 1},
+        # ISSUE 11: chunked prefill + SLO scheduling — one preemption,
+        # two mixed-step chunk rows (12 prompt tokens written through
+        # the mixed step), and a target-bearing finish whose TPOT
+        # verdict failed (explicit tpot_ms preferred over the derived
+        # fallback; r0's finish above derives 6.0 ms from dur - ttft).
+        {"schema": 1, "kind": "serving", "t": 3.2, "pid": 1, "rank": 0,
+         "phase": "preempt", "request": "r1", "generated": 2,
+         "dur_s": 0.02},
+        {"schema": 1, "kind": "prefill_chunk", "t": 3.3, "pid": 1,
+         "rank": 0, "request": "r2", "slot": 2, "chunk": 0,
+         "tokens": 8, "dur_s": 0.004},
+        {"schema": 1, "kind": "prefill_chunk", "t": 3.35, "pid": 1,
+         "rank": 0, "request": "r2", "slot": 2, "chunk": 1,
+         "tokens": 4, "dur_s": 0.004},
+        {"schema": 1, "kind": "serving", "t": 3.4, "pid": 1, "rank": 0,
+         "phase": "finish", "request": "r2", "generated": 5,
+         "dur_s": 0.05, "tpot_ms": 8.0, "slo_ttft_ok": True,
+         "slo_tpot_ok": False},
     ]
     return [_json.dumps(e) for e in evs] + ['{"torn']
 
@@ -229,7 +247,7 @@ def test_trace_report_contract(tmp_path):
         "schema_versions": [1],
         "meta": {"started_at": "2026-08-03T00:00:00Z", "sync": False,
                  "source": "bench"},
-        "n_events": 22,  # torn tail line skipped, not fatal
+        "n_events": 26,  # torn tail line skipped, not fatal
         "collectives": [
             {"op": "allreduce_grad", "plane": "device", "n": 2,
              "total_bytes": 2000, "total_s": 0.004, "mean_ms": 2.0,
@@ -268,7 +286,7 @@ def test_trace_report_contract(tmp_path):
         # ttft_s, mean occupancy (0.25 + 0.5 + 0.25)/3, and the
         # speculation totals from the two speculate events.
         "serving": {
-            "requests": 1,
+            "requests": 2,
             "prefills": 1,
             "generated_tokens": 5,
             "decode_steps": 3,
@@ -278,8 +296,18 @@ def test_trace_report_contract(tmp_path):
             "token_ms_p99": 6.0,
             "ttft_ms_p50": 12.0,
             "ttft_ms_p99": 12.0,
+            # ISSUE 11: per-request TPOT — r0 derives (30 - 12) ms / 3
+            # intervals = 6.0; r2 carries an explicit tpot_ms = 8.0.
+            "tpot_ms_p50": 6.0,
+            "tpot_ms_p99": 8.0,
             "occupancy_mean": 0.3333,
             "tokens_per_sec": 227.27,
+            # ISSUE 11: one target-bearing finish, TPOT verdict failed;
+            # one preemption; 12 prompt tokens over 2 mixed-step chunks.
+            "slo_requests": 1,
+            "slo_attainment": 0.0,
+            "preemptions": 1,
+            "chunked_prefill": {"chunks": 2, "chunk_tokens": 12},
             "speculation": {
                 "ticks": 2,
                 "drafted": 8,
@@ -304,7 +332,7 @@ def test_trace_report_contract(tmp_path):
     }, summary
     # chrome export emitted alongside
     chrome = _json.loads(chrome_file.read_text())
-    assert len(chrome["traceEvents"]) == 21  # meta excluded
+    assert len(chrome["traceEvents"]) == 25  # meta excluded
     # and the human rendering mentions the essentials
     proc2 = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
@@ -317,6 +345,11 @@ def test_trace_report_contract(tmp_path):
                   "serving (continuous batching)", "tokens/s: 227.27",
                   "p50 4.000 ms, p99 6.000 ms", "33.3% mean",
                   "TTFT: p50 12.000 ms, p99 12.000 ms",
+                  "TPOT: p50 6.000 ms, p99 8.000 ms per request",
+                  "SLO attainment: 0.0% of 1 target-bearing request(s)",
+                  "preemptions: 1",
+                  "chunked prefill: 12 prompt token(s) over 2 "
+                  "mixed-step chunk(s)",
                   "speculation: 8 drafted, 2 accepted (25.0% acceptance)",
                   "accept-length histogram: 0:2 2:1",
                   "prefix cache: 1/2 admissions hit (50.0%), "

@@ -1,0 +1,660 @@
+"""Chunked prefill + SLO-aware scheduling invariants (ISSUE 11).
+
+The acceptance pins, asserted structurally:
+
+- **Stream equivalence** — chunked admission (``prefill_chunk > 0``,
+  prompts written C tokens per mixed tick while other slots decode)
+  produces token streams bit-identical to sequential ``generate``
+  across dense == paged == tensor-parallel == single-device, prefix
+  cache on/off, speculative decode on/off — the engine contract is
+  layout- and schedule-independent.
+- **One mixed program** — the mixed step's jit cache stays at ONE entry
+  across every chunk/decode occupancy mix (fills joining/completing,
+  decodes churning, the SLO cap throttling fill rows), and under TP its
+  compiled HLO carries exactly the pre-chunking collective set: 2
+  all-reduces per layer, nothing else.
+- **Preemption equivalence** — a request preempted mid-stream and
+  resumed (same scheduler, or re-routed to a second replica through the
+  router) produces the identical stream, including with prefix-cache
+  re-adoption of its own blocks and with speculative decode active.
+- **Whole-journey stamps** — requeue/preemption keep the ORIGINAL
+  arrival stamp (the ``keep_arrival`` helper all three submission paths
+  share), so queue_wait/TTFT can never be silently reset.
+
+Plus the SLO policy units (chunk-row interference cap, preempt events,
+violation counters via the PR 6 tap) and the TPOT / ``slo_attainment``
+rollup contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from chainermn_tpu.models.transformer import TransformerLM, generate
+from chainermn_tpu.serving import Request, Scheduler, ServingEngine
+from chainermn_tpu.serving.scheduler import keep_arrival
+
+VOCAB = 32
+
+
+def tiny_lm(**kw):
+    cfg = dict(vocab_size=VOCAB, num_layers=2, num_heads=4, d_model=16,
+               d_ff=32, max_len=32, compute_dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    return model, params
+
+
+def _requests(n, seed=0, max_prompt=9, max_new=6):
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        # repetitive prompts give the n-gram drafter material, so the
+        # spec arms actually accept drafts
+        base = rs.randint(1, VOCAB, size=3).tolist()
+        p = (base * 4)[: int(rs.randint(2, max_prompt))]
+        out.append((p, int(rs.randint(1, max_new))))
+    return out
+
+
+def _generate_ref(model, params, prompt, n_new):
+    return np.asarray(generate(
+        model, params, jnp.asarray([prompt], jnp.int32),
+        len(prompt) + n_new,
+    ))[0].tolist()
+
+
+def _engine(lm, *, impl="paged", prefix="off", spec=0, chunk=3,
+            mesh=None, slots=2, **kw):
+    model, params = lm
+    return ServingEngine(
+        model, params, num_slots=slots, max_len=32, decode_impl=impl,
+        kv_block_size=8, prefill_buckets=(4, 8, 16), mesh=mesh,
+        spec_tokens=spec, prefix_cache=prefix, prefill_chunk=chunk,
+        **kw,
+    )
+
+
+def _run_stream(engine, reqs, policy="prefill_priority", **req_kw):
+    sched = Scheduler(engine, policy=policy)
+    ids = [sched.submit(Request(prompt=p, max_new_tokens=g, **req_kw))
+           for p, g in reqs]
+    results = sched.run()
+    return [results[rid]["tokens"] for rid in ids], sched
+
+
+class TestChunkedStreamEquivalence:
+    """Chunked == sequential generate, across layouts and features."""
+
+    @pytest.mark.parametrize("impl,prefix,spec", [
+        ("dense", "off", 0),
+        ("dense", "off", 4),
+        ("paged", "off", 0),
+        ("paged", "on", 0),
+        ("paged", "off", 4),
+        ("paged", "on", 4),
+    ])
+    def test_chunked_matches_generate(self, lm, impl, prefix, spec):
+        model, params = lm
+        # 2 slots x 6 requests force staggered fills mid-decode of
+        # other requests — every chunk/decode occupancy mix occurs.
+        engine = _engine(lm, impl=impl, prefix=prefix, spec=spec)
+        reqs = _requests(6, seed=0)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        assert engine.mixed_compile_count() in (None, 1)
+
+    def test_chunked_equals_monolithic_streams(self, lm):
+        """The same request set through prefill_chunk=0 and >0 engines
+        yields byte-identical streams — chunking is a schedule, not a
+        semantic."""
+        reqs = _requests(5, seed=7)
+        mono = _engine(lm, chunk=0, prefix="on")
+        chunked = _engine(lm, chunk=5, prefix="on")
+        s_mono, _ = _run_stream(mono, reqs)
+        s_chunk, _ = _run_stream(chunked, reqs)
+        assert s_mono == s_chunk
+
+    @pytest.mark.parametrize("impl,spec", [
+        ("dense", 0), ("paged", 0), ("paged", 4),
+    ])
+    def test_tp_chunked_matches_single_device(self, lm, impl, spec):
+        model, params = lm
+        mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("model",))
+        reqs = _requests(5, seed=11)
+        prefix = "on" if impl == "paged" else "off"
+        single = _engine(lm, impl=impl, prefix=prefix, spec=spec,
+                         slots=3)
+        tp = _engine(lm, impl=impl, prefix=prefix, spec=spec, slots=3,
+                     mesh=mesh)
+        s_streams, _ = _run_stream(single, reqs)
+        t_streams, _ = _run_stream(tp, reqs)
+        assert t_streams == s_streams
+        for (prompt, n_new), got in zip(reqs, t_streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        assert tp.mixed_compile_count() in (None, 1)
+
+    def test_long_prompt_fill_interleaves_with_decode(self, lm):
+        """The tentpole's point, measured not asserted: while a long
+        prompt's fill is in progress, the other in-flight streams keep
+        emitting tokens — the decode_step events BETWEEN the long
+        request's first and last chunk carry nonzero token counts
+        (monolithic prefill would freeze them for one big forward)."""
+        engine = _engine(lm, prefix="off", chunk=2, slots=3)
+        sched = Scheduler(engine, policy="prefill_priority")
+        short = [sched.submit(Request(prompt=[i + 1, i + 2],
+                                      max_new_tokens=12))
+                 for i in range(2)]
+        long_prompt = list(np.random.RandomState(3).randint(
+            1, VOCAB, size=18))
+        # admit the short pair and give them a tick first
+        sched.tick()
+        rid_long = sched.submit(Request(
+            prompt=[int(t) for t in long_prompt], max_new_tokens=3))
+        sched.run()
+        evs = sched.event_window
+        chunk_idx = [i for i, e in enumerate(evs)
+                     if e.get("kind") == "prefill_chunk"
+                     and e.get("request") == rid_long]
+        assert len(chunk_idx) == 9  # 18 tokens / chunk 2
+        between = [e for e in evs[chunk_idx[0]:chunk_idx[-1]]
+                   if e.get("kind") == "serving"
+                   and e.get("phase") == "decode_step"]
+        assert between and any(e["tokens"] > 0 for e in between), (
+            "decode starved during the chunked fill")
+        assert short  # streams finished; equivalence covered above
+
+
+class TestMixedStepStructure:
+    def test_mixed_compiles_once_across_churn(self, lm):
+        engine = _engine(lm, prefix="on", chunk=3)
+        streams, _ = _run_stream(engine, _requests(6, seed=13))
+        assert len(streams) == 6
+        assert engine.mixed_compile_count() == 1
+
+    def test_tp_mixed_collective_counts(self, lm):
+        """Exactly 2 all-reduces per layer (the pre-chunking set),
+        zero other collectives — chunk rows add nothing to the wire."""
+        model, params = lm
+        mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("model",))
+        engine = _engine(lm, prefix="off", chunk=4, slots=3, mesh=mesh)
+        args = (
+            engine._cache, engine._vars,
+            jnp.zeros((3, engine._mixed_T), jnp.int32),
+            jnp.zeros((3,), jnp.int32),
+            jnp.asarray(engine._dummy_tables()), engine._key,
+        )
+        txt = engine._mixed_step_jit.lower(*args).compile().as_text()
+        n_ar = txt.count("all-reduce(")
+        assert n_ar == 2 * model.num_layers, (
+            f"expected {2 * model.num_layers} all-reduces, got {n_ar}")
+        for op in ("all-gather(", "collective-permute(", "all-to-all(",
+                   "reduce-scatter("):
+            assert txt.count(op) == 0, f"unexpected {op} in mixed step"
+
+    def test_mixed_width_covers_chunk_and_verify_span(self, lm):
+        assert _engine(lm, chunk=3, spec=0)._mixed_T == 3
+        assert _engine(lm, chunk=3, spec=4)._mixed_T == 5
+        assert _engine(lm, chunk=8, spec=4)._mixed_T == 8
+
+    def test_fill_row_cap_is_host_only(self, lm):
+        """max_fill_rows throttles which fills advance (SLO
+        interference bound) without a second compile — and a capped
+        fill makes no progress that tick."""
+        engine = _engine(lm, prefix="off", chunk=2, slots=3)
+        s0 = engine.chunked_join([1, 2, 3, 4, 5, 6])
+        s1 = engine.chunked_join([7, 8, 9, 10, 11, 12])
+        _, fills, _, _ = engine.mixed_step(max_fill_rows=1)
+        assert [f["slot"] for f in fills] == [s0]
+        assert engine._pending_fill[s1]["pos"] == 0
+        _, fills2, _, _ = engine.mixed_step(max_fill_rows=0)
+        assert fills2 == []
+        _, fills3, _, _ = engine.mixed_step()
+        assert {f["slot"] for f in fills3} == {s0, s1}
+        assert engine.mixed_compile_count() == 1
+
+    def test_chunked_join_defers_like_prefill_join(self, lm):
+        """Deferral contract unchanged: pool exhaustion returns None
+        with host state untouched, and the scheduler retry admits once
+        capacity frees."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, num_blocks=4,  # 3 allocatable blocks
+            prefill_buckets=(4, 8, 16), prefill_chunk=3,
+            prefix_cache="off",
+        )
+        s0 = engine.chunked_join([1] * 17)  # needs 3 blocks
+        assert s0 is not None
+        v0 = engine._alloc.version
+        assert engine.chunked_join([2] * 9) is None  # needs 2 more
+        assert engine._alloc.version == v0  # rollback restored version
+        assert engine.free_slot_count == 1
+        assert engine.n_filling == 1
+
+    def test_engine_validation(self, lm):
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            _engine(lm, chunk=-1)
+        # greedy-only, the spec_tokens precedent: sampled streams would
+        # silently diverge between the chunked and monolithic schedules
+        with pytest.raises(ValueError, match="greedy-only"):
+            _engine(lm, chunk=4, temperature=0.7)
+        eng = _engine(lm, chunk=0)
+        with pytest.raises(RuntimeError, match="chunked_join"):
+            eng.chunked_join([1, 2])
+        with pytest.raises(RuntimeError, match="mixed_step"):
+            eng.mixed_step()
+        # explicit decision recorded with provenance
+        eng2 = _engine(lm, chunk=4)
+        recs = [d for d in eng2.decisions
+                if d["name"] == "prefill_chunk"]
+        assert recs and recs[0]["winner"] == "4"
+        assert recs[0]["source"] == "explicit"
+
+
+class TestPreemption:
+    """Preempt → resume == uninterrupted, in every composition."""
+
+    @pytest.mark.parametrize("chunk,spec,prefix", [
+        (0, 0, "on"),   # monolithic + prefix re-adoption
+        (3, 0, "on"),   # chunked + prefix re-adoption
+        (3, 4, "on"),   # chunked + speculative decode
+        (0, 0, "off"),  # no cache: full re-prefill, still identical
+    ])
+    def test_preempt_resume_matches_generate(self, lm, chunk, spec,
+                                             prefix):
+        model, params = lm
+        engine = _engine(lm, prefix=prefix, spec=spec, chunk=chunk,
+                         slots=1)
+        sched = Scheduler(engine, policy="prefill_priority")
+        base = [3, 5, 7]
+        prompt = (base * 4)[:9]
+        rid = sched.submit(Request(prompt=prompt, max_new_tokens=10))
+        for _ in range(4):
+            sched.tick()
+        assert sched.in_flight == 1
+        slot = next(iter(sched._inflight))
+        arrival = sched._inflight[slot].request._arrival
+        sched.preempt(slot)
+        assert sched.pending == 1 and sched.in_flight == 0
+        # the ORIGINAL arrival stamp survives the requeue (satellite)
+        assert sched._queue[0]._arrival == arrival
+        results = sched.run()
+        assert results[rid]["tokens"] == _generate_ref(
+            model, params, prompt, 10)
+        assert sched.preemptions == 1
+
+    def test_resume_readopts_own_blocks_through_trie(self, lm):
+        """The preempted request's written FULL blocks re-adopt through
+        the trie: the resume prefills at most the boundary tail, not
+        the whole history (the 'resume re-prefills nothing' pin)."""
+        engine = _engine(lm, prefix="on", chunk=0, slots=1)
+        sched = Scheduler(engine, policy="prefill_priority")
+        prompt = list(np.random.RandomState(5).randint(1, VOCAB, size=9))
+        rid = sched.submit(Request(prompt=[int(t) for t in prompt],
+                                   max_new_tokens=10))
+        for _ in range(6):
+            sched.tick()
+        slot = next(iter(sched._inflight))
+        history_len = len(sched._inflight[slot].stream)
+        before = dict(engine.prefix_stats)
+        sched.preempt(slot)
+        sched.run()
+        st = engine.prefix_stats
+        assert st["hits"] == before["hits"] + 1
+        resumed_prefill = (st["prefill_tokens"]
+                           - before["prefill_tokens"])
+        # KV exists for history_len - 1 positions; everything in full
+        # blocks re-adopts, so the re-prefill is under one block + tail
+        assert resumed_prefill <= (history_len - 1) % 8 + 8
+        assert resumed_prefill < history_len - 1
+        assert rid in sched.results
+
+    def test_preempt_mid_fill_resumes_identically(self, lm):
+        model, params = lm
+        engine = _engine(lm, prefix="on", chunk=2, slots=1)
+        sched = Scheduler(engine, policy="prefill_priority")
+        prompt = list(range(1, 19))  # 18 tokens -> 9 chunks
+        rid = sched.submit(Request(prompt=prompt, max_new_tokens=4))
+        sched.tick()  # admit
+        sched.tick()  # one chunk written
+        assert sched.filling == 1
+        slot = next(iter(sched._filling))
+        sched.preempt(slot)
+        assert engine.n_filling == 0 and sched.filling == 0
+        results = sched.run()
+        assert results[rid]["tokens"] == _generate_ref(
+            model, params, prompt, 4)
+
+    def test_preempt_resume_with_concurrent_streams(self, lm):
+        """Preemption must not disturb the OTHER in-flight streams:
+        everything still equals generate."""
+        model, params = lm
+        engine = _engine(lm, prefix="on", chunk=3, slots=2)
+        sched = Scheduler(engine, policy="prefill_priority")
+        reqs = _requests(3, seed=21, max_new=8)
+        ids = [sched.submit(Request(prompt=p, max_new_tokens=g))
+               for p, g in reqs]
+        for _ in range(5):
+            sched.tick()
+        if sched._inflight:
+            sched.preempt(next(iter(sched._inflight)))
+        results = sched.run()
+        for rid, (p, g) in zip(ids, reqs):
+            assert results[rid]["tokens"] == _generate_ref(
+                model, params, p, g)
+
+    def test_router_preempt_reroutes_to_second_replica(self, lm):
+        """Cross-replica migration: preempt on replica A, resume on
+        replica B — stream identical to uninterrupted generate (resume
+        state travels ON the request; B's trie is cold, so it simply
+        re-prefills the history)."""
+        from chainermn_tpu.serving.cluster import Router, make_replicas
+
+        model, params = lm
+        replicas = make_replicas(
+            model, params, 2, tp=1, num_slots=2, max_len=32,
+            decode_impl="paged", kv_block_size=8,
+            prefill_buckets=(4, 8, 16), prefix_cache="on",
+            prefill_chunk=3, spec_tokens=0,
+        )
+        router = Router(replicas, mode="colocated_chunked",
+                        policy="least_loaded")
+        prompt = (11, 12, 13) * 3
+        req = Request(prompt=list(prompt), max_new_tokens=10)
+        rid = router.submit(req)
+        src = next(i for i, rep in router.replicas.items()
+                   if rep.load() > 0)
+        # drive the holding replica until the request is mid-stream
+        for _ in range(6):
+            router.replicas[src].tick()
+        assert router.replicas[src].scheduler.in_flight == 1
+        dst = router.preempt_request(rid)
+        assert dst != src
+        assert router.replicas[dst].scheduler.pending == 1
+        results = router.run()
+        assert results[rid]["tokens"] == _generate_ref(
+            model, params, list(prompt), 10)
+
+    def test_disagg_preempt_resumes_on_decode_replica(self, lm):
+        """Review regression: in DISAGGREGATED mode a preempted request
+        must resume on a decode replica's scheduler (honouring the
+        parked stream), never re-enter the prefill-pump queue — which
+        would regenerate from the original prompt and re-sample TTFT.
+        Stream still == uninterrupted generate, exactly one TTFT sample
+        across the cluster."""
+        from chainermn_tpu.observability import trace as obs_trace
+        from chainermn_tpu.serving.cluster import Router, make_replicas
+
+        model, params = lm
+        replicas = make_replicas(
+            model, params, 2, tp=1, num_slots=2, max_len=32,
+            decode_impl="paged", kv_block_size=8,
+            prefill_buckets=(4, 8, 16), prefix_cache="on",
+            spec_tokens=0,
+        )
+        router = Router(replicas, mode="disaggregated",
+                        prefill_replicas=[0])
+        rec = obs_trace.enable(None)
+        try:
+            for rep in replicas:
+                rep.scheduler.start_window()
+            prompt = [9, 8, 7, 6, 5]
+            req = Request(prompt=prompt, max_new_tokens=10)
+            rid = router.submit(req)
+            # drive the handoff + a few decode ticks deterministically
+            router._pump_prefill()
+            router._pump_adopt()
+            dec = router.replicas[1]
+            for _ in range(3):
+                dec.tick()
+            assert dec.scheduler.in_flight == 1
+            new_id = router.preempt_request(rid, exclude_replica=False)
+            # only one decode replica: it resumes on ITS scheduler
+            assert new_id == 1
+            assert dec.scheduler.pending == 1
+            assert all(len(q) == 0 for q in router._pqueues.values())
+            results = router.run()
+            assert results[rid]["tokens"] == _generate_ref(
+                model, params, prompt, 10)
+            ttft = [e for e in rec.events
+                    if e.get("kind") == "serving"
+                    and e.get("phase") == "prefill"
+                    and e.get("request") == rid
+                    and e.get("ttft_s") is not None]
+            assert len(ttft) == 1, ttft
+        finally:
+            obs_trace.disable()
+
+    def test_keep_arrival_helper_contract(self):
+        r = Request(prompt=[1], max_new_tokens=1)
+        assert r._arrival == 0.0
+        keep_arrival(r)
+        first = r._arrival
+        assert first > 0.0
+        keep_arrival(r)  # idempotent: re-submission never resets
+        assert r._arrival == first
+
+
+class TestSloPolicy:
+    def test_policy_validation_and_targets(self, lm):
+        engine = _engine(lm, chunk=0)
+        with pytest.raises(ValueError, match="policy"):
+            Scheduler(engine, policy="deadline")
+        with pytest.raises(ValueError, match="ttft_target_ms"):
+            Request(prompt=[1], max_new_tokens=1, ttft_target_ms=0.0)
+        with pytest.raises(ValueError, match="tpot_target_ms"):
+            Request(prompt=[1], max_new_tokens=1, tpot_target_ms=-1.0)
+
+    def test_slo_preempts_overbudget_for_at_risk_head(self, lm):
+        """slots=1: an in-flight stream with an unmeetable TPOT target
+        blocks a head whose TTFT budget is burning — the slo policy
+        preempts it (preempt event + counter), the head admits, both
+        streams still equal generate."""
+        model, params = lm
+        engine = _engine(lm, prefix="on", chunk=0, slots=1)
+        sched = Scheduler(engine, policy="slo")
+        p1, p2 = [2, 4, 6, 8], [3, 5, 7]
+        r1 = sched.submit(Request(prompt=p1, max_new_tokens=10,
+                                  tpot_target_ms=1e-6))
+        for _ in range(3):  # r1 in flight, generated >= 2, over budget
+            sched.tick()
+        r2 = sched.submit(Request(prompt=p2, max_new_tokens=3,
+                                  ttft_target_ms=1e-6))
+        results = sched.run()
+        assert sched.preemptions >= 1
+        assert results[r1]["tokens"] == _generate_ref(model, params,
+                                                      p1, 10)
+        assert results[r2]["tokens"] == _generate_ref(model, params,
+                                                      p2, 3)
+        evs = sched.event_window
+        assert any(e.get("phase") == "preempt" for e in evs)
+        # the preempted request's finish verdict records the TPOT miss
+        fin = [e for e in evs if e.get("phase") == "finish"
+               and e.get("request") == r1]
+        assert fin and fin[0]["slo_tpot_ok"] is False
+
+    def test_slo_never_preempts_targetless_streams(self, lm):
+        """No over-budget victim (streams without targets) = no
+        preemption, however starved the head is."""
+        engine = _engine(lm, prefix="off", chunk=0, slots=1)
+        sched = Scheduler(engine, policy="slo")
+        sched.submit(Request(prompt=[2, 4], max_new_tokens=6))
+        for _ in range(3):
+            sched.tick()
+        sched.submit(Request(prompt=[3, 5], max_new_tokens=2,
+                             ttft_target_ms=1e-6))
+        sched.run()
+        assert sched.preemptions == 0
+
+    def test_tpot_debt_caps_chunk_rows(self, lm):
+        """While an in-flight stream is over its TPOT budget, only ONE
+        fill row advances per mixed tick (the interference bound);
+        with the debt cleared, every fill advances."""
+        engine = _engine(lm, prefix="off", chunk=2, slots=4)
+        sched = Scheduler(engine, policy="slo")
+        rid = sched.submit(Request(prompt=[2, 4], max_new_tokens=12,
+                                   tpot_target_ms=1e-6))
+        for _ in range(4):
+            sched.tick()  # fill + >= 2 tokens: over budget now
+        assert sched.in_flight == 1
+        assert sched._chunk_row_cap() == 1
+        sched.submit(Request(prompt=list(range(1, 11)),
+                             max_new_tokens=2))
+        sched.submit(Request(prompt=list(range(11, 21)),
+                             max_new_tokens=2))
+        n_before = len([e for e in sched.event_window
+                        if e.get("kind") == "prefill_chunk"])
+        sched.tick()
+        chunk_evs = [e for e in sched.event_window
+                     if e.get("kind") == "prefill_chunk"][n_before:]
+        assert len(chunk_evs) == 1, chunk_evs
+        # targetless in-flight = no debt = no cap
+        engine2 = _engine(lm, prefix="off", chunk=2, slots=4)
+        sched2 = Scheduler(engine2, policy="slo")
+        sched2.submit(Request(prompt=[2, 4], max_new_tokens=12))
+        for _ in range(4):
+            sched2.tick()
+        assert sched2._chunk_row_cap() is None
+
+    def test_violation_and_preemption_counters_via_tap(self, lm):
+        from chainermn_tpu.observability import metrics
+        from chainermn_tpu.observability import trace as obs_trace
+
+        model, params = lm
+        reg = metrics.install_tap()
+        obs_trace.enable(None)  # the tap rides the recorder's sinks
+        try:
+            engine = _engine(lm, prefix="off", chunk=2, slots=1)
+            sched = Scheduler(engine, policy="slo")
+            sched.submit(Request(prompt=[2, 4, 6], max_new_tokens=8,
+                                 tpot_target_ms=1e-6))
+            for _ in range(4):
+                sched.tick()
+            sched.submit(Request(prompt=[3, 5], max_new_tokens=2,
+                                 ttft_target_ms=1e-6))
+            sched.run()
+            snap = reg.snapshot()
+            pre = {tuple(v.get("labels", {}).items()): v["value"]
+                   for v in snap["serving_preemptions_total"]["values"]}
+            assert sum(pre.values()) >= 1
+            viol = {dict(v.get("labels", {})).get("kind"): v["value"]
+                    for v in snap["serving_slo_violations_total"][
+                        "values"]}
+            assert viol.get("tpot", 0) >= 1
+            assert snap["serving_chunk_tokens_total"]["values"][0][
+                "value"] > 0
+            assert "serving_chunk_rows" in snap
+        finally:
+            obs_trace.disable()
+            metrics.uninstall_tap()
+
+
+class TestRollups:
+    def test_tpot_and_slo_attainment_rollup(self, lm):
+        """Generous targets -> every verdict ok, slo_attainment 1.0;
+        TPOT percentiles present in Scheduler.summary() (the
+        summarize_serving owner — trace_report's section reads the same
+        dict)."""
+        engine = _engine(lm, prefix="off", chunk=3)
+        streams, sched = _run_stream(
+            engine, _requests(4, seed=9, max_new=6),
+            ttft_target_ms=1e6, tpot_target_ms=1e6,
+        )
+        s = sched.summary()
+        assert s["slo_requests"] == 4
+        assert s["slo_attainment"] == 1.0
+        assert s["tpot_ms_p50"] is not None
+        assert s["tpot_ms_p99"] >= s["tpot_ms_p50"]
+        ck = s.get("chunked_prefill")
+        assert ck and ck["chunks"] >= 1 and ck["chunk_tokens"] >= 1
+        fin = [e for e in sched.event_window
+               if e.get("phase") == "finish"]
+        assert all(e.get("slo_ttft_ok") and e.get("slo_tpot_ok")
+                   for e in fin if e.get("generated", 0) > 1)
+
+    def test_resume_never_reenters_ttft_percentile(self, lm):
+        """A resumed request's re-prefill event carries resumed=True
+        and NO ttft_s: exactly one TTFT sample per request, however
+        many times it was preempted."""
+        engine = _engine(lm, prefix="on", chunk=0, slots=1)
+        sched = Scheduler(engine, policy="prefill_priority")
+        sched.start_window()
+        rid = sched.submit(Request(prompt=[2, 4, 6], max_new_tokens=8))
+        for _ in range(3):
+            sched.tick()
+        sched.preempt(next(iter(sched._inflight)))
+        # drain via ticks: run() would start a FRESH window and wipe
+        # the pre-preemption events this test inspects
+        for _ in range(30):
+            if sched.drained:
+                break
+            sched.tick()
+        assert sched.drained
+        prefills = [e for e in sched.event_window
+                    if e.get("kind") == "serving"
+                    and e.get("phase") == "prefill"
+                    and e.get("request") == rid]
+        assert len(prefills) == 2
+        with_ttft = [e for e in prefills if e.get("ttft_s") is not None]
+        assert len(with_ttft) == 1
+        resumed = [e for e in prefills if e.get("resumed")]
+        assert len(resumed) == 1 and resumed[0].get("ttft_s") is None
+
+    def test_mid_fill_preempt_emits_one_queue_wait(self, lm):
+        """Review regression: a CHUNKED admission preempted mid-fill
+        (no token sampled, no resume state) re-admits as a fresh join —
+        it must not emit a second whole-journey queue_wait sample (the
+        percentile would count the request twice, second sample
+        inflated by the aborted fill)."""
+        engine = _engine(lm, prefix="off", chunk=2, slots=1)
+        sched = Scheduler(engine, policy="prefill_priority")
+        sched.start_window()
+        rid = sched.submit(Request(prompt=list(range(1, 15)),
+                                   max_new_tokens=2))
+        sched.tick()  # admit into a fill (queue_wait emitted)
+        slot = next(iter(sched._filling))
+        sched.preempt(slot)
+        for _ in range(30):
+            if sched.drained:
+                break
+            sched.tick()
+        assert sched.drained
+        qw = [e for e in sched.event_window
+              if e.get("kind") == "serving"
+              and e.get("phase") == "queue_wait"
+              and e.get("request") == rid]
+        assert len(qw) == 1
+        # ...and exactly one TTFT sample (delivered on the resume-fill
+        # completion — the request never had a first token before)
+        ttft = [e for e in sched.event_window
+                if e.get("phase") == "prefill"
+                and e.get("request") == rid
+                and e.get("ttft_s") is not None]
+        assert len(ttft) == 1
+
+    def test_evacuate_carries_filling_requests(self, lm):
+        """Replica-loss path (ISSUE 8 composition): mid-fill chunked
+        admissions evacuate like in-flight ones, arrival stamps
+        intact."""
+        engine = _engine(lm, prefix="off", chunk=2, slots=2)
+        sched = Scheduler(engine, policy="prefill_priority")
+        sched.submit(Request(prompt=list(range(1, 15)),
+                             max_new_tokens=2))
+        sched.tick()  # admit into a fill
+        assert sched.filling == 1
+        orphans = sched.evacuate()
+        assert len(orphans) == 1
+        assert orphans[0]._arrival > 0.0
+        assert sched.drained

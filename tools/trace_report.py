@@ -357,6 +357,24 @@ def render_text(s: dict) -> str:
                 f"  TTFT: p50 {sv['ttft_ms_p50']:.3f} ms, "
                 f"p99 {sv['ttft_ms_p99']:.3f} ms"
             )
+        if sv.get("tpot_ms_p50") is not None:
+            lines.append(
+                f"  TPOT: p50 {sv['tpot_ms_p50']:.3f} ms, "
+                f"p99 {sv['tpot_ms_p99']:.3f} ms per request"
+            )
+        if sv.get("slo_attainment") is not None:
+            lines.append(
+                f"  SLO attainment: {sv['slo_attainment'] * 100:.1f}% "
+                f"of {sv['slo_requests']} target-bearing request(s)"
+            )
+        if sv.get("preemptions"):
+            lines.append(f"  preemptions: {sv['preemptions']}")
+        ck = sv.get("chunked_prefill")
+        if ck:
+            lines.append(
+                f"  chunked prefill: {ck['chunk_tokens']} prompt "
+                f"token(s) over {ck['chunks']} mixed-step chunk(s)"
+            )
         if sv.get("occupancy_mean") is not None:
             lines.append(
                 f"  slot occupancy: {sv['occupancy_mean'] * 100:.1f}% mean"
