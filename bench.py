@@ -452,7 +452,9 @@ _COMPACT_KEYS = (
     "kernel_sweep_failures", "kernel_sweep_numeric_failures",
     "kernel_sweep_numeric_errors", "proxy_spread_pct", "autotune",
     "hidden_comm_fraction", "reduction_schedule_selected",
-    "overlap_spread_pct", "serving_tokens_per_sec", "serving_spread_pct",
+    "overlap_spread_pct", "composed_best_vs_two_level",
+    "composed_spread_pct", "composed_selected",
+    "serving_tokens_per_sec", "serving_spread_pct",
     "serving_spec_selected", "serving_spec_speedup",
     "serving_spec_accept_rate", "serving_prefix_ttft_speedup",
     "serving_prefix_hit_rate", "serving_prefix_spread_pct",
@@ -2701,6 +2703,160 @@ def _bench_overlap(comm, on_accel: bool):
     return out
 
 
+def _bench_composed(comm, on_accel: bool):
+    """ISSUE 12: the derived-composition sweep — the mesh re-factored
+    THREE-LEVEL (8 devices -> 2x2x2, the north-star multi-slice
+    rehearsal a flat or 2-axis bench cannot stand in for) and every
+    composition the deriver generates for it timed through the standard
+    optimizer path (CPU-proxy convention: median-of-n>=3 + spread — a
+    delta inside ``composed_spread_pct`` is noise).
+
+    Rows are keyed by COMPOSITION SIGNATURE (the registry's spelling):
+    the menu's ``flat``/``two_level`` appear as their derived instances
+    (``ar(a0+a1+a2)`` / ``rs(a2)>ar(a0+a1)>ag(a2)``), so the
+    best-vs-``two_level`` ratio on the compact line prices exactly what
+    the composition layer buys beyond the old menu. The medians are
+    adopted into the tuning cache as this 3-level world shape's
+    ``reduction_schedule`` decision (spread-gated, carried-blob aware —
+    ``tuning seed`` learns the same rows offline)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from chainermn_tpu import create_multi_node_optimizer
+    from chainermn_tpu.communicators.xla_communicator import XlaCommunicator
+    from chainermn_tpu.parallel.composition import (
+        canonical_axis_names,
+        derive_compositions,
+        normalize_schedule_name,
+        schedule_candidates,
+        two_level_composition,
+    )
+    from chainermn_tpu.parallel.mesh import best_mesh_shape
+    from chainermn_tpu.parallel.reduction_schedule import (
+        DECISION as _SCHED_DECISION,
+    )
+
+    devices = list(comm.mesh.devices.flat)
+    shape = best_mesh_shape(len(devices), 3)
+    names = canonical_axis_names(3)
+    comm3 = XlaCommunicator(
+        mesh=Mesh(np.array(devices).reshape(shape), names)
+    )
+    axes = comm3.grad_axes
+
+    width = 1536 if on_accel else 128
+    layers = 2
+    batch = 8 * comm3.size
+    steps = 16 if on_accel else 2
+    rng = jax.random.PRNGKey(0)
+    params = [
+        jax.random.normal(jax.random.fold_in(rng, i),
+                          (width, width), jnp.float32) * 0.02
+        for i in range(layers)
+    ]
+    x = jax.random.normal(rng, (batch, width), jnp.bfloat16)
+    payload_bytes = sum(p.size * 4 for p in params)
+
+    def time_loop(opt):
+        def local(params, opt_state, xb):
+            def one(carry, _):
+                params, opt_state = carry
+
+                def loss_fn(ps):
+                    h = xb
+                    for w in ps:
+                        h = jnp.tanh(h @ w.astype(jnp.bfloat16))
+                    return jnp.sum(h.astype(jnp.float32) ** 2)
+
+                grads = jax.grad(loss_fn)(params)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), ()
+
+            (params, opt_state), _ = jax.lax.scan(
+                one, (params, opt_state), None, length=steps
+            )
+            return params
+
+        fn = jax.jit(
+            shard_map(local, mesh=comm3.mesh,
+                      in_specs=(P(), opt.opt_state_spec(), P(axes)),
+                      out_specs=P(), check_vma=False)
+        )
+        opt_state = opt.init(params)
+        _fetch_scalar(fn(params, opt_state, x)[0][:1, :1])  # compile+warm
+
+        def sample():
+            t0 = time.perf_counter()
+            _fetch_scalar(fn(params, opt_state, x)[0][:1, :1])
+            return (time.perf_counter() - t0) / steps * 1000
+
+        return _repeat_median(sample, 3)
+
+    sched_ms: dict = {}
+    spreads: dict = {}
+    for comp in derive_compositions(names):
+        sig = comp.signature()
+        opt = create_multi_node_optimizer(
+            optax.sgd(1e-3), comm3, allreduce_grad_dtype=jnp.bfloat16,
+            reduction_schedule=sig,
+        )
+        med, spread = time_loop(opt)
+        sched_ms[sig] = round(med, 3)
+        spreads[sig] = spread
+    two_level_sig = two_level_composition(names).signature()
+    best_sig = min(sched_ms, key=sched_ms.get)
+    out = {
+        "composed_schedule_ms": sched_ms,
+        "composed_spread_pct": max(spreads.values()),
+        "composed_world_shape": [int(d) for d in shape],
+        "composed_payload_mb": max(1, payload_bytes >> 20),
+        "composed_best": best_sig,
+        # what composing beyond the menu buys: the best derived
+        # pipeline's speedup over the menu's two_level on this
+        # 3-level factoring (>1 = a composition the menu could not
+        # express wins; judge it against composed_spread_pct).
+        "composed_best_vs_two_level": round(
+            sched_ms[two_level_sig] / max(sched_ms[best_sig], 1e-9), 3
+        ),
+    }
+    try:
+        from chainermn_tpu import tuning
+
+        key = tuning.decision_key(
+            shape=tuple(int(d) for d in shape)
+            + (max(1, payload_bytes >> 20),),
+            dtype="sched",
+        )
+        # Adopt under the registry's candidate SPELLING: the flat /
+        # two_level derived instances go in by menu name (a signature
+        # winner the candidate list excludes would be silently
+        # discarded at choice() time), novel pipelines by signature.
+        adopt_ms = {normalize_schedule_name(s, 3): v
+                    for s, v in sched_ms.items()}
+        adopt_spreads = {normalize_schedule_name(s, 3): v
+                         for s, v in spreads.items()}
+        tuning.record_measurement(
+            _SCHED_DECISION, key, adopt_ms, spreads=adopt_spreads
+        )
+        selected = tuning.choice(
+            _SCHED_DECISION, schedule_candidates(3), key
+        )
+        out["composed_selected"] = selected
+        rec = [d for d in tuning.decisions_taken()
+               if d["name"] == _SCHED_DECISION and d["key"] == key]
+        if rec:
+            out["composed_schedule_source"] = rec[-1]["source"]
+    except Exception as e:
+        out["composed_autotune_error"] = f"{type(e).__name__}: {e}"[:120]
+    return out
+
+
 def _bench_plan(comm, on_accel: bool):
     """ISSUE 10: hand-wired vs plan-compiled train step (CPU-proxy
     convention: median-of-n>=3 + spread — a delta inside the spread is
@@ -3408,6 +3564,8 @@ def _run_bench(mode: str) -> None:
          lambda: _bench_double_buffering(comm, on_accel))
     supp("overlap", "overlap_error",
          lambda: _bench_overlap(comm, on_accel))
+    supp("composed", "composed_error",
+         lambda: _bench_composed(comm, on_accel))
     supp("plan", "plan_error",
          lambda: _bench_plan(comm, on_accel))
     supp("transformer", "transformer_error",

@@ -411,15 +411,20 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
 
     - trace-time layout events (in-jit bucketed schedules; no
       ``dur_s``): counted per schedule with their ``overlapped`` flag —
-      what the compiled program COMMITTED to;
+      what the compiled program COMMITTED to. Events carrying a
+      ``composition`` signature (ISSUE 12: one event per bucket per
+      STAGE) group under ``compositions`` instead, keyed by signature
+      with a per-stage bytes/time table — the consumer side of the
+      composed schedules' stage events;
     - measured events (the eager ``OverlappedBucketReducer``; ``dur_s``
       = dispatch->ready, ``blocked_s`` = wait actually paid at
       collect): aggregated into comm time total vs comm time hidden
       behind compute, and the ``hidden_fraction`` between them.
 
-    Returns None when the trace carries neither (section omitted)."""
+    Returns None when the trace carries none (section omitted)."""
     configs: list[dict] = []
     layout: dict = {}
+    composed: dict = {}
     n_measured = 0
     comm_s = 0.0
     blocked_s = 0.0
@@ -433,7 +438,32 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
             })
         elif kind == "wire":
             dur = ev.get("dur_s")
-            if dur is None:
+            if ev.get("composition"):
+                sig = str(ev["composition"])
+                row = composed.setdefault(sig, {
+                    "schedule": str(ev.get("schedule", sig)),
+                    "buckets": 0, "nbytes": 0, "overlapped": 0,
+                    "stages": {},
+                })
+                # stage_index 0 marks a bucket's first stage event —
+                # one bucket, not one per stage
+                if not ev.get("stage_index"):
+                    row["buckets"] += 1
+                    row["overlapped"] += 1 if ev.get("overlapped") else 0
+                row["nbytes"] += int(ev.get("nbytes") or 0)
+                st = row["stages"].setdefault(
+                    str(ev.get("stage", "?")),
+                    {"op": ev.get("stage_op"), "n": 0, "nbytes": 0},
+                )
+                st["n"] += 1
+                st["nbytes"] += int(ev.get("nbytes") or 0)
+                if dur is not None:
+                    # a measured composed event (eager executors):
+                    # per-stage time lands in the table too
+                    st["dur_ms"] = round(
+                        st.get("dur_ms", 0.0) + float(dur) * 1e3, 4
+                    )
+            elif dur is None:
                 key = str(ev.get("schedule", "?"))
                 row = layout.setdefault(
                     key, {"buckets": 0, "nbytes": 0, "overlapped": 0}
@@ -448,7 +478,7 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
                 # FULLY-HIDDEN bucket and must count as such.
                 b = ev.get("blocked_s")
                 blocked_s += float(dur if b is None else b)
-    if not configs and not layout and not n_measured:
+    if not configs and not layout and not composed and not n_measured:
         return None
     out: dict = {}
     if configs:
@@ -456,6 +486,10 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
     if layout:
         out["schedules"] = {
             k: layout[k] for k in sorted(layout)
+        }
+    if composed:
+        out["compositions"] = {
+            k: composed[k] for k in sorted(composed)
         }
     if n_measured:
         hidden_s = max(0.0, comm_s - blocked_s)

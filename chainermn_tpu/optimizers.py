@@ -271,13 +271,38 @@ class MultiNodeOptimizer:
                 "(allreduce_grad_dtype=jnp.int8) — other dtypes lose "
                 "nothing systematic to feed back"
             )
+        from chainermn_tpu.parallel.composition import (
+            Composition,
+            CompositionError,
+            compile_schedule,
+        )
         from chainermn_tpu.parallel.reduction_schedule import SCHEDULES
 
         if reduction_schedule not in (None, "auto") + SCHEDULES:
-            raise ValueError(
-                f"reduction_schedule must be one of "
-                f"{(None, 'auto') + SCHEDULES}, got {reduction_schedule!r}"
-            )
+            # Beyond the menu: a composition signature string or a
+            # Composition instance (ISSUE 12) — validated against this
+            # communicator's mesh axes NOW, so a broken pipeline fails
+            # at construction, not inside the compiled step.
+            try:
+                comp = compile_schedule(
+                    reduction_schedule, communicator.grad_axes
+                )
+            except CompositionError as e:
+                raise ValueError(
+                    f"reduction_schedule must be one of "
+                    f"{(None, 'auto') + SCHEDULES}, a composition "
+                    f"signature, or a Composition; got "
+                    f"{reduction_schedule!r} ({e})"
+                ) from None
+            if comp.has_update:
+                raise ValueError(
+                    f"reduction_schedule composition "
+                    f"{comp.signature()!r} carries a sharded_update "
+                    "stage — spell the structural form as "
+                    "reduction_schedule='zero'"
+                )
+            if isinstance(reduction_schedule, Composition):
+                reduction_schedule = comp  # normalized+validated
         if error_feedback and reduction_schedule not in (None, "flat"):
             raise ValueError(
                 "error_feedback owns its reduction (the flat or the "
@@ -299,12 +324,21 @@ class MultiNodeOptimizer:
                     "compression or the flat/two_level schedules"
                 )
         self.reduction_schedule = reduction_schedule
-        #: candidates an ``'auto'`` resolution may pick: ``'zero'`` is
-        #: eligible only when nothing structurally incompatible is on.
+        #: candidates an ``'auto'`` resolution may pick: the DERIVED
+        #: choice set for this mesh's axis count (menu names + the
+        #: compositions the menu cannot express, by signature —
+        #: chainermn_tpu.parallel.composition.schedule_candidates).
+        #: ``'zero'`` is eligible only when nothing structurally
+        #: incompatible is on; beyond-menu compositions only on a
+        #: lossless/bf16 wire (the int8 two-phase wire has flat and
+        #: two-level renderings only).
+        from chainermn_tpu.parallel.composition import schedule_candidates
+
         self._auto_candidates = tuple(
-            s for s in SCHEDULES
+            s for s in schedule_candidates(len(communicator.grad_axes))
             if not (s == "zero" and (double_buffering or error_feedback
                                      or self._int8_wire()))
+            and not (s not in SCHEDULES and self._int8_wire())
         )
         #: the one-shot 'auto' resolution (first need wins — init and
         #: update must agree on the state layout) + its registry record.
@@ -485,7 +519,6 @@ class MultiNodeOptimizer:
         comm = self.communicator
         names = comm.grad_axes
         ax = names[-1]
-        rest = names[:-1]
         n = self._zero_n()
         compress = self.compress_dtype
 
@@ -519,20 +552,23 @@ class MultiNodeOptimizer:
         n_tot = axes_size(names)
         idx = lax.axis_index(ax)
 
-        def rs(g):
-            rows = _chunk_rows(g, n)
-            if compress is not None and jnp.issubdtype(
-                g.dtype, jnp.floating
-            ):
-                rows = rows.astype(compress)
-            part = lax.psum_scatter(
-                rows, ax, scatter_dimension=0, tiled=False
-            )
-            if rest:
-                part = lax.psum(part, rest)
-            return (part / n_tot).astype(g.dtype)
+        # The 'zero' schedule IS a composition instance (ISSUE 12):
+        # rs(fast) > [ar(rest)] > sharded_update > ag(fast) — the
+        # reduce prefix and gather suffix run through the one staged
+        # executor, with the inner optimizer fused between them.
+        from chainermn_tpu.parallel.composition import (
+            run_gather_suffix,
+            run_reduce_prefix,
+            zero_composition,
+        )
 
-        gchunks = jax.tree.map(rs, grads)
+        pre, post = zero_composition(names).split_update()
+        gchunks = jax.tree.map(
+            lambda g: run_reduce_prefix(
+                g, pre, total=n_tot, wire_dtype=compress
+            ),
+            grads,
+        )
         pchunks = (jax.tree.map(
             lambda p: lax.dynamic_index_in_dim(
                 _chunk_rows(p, n), idx, keepdims=False
@@ -542,11 +578,10 @@ class MultiNodeOptimizer:
         uchunks, schunk = inner.update(gchunks, schunk, pchunks)
         inner_state = jax.tree.map(lambda e: e[None], schunk)
 
-        def ag(u, g):
-            rows = lax.all_gather(u, ax, axis=0, tiled=False)
-            return _unchunk(rows, g.shape, g.dtype)
-
-        updates = jax.tree.map(ag, uchunks, grads)
+        updates = jax.tree.map(
+            lambda u, g: run_gather_suffix(u, g, post, pre),
+            uchunks, grads,
+        )
         return updates, _ZeroShardState(inner=inner_state)
 
     # -- optax protocol ----------------------------------------------------

@@ -62,6 +62,14 @@ def __getattr__(name):
         from chainermn_tpu.parallel import reduction_schedule as _rs
 
         return getattr(_rs, name)
+    if name in ("Composition", "CompositionError", "Stage",
+                "compile_schedule", "derive_compositions",
+                "parse_signature", "predicted_collectives",
+                "reduce_composed", "schedule_candidates",
+                "validate_composition", "zero_composition"):
+        from chainermn_tpu.parallel import composition as _comp
+
+        return getattr(_comp, name)
     if name in ("moe_layer_local", "top1_route", "topk_route",
                 "load_balancing_loss", "make_expert_params"):
         from chainermn_tpu.parallel import moe as _m
@@ -119,6 +127,17 @@ __all__ = [
     "bucket_partition",
     "OverlappedBucketReducer",
     "SCHEDULES",
+    "Composition",
+    "CompositionError",
+    "Stage",
+    "compile_schedule",
+    "derive_compositions",
+    "parse_signature",
+    "predicted_collectives",
+    "reduce_composed",
+    "schedule_candidates",
+    "validate_composition",
+    "zero_composition",
     "moe_layer_local",
     "top1_route",
     "topk_route",

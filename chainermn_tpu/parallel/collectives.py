@@ -445,6 +445,50 @@ def two_level_shard_len(size: int, n_intra: int) -> int:
     return -(-size // n_intra)
 
 
+# ---------------------------------------------------------------------------
+# Staged primitives over MERGED axis tuples — the composition layer's
+# vocabulary (chainermn_tpu.parallel.composition): each is one stage of
+# a composed reduction pipeline, one XLA collective over the flattened
+# product of its axis group.
+# ---------------------------------------------------------------------------
+
+
+def _merged_axes_arg(axes):
+    names = _names_tuple(axes)
+    return names if len(names) > 1 else names[0]
+
+
+def staged_reduce_scatter(flat: jax.Array, axes) -> jax.Array:
+    """One composition stage: ceil-pad the flat buffer into
+    ``[n, c]`` rows over the MERGED axis group ``axes`` (``n`` = the
+    product of their sizes, ``c`` = :func:`two_level_shard_len`) and
+    ``psum_scatter`` it — this member's exactly-summed 1/n shard. The
+    padding rule is the two-level frame's, so a single-axis stage is
+    byte-identical to the pinned ``decomposed_allreduce`` scatter."""
+    names = _names_tuple(axes)
+    n = 1
+    for a in names:
+        n *= lax.axis_size(a)
+    c = two_level_shard_len(flat.size, n)
+    rows = jnp.pad(flat, (0, n * c - flat.size)).reshape(n, c)
+    return lax.psum_scatter(
+        rows, _merged_axes_arg(names), scatter_dimension=0, tiled=False
+    )
+
+
+def staged_allreduce(x: jax.Array, axes) -> jax.Array:
+    """One composition stage: ``psum`` over the merged axis group."""
+    return lax.psum(x, _names_tuple(axes))
+
+
+def staged_allgather(shard: jax.Array, axes, orig_size: int) -> jax.Array:
+    """One composition stage: the conjugate gather of
+    :func:`staged_reduce_scatter` — ``all_gather`` the shard rows back
+    over the merged group and un-pad to ``orig_size`` elements."""
+    rows = lax.all_gather(shard, _merged_axes_arg(axes), axis=0, tiled=False)
+    return rows.reshape(-1)[:orig_size]
+
+
 def int8_two_level_allreduce_mean_with_feedback(
     x: jax.Array, residual: jax.Array, intra_axis: str, inter_axis: str
 ):

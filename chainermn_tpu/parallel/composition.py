@@ -1,0 +1,739 @@
+"""Topology-composed collective schedules — the composition DSL.
+
+The reference hand-wrote ONE reduction pipeline per topology class
+(``two_dimensional_communicator.py`` (dagger): intra reduce-scatter ->
+inter allreduce -> intra all-gather, fixed) and our schedule layer
+started the same way: a three-entry menu (``flat`` / ``two_level`` /
+``zero``). HiCCL (arXiv:2408.05962) and The Big Send-off
+(arXiv:2504.18658) make the case that the winning schedule should be
+COMPOSED from primitives per topology level — on a 3-level
+``(dcn, ici_y, ici_x)`` mesh the menu cannot even express the best
+pipeline (e.g. the per-level ladder ``rs(ici_x) > rs(ici_y) > ar(dcn) >
+ag(ici_y) > ag(ici_x)``), and an autotuner can only search what its
+candidate set contains.
+
+This module is that generalisation, in three pieces:
+
+- a tiny DSL: a :class:`Composition` is an ordered tuple of
+  :class:`Stage` s, each ``(primitive x axis-subset)`` with primitives
+  ``reduce_scatter`` / ``allreduce`` / ``allgather`` /
+  ``sharded_update`` (the ZeRO fuse point, arXiv:2004.13336). Each
+  composition prints as a stable signature string
+  (``"rs(a2)>ar(a0+a1)>ag(a2)"``) — the spelling the autotune registry,
+  trace ``wire`` events and bench rows all key on;
+- a VALIDATOR (:func:`validate_composition`) that proves a composition
+  is a correct mean-allreduce *before* anything runs: every element
+  reduced over every mesh axis exactly once, every scatter conjugated
+  by a gather (LIFO, same axis group), the sharded-update placed at the
+  fully-reduced shard. Violations raise :class:`CompositionError`
+  naming the broken invariant;
+- a DERIVER (:func:`derive_compositions`) that enumerates the legal
+  reduction compositions for an arbitrary n-level mesh (per-level
+  rs->ar->ag ladders, axis-merged variants, slow-axis-innermost
+  orderings — ``2^k`` compositions for ``k`` axes), so schedules for
+  new topologies are generated, not hand-written. The old menu entries
+  are DERIVED INSTANCES: ``flat`` is ``ar(all)``, ``two_level`` is
+  ``rs(fast) > ar(rest) > ag(fast)``, and ``zero`` is
+  ``rs(fast) > ar(rest) > su > ag(fast)`` (``rs(all) > su > ag(all)``
+  on a flat mesh).
+
+Execution is :func:`reduce_composed` — the ONE executor every schedule
+(menu name or derived signature) compiles down to, inside the named-
+axis context. Its per-stage primitives are exactly the collectives the
+signature predicts (:func:`predicted_collectives`), which is what the
+structural HLO-count tests pin (``tests/test_composition.py``).
+
+Mesh-axis convention: the tuple is in MESH ORDER, slow/DCN-most first,
+fast/ICI-most last (the repo's convention) — so "scatter the fast axes
+first, reduce the slow axis innermost" is "partition the reversed axis
+tuple".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+PyTree = Any
+
+#: Stage primitives. ``sharded_update`` is the ZeRO fuse point: the
+#: caller's update function runs on the fully-reduced 1/n shard.
+PRIMITIVES = ("reduce_scatter", "allreduce", "allgather", "sharded_update")
+
+_SHORT = {"reduce_scatter": "rs", "allreduce": "ar", "allgather": "ag",
+          "sharded_update": "su"}
+_LONG = {v: k for k, v in _SHORT.items()}
+
+#: HLO op a stage lowers to (the vocabulary of the structural tests;
+#: ``sharded_update`` owes the wire nothing).
+STAGE_HLO = {"reduce_scatter": "reduce-scatter", "allreduce": "all-reduce",
+             "allgather": "all-gather"}
+
+
+class CompositionError(ValueError):
+    """A composition failed validation; the message names the broken
+    invariant."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Stage:
+    """One pipeline stage: ``primitive`` over the merged axis group
+    ``axes`` (mesh-order tuple; empty only for ``sharded_update``)."""
+
+    primitive: str
+    axes: tuple[str, ...] = ()
+
+    def signature(self) -> str:
+        if self.primitive == "sharded_update":
+            return "su"
+        return f"{_SHORT[self.primitive]}({'+'.join(self.axes)})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Composition:
+    """An ordered stage list; build via :func:`parse_signature`,
+    :func:`compile_schedule` or :func:`derive_compositions`, then prove
+    it with :func:`validate_composition` before running it."""
+
+    stages: tuple[Stage, ...]
+
+    def signature(self) -> str:
+        return ">".join(s.signature() for s in self.stages)
+
+    @property
+    def has_update(self) -> bool:
+        return any(s.primitive == "sharded_update" for s in self.stages)
+
+    def split_update(self) -> tuple[tuple[Stage, ...], tuple[Stage, ...]]:
+        """``(reduce_prefix, gather_suffix)`` around the
+        ``sharded_update`` stage — the seam the ZeRO executors use (the
+        inner optimizer runs BETWEEN them, once, on the whole chunk
+        tree)."""
+        for i, s in enumerate(self.stages):
+            if s.primitive == "sharded_update":
+                return self.stages[:i], self.stages[i + 1:]
+        raise CompositionError(
+            f"composition {self.signature()!r} has no sharded_update "
+            "stage to split at"
+        )
+
+    def __str__(self) -> str:  # pragma: no cover - convenience
+        return self.signature()
+
+
+_STAGE_RE = re.compile(r"^(rs|ar|ag|su)(?:\(([^()]*)\))?$")
+
+
+def parse_signature(sig: str) -> Composition:
+    """Parse ``"rs(a2)>ar(a0+a1)>ag(a2)"`` back into a
+    :class:`Composition` (the registry stores winners as signature
+    strings; this is the way back)."""
+    stages = []
+    for part in str(sig).split(">"):
+        m = _STAGE_RE.match(part.strip())
+        if not m:
+            raise CompositionError(
+                f"unparseable composition stage {part!r} in {sig!r} "
+                "(expected e.g. 'rs(intra)', 'ar(a0+a1)', 'su')"
+            )
+        short, axes = m.groups()
+        if short == "su":
+            if axes:
+                raise CompositionError(
+                    f"sharded_update stage carries no axes, got {part!r}"
+                )
+            stages.append(Stage("sharded_update"))
+        else:
+            names = tuple(a for a in (axes or "").split("+") if a)
+            stages.append(Stage(_LONG[short], names))
+    return Composition(tuple(stages))
+
+
+def canonical_axis_names(k: int) -> tuple[str, ...]:
+    """Positional axis tokens ``('a0', ..., 'a<k-1>')`` — the spelling
+    the WORLD-SHAPE-keyed tuning decision uses, so a cached winner is
+    portable across communicators whose meshes name their axes
+    differently (``bind_composition`` maps tokens back by position)."""
+    return tuple(f"a{i}" for i in range(k))
+
+
+def bind_composition(comp: Composition, axes: Sequence[str]) -> Composition:
+    """Rebind a composition written over :func:`canonical_axis_names`
+    onto the actual mesh ``axes`` by position. A composition already
+    spelled in ``axes``'s names passes through unchanged."""
+    names = tuple(axes)
+    used = {a for s in comp.stages for a in s.axes}
+    if used <= set(names):
+        return comp
+    canon = canonical_axis_names(len(names))
+    if not used <= set(canon):
+        raise CompositionError(
+            f"composition {comp.signature()!r} names axes "
+            f"{sorted(used - set(names))} that are neither on the mesh "
+            f"{names} nor canonical positional tokens {canon}"
+        )
+    table = dict(zip(canon, names))
+    return Composition(tuple(
+        Stage(s.primitive, tuple(table[a] for a in s.axes))
+        for s in comp.stages
+    ))
+
+
+# ---------------------------------------------------------------------------
+# Validator: prove the composition is a correct mean-allreduce
+# ---------------------------------------------------------------------------
+
+
+def validate_composition(
+    comp: Composition, mesh_axes: Sequence[str]
+) -> Composition:
+    """Prove ``comp`` is a correct mean-allreduce over ``mesh_axes``
+    BEFORE anything runs. Invariants (each violation raises
+    :class:`CompositionError` naming it):
+
+    - the stage list is non-empty and every primitive is known;
+    - every reduce/scatter/gather stage names >= 1 mesh axis, no axis
+      twice within a stage;
+    - every mesh axis is REDUCED EXACTLY ONCE (by a ``reduce_scatter``
+      or ``allreduce`` stage) — a missed axis leaves a partial sum, a
+      doubled axis over-reduces;
+    - scatters and gathers are CONJUGATE: each ``allgather`` closes the
+      most recent open ``reduce_scatter`` with the SAME axis group
+      (LIFO), and no scatter is left open at the end — otherwise the
+      output shards don't reassemble to the input layout;
+    - at most one ``sharded_update``, placed at the fully-reduced shard:
+      after every reduction, before every gather, with at least one
+      scatter open (otherwise the update is not sharded — that is the
+      plain post-reduction update, not a composition stage).
+    """
+    mesh = tuple(mesh_axes)
+    if not isinstance(comp, Composition):
+        raise CompositionError(
+            f"expected a Composition, got {type(comp).__name__}"
+        )
+    if not comp.stages:
+        raise CompositionError(
+            "empty stage list: a composition must reduce over "
+            f"{mesh} and an empty pipeline reduces nothing"
+        )
+    reduced: list[str] = []
+    open_scatters: list[tuple[str, ...]] = []
+    update_seen = False
+    for st in comp.stages:
+        if st.primitive not in PRIMITIVES:
+            raise CompositionError(
+                f"unknown primitive {st.primitive!r} (stages compose "
+                f"{PRIMITIVES})"
+            )
+        if st.primitive == "sharded_update":
+            if update_seen:
+                raise CompositionError(
+                    f"{comp.signature()!r}: more than one sharded_update "
+                    "stage — the ZeRO fuse point is single"
+                )
+            if set(reduced) != set(mesh):
+                raise CompositionError(
+                    f"{comp.signature()!r}: sharded_update before every "
+                    f"axis is reduced (reduced {tuple(reduced)}, mesh "
+                    f"{mesh}) — the update must see the fully-reduced "
+                    "mean chunk"
+                )
+            if not open_scatters:
+                raise CompositionError(
+                    f"{comp.signature()!r}: sharded_update with no open "
+                    "reduce_scatter — the update would not be sharded "
+                    "(that is a plain post-reduction update, not a "
+                    "composition stage)"
+                )
+            update_seen = True
+            continue
+        if not st.axes:
+            raise CompositionError(
+                f"{comp.signature()!r}: {st.primitive} stage with an "
+                "empty axis group — every collective stage names the "
+                "axes it rides"
+            )
+        if len(set(st.axes)) != len(st.axes):
+            raise CompositionError(
+                f"{comp.signature()!r}: duplicate axis within stage "
+                f"{st.signature()!r}"
+            )
+        for a in st.axes:
+            if a not in mesh:
+                raise CompositionError(
+                    f"{comp.signature()!r}: axis {a!r} is not on the "
+                    f"mesh {mesh}"
+                )
+        if st.primitive in ("reduce_scatter", "allreduce"):
+            if update_seen:
+                raise CompositionError(
+                    f"{comp.signature()!r}: {st.signature()} after the "
+                    "sharded_update — every reduction precedes the fuse "
+                    "point"
+                )
+            dup = [a for a in st.axes if a in reduced]
+            if dup:
+                raise CompositionError(
+                    f"{comp.signature()!r}: axis {dup[0]!r} reduced more "
+                    "than once — the mean would be over-divided"
+                )
+            reduced.extend(st.axes)
+            if st.primitive == "reduce_scatter":
+                open_scatters.append(st.axes)
+        else:  # allgather
+            if not open_scatters:
+                raise CompositionError(
+                    f"{comp.signature()!r}: {st.signature()} with no open "
+                    "reduce_scatter to conjugate"
+                )
+            top = open_scatters.pop()
+            if top != st.axes:
+                raise CompositionError(
+                    f"{comp.signature()!r}: {st.signature()} does not "
+                    f"conjugate the open reduce_scatter over {top} — "
+                    "scatter/gather pairs close LIFO with the same axis "
+                    "group"
+                )
+    missing = [a for a in mesh if a not in reduced]
+    if missing:
+        raise CompositionError(
+            f"{comp.signature()!r}: axes {tuple(missing)} never reduced "
+            "— the result would not be the mean over the mesh"
+        )
+    if open_scatters:
+        raise CompositionError(
+            f"{comp.signature()!r}: reduce_scatter over "
+            f"{open_scatters[-1]} never gathered back — the output "
+            "would stay sharded"
+        )
+    return comp
+
+
+def predicted_collectives(comp: Composition) -> dict[str, int]:
+    """HLO collective counts the compiled program must carry — one op
+    per stage (``tests/test_composition.py`` compiles and compares)."""
+    out = {"reduce-scatter": 0, "all-reduce": 0, "all-gather": 0}
+    for st in comp.stages:
+        hlo = STAGE_HLO.get(st.primitive)
+        if hlo is not None:
+            out[hlo] += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Deriver: enumerate the legal compositions for an n-level mesh
+# ---------------------------------------------------------------------------
+
+
+def _contiguous_partitions(items: tuple) -> list[list[tuple]]:
+    """All ordered partitions of ``items`` into contiguous groups."""
+    if not items:
+        return [[]]
+    out = []
+    for i in range(1, len(items) + 1):
+        head = items[:i]
+        for rest in _contiguous_partitions(items[i:]):
+            out.append([head] + rest)
+    return out
+
+
+def derive_compositions(mesh_axes: Sequence[str]) -> tuple[Composition, ...]:
+    """Enumerate the legal mean-allreduce compositions for a mesh.
+
+    Recipe: reverse the axis tuple (fast level scatters first, slow
+    level reduces innermost — the dcn-last ordering), partition it into
+    contiguous LEVEL GROUPS (axis-merged variants: one collective per
+    group over the merged axes), scatter every outer group, reduce the
+    innermost group by either an ``allreduce`` or its own
+    ``reduce_scatter``/``allgather`` pair, and conjugate-gather back
+    out. ``2^k`` compositions for ``k`` axes — the menu's entries fall
+    out as instances (``flat`` = the one-group allreduce,
+    ``two_level`` = the ((fast), (rest)) split), and the rest are the
+    pipelines the menu could not express (per-level ladders, merged
+    scatters, scattered-slow-level variants). Every derived composition
+    passes :func:`validate_composition` by construction (property-swept
+    in the tests anyway).
+    """
+    names = tuple(mesh_axes)
+    if not names:
+        raise CompositionError("derive_compositions: empty mesh axis tuple")
+    seen = set()
+    out: list[Composition] = []
+    for parts in _contiguous_partitions(names[::-1]):
+        # each group back in mesh order for readable signatures
+        groups = [tuple(sorted(g, key=names.index)) for g in parts]
+        outer, inner = groups[:-1], groups[-1]
+        for innermost in ("allreduce", "reduce_scatter"):
+            stages = [Stage("reduce_scatter", g) for g in outer]
+            stages.append(Stage(innermost, inner))
+            if innermost == "reduce_scatter":
+                stages.append(Stage("allgather", inner))
+            stages.extend(Stage("allgather", g) for g in reversed(outer))
+            comp = Composition(tuple(stages))
+            sig = comp.signature()
+            if sig not in seen:
+                seen.add(sig)
+                out.append(validate_composition(comp, names))
+    return tuple(out)
+
+
+def flat_composition(mesh_axes: Sequence[str]) -> Composition:
+    """``flat`` as a derived instance: one fused allreduce over the
+    merged axes."""
+    return Composition((Stage("allreduce", tuple(mesh_axes)),))
+
+
+def two_level_composition(mesh_axes: Sequence[str]) -> Composition:
+    """``two_level`` as a derived instance: scatter the last (fast)
+    axis, allreduce the shard over the rest, gather back — the
+    reference's ``TwoDimensionalCommunicator`` pipeline
+    (``two_dimensional_communicator.py`` (dagger)). On a flat mesh the
+    rest is empty and this is the pinned rs->ag decomposition."""
+    names = tuple(mesh_axes)
+    fast, rest = (names[-1],), names[:-1]
+    stages = [Stage("reduce_scatter", fast)]
+    if rest:
+        stages.append(Stage("allreduce", rest))
+    stages.append(Stage("allgather", fast))
+    return Composition(tuple(stages))
+
+
+def zero_composition(mesh_axes: Sequence[str]) -> Composition:
+    """``zero`` as a derived instance: the two_level reduction with the
+    sharded update fused at the fully-reduced chunk —
+    ``rs(all) > su > ag(all)`` on a flat mesh (arXiv:2004.13336),
+    ``rs(fast) > ar(rest) > su > ag(fast)`` on a hierarchical one (the
+    exact pipeline ``MultiNodeOptimizer._zero_update`` and the
+    ParallelPlan zero group hand-wired before this layer existed)."""
+    names = tuple(mesh_axes)
+    fast, rest = (names[-1],), names[:-1]
+    stages = [Stage("reduce_scatter", fast)]
+    if rest:
+        stages.append(Stage("allreduce", rest))
+    stages.append(Stage("sharded_update"))
+    stages.append(Stage("allgather", fast))
+    return Composition(tuple(stages))
+
+
+def compile_schedule(schedule, mesh_axes: Sequence[str]) -> Composition:
+    """Lower a schedule spelling to a validated :class:`Composition`:
+    a menu name (``'flat'``/``'two_level'``/``'zero'``), a signature
+    string (actual axis names or canonical positional tokens), or a
+    ``Composition`` instance. This is the ONE front door every executor
+    call site uses — the menu entries are compiled, not special-cased.
+    """
+    names = tuple(mesh_axes)
+    if isinstance(schedule, Composition):
+        return validate_composition(bind_composition(schedule, names), names)
+    if schedule == "flat":
+        return flat_composition(names)
+    if schedule == "two_level":
+        return two_level_composition(names)
+    if schedule == "zero":
+        return zero_composition(names)
+    if isinstance(schedule, str) and (">" in schedule or "(" in schedule):
+        comp = parse_signature(schedule)
+        return validate_composition(bind_composition(comp, names), names)
+    from chainermn_tpu.parallel.reduction_schedule import SCHEDULES
+
+    raise CompositionError(
+        f"unknown schedule {schedule!r}: expected one of {SCHEDULES}, a "
+        "composition signature (e.g. 'rs(a1)>ar(a0)>ag(a1)'), or a "
+        "Composition"
+    )
+
+
+def schedule_candidates(n_axes: int) -> tuple[str, ...]:
+    """The ``reduction_schedule`` decision's candidate set for a
+    ``n_axes``-level world shape: the legacy menu names first (cache
+    back-compat — existing entries keep resolving, and the table default
+    ``'flat'`` stays a member), then the DERIVED compositions the menu
+    cannot express, keyed by canonical-token signature string. This is
+    what makes the autotuner search generated schedules instead of a
+    fixed menu."""
+    from chainermn_tpu.parallel.reduction_schedule import SCHEDULES
+
+    names = canonical_axis_names(max(1, int(n_axes)))
+    menu_sigs = {flat_composition(names).signature(),
+                 two_level_composition(names).signature()}
+    derived = tuple(
+        c.signature() for c in derive_compositions(names)
+        if c.signature() not in menu_sigs
+    )
+    return tuple(SCHEDULES) + derived
+
+
+def normalize_schedule_name(schedule: str, n_axes: int) -> str:
+    """Map a menu-instance SIGNATURE back to its menu name — the
+    spelling :func:`schedule_candidates` (and therefore the registry's
+    candidate matching) uses. A composed sweep times every derived
+    pipeline by signature, and ``flat``/``two_level`` are among them as
+    ``ar(all)`` / ``rs(fast)>ar(rest)>ag(fast)``: adopting such a
+    winner under its signature would store a cache entry the candidate
+    list never matches (silently discarded, table default wins).
+    Non-menu signatures and menu names pass through unchanged."""
+    names = canonical_axis_names(max(1, int(n_axes)))
+    table = {
+        flat_composition(names).signature(): "flat",
+        two_level_composition(names).signature(): "two_level",
+        zero_composition(names).signature(): "zero",
+    }
+    return table.get(schedule, schedule)
+
+
+def signature_for(schedule, n_axes: int) -> str:
+    """Canonical-token signature for a winner string (menu name or
+    signature) — the provenance spelling ``resolve_schedule`` reports,
+    so a decision record names the actual pipeline, not just the menu
+    label."""
+    names = canonical_axis_names(max(1, int(n_axes)))
+    return compile_schedule(schedule, names).signature()
+
+
+# ---------------------------------------------------------------------------
+# Executor: one staged interpreter for every composition
+# ---------------------------------------------------------------------------
+
+
+def _axes_arg(axes: tuple[str, ...]):
+    return axes if len(axes) > 1 else axes[0]
+
+
+def _replay_sizes(stages: Sequence[Stage], size: int, axis_sizes):
+    """Static walk of the scatter frame: per-stage (size_in, size_out)
+    element counts and the LIFO scatter stack — shared by the executor,
+    the split ZeRO runners and the trace-time wire layout, so no two
+    consumers can disagree about padding."""
+    cur = int(size)
+    stack: list[tuple[tuple[str, ...], int]] = []
+    rows: list[tuple[Stage, int, int]] = []
+    for st in stages:
+        if st.primitive == "reduce_scatter":
+            n = 1
+            for a in st.axes:
+                n *= int(axis_sizes[a])
+            out = -(-cur // n)  # ceil: the padded shard length
+            stack.append((st.axes, cur))
+            rows.append((st, cur, out))
+            cur = out
+        elif st.primitive == "allgather":
+            axes, orig = stack.pop()
+            rows.append((st, cur, orig))
+            cur = orig
+        else:  # allreduce / sharded_update: size unchanged
+            rows.append((st, cur, cur))
+    return rows, cur, stack
+
+
+def stage_wire_layout(
+    comp: Composition, axis_sizes: Mapping[str, int], itemsize: int,
+    size: int,
+) -> list[dict]:
+    """Host-side per-stage wire table for one bucket of ``size``
+    elements at ``itemsize`` wire bytes each: the payload bytes each
+    collective stage carries (full buffer into a scatter / out of a
+    gather, the reduced shard through an allreduce). This is what the
+    trace ``wire`` events record per stage and what
+    ``tools/trace_report.py``'s overlap section tabulates per
+    composition signature."""
+    rows, _, _ = _replay_sizes(comp.stages, size, axis_sizes)
+    out = []
+    for st, size_in, size_out in rows:
+        hlo = STAGE_HLO.get(st.primitive)
+        if hlo is None:
+            continue
+        nbytes = max(size_in, size_out) * itemsize
+        out.append({"stage": st.signature(), "op": hlo, "nbytes": nbytes})
+    return out
+
+
+def reduce_composed(
+    x,
+    comp: Composition,
+    *,
+    op: str = "mean",
+    update_fn: Optional[Callable] = None,
+) -> Any:
+    """Run ``comp`` on one buffer inside its named-axis context — THE
+    executor every schedule lowers to. Stage semantics:
+
+    - ``reduce_scatter``: ceil-pad the flat buffer into ``[n, c]`` rows
+      over the stage's merged axis group and ``psum_scatter`` it (the
+      shard is this member's exactly-summed 1/n slice);
+    - ``allreduce``: ``psum`` over the group;
+    - ``allgather``: conjugate gather of the matching scatter, un-pad;
+    - ``sharded_update``: call ``update_fn`` on the fully-reduced
+      shard (the ZeRO fuse point).
+
+    The mean division lands immediately after the stage that completes
+    the reduction over every mesh axis — exactly where
+    ``decomposed_allreduce`` divides, so the menu schedules compile to
+    byte-identical programs through this path. The single-stage
+    ``ar(all)`` composition short-circuits to ``lax.pmean`` (the
+    legacy ``flat`` program, literally).
+    """
+    from jax import lax
+
+    from chainermn_tpu.parallel.collectives import (
+        staged_allgather,
+        staged_allreduce,
+        staged_reduce_scatter,
+    )
+
+    if op not in ("sum", "mean"):
+        raise ValueError(f"op must be 'sum' or 'mean', got {op!r}")
+    stages = comp.stages
+    if comp.has_update and update_fn is None:
+        raise ValueError(
+            f"composition {comp.signature()!r} has a sharded_update "
+            "stage but no update_fn was given"
+        )
+    reduce_axes = tuple(
+        a for s in stages
+        if s.primitive in ("reduce_scatter", "allreduce") for a in s.axes
+    )
+    # flat short-circuit: one fused pmean, the pre-composition program.
+    if (len(stages) == 1 and stages[0].primitive == "allreduce"
+            and op == "mean"):
+        return lax.pmean(x, _axes_arg(stages[0].axes))
+    n_tot = 1
+    for a in reduce_axes:
+        n_tot *= lax.axis_size(a)
+    shape = x.shape
+    cur = x.reshape(-1)
+    stack: list[int] = []  # original sizes, LIFO with the scatters
+    remaining = len(reduce_axes)
+    for st in stages:
+        if st.primitive == "reduce_scatter":
+            stack.append(cur.size)
+            cur = staged_reduce_scatter(cur, st.axes)
+            remaining -= len(st.axes)
+        elif st.primitive == "allreduce":
+            cur = staged_allreduce(cur, st.axes)
+            remaining -= len(st.axes)
+        elif st.primitive == "allgather":
+            cur = staged_allgather(cur, st.axes, stack.pop())
+        else:  # sharded_update
+            cur = update_fn(cur)
+        if remaining == 0 and op == "mean":
+            cur = cur / n_tot
+            remaining = -1  # divide exactly once
+    return cur.reshape(shape)
+
+
+# -- split execution around the ZeRO fuse point -----------------------------
+
+
+def run_reduce_prefix(
+    g,
+    stages: Sequence[Stage],
+    *,
+    total: int,
+    wire_dtype=None,
+):
+    """Run a composition's reduce prefix (the stages before
+    ``sharded_update``) on one leaf: flatten, optionally cast to the
+    compressed wire dtype, scatter/reduce per stage, divide by
+    ``total`` (the full data-parallel degree) and return the mean chunk
+    in the leaf's dtype — exactly the hand-wired
+    ``zero_grad_scatter``/``MultiNodeOptimizer._zero_update`` scatter
+    arithmetic, now derived from the composition."""
+    import jax.numpy as jnp
+
+    from chainermn_tpu.parallel.collectives import (
+        staged_allreduce,
+        staged_reduce_scatter,
+    )
+
+    cur = g.reshape(-1)
+    if wire_dtype is not None and jnp.issubdtype(g.dtype, jnp.floating):
+        cur = cur.astype(wire_dtype)
+    for st in stages:
+        if st.primitive == "reduce_scatter":
+            cur = staged_reduce_scatter(cur, st.axes)
+        elif st.primitive == "allreduce":
+            cur = staged_allreduce(cur, st.axes)
+        else:
+            raise CompositionError(
+                f"{st.signature()}: only reduce stages run before the "
+                "sharded_update"
+            )
+    return (cur / total).astype(g.dtype)
+
+
+def run_gather_suffix(
+    u_chunk,
+    like,
+    stages: Sequence[Stage],
+    prefix: Sequence[Stage],
+):
+    """Run a composition's gather suffix (the stages after
+    ``sharded_update``) on one updated chunk, reassembling ``like``'s
+    shape/dtype. The un-pad sizes replay the prefix's static scatter
+    frame (:func:`_replay_sizes`), so prefix and suffix can never
+    disagree about the padding."""
+    from jax import lax
+
+    from chainermn_tpu.parallel.collectives import staged_allgather
+
+    axis_sizes = {}
+    for st in tuple(prefix) + tuple(stages):
+        for a in st.axes:
+            if a not in axis_sizes:
+                axis_sizes[a] = lax.axis_size(a)
+    _, _, stack = _replay_sizes(prefix, like.size, axis_sizes)
+    cur = u_chunk
+    for st in stages:
+        if st.primitive != "allgather":
+            raise CompositionError(
+                f"{st.signature()}: only allgather stages run after the "
+                "sharded_update"
+            )
+        _, orig = stack.pop()
+        cur = staged_allgather(cur, st.axes, orig)
+    return cur.reshape(like.shape).astype(like.dtype)
+
+
+def reduce_composed_tree(leaves: list, comp: Composition, *, op="mean"):
+    """Reduce a LIST of leaves under ``comp``. The single-stage
+    ``ar(all)`` composition keeps the hand-wired list form (one fused
+    ``pmean`` over all leaves — ONE HLO all-reduce, the ParallelPlan's
+    pre-composition program, byte-identical); every other composition
+    pipelines each leaf's flat buffer through the executor (per-leaf
+    stage collectives — the documented cost of a scattered pipeline
+    without a packing layer, pinned in tests/test_composition.py)."""
+    from jax import lax
+
+    stages = comp.stages
+    if (len(stages) == 1 and stages[0].primitive == "allreduce"
+            and op == "mean"):
+        return lax.pmean(leaves, _axes_arg(stages[0].axes))
+    return [reduce_composed(g, comp, op=op) for g in leaves]
+
+
+__all__ = [
+    "Composition",
+    "CompositionError",
+    "PRIMITIVES",
+    "STAGE_HLO",
+    "Stage",
+    "bind_composition",
+    "canonical_axis_names",
+    "compile_schedule",
+    "derive_compositions",
+    "flat_composition",
+    "normalize_schedule_name",
+    "parse_signature",
+    "predicted_collectives",
+    "reduce_composed",
+    "reduce_composed_tree",
+    "run_gather_suffix",
+    "run_reduce_prefix",
+    "schedule_candidates",
+    "signature_for",
+    "stage_wire_layout",
+    "two_level_composition",
+    "validate_composition",
+    "zero_composition",
+]

@@ -103,6 +103,19 @@ class ParallelPlan:
         ICI-topology-aware via :func:`~chainermn_tpu.parallel.mesh.
         make_mesh` — on a pod slice the 2-D ``(dcn, ici)`` factorisation
         falls out of the canonical order.
+      grad_reduction: optional schedule for the data-parallel gradient
+        reduction of the non-ZeRO update groups — a menu name, a
+        composition signature, or a
+        :class:`~chainermn_tpu.parallel.composition.Composition` over
+        exactly this plan's dp axes (``data`` [+ ``zero``]), validated
+        at construction (ISSUE 12). Default ``None`` keeps the fused
+        ``pmean`` (byte-identical to the pre-composition plan; the
+        single-stage ``ar(all)`` composition compiles to the same
+        program). A composition with stages acts as a SPEC PROVIDER:
+        the affected axes' owed collectives in :meth:`describe` come
+        from its stage list
+        (:func:`~chainermn_tpu.parallel.plan_specs.
+        composition_collectives`).
     """
 
     def __init__(
@@ -110,6 +123,7 @@ class ParallelPlan:
         axes: Mapping[str, int] | Sequence[str],
         *,
         devices=None,
+        grad_reduction=None,
     ) -> None:
         if devices is None:
             devices = jax.devices()
@@ -152,6 +166,34 @@ class ParallelPlan:
                 f"given"
             )
         self.mesh = make_mesh(tuple(self.axes), shape, devices)
+        self._grad_comp = None
+        if grad_reduction is not None:
+            from chainermn_tpu.parallel.composition import compile_schedule
+
+            if not self.dp_axes:
+                raise ValueError(
+                    "grad_reduction= needs a data-parallel axis "
+                    "('data'/'zero') to reduce over; this plan has none"
+                )
+            comp = compile_schedule(grad_reduction, self.dp_axes)
+            if comp.has_update:
+                raise ValueError(
+                    f"grad_reduction composition {comp.signature()!r} "
+                    "carries a sharded_update stage — the sharded update "
+                    "is the 'zero' AXIS's job (add zero to the plan's "
+                    "axes); grad_reduction takes pure reductions"
+                )
+            self._grad_comp = comp
+            # The composition is the spec provider for the plain data
+            # axis: its owed collectives come from the stage list. The
+            # 'zero' axis keeps its own provider entry — the sharded
+            # update's per-leaf rs/ag is that axis's job regardless of
+            # how the replicated groups' gradients reduce.
+            owed = _ps.composition_collectives(comp)
+            if "data" in owed and "data" in self.axes:
+                self.axes["data"] = dataclasses.replace(
+                    self.axes["data"], collectives=owed["data"]
+                )
 
     # -- topology accessors -------------------------------------------------
 
@@ -172,12 +214,17 @@ class ParallelPlan:
 
     def describe(self) -> dict:
         """Axis sizes + the collectives each spec provider owes the step
-        (the dryrun/bench provenance line)."""
-        return {
+        (the dryrun/bench provenance line). A composed gradient
+        reduction reports its signature — the provenance names the
+        pipeline, not a menu label."""
+        out = {
             "mesh": {a: s.size for a, s in self.axes.items()},
             "collectives": _ps.owed_collectives(self.axes),
             "batch_spec": str(self.batch_spec()),
         }
+        if self._grad_comp is not None:
+            out["grad_reduction"] = self._grad_comp.signature()
+        return out
 
     # -- specs --------------------------------------------------------------
 
@@ -363,11 +410,13 @@ class ParallelPlan:
                     pipeline):
         from jax import shard_map
 
-        from chainermn_tpu.parallel.zero import (
-            zero_gather_updates,
-            zero_grad_scatter,
-            zero_param_chunk,
+        from chainermn_tpu.parallel.composition import (
+            reduce_composed_tree,
+            run_gather_suffix,
+            run_reduce_prefix,
+            zero_composition,
         )
+        from chainermn_tpu.parallel.zero import zero_param_chunk
         from chainermn_tpu.training.train_step import (
             TrainState,
             normalize_loss_fn,
@@ -376,6 +425,11 @@ class ParallelPlan:
         mesh = self.mesh
         dp_axes = self.dp_axes
         dp_total = self.dp_size
+        grad_comp = self._grad_comp
+        # the zero group's structural composition (scatter axis last in
+        # dp order — 'zero' — the other dp axes reduce the shard)
+        zero_comp = (zero_composition(dp_axes)
+                     if "zero" in self.axes else None)
         spec_tree = self.param_specs(params, param_specs)
         treedef = jax.tree.structure(params)
         flat_specs = jax.tree.leaves(spec_tree)
@@ -472,17 +526,22 @@ class ParallelPlan:
             flat_u: list = [None] * len(flat_p)
             new_opt = {}
 
-            # Stacked groups + plain replicated: pmean over the dp axes
-            # (TP/pipe leaves included — those axes are extra data
-            # parallelism for them; the model/pipe axes themselves are
-            # never reduced, the tensor/pipeline composition rule).
+            # Stacked groups + plain replicated: the dp-axes gradient
+            # reduction — the plan's grad_reduction composition when
+            # one is set, else the fused pmean (TP/pipe leaves included
+            # — those axes are extra data parallelism for them; the
+            # model/pipe axes themselves are never reduced, the
+            # tensor/pipeline composition rule).
             for grp in ("model", "pipe", "rep"):
                 idx = groups.get(grp)
                 if not idx:
                     continue
                 g = [flat_g[i] for i in idx]
                 if dp_axes:
-                    g = lax.pmean(g, dp_axes)
+                    if grad_comp is not None:
+                        g = reduce_composed_tree(g, grad_comp)
+                    else:
+                        g = lax.pmean(g, dp_axes)
                 p_sub = [flat_p[i] for i in idx]
                 st = new_in = state.opt_state[grp]
                 if grp != "rep":
@@ -494,16 +553,16 @@ class ParallelPlan:
                     flat_u[i] = ui
                 new_opt[grp] = st_out
 
-            # ZeRO group: reduce-scatter in, sharded 1/n update,
-            # all-gather out (the zero provider's owed collectives).
+            # ZeRO group: the composition rs(zero) > ar(other dp) >
+            # sharded_update > ag(zero) — the derived instance the
+            # hand-wired zero_grad_scatter/zero_gather_updates pair
+            # used to spell (identical primitives, identical counts),
+            # with the inner optimizer fused at the split point.
             idx = groups.get("zero")
             if idx:
-                other_dp = tuple(a for a in dp_axes if a != "zero")
+                zpre, zpost = zero_comp.split_update()
                 gch = [
-                    zero_grad_scatter(
-                        flat_g[i], "zero", extra_axes=other_dp,
-                        total=dp_total,
-                    )
+                    run_reduce_prefix(flat_g[i], zpre, total=dp_total)
                     for i in idx
                 ]
                 pch = [zero_param_chunk(flat_p[i], "zero") for i in idx]
@@ -513,7 +572,9 @@ class ParallelPlan:
                 uch, st_out = inner.update(gch, st, pch)
                 new_opt["zero"] = jax.tree.map(lambda e: e[None], st_out)
                 for i, uc in zip(idx, uch):
-                    flat_u[i] = zero_gather_updates(uc, flat_p[i], "zero")
+                    flat_u[i] = run_gather_suffix(
+                        uc, flat_p[i], zpost, zpre
+                    )
 
             updates_c = jax.tree.unflatten(treedef, flat_u)
             params_c2 = optax.apply_updates(params_c, updates_c)

@@ -7,8 +7,15 @@ HiCCL (arXiv:2408.05962) shows hierarchy-aware collective composition
 allreduce on multi-chip meshes, and Xu et al. (arXiv:2004.13336) show a
 reduce-scatter + sharded weight update strictly dominating replicated
 allreduce+update at data-parallel scale. This module gives the
-framework ONE schedule abstraction with three interchangeable,
-equivalence-tested strategies (``tests/test_reduction_schedule.py``):
+framework ONE schedule abstraction whose entries are DERIVED INSTANCES
+of the composition DSL (:mod:`chainermn_tpu.parallel.composition`,
+ISSUE 12): every spelling — a menu name below, a composition signature
+string, or a ``Composition`` — compiles through ``compile_schedule``
+and runs through the one staged executor ``reduce_composed``, and the
+autotuner's candidate set is the deriver's output for the world shape,
+not a fixed menu. The three named, equivalence-tested strategies
+(``tests/test_reduction_schedule.py``; derived sweep in
+``tests/test_composition.py``):
 
 - ``'flat'`` — the existing packed allreduce: float leaves ride ~64 MB
   flat buckets (the reference's ``_memory_utility.pack_params`` (dagger)
@@ -55,7 +62,10 @@ from chainermn_tpu.observability import trace as _trace
 
 PyTree = Any
 
-#: The interchangeable strategies (order = the registry's candidates).
+#: The NAMED strategies (the head of the registry's candidate list —
+#: the full choice set for a world shape is
+#: :func:`chainermn_tpu.parallel.composition.schedule_candidates`,
+#: which appends the derived beyond-menu composition signatures).
 SCHEDULES = ("flat", "two_level", "zero")
 
 #: Registry decision name for the ``'auto'`` schedule resolution.
@@ -115,19 +125,36 @@ def resolve_schedule(
     payload_bytes: int,
     world_shape: Sequence[int],
     *,
-    candidates: Sequence[str] = SCHEDULES,
+    candidates: Optional[Sequence[str]] = None,
 ):
     """The ``reduction_schedule='auto'`` resolution: winner through the
     autotune registry, keyed ``device_kind x (world-shape, payload-MB)
     x 'sched'`` (each dim power-of-two bucketed by ``decision_key``, so
     nearby payloads share one decision). Returns ``(winner, record)``
     with ``record`` the registry's decision provenance (name / winner /
-    source / key) for the observability layer. Table default is
-    ``'flat'``; a cache entry seeded from bench's ``overlap`` phase
-    rows (``python -m chainermn_tpu.tuning seed``) moves it where a
-    measured comparison shows another schedule paying."""
-    from chainermn_tpu import tuning
+    source / key, plus ``composition`` — the canonical-token signature
+    the winner compiles to, so provenance names the actual pipeline and
+    not just a menu label) for the observability layer.
 
+    ``candidates`` defaults to the DERIVED choice set for this world
+    shape (:func:`~chainermn_tpu.parallel.composition.
+    schedule_candidates`): the menu names plus every composition the
+    deriver generates for a ``len(world_shape)``-level mesh, keyed by
+    signature string — the autotuner searches generated schedules, not
+    a fixed menu. Table default is ``'flat'``; a cache entry seeded
+    from bench's ``overlap``/``composed`` phase rows
+    (``python -m chainermn_tpu.tuning seed``) moves it where a measured
+    comparison shows another pipeline paying (spread-gated, as always).
+    """
+    from chainermn_tpu import tuning
+    from chainermn_tpu.parallel.composition import (
+        schedule_candidates,
+        signature_for,
+    )
+
+    n_axes = max(1, len(tuple(world_shape)))
+    if candidates is None:
+        candidates = schedule_candidates(n_axes)
     mb = max(1, int(payload_bytes) >> 20)
     key = tuning.decision_key(
         device_kind, shape=tuple(int(d) for d in world_shape) + (mb,),
@@ -139,13 +166,19 @@ def resolve_schedule(
          if d.get("name") == DECISION and d.get("key") == key),
         None,
     )
+    if rec is not None:
+        rec = dict(rec)
+        try:
+            rec["composition"] = signature_for(winner, n_axes)
+        except Exception:
+            pass
     return winner, rec
 
 
 def reduce_tree(
     grads: PyTree,
     *,
-    schedule: str,
+    schedule,
     axes,
     compress_dtype=None,
     bucket_bytes: Optional[int] = None,
@@ -159,40 +192,75 @@ def reduce_tree(
     probe ``collectives.axes_bound`` and fall back to their legacy
     identity/pmean path outside it — this function does not degrade).
 
-    Leaves are grouped by wire dtype and packed into ~``bucket_bytes``
-    flat buffers (:func:`bucket_partition`); each bucket crosses the
-    wire as ONE collective pipeline chosen by ``schedule``:
-
-    - ``'flat'``: fused ``pmean`` (or the int8 two-phase wire);
-    - ``'two_level'``: :func:`~chainermn_tpu.parallel.collectives.decomposed_allreduce`
-      (reduce-scatter over the last axis -> shard allreduce over the
-      rest -> all-gather), int8 riding only the non-scatter stage.
+    ``schedule`` is a menu name (``'flat'`` / ``'two_level'``), a
+    composition signature string, or a
+    :class:`~chainermn_tpu.parallel.composition.Composition` — every
+    spelling is COMPILED to a validated composition
+    (:func:`~chainermn_tpu.parallel.composition.compile_schedule`) and
+    run through the one staged executor
+    (:func:`~chainermn_tpu.parallel.composition.reduce_composed`), so
+    the menu entries are derived instances, not separate code paths
+    (``'flat'`` = ``ar(all)``, one fused pmean per bucket;
+    ``'two_level'`` = ``rs(fast) > ar(rest) > ag(fast)``, the pinned
+    hierarchical pipeline). Leaves are grouped by wire dtype and packed
+    into ~``bucket_bytes`` flat buffers (:func:`bucket_partition`);
+    each bucket crosses the wire as that composition's stage pipeline.
+    The int8 wire is a WIRE variant, not a schedule: it has a flat and
+    a two-level rendering only (the two-phase quantized scheme has no
+    generic staged form), and any other composition on an int8 wire is
+    refused loudly.
 
     Zero-size leaves take the exact per-leaf path (see
     :func:`bucket_partition`'s edge contract). At TRACE time (host-side
     Python, once per compilation — the lowered HLO is untouched) one
-    ``pack`` event plus one ``wire`` event PER BUCKET are recorded:
-    the wire events carry ``overlapped`` (true under the
-    double-buffered mode, whose update consumes the PREVIOUS step's
-    buckets — the dependency break that lets the runtime run these
-    collectives concurrently with compute) so ``tools/trace_report.py``
-    can attribute comm time to the overlap.
+    ``pack`` event plus one ``wire`` event PER BUCKET PER STAGE are
+    recorded: each wire event carries the bucket's ``composition``
+    signature, its ``stage`` (e.g. ``rs(intra)``) and that stage's
+    payload bytes, plus ``overlapped`` (true under the double-buffered
+    mode, whose update consumes the PREVIOUS step's buckets — the
+    dependency break that lets the runtime run these collectives
+    concurrently with compute) so ``tools/trace_report.py`` can
+    attribute comm time per composition stage.
     """
-    if schedule not in ("flat", "two_level"):
-        raise ValueError(
-            f"reduce_tree handles 'flat'/'two_level', got {schedule!r} "
-            "('zero' is structural — see MultiNodeOptimizer)"
-        )
     from chainermn_tpu.parallel.collectives import (
-        decomposed_allreduce,
         int8_allreduce_mean,
         int8_decomposed_allreduce_mean,
         _names_tuple,
     )
+    from chainermn_tpu.parallel.composition import (
+        CompositionError,
+        compile_schedule,
+        reduce_composed,
+        stage_wire_layout,
+        two_level_composition,
+    )
 
     names = _names_tuple(axes)
+    try:
+        comp = compile_schedule(schedule, names)
+    except CompositionError as e:
+        raise ValueError(str(e)) from None
+    if comp.has_update:
+        valid = tuple(s for s in SCHEDULES if s != "zero")
+        raise ValueError(
+            f"reduce_tree runs the pure reduction schedules {valid} (or "
+            f"any validated composition without a sharded_update stage), "
+            f"got {schedule!r} — the sharded update is structural, see "
+            "MultiNodeOptimizer's 'zero' schedule"
+        )
+    label = (schedule if isinstance(schedule, str) and "(" not in schedule
+             else comp.signature())
+    sig = comp.signature()
     int8_wire = (compress_dtype is not None
                  and jnp.dtype(compress_dtype) == jnp.dtype(jnp.int8))
+    flat_sig = compile_schedule("flat", names).signature()
+    two_level_sig = two_level_composition(names).signature()
+    if int8_wire and sig not in (flat_sig, two_level_sig):
+        raise ValueError(
+            f"the int8 two-phase wire has flat and two-level renderings "
+            f"only — composition {sig!r} cannot ride it; use the bf16/f32 "
+            "wire for composed schedules"
+        )
     leaves, treedef = jax.tree.flatten(grads)
     if not leaves:
         return grads
@@ -220,16 +288,18 @@ def reduce_tree(
 
     def reduce_bucket(flat, dt):
         if int8_wire and jnp.issubdtype(dt, jnp.floating):
-            if schedule == "two_level":
+            # The quantized wire's rendering is chosen by the
+            # composition's SHAPE: a scatter stage means the int8
+            # phases ride only the non-scatter axes.
+            if sig == two_level_sig:
                 return int8_decomposed_allreduce_mean(flat, names)
             return int8_allreduce_mean(flat, names)
-        if schedule == "two_level":
-            return decomposed_allreduce(flat, names, op="mean")
-        return lax.pmean(flat, names)
+        return reduce_composed(flat, comp, op="mean")
 
     rec = _trace.active()
     n_buckets_total = 0
-    bucket_meta: list[tuple[int, str]] = []  # (wire nbytes, dtype name)
+    # (bucket wire bytes, dtype name, element count) per bucket
+    bucket_meta: list[tuple[int, str, int]] = []
     for dt, idxs in groups.items():
         itemsize = jnp.dtype(dt).itemsize
         wire_item = (1 if int8_wire and jnp.issubdtype(dt, jnp.floating)
@@ -254,7 +324,9 @@ def reduce_tree(
                     .astype(leaves[i].dtype)
                 )
                 off += n
-            bucket_meta.append((flat.size * wire_item, jnp.dtype(dt).name))
+            bucket_meta.append(
+                (flat.size * wire_item, jnp.dtype(dt).name, flat.size)
+            )
 
     if rec is not None:
         def wire_itemsize(g):
@@ -266,7 +338,7 @@ def reduce_tree(
                      (jnp.dtype(compress_dtype).name
                       if compress_dtype is not None else "none"))
         rec.event(
-            "pack", op=(op or f"scheduled_reduce[{schedule}]"),
+            "pack", op=(op or f"scheduled_reduce[{label}]"),
             nbytes=sum(g.size * wire_itemsize(g) for g in leaves),
             bucket_bytes=(bucket_bytes if bucket_bytes is not None
                           else DEFAULT_BUCKET_BYTES),
@@ -275,14 +347,21 @@ def reduce_tree(
             provenance=provenance,
             **({"size": size} if size is not None else {}),
         )
-        for b_i, (nbytes, dt_name) in enumerate(bucket_meta):
-            rec.event(
-                "wire", schedule=schedule, bucket=b_i,
-                n_buckets=n_buckets_total, nbytes=nbytes,
-                wire_dtype=("int8" if int8_wire and "float" in dt_name
-                            else dt_name),
-                overlapped=bool(overlapped),
-            )
+        axis_sizes = {a: lax.axis_size(a) for a in names}
+        for b_i, (nbytes, dt_name, n_elems) in enumerate(bucket_meta):
+            wire_item = max(1, nbytes // max(1, n_elems))
+            for s_i, row in enumerate(
+                stage_wire_layout(comp, axis_sizes, wire_item, n_elems)
+            ):
+                rec.event(
+                    "wire", schedule=label, composition=sig,
+                    stage=row["stage"], stage_index=s_i,
+                    stage_op=row["op"], bucket=b_i,
+                    n_buckets=n_buckets_total, nbytes=row["nbytes"],
+                    wire_dtype=("int8" if int8_wire and "float" in dt_name
+                                else dt_name),
+                    overlapped=bool(overlapped),
+                )
     return jax.tree.unflatten(treedef, out)
 
 

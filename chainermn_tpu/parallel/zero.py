@@ -52,15 +52,22 @@ from chainermn_tpu.parallel.collectives import (
     _names_tuple as _names,
     axes_index as _group_index,
     axes_size as _group_size,
+    two_level_shard_len as _shard_len,
 )
 
 PyTree = Any
 
 
 def _chunk_rows(x: jax.Array, n: int) -> jax.Array:
-    """Flatten ``x`` and pad so it splits into ``n`` equal rows [n, c]."""
+    """Flatten ``x`` and pad so it splits into ``n`` equal rows [n, c].
+
+    The row length comes from ``collectives.two_level_shard_len`` — the
+    ONE owner of the ceil-pad rule, shared with the staged composition
+    primitives (``staged_reduce_scatter``): the ZeRO path pairs grad
+    chunks from the composed scatter with param chunks from here, and
+    the pairing is only correct while both read the same rule."""
     flat = x.reshape(-1)
-    c = -(-flat.size // n)  # ceil
+    c = _shard_len(flat.size, n)
     return jnp.pad(flat, (0, n * c - flat.size)).reshape(n, c)
 
 

@@ -105,11 +105,87 @@ def create_train_state(
         opt_state = opt_state._replace(
             residual=jax.tree.map(stack, opt_state.residual)
         )
-    return TrainState(
+    state = TrainState(
         params=params,
         opt_state=opt_state,
         step=jnp.zeros((), jnp.int32),
         model_state=model_state,
+    )
+    if comm is not None:
+        state = _place_state(state, optimizer, comm)
+    return state
+
+
+def _train_state_spec(optimizer, comm):
+    """The :class:`TrainState` prefix-spec the jitted step carries
+    (``P()`` when fully replicated) — ONE owner shared by
+    ``make_train_step`` (shard_map in/out specs) and
+    ``create_train_state`` (initial placement): the state is created
+    already laid out exactly as the compiled step expects, so the
+    second step cannot recompile on a committed-ness change — step
+    compiles stay pinned at 1 (the ISSUE 12 dryrun's trainer pin)."""
+    if getattr(optimizer, "error_feedback", False):
+        # The EF residual is PER-RANK state: stacked [n_slots, ...] over
+        # the COMMUNICATOR's grad axes (the layout create_train_state
+        # initialises), the rest replicated.
+        return TrainState(
+            params=P(),
+            opt_state=_ErrorFeedbackState(
+                inner=P(), residual=P(comm.grad_axes)
+            ),
+            step=P(),
+            model_state=P(),
+        )
+    # Schedule-aware state carry: a 'zero' reduction schedule's
+    # optimizer state is 1/n per shard (stacked [n, ...] leaves) — the
+    # optimizer publishes the prefix spec and the step threads it, the
+    # same honest-sharding pattern as the EF residual.
+    opt_spec = P()
+    spec_fn = getattr(optimizer, "opt_state_spec", None)
+    if spec_fn is not None:
+        opt_spec = spec_fn()
+    if opt_spec != P():
+        return TrainState(
+            params=P(), opt_state=opt_spec, step=P(), model_state=P()
+        )
+    return P()
+
+
+def _place_state(state: "TrainState", optimizer, comm) -> "TrainState":
+    """Commit every state leaf to ``comm.mesh`` per the step's own spec
+    (:func:`_train_state_spec`): already-placed leaves (bcast params,
+    the EF residual's sharded stack) pass through untouched, everything
+    else lands replicated (or per its prefix spec). Placement at
+    creation time is what pins the step's jit cache at 1 — an
+    uncommitted opt_state would compile once unspecified and once
+    committed. Multi-process meshes are left alone: ``device_put`` of a
+    host array onto non-addressable devices is not a local operation
+    (the 4-proc scaling rehearsal caught a gloo wire fault from it) —
+    there the jit boundary keeps owning placement, at the documented
+    cost of its one extra compile."""
+    mesh_devices = comm.mesh.devices.flat
+    try:
+        pidx = jax.process_index()
+    except Exception:
+        return state
+    if any(d.process_index != pidx for d in mesh_devices):
+        return state
+    spec = _train_state_spec(optimizer, comm)
+
+    def put(x, s):
+        if not isinstance(x, (jax.Array, np.ndarray)):
+            return x  # exotic leaf: leave its semantics alone
+        sharding = NamedSharding(comm.mesh, s)
+        if isinstance(x, jax.Array) and x.sharding == sharding:
+            return x  # already placed (no copy)
+        return jax.device_put(jnp.asarray(x), sharding)
+
+    if isinstance(spec, P):
+        return jax.tree.map(lambda x: put(x, spec), state)
+    # prefix tree: broadcast each P leaf over its state subtree
+    return jax.tree.map(
+        lambda s, sub: jax.tree.map(lambda x: put(x, s), sub),
+        spec, state, is_leaf=lambda s: isinstance(s, P),
     )
 
 
@@ -229,29 +305,10 @@ def make_train_step(
     # of the state uses. The optimizer sees a single layout: local_step
     # squeezes the per-slot [1, ...] slice around opt.update.
     ef = getattr(optimizer, "error_feedback", False)
-    state_spec: Any = P()
-    if ef:
-        state_spec = TrainState(
-            params=P(),
-            opt_state=_ErrorFeedbackState(
-                inner=P(), residual=P(comm.grad_axes)
-            ),
-            step=P(),
-            model_state=P(),
-        )
-    else:
-        # Schedule-aware state carry: a 'zero' reduction schedule's
-        # optimizer state is 1/n per shard (stacked [n, ...] leaves) —
-        # the optimizer publishes the prefix spec and the step threads
-        # it, the same honest-sharding pattern as the EF residual.
-        opt_spec = P()
-        spec_fn = getattr(optimizer, "opt_state_spec", None)
-        if spec_fn is not None:
-            opt_spec = spec_fn()
-        if opt_spec != P():
-            state_spec = TrainState(
-                params=P(), opt_state=opt_spec, step=P(), model_state=P()
-            )
+    # One owner for the state layout (_train_state_spec): the same spec
+    # create_train_state places the initial state with, so the compiled
+    # step's inputs arrive exactly as laid out — no second compile.
+    state_spec: Any = _train_state_spec(optimizer, comm)
 
     _loss_with_aux = normalize_loss_fn(loss_fn)
 

@@ -244,6 +244,43 @@ def _seed_one_result(result: dict, source: str, out: list,
                                    for k, v in sched_ms.items()},
                  "spread_pct": spread})
 
+    # Composed schedules (ISSUE 12): bench's ``composed`` phase sweeps
+    # the DERIVED composition list on the multi-level factoring of the
+    # mesh (rows keyed by composition signature string) — same decision
+    # name, its own world-shape key (e.g. (2,2,2) vs the flat (8,)), so
+    # the flat-mesh 'overlap' entry and the 3-level one coexist. Spread-
+    # gated through measure.decide like every adoption.
+    comp_ms = result.get("composed_schedule_ms")
+    if isinstance(comp_ms, dict) and len(comp_ms) >= 2 and all(
+        isinstance(v, (int, float)) for v in comp_ms.values()
+    ):
+        from chainermn_tpu.parallel.composition import (
+            normalize_schedule_name,
+        )
+        from chainermn_tpu.tuning.measure import decide
+
+        n_axes = len(result.get("composed_world_shape") or (1, 1, 1))
+        # The registry's candidate spelling: menu-instance signatures
+        # (the derived flat/two_level) adopt by MENU NAME — a signature
+        # winner the candidate list excludes would be silently
+        # discarded at choice() time and the table default would win.
+        comp_ms = {normalize_schedule_name(k, n_axes): v
+                   for k, v in comp_ms.items()}
+        spread = float(result.get("composed_spread_pct", 0.0))
+        winner = decide(comp_ms, {k: spread for k in comp_ms})
+        if winner is not None:
+            world = result.get("composed_world_shape") or [
+                result.get("n_devices", 1)
+            ]
+            payload_mb = result.get("composed_payload_mb", 1)
+            key = _bucketed_key(
+                kind, tuple(world) + (payload_mb,), "sched"
+            )
+            put("reduction_schedule", key, winner,
+                {"candidates_ms": {k: round(float(v), 4)
+                                   for k, v in comp_ms.items()},
+                 "spread_pct": spread})
+
     # Serving decode decisions (ISSUE 4/5/7): bench's ``serving`` and
     # ``serving_prefix`` phases record per-candidate medians keyed by
     # the engine's own decision key material (``serving_model_shape``

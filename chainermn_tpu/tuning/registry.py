@@ -51,7 +51,12 @@ from chainermn_tpu.tuning import measure as _measure
 #:   so the pinned ``two_level``/``zero`` pipelines must EARN their
 #:   extra program structure with a bench ``overlap``-phase win
 #:   (seeded from BENCH_DETAILS.json ``overlap_schedule_ms`` rows; see
-#:   chainermn_tpu.parallel.reduction_schedule).
+#:   chainermn_tpu.parallel.reduction_schedule). The choice set is the
+#:   DERIVED composition list for the world shape (ISSUE 12:
+#:   composition.schedule_candidates — menu names + signature-keyed
+#:   derived pipelines, swept by bench's ``composed`` phase and seeded
+#:   from its ``composed_schedule_ms`` rows, spread-gated as always);
+#:   the ``flat`` table default stays the no-evidence answer.
 #: - ``decode_impl`` (serving steady-state step): ``paged`` everywhere
 #:   — the idle-box CPU-proxy point measured paged 0.95 ms vs dense
 #:   1.38 ms/step (D64xH4xL64, gap outside the 17.5% spread), and on

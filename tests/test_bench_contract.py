@@ -523,6 +523,90 @@ def test_compact_overflow_sheds_newest_keys_with_marker(tmp_path,
     assert parsed2["serving_cluster_spread_pct"] == 2.0
 
 
+def test_composed_rows_contract_and_seeding(tmp_path, monkeypatch):
+    """ISSUE 12 satellite: the ``composed`` phase's headline rows ride
+    the compact line (best-vs-two_level ratio + spread gate + selected
+    pipeline), the phase is wired into the supplementary chain, and
+    ``tuning seed`` learns the 3-level ``reduction_schedule`` decision
+    from the signature-keyed ``composed_schedule_ms`` rows — spread-
+    gated exactly like the in-run adoption, under its own world-shape
+    key so the flat-mesh ``overlap`` entry is untouched."""
+    for k in ("composed_best_vs_two_level", "composed_spread_pct",
+              "composed_selected"):
+        assert k in bench._COMPACT_KEYS, k
+    assert callable(bench._bench_composed)
+    import inspect
+
+    src = inspect.getsource(bench._run_bench)
+    assert 'supp("composed", "composed_error"' in src
+
+    from chainermn_tpu.tuning.cache import seed_from_bench_details
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    ladder = "rs(a2)>rs(a1)>ar(a0)>ag(a1)>ag(a2)"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-04T00:00:00Z",
+        "composed_schedule_ms": {
+            "ar(a0+a1+a2)": 4.0,
+            "rs(a2)>ar(a0+a1)>ag(a2)": 3.5,
+            ladder: 2.0,
+        },
+        "composed_spread_pct": 5.0,
+        "composed_world_shape": [2, 2, 2],
+        "composed_payload_mb": 3,
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    # keyed by the 3-level world shape + payload bucket, winner = the
+    # ladder SIGNATURE (a pipeline the old menu could not express)
+    assert (f"reduction_schedule|TPU v5 lite|2x2x2x4|sched -> {ladder}"
+            in seeded)
+
+    # ...and the seeded entry is exactly what resolve_schedule's
+    # derived candidate set resolves for that world shape (conftest
+    # pins the registry to 'off' for hermeticity — 'table' still
+    # consults the cache, like every non-off mode).
+    from chainermn_tpu.parallel.reduction_schedule import resolve_schedule
+
+    monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE", "table")
+    monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_CACHE", str(cache))
+    winner, rec = resolve_schedule("TPU v5 lite", 3 << 20, (2, 2, 2))
+    assert winner == ladder
+    assert rec["source"].startswith("cache")
+    assert rec["composition"] == ladder
+
+    # a winner that IS a menu instance adopts by MENU NAME — stored
+    # under its signature the candidate list would never match it and
+    # choice() would silently fall back to the table default (review
+    # finding, pinned here): two_level's derived signature wins ->
+    # entry winner 'two_level', and resolve_schedule returns it.
+    cache3 = tmp_path / "cache3.json"
+    doc["composed_schedule_ms"] = {
+        "ar(a0+a1+a2)": 4.0,
+        "rs(a2)>ar(a0+a1)>ag(a2)": 2.0,
+        ladder: 3.5,
+    }
+    doc["composed_spread_pct"] = 5.0
+    details.write_text(json.dumps(doc))
+    seeded3 = "\n".join(seed_from_bench_details(str(details), str(cache3)))
+    assert ("reduction_schedule|TPU v5 lite|2x2x2x4|sched -> two_level"
+            in seeded3)
+    monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_CACHE", str(cache3))
+    winner3, rec3 = resolve_schedule("TPU v5 lite", 3 << 20, (2, 2, 2))
+    assert winner3 == "two_level"
+    assert rec3["composition"] == "rs(a2)>ar(a0+a1)>ag(a2)"
+
+    # a spread-dominated sweep refuses to pin a winner
+    doc["composed_schedule_ms"] = {ladder: 2.0, "ar(a0+a1+a2)": 2.05}
+    doc["composed_spread_pct"] = 10.0
+    details.write_text(json.dumps(doc))
+    assert "reduction_schedule" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache.with_suffix(".2")))
+    )
+
+
 def test_plan_rows_contract():
     """ISSUE 10 satellite: the ``plan`` bench phase's headline rows ride
     the compact line (hand-wired vs plan-compiled ratio + spread gate),

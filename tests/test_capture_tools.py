@@ -162,6 +162,26 @@ def _golden_trace_lines():
          "schedule": "overlap_eager", "bucket": 1, "n_buckets": 2,
          "nbytes": 4096, "dur_s": 0.003, "blocked_s": 0.003,
          "overlapped": False},
+        # ISSUE 12: one composed-schedule bucket — per-STAGE wire
+        # events carrying the composition signature (rs -> ar -> ag:
+        # the scatter and gather carry the full bucket, the shard
+        # allreduce 1/4 of it), grouped by signature in the overlap
+        # section's per-stage table.
+        {"schema": 1, "kind": "wire", "t": 2.12, "pid": 1, "rank": 0,
+         "schedule": "two_level", "composition": "rs(a1)>ar(a0)>ag(a1)",
+         "stage": "rs(a1)", "stage_index": 0, "stage_op": "reduce-scatter",
+         "bucket": 0, "n_buckets": 1, "nbytes": 2048,
+         "wire_dtype": "bfloat16", "overlapped": False},
+        {"schema": 1, "kind": "wire", "t": 2.13, "pid": 1, "rank": 0,
+         "schedule": "two_level", "composition": "rs(a1)>ar(a0)>ag(a1)",
+         "stage": "ar(a0)", "stage_index": 1, "stage_op": "all-reduce",
+         "bucket": 0, "n_buckets": 1, "nbytes": 512,
+         "wire_dtype": "bfloat16", "overlapped": False},
+        {"schema": 1, "kind": "wire", "t": 2.14, "pid": 1, "rank": 0,
+         "schedule": "two_level", "composition": "rs(a1)>ar(a0)>ag(a1)",
+         "stage": "ag(a1)", "stage_index": 2, "stage_op": "all-gather",
+         "bucket": 0, "n_buckets": 1, "nbytes": 2048,
+         "wire_dtype": "bfloat16", "overlapped": False},
         # ISSUE 4: one request through the serving scheduler — queue
         # wait, bucketed prefill (its sampled token counts as generated;
         # ttft_s = submit -> first token, ISSUE 5), three decode steps
@@ -247,7 +267,7 @@ def test_trace_report_contract(tmp_path):
         "schema_versions": [1],
         "meta": {"started_at": "2026-08-03T00:00:00Z", "sync": False,
                  "source": "bench"},
-        "n_events": 26,  # torn tail line skipped, not fatal
+        "n_events": 29,  # torn tail line skipped, not fatal
         "collectives": [
             {"op": "allreduce_grad", "plane": "device", "n": 2,
              "total_bytes": 2000, "total_s": 0.004, "mean_ms": 2.0,
@@ -276,6 +296,20 @@ def test_trace_report_contract(tmp_path):
                         "schedule": "two_level", "donate": True}],
             "schedules": {"two_level": {"buckets": 1, "nbytes": 1000,
                                         "overlapped": 1}},
+            # ISSUE 12: the composed bucket's per-stage table, grouped
+            # by composition signature (2048 + 512 + 2048 wire bytes
+            # over the three stages of one bucket).
+            "compositions": {"rs(a1)>ar(a0)>ag(a1)": {
+                "schedule": "two_level", "buckets": 1, "nbytes": 4608,
+                "overlapped": 0,
+                "stages": {
+                    "rs(a1)": {"op": "reduce-scatter", "n": 1,
+                               "nbytes": 2048},
+                    "ar(a0)": {"op": "all-reduce", "n": 1, "nbytes": 512},
+                    "ag(a1)": {"op": "all-gather", "n": 1,
+                               "nbytes": 2048},
+                },
+            }},
             "measured": {"n": 2, "comm_ms_total": 8.0,
                          "comm_ms_blocked": 4.0, "comm_ms_hidden": 4.0,
                          "hidden_fraction": 0.5},
@@ -332,7 +366,7 @@ def test_trace_report_contract(tmp_path):
     }, summary
     # chrome export emitted alongside
     chrome = _json.loads(chrome_file.read_text())
-    assert len(chrome["traceEvents"]) == 25  # meta excluded
+    assert len(chrome["traceEvents"]) == 28  # meta excluded
     # and the human rendering mentions the essentials
     proc2 = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
@@ -342,6 +376,10 @@ def test_trace_report_contract(tmp_path):
     assert proc2.returncode == 0
     for token in ("allreduce_grad", "STRAGGLER", "allreduce_wire=bf16",
                   "comm/compute overlap", "50.0% hidden",
+                  "composed rs(a1)>ar(a0)>ag(a1) [two_level]: "
+                  "1 bucket(s), 4.5 KiB wire",
+                  "rs(a1) [reduce-scatter]: n=1, 2.0 KiB",
+                  "ar(a0) [all-reduce]: n=1, 512 B",
                   "serving (continuous batching)", "tokens/s: 227.27",
                   "p50 4.000 ms, p99 6.000 ms", "33.3% mean",
                   "TTFT: p50 12.000 ms, p99 12.000 ms",

@@ -439,8 +439,9 @@ class TestStructural:
         assert not counts.get("psum")               # no grad all-reduce
 
     def test_wire_events_record_bucket_layout_and_overlap_flag(self, comm):
-        """Per-bucket trace-time wire events: schedule, bucket count,
-        wire bytes, and overlapped=True exactly under double buffering."""
+        """Per-bucket, per-STAGE trace-time wire events: schedule label,
+        composition signature, stage payload bytes, and overlapped=True
+        exactly under double buffering."""
         from chainermn_tpu.testing import count_primitives
 
         rec = trace.enable(None)
@@ -453,10 +454,16 @@ class TestStructural:
             tree, axis_env=env,
         )
         wires = [e for e in rec.events if e["kind"] == "wire"]
-        assert len(wires) == 1
-        assert wires[0]["schedule"] == "two_level"
-        assert wires[0]["nbytes"] == (64 * 32 + 32) * 2
-        assert wires[0]["overlapped"] is False
+        # on the flat mesh two_level IS rs(data)>ag(data): one wire
+        # event per stage, both carrying the composition signature
+        assert len(wires) == 2
+        assert [w["stage"] for w in wires] == ["rs(data)", "ag(data)"]
+        assert all(w["schedule"] == "two_level" for w in wires)
+        assert all(w["composition"] == "rs(data)>ag(data)" for w in wires)
+        # both stages carry the full bucket payload (in / out of the
+        # scatter frame) on the bf16 wire
+        assert all(w["nbytes"] == (64 * 32 + 32) * 2 for w in wires)
+        assert all(w["overlapped"] is False for w in wires)
 
         # the double-buffered optimizer tags its buckets overlapped
         opt = create_multi_node_optimizer(

@@ -21,12 +21,15 @@ Sections:
 - **overlap** — comm/compute overlap (ISSUE 3): the step's overlap
   configuration (``overlap_config`` events — double-buffering
   staleness, reduction schedule, donation), the per-bucket ``wire``
-  layout the compiled schedules committed to, and — where measured
-  wire events exist (the eager ``OverlappedBucketReducer``; dur =
-  dispatch->ready, blocked = wait actually paid at collect) — per-step
-  comm time vs comm time hidden behind compute and the
-  ``hidden_fraction`` between them. Omitted when the trace carries no
-  overlap events.
+  layout the compiled schedules committed to, the COMPOSED schedules
+  grouped by composition signature with a per-stage bytes/time table
+  (ISSUE 12: wire events carrying ``composition``/``stage`` fields —
+  one row per ``rs``/``ar``/``ag`` stage of the derived pipeline), and
+  — where measured wire events exist (the eager
+  ``OverlappedBucketReducer``; dur = dispatch->ready, blocked = wait
+  actually paid at collect) — per-step comm time vs comm time hidden
+  behind compute and the ``hidden_fraction`` between them. Omitted
+  when the trace carries no overlap events.
 - **serving** — continuous-batching accounting (ISSUE 4) from the
   scheduler's ``serving`` events: requests/tokens served, tokens/s over
   device-busy time, nearest-rank p50/p99 per-token latency (one decode
@@ -328,6 +331,20 @@ def render_text(s: dict) -> str:
                 f"{_fmt_bytes(row['nbytes'])} wire, "
                 f"{row['overlapped']} overlapped"
             )
+        for sig, row in ov.get("compositions", {}).items():
+            lines.append(
+                f"  composed {sig} [{row['schedule']}]: "
+                f"{row['buckets']} bucket(s), "
+                f"{_fmt_bytes(row['nbytes'])} wire, "
+                f"{row['overlapped']} overlapped"
+            )
+            for st, srow in row.get("stages", {}).items():
+                dur = (f", {srow['dur_ms']:.3f} ms"
+                       if srow.get("dur_ms") is not None else "")
+                lines.append(
+                    f"    {st} [{srow.get('op')}]: n={srow['n']}, "
+                    f"{_fmt_bytes(srow['nbytes'])}{dur}"
+                )
         m = ov.get("measured")
         if m:
             lines.append(
