@@ -1,4 +1,5 @@
-"""ParallelPlan — one global-view mesh program for DP x TP x ZeRO x pipeline.
+"""ParallelPlan — one global-view mesh program for
+DP x TP x ZeRO x pipeline x sequence.
 
 The reference's training stack was per-process communicator-style: every
 parallel form was a wrapper at the call site (``communicators/`` (dagger),
@@ -25,7 +26,28 @@ per-axis modules participate as *spec providers*
   ``reduce_from_tp`` adjoint pairs, one psum per column->row pair;
 - ``pipe`` — GPipe micro-batch pipelining
   (:mod:`chainermn_tpu.parallel.pipeline`): stage leaves stack
-  ``[n_stages, ...]``, the conveyor's ppermute rides the schedule.
+  ``[n_stages, ...]``, the conveyor's ppermute rides the schedule;
+- ``seq`` — sequence/context parallelism (ISSUE 13): the batch's
+  sequence dim shards over it (``batch_spec`` appends it after the dp
+  axes), attention routes through the ring
+  (:func:`~chainermn_tpu.parallel.ring_attention.
+  seq_ring_attention_local` — ``n - 1`` ppermutes per layer per forward
+  pass) or Ulysses (:mod:`chainermn_tpu.parallel.ulysses` — two
+  all_to_alls in, one out) via the ``seq_attn_impl`` tuning decision
+  (:meth:`ParallelPlan.seq_attention`), and gradients take one extra
+  all-reduce over the axis (mean over token shards) before the dp
+  reduction.
+
+Two composed forms ride the same contract (ISSUE 13 sweep-ins):
+``zero_stacked_groups=True`` chunks the STACKED groups' optimizer state
+over the ``zero`` axis too (TP x ZeRO — the arXiv:2004.13336
+cross-replica update sharding applied per TP/pipe shard: the stacked
+groups' dp gradient mean becomes the same rs > ar > update > ag
+pipeline the zero group runs, identical wire bytes); and a leaf spec
+``P('pipe', 'model')`` stacks a leaf over BOTH axes (the pipe x model
+composed plan — stage slices that are themselves tensor-parallel,
+``stage_fn`` written with the :mod:`~chainermn_tpu.parallel.tensor`
+helpers).
 
 Buffer donation is threaded through the compiled step by construction
 (``donate_argnums=(0,)`` on the whole :class:`TrainState`): step ``t+1``
@@ -116,6 +138,13 @@ class ParallelPlan:
         from its stage list
         (:func:`~chainermn_tpu.parallel.plan_specs.
         composition_collectives`).
+      zero_stacked_groups: chunk the STACKED groups' (``model``/``pipe``)
+        optimizer state over the ``zero`` axis too (ISSUE 13 — TP x ZeRO
+        per arXiv:2004.13336): their dp gradient mean becomes the zero
+        composition's rs > ar > sharded-update > ag per leaf (same wire
+        bytes), state leaves stack ``[n_stack, n_zero, ...]``. Requires
+        a ``zero`` axis and at least one stacked axis; mutually
+        exclusive with ``grad_reduction=``.
     """
 
     def __init__(
@@ -124,6 +153,7 @@ class ParallelPlan:
         *,
         devices=None,
         grad_reduction=None,
+        zero_stacked_groups: bool = False,
     ) -> None:
         if devices is None:
             devices = jax.devices()
@@ -166,6 +196,30 @@ class ParallelPlan:
                 f"given"
             )
         self.mesh = make_mesh(tuple(self.axes), shape, devices)
+        #: decision records the plan resolved (``seq_attn_impl``
+        #: provenance — the dryrun/bench line and tests read it; same
+        #: shape as ``ServingEngine.decisions``).
+        self.decisions: list[dict] = []
+        self._seq_impl: Optional[str] = None
+        self._zsg = bool(zero_stacked_groups)
+        if self._zsg:
+            if "zero" not in self.axes:
+                raise ValueError(
+                    "zero_stacked_groups=True needs a 'zero' axis to "
+                    "chunk the stacked groups' state over"
+                )
+            if not any(s.stacked for s in self.axes.values()):
+                raise ValueError(
+                    "zero_stacked_groups=True needs a stacked axis "
+                    "('model'/'pipe') whose state it can chunk — a plain "
+                    "zero plan already chunks everything"
+                )
+            if grad_reduction is not None:
+                raise ValueError(
+                    "zero_stacked_groups and grad_reduction= are "
+                    "mutually exclusive: the stacked groups' reduction "
+                    "IS the zero composition (rs > ar > update > ag)"
+                )
         self._grad_comp = None
         if grad_reduction is not None:
             from chainermn_tpu.parallel.composition import compile_schedule
@@ -210,6 +264,11 @@ class ParallelPlan:
         return math.prod(self.axis_size(a) for a in self.dp_axes) or 1
 
     def batch_spec(self) -> P:
+        """Batch sharding: dim 0 over the dp axes, and — with a ``seq``
+        axis — dim 1 (the sequence) over it: every batch leaf must then
+        carry ``[B, T, ...]`` with ``T`` divisible by the seq size."""
+        if "seq" in self.axes:
+            return P(self.dp_axes if self.dp_axes else None, "seq")
         return P(self.dp_axes) if self.dp_axes else P()
 
     def describe(self) -> dict:
@@ -224,7 +283,118 @@ class ParallelPlan:
         }
         if self._grad_comp is not None:
             out["grad_reduction"] = self._grad_comp.signature()
+        if self._zsg:
+            out["zero_stacked_groups"] = True
+        if self._seq_impl is not None:
+            out["seq_attn_impl"] = self._seq_impl
         return out
+
+    # -- the seq axis's attention router (ISSUE 13) -------------------------
+
+    @staticmethod
+    def seq_local_positions(t_local: int, axis_name: str = "seq"):
+        """GLOBAL positions of this shard's ``t_local`` tokens — call
+        INSIDE the compiled step (``axis_index * t_local + arange``);
+        what sequence-parallel loss functions pass as the model's
+        ``positions=`` so rope/learned tables line up across shards."""
+        import jax.numpy as jnp
+
+        return (lax.axis_index(axis_name) * t_local
+                + jnp.arange(t_local, dtype=jnp.int32))
+
+    def seq_attention(
+        self,
+        *,
+        heads: int,
+        t_local: int,
+        kv_heads: Optional[int] = None,
+        impl: str = "auto",
+        causal: bool = True,
+        block_q: int = 512,
+        block_k: int = 1024,
+    ):
+        """Resolve the ``seq_attn_impl`` tuning decision and return
+        ``(attn_fn, record)`` — ``attn_fn`` matches the ``attention_fn``
+        contract of :class:`~chainermn_tpu.models.transformer.
+        TransformerBlock` and runs INSIDE the compiled step's shard_map.
+
+        ``impl='auto'`` resolves through the registry (decision
+        ``seq_attn_impl``, keyed device_kind x seq-shards x heads x
+        T-bucket; table default ``ring`` — no divisibility constraint,
+        ``O(T_local)`` resident K/V). An 'auto' resolution to
+        ``ulysses`` with ``heads % seq_size != 0`` (or kv heads — GQA)
+        force-falls back to ``ring`` with ``source:
+        'forced:heads-indivisible'`` recorded in ``plan.decisions``; an
+        EXPLICIT ``impl='ulysses'`` with indivisible heads is rejected
+        at entry with both numbers named
+        (:func:`~chainermn_tpu.parallel.ulysses.
+        check_ulysses_divisibility`). The resolved impl's owed HLO
+        collectives replace the seq axis's descriptor entry
+        (:data:`~chainermn_tpu.parallel.plan_specs.
+        SEQ_IMPL_COLLECTIVES`), so :meth:`describe` names what actually
+        compiles.
+        """
+        from chainermn_tpu import tuning
+        from chainermn_tpu.parallel.ring_attention import (
+            seq_ring_attention_local,
+        )
+        from chainermn_tpu.parallel.ulysses import (
+            check_ulysses_divisibility,
+            ulysses_attention_local,
+        )
+
+        if "seq" not in self.axes:
+            raise ValueError("seq_attention needs a 'seq' plan axis")
+        n = self.axis_size("seq")
+        kvh = int(kv_heads or heads)
+        key = tuning.decision_key(
+            shape=(n, int(heads), max(1, int(t_local))), dtype="seqattn"
+        )
+        if impl == "auto":
+            winner = tuning.choice(
+                "seq_attn_impl", _ps.SEQ_ATTN_IMPLS, key
+            )
+            source = next(
+                (d["source"] for d in tuning.decisions_taken()
+                 if d["name"] == "seq_attn_impl" and d["key"] == key),
+                "table",
+            )
+            if winner == "ulysses" and (heads % n or kvh % n):
+                winner, source = "ring", "forced:heads-indivisible"
+        elif impl in _ps.SEQ_ATTN_IMPLS:
+            if impl == "ulysses":
+                # explicit request: reject at entry, naming both numbers
+                check_ulysses_divisibility(heads, kvh, n)
+            winner, source = impl, "explicit"
+        else:
+            raise ValueError(
+                f"seq_attn_impl must be one of "
+                f"{_ps.SEQ_ATTN_IMPLS + ('auto',)}, got {impl!r}"
+            )
+        record = {"name": "seq_attn_impl", "key": key, "winner": winner,
+                  "source": source}
+        self.decisions.append(record)
+        self._seq_impl = winner
+        self.axes["seq"] = dataclasses.replace(
+            self.axes["seq"],
+            collectives=_ps.SEQ_IMPL_COLLECTIVES[winner],
+        )
+        interpret = self.mesh.devices.flat[0].platform != "tpu"
+
+        if winner == "ring":
+            def attn_fn(q, k, v, *, causal=causal, scale=None, **kw):
+                return seq_ring_attention_local(
+                    q, k, v, "seq", causal=causal, scale=scale,
+                    block_q=block_q, block_k=block_k,
+                    interpret=interpret, **kw,
+                )
+        else:
+            def attn_fn(q, k, v, *, causal=causal, scale=None, **kw):
+                return ulysses_attention_local(
+                    q, k, v, "seq", causal=causal, scale=scale,
+                    impl="flash", interpret=interpret, **kw,
+                )
+        return attn_fn, record
 
     # -- specs --------------------------------------------------------------
 
@@ -254,14 +424,29 @@ class ParallelPlan:
 
         if group == "zero":
             return zero_stacked_init(inner, leaves, self.axis_size("zero"))
-        if group in ("model", "pipe"):
-            return jax.vmap(inner.init)(leaves)
-        return inner.init(leaves)
+        if group == "rep":
+            return inner.init(leaves)
+        stack_axes = _ps.group_stack_axes(group)
+        if self._zsg:
+            z = self.axis_size("zero")
+
+            def fn(ls):
+                return zero_stacked_init(inner, ls, z)
+        else:
+            fn = inner.init
+        for _ in stack_axes:
+            fn = jax.vmap(fn)
+        return fn(leaves)
 
     def _group_state_spec_leaf(self, group: str) -> P:
-        if group in ("zero", "model", "pipe"):
-            return P(group)
-        return P()
+        if group == "zero":
+            return P("zero")
+        if group == "rep":
+            return P()
+        axes = _ps.group_stack_axes(group)
+        if self._zsg:
+            axes = axes + ("zero",)
+        return P(*axes)
 
     def state_specs(self, params: PyTree, inner, specs: PyTree | None = None):
         """The full :class:`TrainState` spec pytree the compiled step
@@ -425,7 +610,10 @@ class ParallelPlan:
         mesh = self.mesh
         dp_axes = self.dp_axes
         dp_total = self.dp_size
+        has_seq = "seq" in self.axes
+        red_axes = dp_axes + (("seq",) if has_seq else ())
         grad_comp = self._grad_comp
+        zsg = self._zsg
         # the zero group's structural composition (scatter axis last in
         # dp order — 'zero' — the other dp axes reduce the shard)
         zero_comp = (zero_composition(dp_axes)
@@ -438,44 +626,61 @@ class ParallelPlan:
             # docstring: a replicated leaf consumed inside stage_fn would
             # receive per-stage gradients with no cross-stage sum, and
             # check_vma=False would mask the divergence as silently wrong
-            # params — reject anything not pipe-stacked up front.
+            # params — reject anything not pipe-stacked up front. A
+            # composed pipe x model leaf (P('pipe', 'model')) leads with
+            # pipe and satisfies the same contract: its stage slice is
+            # itself tensor-parallel.
             bad = [
                 jax.tree_util.keystr(path)
                 for (path, _), spec in zip(
                     jax.tree_util.tree_flatten_with_path(params)[0],
                     flat_specs,
                 )
-                if tuple(spec) != ("pipe",)
+                if not (tuple(spec) and tuple(spec)[0] == "pipe")
             ]
             if bad:
                 raise ValueError(
                     "every trainable leaf of a pipe plan must be "
-                    f"pipe-stacked (P('pipe')); got {bad[:8]} — stage "
+                    f"pipe-stacked (P('pipe') or P('pipe', 'model')); "
+                    f"got {bad[:8]} — stage "
                     "leaves carry their own slice per stage, and "
                     "replicated leaves have no cross-stage gradient sum "
                     "(the embed/head-outside contract of make_pipeline)"
                 )
         groups = self._groups(flat_specs)
-        stacked_idx = {
-            i for grp in ("model", "pipe") for i in groups.get(grp, ())
+        #: leaf index -> leading stacked dims its local view collapses
+        stack_depth = {
+            i: len(_ps.group_stack_axes(grp))
+            for grp, idx in groups.items() for i in idx
         }
         state_spec = self.state_specs(params, inner, param_specs)
         batch_spec = self.batch_spec()
         n_pipe = self.axis_size("pipe")
         lfn = None if pipeline is not None else normalize_loss_fn(loss_fn)
 
+        def _peel(leaf, n):
+            for _ in range(n):
+                leaf = leaf[0]
+            return leaf
+
+        def _wrap(leaf, n):
+            for _ in range(n):
+                leaf = leaf[None]
+            return leaf
+
         def collapse(tree):
             flat = treedef.flatten_up_to(tree)
             return jax.tree.unflatten(
                 treedef,
-                [l[0] if i in stacked_idx else l for i, l in enumerate(flat)],
+                [_peel(l, stack_depth.get(i, 0))
+                 for i, l in enumerate(flat)],
             )
 
         def expand(tree):
             flat = treedef.flatten_up_to(tree)
             return jax.tree.unflatten(
                 treedef,
-                [l[None] if i in stacked_idx else l
+                [_wrap(l, stack_depth.get(i, 0))
                  for i, l in enumerate(flat)],
             )
 
@@ -523,6 +728,12 @@ class ParallelPlan:
 
             flat_p = treedef.flatten_up_to(params_c)
             flat_g = treedef.flatten_up_to(grads_c)
+            if has_seq:
+                # The seq shards each computed the mean loss of their
+                # OWN tokens: one fused all-reduce makes every gradient
+                # the global token mean before the dp reduction (mean of
+                # equal-sized shard means).
+                flat_g = lax.pmean(flat_g, "seq")
             flat_u: list = [None] * len(flat_p)
             new_opt = {}
 
@@ -531,24 +742,51 @@ class ParallelPlan:
             # one is set, else the fused pmean (TP/pipe leaves included
             # — those axes are extra data parallelism for them; the
             # model/pipe axes themselves are never reduced, the
-            # tensor/pipeline composition rule).
-            for grp in ("model", "pipe", "rep"):
-                idx = groups.get(grp)
-                if not idx:
+            # tensor/pipeline composition rule). With
+            # zero_stacked_groups the stacked groups run the zero
+            # composition instead: rs(zero) > ar(other dp) > 1/z-chunk
+            # update > ag(zero) per leaf — same wire bytes as the fused
+            # pmean they replace, state 1/z per TP/pipe shard.
+            for grp, idx in groups.items():
+                if grp == "zero" or not idx:
                     continue
+                depth = len(_ps.group_stack_axes(grp))
                 g = [flat_g[i] for i in idx]
+                p_sub = [flat_p[i] for i in idx]
+                st = state.opt_state[grp]
+                if depth and zsg:
+                    zpre, zpost = zero_comp.split_update()
+                    gch = [
+                        run_reduce_prefix(gi, zpre, total=dp_total)
+                        for gi in g
+                    ]
+                    pch = [zero_param_chunk(pi, "zero") for pi in p_sub]
+                    stc = jax.tree.map(
+                        lambda e: _peel(e, depth + 1), st
+                    )
+                    uch, st_out = inner.update(gch, stc, pch)
+                    st_out = jax.tree.map(
+                        lambda e: _wrap(e, depth + 1), st_out
+                    )
+                    for i, uc, pi in zip(idx, uch, p_sub):
+                        flat_u[i] = run_gather_suffix(
+                            uc, pi, zpost, zpre
+                        )
+                    new_opt[grp] = st_out
+                    continue
                 if dp_axes:
                     if grad_comp is not None:
                         g = reduce_composed_tree(g, grad_comp)
                     else:
                         g = lax.pmean(g, dp_axes)
-                p_sub = [flat_p[i] for i in idx]
-                st = new_in = state.opt_state[grp]
-                if grp != "rep":
-                    new_in = jax.tree.map(lambda e: e[0], st)
+                new_in = st
+                if depth:
+                    new_in = jax.tree.map(lambda e: _peel(e, depth), st)
                 u, st_out = inner.update(g, new_in, p_sub)
-                if grp != "rep":
-                    st_out = jax.tree.map(lambda e: e[None], st_out)
+                if depth:
+                    st_out = jax.tree.map(
+                        lambda e: _wrap(e, depth), st_out
+                    )
                 for i, ui in zip(idx, u):
                     flat_u[i] = ui
                 new_opt[grp] = st_out
@@ -579,10 +817,10 @@ class ParallelPlan:
             updates_c = jax.tree.unflatten(treedef, flat_u)
             params_c2 = optax.apply_updates(params_c, updates_c)
             metrics = {"loss": loss, **metrics}
-            if dp_axes:
-                metrics = lax.pmean(metrics, dp_axes)
+            if red_axes:
+                metrics = lax.pmean(metrics, red_axes)
                 if jax.tree.leaves(model_state):
-                    model_state = lax.pmean(model_state, dp_axes)
+                    model_state = lax.pmean(model_state, red_axes)
             new_state = TrainState(
                 params=expand(params_c2),
                 opt_state=new_opt,

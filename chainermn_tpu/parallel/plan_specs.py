@@ -38,8 +38,47 @@ PyTree = Any
 #: Canonical mesh-axis order: DCN-tolerant axes first, ICI-hungry last
 #: (the repo's mesh convention — the fast/intra axis sits last). ``data``
 #: tolerates DCN (one allreduce/step), ``model`` wants ICI (one psum per
-#: layer pair), ``zero``/``pipe`` sit between.
-CANONICAL_AXES = ("data", "zero", "pipe", "model")
+#: layer pair), ``zero``/``pipe`` sit between; ``seq`` (ring-attention
+#: neighbour exchange per layer, ISSUE 13) sits just before ``model`` —
+#: its ppermutes want ICI, but only to a neighbour, so ``model``'s
+#: all-reduces keep the fastest slot.
+CANONICAL_AXES = ("data", "zero", "pipe", "seq", "model")
+
+#: the ``seq_attn_impl`` tuning decision's candidates and the HLO
+#: collectives each routes the compiled step through (what
+#: :meth:`~chainermn_tpu.parallel.plan.ParallelPlan.seq_attention`
+#: substitutes into the axis descriptor once the impl is resolved).
+SEQ_ATTN_IMPLS = ("ring", "ulysses")
+SEQ_IMPL_COLLECTIVES = {
+    # n-1 kv hops/layer/pass (the unrolled plan ring) + the one grad mean
+    "ring": ("collective-permute", "all-reduce"),
+    # two reshards in, one out, per layer + the one grad mean
+    "ulysses": ("all-to-all", "all-reduce"),
+}
+
+
+def seq_plan_axis(impl: str = "ring", axis_name: str = "seq") -> dict:
+    """Spec-provider descriptor for the ``seq`` axis (ISSUE 13): the
+    batch's SEQUENCE dim shards over it (``ParallelPlan.batch_spec``
+    appends it after the dp axes), params and optimizer state stay
+    replicated (it is token parallelism, not weight parallelism), and it
+    owes the compiled step one gradient all-reduce plus the per-layer
+    attention collectives of the routed impl —
+    :func:`~chainermn_tpu.parallel.ring_attention.
+    seq_ring_attention_local` (``collective-permute``, the default) or
+    :func:`~chainermn_tpu.parallel.ulysses.ulysses_attention_local`
+    (``all-to-all``)."""
+    if impl not in SEQ_ATTN_IMPLS:
+        raise ValueError(
+            f"seq_plan_axis impl must be one of {SEQ_ATTN_IMPLS}, got "
+            f"{impl!r}"
+        )
+    return {
+        "name": axis_name,
+        "stacked": False,
+        "state_stacked": False,
+        "collectives": SEQ_IMPL_COLLECTIVES[impl],
+    }
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +112,8 @@ def _provider(role: str) -> dict:
         from chainermn_tpu.parallel.pipeline import pipe_plan_axis
 
         return pipe_plan_axis()
+    if role == "seq":
+        return seq_plan_axis()
     raise ValueError(
         f"unknown plan axis {role!r}: a ParallelPlan composes "
         f"{CANONICAL_AXES} (any subset)"
@@ -110,11 +151,14 @@ def normalize_param_specs(
 
     ``specs`` may be ``None`` (everything replicated), a single ``P``
     (broadcast), or a prefix pytree of ``P`` leaves (each broadcast over
-    its params subtree). Each leaf spec must be ``P()`` or ``P(axis)``
-    for a *stacked* plan axis (``model``/``pipe``) — the leading-stack
-    convention of :func:`~chainermn_tpu.parallel.tensor.stack_tp_params`
-    / :func:`~chainermn_tpu.parallel.pipeline.stack_stage_params` — and
-    the leaf's leading dim must equal that axis's size.
+    its params subtree). Each leaf spec must be ``P()``, ``P(axis)``,
+    or a canonical-order run of *stacked* plan axes
+    (``P('pipe', 'model')`` — the composed pipe x model plan, ISSUE 13)
+    — the leading-stack convention of
+    :func:`~chainermn_tpu.parallel.tensor.stack_tp_params` /
+    :func:`~chainermn_tpu.parallel.pipeline.stack_stage_params`,
+    one leading dim per named axis — and each leading dim must equal
+    its axis's size.
     """
     if specs is None:
         specs = P()
@@ -138,26 +182,36 @@ def normalize_param_specs(
         entries = tuple(spec)
         if not entries:
             return spec
-        if len(entries) != 1 or entries[0] is None:
+        if any(e is None for e in entries):
             raise ValueError(
                 f"plan param specs use the leading-stack convention: "
-                f"P() or P(<stacked axis>), got {spec}"
+                f"P() or P(<stacked axes...>), got {spec}"
             )
-        ax = entries[0]
-        if ax not in axes or not axes[ax].stacked:
-            stacked = [a for a, s in axes.items() if s.stacked]
+        for ax in entries:
+            if ax not in axes or not axes[ax].stacked:
+                stacked = [a for a, s in axes.items() if s.stacked]
+                raise ValueError(
+                    f"param spec {spec} names {ax!r}, but this plan's "
+                    f"stacked axes are {stacked} (zero/data/seq shard "
+                    f"state, batch and activations, never parameter "
+                    f"leaves)"
+                )
+        order = [CANONICAL_AXES.index(a) for a in entries]
+        if len(set(entries)) != len(entries) or order != sorted(order):
             raise ValueError(
-                f"param spec {spec} names {ax!r}, but this plan's "
-                f"stacked axes are {stacked} (zero/data shard state and "
-                f"batch, never parameter leaves)"
+                f"multi-axis param spec {spec} must name distinct "
+                f"stacked axes in canonical order {CANONICAL_AXES}"
             )
-        lead = jax.numpy.shape(leaf)[0] if jax.numpy.ndim(leaf) else None
-        if lead != axes[ax].size:
-            raise ValueError(
-                f"leaf sharded {spec} must stack [{axes[ax].size}, ...] "
-                f"over {ax!r}; got leading dim {lead} "
-                f"(use stack_tp_params / stack_stage_params)"
-            )
+        shape = jax.numpy.shape(leaf)
+        for d, ax in enumerate(entries):
+            lead = shape[d] if len(shape) > d else None
+            if lead != axes[ax].size:
+                raise ValueError(
+                    f"leaf sharded {spec} must stack "
+                    f"[{axes[ax].size}, ...] over {ax!r} at dim {d}; "
+                    f"got leading dim {lead} "
+                    f"(use stack_tp_params / stack_stage_params)"
+                )
         return spec
 
     return jax.tree.map(check, full, params)
@@ -169,28 +223,42 @@ def partition_groups(
 ) -> dict[str, list[int]]:
     """Split flattened param leaves into update groups by their spec.
 
-    - each stacked axis (``model``, ``pipe``) gets its own group: state
-      mirrors the stacked params (already factored ``1/n`` over that
-      axis), updated per shard;
+    - each stacked spec (``model``, ``pipe``, or the composed
+      ``pipe+model`` — keyed by ``'+'.join(axes)``) gets its own group:
+      state mirrors the stacked params (already factored ``1/n`` over
+      those axes), updated per shard;
     - replicated leaves form the ``'zero'`` group when a
       ``state_stacked`` axis is present (their state chunks over it), or
       the plain ``'rep'`` group otherwise.
 
-    A leaf cannot belong to both a stacked axis AND the zero group: a
-    TP/pipe-sharded parameter's optimizer state is already sharded
-    ``n``-ways by construction, so ZeRO applies to the replicated
-    leaves — the spec-provider contract (docs/parallelism.md).
+    A leaf cannot belong to both a stacked axis AND the zero group by
+    default: a TP/pipe-sharded parameter's optimizer state is already
+    sharded ``n``-ways by construction, so ZeRO applies to the
+    replicated leaves — the spec-provider contract (docs/parallelism.md).
+    ``ParallelPlan(zero_stacked_groups=True)`` additionally chunks the
+    STACKED groups' state over the zero axis (the cross-replica
+    weight-update sharding of arXiv:2004.13336 applied per TP/pipe
+    shard, ISSUE 13) — that changes the state layout and update wiring,
+    not the grouping here.
     """
     has_zero = any(s.state_stacked for s in axes.values())
     groups: dict[str, list[int]] = {}
     for i, spec in enumerate(flat_specs):
         entries = tuple(spec)
         if entries:
-            key = entries[0]
+            key = "+".join(entries)
         else:
             key = "zero" if has_zero else "rep"
         groups.setdefault(key, []).append(i)
     return groups
+
+
+def group_stack_axes(group: str) -> tuple[str, ...]:
+    """The stacked mesh axes a :func:`partition_groups` key names (empty
+    for the ``zero``/``rep`` groups)."""
+    if group in ("zero", "rep"):
+        return ()
+    return tuple(group.split("+"))
 
 
 def owed_collectives(axes: Mapping[str, AxisSpec]) -> dict[str, tuple]:

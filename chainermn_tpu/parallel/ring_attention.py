@@ -687,6 +687,182 @@ def ring_attention_local(
     return finalize_online_softmax(o, l, q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Plan-provider ring (ISSUE 13): statically UNROLLED, n-1 forward hops.
+#
+# The scan-based rings above rotate n times (the last rotation brings K/V
+# home for the backward's residuals); fine for a loop the HLO shows once,
+# but the ParallelPlan's structural acceptance pins the compiled program's
+# collective-permute COUNT at ``n_seq_shards - 1`` per layer per forward
+# ring pass — the minimal neighbour exchange (block s needs n-1 hops to
+# visit every other shard). So the plan's provider unrolls the ring over
+# the static mesh size, rotates K and V as ONE stacked array (one
+# collective-permute per hop), and skips the useless homing hop; the
+# custom-vjp backward restarts from the saved home K/V (they are the
+# function's own inputs — nothing to re-gather). Backward counts, also
+# pinned: n-1 kv hops (same argument) plus n hops for the travelling
+# dk/dv accumulator — it starts at home, must visit all n shards, and
+# needs one extra hop to come home after the last accumulation.
+# ---------------------------------------------------------------------------
+
+
+def _seq_ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k,
+                       interpret):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    kw = dict(scale=scale, block_q=block_q, block_k=block_k,
+              interpret=interpret)
+    o = jnp.zeros((B, Tq, H, D), jnp.float32)
+    lse = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    perm = _ring_perm(n)
+
+    def _full(o, lse, k_blk, v_blk):
+        o_b, lse_b = flash_block_fwd(q, k_blk, v_blk, causal=False, **kw)
+        return merge_partials(o, lse, o_b, lse_b)
+
+    def _diag(o, lse, k_blk, v_blk):
+        o_b, lse_b = flash_block_fwd(q, k_blk, v_blk, causal=True, **kw)
+        return merge_partials(o, lse, o_b, lse_b)
+
+    def _skip(o, lse, k_blk, v_blk):
+        return o, lse
+
+    kv = jnp.stack([k, v])
+    for s in range(n):
+        # Rotate FIRST (depends only on the carried pair) so the async
+        # collective-permute overlaps this step's kernels — but never
+        # after the LAST step: the homing hop is pure waste and the
+        # ppermute-count pin forbids it.
+        kv_next = lax.ppermute(kv, axis_name, perm) if s + 1 < n else None
+        k_blk, v_blk = kv[0], kv[1]
+        if causal:
+            src = (my - s) % n
+            branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            o, lse = lax.switch(
+                branch, (_full, _diag, _skip), o, lse, k_blk, v_blk
+            )
+        else:
+            o, lse = _full(o, lse, k_blk, v_blk)
+        if kv_next is not None:
+            kv = kv_next
+    return o.astype(q.dtype), lse
+
+
+def _seq_ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, scale,
+                       block_q, block_k, interpret):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    kw = dict(scale=scale, block_q=block_q, block_k=block_k,
+              interpret=interpret)
+    do = g
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)  # [B, H, Tq]
+    perm = _ring_perm(n)
+
+    def _full(k_blk, v_blk):
+        return flash_block_bwd(q, k_blk, v_blk, do, lse, delta,
+                               causal=False, **kw)
+
+    def _diag(k_blk, v_blk):
+        return flash_block_bwd(q, k_blk, v_blk, do, lse, delta,
+                               causal=True, **kw)
+
+    def _skip(k_blk, v_blk):
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.zeros(k_blk.shape, jnp.float32),
+                jnp.zeros(v_blk.shape, jnp.float32))
+
+    kv = jnp.stack([k, v])
+    dkv = jnp.zeros((2,) + k.shape, jnp.float32)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    for s in range(n):
+        kv_next = lax.ppermute(kv, axis_name, perm) if s + 1 < n else None
+        k_blk, v_blk = kv[0], kv[1]
+        if causal:
+            src = (my - s) % n
+            branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            dq_c, dk_c, dv_c = lax.switch(
+                branch, (_full, _diag, _skip), k_blk, v_blk
+            )
+        else:
+            dq_c, dk_c, dv_c = _full(k_blk, v_blk)
+        dq = dq + dq_c
+        # The accumulator travels WITH its block and rotates after EVERY
+        # accumulation (n hops total): after the last one the block's
+        # dk/dv sits one shard past its last visit — exactly home.
+        dkv = lax.ppermute(dkv + jnp.stack([dk_c, dv_c]), axis_name, perm)
+        if kv_next is not None:
+            kv = kv_next
+    return (dq.astype(q.dtype), dkv[0].astype(k.dtype),
+            dkv[1].astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _seq_ring(q, k, v, axis_name, causal, scale, block_q, block_k,
+              interpret):
+    out, _lse = _seq_ring_fwd_impl(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _seq_ring_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
+                  interpret):
+    out, lse = _seq_ring_fwd_impl(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    # Home k/v are the function's own inputs — saving them costs nothing
+    # and lets the backward ring start without the scan rings' homing
+    # rotation.
+    return out, (q, k, v, out, lse)
+
+
+def _seq_ring_bwd(axis_name, causal, scale, block_q, block_k, interpret,
+                  res, g):
+    q, k, v, out, lse = res
+    return _seq_ring_bwd_impl(
+        q, k, v, out, lse, g, axis_name, causal, scale, block_q, block_k,
+        interpret
+    )
+
+
+_seq_ring.defvjp(_seq_ring_fwd, _seq_ring_bwd)
+
+
+def seq_ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """The ParallelPlan ``seq``-axis ring — call INSIDE ``shard_map``.
+
+    Same contract as :func:`ring_attention_local` (contiguous layout,
+    flash kernels, GQA via smaller K/V head counts), but the ring is
+    statically unrolled with exactly ``n - 1`` K/V hops per forward pass
+    and ``(n - 1) + n`` per backward (kv + travelling dk/dv accumulator)
+    — each hop ONE ``collective-permute`` of the stacked (K, V) pair, so
+    the plan's structural HLO-count acceptance can pin the program
+    (tests/test_sequence_parallel.py). Signature matches the
+    ``attention_fn`` contract of
+    :class:`~chainermn_tpu.models.transformer.TransformerBlock`.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    return _seq_ring(q, k, v, axis_name, bool(causal), float(scale),
+                     int(block_q), int(block_k), bool(interpret))
+
+
 def make_ring_attention(
     mesh: Mesh,
     axis_name: str = "seq",

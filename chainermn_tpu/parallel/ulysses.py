@@ -24,6 +24,28 @@ from chainermn_tpu.ops.attention import blockwise_attention
 from chainermn_tpu.ops.flash_attention import flash_attention
 
 
+def check_ulysses_divisibility(q_heads: int, kv_heads: int, n: int,
+                               *, axis_name: str = "seq") -> None:
+    """Reject head counts Ulysses cannot reshard, naming BOTH numbers.
+
+    Heads are the resharding currency: the two ``all_to_all``s split the
+    head dim ``n`` ways, so ``q_heads % n`` and ``kv_heads % n`` must
+    both be 0. Raised at ENTRY (``make_ulysses_attention``'s returned fn
+    and the plan's ``seq_attn_impl`` resolver call this before any
+    ``shard_map`` trace) so the caller sees the arithmetic, not a shape
+    error from inside the collective (ISSUE 13 satellite — previously
+    the check only fired mid-trace and had to be caught by the caller).
+    """
+    for name, h in (("q", int(q_heads)), ("kv", int(kv_heads))):
+        if h % n != 0:
+            raise ValueError(
+                f"ulysses: {name} heads {h} not divisible by axis "
+                f"{axis_name!r} size {n} — pad the head count, shrink "
+                f"the seq axis, or use the ring provider (seq_attn_impl="
+                f"'ring'), which has no divisibility constraint"
+            )
+
+
 def ulysses_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -63,13 +85,8 @@ def ulysses_attention_local(
       Local output shard ``[B, T_local, H, D]``.
     """
     n = lax.axis_size(axis_name)
-    H = q.shape[2]
-    for name, h in (("q", H), ("kv", k.shape[2])):
-        if h % n != 0:
-            raise ValueError(
-                f"ulysses: {name} heads {h} not divisible by axis "
-                f"{axis_name!r} size {n}"
-            )
+    check_ulysses_divisibility(q.shape[2], k.shape[2], n,
+                               axis_name=axis_name)
     if window is not None and (impl != "flash" or attn_fn is not None):
         raise ValueError(
             "window is implemented by the flash kernel — use impl='flash' "
@@ -136,6 +153,7 @@ def make_ulysses_attention(
     spec = P(batch_axis, axis_name, None, None)
     seg_spec = P(batch_axis, axis_name)
     interpret = mesh.devices.flat[0].platform != "tpu"
+    n = mesh.shape[axis_name]
 
     def local(q, k, v, seg=None):
         return ulysses_attention_local(
@@ -148,4 +166,13 @@ def make_ulysses_attention(
         local, mesh=mesh, in_specs=in_specs, out_specs=spec,
         check_vma=False,
     )
-    return jax.jit(fn)
+    jitted = jax.jit(fn)
+
+    def checked(q, k, v, *rest):
+        # Divisibility rejected at ENTRY, with global head counts —
+        # not from inside the shard_map trace.
+        check_ulysses_divisibility(q.shape[2], k.shape[2], n,
+                                   axis_name=axis_name)
+        return jitted(q, k, v, *rest)
+
+    return checked

@@ -115,6 +115,21 @@ DEFAULT_TABLE: dict = {
     # adoption through the bench's bursty goodput-under-SLO rows
     # (spread-gated, the spec_tokens/cluster_disagg precedent).
     "prefill_chunk": {"*": "0"},
+    # Sequence-axis attention (ISSUE 13): ring (n-1 neighbour ppermutes
+    # per layer, O(T_local) resident K/V, no divisibility constraint)
+    # vs Ulysses (two all_to_alls in + one out per layer; cheaper when
+    # heads >= seq size AND the full sequence fits a shard's HBM —
+    # which is exactly when you need less sequence parallelism). Ring
+    # everywhere until a bench ``seq_parallel`` capture shows Ulysses
+    # winning a shape; heads-indivisible shapes force ring regardless.
+    "seq_attn_impl": {"*": "ring"},
+    # Sequence-parallel long-prompt prefill over the replica's 'model'
+    # partition (ISSUE 13): 'off' until the bench's long-prompt TTFT
+    # rows (``seq_parallel_ttft_ms``) show the sharded forward beating
+    # the TP prefill on this shape — the in-program param all-gather
+    # and per-layer ring hops must EARN their place, the
+    # spec_tokens/cluster_disagg precedent.
+    "prefill_seq_parallel": {"*": "off"},
 }
 
 _MODE_ENV = "CHAINERMN_TPU_AUTOTUNE"

@@ -562,6 +562,198 @@ class TestPlanStructural:
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 13 sweep-ins: TP x ZeRO stacked-group state + pipe x model specs
+# ---------------------------------------------------------------------------
+
+
+class TestZeroStackedGroups:
+    """``zero_stacked_groups=True``: the stacked groups' optimizer state
+    chunks over the zero axis too (arXiv:2004.13336 applied per TP
+    shard) — dist == single values AND grads, state 1/z per shard,
+    and the stacked groups' dp reduction becomes the zero composition's
+    rs/ag (pinned in the compiled HLO)."""
+
+    def _workload(self):
+        w1, w2, b2 = _mlp_params(jax.random.PRNGKey(3))
+        x = jax.random.normal(jax.random.PRNGKey(4), (16, 8))
+        y = jax.random.normal(jax.random.PRNGKey(5), (16, 8))
+
+        def loss_fn(p, batch):
+            xb, yb = batch
+            out = tp_mlp(xb, p["w1"], None, p["w2"], p["b2"],
+                         axis_name="model")
+            return jnp.mean((out - yb) ** 2)
+
+        return w1, w2, b2, x, y, loss_fn
+
+    def _plan_and_params(self, w1, w2, b2):
+        plan = ParallelPlan(("data", "model", "zero"),
+                            devices=_devices(), zero_stacked_groups=True)
+        m = plan.axis_size("model")
+        params = {
+            "w1": stack_tp_params(w1, m, 1),
+            "w2": stack_tp_params(w2, m, 0),
+            "b2": b2,
+        }
+        specs = {"w1": P("model"), "w2": P("model"), "b2": P()}
+        return plan, params, specs
+
+    def test_values_and_grads_match_reference(self):
+        w1, w2, b2, x, y, loss_fn = self._workload()
+        plan, params, specs = self._plan_and_params(w1, w2, b2)
+
+        inner = optax.adamw(1e-2)
+        state = plan.create_train_state(params, inner, param_specs=specs)
+        step = plan.compile_train_step(loss_fn, inner, params,
+                                       param_specs=specs)
+        losses = []
+        for _ in range(3):
+            state, m = step(state, (x, y))
+            losses.append(float(m["loss"]))
+        _, ref_losses, _ = _run_ref(inner, w1, w2, b2, x, y, 3)
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-5,
+                                   atol=1e-6)
+        assert step.cache_size() in (None, 1)
+
+        lr = 0.1
+        state = plan.create_train_state(params, optax.sgd(lr),
+                                        param_specs=specs)
+        step = plan.compile_train_step(loss_fn, optax.sgd(lr), params,
+                                       param_specs=specs)
+        state, _ = step(state, (x, y))
+        _, _, g0 = _run_ref(optax.sgd(lr), w1, w2, b2, x, y, 1)
+        w1_after = np.concatenate(
+            list(np.asarray(jax.device_get(state.params["w1"]))), axis=-1
+        )
+        np.testing.assert_allclose(
+            (np.asarray(w1) - w1_after) / lr, np.asarray(g0["w1"]),
+            rtol=1e-4, atol=1e-6,
+        )
+
+    def test_state_layout_and_hlo(self):
+        """Model-group state leaves stack [m, z, ...] with
+        P('model', 'zero'), per-device bytes 1/(m*z); the compiled step
+        carries one rs + one ag per FLOAT LEAF (TP leaves now included
+        — that is the feature) and no ppermute."""
+        w1, w2, b2, x, y, loss_fn = self._workload()
+        plan, params, specs = self._plan_and_params(w1, w2, b2)
+        desc = plan.describe()
+        assert desc["zero_stacked_groups"] is True
+        inner = optax.adamw(1e-2)
+        state = plan.create_train_state(params, inner, param_specs=specs)
+        z = plan.axis_size("zero")
+        m = plan.axis_size("model")
+        for leaf in jax.tree.leaves(state.opt_state["model"]):
+            assert leaf.shape[:2] == (m, z), leaf.shape
+            assert tuple(leaf.sharding.spec)[:2] == ("model", "zero")
+            shard = leaf.addressable_shards[0].data
+            assert shard.size * m * z == leaf.size
+        step = plan.compile_train_step(loss_fn, inner, params,
+                                       param_specs=specs)
+        txt = step.lower(state, (x, y)).compile().as_text()
+        counts = _collective_counts(txt)
+        # per-leaf rs/ag for w1, w2 (model group) AND b2 (zero group):
+        # the stacked groups joined the zero pipeline
+        assert counts["reduce-scatter("] == 3, counts
+        assert counts["all-gather("] == 3, counts
+        assert counts["collective-permute("] == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="zero"):
+            ParallelPlan({"data": 4, "model": 2},
+                         devices=_devices(), zero_stacked_groups=True)
+        with pytest.raises(ValueError, match="stacked axis"):
+            ParallelPlan({"data": 2, "zero": 4},
+                         devices=_devices(), zero_stacked_groups=True)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            ParallelPlan({"data": 2, "zero": 2, "model": 2},
+                         devices=_devices(), zero_stacked_groups=True,
+                         grad_reduction="flat")
+
+
+class TestPipeModelComposed:
+    """``P('pipe', 'model')`` leaves: stage slices that are themselves
+    tensor-parallel — the composed plan the PR 9 follow-up named.
+    dist == single values AND grads through the one compiled step."""
+
+    def test_values_and_grads(self):
+        d, n_pipe, n_tp = 8, 2, 2
+        plan = ParallelPlan({"data": 2, "pipe": n_pipe, "model": n_tp},
+                            devices=_devices())
+        keys = jax.random.split(jax.random.PRNGKey(6), n_pipe)
+        stage_w = [jax.random.normal(k, (d, d)) * 0.4 for k in keys]
+        pw = jnp.stack([stack_tp_params(w, n_tp, 1) for w in stage_w])
+        params = {"w": pw}  # [pipe, model, d, d/n_tp]
+        from chainermn_tpu.parallel.tensor import (
+            copy_to_tp,
+            gather_from_tp,
+        )
+
+        def stage_fn(p, mb):
+            h = copy_to_tp(mb, "model") @ p["w"]  # column-parallel
+            h = gather_from_tp(h, "model", 1)
+            return jnp.tanh(h)
+
+        pipe = PipelinePlanSpec(
+            stage_fn=stage_fn,
+            loss_fn=lambda yh, b: jnp.mean((yh - b[1]) ** 2),
+            n_microbatches=n_pipe,
+        )
+        lr = 0.1
+        state = plan.create_train_state(
+            params, optax.sgd(lr), param_specs={"w": P("pipe", "model")}
+        )
+        step = plan.compile_train_step(
+            None, optax.sgd(lr), params,
+            param_specs={"w": P("pipe", "model")}, pipeline=pipe,
+        )
+        x = jax.random.normal(jax.random.PRNGKey(7), (8, d))
+        y = jax.random.normal(jax.random.PRNGKey(8), (8, d))
+        state, m = step(state, (x, y))
+
+        def seq_loss(ws, xb, yb):
+            h = xb
+            for w in ws:
+                h = jnp.tanh(h @ w)
+            return jnp.mean((h - yb) ** 2)
+
+        ref_l, ref_g = jax.value_and_grad(seq_loss)(stage_w, x, y)
+        np.testing.assert_allclose(float(m["loss"]), float(ref_l),
+                                   rtol=1e-5)
+        new_w = np.asarray(jax.device_get(state.params["w"]))
+        for i in range(n_pipe):
+            full_after = np.concatenate(list(new_w[i]), axis=-1)
+            np.testing.assert_allclose(
+                (np.asarray(stage_w[i]) - full_after) / lr,
+                np.asarray(ref_g[i]), rtol=1e-4, atol=1e-6,
+            )
+        assert step.cache_size() in (None, 1)
+        assert "pipe+model" in state.opt_state
+        # state mirrors the double stack (adam: non-empty state leaves)
+        adam_state = plan.create_train_state(
+            params, optax.adamw(1e-2),
+            param_specs={"w": P("pipe", "model")},
+        )
+        leaf = jax.tree.leaves(adam_state.opt_state["pipe+model"])[0]
+        assert leaf.shape[:2] == (n_pipe, n_tp)
+        assert tuple(leaf.sharding.spec)[:2] == ("pipe", "model")
+
+    def test_spec_validation(self):
+        plan = ParallelPlan({"data": 2, "pipe": 2, "model": 2},
+                            devices=_devices())
+        params = {"w": jnp.zeros((2, 2, 4, 4))}
+        full = plan.param_specs(params, {"w": P("pipe", "model")})
+        assert full["w"] == P("pipe", "model")
+        # non-canonical order rejected
+        with pytest.raises(ValueError, match="canonical order"):
+            plan.param_specs(params, {"w": P("model", "pipe")})
+        # each leading dim checked against its axis
+        with pytest.raises(ValueError, match="leading dim"):
+            plan.param_specs({"w": jnp.zeros((2, 3, 4))},
+                             {"w": P("pipe", "model")})
+
+
+# ---------------------------------------------------------------------------
 # Satellite: checkpoint round-trip over a plan-sharded [n, ...] ZeRO state
 # ---------------------------------------------------------------------------
 

@@ -650,6 +650,392 @@ class TestSlidingWindowSP:
                 assert int(seg_p[0, -1]) == jnp.iinfo(jnp.int32).min
 
 
+class TestSeqRingLocal:
+    """The plan-provider ring (ISSUE 13): statically unrolled, n-1
+    forward K/V hops — same dist == single invariant as the scan rings,
+    plus the hop-count pins the ParallelPlan acceptance rests on."""
+
+    def _dist(self, comm, q, k, v, grad=False):
+        from jax import shard_map
+
+        from chainermn_tpu.parallel.ring_attention import (
+            seq_ring_attention_local,
+        )
+
+        ax = comm.axis_name
+
+        def fwd(q, k, v):
+            def local(q, k, v):
+                return seq_ring_attention_local(
+                    q, k, v, ax, causal=True, block_q=4, block_k=4,
+                    interpret=True,
+                )
+
+            return shard_map(
+                local, mesh=comm.mesh, in_specs=(P(None, ax),) * 3,
+                out_specs=P(None, ax), check_vma=False,
+            )(q, k, v)
+
+        if not grad:
+            return jax.jit(fwd)(q, k, v)
+        return jax.jit(jax.grad(
+            lambda a, b, c: (fwd(a, b, c).astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2),
+        ))(q, k, v)
+
+    def test_matches_full_attention_values_and_grads(self, comm):
+        q, k, v = _qkv(40)
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(self._dist(comm, q, k, v)), ref,
+            rtol=1e-5, atol=1e-5,
+        )
+        g = self._dist(comm, q, k, v, grad=True)
+        g_ref = jax.grad(
+            lambda a, b, c: (dot_product_attention(
+                a, b, c, causal=True).astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            ),
+            g, g_ref,
+        )
+
+    def test_gqa(self, comm):
+        ks = jax.random.split(jax.random.PRNGKey(41), 3)
+        q = jax.random.normal(ks[0], (B, T, H, D))
+        k = jax.random.normal(ks[1], (B, T, 2, D))
+        v = jax.random.normal(ks[2], (B, T, 2, D))
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(self._dist(comm, q, k, v)), ref,
+            rtol=1e-5, atol=1e-5,
+        )
+        g = self._dist(comm, q, k, v, grad=True)
+        g_ref = jax.grad(
+            lambda a, b, c: (dot_product_attention(
+                a, b, c, causal=True).astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            ),
+            g, g_ref,
+        )
+
+    def test_hop_counts_pinned(self, comm):
+        """The structural claim the plan's acceptance rests on: n-1
+        collective-permutes per FORWARD ring pass (each hop one permute
+        of the stacked K/V pair — no homing rotation), and
+        (n-1) + n per backward (kv hops + the travelling dk/dv
+        accumulator's n hops: it starts home, visits all n shards, and
+        needs one extra hop back). Counted in the jaxpr — the unrolled
+        program shows every hop, unlike the scan rings' loop body."""
+        from jax import shard_map
+
+        from chainermn_tpu.parallel.ring_attention import (
+            seq_ring_attention_local,
+        )
+
+        ax = comm.axis_name
+        n = comm.size
+
+        def fwd(q, k, v):
+            def local(q, k, v):
+                o = seq_ring_attention_local(
+                    q, k, v, ax, causal=True, block_q=4, block_k=4,
+                    interpret=True,
+                )
+                return jax.lax.psum((o.astype(jnp.float32) ** 2).sum(), ax)
+
+            return shard_map(
+                local, mesh=comm.mesh, in_specs=(P(None, ax),) * 3,
+                out_specs=P(), check_vma=False,
+            )(q, k, v)
+
+        q = jnp.zeros((1, T, 2, 8))
+        assert str(jax.make_jaxpr(fwd)(q, q, q)).count("ppermute") == n - 1
+        n_grad = str(jax.make_jaxpr(
+            jax.grad(fwd, argnums=(0, 1, 2))
+        )(q, q, q)).count("ppermute")
+        assert n_grad == (n - 1) + (n - 1) + n, n_grad
+
+
+class TestSeqPlanAxis:
+    """ISSUE 13 tentpole: the ``seq`` axis as a ParallelPlan spec
+    provider — plan-compiled ``data x seq`` / ``seq x model`` steps must
+    equal the single-device reference (values AND gradients), the ring's
+    compiled HLO must carry exactly ``n_seq - 1`` collective-permutes
+    per layer per forward pass, the jit cache stays at 1 with
+    whole-state donation intact, and composing TP adds ZERO collectives
+    beyond what the providers owe."""
+
+    LM_KW = dict(vocab_size=32, num_layers=2, num_heads=4, d_model=16,
+                 d_ff=32, max_len=64, compute_dtype=jnp.float32,
+                 pos_encoding="rope", return_hidden=True)
+
+    def _lm(self, attn_fn=None, **kw):
+        from chainermn_tpu.models.transformer import TransformerLM
+
+        cfg = dict(self.LM_KW)
+        cfg.update(kw)
+        return TransformerLM(**cfg, attention_fn=attn_fn)
+
+    def _params_and_tokens(self, seed=4, kv_heads=None):
+        ref = self._lm(num_kv_heads=kv_heads)
+        tok = jax.random.randint(jax.random.PRNGKey(3), (4, 32), 0, 32)
+        variables = ref.init(
+            jax.random.PRNGKey(seed), tok[:, :4], train=False
+        )
+        return ref, {"params": variables["params"]}, tok
+
+    def _losses(self, model, sp=False):
+        def sp_loss(p, batch):
+            from chainermn_tpu.parallel.plan import ParallelPlan
+
+            pos = ParallelPlan.seq_local_positions(batch.shape[1])
+            h = model.apply({"params": p["params"]}, batch,
+                            positions=pos, train=False)
+            return jnp.mean(h.astype(jnp.float32) ** 2)
+
+        def ref_loss(p, batch):
+            h = model.apply({"params": p["params"]}, batch, train=False)
+            return jnp.mean(h.astype(jnp.float32) ** 2)
+
+        return sp_loss if sp else ref_loss
+
+    @pytest.mark.parametrize("impl,seq,kv_heads", [
+        ("ring", 4, None),
+        ("ring", 4, 2),      # GQA through the plan ring
+        ("ulysses", 2, None),
+        ("ulysses", 2, 2),   # GQA through the plan Ulysses (kvh % n == 0)
+    ])
+    def test_data_seq_plan_values_and_grads(self, impl, seq, kv_heads):
+        from chainermn_tpu.parallel.plan import ParallelPlan
+
+        devices = jax.devices("cpu")[:2 * seq]
+        plan = ParallelPlan({"data": 2, "seq": seq}, devices=devices)
+        attn_fn, rec = plan.seq_attention(
+            heads=4, kv_heads=kv_heads, t_local=32 // seq, impl=impl
+        )
+        assert rec["winner"] == impl
+        ref_model, params, tok = self._params_and_tokens(kv_heads=kv_heads)
+        sp_model = self._lm(attn_fn, num_kv_heads=kv_heads)
+
+        lr = 0.1
+        import optax
+
+        state = plan.create_train_state(params, optax.sgd(lr))
+        step = plan.compile_train_step(
+            self._losses(sp_model, sp=True), optax.sgd(lr), params
+        )
+        state, m = step(state, tok)
+        l_ref, g_ref = jax.value_and_grad(
+            lambda p: self._losses(ref_model)(p, tok)
+        )(params)
+        np.testing.assert_allclose(float(m["loss"]), float(l_ref),
+                                   rtol=1e-4)
+        # gradients certified through the sgd delta, every leaf
+        after = jax.device_get(state.params)
+        jax.tree.map(
+            lambda p0, p1, g: np.testing.assert_allclose(
+                (np.asarray(p0) - np.asarray(p1)) / lr, np.asarray(g),
+                rtol=2e-3, atol=2e-5,
+            ),
+            params, after, g_ref,
+        )
+        assert step.cache_size() in (None, 1)
+
+    def test_ring_hlo_ppermute_count_and_donation(self):
+        """The compiled ``data x seq`` train step carries EXACTLY
+        ``(n-1) + (n-1) + n`` collective-permutes per layer (forward
+        ring + backward kv ring + accumulator homing), the forward-only
+        program exactly ``n - 1`` per layer, donation aliases every
+        state buffer, and the jit cache stays at 1 across steps."""
+        import optax
+
+        from chainermn_tpu.parallel.plan import ParallelPlan
+
+        seq, layers = 4, 2
+        plan = ParallelPlan({"data": 2, "seq": seq},
+                            devices=jax.devices("cpu")[:8])
+        attn_fn, _ = plan.seq_attention(heads=4, t_local=32 // seq,
+                                        impl="ring")
+        sp_model = self._lm(attn_fn)
+        _, params, tok = self._params_and_tokens()
+        loss = self._losses(sp_model, sp=True)
+        inner = optax.adamw(1e-2)
+        state = plan.create_train_state(params, inner)
+        step = plan.compile_train_step(loss, inner, params)
+        txt = step.lower(state, tok).compile().as_text()
+        assert txt.count("collective-permute(") == (3 * seq - 2) * layers
+        assert "input_output_alias" in txt
+        n_alias = txt.count("may-alias") + txt.count("must-alias")
+        assert n_alias >= len(jax.tree.leaves(state))
+
+        # forward-only: n-1 per layer per ring pass, nothing else
+        from jax import shard_map
+
+        fwd = jax.jit(shard_map(
+            lambda p, t: loss(p, t), mesh=plan.mesh,
+            in_specs=(plan.param_specs(params), plan.batch_spec()),
+            out_specs=P(), check_vma=False,
+        ))
+        fwd_txt = fwd.lower(params, tok).compile().as_text()
+        assert fwd_txt.count("collective-permute(") == (seq - 1) * layers
+
+        for _ in range(2):
+            state, m = step(state, tok)
+        assert step.cache_size() in (None, 1)
+        assert np.isfinite(float(m["loss"]))
+
+    def test_seq_model_plan_zero_extra_collectives(self):
+        """``seq x model``: the plan-compiled step carries exactly the
+        collectives the two providers owe — the ring's ppermutes plus
+        TP's all-reduces plus the one seq gradient mean — pinned
+        against the hand-wired shard_map of the same computation (the
+        test_plan.py convention), with zero all-to-alls and zero
+        ppermutes beyond the ring's."""
+        import optax
+        from jax import shard_map
+
+        from chainermn_tpu.parallel.plan import ParallelPlan
+        from chainermn_tpu.parallel.ring_attention import (
+            seq_ring_attention_local,
+        )
+        from chainermn_tpu.parallel.tensor import stack_tp_params, tp_mlp
+
+        seq = n_tp = 2
+        d, Hh, Dh = 8, 2, 4
+        plan = ParallelPlan({"seq": seq, "model": n_tp},
+                            devices=jax.devices("cpu")[:4])
+        attn_fn, _ = plan.seq_attention(heads=Hh, t_local=8, impl="ring")
+        ks = jax.random.split(jax.random.PRNGKey(0), 4)
+        wq = jax.random.normal(ks[0], (d, d)) * 0.3
+        w1 = jax.random.normal(ks[1], (d, d)) * 0.3
+        w2 = jax.random.normal(ks[2], (d, d)) * 0.3
+        params = {
+            "wq": wq,
+            "w1": stack_tp_params(w1, n_tp, 1),
+            "w2": stack_tp_params(w2, n_tp, 0),
+            "b2": jnp.zeros((d,)),
+        }
+        specs = {"wq": P(), "w1": P("model"), "w2": P("model"), "b2": P()}
+        x = jax.random.normal(ks[3], (2, 16, d))
+        y = jnp.zeros((2, 16, d))
+        lr = 0.1
+
+        def loss_fn(p, batch):
+            xb, yb = batch
+            Bb, Tb, _ = xb.shape
+            q = (xb @ p["wq"]).reshape(Bb, Tb, Hh, Dh)
+            a = attn_fn(q, q, q, causal=True, scale=Dh ** -0.5)
+            h = a.reshape(Bb * Tb, d)
+            out = tp_mlp(h, p["w1"], None, p["w2"], p["b2"],
+                         axis_name="model")
+            return jnp.mean((out.reshape(Bb, Tb, d) - yb) ** 2)
+
+        inner = optax.sgd(lr)
+        state = plan.create_train_state(params, inner, param_specs=specs)
+        step = plan.compile_train_step(loss_fn, inner, params,
+                                       param_specs=specs)
+        plan_txt = step.lower(state, (x, y)).compile().as_text()
+        plan_counts = {op: plan_txt.count(op) for op in
+                       ("all-reduce(", "collective-permute(",
+                        "all-to-all(", "reduce-scatter(", "all-gather(")}
+
+        def hand_local(params, batch):
+            p = {"wq": params["wq"], "w1": params["w1"][0],
+                 "w2": params["w2"][0], "b2": params["b2"]}
+
+            def loss(p):
+                return loss_fn(p, batch)
+
+            l, g = jax.value_and_grad(loss)(p)
+            g = jax.lax.pmean(g, ("seq",))
+            new = {
+                "wq": p["wq"] - lr * g["wq"],
+                "w1": (p["w1"] - lr * g["w1"])[None],
+                "w2": (p["w2"] - lr * g["w2"])[None],
+                "b2": p["b2"] - lr * g["b2"],
+            }
+            return new, jax.lax.pmean(l, ("seq",))
+
+        pspec = {"wq": P(), "w1": P("model"), "w2": P("model"),
+                 "b2": P()}
+        hand = jax.jit(shard_map(
+            hand_local, mesh=plan.mesh,
+            in_specs=(pspec, P(None, "seq")),
+            out_specs=(pspec, P()),
+            check_vma=False,
+        ))
+        hand_txt = hand.lower(params, (x, y)).compile().as_text()
+        hand_counts = {op: hand_txt.count(op) for op in plan_counts}
+        assert plan_counts == hand_counts, (plan_counts, hand_counts)
+        # the vocabulary: ring hops present, TP psums present, nothing
+        # resharded head<->sequence (no all-to-all), no zero machinery
+        assert plan_counts["collective-permute("] == 3 * seq - 2
+        assert plan_counts["all-to-all("] == 0
+        assert plan_counts["reduce-scatter("] == 0
+        assert plan_counts["all-gather("] == 0
+        assert plan_counts["all-reduce("] >= 2  # TP pair + grad mean
+
+    def test_seq_attn_impl_forced_fallback_and_rejection(self,
+                                                         monkeypatch):
+        """Satellite: 'auto' resolving to ulysses with
+        heads % seq_size != 0 force-falls back to ring with
+        ``forced:heads-indivisible`` provenance; an EXPLICIT ulysses
+        request is rejected at entry naming both numbers."""
+        from chainermn_tpu.parallel.plan import ParallelPlan
+
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_FORCE",
+                           "seq_attn_impl=ulysses")
+        plan = ParallelPlan({"seq": 8}, devices=jax.devices("cpu")[:8])
+        _, rec = plan.seq_attention(heads=4, t_local=4, impl="auto")
+        assert rec["winner"] == "ring"
+        assert rec["source"] == "forced:heads-indivisible"
+        assert plan.decisions[-1] == rec
+        assert plan.describe()["seq_attn_impl"] == "ring"
+        # kv heads (GQA) gate the fallback too
+        plan2 = ParallelPlan({"seq": 2}, devices=jax.devices("cpu")[:2])
+        _, rec2 = plan2.seq_attention(heads=4, kv_heads=1, t_local=16,
+                                      impl="auto")
+        assert rec2["source"] == "forced:heads-indivisible"
+
+        monkeypatch.delenv("CHAINERMN_TPU_AUTOTUNE_FORCE")
+        plan3 = ParallelPlan({"seq": 8}, devices=jax.devices("cpu")[:8])
+        with pytest.raises(ValueError) as e:
+            plan3.seq_attention(heads=6, t_local=4, impl="ulysses")
+        assert "6" in str(e.value) and "8" in str(e.value)
+
+    def test_make_ulysses_rejects_at_entry(self, comm):
+        """Satellite: the jitted Ulysses entry point rejects indivisible
+        heads BEFORE the shard_map trace, naming both numbers."""
+        fn = make_ulysses_attention(comm.mesh, comm.axis_name)
+        q = jnp.zeros((B, T, 6, D))
+        with pytest.raises(ValueError) as e:
+            fn(q, q, q)
+        assert "6" in str(e.value) and "8" in str(e.value)
+        assert "not divisible" in str(e.value)
+
+    def test_batch_spec_and_describe(self):
+        from chainermn_tpu.parallel.plan import ParallelPlan
+
+        plan = ParallelPlan({"data": 2, "seq": 4},
+                            devices=jax.devices("cpu")[:8])
+        assert plan.batch_spec() == P(("data",), "seq")
+        desc = plan.describe()
+        assert desc["mesh"] == {"data": 2, "seq": 4}
+        assert desc["collectives"]["seq"] == (
+            "collective-permute", "all-reduce",
+        )
+        plan2 = ParallelPlan({"seq": 8}, devices=jax.devices("cpu")[:8])
+        assert plan2.batch_spec() == P(None, "seq")
+
+
 class TestUlyssesWindow:
     def test_ulysses_window_matches_single_device(self, comm):
         from chainermn_tpu.parallel.ulysses import make_ulysses_attention
