@@ -463,6 +463,8 @@ _COMPACT_KEYS = (
     "plan_vs_handwired", "plan_spread_pct",
     "serving_burst_goodput", "serving_burst_ttft_p99_ms",
     "serving_burst_spread_pct", "serving_burst_selected",
+    "seq_parallel_selected", "seq_parallel_ttft_ms",
+    "seq_parallel_spread_pct",
 )
 
 
@@ -2960,6 +2962,222 @@ def _bench_plan(comm, on_accel: bool):
     return out
 
 
+def _bench_seq_parallel(comm, on_accel: bool):
+    """ISSUE 13: the sequence axis, priced twice (CPU-proxy convention:
+    median-of-n>=3 + spread — a delta inside ``seq_parallel_spread_pct``
+    is noise; on-accel rows are single samples and the offline seeder
+    applies the 10% floor):
+
+    1. TRAINING — one ``data x seq`` plan-compiled Transformer step per
+       ``seq_attn_impl`` candidate (ring's n-1 ppermutes/layer vs
+       Ulysses' all_to_all reshard), adopted as this
+       shards x heads x T shape's ``seq_attn_impl`` decision;
+    2. SERVING — long-prompt TTFT through a TP engine at 1/2/4 model
+       shards, monolithic vs sequence-parallel prefill at the top shard
+       count, adopted (spread-gated) as this model shape's
+       ``prefill_seq_parallel`` decision — the number that decides
+       whether the wide-prefill/narrow-decode split finally earns
+       ``cluster_disagg`` its hop.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.parallel.plan import ParallelPlan
+    from chainermn_tpu.parallel.plan_specs import SEQ_ATTN_IMPLS
+    from chainermn_tpu.serving import ServingEngine, serving_decision_key
+
+    devices = list(comm.mesh.devices.flat)
+    n_seq = min(4, len(devices) // 2) or 1
+    if on_accel:
+        layers, d_model, heads, d_ff = 4, 512, 8, 2048
+        vocab, T, batch = 32000, 2048, 2 * (len(devices) // n_seq or 1)
+        dtype = jnp.bfloat16
+        steps = 8
+    else:
+        layers, d_model, heads, d_ff = 2, 64, 4, 128
+        vocab, T, batch = 256, 64, 4
+        dtype = jnp.float32
+        steps = 2
+    t_local = T // n_seq
+
+    # --- 1. training: ring vs ulysses through the ONE plan step
+    plan = ParallelPlan(
+        {"data": len(devices) // n_seq, "seq": n_seq}, devices=devices
+    )
+    tok = jax.random.randint(jax.random.PRNGKey(0), (batch, T), 0, vocab)
+    import optax
+
+    inner = optax.sgd(1e-3)
+    attn_ms: dict = {}
+    attn_spreads: dict = {}
+    lm_kw = dict(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        d_model=d_model, d_ff=d_ff, max_len=T, compute_dtype=dtype,
+        pos_encoding="rope", return_hidden=True,
+    )
+    # init through the attention-free twin: the ring/ulysses locals
+    # need the mesh axis context the init trace does not have
+    params = {"params": jax.jit(
+        functools.partial(TransformerLM(**lm_kw).init, train=False)
+    )(jax.random.PRNGKey(1), tok[:1, :8])["params"]}
+    for impl in SEQ_ATTN_IMPLS:
+        if impl == "ulysses" and heads % n_seq:
+            continue  # forced-ring shape: nothing to compare
+        attn_fn, _rec = plan.seq_attention(
+            heads=heads, t_local=t_local, impl=impl
+        )
+        model = TransformerLM(**lm_kw, attention_fn=attn_fn)
+
+        def loss_fn(p, batch_):
+            pos = ParallelPlan.seq_local_positions(batch_.shape[1])
+            h = model.apply({"params": p["params"]}, batch_,
+                            positions=pos, train=False)
+            return jnp.mean(h.astype(jnp.float32) ** 2)
+
+        state = plan.create_train_state(params, inner)
+        step = plan.compile_train_step(loss_fn, inner, params)
+        state, m = step(state, tok)  # compile + warm
+        _fetch_scalar(m["loss"])
+
+        def sample():
+            nonlocal state
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, m = step(state, tok)
+            _fetch_scalar(m["loss"])
+            return (time.perf_counter() - t0) / steps * 1000
+
+        med, spread = _repeat_median(sample, 1 if on_accel else 3)
+        attn_ms[impl] = round(med, 3)
+        attn_spreads[impl] = spread
+    out = {
+        "seq_parallel_attn_ms": attn_ms,
+        # T here is the LOCAL shard length — the seq_attn_impl decision
+        # key's T-bucket (the plan's seq_attention and the offline
+        # seeder must rebuild the same key).
+        "seq_parallel_attn_shape": f"S{n_seq}xH{heads}xT{t_local}",
+        "seq_parallel_shards": n_seq,
+    }
+    if not on_accel and attn_spreads:
+        out["seq_parallel_attn_spread_pct"] = max(attn_spreads.values())
+
+    try:
+        from chainermn_tpu import tuning
+
+        if len(attn_ms) > 1:
+            akey = tuning.decision_key(
+                shape=(n_seq, heads, t_local), dtype="seqattn"
+            )
+            tuning.record_measurement(
+                "seq_attn_impl", akey, attn_ms,
+                spreads=None if on_accel else attn_spreads,
+            )
+            out["seq_parallel_attn_selected"] = tuning.choice(
+                "seq_attn_impl", SEQ_ATTN_IMPLS, akey
+            )
+    except Exception as e:
+        out["seq_parallel_attn_autotune_error"] = (
+            f"{type(e).__name__}: {e}"[:120])
+
+    # --- 2. serving: long-prompt TTFT, monolithic vs seq-parallel
+    if on_accel:
+        s_layers, s_dm, s_heads, s_dff = 4, 512, 8, 2048
+        s_vocab, s_maxlen, prompt_len, gen = 32000, 2048, 1500, 4
+        s_dtype = jnp.bfloat16
+    else:
+        s_layers, s_dm, s_heads, s_dff = 2, 64, 4, 128
+        s_vocab, s_maxlen, prompt_len, gen = 256, 64, 40, 2
+        s_dtype = jnp.float32
+    s_model = TransformerLM(
+        vocab_size=s_vocab, num_layers=s_layers, num_heads=s_heads,
+        d_model=s_dm, d_ff=s_dff, max_len=s_maxlen,
+        compute_dtype=s_dtype,
+    )
+    s_params = jax.jit(
+        functools.partial(s_model.init, train=False)
+    )(jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32))
+    rs = np.random.RandomState(11)
+    prompt = rs.randint(1, s_vocab, size=prompt_len).tolist()
+
+    def ttft_median(shards, seq_parallel):
+        mesh = Mesh(np.array(devices[:shards]), ("model",))
+        engine = ServingEngine(
+            s_model, s_params, num_slots=2, max_len=s_maxlen,
+            decode_impl="paged", kv_block_size="auto",
+            prefill_buckets=(s_maxlen,), mesh=mesh,
+            prefill_seq_parallel="on" if seq_parallel else "off",
+        )
+
+        def once():
+            t0 = time.perf_counter()
+            res = engine.prefill_join(prompt)
+            jax.block_until_ready(jax.tree.leaves(engine._cache)[0])
+            dt = (time.perf_counter() - t0) * 1000
+            assert res is not None
+            engine.leave(res[0])
+            return dt
+
+        once()  # compile + warm
+        return _repeat_median(once, 1 if on_accel else 3)
+
+    ttft_by_shards: dict = {}
+    ttft_spreads: dict = {}
+    top = None
+    for shards in (1, 2, 4):
+        if shards > len(devices) or s_heads % shards:
+            continue
+        kvh = s_heads  # MHA here; GQA shapes gate on kv heads too
+        if shards > 1 and kvh % shards:
+            continue
+        med, spread = ttft_median(shards, seq_parallel=shards > 1)
+        ttft_by_shards[str(shards)] = round(med, 4)
+        ttft_spreads[str(shards)] = spread
+        top = shards
+    out["seq_parallel_ttft_shards_ms"] = ttft_by_shards
+    out["seq_parallel_model_shape"] = f"D{s_dm}xH{s_heads}xL{s_maxlen}"
+    if top and top > 1:
+        # the decision's candidates, measured at the TOP shard count:
+        # 'off' = the TP monolithic prefill on the SAME mesh (isolates
+        # the sharded forward from the TP speedup itself)
+        med_off, spread_off = ttft_median(top, seq_parallel=False)
+        ttft_ms = {"off": round(med_off, 4),
+                   "on": ttft_by_shards[str(top)]}
+        sp = {"off": spread_off, "on": ttft_spreads[str(top)]}
+        out["seq_parallel_ttft_ms"] = ttft_ms
+        if not on_accel:
+            out["seq_parallel_spread_pct"] = max(sp.values())
+        if ttft_ms["on"]:
+            out["seq_parallel_ttft_speedup"] = round(
+                ttft_ms["off"] / ttft_ms["on"], 3
+            )
+        try:
+            from chainermn_tpu import tuning
+
+            key = serving_decision_key(s_dm, s_heads, s_maxlen)
+            tuning.record_measurement(
+                "prefill_seq_parallel", key, ttft_ms,
+                spreads=None if on_accel else sp,
+            )
+            out["seq_parallel_selected"] = tuning.choice(
+                "prefill_seq_parallel", ("off", "on"), key
+            )
+        except Exception as e:
+            out["seq_parallel_autotune_error"] = (
+                f"{type(e).__name__}: {e}"[:120])
+    if not on_accel:
+        out["seq_parallel_note"] = (
+            "CPU-proxy honest floor: tiny LM, loopback ppermutes — the "
+            "ring-vs-ulysses and off-vs-on rankings hold for THIS "
+            "backend; absolute ms is not chip latency"
+        )
+    return out
+
+
 def _bench_allreduce(comm, n_elems: int = 100_000_000):
     """The reference's ``allreduce_grad`` GB/s microbenchmark (BASELINE.json
     tracked metric): achieved bytes/s of a jitted psum over a flat bf16
@@ -3568,6 +3786,8 @@ def _run_bench(mode: str) -> None:
          lambda: _bench_composed(comm, on_accel))
     supp("plan", "plan_error",
          lambda: _bench_plan(comm, on_accel))
+    supp("seq_parallel", "seq_parallel_error",
+         lambda: _bench_seq_parallel(comm, on_accel))
     supp("transformer", "transformer_error",
          lambda: _bench_transformer(comm, on_accel))
     supp("s2d_resnet", "s2d_error", lambda: _bench_s2d_resnet(comm, on_accel))

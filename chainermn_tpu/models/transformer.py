@@ -96,6 +96,13 @@ class TransformerBlock(nn.Module):
     #: per-head width override; required under ``tp_axis`` where
     #: ``d_model // num_heads`` no longer holds (num_heads is local).
     head_dim: Optional[int] = None
+    #: sow each NON-decode forward's post-rope K/V into a mutable
+    #: ``'kv_out'`` collection (``{'k': (kh,), 'v': (vh,)}`` per block,
+    #: ``compute_dtype`` — exactly what the slot-decode cache stores).
+    #: The serving engine's sequence-parallel prefill (ISSUE 13) runs a
+    #: train-mode forward over the prompt shards and scatters these into
+    #: the paged/dense cache at true positions.
+    sow_kv: bool = False
 
     def _decode_attend(self, qh, kh_new, vh_new, head_dim):
         """One-token attention against the mutable KV cache.
@@ -329,9 +336,12 @@ class TransformerBlock(nn.Module):
                 )
             if self.window is not None and not self.causal:
                 raise ValueError("window requires a causal block")
+            vh = heads(v, kv_heads)
+            if self.sow_kv:
+                self.sow("kv_out", "k", kh.astype(self.compute_dtype))
+                self.sow("kv_out", "v", vh.astype(self.compute_dtype))
             kw = {} if segment_ids is None else {"segment_ids": segment_ids}
-            o = attn(qh, kh,
-                     heads(v, kv_heads), causal=self.causal,
+            o = attn(qh, kh, vh, causal=self.causal,
                      scale=head_dim**-0.5, **kw)
         o = nn.Dense(
             D, use_bias=False,
@@ -458,6 +468,9 @@ class TransformerLM(nn.Module):
     #: per-head width override for the blocks (required under
     #: ``tp_axis``).
     head_dim: Optional[int] = None
+    #: thread ``TransformerBlock.sow_kv`` through every block (the
+    #: sequence-parallel prefill's KV capture, ISSUE 13).
+    sow_kv: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None,
@@ -546,6 +559,7 @@ class TransformerLM(nn.Module):
                 kv_num_blocks=self.kv_num_blocks,
                 tp_axis=self.tp_axis,
                 head_dim=self.head_dim,
+                sow_kv=self.sow_kv,
                 name=f"block_{i}",
             )(x, segment_ids, rope_positions, train, decode,
               decode_positions, block_tables, decode_slots)

@@ -483,9 +483,148 @@ class OverlappedBucketReducer:
         return jax.tree.unflatten(treedef, out)
 
 
+class MeasuredComposedReducer:
+    """Eager per-STAGE composed reduction — the measured side of the
+    composed-schedule story (ISSUE 13 satellite, the PR 11 follow-up).
+
+    The in-jit composed executor (:func:`~chainermn_tpu.parallel.
+    composition.reduce_composed`) emits trace-time ``wire`` layout
+    events per stage — bytes the program COMMITTED to, no durations.
+    This driver runs the SAME stage list eagerly (one jitted shard_map
+    program per stage over the communicator's mesh, the stacked
+    ``[size, ...]`` eager-communicator convention), blocks between
+    stages, and records one ``wire`` event per stage carrying
+    ``dur_s`` — so ``tools/trace_report.py``'s overlap section gains a
+    MEASURED per-stage duration column in the per-signature stage table
+    (``summarize_overlap`` folds ``dur_s`` into ``stages[..].dur_ms``).
+    The blocking is the point: a per-stage wall clock is only honest
+    when the previous stage's collective has retired
+    (the :class:`OverlappedBucketReducer` dur_s/blocked_s pattern,
+    applied per stage instead of per bucket).
+
+    Pure reductions only — a ``sharded_update`` stage belongs to the
+    optimizer fuse point, not an eager wire driver (refused loudly).
+
+    Usage::
+
+        red = MeasuredComposedReducer(comm, schedule="two_level")
+        mean = red.reduce(stacked_grads)   # [size, ...] leaves -> mean
+    """
+
+    def __init__(self, comm, schedule="two_level") -> None:
+        from chainermn_tpu.parallel.composition import (
+            CompositionError,
+            compile_schedule,
+        )
+
+        self.comm = comm
+        axes = comm.grad_axes
+        axes = axes if isinstance(axes, tuple) else (axes,)
+        self.comp = compile_schedule(schedule, axes)
+        if self.comp.has_update:
+            raise CompositionError(
+                f"{self.comp.signature()!r} carries a sharded_update "
+                "stage — the eager measured reducer runs pure "
+                "reductions (the update fuse point is "
+                "MultiNodeOptimizer's 'zero' schedule)"
+            )
+        self._axes = axes
+        self._stage_jits: dict = {}
+
+    def _stage_fn(self, i: int, primitive, stage_axes, orig_size,
+                  cur_size):
+        key = (i, cur_size)
+        if key in self._stage_jits:
+            return self._stage_jits[key]
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from chainermn_tpu.parallel.collectives import (
+            staged_allgather,
+            staged_allreduce,
+            staged_reduce_scatter,
+        )
+
+        def local(x):
+            b = x[0]
+            if primitive == "reduce_scatter":
+                out = staged_reduce_scatter(b, stage_axes)
+            elif primitive == "allreduce":
+                out = staged_allreduce(b, stage_axes)
+            else:
+                out = staged_allgather(b, stage_axes, orig_size)
+            return out[None]
+
+        fn = jax.jit(shard_map(
+            local, mesh=self.comm.mesh,
+            in_specs=P(self._axes), out_specs=P(self._axes),
+            check_vma=False,
+        ))
+        self._stage_jits[key] = fn
+        return fn
+
+    def reduce(self, grads_stacked: PyTree) -> PyTree:
+        """Run the composition stage by stage on ONE flat f32 buffer
+        (leaves ``[size, ...]`` stacked per-rank contributions,
+        concatenated), blocking per stage, and return the un-stacked
+        mean tree. Records one measured ``wire`` event per stage."""
+        from chainermn_tpu.parallel.composition import (
+            _replay_sizes,
+            stage_wire_layout,
+        )
+
+        n = self.comm.size
+        leaves, treedef = jax.tree.flatten(grads_stacked)
+        for leaf in leaves:
+            if leaf.shape[0] != n:
+                raise ValueError(
+                    f"stacked leaves must have leading dim == size "
+                    f"({n}), got {leaf.shape}"
+                )
+        sizes = [leaf[0].size for leaf in leaves]
+        flat = jnp.concatenate(
+            [jnp.asarray(leaf).astype(jnp.float32).reshape(n, -1)
+             for leaf in leaves], axis=1,
+        ) if leaves else jnp.zeros((n, 0), jnp.float32)
+        n_elems = flat.shape[1]
+        axis_sizes = {a: int(self.comm.mesh.shape[a])
+                      for a in self._axes}
+        rows, _, _ = _replay_sizes(self.comp.stages, n_elems, axis_sizes)
+        layout = stage_wire_layout(self.comp, axis_sizes, 4, n_elems)
+        sig = self.comp.signature()
+        rec = _trace.active()
+
+        cur = flat
+        li = 0
+        for i, (st, size_in, size_out) in enumerate(rows):
+            fn = self._stage_fn(i, st.primitive, st.axes, size_out,
+                                size_in)
+            t0 = time.perf_counter()
+            cur = jax.block_until_ready(fn(cur))
+            dur = time.perf_counter() - t0
+            if rec is not None and li < len(layout):
+                rec.event(
+                    "wire", schedule="composed_eager", composition=sig,
+                    stage=st.signature(), stage_index=li,
+                    stage_op=layout[li]["op"], bucket=0, n_buckets=1,
+                    nbytes=layout[li]["nbytes"],
+                    dur_s=round(dur, 9), overlapped=False,
+                )
+            li += 1
+        mean = cur[0] / n  # replicated sum row -> mean
+        out = []
+        off = 0
+        for leaf, k in zip(leaves, sizes):
+            out.append(mean[off:off + k].reshape(leaf.shape[1:])
+                       .astype(leaf.dtype))
+            off += k
+        return jax.tree.unflatten(treedef, out)
+
+
 __all__ = [
     "DECISION",
     "DEFAULT_BUCKET_BYTES",
+    "MeasuredComposedReducer",
     "OverlappedBucketReducer",
     "SCHEDULES",
     "bucket_partition",

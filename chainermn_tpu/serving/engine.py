@@ -74,6 +74,16 @@ MIN_SHARED_BLOCKS = ("1", "2", "4")
 #: step while the remaining active slots decode — the long-prompt
 #: TPOT-freeze fix, priced by the bench's bursty goodput-under-SLO rows.
 PREFILL_CHUNKS = ("0", "16", "32", "64", "128")
+#: sequence-parallel long-prompt prefill over the replica's ``model``
+#: partition (ISSUE 13): 'off' = the TP (or single-device) monolithic
+#: prefill; 'on' = a cache-miss prompt's forward is SHARDED over the
+#: mesh's 'model' axis — each shard runs its token slice through the
+#: ring/Ulysses attention (decision ``seq_attn_impl``, shared with the
+#: ParallelPlan's seq axis), the sown per-layer K/V is resharded
+#: heads<->sequence by one all_to_all into exactly the TP cache layout,
+#: and the assembled block chain is handed to the existing paged/dense
+#: decode path. Streams stay bit-identical to sequential ``generate``.
+PREFILL_SEQ_PARALLEL = ("off", "on")
 
 
 def serving_decision_key(d_model: int, num_heads: int, max_len: int,
@@ -156,6 +166,20 @@ def resolve_prefill_chunk(d_model: int, num_heads: int,
     ))
 
 
+def resolve_prefill_seq_parallel(d_model: int, num_heads: int,
+                                 max_len: int) -> str:
+    """Resolve ``prefill_seq_parallel`` ('off' | 'on') via the registry
+    (decision ``prefill_seq_parallel``, same key as the other serving
+    decisions; table default 'off' — the wide prefill must EARN adoption
+    through bench's ``seq_parallel`` long-prompt TTFT rows)."""
+    from chainermn_tpu import tuning
+
+    return tuning.choice(
+        "prefill_seq_parallel", PREFILL_SEQ_PARALLEL,
+        serving_decision_key(d_model, num_heads, max_len),
+    )
+
+
 def shard_lm_params(model, variables, n: int):
     """Stack a :class:`~chainermn_tpu.models.transformer.TransformerLM`
     param tree into ``[n, ...]`` per-shard leaves for tensor-parallel
@@ -198,6 +222,52 @@ def shard_lm_params(model, variables, n: int):
         return jnp.stack([leaf] * n)
 
     return jax.tree_util.tree_map_with_path(shard_leaf, variables)
+
+
+def unshard_lm_params(model, stacked):
+    """Inverse of :func:`shard_lm_params`: reassemble the FULL param
+    tree from the ``[n, ...]``-stacked shard form. Pure ``jnp`` — the
+    sequence-parallel prefill program calls it INSIDE ``shard_map``
+    after an in-program all-gather of the resident TP stacks, so the
+    full weights exist only transiently per prefill (no 2x-params
+    replica lives in HBM). ``ff_down``'s bias was stored divided by
+    ``n``, so its reassembly is the shard SUM (bit-exact for
+    power-of-two ``n``, same note as the shard direction). Roundtrip
+    ``unshard(shard(p)) == p`` is pinned in tests/test_serving.py."""
+    import jax
+    import jax.numpy as jnp
+
+    n_heads = model.num_heads
+    kv_heads = model.num_kv_heads or model.num_heads
+    head_dim = model.d_model // model.num_heads
+
+    def cols(t):
+        # [n, d, c] stacked column shards -> [d, n*c] in shard order
+        return t.transpose(1, 0, 2).reshape(t.shape[1], -1)
+
+    def un(path, leaf):
+        names = [str(getattr(p, "key", p)) for p in path]
+        n = leaf.shape[0]
+        if "qkv" in names and names[-1] == "kernel":
+            hl = n_heads // n * head_dim
+            kl = kv_heads // n * head_dim
+            q = leaf[:, :, :hl]
+            k = leaf[:, :, hl:hl + kl]
+            v = leaf[:, :, hl + kl:]
+            return jnp.concatenate([cols(q), cols(k), cols(v)], axis=-1)
+        if "proj" in names and names[-1] == "kernel":
+            return leaf.reshape(-1, leaf.shape[-1])
+        if "ff_up" in names:
+            if names[-1] == "kernel":
+                return cols(leaf)
+            return leaf.reshape(-1)  # bias: [n, dff/n] -> [dff]
+        if "ff_down" in names and names[-1] == "kernel":
+            return leaf.reshape(-1, leaf.shape[-1])
+        if "ff_down" in names and names[-1] == "bias":
+            return leaf.sum(axis=0)  # stored as bias / n per shard
+        return leaf[0]  # replicated tiles
+
+    return jax.tree_util.tree_map_with_path(un, stacked)
 
 
 class ServingEngine:
@@ -264,6 +334,27 @@ class ServingEngine:
         rejected. ``'auto'`` resolves through the registry (decision
         ``prefill_chunk``, table default 0 — chunking must earn
         adoption via the bursty bench rows).
+      prefill_seq_parallel: sequence-parallel long-prompt prefill over
+        the mesh's ``model`` partition (ISSUE 13): ``'on'`` shards a
+        cache-MISS prompt's forward over the TP devices — each shard
+        runs its token slice with ring/Ulysses attention (decision
+        ``seq_attn_impl``; Ulysses force-falls back to ring when heads
+        are indivisible), the sown per-layer K/V is resharded by one
+        ``all_to_all`` per layer into exactly the TP cache layout and
+        scattered at true positions, and the last true position's
+        logits are psum-selected for the first token — the assembled
+        block chain then feeds the existing paged/dense decode path.
+        Streams stay bit-identical to sequential ``generate``; composes
+        with the prefix cache (a trie HIT takes the monolithic tail
+        prefill — its context lives in adopted blocks the sharded
+        forward cannot see; the MISS, which is where long-prompt TTFT
+        lives, goes wide). Requires a ``mesh``, greedy decoding, no
+        ``window``, and ``prefill_chunk == 0`` (chunked admission takes
+        precedence) — explicit ``'on'`` violating these is rejected; an
+        ``'auto'`` resolution is forced off with provenance. ``'auto'``
+        resolves via the registry (table default ``off`` — the wide
+        prefill must earn adoption through bench's ``seq_parallel``
+        long-prompt TTFT rows).
     """
 
     def __init__(self, model, params, *, num_slots: int,
@@ -278,7 +369,8 @@ class ServingEngine:
                  rng=None, pad_id: int = 0, mesh=None,
                  spec_tokens="auto", drafter=None,
                  prefix_cache="auto", min_shared_blocks="auto",
-                 prefill_chunk="auto") -> None:
+                 prefill_chunk="auto",
+                 prefill_seq_parallel="auto") -> None:
         import jax
 
         from chainermn_tpu.models.transformer import TransformerLM
@@ -589,6 +681,107 @@ class ServingEngine:
         # the first export/import — most engines never transfer.
         self._kv_extract_jit = None
         self._kv_inject_jit = None
+
+        # ---- sequence-parallel prefill (ISSUE 13): shard a cache-miss
+        # prompt's forward over the mesh's 'model' partition.
+        if (prefill_seq_parallel != "auto"
+                and prefill_seq_parallel not in PREFILL_SEQ_PARALLEL):
+            raise ValueError(
+                f"prefill_seq_parallel must be one of "
+                f"{PREFILL_SEQ_PARALLEL + ('auto',)}, got "
+                f"{prefill_seq_parallel!r}"
+            )
+        explicit_sp = prefill_seq_parallel != "auto"
+        if prefill_seq_parallel == "auto":
+            prefill_seq_parallel = resolve_prefill_seq_parallel(
+                model.d_model, model.num_heads, max_len
+            )
+            self._adopt_decision("prefill_seq_parallel", key)
+        else:
+            self.decisions.append({"name": "prefill_seq_parallel",
+                                   "key": key,
+                                   "winner": prefill_seq_parallel,
+                                   "source": "explicit"})
+        if prefill_seq_parallel == "on":
+            blocked = None
+            if mesh is None:
+                blocked = ("forced:no-mesh",
+                           "needs a mesh with a 'model' axis to shard "
+                           "the prompt over")
+            elif model.window is not None:
+                blocked = ("forced:window",
+                           "the sharded forward's ring/Ulysses "
+                           "attention does not honour a sliding window")
+            elif self.prefill_chunk > 0:
+                blocked = ("forced:chunked",
+                           "chunked admission (prefill_chunk > 0) "
+                           "already bounds long-prompt interference and "
+                           "takes precedence")
+            elif self.temperature > 0.0:
+                blocked = ("forced:sampling",
+                           "greedy-only: the bit-identical-stream "
+                           "guarantee is a greedy property (the "
+                           "spec_tokens/prefill_chunk precedent)")
+            if blocked is not None:
+                if explicit_sp:
+                    raise ValueError(
+                        f"prefill_seq_parallel='on' {blocked[1]} — "
+                        f"({blocked[0]})"
+                    )
+                prefill_seq_parallel = "off"
+                self.decisions.append({"name": "prefill_seq_parallel",
+                                       "key": key, "winner": "off",
+                                       "source": blocked[0]})
+        self.prefill_seq_parallel = prefill_seq_parallel == "on"
+        #: whether the LAST prefill_join ran the sequence-parallel
+        #: program (the scheduler's prefill-event field).
+        self.last_prefill_seq_parallel = False
+        self._base_model = model
+        self._seq_base_model = None
+        self._seq_attn_impl = None
+        self._seq_prefill_jits: dict[int, Any] = {}
+        if self.prefill_seq_parallel:
+            from chainermn_tpu import tuning
+            from chainermn_tpu.parallel.plan_specs import SEQ_ATTN_IMPLS
+            from chainermn_tpu.parallel.ring_attention import (
+                seq_ring_attention_local,
+            )
+            from chainermn_tpu.parallel.ulysses import (
+                ulysses_attention_local,
+            )
+
+            n = self._tp_n
+            kvh = model.num_kv_heads or model.num_heads
+            skey = tuning.decision_key(
+                shape=(n, model.num_heads, max_len), dtype="seqattn"
+            )
+            impl = tuning.choice("seq_attn_impl", SEQ_ATTN_IMPLS, skey)
+            self._adopt_decision("seq_attn_impl", skey)
+            if impl == "ulysses" and (model.num_heads % n or kvh % n):
+                impl = "ring"
+                self.decisions.append({
+                    "name": "seq_attn_impl", "key": skey,
+                    "winner": "ring",
+                    "source": "forced:heads-indivisible",
+                })
+            self._seq_attn_impl = impl
+            interp = mesh.devices.flat[0].platform != "tpu"
+            if impl == "ring":
+                def _seq_attn(q, k, v, *, causal, scale, **kw):
+                    return seq_ring_attention_local(
+                        q, k, v, "model", causal=causal, scale=scale,
+                        interpret=interp,
+                    )
+            else:
+                def _seq_attn(q, k, v, *, causal, scale, **kw):
+                    return ulysses_attention_local(
+                        q, k, v, "model", causal=causal, scale=scale,
+                        impl="flash", interpret=interp,
+                    )
+            self._seq_base_model = model.clone(
+                attention_fn=_seq_attn, sow_kv=True
+            )
+
         self._decode_step_jit = self._build_decode_step()
         self._verify_step_jit = (
             self._build_verify_step() if self.spec_tokens > 0 else None
@@ -884,6 +1077,104 @@ class ServingEngine:
         self._prefill_jits[bucket] = fn
         return fn
 
+    def _seq_prefill_fn(self, t_pad: int):
+        """The (cached) sequence-parallel prefill program for one padded
+        length ``t_pad`` (a bucket rounded up to the shard count — the
+        compile count stays bounded by the bucket ladder).
+
+        ONE ``shard_map`` over the mesh's ``model`` axis: tokens arrive
+        sequence-sharded ``[1, t_pad/n]`` per shard; the resident TP
+        param stacks are all-gathered and reassembled IN-PROGRAM
+        (:func:`unshard_lm_params` — full weights exist only transiently,
+        no 2x-params replica in HBM); each shard runs its slice through
+        the base model with global rope/learned positions and
+        ``sow_kv=True``; per layer, one ``all_to_all`` reshards the sown
+        K/V heads<->sequence into exactly the TP cache layout (all
+        positions x local kv heads) and scatters it at true positions
+        (``paged_update`` redirects pad overhang to scratch; dense
+        scatters drop out-of-bounds rows — the monolithic path's own
+        staleness contract); the last TRUE position's logits are
+        psum-selected across shards and greedy-argmaxed for the first
+        token. The cache is donated, so the chain hands off to decode
+        without a copy."""
+        if t_pad in self._seq_prefill_jits:
+            return self._seq_prefill_jits[t_pad]
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from chainermn_tpu.ops.paged_kv import paged_update
+
+        base = self._seq_base_model
+        base_model = self._base_model
+        paged = self._alloc is not None
+
+        def local(cache_st, vars_st, tokens, true_len, slot, table_row):
+            cache = jax.tree.map(lambda a: a[0], cache_st)
+            stacked = jax.tree.map(
+                lambda a: jax.lax.all_gather(
+                    a[0], "model", axis=0, tiled=False
+                ),
+                vars_st,
+            )
+            full = unshard_lm_params(base_model, stacked)
+            Tl = tokens.shape[1]
+            my = jax.lax.axis_index("model")
+            pos = my * Tl + jnp.arange(Tl, dtype=jnp.int32)
+            logits, mut = base.apply(
+                full, tokens, positions=pos, train=False,
+                mutable=["kv_out"],
+            )
+            # first generated token = greedy argmax at the last TRUE
+            # prompt position (exactly what the monolithic prefill
+            # samples at temperature 0)
+            j = true_len - 1
+            row = jnp.where(
+                (j // Tl) == my,
+                logits[0, j % Tl].astype(jnp.float32), 0.0,
+            )
+            tok = jnp.argmax(
+                jax.lax.psum(row, "model")
+            ).astype(jnp.int32)
+            new_cache = dict(cache)
+            for blk, kv in mut["kv_out"].items():
+                entry = dict(cache[blk])
+                for src, dst in (("k", "key"), ("v", "value")):
+                    sh = jax.lax.all_to_all(
+                        kv[src][0], "model", split_axis=2,
+                        concat_axis=1, tiled=True,
+                    )  # [1, t_pad, kvh/n, dh] — the TP cache layout
+                    if paged:
+                        pool = entry[f"pool_{dst}"]
+                        entry[f"pool_{dst}"] = paged_update(
+                            pool, table_row,
+                            jnp.zeros((1,), jnp.int32),
+                            sh.astype(pool.dtype),
+                        )
+                    else:
+                        cols = jnp.arange(t_pad, dtype=jnp.int32)
+                        entry[f"cached_{dst}"] = (
+                            entry[f"cached_{dst}"]
+                            .at[slot[:, None], cols[None, :]]
+                            .set(sh.astype(entry[f"cached_{dst}"].dtype))
+                        )
+                new_cache[blk] = entry
+            return jax.tree.map(lambda a: a[None], new_cache), tok
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=self._mesh,
+                in_specs=(P("model"), P("model"), P(None, "model"),
+                          P(), P(), P()),
+                out_specs=(P("model"), P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+        self._seq_prefill_jits[t_pad] = fn
+        return fn
+
     # ------------------------------------------------------------------
     # serving surface
 
@@ -998,6 +1289,20 @@ class ServingEngine:
             return None
         slot, prompt, P_len, tail_start, tail_len, _matched, _cow = res
         bucket = bucket_length(tail_len, self._buckets)
+        self.last_prefill_seq_parallel = False
+
+        # Sequence-parallel path (ISSUE 13): a cache-MISS prompt
+        # (tail_start == 0 — on a trie hit the tail's context lives in
+        # adopted blocks the sharded forward cannot see, so the
+        # monolithic tail prefill runs; it is also already short) whose
+        # shard-rounded bucket fits the horizon goes wide over the
+        # 'model' partition.
+        if self.prefill_seq_parallel and tail_start == 0:
+            t_pad = -(-bucket // self._tp_n) * self._tp_n
+            if t_pad <= self.max_len:
+                return self._seq_prefill_run(
+                    slot, prompt, P_len, tail_len, t_pad, bucket
+                )
 
         padded = np.full((1, bucket), self.pad_id, np.int32)
         padded[0, :tail_len] = prompt[tail_start:]
@@ -1017,6 +1322,45 @@ class ServingEngine:
         self._publish_full_blocks(slot, prompt, P_len)
         self._publish_pool_gauges()
         return slot, tok, bucket
+
+    def _seq_prefill_run(self, slot, prompt, P_len, tail_len, t_pad,
+                         bucket):
+        """The sequence-parallel half of :meth:`prefill_join`: run the
+        sharded forward (:meth:`_seq_prefill_fn`), then commit the SAME
+        host metadata the monolithic join commits — the stream is
+        indistinguishable downstream (that is the guarantee)."""
+        import jax.numpy as jnp
+
+        fn = self._seq_prefill_fn(t_pad)
+        padded = np.full((1, t_pad), self.pad_id, np.int32)
+        padded[0, :tail_len] = prompt
+        self._cache, tok = fn(
+            self._cache, self._vars, jnp.asarray(padded),
+            jnp.int32(tail_len), jnp.asarray([slot], jnp.int32),
+            jnp.asarray(self._dummy_tables()[slot:slot + 1]),
+        )
+        tok = int(tok)
+        self._positions[slot] = P_len
+        self._last_tok[slot] = tok
+        self._active[slot] = True
+        self._history[slot] = [int(t) for t in prompt] + [tok]
+        self.last_prefill_seq_parallel = True
+        self._publish_full_blocks(slot, prompt, P_len)
+        self._publish_pool_gauges()
+        return slot, tok, bucket
+
+    def seq_prefill_compile_count(self) -> Optional[int]:
+        """Compilations of the sequence-parallel prefill programs —
+        bounded by the shard-rounded bucket ladder, like the monolithic
+        prefill's. None when the path is off or the runtime hides the
+        cache."""
+        if not self._seq_prefill_jits:
+            return None if not self.prefill_seq_parallel else 0
+        sizes = [getattr(f, "_cache_size", None)
+                 for f in self._seq_prefill_jits.values()]
+        if any(s is None for s in sizes):
+            return None
+        return int(sum(s() for s in sizes))
 
     def _publish_full_blocks(self, slot: int, tokens,
                              n_positions: int) -> None:

@@ -370,6 +370,11 @@ class Scheduler:
                         dur_s=round(dur_s, 9))
         if chunks is not None:
             ev["chunks"] = chunks
+        if getattr(self.engine, "last_prefill_seq_parallel", False):
+            # ISSUE 13: this admission's forward ran sharded over the
+            # 'model' partition — the TTFT percentiles can be split by
+            # this field when pricing the wide-prefill adoption.
+            ev["seq_parallel"] = True
         if resume is None:
             ev["ttft_s"] = round(now - req._arrival, 9)
             fl = _InFlight(req, slot, list(req.prompt) + [int(tok)], 1,

@@ -121,6 +121,7 @@ def store_entry(
 _MOE_SHAPE = re.compile(r"T(\d+)xE(\d+)xD(\d+)")
 _ATTN_SHAPE = re.compile(r"B(\d+)xT(\d+)xH(\d+)xD(\d+)_(\w+?)_")
 _SERVING_SHAPE = re.compile(r"D(\d+)xH(\d+)xL(\d+)")
+_SEQATTN_SHAPE = re.compile(r"S(\d+)xH(\d+)xT(\d+)")
 
 
 def _bucketed_key(device_kind: str, dims, dtype_name: str) -> str:
@@ -281,6 +282,31 @@ def _seed_one_result(result: dict, source: str, out: list,
                                    for k, v in comp_ms.items()},
                  "spread_pct": spread})
 
+    # Sequence-axis attention impl (ISSUE 13): bench's ``seq_parallel``
+    # phase times the ONE plan-compiled step per candidate (ring's n-1
+    # ppermutes/layer vs Ulysses' all_to_all reshard), keyed
+    # shards x heads x LOCAL-T — the same key
+    # ParallelPlan.seq_attention resolves under. Spread-gated like
+    # every adoption.
+    m_sa = _SEQATTN_SHAPE.search(result.get("seq_parallel_attn_shape", ""))
+    sa_ms = result.get("seq_parallel_attn_ms")
+    if m_sa and isinstance(sa_ms, dict) and len(sa_ms) >= 2 and all(
+        isinstance(v, (int, float)) for v in sa_ms.values()
+    ):
+        from chainermn_tpu.tuning.measure import decide
+
+        if "seq_parallel_attn_spread_pct" in result:
+            spread = float(result["seq_parallel_attn_spread_pct"])
+        else:
+            spread = 10.0  # on-accel single sample: the noise floor
+        winner = decide(sa_ms, {k: spread for k in sa_ms})
+        if winner is not None:
+            key = _bucketed_key(kind, m_sa.groups(), "seqattn")
+            put("seq_attn_impl", key, winner,
+                {"candidates_ms": {k: round(float(v), 4)
+                                   for k, v in sa_ms.items()},
+                 "spread_pct": spread})
+
     # Serving decode decisions (ISSUE 4/5/7): bench's ``serving`` and
     # ``serving_prefix`` phases record per-candidate medians keyed by
     # the engine's own decision key material (``serving_model_shape``
@@ -302,7 +328,9 @@ def _seed_one_result(result: dict, source: str, out: list,
         result.get("serving_cluster_model_shape", "")) or m)
     m_bu = (_SERVING_SHAPE.search(
         result.get("serving_burst_model_shape", "")) or m)
-    if m or m_px or m_cl or m_bu:
+    m_sp = (_SERVING_SHAPE.search(
+        result.get("seq_parallel_model_shape", "")) or m)
+    if m or m_px or m_cl or m_bu or m_sp:
         from chainermn_tpu.tuning.measure import decide
 
         for row_key, spread_key, name in (
@@ -320,6 +348,8 @@ def _seed_one_result(result: dict, source: str, out: list,
              "serving_cluster_disagg_spread_pct", "cluster_disagg"),
             ("serving_burst_chunk_ms",
              "serving_burst_spread_pct", "prefill_chunk"),
+            ("seq_parallel_ttft_ms",
+             "seq_parallel_spread_pct", "prefill_seq_parallel"),
         ):
             rows = result.get(row_key)
             if not (isinstance(rows, dict) and len(rows) >= 2 and all(
@@ -345,6 +375,8 @@ def _seed_one_result(result: dict, source: str, out: list,
                     m_row = m_cl
                 elif name == "prefill_chunk":
                     m_row = m_bu
+                elif name == "prefill_seq_parallel":
+                    m_row = m_sp
                 else:
                     m_row = m
                 if m_row is None:
@@ -390,6 +422,13 @@ def _seed_one_result(result: dict, source: str, out: list,
                         v = result.get(row)
                         if v is not None:
                             evidence[ev_key] = v
+                if name == "prefill_seq_parallel":
+                    # the per-shard-count TTFT curve behind the off/on
+                    # ranking (ISSUE 13) — auditable evidence for the
+                    # wide-prefill adoption.
+                    v = result.get("seq_parallel_ttft_shards_ms")
+                    if v is not None:
+                        evidence["ttft_shards_ms"] = v
                 put(name, key, winner, evidence)
 
     # Double buffering: the measured on/off step-time ratio.

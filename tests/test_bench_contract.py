@@ -407,6 +407,80 @@ def test_serving_burst_rows_contract_and_seeding(tmp_path):
         seed_from_bench_details(str(details), str(cache2)))
 
 
+def test_seq_parallel_rows_contract_and_seeding(tmp_path):
+    """ISSUE 13 satellite: the ``seq_parallel`` phase's headline rows
+    ride the compact line (selected prefill mode + off/on TTFT + spread
+    gate), the phase is wired into the supplementary chain, and
+    ``tuning seed`` learns BOTH new decisions — ``seq_attn_impl`` from
+    the ring-vs-ulysses step medians (keyed shards x heads x local-T,
+    the plan resolver's own key) and ``prefill_seq_parallel`` from the
+    long-prompt TTFT rows (the serving decision key) — spread-gated
+    exactly like the in-run adoption, with the per-shard TTFT curve
+    carried as evidence."""
+    for k in ("seq_parallel_selected", "seq_parallel_ttft_ms",
+              "seq_parallel_spread_pct"):
+        assert k in bench._COMPACT_KEYS, k
+    assert callable(bench._bench_seq_parallel)
+    import inspect
+
+    src = inspect.getsource(bench._run_bench)
+    assert 'supp("seq_parallel", "seq_parallel_error"' in src
+
+    from chainermn_tpu.tuning.cache import (
+        load_cache,
+        seed_from_bench_details,
+    )
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-04T00:00:00Z",
+        "seq_parallel_attn_shape": "S4xH8xT512",
+        "seq_parallel_attn_ms": {"ring": 2.0, "ulysses": 3.1},
+        "seq_parallel_attn_spread_pct": 5.0,
+        "seq_parallel_model_shape": "D512xH8xL2048",
+        "seq_parallel_ttft_ms": {"off": 40.0, "on": 14.0},
+        "seq_parallel_spread_pct": 6.0,
+        "seq_parallel_ttft_shards_ms": {"1": 40.0, "2": 22.0, "4": 14.0},
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert "seq_attn_impl|TPU v5 lite|4x8x512|seqattn -> ring" in seeded
+    assert ("prefill_seq_parallel|TPU v5 lite|512x8x2048|decode -> on"
+            in seeded)
+    entry = load_cache(str(cache))["decisions"][
+        "prefill_seq_parallel|TPU v5 lite|512x8x2048|decode"]
+    assert entry["ttft_shards_ms"] == {"1": 40.0, "2": 22.0, "4": 14.0}
+    assert entry["candidates_ms"]["on"] == 14.0
+
+    # spread-dominated rows are refused (noise-band "winner") — the
+    # table defaults (ring / off) stand, the honest-refusal precedent
+    doc["seq_parallel_ttft_ms"] = {"off": 14.2, "on": 14.0}
+    doc["seq_parallel_spread_pct"] = 12.0
+    doc["seq_parallel_attn_ms"] = {"ring": 2.0, "ulysses": 2.05}
+    doc["seq_parallel_attn_spread_pct"] = 11.0
+    details.write_text(json.dumps(doc))
+    cache2 = tmp_path / "cache2.json"
+    seeded2 = "\n".join(seed_from_bench_details(str(details),
+                                                str(cache2)))
+    assert "prefill_seq_parallel" not in seeded2
+    assert "seq_attn_impl" not in seeded2
+
+    # ABSENT spread = on-accel single sample: the 10% floor applies
+    doc.pop("seq_parallel_spread_pct")
+    doc.pop("seq_parallel_attn_spread_pct")
+    doc["seq_parallel_ttft_ms"] = {"off": 15.0, "on": 14.0}
+    details.write_text(json.dumps(doc))
+    assert "prefill_seq_parallel" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+    doc["seq_parallel_ttft_ms"] = {"off": 40.0, "on": 14.0}
+    details.write_text(json.dumps(doc))
+    assert ("prefill_seq_parallel|TPU v5 lite|512x8x2048|decode -> on"
+            in "\n".join(seed_from_bench_details(str(details),
+                                                 str(cache2))))
+
+
 def test_transformer_knob_env_validation(monkeypatch):
     """The accel transformer knobs reject malformed env values with a
     message naming the variable (a bare ZeroDivisionError from

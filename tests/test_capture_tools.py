@@ -166,12 +166,17 @@ def _golden_trace_lines():
         # events carrying the composition signature (rs -> ar -> ag:
         # the scatter and gather carry the full bucket, the shard
         # allreduce 1/4 of it), grouped by signature in the overlap
-        # section's per-stage table.
+        # section's per-stage table. The rs/ag stages additionally
+        # carry MEASURED dur_s (ISSUE 13: the eager
+        # MeasuredComposedReducer pattern) — the stage rows then gain a
+        # dur_ms column; the ar stage stays layout-only (no dur), so
+        # the table renders mixed measured/unmeasured rows.
         {"schema": 1, "kind": "wire", "t": 2.12, "pid": 1, "rank": 0,
          "schedule": "two_level", "composition": "rs(a1)>ar(a0)>ag(a1)",
          "stage": "rs(a1)", "stage_index": 0, "stage_op": "reduce-scatter",
          "bucket": 0, "n_buckets": 1, "nbytes": 2048,
-         "wire_dtype": "bfloat16", "overlapped": False},
+         "wire_dtype": "bfloat16", "overlapped": False,
+         "dur_s": 0.0015},
         {"schema": 1, "kind": "wire", "t": 2.13, "pid": 1, "rank": 0,
          "schedule": "two_level", "composition": "rs(a1)>ar(a0)>ag(a1)",
          "stage": "ar(a0)", "stage_index": 1, "stage_op": "all-reduce",
@@ -181,7 +186,8 @@ def _golden_trace_lines():
          "schedule": "two_level", "composition": "rs(a1)>ar(a0)>ag(a1)",
          "stage": "ag(a1)", "stage_index": 2, "stage_op": "all-gather",
          "bucket": 0, "n_buckets": 1, "nbytes": 2048,
-         "wire_dtype": "bfloat16", "overlapped": False},
+         "wire_dtype": "bfloat16", "overlapped": False,
+         "dur_s": 0.0005},
         # ISSUE 4: one request through the serving scheduler — queue
         # wait, bucketed prefill (its sampled token counts as generated;
         # ttft_s = submit -> first token, ISSUE 5), three decode steps
@@ -299,15 +305,18 @@ def test_trace_report_contract(tmp_path):
             # ISSUE 12: the composed bucket's per-stage table, grouped
             # by composition signature (2048 + 512 + 2048 wire bytes
             # over the three stages of one bucket).
+            # ISSUE 13: stage rows carry dur_ms where measured events
+            # (dur_s — the eager MeasuredComposedReducer) exist; a
+            # layout-only stage row simply has no dur_ms key.
             "compositions": {"rs(a1)>ar(a0)>ag(a1)": {
                 "schedule": "two_level", "buckets": 1, "nbytes": 4608,
                 "overlapped": 0,
                 "stages": {
                     "rs(a1)": {"op": "reduce-scatter", "n": 1,
-                               "nbytes": 2048},
+                               "nbytes": 2048, "dur_ms": 1.5},
                     "ar(a0)": {"op": "all-reduce", "n": 1, "nbytes": 512},
                     "ag(a1)": {"op": "all-gather", "n": 1,
-                               "nbytes": 2048},
+                               "nbytes": 2048, "dur_ms": 0.5},
                 },
             }},
             "measured": {"n": 2, "comm_ms_total": 8.0,
@@ -378,8 +387,9 @@ def test_trace_report_contract(tmp_path):
                   "comm/compute overlap", "50.0% hidden",
                   "composed rs(a1)>ar(a0)>ag(a1) [two_level]: "
                   "1 bucket(s), 4.5 KiB wire",
-                  "rs(a1) [reduce-scatter]: n=1, 2.0 KiB",
+                  "rs(a1) [reduce-scatter]: n=1, 2.0 KiB, 1.500 ms",
                   "ar(a0) [all-reduce]: n=1, 512 B",
+                  "ag(a1) [all-gather]: n=1, 2.0 KiB, 0.500 ms",
                   "serving (continuous batching)", "tokens/s: 227.27",
                   "p50 4.000 ms, p99 6.000 ms", "33.3% mean",
                   "TTFT: p50 12.000 ms, p99 12.000 ms",

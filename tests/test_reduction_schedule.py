@@ -593,6 +593,56 @@ class TestOverlappedBucketReducer:
         with pytest.raises(RuntimeError, match="no dispatched"):
             red.collect()
 
+    def test_measured_composed_reducer(self, comm):
+        """ISSUE 13 satellite (PR 11 follow-up): the eager per-STAGE
+        composed executor — mean correct for every derived pipeline,
+        one measured ``wire`` event per stage carrying the composition
+        signature + ``dur_s``, and the overlap rollup's per-signature
+        stage rows gain the measured ``dur_ms`` column."""
+        from chainermn_tpu.parallel.reduction_schedule import (
+            MeasuredComposedReducer,
+        )
+
+        rec = trace.enable(None)
+        rs = np.random.RandomState(5)
+        stacked = {
+            "a": jnp.asarray(rs.randn(N, 33), jnp.float32),
+            "b": jnp.asarray(rs.randn(N, 4, 2), jnp.float32),
+        }
+        for sched, n_stages in (("flat", 1), ("two_level", 2)):
+            red = MeasuredComposedReducer(comm, schedule=sched)
+            out = red.reduce(stacked)
+            jax.tree.map(
+                lambda o, g: np.testing.assert_allclose(
+                    np.asarray(o), np.asarray(g).mean(0),
+                    rtol=1e-5, atol=1e-6,
+                ),
+                out, stacked,
+            )
+            sig = red.comp.signature()
+            wires = [e for e in rec.events
+                     if e["kind"] == "wire"
+                     and e.get("composition") == sig]
+            assert len(wires) == n_stages, (sig, wires)
+            for i, w in enumerate(wires):
+                assert w["schedule"] == "composed_eager"
+                assert w["stage_index"] == i
+                assert w["dur_s"] >= 0
+                assert w["nbytes"] > 0
+        ov = trace.summarize_overlap(rec.events)
+        for sig, row in ov["compositions"].items():
+            for st, srow in row["stages"].items():
+                assert srow.get("dur_ms") is not None, (sig, st)
+
+    def test_measured_composed_refuses_update_stage(self, comm):
+        from chainermn_tpu.parallel.composition import CompositionError
+        from chainermn_tpu.parallel.reduction_schedule import (
+            MeasuredComposedReducer,
+        )
+
+        with pytest.raises(CompositionError, match="sharded_update"):
+            MeasuredComposedReducer(comm, schedule="zero")
+
     def test_staleness_one_loop_matches_reference(self, comm):
         """The reducer's intended double-buffered usage: dispatch step
         t, collect at t+1 — each step's mean arrives exactly once, one

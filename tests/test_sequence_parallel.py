@@ -1036,6 +1036,23 @@ class TestSeqPlanAxis:
         assert plan2.batch_spec() == P(None, "seq")
 
 
+def test_dryrun_phase_table_wires_seq_parallel_phase():
+    """Satellite: dryrun phase N (8-device data x seq plan vs
+    single-device ref + seq-parallel prefill streams == generate) is in
+    __graft_entry__'s phase table, and tools/byte_audit.py carries the
+    ring's per-hop K/V byte rows."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = open(os.path.join(root, "__graft_entry__.py")).read()
+    assert "_phase_seq_parallel" in src
+    assert ('"N:seq-axis plan + seq-parallel prefill", '
+            "_phase_seq_parallel" in src)
+    audit = open(os.path.join(root, "tools", "byte_audit.py")).read()
+    assert "_seq_ring_bytes" in audit
+    assert "per_hop_kv_bytes" in audit
+
+
 class TestUlyssesWindow:
     def test_ulysses_window_matches_single_device(self, comm):
         from chainermn_tpu.parallel.ulysses import make_ulysses_attention

@@ -210,6 +210,144 @@ class TestTensorParallel:
             assert txt.count(op) == 0, f"unexpected {op} in decode step"
 
 
+class TestSeqParallelPrefill:
+    """ISSUE 13: sequence-parallel prefill over the mesh's 'model'
+    partition — streams must stay bit-identical to sequential
+    ``generate`` across dense/paged x prefix-cache on/off, the trie-hit
+    path must compose (hit -> monolithic tail, miss -> wide), and the
+    explicit-'on' capability gates must reject loudly."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return Mesh(np.array(jax.devices("cpu")[:2]), ("model",))
+
+    def _long_requests(self, n, seed=0):
+        rs = np.random.RandomState(seed)
+        out = []
+        for _ in range(n):
+            p_len = int(rs.randint(8, 20))
+            out.append((rs.randint(1, VOCAB, size=p_len).tolist(),
+                        int(rs.randint(1, 6))))
+        return out
+
+    @pytest.mark.parametrize("impl,prefix", [
+        ("dense", "off"), ("paged", "off"), ("paged", "on"),
+    ])
+    def test_streams_bit_identical_to_generate(self, lm, mesh, impl,
+                                               prefix):
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl=impl,
+            kv_block_size=8, prefill_buckets=(8, 16), mesh=mesh,
+            prefix_cache=prefix, prefill_seq_parallel="on",
+        )
+        reqs = self._long_requests(4)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        # the wide path actually ran, and its compiles stay bounded by
+        # the shard-rounded bucket ladder
+        assert engine.last_prefill_seq_parallel is True
+        assert engine.seq_prefill_compile_count() <= 2
+        assert engine.decode_compile_count() == 1
+        assert engine._seq_attn_impl == "ring"  # the table default
+
+    def test_gqa_streams_match(self, mesh):
+        model = tiny_lm(num_kv_heads=2)
+        params = model.init(
+            jax.random.PRNGKey(6), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(8, 16), mesh=mesh,
+            prefill_seq_parallel="on",
+        )
+        reqs = self._long_requests(3, seed=21)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+
+    def test_prefix_hit_takes_monolithic_tail_and_streams_match(
+        self, lm, mesh
+    ):
+        """Composition with the prefix cache: the MISS goes wide, a
+        trie HIT (its context lives in adopted blocks the sharded
+        forward cannot see) takes the monolithic tail — both streams
+        equal to sequential generate."""
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(8, 16), mesh=mesh,
+            prefix_cache="on", min_shared_blocks=1,
+            prefill_seq_parallel="on",
+        )
+        prompt = np.random.RandomState(7).randint(
+            1, VOCAB, size=18
+        ).tolist()
+        want = _generate_ref(model, params, prompt, 4)
+        streams, _ = _run_stream(engine, [(prompt, 4)])
+        assert streams[0] == want
+        assert engine.last_prefill_seq_parallel is True  # miss: wide
+        streams2, _ = _run_stream(engine, [(prompt, 4)])
+        assert streams2[0] == want
+        assert engine.prefix_stats["hits"] >= 1
+        assert engine.last_prefill_seq_parallel is False  # hit: tail
+
+    def test_scheduler_prefill_event_carries_seq_parallel(self, lm,
+                                                          mesh):
+        model, params = lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(8, 16), mesh=mesh,
+            prefill_seq_parallel="on",
+        )
+        _, sched = _run_stream(engine, self._long_requests(2, seed=5))
+        evs = [e for e in sched.event_window
+               if e.get("phase") == "prefill"]
+        assert evs and all(e.get("seq_parallel") for e in evs)
+
+    def test_unshard_roundtrip(self, lm):
+        from chainermn_tpu.serving.engine import (
+            shard_lm_params,
+            unshard_lm_params,
+        )
+
+        model, params = lm
+        stacked = shard_lm_params(model, {"params": params["params"]}, 2)
+        full = unshard_lm_params(model, stacked)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-7, atol=1e-7
+            ),
+            full, {"params": params["params"]},
+        )
+
+    def test_explicit_on_capability_gates(self, lm, mesh):
+        model, params = lm
+        with pytest.raises(ValueError, match="mesh"):
+            ServingEngine(model, params, num_slots=2, max_len=32,
+                          prefill_seq_parallel="on")
+        with pytest.raises(ValueError, match="greedy"):
+            ServingEngine(model, params, num_slots=2, max_len=32,
+                          mesh=mesh, temperature=0.7,
+                          prefill_seq_parallel="on")
+        with pytest.raises(ValueError, match="chunked"):
+            ServingEngine(model, params, num_slots=2, max_len=32,
+                          mesh=mesh, prefill_chunk=8,
+                          prefill_seq_parallel="on")
+        with pytest.raises(ValueError, match="prefill_seq_parallel"):
+            ServingEngine(model, params, num_slots=2, max_len=32,
+                          prefill_seq_parallel="sideways")
+        # 'auto' resolves through the registry: table default off, with
+        # the decision recorded
+        engine = ServingEngine(model, params, num_slots=2, max_len=32)
+        recs = [d for d in engine.decisions
+                if d["name"] == "prefill_seq_parallel"]
+        assert recs and recs[-1]["winner"] == "off"
+        assert engine.prefill_seq_parallel is False
+
+
 class TestNoRecompile:
     def test_decode_step_compiles_exactly_once_across_churn(self, lm):
         """The tentpole's shape discipline, pinned: joins/leaves/ragged

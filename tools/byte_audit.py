@@ -121,6 +121,37 @@ def _floors(rec: dict, steps_in_program: int) -> None:
             )
 
 
+def _seq_ring_bytes(model, B: int, T: int, n: int) -> dict:
+    """The seq-axis ring's wire-byte accounting for this workload at
+    ``n`` sequence shards (ISSUE 13): per hop the unrolled plan ring
+    (``seq_ring_attention_local``) moves the stacked (K, V) pair of one
+    shard's slice — ``2 * B * T/n * kv_heads * head_dim`` elements — as
+    ONE collective-permute; a forward pass is ``n-1`` hops per layer,
+    the backward ``(n-1) + n`` (kv ring + the travelling dk/dv
+    accumulator). These bytes cross the ICI neighbour links, NOT HBM,
+    so they are reported as roofline INPUTS (floor them against the
+    device's ICI bandwidth when sizing a mesh), not folded into the
+    HBM floors above."""
+    import numpy as np
+
+    kv_heads = model.num_kv_heads or model.num_heads
+    head_dim = model.d_model // model.num_heads
+    try:
+        itemsize = np.dtype(model.compute_dtype).itemsize
+    except TypeError:
+        itemsize = 2  # bfloat16: not a numpy dtype, 2 wire bytes
+    per_hop = 2 * B * (T // n) * kv_heads * head_dim * itemsize
+    layers = model.num_layers
+    return {
+        "shards": n,
+        "per_hop_kv_bytes": per_hop,
+        "hops_per_layer_fwd": n - 1,
+        "hops_per_layer_bwd": 2 * n - 1,
+        "ring_bytes_per_step": per_hop * (3 * n - 2) * layers,
+        "plane": "ici (neighbour exchange; not an HBM floor)",
+    }
+
+
 def audit_transformer(remat: str, batch: int, chunks: int) -> dict:
     """AOT-compile the LM-scale bench transformer step — the VERY
     workload ``bench._bench_transformer`` times, via the shared
@@ -173,6 +204,11 @@ def audit_transformer(remat: str, batch: int, chunks: int) -> dict:
     rec["model_flops_per_step"] = model_flops
     rec["model_compute_floor_ms"] = round(
         model_flops / peak_flops * 1e3, 1)
+    # ISSUE 13: the seq-axis ring's per-hop K/V wire bytes for THIS
+    # workload — the ICI-side roofline input for long-context sharding.
+    n_seq = int(os.environ.get("CHAINERMN_AUDIT_SEQ_SHARDS", "4"))
+    if n_seq > 1 and T % n_seq == 0:
+        rec["seq_ring"] = _seq_ring_bytes(model, B, T, n_seq)
     return rec
 
 
@@ -212,6 +248,11 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--chunks", type=int, default=16)
     ap.add_argument(
+        "--seq-shards", type=int, default=4,
+        help="seq-axis shard count for the transformer audit's "
+             "seq_ring wire-byte rows (ISSUE 13); the ring's per-hop "
+             "K/V bytes are ICI-plane roofline inputs")
+    ap.add_argument(
         "--target", choices=["auto", "cpu"], default="auto",
         help="cpu: pin the CPU backend before first device use "
              "(conftest's recipe) — FLOPs are backend-honest either way "
@@ -222,6 +263,7 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    os.environ["CHAINERMN_AUDIT_SEQ_SHARDS"] = str(args.seq_shards)
     if args.workload == "transformer":
         rec = audit_transformer(
             args.remat, args.batch or 16, args.chunks)
