@@ -859,6 +859,27 @@ def seq_ring_attention_local(
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = _use_interpret()
+    try:
+        from chainermn_tpu.observability import trace as _trace
+
+        rec = _trace.active()
+    except Exception:
+        rec = None
+    if rec is not None:
+        # Trace-time layout event (the in-jit bucketed schedules'
+        # convention — what the compiled program COMMITTED to, once per
+        # compile, no duration): one forward ring pass moves the
+        # stacked (K, V) pair n-1 hops; overlapped=True because the
+        # hop is issued before the step's kernels (async
+        # collective-permute rides behind compute by construction).
+        n = lax.axis_size(axis_name)
+        per_hop = 2 * k.size * jnp.dtype(k.dtype).itemsize
+        rec.event(
+            "wire", schedule="seq_ring", axis=str(axis_name),
+            hops=n - 1, bucket=0, n_buckets=1,
+            nbytes=per_hop * (n - 1),
+            wire_dtype=str(k.dtype), overlapped=True,
+        )
     return _seq_ring(q, k, v, axis_name, bool(causal), float(scale),
                      int(block_q), int(block_k), bool(interpret))
 

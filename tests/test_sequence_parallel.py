@@ -726,6 +726,34 @@ class TestSeqRingLocal:
             g, g_ref,
         )
 
+    def test_seq_ring_wire_event(self, comm):
+        """Tracing a seq-ring program emits ONE trace-time ``seq_ring``
+        wire layout event per compile: n-1 hops of the stacked (K, V)
+        pair, overlapped=True (the hop is issued before the step's
+        kernels) — what the observability overlap rollup groups under
+        'seq_ring'."""
+        from chainermn_tpu.observability import trace
+
+        rec = trace.enable(None)
+        try:
+            q, k, v = _qkv(45)
+            self._dist(comm, q, k, v)
+            wires = [e for e in rec.events
+                     if e.get("kind") == "wire"
+                     and e.get("schedule") == "seq_ring"]
+            assert len(wires) == 1
+            w = wires[0]
+            n = comm.size
+            assert w["hops"] == n - 1
+            # per hop: the stacked K+V local shards
+            per_hop = 2 * (B * (T // n) * H * D) * 4
+            assert w["nbytes"] == per_hop * (n - 1)
+            assert w["overlapped"] is True
+            ov = trace.summarize_overlap(rec.events)
+            assert "seq_ring" in ov["schedules"]
+        finally:
+            trace.disable()
+
     def test_hop_counts_pinned(self, comm):
         """The structural claim the plan's acceptance rests on: n-1
         collective-permutes per FORWARD ring pass (each hop one permute
