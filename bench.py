@@ -465,6 +465,8 @@ _COMPACT_KEYS = (
     "serving_burst_spread_pct", "serving_burst_selected",
     "seq_parallel_selected", "seq_parallel_ttft_ms",
     "seq_parallel_spread_pct",
+    "serving_tenants_goodput", "serving_tenants_fairness",
+    "serving_tenants_spread_pct", "serving_tenants_selected",
 )
 
 
@@ -1913,6 +1915,238 @@ def _bench_serving_burst(comm, on_accel: bool):
             "CPU-proxy honest floor: tiny LM, ms-scale open-loop gaps "
             "— the goodput ranking holds for THIS backend; absolute "
             "tokens/s is not chip throughput"
+        )
+    return out
+
+
+def _bench_serving_tenants(comm, on_accel: bool):
+    """ISSUE 14: mixed-tenant adapter serving — N tenants' low-rank
+    deltas over one base model, Zipf-skewed offered load, shared
+    per-tenant system prompts (the namespaced prefix cache's food),
+    deficit-round-robin fair-share admission.
+
+    The run is SATURATED and wall-bounded (``max_seconds``) so the
+    fairness property is actually exercised: the queue holds a
+    Zipf-skewed backlog, and equal-weight DRR admission should serve
+    tenants near-evenly regardless — Jain's index over the per-tenant
+    served-token totals is the measured verdict, not prose. Rows
+    (CPU-proxy convention: median-of-n>=3 + spread):
+
+    1. ``serving_tenants_goodput`` — generated tokens / wall for the
+       mixed-tenant gather engine;
+    2. ``serving_tenants_fairness`` — Jain over per-tenant served
+       tokens (1.0 = perfectly even service under the skewed backlog);
+    3. ``serving_tenants_ttft_p99_ms`` — per-tenant p99 TTFT from the
+       rollup (details file);
+    4. ``serving_tenants_adapter_ms`` — ms per generated token serving
+       the DOMINANT tenant's stream set via the gather bank vs a
+       merged (weights-folded) engine — adopted as this shape's
+       ``adapter_impl`` decision via ``record_measurement``
+       (spread-gated: a noise-band winner is honestly refused and the
+       table default ``gather`` stands, the PR 4/5/7/8/10 precedent).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.observability.stats import jain_index
+    from chainermn_tpu.serving import (
+        ADAPTER_IMPLS,
+        AdapterBank,
+        Request,
+        Scheduler,
+        ServingEngine,
+        random_adapter,
+        serving_decision_key,
+    )
+
+    if on_accel:
+        layers, d_model, heads, d_ff = 4, 512, 8, 2048
+        vocab, max_len, slots = 32000, 512, 8
+        block_size, sys_len, tail_len = 32, 64, 8
+        n_tenants, n_requests, gen = 4, 48, 24
+        max_seconds = 20.0
+        dtype = jnp.bfloat16
+    else:
+        layers, d_model, heads, d_ff = 2, 64, 4, 128
+        vocab, max_len, slots = 256, 64, 4
+        block_size, sys_len, tail_len = 8, 16, 4
+        # Offered load deliberately exceeds what the wall bound can
+        # serve (every tenant's backlog outlives the window on an idle
+        # box): the queue stays backlogged for ALL tenants, so the
+        # fairness index measures the ADMISSION policy — an FCFS run
+        # would reproduce the offered Zipf skew (~0.77), fair-share
+        # should push toward 1.0.
+        n_tenants, n_requests, gen = 3, 120, 16
+        max_seconds = 0.2
+        dtype = jnp.float32
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        d_model=d_model, d_ff=d_ff, max_len=max_len, compute_dtype=dtype,
+    )
+    params = jax.jit(
+        functools.partial(model.init, train=False)
+    )(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    tenants = [f"tenant{i}" for i in range(n_tenants)]
+    bank = AdapterBank(model, capacity=n_tenants + 1, rank=2)
+    for i, t in enumerate(tenants):
+        bank.register(t, random_adapter(model, 2, seed=100 + i,
+                                        scale=0.5))
+    weights = {t: 1.0 for t in tenants}
+
+    # Zipf-skewed offered load over a shared per-tenant system prompt
+    # plus a unique tail — one seeded schedule for every repeat/arm.
+    rs = np.random.RandomState(23)
+    sys_prompts = {t: rs.randint(1, vocab, size=sys_len).tolist()
+                   for t in tenants}
+    zipf_w = np.array([1.0 / (i + 1) ** 1.2 for i in range(n_tenants)])
+    zipf_w /= zipf_w.sum()
+    order = rs.choice(n_tenants, size=n_requests, p=zipf_w)
+    reqs_spec = [
+        (tenants[int(i)],
+         sys_prompts[tenants[int(i)]]
+         + rs.randint(1, vocab, size=tail_len).tolist())
+        for i in order
+    ]
+
+    engine = ServingEngine(
+        model, params, num_slots=slots, max_len=max_len,
+        decode_impl="paged", kv_block_size=block_size,
+        prefill_buckets=(8, 16, 32), spec_tokens=0, prefix_cache="on",
+        min_shared_blocks=1, prefill_chunk=0,
+        prefill_seq_parallel="off", adapter_bank=bank,
+        adapter_impl="gather",
+    )
+
+    def run_mixed(bound, fair: bool = True):
+        sched = Scheduler(engine, policy="prefill_priority",
+                          tenant_weights=dict(weights) if fair
+                          else None)
+        for t, p in reqs_spec:
+            sched.submit(Request(prompt=p, max_new_tokens=gen,
+                                 tenant_id=t))
+        sched.run(max_seconds=bound)
+        s = sched.summary()
+        # The wall bound leaves work in flight by design (saturation);
+        # release the engine's slots so the next repeat starts from a
+        # clean array instead of raising on a full engine.
+        for slot in range(engine.num_slots):
+            if engine._active[slot]:
+                engine.leave(slot)
+        wall = s.get("wall_s") or 1e-9
+        per_tenant = {
+            t: row["generated_tokens"]
+            for t, row in (s.get("tenants") or {}).items()
+        }
+        fairness = jain_index([
+            per_tenant.get(t, 0) / weights[t] for t in tenants
+        ])
+        return {
+            "goodput": round((s.get("generated_tokens") or 0) / wall, 2),
+            "fairness": round(fairness, 4) if fairness is not None
+            else None,
+            "ttft_p99": {t: (s.get("tenants") or {}).get(
+                t, {}).get("ttft_ms_p99") for t in tenants},
+        }
+
+    run_mixed(max_seconds)  # compile + trie warm
+    rows = [run_mixed(max_seconds) for _ in range(1 if on_accel else 3)]
+    rows.sort(key=lambda r: r["goodput"])
+    med = rows[len(rows) // 2]
+    vals = [r["goodput"] for r in rows]
+    spread = None
+    if len(rows) > 1 and med["goodput"]:
+        spread = round(100.0 * (vals[-1] - vals[0]) / med["goodput"], 1)
+
+    # The FCFS contrast row: same backlog, fair share off — the
+    # fairness delta is the admission policy's measured contribution.
+    fifo = run_mixed(max_seconds, fair=False)
+
+    out = {
+        "serving_tenants_model_shape": f"D{d_model}xH{heads}xL{max_len}",
+        "serving_tenants_n": n_tenants,
+        "serving_tenants_requests": n_requests,
+        "serving_tenants_goodput": med["goodput"],
+        "serving_tenants_fairness": med["fairness"],
+        "serving_tenants_fairness_fifo": fifo["fairness"],
+        "serving_tenants_ttft_p99_ms": med["ttft_p99"],
+    }
+    if not on_accel and spread is not None:
+        out["serving_tenants_spread_pct"] = spread
+
+    # --- adapter_impl adoption: ms per generated token serving the
+    # DOMINANT tenant's streams — the per-slot gather vs the folded
+    # weights (the single-tenant-dominant question the decision asks).
+    try:
+        from chainermn_tpu import tuning
+
+        dom = tenants[0]
+        dom_reqs = [p for t, p in reqs_spec if t == dom][:slots + 2]
+
+        def run_dominant(eng):
+            sched = Scheduler(eng, policy="prefill_priority")
+            for p in dom_reqs:
+                sched.submit(Request(prompt=p, max_new_tokens=gen,
+                                     tenant_id=dom))
+            sched.run()
+            s = sched.summary()
+            toks = s.get("generated_tokens") or 1
+            return (s.get("wall_s") or 1e-9) / toks * 1e3
+
+        merged_eng = ServingEngine(
+            model, params, num_slots=slots, max_len=max_len,
+            decode_impl="paged", kv_block_size=block_size,
+            prefill_buckets=(8, 16, 32), spec_tokens=0,
+            prefix_cache="on", min_shared_blocks=1, prefill_chunk=0,
+            prefill_seq_parallel="off", adapter_bank=bank,
+            adapter_impl="merged", merged_tenant=dom,
+        )
+        arm_ms = {"gather": [], "merged": []}
+        run_dominant(engine)
+        run_dominant(merged_eng)  # compile both before timing
+        for _ in range(1 if on_accel else 3):
+            arm_ms["gather"].append(run_dominant(engine))
+            arm_ms["merged"].append(run_dominant(merged_eng))
+        med_ms = {}
+        arm_spreads = {}
+        for name, samples in arm_ms.items():
+            samples.sort()
+            m = samples[len(samples) // 2]
+            med_ms[name] = round(m, 4)
+            arm_spreads[name] = (
+                round(100.0 * (samples[-1] - samples[0]) / m, 1)
+                if len(samples) > 1 and m else 0.0)
+        out["serving_tenants_adapter_ms"] = med_ms
+        # The gather/merged arms' OWN spread, not the mixed-run goodput
+        # spread (review finding: the offline seed gated adapter_impl
+        # on serving_tenants_spread_pct, a different measurement — the
+        # live adoption below and a re-seed from this row could
+        # disagree on identical data).
+        if not on_accel:
+            out["serving_tenants_adapter_spread_pct"] = max(
+                arm_spreads.values())
+        key = serving_decision_key(d_model, heads, max_len)
+        tuning.record_measurement(
+            "adapter_impl", key, med_ms,
+            spreads=None if on_accel else {
+                k: max(arm_spreads.values()) for k in med_ms},
+        )
+        out["serving_tenants_selected"] = tuning.choice(
+            "adapter_impl", ADAPTER_IMPLS, key)
+        out["serving_tenants_merged_speedup"] = round(
+            med_ms["gather"] / med_ms["merged"], 3)
+    except Exception as e:
+        out["serving_tenants_autotune_error"] = (
+            f"{type(e).__name__}: {e}"[:160])
+    if not on_accel:
+        out["serving_tenants_note"] = (
+            "CPU-proxy honest floor: tiny LM + rank-2 adapters — the "
+            "fairness index and the gather/merged ranking hold for "
+            "THIS backend; absolute tokens/s is not chip throughput"
         )
     return out
 
@@ -3801,6 +4035,8 @@ def _run_bench(mode: str) -> None:
          lambda: _bench_serving_cluster(comm, on_accel))
     supp("serving_burst", "serving_burst_error",
          lambda: _bench_serving_burst(comm, on_accel))
+    supp("serving_tenants", "serving_tenants_error",
+         lambda: _bench_serving_tenants(comm, on_accel))
     # Last on purpose: this one spawns fresh child processes whose backend
     # init rolls the tunnel-flap dice — a stall here must only ever cost
     # this row, not any of the above.

@@ -104,6 +104,32 @@ class TransformerBlock(nn.Module):
     #: the paged/dense cache at true positions.
     sow_kv: bool = False
 
+    @staticmethod
+    def _lora_delta(name, adapters, inp, out):
+        """Add the low-rank delta ``(inp @ A) @ B`` for projection
+        ``name`` (ISSUE 14: multi-tenant adapters). ``adapters`` maps a
+        projection name to its ``(A, B)`` pair — either unbatched
+        ``[d_in, r]`` / ``[r, d_out]`` (one adapter for every row: the
+        sequential ``generate`` reference) or per-row ``[B, d_in, r]`` /
+        ``[B, r, d_out]`` (the serving engine's per-slot tenant gather).
+        The scale is pre-folded into ``B`` by the
+        :class:`~chainermn_tpu.serving.adapters.AdapterBank`, so both
+        paths consume the identical values. A zero A/B row contributes
+        an exact 0 — the zero-adapter tenant stays bitwise the base
+        model."""
+        if not adapters or name not in adapters:
+            return out
+        A, B = adapters[name]
+        A = A.astype(inp.dtype)
+        B = B.astype(inp.dtype)
+        if A.ndim == 2:  # shared adapter (reference path)
+            delta = (inp @ A) @ B
+        else:  # per-row gathered stacks (serving slot array)
+            delta = jnp.einsum(
+                "btr,bro->bto", jnp.einsum("btd,bdr->btr", inp, A), B
+            )
+        return out + delta.astype(out.dtype)
+
     def _decode_attend(self, qh, kh_new, vh_new, head_dim):
         """One-token attention against the mutable KV cache.
 
@@ -274,14 +300,15 @@ class TransformerBlock(nn.Module):
     def __call__(self, x, segment_ids=None, rope_positions=None,
                  train: bool = True, decode: bool = False,
                  decode_positions=None, block_tables=None,
-                 decode_slots=None):
+                 decode_slots=None, adapters=None):
         # ``train`` is positional so ``nn.remat(..., static_argnums=(4,))``
         # can mark it static. ``decode_positions`` ([B] int32 first-new
         # -token positions) selects the slot-array decode path
         # (:meth:`_slot_decode_attend`); ``block_tables`` ([B, max_blocks]
         # int32) feeds the paged layout; ``decode_slots`` ([B] int32) maps
         # token rows onto dense-cache rows (prefill of one slot out of
-        # many).
+        # many); ``adapters`` ({'qkv'|'proj'|'ff_up'|'ff_down': (A, B)})
+        # adds per-projection low-rank deltas (:meth:`_lora_delta`).
         D = x.shape[-1]
         head_dim = self.head_dim or D // self.num_heads
         kv_heads = self.num_kv_heads or self.num_heads
@@ -299,6 +326,11 @@ class TransformerBlock(nn.Module):
             (self.num_heads + 2 * kv_heads) * head_dim, use_bias=False,
             dtype=self.compute_dtype, param_dtype=jnp.float32, name="qkv",
         )(h)
+        # Column-parallel delta (ISSUE 14): h is replicated under TP
+        # (post copy_to_tp), the adapter's B is column-sharded like the
+        # qkv kernel — the delta lands on the shard's own columns, no
+        # new collective.
+        qkv = self._lora_delta("qkv", adapters, h, qkv)
         q, k, v = jnp.split(
             qkv,
             [self.num_heads * head_dim, (self.num_heads + kv_heads) * head_dim],
@@ -343,10 +375,16 @@ class TransformerBlock(nn.Module):
             kw = {} if segment_ids is None else {"segment_ids": segment_ids}
             o = attn(qh, kh, vh, causal=self.causal,
                      scale=head_dim**-0.5, **kw)
+        o_flat = o.reshape(B, T, self.num_heads * head_dim)
         o = nn.Dense(
             D, use_bias=False,
             dtype=self.compute_dtype, param_dtype=jnp.float32, name="proj",
-        )(o.reshape(B, T, self.num_heads * head_dim))
+        )(o_flat)
+        # Row-parallel delta (ISSUE 14): the adapter's A is sharded
+        # along the same local-head rows as the proj kernel, so the
+        # per-shard partial delta rides the existing psum below —
+        # exactly the pre-adapter collective set.
+        o = self._lora_delta("proj", adapters, o_flat, o)
         if self.tp_axis is not None:
             # Row-parallel output projection: the ONE psum of the
             # attention column→row pair.
@@ -358,14 +396,18 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
         if self.tp_axis is not None:
             h = copy_to_tp(h, self.tp_axis)
-        h = nn.Dense(
+        up = nn.Dense(
             self.d_ff, dtype=self.compute_dtype, param_dtype=jnp.float32,
             name="ff_up",
         )(h)
-        h = nn.gelu(h)
-        h = nn.Dense(
+        # Column-parallel (B sharded with the ff_up kernel's d_ff split).
+        h = nn.gelu(self._lora_delta("ff_up", adapters, h, up))
+        down = nn.Dense(
             D, dtype=self.compute_dtype, param_dtype=jnp.float32, name="ff_down",
         )(h)
+        # Row-parallel (A sharded with the ff_down kernel's d_ff rows;
+        # the partial delta rides the layer's second psum).
+        h = self._lora_delta("ff_down", adapters, h, down)
         if self.tp_axis is not None:
             # Row-parallel FFN down projection (psum #2 of the layer).
             # ff_down's bias rides INSIDE the reduce: the sharder stores
@@ -476,7 +518,7 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, *, segment_ids=None, positions=None,
                  train: bool = True, decode: bool = False,
                  decode_positions=None, block_tables=None,
-                 decode_slots=None):
+                 decode_slots=None, adapters=None):
         """``segment_ids`` (optional ``[B, T]``) confines attention to
         packed documents; requires a segment-capable ``attention_fn``
         (e.g. :func:`chainermn_tpu.ops.flash_attention.flash_attention`).
@@ -489,7 +531,14 @@ class TransformerLM(nn.Module):
         the slot-array path — per-row write positions, ``T >= 1``
         chunked prefill, paged/dense layouts, ``decode_slots`` row
         mapping — the serving engine's contract
-        (:mod:`chainermn_tpu.serving`)."""
+        (:mod:`chainermn_tpu.serving`).
+        ``adapters`` (optional, ISSUE 14): per-layer low-rank deltas —
+        a sequence of ``num_layers`` dicts, each mapping a hooked
+        projection (``qkv``/``proj``/``ff_up``/``ff_down``) to its
+        ``(A, B)`` pair (see :meth:`TransformerBlock._lora_delta` for
+        the unbatched vs per-row forms); the serving engine's
+        :class:`~chainermn_tpu.serving.adapters.AdapterBank` builds
+        both."""
         if segment_ids is not None and self.attention_fn is None:
             raise ValueError(
                 "segment_ids needs a segment-capable attention_fn — pass "
@@ -507,6 +556,11 @@ class TransformerLM(nn.Module):
             )
         if decode_positions is not None and not decode:
             raise ValueError("decode_positions requires decode=True")
+        if adapters is not None and len(adapters) != self.num_layers:
+            raise ValueError(
+                f"adapters covers {len(adapters)} layers, model has "
+                f"{self.num_layers}"
+            )
         B, T = tokens.shape
         if decode_positions is not None and positions is None:
             # Per-row global positions for rope / the learned table:
@@ -562,7 +616,8 @@ class TransformerLM(nn.Module):
                 sow_kv=self.sow_kv,
                 name=f"block_{i}",
             )(x, segment_ids, rope_positions, train, decode,
-              decode_positions, block_tables, decode_slots)
+              decode_positions, block_tables, decode_slots,
+              adapters[i] if adapters is not None else None)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
         if self.return_hidden:
             return x
@@ -760,7 +815,8 @@ def _tempered_filtered(logits, temperature, top_k, top_p):
 
 def generate(model: TransformerLM, params, prompt, n_steps: int, *,
              temperature: float = 0.0, rng=None, pad_id: int = 0,
-             top_k: Optional[int] = None, top_p: Optional[float] = None):
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             adapters=None):
     """Autoregressive generation with a per-block KV cache.
 
     TPU-first shape discipline: ONE jitted ``lax.scan`` of single-token
@@ -789,6 +845,11 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
         ``temperature > 0``.
       pad_id: padding token in ``prompt``; positions where every shorter
         row has run out of prompt switch to model continuations.
+      adapters: optional per-layer low-rank deltas (ISSUE 14) — the
+        unbatched ``(A, B)`` form shared by every row; the single-
+        tenant reference the serving engine's per-slot gather is pinned
+        against (``AdapterBank.adapter_arrays`` hands out exactly the
+        values the engine gathers, scale pre-folded).
 
     Returns:
       ``[B, n_steps]`` int32 tokens (prompt positions pass through).
@@ -820,6 +881,7 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
             {**params, "cache": cache}, tok[:, None],
             positions=jnp.full((1,), t, jnp.int32),
             train=False, decode=True, mutable=["cache"],
+            adapters=adapters,
         )
         logits = logits[:, 0]  # [B, vocab]
         key, sub = jax.random.split(key)

@@ -569,6 +569,15 @@ def _tap_event(ev: Mapping[str, Any]) -> None:
                     "serving_ttft_seconds",
                     "submit -> first token (the TTFT SLO)",
                 ).observe(float(ev["ttft_s"]))
+                if ev.get("tenant") is not None:
+                    # Per-tenant TTFT (ISSUE 14): the tenant label set
+                    # is bounded by adapter-bank capacity, so the
+                    # cardinality stays small by construction.
+                    reg.histogram(
+                        "serving_tenant_ttft_seconds",
+                        "submit -> first token per tenant",
+                    ).observe(float(ev["ttft_s"]),
+                              tenant=str(ev["tenant"]))
             reg.counter(
                 "serving_tokens_total", "generated tokens (first token "
                 "per prefill + decode-step tokens)"
@@ -590,6 +599,17 @@ def _tap_event(ev: Mapping[str, Any]) -> None:
         elif phase == "finish":
             reg.counter("serving_requests_total",
                         "completed serving requests").inc()
+            if ev.get("tenant") is not None:
+                reg.counter(
+                    "serving_tenant_requests_total",
+                    "completed serving requests per tenant",
+                ).inc(tenant=str(ev["tenant"]))
+                gen = ev.get("generated")
+                if gen:
+                    reg.counter(
+                        "serving_tenant_tokens_total",
+                        "generated tokens per tenant (from finishes)",
+                    ).inc(float(gen), tenant=str(ev["tenant"]))
             # SLO verdicts (ISSUE 11): one violation count per missed
             # target kind — a request can miss both.
             if ev.get("slo_ttft_ok") is False:

@@ -40,3 +40,24 @@ def nearest_rank(values: Sequence[float], q: float) -> Optional[float]:
         return None
     s = sorted(values)
     return s[nearest_rank_index(len(s), q)]
+
+
+def jain_index(values: Sequence[float]) -> Optional[float]:
+    """Jain's fairness index over per-tenant allocations (ISSUE 14):
+
+        J(x) = (sum x_i)^2 / (n * sum x_i^2)
+
+    1.0 = perfectly even, 1/n = one tenant took everything. ONE owner
+    shared by the serving rollup's tenant fairness, the scheduler's
+    summary, and bench's ``serving_tenants`` phase — pinned against a
+    literal numpy reference in tests/test_adapters.py. Pass allocations
+    pre-divided by weight to measure WEIGHTED fairness. None when
+    empty; an all-zero allocation reads as perfectly fair (nobody got
+    anything — 1.0, not a division error)."""
+    xs = [float(v) for v in values]
+    if not xs:
+        return None
+    sq = sum(x * x for x in xs)
+    if sq == 0.0:
+        return 1.0
+    return (sum(xs) ** 2) / (len(xs) * sq)

@@ -67,7 +67,7 @@ MAX_BUFFERED_EVENTS = 200_000
 # context (to avoid paying a jax import in a report tool) — load stats
 # the same way there.
 if __package__:
-    from chainermn_tpu.observability.stats import nearest_rank
+    from chainermn_tpu.observability.stats import jain_index, nearest_rank
 else:  # pragma: no cover - exercised via tools/trace_report.py
     import importlib.util as _ilu
 
@@ -79,6 +79,7 @@ else:  # pragma: no cover - exercised via tools/trace_report.py
     _mod = _ilu.module_from_spec(_spec)
     _spec.loader.exec_module(_mod)
     nearest_rank = _mod.nearest_rank
+    jain_index = _mod.jain_index
 
 #: Event sinks (ISSUE 6): callables ``sink(event_dict)`` invoked for
 #: every event ANY recorder emits — the metrics tap and the flight ring
@@ -554,7 +555,16 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
       lookups, prompt vs prefilled vs cache-served token totals
       (``prefilled_tokens`` is the MEASURED prefill work — the bench
       acceptance reads it, not prose), ``hit_token_rate`` = hit tokens
-      / prompt tokens, and total ``cow_blocks`` copied.
+      / prompt tokens, and total ``cow_blocks`` copied;
+    - ``tenants`` (present when any prefill/finish event exists,
+      ISSUE 14) = per-tenant rollup — requests, generated tokens,
+      TTFT/TPOT p50/p99, SLO attainment where targets were stated —
+      keyed by the events' ``tenant`` field with a ``'default'``
+      fallback, so pre-tenant traces keep parsing (they roll up as one
+      ``'default'`` tenant); ``tenant_fairness_jain`` = Jain's index
+      over the per-tenant generated-token totals
+      (:func:`~chainermn_tpu.observability.stats.jain_index` — 1.0 for
+      a single tenant by construction).
 
     Returns None when the trace carries no serving events."""
     queue_waits: list[float] = []
@@ -575,6 +585,8 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
     accept_hist: dict = {}
     px_lookups = px_hits = 0
     px_hit_tokens = px_prompt_tokens = px_prefill_tokens = px_cow = 0
+    tenant_ttfts: dict = {}
+    tenant_fin: dict = {}
     for ev in events:
         kind = ev.get("kind")
         if kind == "prefill_chunk":
@@ -608,6 +620,9 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
             prefills.append(dur)
             if ev.get("ttft_s") is not None:
                 ttfts.append(float(ev["ttft_s"]))
+                tenant_ttfts.setdefault(
+                    ev.get("tenant") or "default", []
+                ).append(float(ev["ttft_s"]))
                 rid = ev.get("request")
                 if rid is not None and rid not in ttft_by_req:
                     ttft_by_req[rid] = float(ev["ttft_s"])
@@ -643,6 +658,21 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
             slo_total += 1
             if all(verdicts):
                 slo_ok += 1
+        # Per-tenant accumulation (ISSUE 14): the 'default' fallback
+        # keeps pre-tenant traces rolling up as one tenant.
+        tf = tenant_fin.setdefault(
+            ev.get("tenant") or "default",
+            {"requests": 0, "tokens": 0, "tpots": [],
+             "slo_total": 0, "slo_ok": 0},
+        )
+        tf["requests"] += 1
+        tf["tokens"] += int(ev.get("generated") or 0)
+        if tpot is not None:
+            tf["tpots"].append(float(tpot))
+        if verdicts:
+            tf["slo_total"] += 1
+            if all(verdicts):
+                tf["slo_ok"] += 1
     if not (queue_waits or prefills or steps or finishes or spec_ticks
             or px_lookups or preemptions or chunks):
         return None
@@ -708,6 +738,33 @@ def summarize_serving(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
                                if px_prompt_tokens else None),
             "cow_blocks": px_cow,
         }
+    if tenant_fin or tenant_ttfts:
+        tenants: dict = {}
+        for t in sorted(set(tenant_fin) | set(tenant_ttfts)):
+            tf = tenant_fin.get(t, {"requests": 0, "tokens": 0,
+                                    "tpots": [], "slo_total": 0,
+                                    "slo_ok": 0})
+            tts = tenant_ttfts.get(t, [])
+            row: dict = {
+                "requests": tf["requests"],
+                "generated_tokens": tf["tokens"],
+                "ttft_ms_p50": (round(pct(tts, 0.5) * 1e3, 4)
+                                if tts else None),
+                "ttft_ms_p99": (round(pct(tts, 0.99) * 1e3, 4)
+                                if tts else None),
+                "tpot_ms_p50": (round(pct(tf["tpots"], 0.5), 4)
+                                if tf["tpots"] else None),
+                "tpot_ms_p99": (round(pct(tf["tpots"], 0.99), 4)
+                                if tf["tpots"] else None),
+            }
+            if tf["slo_total"]:
+                row["slo_requests"] = tf["slo_total"]
+                row["slo_attainment"] = round(
+                    tf["slo_ok"] / tf["slo_total"], 4)
+            tenants[t] = row
+        out["tenants"] = tenants
+        out["tenant_fairness_jain"] = round(jain_index(
+            [tenants[t]["generated_tokens"] for t in tenants]), 4)
     return out
 
 

@@ -2,8 +2,19 @@
 with a paged KV cache, a bucketed prefill/decode split, and tokens/s
 accounting — plus speculative draft-and-verify decoding (ISSUE 5):
 per-tick n-gram/model drafting, one jitted multi-token verify step,
-host-metadata rollback. See docs/serving.md for the engine contract."""
+host-metadata rollback — plus multi-tenant adapter serving (ISSUE 14):
+stacked low-rank deltas over one base model, deficit-round-robin
+fair-share admission, per-tenant SLO accounting. See docs/serving.md
+for the engine contract."""
 
+from chainermn_tpu.serving.adapters import (
+    ADAPTER_IMPLS,
+    ADAPTER_TARGETS,
+    AdapterBank,
+    LowRankAdapter,
+    random_adapter,
+    shard_adapter_stacks,
+)
 from chainermn_tpu.serving.engine import (
     DECODE_IMPLS,
     KV_BLOCK_SIZES,
@@ -12,6 +23,7 @@ from chainermn_tpu.serving.engine import (
     PREFIX_CACHE,
     SPEC_TOKENS,
     ServingEngine,
+    resolve_adapter_impl,
     resolve_decode_impl,
     resolve_kv_block_size,
     resolve_min_shared_blocks,
@@ -27,7 +39,12 @@ from chainermn_tpu.serving.kv_blocks import (
     default_num_blocks,
     init_serving_cache,
 )
-from chainermn_tpu.serving.scheduler import POLICIES, Request, Scheduler
+from chainermn_tpu.serving.scheduler import (
+    POLICIES,
+    DeficitRoundRobin,
+    Request,
+    Scheduler,
+)
 from chainermn_tpu.serving.speculate import (
     ModelDrafter,
     NgramDrafter,
@@ -38,8 +55,13 @@ __all__ = [
     "ServingEngine",
     "Scheduler",
     "Request",
+    "AdapterBank",
+    "LowRankAdapter",
+    "DeficitRoundRobin",
     "BlockAllocator",
     "PrefixCache",
+    "ADAPTER_IMPLS",
+    "ADAPTER_TARGETS",
     "DECODE_IMPLS",
     "KV_BLOCK_SIZES",
     "MIN_SHARED_BLOCKS",
@@ -52,6 +74,8 @@ __all__ = [
     "accept_length",
     "default_num_blocks",
     "init_serving_cache",
+    "random_adapter",
+    "resolve_adapter_impl",
     "resolve_decode_impl",
     "resolve_kv_block_size",
     "resolve_min_shared_blocks",
@@ -59,5 +83,6 @@ __all__ = [
     "resolve_prefix_cache",
     "resolve_spec_tokens",
     "serving_decision_key",
+    "shard_adapter_stacks",
     "shard_lm_params",
 ]

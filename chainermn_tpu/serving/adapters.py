@@ -1,0 +1,482 @@
+"""Multi-tenant adapter bank: stacked low-rank deltas over one frozen
+base model (ISSUE 14 tentpole).
+
+The north star says "millions of users", which means thousands of
+*variants*, not one model — and the reference's whole inference surface
+was a single-model per-sentence loop (``examples/seq2seq/seq2seq.py``
+†). This module serves many per-tenant fine-tuning deltas over
+ONE compiled program, riding the codebase's signature discipline: all
+variation lives in host metadata.
+
+- :class:`LowRankAdapter` — one tenant's delta: per layer, per hooked
+  projection (``qkv``/``proj``/``ff_up``/``ff_down``), a rank-r pair
+  ``A [d_in, r]`` / ``B [r, d_out]`` plus a scalar ``scale``
+  (folded into ``B`` at registration so every consumer — the engine's
+  per-slot gather, the ``generate`` reference, the merged fold — reads
+  the identical values).
+- :class:`AdapterBank` — the device-feedable store: per layer, per
+  target, ``[capacity, ...]``-stacked A/B arrays. Row 0 is the NULL
+  adapter (all zeros, never evicted): a zero delta contributes an
+  exact 0, so a zero-adapter tenant is bitwise the base model.
+  ``register``/``evict`` mutate host numpy + bump ``version`` (the
+  engine re-uploads its device copy only then — the block-table
+  discipline); refcounts pin a tenant's row while any slot serves it,
+  so an evict can never yank weights out from under a live stream.
+
+Engine contract (:class:`~chainermn_tpu.serving.engine.ServingEngine`
+with ``adapter_bank=``): each slot carries a host-side tenant row, the
+ONE jitted decode/verify/mixed/prefill program gathers that slot's A/B
+rows from the stacks and adds the rank-r delta inside the forward
+(``TransformerBlock._lora_delta``) — tenant churn mutates host metadata
+only (jit cache pinned at 1), and under TP the stacks are sharded along
+the existing Megatron column/row split so the compiled step keeps
+EXACTLY the pre-adapter collective set (2 all-reduces/layer, pinned by
+HLO count in tests/test_adapters.py).
+
+``adapter_impl`` (tuning decision, table ``gather``): ``'gather'`` =
+the per-slot stack gather above (mixed-tenant traffic); ``'merged'`` =
+:func:`merge_adapter_params` folds one tenant's delta into the base
+weights at construction (zero per-step delta cost — the single-tenant-
+dominant deployment; the engine then refuses other tenants loudly).
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+#: the hooked projections, in block order. ``qkv``/``ff_up`` are
+#: column-parallel under TP (B sharded with the kernel's output
+#: columns), ``proj``/``ff_down`` row-parallel (A sharded with the
+#: kernel's input rows; the partial delta rides the layer's existing
+#: psum).
+ADAPTER_TARGETS = ("qkv", "proj", "ff_up", "ff_down")
+
+#: tuning-registry candidates for the ``adapter_impl`` decision.
+ADAPTER_IMPLS = ("gather", "merged")
+
+
+def _target_dims(model) -> dict:
+    """``target -> (d_in, d_out)`` for one block of ``model``."""
+    kv = model.num_kv_heads or model.num_heads
+    dh = model.head_dim or model.d_model // model.num_heads
+    return {
+        "qkv": (model.d_model, (model.num_heads + 2 * kv) * dh),
+        "proj": (model.num_heads * dh, model.d_model),
+        "ff_up": (model.d_model, model.d_ff),
+        "ff_down": (model.d_ff, model.d_model),
+    }
+
+
+class LowRankAdapter:
+    """One tenant's low-rank delta over the hooked projections.
+
+    Args:
+      layers: per-layer mapping ``target -> (A, B)`` with ``A
+        [d_in, r]`` and ``B [r, d_out]`` (float32 host arrays; a layer
+        may hook any subset of :data:`ADAPTER_TARGETS`, missing targets
+        delta nothing). ``len(layers)`` must equal the model's layer
+        count at registration.
+      scale: the LoRA alpha/r multiplier, folded into ``B`` at
+        registration (every consumer sees the folded values — the
+        gather path, the ``generate`` reference, and the merged fold
+        cannot drift on scaling).
+    """
+
+    def __init__(self, layers: Sequence[Mapping[str, tuple]],
+                 scale: float = 1.0) -> None:
+        self.layers = [dict(layer) for layer in layers]
+        self.scale = float(scale)
+        for li, layer in enumerate(self.layers):
+            for tgt, pair in layer.items():
+                if tgt not in ADAPTER_TARGETS:
+                    raise ValueError(
+                        f"layer {li}: unknown adapter target {tgt!r} "
+                        f"(one of {ADAPTER_TARGETS})"
+                    )
+                A, B = pair
+                A = np.asarray(A, np.float32)
+                B = np.asarray(B, np.float32)
+                if A.ndim != 2 or B.ndim != 2 or A.shape[1] != B.shape[0]:
+                    raise ValueError(
+                        f"layer {li} {tgt}: A {A.shape} / B {B.shape} "
+                        "must be [d_in, r] / [r, d_out] with matching r"
+                    )
+                layer[tgt] = (A, B)
+
+    @property
+    def rank(self) -> int:
+        return max(
+            (pair[0].shape[1] for layer in self.layers
+             for pair in layer.values()),
+            default=0,
+        )
+
+
+def random_adapter(model, rank: int, *, seed: int = 0,
+                   targets: Sequence[str] = ADAPTER_TARGETS,
+                   scale: float = 1.0,
+                   init_scale: float = 0.02) -> LowRankAdapter:
+    """A random rank-``rank`` adapter for ``model`` (tests/bench/dryrun
+    workload material — NOT a training story). Both A and B are drawn
+    ~N(0, init_scale²) so the delta is small but nonzero everywhere:
+    a stream served through it must actually diverge from base."""
+    rs = np.random.RandomState(seed)
+    dims = _target_dims(model)
+    layers = []
+    for _ in range(model.num_layers):
+        layer = {}
+        for tgt in targets:
+            d_in, d_out = dims[tgt]
+            layer[tgt] = (
+                rs.normal(0.0, init_scale, (d_in, rank)).astype(
+                    np.float32),
+                rs.normal(0.0, init_scale, (rank, d_out)).astype(
+                    np.float32),
+            )
+        layers.append(layer)
+    return LowRankAdapter(layers, scale=scale)
+
+
+class AdapterBank:
+    """Stacked per-tenant A/B rows over one base model; see module
+    docstring.
+
+    Args:
+      model: the base ``TransformerLM`` (full — pre-TP — shape; the
+        engine shards the stacks itself when it runs under a mesh).
+      capacity: tenant rows INCLUDING the reserved null row 0 — at most
+        ``capacity - 1`` adapter-bearing tenants resident at once.
+      rank: the stack's rank budget; a registered adapter of smaller
+        rank is zero-padded (exact — zero columns delta nothing), a
+        larger one is refused.
+      targets: hooked projections (default all four).
+    """
+
+    def __init__(self, model, capacity: int, rank: int,
+                 targets: Sequence[str] = ADAPTER_TARGETS) -> None:
+        if capacity < 2:
+            raise ValueError(
+                f"capacity must be >= 2 (row 0 is the null adapter), "
+                f"got {capacity}"
+            )
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        for tgt in targets:
+            if tgt not in ADAPTER_TARGETS:
+                raise ValueError(
+                    f"unknown adapter target {tgt!r} (one of "
+                    f"{ADAPTER_TARGETS})"
+                )
+        self.capacity = int(capacity)
+        self.rank = int(rank)
+        self.targets = tuple(targets)
+        self.num_layers = int(model.num_layers)
+        self._dims = _target_dims(model)
+        #: per-layer ``{target: (A [cap, d_in, r], B [cap, r, d_out])}``
+        #: host stacks (float32; row 0 stays all-zero forever).
+        self._stacks = [
+            {
+                tgt: (
+                    np.zeros((capacity, self._dims[tgt][0], rank),
+                             np.float32),
+                    np.zeros((capacity, rank, self._dims[tgt][1]),
+                             np.float32),
+                )
+                for tgt in self.targets
+            }
+            for _ in range(self.num_layers)
+        ]
+        #: tenant -> row. Row 0 is shared by every ZERO-adapter tenant
+        #: (registered with ``adapter=None``) — bitwise the base model.
+        self._rows: dict[str, int] = {}
+        self._free = list(range(capacity - 1, 0, -1))
+        #: tenant -> live-slot refcount (the engine pins at join,
+        #: unpins at leave); an evict of a pinned tenant refuses.
+        self._pins: dict[str, int] = {}
+        #: bumped on every register/evict that changes row CONTENTS —
+        #: the engine keys its device copy on it (the block-table
+        #: re-upload discipline: registration churn, not decode ticks,
+        #: pays the H2D).
+        self.version = 0
+        #: lifetime register/evict counts (dryrun/bench visibility).
+        self.registrations = 0
+        self.evictions = 0
+        #: weak refs to per-engine change hooks (:meth:`add_listener`).
+        self._listeners: list = []
+
+    # ------------------------------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """Subscribe a ``fn(tenant_id)`` hook fired on every register/
+        evict of that tenant (bound methods held weakly — a dropped
+        engine unsubscribes itself). The serving engine uses this to
+        invalidate the tenant's prefix-trie namespace: cached KV was
+        computed under the OLD weights, and adopting it after a
+        re-registration would silently diverge from ``generate`` under
+        the new adapter."""
+        ref = (weakref.WeakMethod(fn) if hasattr(fn, "__self__")
+               else weakref.ref(fn))
+        self._listeners.append(ref)
+
+    def _notify(self, tenant_id: str) -> None:
+        for ref in list(self._listeners):
+            fn = ref()
+            if fn is None:
+                self._listeners.remove(ref)
+            else:
+                fn(tenant_id)
+
+    def residents(self) -> list[str]:
+        """Registered tenants, registration order."""
+        return list(self._rows)
+
+    @property
+    def free_rows(self) -> int:
+        return len(self._free)
+
+    def resident(self, tenant_id: Optional[str]) -> bool:
+        return tenant_id is None or tenant_id in self._rows
+
+    def row_of(self, tenant_id: Optional[str]) -> int:
+        """The stack row serving ``tenant_id`` (None -> the null row).
+        Unknown tenants raise — silently serving the base model for a
+        tenant whose adapter never registered would corrupt streams the
+        quiet way."""
+        if tenant_id is None:
+            return 0
+        row = self._rows.get(tenant_id)
+        if row is None:
+            raise KeyError(
+                f"tenant {tenant_id!r} has no registered adapter on "
+                f"this bank (residents: {self.residents()})"
+            )
+        return row
+
+    def pin(self, tenant_id: Optional[str]) -> None:
+        if tenant_id is None:
+            return
+        self.row_of(tenant_id)  # must be resident
+        self._pins[tenant_id] = self._pins.get(tenant_id, 0) + 1
+
+    def unpin(self, tenant_id: Optional[str]) -> None:
+        if tenant_id is None:
+            return
+        n = self._pins.get(tenant_id, 0)
+        if n <= 0:  # pragma: no cover - internal guard
+            raise AssertionError(f"tenant {tenant_id!r} pin underflow")
+        if n == 1:
+            del self._pins[tenant_id]
+        else:
+            self._pins[tenant_id] = n - 1
+
+    def refcount(self, tenant_id: str) -> int:
+        return self._pins.get(tenant_id, 0)
+
+    # ------------------------------------------------------------------
+
+    def register(self, tenant_id: str,
+                 adapter: Optional[LowRankAdapter] = None) -> int:
+        """Install ``tenant_id``'s delta; returns its row. ``None`` =
+        a ZERO-adapter tenant riding the shared null row (bitwise the
+        base model — tenancy for isolation/accounting only). Re-
+        registering a resident tenant with new weights is refused while
+        any slot serves it (the refcount contract) and otherwise
+        overwrites in place."""
+        if not tenant_id:
+            raise ValueError("tenant_id must be a non-empty string")
+        if tenant_id in self._rows and self._pins.get(tenant_id):
+            raise RuntimeError(
+                f"tenant {tenant_id!r} is pinned by "
+                f"{self._pins[tenant_id]} live slot(s) — re-registering "
+                "would swap weights under an in-flight stream"
+            )
+        if adapter is None:
+            if tenant_id in self._rows and self._rows[tenant_id] != 0:
+                self._release_row(tenant_id)
+            self._rows[tenant_id] = 0
+            self.registrations += 1
+            self._notify(tenant_id)
+            return 0
+        if len(adapter.layers) != self.num_layers:
+            raise ValueError(
+                f"adapter covers {len(adapter.layers)} layers, bank "
+                f"holds {self.num_layers}"
+            )
+        if adapter.rank > self.rank:
+            raise ValueError(
+                f"adapter rank {adapter.rank} exceeds the bank's rank "
+                f"budget {self.rank}"
+            )
+        for layer in adapter.layers:
+            for tgt, (A, B) in layer.items():
+                if tgt not in self.targets:
+                    raise ValueError(
+                        f"adapter hooks {tgt!r} but the bank stacks "
+                        f"only {self.targets}"
+                    )
+                d_in, d_out = self._dims[tgt]
+                if A.shape[0] != d_in or B.shape[1] != d_out:
+                    raise ValueError(
+                        f"{tgt}: A {A.shape} / B {B.shape} do not match "
+                        f"the model's ({d_in}, r) / (r, {d_out})"
+                    )
+        row = self._rows.get(tenant_id)
+        if row is None or row == 0:
+            if not self._free:
+                raise RuntimeError(
+                    f"adapter bank full ({self.capacity - 1} rows; "
+                    f"residents: {self.residents()}) — evict a tenant "
+                    "first"
+                )
+            row = self._free.pop()
+            if self._rows.get(tenant_id) == 0:
+                del self._rows[tenant_id]
+        for li, layer in enumerate(adapter.layers):
+            for tgt in self.targets:
+                As, Bs = self._stacks[li][tgt]
+                As[row] = 0.0
+                Bs[row] = 0.0
+                if tgt in layer:
+                    A, B = layer[tgt]
+                    r = A.shape[1]
+                    As[row, :, :r] = A
+                    # scale folds into B ONCE: gather, generate
+                    # reference and merged fold all read B*scale.
+                    Bs[row, :r, :] = B * adapter.scale
+        self._rows[tenant_id] = row
+        self.version += 1
+        self.registrations += 1
+        self._notify(tenant_id)
+        return row
+
+    def _release_row(self, tenant_id: str) -> None:
+        row = self._rows.pop(tenant_id)
+        if row != 0:
+            self._free.append(row)
+
+    def evict(self, tenant_id: str) -> None:
+        """Drop ``tenant_id``'s row (refused while pinned by live
+        slots). The row's stale stack values are harmless — nothing
+        gathers an unmapped row — and the next registration overwrites
+        them."""
+        if tenant_id not in self._rows:
+            raise KeyError(f"tenant {tenant_id!r} is not resident")
+        if self._pins.get(tenant_id):
+            raise RuntimeError(
+                f"tenant {tenant_id!r} is pinned by "
+                f"{self._pins[tenant_id]} live slot(s) — drain before "
+                "evicting"
+            )
+        self._release_row(tenant_id)
+        self.evictions += 1
+        self._notify(tenant_id)
+
+    # ------------------------------------------------------------------
+    # consumer views
+
+    def stacks(self) -> list:
+        """The per-layer host stacks (live references — read-only by
+        contract): ``[{target: (A [cap, d_in, r], B [cap, r, d_out])}]``.
+        The engine uploads/shards these, keyed on :attr:`version`."""
+        return self._stacks
+
+    def adapter_arrays(self, tenant_id: Optional[str]) -> list:
+        """The unbatched per-layer ``{target: (A, B)}`` view of one
+        tenant's row — EXACTLY the values the serving programs gather
+        (scale already folded into B), so ``generate(...,
+        adapters=bank.adapter_arrays(t))`` is the engine's bit-
+        equivalence reference."""
+        row = self.row_of(tenant_id)
+        return [
+            {tgt: (As[row], Bs[row])
+             for tgt, (As, Bs) in layer.items()}
+            for layer in self._stacks
+        ]
+
+    def merge_adapter_params(self, params, tenant_id: Optional[str]):
+        """Offline-merge ``tenant_id``'s delta into a base param tree:
+        every hooked kernel becomes ``W + A @ B`` (float32 — the
+        ``adapter_impl='merged'`` fold and the ISSUE 14 offline-merged
+        reference). The null row merges exact zeros, so a zero-adapter
+        tenant's fold IS the base tree bitwise."""
+        import jax
+
+        row = self.row_of(tenant_id)
+        deltas = [
+            {tgt: (As[row].astype(np.float64) @ Bs[row].astype(
+                np.float64)).astype(np.float32)
+             for tgt, (As, Bs) in layer.items()}
+            for layer in self._stacks
+        ]
+
+        def merge_leaf(path, leaf):
+            names = [str(getattr(p, "key", p)) for p in path]
+            li = next((int(n.split("_", 1)[1]) for n in names
+                       if n.startswith("block_")), None)
+            if li is None or names[-1] != "kernel":
+                return leaf
+            tgt = next((t for t in ADAPTER_TARGETS if t in names), None)
+            if tgt is None or tgt not in deltas[li]:
+                return leaf
+            d = deltas[li][tgt]
+            if not d.any():
+                return leaf  # null row: the base tree, bitwise
+            return leaf + d.astype(leaf.dtype)
+
+        return jax.tree_util.tree_map_with_path(merge_leaf, params)
+
+
+def shard_adapter_stacks(model, stacks, n: int):
+    """Shard the bank's ``[capacity, ...]`` stacks for tensor-parallel
+    decode over ``n`` model shards, mirroring
+    :func:`~chainermn_tpu.serving.engine.shard_lm_params`'s Megatron
+    placement so the delta adds shard-locally:
+
+    - ``qkv``: A replicated; B column-sharded through the q|k|v head
+      grouping (:func:`~chainermn_tpu.parallel.tensor
+      .shard_qkv_columns`);
+    - ``ff_up``: A replicated; B column-sharded on ``d_ff``;
+    - ``proj``/``ff_down``: A row-sharded on the input dim (each
+      shard's partial ``(x_sh @ A_sh) @ B`` rides the layer's existing
+      psum — no new collective); B replicated.
+
+    Returns per-layer dicts of ``[n, capacity, ...]`` jnp stacks (feed
+    through ``shard_map`` with ``P('model')`` on the leading axis).
+    """
+    import jax.numpy as jnp
+
+    from chainermn_tpu.parallel.tensor import (
+        shard_qkv_columns,
+        stack_tp_params,
+    )
+
+    n_heads = model.num_heads
+    kv_heads = model.num_kv_heads or model.num_heads
+    head_dim = model.head_dim or model.d_model // model.num_heads
+
+    def repl(a):
+        a = jnp.asarray(a)
+        return jnp.broadcast_to(a[None], (n,) + a.shape)
+
+    out = []
+    for layer in stacks:
+        sharded = {}
+        for tgt, (A, B) in layer.items():
+            A = jnp.asarray(A)
+            B = jnp.asarray(B)
+            cap, r = A.shape[0], A.shape[2]
+            if tgt == "qkv":
+                Bs = shard_qkv_columns(
+                    B.reshape(cap * r, B.shape[2]),
+                    n_heads, kv_heads, head_dim, n,
+                ).reshape(n, cap, r, -1)
+                sharded[tgt] = (repl(A), Bs)
+            elif tgt == "ff_up":
+                sharded[tgt] = (repl(A), stack_tp_params(B, n, 2))
+            else:  # proj / ff_down: row-parallel input split
+                sharded[tgt] = (stack_tp_params(A, n, 1), repl(B))
+        out.append(sharded)
+    return out

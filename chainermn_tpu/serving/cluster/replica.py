@@ -61,11 +61,20 @@ class Replica:
         ``kv_blocks_free`` gauge, read directly from engine state."""
         return self.engine.kv_blocks_free()
 
-    def prefix_hit_blocks(self, prompt) -> int:
+    def prefix_hit_blocks(self, prompt, tenant_id=None) -> int:
         """FULL blocks of ``prompt`` this replica's prefix trie already
-        holds (read-only probe) — the cache-aware placement signal: a
-        deeper hit means less prefill work HERE than anywhere else."""
-        return self.engine.prefix_match_depth(prompt)
+        holds under ``tenant_id``'s namespace (read-only probe) — the
+        cache-aware placement signal: a deeper hit means less prefill
+        work HERE than anywhere else."""
+        return self.engine.prefix_match_depth(prompt,
+                                              tenant_id=tenant_id)
+
+    def adapter_resident(self, tenant_id) -> bool:
+        """Whether this replica can serve ``tenant_id`` right now
+        (ISSUE 14) — the router's adapter-residency placement signal.
+        Engines without a bank serve everyone (base model)."""
+        fn = getattr(self.engine, "adapter_resident", None)
+        return bool(fn(tenant_id)) if callable(fn) else True
 
     # ---- drive ------------------------------------------------------
 

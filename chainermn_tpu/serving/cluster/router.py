@@ -51,7 +51,12 @@ from collections import deque
 from typing import Optional, Sequence
 
 from chainermn_tpu.serving.cluster.replica import Replica
-from chainermn_tpu.serving.scheduler import Request, keep_arrival
+from chainermn_tpu.serving.scheduler import (
+    Request,
+    check_session_tenant,
+    keep_arrival,
+    pin_session_tenant,
+)
 
 ROUTE_POLICIES = ("least_loaded", "prefix_aware")
 #: tuning-registry candidates for the cluster topology decision.
@@ -195,6 +200,9 @@ class Router:
         self._ids = _ROUTER_IDS
         self._seen_ids: set = set()
         self._sessions: dict = {}
+        #: session -> tenant pinning (the ISSUE 14 consistency guard —
+        #: same rule as Scheduler.submit's).
+        self._session_tenants: dict = {}
         #: requests that finished at the router (done at prefill —
         #: no decode leg, no transfer); merged into :meth:`run`'s
         #: result dict beside the replicas' own results.
@@ -274,23 +282,53 @@ class Router:
     def _alive(self, ids) -> list[Replica]:
         return [self.replicas[i] for i in ids if self.replicas[i].alive]
 
-    def _score(self, rep: Replica, prompt, extra_queue: int = 0):
-        """Placement score, maximized. Prefix hit depth dominates under
-        ``prefix_aware`` (a deeper hit is prefill work NOT done —
-        worth more than perfect load balance); load breaks ties; free
-        pool blocks break those (a starved pool defers admissions, the
-        latency the gauges exist to predict)."""
-        hit = rep.prefix_hit_blocks(prompt) if (
+    def _resident(self, candidates: Sequence[Replica],
+                  tenant_id) -> list[Replica]:
+        """Restrict ``candidates`` to replicas whose bank holds
+        ``tenant_id`` (review finding: residency was only a SCORE
+        bonus, so a tenant resident nowhere in the candidate set was
+        still placed — and crashed the drive loop with a KeyError at
+        ``prefill_join``/``import_kv`` instead of refusing). Raises
+        the front-door error when none qualify (a resident replica can
+        die between submit and placement). ``tenant_id=None`` filters
+        too: a merged replica serves exactly its folded tenant, so a
+        base-model request must not be placed on it."""
+        out = [rep for rep in candidates
+               if rep.adapter_resident(tenant_id)]
+        if not out:
+            who = (f"tenant {tenant_id!r}" if tenant_id is not None
+                   else "a base-model (tenantless) request")
+            raise RuntimeError(
+                f"{who} has no serving-capable candidate replica "
+                "(adapter not resident / merged-tenant mismatch) — "
+                "register it (or revive the replica) before routing "
+                "traffic"
+            )
+        return out
+
+    def _score(self, rep: Replica, prompt, tenant_id=None,
+               extra_queue: int = 0):
+        """Placement score, maximized. ADAPTER RESIDENCY dominates for
+        tenant-bearing requests (ISSUE 14: a replica whose bank holds
+        the tenant's rows can serve it NOW — anywhere else needs a
+        registration first, and a merged replica serves exactly its
+        folded tenant); then prefix hit depth under ``prefix_aware``
+        (a deeper hit is prefill work NOT done — worth more than
+        perfect load balance, and probed under the TENANT's namespace);
+        load breaks ties; free pool blocks break those (a starved pool
+        defers admissions, the latency the gauges exist to predict)."""
+        resident = int(rep.adapter_resident(tenant_id))
+        hit = rep.prefix_hit_blocks(prompt, tenant_id=tenant_id) if (
             self.policy == "prefix_aware") else 0
         load = rep.load() + extra_queue
         free = rep.kv_blocks_free()
-        return (hit, -load, free if free is not None else 0,
+        return (resident, hit, -load, free if free is not None else 0,
                 -rep.replica_id)
 
     def _choose(self, candidates: Sequence[Replica], request: Request,
                 extra=None) -> Replica:
         return max(candidates, key=lambda rep: self._score(
-            rep, request.prompt,
+            rep, request.prompt, request.tenant_id,
             (extra or {}).get(rep.replica_id, 0)))
 
     def _route(self, request: Request, requeue: bool = False) -> int:
@@ -307,14 +345,18 @@ class Router:
         sid = request.session_id
         if sid is not None and sid in self._sessions:
             pinned = self._sessions[sid]
-            if pinned in self.replicas and self.replicas[pinned].alive \
-                    and pinned in target_ids:
+            if (pinned in self.replicas and self.replicas[pinned].alive
+                    and pinned in target_ids
+                    and self.replicas[pinned].adapter_resident(
+                        request.tenant_id)):
                 rep = self.replicas[pinned]
                 sticky = True
         if rep is None:
             extra = {i: len(self._pqueues.get(i, ()))
                      for i in self.replicas}
-            rep = self._choose(candidates, request, extra)
+            rep = self._choose(
+                self._resident(candidates, request.tenant_id),
+                request, extra)
         if sid is not None:
             self._sessions[sid] = rep.replica_id
         if self.mode == "disaggregated":
@@ -323,13 +365,19 @@ class Router:
             rep.scheduler.submit(request)
         rid = rep.replica_id
         self._route_counts[rid] = self._route_counts.get(rid, 0) + 1
+        ev_extra = ({"tenant": request.tenant_id,
+                     "adapter_resident": rep.adapter_resident(
+                         request.tenant_id)}
+                    if request.tenant_id is not None else {})
         self._event(
             "route", request=request.request_id, replica=rid,
             policy=self.policy, mode=self.mode, sticky=sticky,
             requeue=bool(requeue),
-            hit_blocks=rep.prefix_hit_blocks(request.prompt),
+            hit_blocks=rep.prefix_hit_blocks(
+                request.prompt, tenant_id=request.tenant_id),
             load=rep.load(),
             kv_blocks_free=rep.kv_blocks_free(),
+            **ev_extra,
         )
         self._publish_gauges()
         return rid
@@ -351,7 +399,37 @@ class Router:
         if request.request_id in self._seen_ids:
             raise ValueError(
                 f"duplicate request_id {request.request_id!r}")
+        # Sticky-session/tenant consistency (ISSUE 14 satellite): the
+        # ONE shared validate half; the pin commits below, after the
+        # residency validation — a refused submission must not poison
+        # the session id (review finding).
+        check_session_tenant(self._session_tenants, request)
+        # Tenant must be placeable on EVERY role its journey touches
+        # (review finding: "resident somewhere" passed a tenant whose
+        # adapter lived only on a decode replica, and the prefill pump
+        # then crashed mid-run): colocated needs a resident decode
+        # replica; disaggregated needs one per plane — prefill runs
+        # the forward, and import_kv validates residency on the decode
+        # side before adopting.
+        needed = ([("prefill", self._prefill_ids),
+                   ("decode", self._decode_ids)]
+                  if self.mode == "disaggregated"
+                  else [("decode", self._decode_ids)])
+        for role, ids in needed:
+            if not any(rep.adapter_resident(request.tenant_id)
+                       for rep in self._alive(ids)):
+                who = (f"tenant {request.tenant_id!r} has no resident "
+                       "adapter"
+                       if request.tenant_id is not None
+                       else "a base-model (tenantless) request has no "
+                            "serving-capable replica")
+                raise ValueError(
+                    f"{who} on any alive {role} replica — register "
+                    "the adapter (or add a non-merged replica) before "
+                    "routing traffic"
+                )
         self._seen_ids.add(request.request_id)
+        pin_session_tenant(self._session_tenants, request)
         # The ONE stamp rule (ISSUE 11 satellite): set only when unset,
         # so this front door, Scheduler.submit and the preemption
         # requeue can never disagree about when the journey began.
@@ -378,7 +456,9 @@ class Router:
             while q:
                 req = q[0]
                 t_admit = time.perf_counter()
-                res = rep.engine.prefill_join(req.prompt)
+                join_kw = ({"tenant_id": req.tenant_id}
+                           if req.tenant_id is not None else {})
+                res = rep.engine.prefill_join(req.prompt, **join_kw)
                 if res is None:
                     break
                 q.popleft()
@@ -404,17 +484,23 @@ class Router:
                 t_export = time.perf_counter()
                 payload = rep.engine.export_kv(slot)
                 rep.engine.leave(slot)
-                dst = self._choose_decode()
+                dst = self._choose_decode(req.tenant_id)
                 self._pending[dst.replica_id].append(
                     (req, payload, t_export, t_admit, i))
         return progressed
 
-    def _choose_decode(self) -> Replica:
+    def _choose_decode(self, tenant_id=None) -> Replica:
         """Decode placement: most free pool blocks, then least loaded
-        (pending handoffs count as load — they land next)."""
-        cands = self._alive(self._decode_ids)
-        if not cands:
+        (pending handoffs count as load — they land next). Tenant-
+        bearing handoffs only consider resident replicas —
+        ``import_kv`` validates residency before adopting, so a
+        non-resident pick would crash the adopt pump. Alive is checked
+        FIRST so a dead-pool outage reads as what it is, not as a
+        residency problem (review finding)."""
+        alive = self._alive(self._decode_ids)
+        if not alive:
             raise RuntimeError("no alive decode replica")
+        cands = self._resident(alive, tenant_id)
         return max(cands, key=lambda rep: (
             rep.kv_blocks_free() or 0,
             -(rep.load() + len(self._pending[rep.replica_id])),
@@ -567,12 +653,19 @@ class Router:
                 f"request {request_id!r} is not in flight on any "
                 "alive replica")
         src_id, slot = src
-        req = self.replicas[src_id].scheduler.preempt(slot, requeue=False)
         ids = [i for i in self._decode_ids if i != src_id] \
             if exclude_replica else list(self._decode_ids)
         cands = self._alive(ids) or self._alive(self._decode_ids)
         if not cands:
             raise RuntimeError("no alive decode replica to resume on")
+        # Residency filter BEFORE preempting (review finding: _choose
+        # treats residency as a score, not a filter — a non-resident
+        # winner would refuse the submit and strand the just-preempted
+        # request). Failing here leaves the stream running in place.
+        tenant = getattr(self.replicas[src_id].engine,
+                         "tenant_of_slot", lambda s: None)(slot)
+        cands = self._resident(cands, tenant)
+        req = self.replicas[src_id].scheduler.preempt(slot, requeue=False)
         # Same scoring as _route's placement, pending prefill queues
         # included in the load tiebreak (review finding: a diverging
         # re-implementation scored migrations differently).
@@ -589,8 +682,11 @@ class Router:
             "route", request=req.request_id, replica=rid,
             policy=self.policy, mode=self.mode, sticky=False,
             requeue=True, preempted_from=src_id,
-            hit_blocks=rep.prefix_hit_blocks(req.prompt),
+            hit_blocks=rep.prefix_hit_blocks(
+                req.prompt, tenant_id=req.tenant_id),
             load=rep.load(), kv_blocks_free=rep.kv_blocks_free(),
+            **({"tenant": req.tenant_id}
+               if req.tenant_id is not None else {}),
         )
         self._publish_gauges()
         return rid

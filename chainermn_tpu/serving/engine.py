@@ -84,6 +84,42 @@ PREFILL_CHUNKS = ("0", "16", "32", "64", "128")
 #: and the assembled block chain is handed to the existing paged/dense
 #: decode path. Streams stay bit-identical to sequential ``generate``.
 PREFILL_SEQ_PARALLEL = ("off", "on")
+#: multi-tenant adapter application (ISSUE 14): 'gather' = the one
+#: compiled program gathers each slot's A/B rows from the bank's
+#: stacks and adds the rank-r delta in-forward (mixed-tenant traffic;
+#: tenant churn is host metadata only); 'merged' = the tenant's delta
+#: is folded into the base weights at construction (zero per-step
+#: delta cost — single-tenant-dominant traffic; other tenants refused
+#: loudly). Table default 'gather': merging must EARN adoption through
+#: the bench's ``serving_tenants`` rows. ONE definition, in
+#: adapters.py — the ctor's validation and the tuning candidates must
+#: never disagree.
+from chainermn_tpu.serving.adapters import ADAPTER_IMPLS  # noqa: E402
+
+
+def resolve_adapter_impl(d_model: int, num_heads: int, max_len: int) -> str:
+    """Resolve ``adapter_impl`` ('gather' | 'merged') via the registry
+    (decision ``adapter_impl``, same key as the other serving
+    decisions; bench's ``serving_tenants`` phase measures both arms
+    under Zipf-skewed multi-tenant traffic and seeds it)."""
+    from chainermn_tpu import tuning
+
+    return tuning.choice(
+        "adapter_impl", ADAPTER_IMPLS,
+        serving_decision_key(d_model, num_heads, max_len),
+    )
+
+
+def _gather_adapter_rows(stacks, rows):
+    """Per-slot adapter gather (ISSUE 14): index every layer's stacked
+    ``[capacity, ...]`` A/B pair by the ``[B]`` tenant-row vector —
+    the ONE in-program step that turns host tenant metadata into the
+    forward's per-row deltas. Runs inside the jitted programs; a row
+    of 0 gathers the null adapter (exact zeros)."""
+    return [
+        {tgt: (A[rows], B[rows]) for tgt, (A, B) in layer.items()}
+        for layer in stacks
+    ]
 
 
 def serving_decision_key(d_model: int, num_heads: int, max_len: int,
@@ -355,6 +391,32 @@ class ServingEngine:
         resolves via the registry (table default ``off`` — the wide
         prefill must earn adoption through bench's ``seq_parallel``
         long-prompt TTFT rows).
+      adapter_bank: multi-tenant low-rank delta store (ISSUE 14,
+        :class:`~chainermn_tpu.serving.adapters.AdapterBank`): each
+        slot carries a host-side tenant row, every serving program
+        gathers that slot's A/B rows from the bank's stacks and adds
+        the rank-r delta inside the forward — tenant join/leave/
+        registration churn mutates host metadata only (the jit caches
+        stay pinned at 1), and under TP the stacks are sharded along
+        the existing column/row split so the compiled step keeps
+        exactly the pre-adapter 2 all-reduces/layer. A tenant's stream
+        is bit-identical to sequential ``generate`` with that tenant's
+        adapter (``bank.adapter_arrays``); a zero-adapter tenant is
+        bitwise the base model. Blocks ``prefill_seq_parallel`` (no
+        delta path in the sharded prompt forward yet — forced off with
+        provenance).
+      adapter_impl: ``'gather'`` | ``'merged'`` | ``'auto'`` (registry
+        decision ``adapter_impl``, table ``gather``) — requires
+        ``adapter_bank``. ``'merged'`` folds ``merged_tenant``'s delta
+        into the base weights at construction
+        (``bank.merge_adapter_params``) and serves ONLY that tenant
+        (others refused loudly): zero per-step delta cost for
+        single-tenant-dominant traffic, bit-identical to ``generate``
+        over the offline-merged weights.
+      merged_tenant: the tenant ``adapter_impl='merged'`` folds
+        (required for explicit ``'merged'``; an ``'auto'`` resolution
+        of ``merged`` without it falls back to ``gather`` with
+        provenance).
     """
 
     def __init__(self, model, params, *, num_slots: int,
@@ -370,7 +432,9 @@ class ServingEngine:
                  spec_tokens="auto", drafter=None,
                  prefix_cache="auto", min_shared_blocks="auto",
                  prefill_chunk="auto",
-                 prefill_seq_parallel="auto") -> None:
+                 prefill_seq_parallel="auto",
+                 adapter_bank=None, adapter_impl="auto",
+                 merged_tenant=None) -> None:
         import jax
 
         from chainermn_tpu.models.transformer import TransformerLM
@@ -598,6 +662,97 @@ class ServingEngine:
         #: mixed_step advances). NOT active: decode masks exclude them.
         self._pending_fill: dict[int, dict] = {}
 
+        # ---- multi-tenant adapters (ISSUE 14): resolve the impl and,
+        # under 'merged', fold the tenant's delta into the base weights
+        # BEFORE the clone/shard below — the rest of the ctor then
+        # builds an ordinary engine over the folded tree.
+        if adapter_impl != "auto" and adapter_impl not in ADAPTER_IMPLS:
+            raise ValueError(
+                f"adapter_impl must be one of "
+                f"{ADAPTER_IMPLS + ('auto',)}, got {adapter_impl!r}"
+            )
+        self.adapter_bank = adapter_bank
+        self.merged_tenant = merged_tenant
+        if adapter_bank is None:
+            if adapter_impl != "auto":
+                raise ValueError(
+                    f"adapter_impl={adapter_impl!r} needs an "
+                    "adapter_bank"
+                )
+            if merged_tenant is not None:
+                raise ValueError("merged_tenant needs an adapter_bank")
+            self.adapter_impl: Optional[str] = None
+        else:
+            if adapter_bank.num_layers != model.num_layers:
+                raise ValueError(
+                    f"adapter_bank stacks {adapter_bank.num_layers} "
+                    f"layers, model has {model.num_layers}"
+                )
+            if adapter_impl == "auto":
+                adapter_impl = resolve_adapter_impl(
+                    model.d_model, model.num_heads, max_len
+                )
+                self._adopt_decision("adapter_impl", key)
+                if adapter_impl == "merged" and merged_tenant is None:
+                    # The cache says merging wins this shape, but this
+                    # engine was built without a tenant to fold — serve
+                    # the gather path with honest provenance rather
+                    # than guess whose weights to merge.
+                    adapter_impl = "gather"
+                    self.decisions.append({
+                        "name": "adapter_impl", "key": key,
+                        "winner": "gather",
+                        "source": "forced:no-merged-tenant",
+                    })
+            else:
+                if adapter_impl == "merged" and merged_tenant is None:
+                    raise ValueError(
+                        "adapter_impl='merged' needs merged_tenant= — "
+                        "the fold must know whose delta to bake in"
+                    )
+                if adapter_impl == "gather" and merged_tenant is not None:
+                    # Loud like every other invalid combination: an
+                    # explicit gather engine never folds, so a
+                    # merged_tenant here is a typoed/confused intent
+                    # the caller must resolve, not a silent no-op.
+                    raise ValueError(
+                        "merged_tenant= is only meaningful with "
+                        "adapter_impl='merged' (or 'auto'); an "
+                        "explicit 'gather' engine serves every "
+                        "registered tenant and folds nothing"
+                    )
+                self.decisions.append({"name": "adapter_impl",
+                                       "key": key,
+                                       "winner": adapter_impl,
+                                       "source": "explicit"})
+            self.adapter_impl = adapter_impl
+            if adapter_impl == "merged":
+                params = adapter_bank.merge_adapter_params(
+                    params, merged_tenant)
+        #: whether the compiled programs carry the per-slot gather+delta
+        #: (the 'gather' impl); merged/bank-less engines run the plain
+        #: programs.
+        self._use_adapters = (adapter_bank is not None
+                              and self.adapter_impl == "gather")
+        if self._use_adapters:
+            # Trie invalidation on weight churn (review finding): a
+            # tenant's cached KV is only valid under the stacks that
+            # produced it — drop the namespace whenever the bank's
+            # content for that tenant changes (register overwrite,
+            # zero-adapter downgrade, evict), whichever engine or
+            # caller mutated the bank.
+            adapter_bank.add_listener(self._on_adapter_change)
+        #: per-slot tenant identity (host metadata: the prefix-trie
+        #: namespace, the bank pin, the export payload field).
+        self._tenant_ids: list[Optional[str]] = [None] * num_slots
+        #: per-slot bank row the programs gather (0 = null adapter).
+        self._tenant_rows = np.zeros(num_slots, np.int32)
+        self._tenant_rows_ver = 0
+        self._tenant_rows_dev = None
+        self._tenant_rows_dev_ver = -1
+        self._adapter_dev = None
+        self._adapter_ver = -1
+
         # ---- decode-path model (and its TP shard form)
         self._mesh = mesh
         clone_kw: dict[str, Any] = dict(
@@ -717,6 +872,11 @@ class ServingEngine:
                            "chunked admission (prefill_chunk > 0) "
                            "already bounds long-prompt interference and "
                            "takes precedence")
+            elif adapter_bank is not None:
+                blocked = ("forced:adapters",
+                           "the sequence-parallel prompt forward has "
+                           "no adapter-delta path — multi-tenant "
+                           "engines take the monolithic prefill")
             elif self.temperature > 0.0:
                 blocked = ("forced:sampling",
                            "greedy-only: the bit-identical-stream "
@@ -837,14 +997,83 @@ class ServingEngine:
             self._tables_ver = version
         return self._tables_dev
 
-    def _tp_jit(self, inner, n_plain_args: int):
-        """The ONE jit(+shard_map) wrapper all three serving programs
-        (decode / verify / prefill) share: donate the cache, and under
-        TP unstack the ``[n, ...]`` cache/param stacks around the local
-        program so the psum hooks see per-shard leaves.
+    def _adapter_device(self):
+        """The bank's stacks as CACHED device arrays (TP-sharded under a
+        mesh), re-uploaded only when a registration actually changed a
+        row (``bank.version`` — the block-table discipline: the decode
+        loop must not pay an H2D per tick for tenant data that did not
+        change)."""
+        import jax
 
-        ``inner(cache, variables, *rest) -> (cache, out)``;
-        ``n_plain_args`` counts ``rest`` (replicated under TP)."""
+        bank = self.adapter_bank
+        if self._adapter_dev is None or self._adapter_ver != bank.version:
+            import jax.numpy as jnp
+
+            stacks = bank.stacks()
+            if self._mesh is None:
+                dev = [
+                    {t: (jnp.asarray(A), jnp.asarray(B))
+                     for t, (A, B) in layer.items()}
+                    for layer in stacks
+                ]
+            else:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                from chainermn_tpu.serving.adapters import (
+                    shard_adapter_stacks,
+                )
+
+                sh = NamedSharding(self._mesh, P("model"))
+                dev = jax.tree.map(
+                    lambda a: jax.device_put(a, sh),
+                    shard_adapter_stacks(
+                        self._base_model, stacks, self._tp_n),
+                )
+            self._adapter_dev = dev
+            self._adapter_ver = bank.version
+        return self._adapter_dev
+
+    def _tenant_rows_device(self):
+        """The per-slot tenant-row vector as a cached device array —
+        re-uploaded only when a join/leave changed a row (same H2D
+        discipline as the block tables)."""
+        import jax.numpy as jnp
+
+        if (self._tenant_rows_dev is None
+                or self._tenant_rows_dev_ver != self._tenant_rows_ver):
+            self._tenant_rows_dev = jnp.asarray(self._tenant_rows)
+            self._tenant_rows_dev_ver = self._tenant_rows_ver
+        return self._tenant_rows_dev
+
+    def _step_args(self, *mid, tail=(), tenant_rows=None):
+        """ONE argument-splice rule for every jitted program call
+        (prefill/decode/verify/mixed): ``(cache, vars, *mid, *tail)``,
+        with the adapter stacks inserted after ``vars`` and the
+        per-slot tenant rows between ``mid`` and ``tail`` when the
+        bank is active (review finding: four hand-expanded if/else
+        copies of the argument list were one reorder away from
+        silently misfeeding a compiled program). ``tenant_rows``
+        defaults to the cached whole-array upload; prefill passes its
+        single-slot slice."""
+        if not self._use_adapters:
+            return (self._cache, self._vars, *mid, *tail)
+        rows = (self._tenant_rows_device() if tenant_rows is None
+                else tenant_rows)
+        return (self._cache, self._vars, self._adapter_device(),
+                *mid, rows, *tail)
+
+    def _tp_jit(self, inner, n_plain_args: int, n_model_args: int = 0):
+        """The ONE jit(+shard_map) wrapper all the serving programs
+        (decode / verify / mixed / prefill) share: donate the cache,
+        and under TP unstack the ``[n, ...]`` cache/param stacks around
+        the local program so the psum hooks see per-shard leaves.
+
+        ``inner(cache, variables, *model_args, *rest) -> (cache, out)``;
+        ``n_model_args`` counts extra model-axis-sharded pytrees right
+        after ``variables`` (ISSUE 14: the adapter stacks ride here so
+        each shard gathers its own column/row slice), ``n_plain_args``
+        counts the trailing ``rest`` (replicated under TP)."""
         import jax
 
         if self._mesh is None:
@@ -856,13 +1085,18 @@ class ServingEngine:
         def local(cache_st, vars_st, *rest):
             cache = jax.tree.map(lambda a: a[0], cache_st)
             variables = jax.tree.map(lambda a: a[0], vars_st)
-            cache2, out = inner(cache, variables, *rest)
+            sharded = [jax.tree.map(lambda a: a[0], t)
+                       for t in rest[:n_model_args]]
+            cache2, out = inner(cache, variables, *sharded,
+                                *rest[n_model_args:])
             return jax.tree.map(lambda a: a[None], cache2), out
 
         return jax.jit(
             shard_map(
                 local, mesh=self._mesh,
-                in_specs=(P("model"), P("model")) + (P(),) * n_plain_args,
+                in_specs=(P("model"), P("model"))
+                + (P("model"),) * n_model_args
+                + (P(),) * n_plain_args,
                 out_specs=(P("model"), P()),
                 check_vma=False,
             ),
@@ -911,6 +1145,19 @@ class ServingEngine:
     def _build_decode_step(self):
         model = self._decode_model
 
+        if self._use_adapters:
+            def inner(cache, variables, ad, tokens, positions, tables,
+                      rows, key):
+                logits, mutated = model.apply(
+                    {**variables, "cache": cache}, tokens[:, None],
+                    train=False, decode=True, decode_positions=positions,
+                    block_tables=tables, mutable=["cache"],
+                    adapters=_gather_adapter_rows(ad, rows),
+                )
+                return mutated["cache"], self._sample(logits[:, 0], key)
+
+            return self._tp_jit(inner, 5, n_model_args=1)
+
         def inner(cache, variables, tokens, positions, tables, key):
             logits, mutated = model.apply(
                 {**variables, "cache": cache}, tokens[:, None],
@@ -935,6 +1182,20 @@ class ServingEngine:
         import jax.numpy as jnp
 
         model = self._decode_model
+
+        if self._use_adapters:
+            def inner(cache, variables, ad, tokens, positions, tables,
+                      rows):
+                logits, mutated = model.apply(
+                    {**variables, "cache": cache}, tokens,
+                    train=False, decode=True, decode_positions=positions,
+                    block_tables=tables, mutable=["cache"],
+                    adapters=_gather_adapter_rows(ad, rows),
+                )
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return mutated["cache"], greedy  # [slots, K+1]
+
+            return self._tp_jit(inner, 4, n_model_args=1)
 
         def inner(cache, variables, tokens, positions, tables):
             logits, mutated = model.apply(
@@ -966,6 +1227,23 @@ class ServingEngine:
         greedy-argmax grid, which is what acceptance and the chunk
         boundary token both read."""
         model = self._decode_model
+
+        if self._use_adapters:
+            def inner(cache, variables, ad, tokens, positions, tables,
+                      rows, key):
+                logits, mutated = model.apply(
+                    {**variables, "cache": cache}, tokens,  # [slots, T]
+                    train=False, decode=True, decode_positions=positions,
+                    block_tables=tables, mutable=["cache"],
+                    adapters=_gather_adapter_rows(ad, rows),
+                )
+                S, T = tokens.shape
+                toks = self._sample(
+                    logits.reshape(S * T, -1), key
+                ).reshape(S, T)
+                return mutated["cache"], toks  # [slots, T]
+
+            return self._tp_jit(inner, 5, n_model_args=1)
 
         def inner(cache, variables, tokens, positions, tables, key):
             logits, mutated = model.apply(
@@ -1061,19 +1339,35 @@ class ServingEngine:
 
         model = self._decode_model
 
-        def inner(cache, variables, tokens, true_len, start, slot,
-                  table_row, key):
-            logits, mutated = model.apply(
-                {**variables, "cache": cache}, tokens,
-                train=False, decode=True,
-                decode_positions=start,
-                block_tables=table_row, decode_slots=slot,
-                mutable=["cache"],
-            )
-            last = jnp.take(logits[0], true_len - 1, axis=0)  # [V]
-            return mutated["cache"], self._sample(last[None], key)[0]
+        if self._use_adapters:
+            def inner(cache, variables, ad, tokens, true_len, start,
+                      slot, table_row, rows, key):
+                logits, mutated = model.apply(
+                    {**variables, "cache": cache}, tokens,
+                    train=False, decode=True,
+                    decode_positions=start,
+                    block_tables=table_row, decode_slots=slot,
+                    mutable=["cache"],
+                    adapters=_gather_adapter_rows(ad, rows),
+                )
+                last = jnp.take(logits[0], true_len - 1, axis=0)  # [V]
+                return mutated["cache"], self._sample(last[None], key)[0]
 
-        fn = self._tp_jit(inner, 6)
+            fn = self._tp_jit(inner, 7, n_model_args=1)
+        else:
+            def inner(cache, variables, tokens, true_len, start, slot,
+                      table_row, key):
+                logits, mutated = model.apply(
+                    {**variables, "cache": cache}, tokens,
+                    train=False, decode=True,
+                    decode_positions=start,
+                    block_tables=table_row, decode_slots=slot,
+                    mutable=["cache"],
+                )
+                last = jnp.take(logits[0], true_len - 1, axis=0)  # [V]
+                return mutated["cache"], self._sample(last[None], key)[0]
+
+            fn = self._tp_jit(inner, 6)
         self._prefill_jits[bucket] = fn
         return fn
 
@@ -1205,7 +1499,10 @@ class ServingEngine:
         from chainermn_tpu.observability import metrics
 
         reg = metrics.active_registry()
-        if reg is None or self._alloc is None:
+        if reg is None:
+            return
+        if self._alloc is None:
+            self._publish_adapter_gauges(reg)
             return
         reg.gauge("kv_blocks_free",
                   "allocatable KV pool blocks currently free").set(
@@ -1223,6 +1520,29 @@ class ServingEngine:
                       "upper bound on reclaimable — a live descendant "
                       "pins its cached ancestors)").set(
                 self._alloc.blocks_cached())
+        self._publish_adapter_gauges(reg)
+
+    def _publish_adapter_gauges(self, reg) -> None:
+        """Adapter-bank gauges (ISSUE 14): residency + per-tenant slot
+        occupancy, tenant-labeled (the live-SLO surface;
+        ``tools/metrics_dump.py --label tenant=<id>`` filters on
+        exactly this label). No-op without a bank."""
+        if self.adapter_bank is None:
+            return
+        reg.gauge("adapter_bank_residents",
+                  "tenants with a registered adapter row").set(
+            len(self.adapter_bank.residents()))
+        reg.gauge("adapter_bank_free_rows",
+                  "unclaimed adapter rows in the bank").set(
+            self.adapter_bank.free_rows)
+        counts: dict = {}
+        for t in self._tenant_ids:
+            if t is not None:
+                counts[t] = counts.get(t, 0) + 1
+        for t in self.adapter_bank.residents():
+            reg.gauge("serving_tenant_active_slots",
+                      "slots currently serving a tenant").set(
+                counts.get(t, 0), tenant=str(t))
 
     def prefix_trie_blocks(self) -> Optional[int]:
         """Blocks held by the prefix trie (None when sharing is off) —
@@ -1264,11 +1584,17 @@ class ServingEngine:
             return None
         return int(sum(s() for s in sizes))
 
-    def prefill_join(self, prompt):
+    def prefill_join(self, prompt, tenant_id: Optional[str] = None):
         """Admit one request: claim a slot, run bucketed prefill, return
         ``(slot, first_token, bucket)`` — or None when no slot (or,
         paged, not enough pool blocks) is available right now (the
         scheduler retries later; host state is untouched on refusal).
+
+        ``tenant_id`` (ISSUE 14) selects the slot's adapter row (the
+        bank must hold the tenant — unknown tenants raise loudly rather
+        than silently serve the base model) and namespaces the
+        prefix-trie consultation: one tenant's cached blocks can never
+        adopt into another's stream.
 
         With the prefix cache on (ISSUE 7) the join first consults the
         trie: the longest matching FULL-block chain is adopted into the
@@ -1284,7 +1610,7 @@ class ServingEngine:
         """
         import jax.numpy as jnp
 
-        res = self._admit_common(prompt)
+        res = self._admit_common(prompt, tenant_id)
         if res is None:
             return None
         slot, prompt, P_len, tail_start, tail_len, _matched, _cow = res
@@ -1307,13 +1633,15 @@ class ServingEngine:
         padded = np.full((1, bucket), self.pad_id, np.int32)
         padded[0, :tail_len] = prompt[tail_start:]
         fn = self._prefill_fn(bucket)
-        self._cache, tok = fn(
-            self._cache, self._vars, jnp.asarray(padded),
-            jnp.int32(tail_len), jnp.full((1,), tail_start, jnp.int32),
+        self._cache, tok = fn(*self._step_args(
+            jnp.asarray(padded),
+            jnp.int32(tail_len),
+            jnp.full((1,), tail_start, jnp.int32),
             jnp.asarray([slot], jnp.int32),
             jnp.asarray(self._dummy_tables()[slot:slot + 1]),
-            self._split_key(),
-        )
+            tail=(self._split_key(),),
+            tenant_rows=jnp.asarray(self._tenant_rows[slot:slot + 1]),
+        ))
         tok = int(tok)
         self._positions[slot] = P_len
         self._last_tok[slot] = tok
@@ -1369,8 +1697,10 @@ class ServingEngine:
         rule every path shares (prefill/fill completion, import_kv
         adoption, preemption): an adopted prefix walks existing nodes,
         only fresh full blocks add nodes, and the partial tail block is
-        never inserted (the next write targets it). No-op with sharing
-        off."""
+        never inserted (the next write targets it). Inserts under the
+        SLOT's tenant namespace (ISSUE 14): publication is as tenant-
+        scoped as adoption, so cross-tenant block sharing is
+        structurally impossible. No-op with sharing off."""
         if self._prefix is None:
             return
         bs = self._alloc.block_size
@@ -1379,14 +1709,18 @@ class ServingEngine:
             self._prefix.insert(
                 [int(t) for t in tokens[:full * bs]],
                 self._alloc.owned_blocks(slot)[:full],
+                namespace=self._tenant_ids[slot],
             )
 
-    def _admit_common(self, prompt):
+    def _admit_common(self, prompt, tenant_id: Optional[str] = None):
         """Shared admission front half of :meth:`prefill_join` and
-        :meth:`chunked_join`: validate the prompt, consult the prefix
-        trie, reserve the slot's pool blocks for the whole prompt plus
+        :meth:`chunked_join`: validate the prompt (and, ISSUE 14, the
+        tenant — its adapter row must be resident BEFORE any state
+        mutates), consult the prefix trie under the TENANT's namespace,
+        reserve the slot's pool blocks for the whole prompt plus
         the first decode write, COW-protect the unshared tail's
-        boundary, commit the slot and account the admission. Returns
+        boundary, commit the slot (tenant row + bank pin included) and
+        account the admission. Returns
         ``(slot, prompt, P_len, tail_start, tail_len, matched, cow)``
         with the slot POPPED from the free list, or None to defer (host
         state untouched — the scheduler retries). ``last_prefix_info``
@@ -1401,13 +1735,25 @@ class ServingEngine:
                 f"prompt of {P_len} tokens leaves no room to generate "
                 f"within max_len={self.max_len}"
             )
+        row = 0
+        if self.adapter_bank is not None:
+            if self.adapter_impl == "merged":
+                if tenant_id != self.merged_tenant:
+                    raise ValueError(
+                        f"this engine serves the merged tenant "
+                        f"{self.merged_tenant!r} only — got "
+                        f"{tenant_id!r} (route other tenants to a "
+                        "gather-mode engine)"
+                    )
+            else:
+                row = self.adapter_bank.row_of(tenant_id)
         if not self._free:
             return None
         slot = self._free[-1]  # peek; commit only after alloc succeeds
         self.last_prefix_info = None
         matched: list[int] = []
         if self._prefix is not None:
-            matched = self._prefix.lookup(prompt)
+            matched = self._prefix.lookup(prompt, namespace=tenant_id)
             if len(matched) < self._min_shared_blocks:
                 matched = []
         hit_tokens = len(matched) * (self._alloc.block_size
@@ -1454,6 +1800,14 @@ class ServingEngine:
         else:
             cow = 0
         self._free.pop()
+        # Tenant commit (ISSUE 14): the slot's adapter row + bank pin +
+        # trie namespace — host metadata only, like everything above.
+        self._tenant_ids[slot] = tenant_id
+        if self._use_adapters:
+            self.adapter_bank.pin(tenant_id)
+            if self._tenant_rows[slot] != row:
+                self._tenant_rows[slot] = row
+                self._tenant_rows_ver += 1
 
         # Lifetime accounting covers ADMITTED requests only — a deferred
         # admission is retried by the scheduler, and counting each retry
@@ -1475,7 +1829,7 @@ class ServingEngine:
             }
         return slot, prompt, P_len, tail_start, tail_len, matched, cow
 
-    def chunked_join(self, prompt):
+    def chunked_join(self, prompt, tenant_id: Optional[str] = None):
         """Admit one request for CHUNKED prefill (``prefill_chunk > 0``,
         ISSUE 11): claim the slot and reserve its blocks EXACTLY like
         :meth:`prefill_join` — trie adoption, whole-prompt ensure,
@@ -1490,7 +1844,7 @@ class ServingEngine:
             raise RuntimeError(
                 "chunked_join needs prefill_chunk > 0 — use prefill_join"
             )
-        res = self._admit_common(prompt)
+        res = self._admit_common(prompt, tenant_id)
         if res is None:
             return None
         slot, prompt, P_len, tail_start, tail_len, _matched, _cow = res
@@ -1524,13 +1878,12 @@ class ServingEngine:
             # in a block another slot or the trie still reads.
             self._cow_protect(int(s), p, 1)
         t0 = time.perf_counter()
-        self._cache, toks = self._decode_step_jit(
-            self._cache, self._vars,
+        self._cache, toks = self._decode_step_jit(*self._step_args(
             jnp.asarray(self._last_tok, jnp.int32),
             jnp.asarray(self._positions, jnp.int32),
             self._tables_device(),
-            self._split_key(),
-        )
+            tail=(self._split_key(),),
+        ))
         toks = np.asarray(toks)  # device sync: honest per-step latency
         dur = time.perf_counter() - t0
         self._publish_pool_gauges()
@@ -1635,11 +1988,11 @@ class ServingEngine:
         tokens = np.concatenate([self._last_tok[:, None], drafts], axis=1)
 
         t0 = time.perf_counter()
-        self._cache, greedy = self._verify_step_jit(
-            self._cache, self._vars, jnp.asarray(tokens, jnp.int32),
+        self._cache, greedy = self._verify_step_jit(*self._step_args(
+            jnp.asarray(tokens, jnp.int32),
             jnp.asarray(self._positions, jnp.int32),
             self._tables_device(),
-        )
+        ))
         greedy = np.asarray(greedy)  # device sync: honest tick latency
         dur = time.perf_counter() - t0
 
@@ -1762,12 +2115,12 @@ class ServingEngine:
                 chunk_len[s] = n
 
         t0 = time.perf_counter()
-        self._cache, toks = self._mixed_step_jit(
-            self._cache, self._vars, jnp.asarray(tokens, jnp.int32),
+        self._cache, toks = self._mixed_step_jit(*self._step_args(
+            jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32),
             self._tables_device(),
-            self._split_key(),
-        )
+            tail=(self._split_key(),),
+        ))
         toks = np.asarray(toks)  # device sync: honest tick latency
         dur = time.perf_counter() - t0
 
@@ -1849,15 +2202,71 @@ class ServingEngine:
     # re-prefilling. Pure block slicing on the device plane (zero
     # collectives, structurally pinned); everything else is host state.
 
-    def prefix_match_depth(self, prompt) -> int:
-        """FULL blocks of ``prompt`` this engine's prefix trie holds —
-        the router's cache-aware placement signal (read-only probe, no
-        LRU touch). 0 when prefix sharing is off."""
+    def prefix_match_depth(self, prompt,
+                           tenant_id: Optional[str] = None) -> int:
+        """FULL blocks of ``prompt`` this engine's prefix trie holds
+        UNDER ``tenant_id``'s namespace (ISSUE 14) — the router's
+        cache-aware placement signal (read-only probe, no LRU touch).
+        0 when prefix sharing is off."""
         if self._prefix is None:
             return 0
         return self._prefix.match_depth(
-            [int(t) for t in np.asarray(prompt).reshape(-1)]
+            [int(t) for t in np.asarray(prompt).reshape(-1)],
+            namespace=tenant_id,
         )
+
+    # ------------------------------------------------------------------
+    # multi-tenant adapter surface (ISSUE 14)
+
+    def adapter_resident(self, tenant_id: Optional[str]) -> bool:
+        """Whether this engine can serve ``tenant_id`` RIGHT NOW — the
+        router's adapter-residency placement signal. Bank-less engines
+        serve every tenant (base model + namespace isolation only);
+        merged engines serve exactly their folded tenant."""
+        if self.adapter_bank is None:
+            return True
+        if self.adapter_impl == "merged":
+            return tenant_id == self.merged_tenant
+        return self.adapter_bank.resident(tenant_id)
+
+    def _on_adapter_change(self, tenant_id: str) -> None:
+        """Bank change hook (ISSUE 14 review finding): cached KV under
+        ``tenant_id``'s trie namespace was computed with the PREVIOUS
+        weights — a join after a re-registration must re-prefill under
+        the current stacks, never adopt stale-adapter blocks (the
+        bit-equivalence anchor would silently break)."""
+        prefix = getattr(self, "_prefix", None)
+        if prefix is not None:
+            prefix.drop_namespace(tenant_id)
+
+    def register_adapter(self, tenant_id: str, adapter=None) -> int:
+        """Register a tenant on the bank (``adapter=None`` = a zero-
+        adapter tenant riding the null row) and refresh the gauges.
+        Returns the bank row. The NEXT step's cached upload picks the
+        new stacks up (``bank.version``); the compiled programs never
+        change — registration churn is host metadata + one H2D."""
+        if self.adapter_bank is None:
+            raise RuntimeError("this engine has no adapter_bank")
+        if self.adapter_impl == "merged":
+            raise RuntimeError(
+                "a merged engine's weights are folded at construction "
+                "— register tenants on a gather-mode engine"
+            )
+        row = self.adapter_bank.register(tenant_id, adapter)
+        self._publish_pool_gauges()
+        return row
+
+    def evict_adapter(self, tenant_id: str) -> None:
+        """Evict a tenant's row (refused while any slot serves it —
+        the bank's refcount contract) and refresh the gauges."""
+        if self.adapter_bank is None:
+            raise RuntimeError("this engine has no adapter_bank")
+        self.adapter_bank.evict(tenant_id)
+        self._publish_pool_gauges()
+
+    def tenant_of_slot(self, slot: int) -> Optional[str]:
+        """The tenant occupying ``slot`` (None = base/unoccupied)."""
+        return self._tenant_ids[slot]
 
     def kv_blocks_free(self) -> Optional[int]:
         """Free paged-pool blocks (None under dense) — the same number
@@ -1986,6 +2395,7 @@ class ServingEngine:
             "tokens": list(self._history[slot]),
             "position": pos,
             "last_tok": int(self._last_tok[slot]),
+            "tenant": self._tenant_ids[slot],
             "blocks": blocks,
             "nbytes": sum(a.nbytes for blk in blocks for a in blk),
         }
@@ -2023,6 +2433,27 @@ class ServingEngine:
                 f"payload position {pos} leaves no room within "
                 f"max_len={self.max_len}"
             )
+        # Tenant validation BEFORE any state mutates (ISSUE 14): an
+        # adopted stream keeps decoding under its tenant's delta, so
+        # the adapter must be resident HERE too.
+        tenant = payload.get("tenant")
+        row = 0
+        if self.adapter_bank is not None:
+            if self.adapter_impl == "merged":
+                if tenant != self.merged_tenant:
+                    raise ValueError(
+                        f"merged engine serves {self.merged_tenant!r} "
+                        f"only — payload carries tenant {tenant!r}"
+                    )
+            else:
+                try:
+                    row = self.adapter_bank.row_of(tenant)
+                except KeyError as e:
+                    raise ValueError(
+                        f"kv payload tenant {tenant!r} has no resident "
+                        "adapter on the importing engine — register it "
+                        "before streaming"
+                    ) from e
         if not self._free:
             return None
         slot = self._free[-1]  # peek; commit only after alloc succeeds
@@ -2066,6 +2497,12 @@ class ServingEngine:
         self._last_tok[slot] = int(payload["last_tok"])
         self._active[slot] = True
         self._history[slot] = [int(t) for t in payload["tokens"]]
+        self._tenant_ids[slot] = tenant
+        if self._use_adapters:
+            self.adapter_bank.pin(tenant)
+            if self._tenant_rows[slot] != row:
+                self._tenant_rows[slot] = row
+                self._tenant_rows_ver += 1
         # KV exists for tokens[:pos]; cache the FULL blocks (the shared
         # publish rule — partial tails never inserted).
         self._publish_full_blocks(slot, self._history[slot], pos)
@@ -2083,11 +2520,21 @@ class ServingEngine:
     def _release_slot(self, slot: int) -> None:
         """The ONE slot-release body :meth:`leave` and the mid-fill
         branch of :meth:`preempt` share (free list, history, paged
-        blocks, gauges) — release-side accounting added here reaches
-        both paths."""
+        blocks, tenant row/pin, gauges) — release-side accounting added
+        here reaches both paths."""
         self._active[slot] = False
         self._free.append(int(slot))
         self._history[int(slot)] = []
         if self._alloc is not None:
             self._alloc.release(int(slot))
+        # Tenant release (ISSUE 14): unpin the bank row and point the
+        # slot back at the null adapter — a reused slot must never
+        # gather a departed tenant's delta.
+        if self._tenant_ids[slot] is not None:
+            if self._use_adapters:
+                self.adapter_bank.unpin(self._tenant_ids[slot])
+            self._tenant_ids[slot] = None
+        if self._tenant_rows[slot] != 0:
+            self._tenant_rows[slot] = 0
+            self._tenant_rows_ver += 1
         self._publish_pool_gauges()

@@ -348,25 +348,44 @@ class PrefixCache:
     def __init__(self, allocator: BlockAllocator) -> None:
         self.alloc = allocator
         self.block_size = allocator.block_size
-        self._root = _TrieNode((), BlockAllocator.SCRATCH, None)
+        #: per-NAMESPACE trie roots (ISSUE 14: tenant isolation — a
+        #: lookup/insert only ever walks its own namespace's tree, so a
+        #: cross-tenant block adoption is structurally impossible, not
+        #: merely policy). ``None`` is the default namespace
+        #: (single-tenant engines never see another).
+        self._roots: dict = {
+            None: _TrieNode((), BlockAllocator.SCRATCH, None)
+        }
         self._clock = itertools.count(1)
-        #: number of cached nodes (== cached blocks, the trie-size gauge)
+        #: number of cached nodes (== cached blocks, the trie-size
+        #: gauge), summed across namespaces
         self.n_nodes = 0
         #: lifetime eviction count (bench/dryrun visibility)
         self.evictions = 0
         allocator.reclaimer = self.reclaim
         allocator.reclaim_capacity = self.reclaimable
 
+    def _root_for(self, namespace, create: bool = False):
+        root = self._roots.get(namespace)
+        if root is None and create:
+            root = _TrieNode((), BlockAllocator.SCRATCH, None)
+            self._roots[namespace] = root
+        return root
+
     def _chunks(self, tokens: Sequence[int]):
         bs = self.block_size
         for i in range(0, (len(tokens) // bs) * bs, bs):
             yield tuple(int(t) for t in tokens[i:i + bs])
 
-    def lookup(self, tokens: Sequence[int]) -> list[int]:
+    def lookup(self, tokens: Sequence[int],
+               namespace=None) -> list[int]:
         """Physical blocks of the longest cached FULL-block prefix of
-        ``tokens`` (possibly empty). Touches the matched chain's LRU
-        stamps — a hit protects its ancestors from eviction ordering."""
-        node = self._root
+        ``tokens`` under ``namespace`` (possibly empty). Touches the
+        matched chain's LRU stamps — a hit protects its ancestors from
+        eviction ordering."""
+        node = self._root_for(namespace)
+        if node is None:
+            return []
         out: list[int] = []
         stamp = next(self._clock)
         for chunk in self._chunks(tokens):
@@ -378,14 +397,16 @@ class PrefixCache:
             node = child
         return out
 
-    def match_depth(self, tokens: Sequence[int]) -> int:
-        """How many FULL blocks of ``tokens`` the trie holds — a
-        READ-ONLY probe (no LRU stamp: the cluster router consults
-        every replica's trie per routing decision, and a probe that
-        touched stamps would let mere consideration pin chains a real
-        adoption never used). :meth:`lookup` remains the adopting
-        walk."""
-        node = self._root
+    def match_depth(self, tokens: Sequence[int], namespace=None) -> int:
+        """How many FULL blocks of ``tokens`` the trie holds under
+        ``namespace`` — a READ-ONLY probe (no LRU stamp: the cluster
+        router consults every replica's trie per routing decision, and
+        a probe that touched stamps would let mere consideration pin
+        chains a real adoption never used). :meth:`lookup` remains the
+        adopting walk."""
+        node = self._root_for(namespace)
+        if node is None:
+            return 0
         depth = 0
         for chunk in self._chunks(tokens):
             child = node.children.get(chunk)
@@ -395,13 +416,15 @@ class PrefixCache:
             node = child
         return depth
 
-    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
-        """Cache the FULL blocks of a completed prefill: ``blocks[j]``
-        holds the KV of ``tokens[j*bs:(j+1)*bs]``. Chunks already cached
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int],
+               namespace=None) -> int:
+        """Cache the FULL blocks of a completed prefill under
+        ``namespace``: ``blocks[j]`` holds the KV of
+        ``tokens[j*bs:(j+1)*bs]``. Chunks already cached
         are left as-is (first writer wins — the existing node's block is
         the one future joins adopt; the inserting slot simply keeps its
         private copy). Returns how many new nodes were cached."""
-        node = self._root
+        node = self._root_for(namespace, create=True)
         added = 0
         stamp = next(self._clock)
         for j, chunk in enumerate(self._chunks(tokens)):
@@ -418,15 +441,58 @@ class PrefixCache:
             node = child
         return added
 
-    def _evictable_leaves(self) -> list[_TrieNode]:
-        out = []
-        stack = [self._root]
+    def drop_namespace(self, namespace) -> int:
+        """Invalidate EVERY cached block under ``namespace`` (ISSUE 14
+        review finding: an adapter re-registration changes the weights
+        that produced the tenant's cached KV — a later join adopting
+        those blocks would silently diverge from ``generate`` under the
+        new adapter, so the engine drops the namespace on
+        register/evict). Blocks are uncached, not force-freed: a live
+        slot still reading one keeps it until release. Returns the
+        number of nodes dropped."""
+        root = self._roots.pop(namespace, None)
+        if root is None:
+            return 0
+        dropped = 0
+        stack = list(root.children.values())
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
-            if (node is not self._root and not node.children
-                    and self.alloc.refcounts[node.block] == 0):
-                out.append(node)
+            self.alloc.uncache(node.block)
+            dropped += 1
+        self.n_nodes -= dropped
+        self.evictions += dropped
+        if namespace is None:
+            # The default namespace always exists (single-tenant
+            # engines consult it unconditionally).
+            self._root_for(None, create=True)
+        return dropped
+
+    def namespace_blocks(self, namespace=None) -> int:
+        """Cached nodes under one namespace (the per-tenant trie-size
+        probe; the isolation test pins zero overlap between tenants'
+        block sets)."""
+        root = self._root_for(namespace)
+        if root is None:
+            return 0
+        n, stack = 0, [root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node is not root:
+                n += 1
+        return n
+
+    def _evictable_leaves(self) -> list[_TrieNode]:
+        out = []
+        for root in self._roots.values():
+            stack = [root]
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                if (node is not root and not node.children
+                        and self.alloc.refcounts[node.block] == 0):
+                    out.append(node)
         return out
 
     def reclaimable(self) -> int:
@@ -435,19 +501,19 @@ class PrefixCache:
         cached ancestors — they never become evictable leaves — so this
         is strictly tighter than the allocator's ``blocks_cached``
         gauge (the allocator's ``can_cover`` promise reads this)."""
-        def walk(node: _TrieNode) -> tuple[int, bool]:
+        def walk(node: _TrieNode, root: _TrieNode) -> tuple[int, bool]:
             n, subtree_free = 0, True
             for child in node.children.values():
-                cn, cf = walk(child)
+                cn, cf = walk(child, root)
                 n += cn
                 subtree_free = subtree_free and cf
-            if node is self._root:
+            if node is root:
                 return n, subtree_free
             if subtree_free and self.alloc.refcounts[node.block] == 0:
                 return n + 1, True
             return n, False
 
-        return walk(self._root)[0]
+        return sum(walk(root, root)[0] for root in self._roots.values())
 
     def reclaim(self, n: int) -> int:
         """Evict up to ``n`` blocks, LRU leaf first (the allocator's
@@ -455,6 +521,7 @@ class PrefixCache:
         as the next candidate — the parent joins the candidate heap
         then, so one trie scan serves the whole batch (refcounts don't
         change during eviction). Returns the blocks actually freed."""
+        roots = set(map(id, self._roots.values()))
         heap = [(nd.last_used, id(nd), nd)
                 for nd in self._evictable_leaves()]
         heapq.heapify(heap)
@@ -467,7 +534,7 @@ class PrefixCache:
             self.evictions += 1
             freed += 1
             parent = victim.parent
-            if (parent is not self._root and not parent.children
+            if (id(parent) not in roots and not parent.children
                     and self.alloc.refcounts[parent.block] == 0):
                 heapq.heappush(
                     heap, (parent.last_used, id(parent), parent))

@@ -67,12 +67,110 @@ recorder still get the accounting.
 from __future__ import annotations
 
 import itertools
+import math
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Mapping, Optional, Sequence
 
 POLICIES = ("fcfs", "prefill_priority", "slo")
+
+
+class DeficitRoundRobin:
+    """Weighted fair-share pick over per-tenant backlogs (ISSUE 14).
+
+    Classic deficit round robin adapted to an admission queue: each
+    backlogged tenant accrues ``weight * quantum`` credit per round,
+    and a tenant is served when its deficit covers its head request's
+    cost (here: the request's ``max_new_tokens`` — decode work is the
+    contended resource under saturation). Under sustained saturation
+    the admitted work converges to the weight ratio; the math is
+    pinned in isolation in tests/test_adapters.py.
+
+    Invariants the tests drive:
+
+    - **Weighted shares under saturation** — admissions track
+      ``weight`` proportionally, whatever the per-request costs.
+    - **No idle hoarding** — a tenant with nothing queued has its
+      deficit RESET (``select`` drops tenants absent from the
+      backlog), so returning after an idle stretch cannot burst-starve
+      the tenants that kept the engine busy.
+    - **Quota churn mid-run** — :meth:`set_weight` takes effect on the
+      next ``select``; no restart, no queue reshuffle.
+
+    ``select`` never mutates queues — it names the tenant whose head
+    should be TRIED next; the caller charges the cost via
+    :meth:`charge` only when admission actually succeeds (a refused
+    admission must not burn the tenant's credit)."""
+
+    def __init__(self, quantum: float = 1.0) -> None:
+        if quantum <= 0:
+            raise ValueError(f"quantum must be > 0, got {quantum}")
+        self.quantum = float(quantum)
+        self._weights: dict = {}
+        self._deficit: dict = {}
+        self._last = None
+
+    def set_weight(self, tenant, weight: float) -> None:
+        """Set ``tenant``'s share weight (> 0; unlisted tenants weigh
+        1.0). Takes effect on the next :meth:`select` — quota churn
+        mid-run is the supported path, not an edge case."""
+        if weight <= 0:
+            raise ValueError(
+                f"tenant weight must be > 0, got {weight} for "
+                f"{tenant!r}"
+            )
+        self._weights[tenant] = float(weight)
+
+    def weight(self, tenant) -> float:
+        return self._weights.get(tenant, 1.0)
+
+    def deficit(self, tenant) -> float:
+        """Current credit (test/introspection surface)."""
+        return self._deficit.get(tenant, 0.0)
+
+    @staticmethod
+    def _order_key(tenant):
+        return (tenant is not None, str(tenant))
+
+    def select(self, costs: Mapping) -> Optional[object]:
+        """Pick the tenant to serve next from ``costs`` (tenant ->
+        head-request cost, backlogged tenants only). Tenants absent
+        from ``costs`` lose their deficit (idle reset). Credit is
+        granted in whole rounds — just enough that SOME tenant can
+        afford its head — then the first affordable tenant after the
+        last-served one (stable round-robin order) wins."""
+        for t in [t for t in self._deficit if t not in costs]:
+            del self._deficit[t]
+        if not costs:
+            return None
+        order = sorted(costs, key=self._order_key)
+        if self._last in order:
+            i = order.index(self._last) + 1
+            order = order[i:] + order[:i]
+        rounds = min(
+            max(0, math.ceil(
+                (float(costs[t]) - self._deficit.get(t, 0.0))
+                / (self.weight(t) * self.quantum)
+            ))
+            for t in order
+        )
+        if rounds:
+            for t in order:
+                self._deficit[t] = (self._deficit.get(t, 0.0)
+                                    + rounds * self.quantum
+                                    * self.weight(t))
+        for t in order:
+            if self._deficit.get(t, 0.0) >= float(costs[t]):
+                return t
+        return order[0]  # pragma: no cover - rounds guarantee coverage
+
+    def charge(self, tenant, cost: float) -> None:
+        """Spend ``tenant``'s credit for a SUCCESSFUL admission and
+        advance the round-robin pointer."""
+        self._deficit[tenant] = self._deficit.get(tenant, 0.0) - float(
+            cost)
+        self._last = tenant
 
 
 def keep_arrival(request) -> None:
@@ -86,6 +184,34 @@ def keep_arrival(request) -> None:
         request._arrival = time.perf_counter()
 
 
+def check_session_tenant(pins: Mapping, request) -> None:
+    """VALIDATE half of the sticky-session/tenant rule (ISSUE 14
+    satellite), the ONE implementation both front doors —
+    :meth:`Scheduler.submit` and the cluster Router's ``submit`` —
+    share: a session re-submitted under a different tenant raises
+    loudly (one tenant's conversation history must never continue
+    under another's identity). Commit the pin separately via
+    :func:`pin_session_tenant` AFTER every other validation passed —
+    pinning first left a REFUSED submission's session permanently
+    bound to the wrong tenant (review finding)."""
+    sid = request.session_id
+    if sid is not None and sid in pins and pins[sid] != request.tenant_id:
+        raise ValueError(
+            f"session {sid!r} belongs to tenant {pins[sid]!r} but was "
+            f"re-submitted as {request.tenant_id!r} — sessions never "
+            "change tenants"
+        )
+
+
+def pin_session_tenant(pins: dict, request) -> None:
+    """COMMIT half of the sticky-session/tenant rule: record a NEW
+    session's tenant (no-op on later turns). Call only once the
+    submission is certain to be accepted."""
+    if (request.session_id is not None
+            and request.session_id not in pins):
+        pins[request.session_id] = request.tenant_id
+
+
 @dataclass
 class Request:
     """One serving request: ``prompt`` tokens in, up to
@@ -93,10 +219,19 @@ class Request:
     ``eos_id`` when given — the emitted EOS counts as generated, like
     :func:`generate`'s fixed-horizon streams truncated at EOS).
 
+    ``tenant_id`` (optional, ISSUE 14) names the serving tenant: the
+    engine gathers that tenant's adapter rows for the slot, the prefix
+    cache is consulted under the tenant's namespace, fair-share
+    admission buckets by it, and every event/rollup carries it.
+    ``None`` = the base model (the ``'default'`` tenant in rollups).
+
     ``session_id`` (optional) marks a multi-turn conversation: the
     cluster router (ISSUE 8) pins every request of a session to the
     replica that served its first turn, so the per-replica prefix trie
-    stays warm across turns. The single-engine scheduler ignores it.
+    stays warm across turns. The single-engine scheduler ignores the
+    pinning but, like the router, REFUSES a session re-submitted under
+    a different ``tenant_id`` (ISSUE 14 satellite: a silent re-pin
+    would hand one tenant's conversation history to another).
 
     ``ttft_target_ms`` / ``tpot_target_ms`` (optional, ISSUE 11) are
     the request's SLO targets — submit-to-first-token and mean
@@ -110,6 +245,7 @@ class Request:
     max_new_tokens: int
     request_id: Optional[str] = None
     eos_id: Optional[int] = None
+    tenant_id: Optional[str] = None
     session_id: Optional[str] = None
     ttft_target_ms: Optional[float] = None
     tpot_target_ms: Optional[float] = None
@@ -158,14 +294,34 @@ class _Filling:
 
 
 class Scheduler:
-    """Admission + completion loop; see module docstring."""
+    """Admission + completion loop; see module docstring.
 
-    def __init__(self, engine, policy: str = "fcfs") -> None:
+    ``tenant_weights`` (ISSUE 14): a ``{tenant_id: weight}`` mapping
+    turns on deficit-round-robin FAIR-SHARE admission — the queue is
+    still arrival-ordered WITHIN a tenant, but which tenant's head is
+    tried next follows the weighted shares (:class:`DeficitRoundRobin`;
+    unlisted tenants weigh 1.0, ``None`` = base traffic). Composes
+    with every policy: ``prefill_priority``/``slo`` keep draining every
+    admissible request per round, only the ORDER changes, and the slo
+    policy's chunk-cap/preemption discipline is untouched. Quotas can
+    churn mid-run via :meth:`set_tenant_weight`."""
+
+    def __init__(self, engine, policy: str = "fcfs",
+                 tenant_weights: Optional[Mapping[str, float]] = None
+                 ) -> None:
         if policy not in POLICIES:
             raise ValueError(f"policy must be one of {POLICIES}, got "
                              f"{policy!r}")
         self.engine = engine
         self.policy = policy
+        #: fair-share state (active once any weight is configured).
+        self._drr = DeficitRoundRobin()
+        self._fair_share = False
+        if tenant_weights:
+            for t, w in tenant_weights.items():
+                self.set_tenant_weight(t, w)
+        #: session -> tenant pinning (the sticky-consistency guard).
+        self._session_tenants: dict = {}
         # Live-telemetry front door (ISSUE 6): a serving process driven
         # only by the scheduler has no trainer loop to honour the
         # metrics-port env gate — check it here too (no-op when unset).
@@ -196,6 +352,18 @@ class Scheduler:
         self._wall: Optional[float] = None
 
     # ------------------------------------------------------------------
+
+    def set_tenant_weight(self, tenant_id: Optional[str],
+                          weight: float) -> None:
+        """Set (or change, mid-run) a tenant's fair-share weight and
+        activate fair-share admission (ISSUE 14)."""
+        self._drr.set_weight(tenant_id, weight)
+        self._fair_share = True
+
+    @property
+    def fair_share(self) -> bool:
+        """Whether deficit-round-robin admission is active."""
+        return self._fair_share
 
     def _event(self, _kind: str = "serving", **fields) -> None:
         from chainermn_tpu.observability import trace
@@ -260,6 +428,27 @@ class Scheduler:
                 f"{request.max_new_tokens}) but the engine horizon is "
                 f"max_len={self.engine.max_len}"
             )
+        # Tenant validation up front (ISSUE 14): an unregistered
+        # adapter or a merged-engine mismatch fails HERE, not mid-run
+        # in the admission loop where it would abort every other
+        # in-flight stream.
+        resident = getattr(self.engine, "adapter_resident", None)
+        if callable(resident) and not resident(request.tenant_id):
+            # Covers tenant_id=None too (review finding): a merged
+            # engine serves exactly its folded tenant, so a BASE-model
+            # request must also be refused here, not mid-run.
+            who = (f"tenant {request.tenant_id!r}"
+                   if request.tenant_id is not None
+                   else "a base-model (tenantless) request")
+            raise ValueError(
+                f"{who} cannot be served by this engine (adapter not "
+                "resident / merged-tenant mismatch) — register the "
+                "adapter or route elsewhere"
+            )
+        # Sticky-session consistency guard (ISSUE 14 satellite): the
+        # shared validate half; the pin commits below, after EVERY
+        # other check passed.
+        check_session_tenant(self._session_tenants, request)
         # Requests are mutable (the id is written onto them): the same
         # OBJECT queued twice would alias one stream across two entries,
         # and a stale id from a previous scheduler can collide with this
@@ -289,6 +478,7 @@ class Scheduler:
         # the last hop. keep_arrival is the ONE rule all three paths
         # share (ISSUE 11 satellite).
         keep_arrival(request)
+        pin_session_tenant(self._session_tenants, request)
         self._queue.append(request)
         self._publish_gauges()
         return request.request_id
@@ -321,6 +511,15 @@ class Scheduler:
 
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _tenant_field(req: Request) -> dict:
+        """The per-event tenant tag (ISSUE 14): present only for
+        tenant-bearing requests, so pre-tenant traces — and fake-engine
+        tests — keep their exact shape (the rollup's ``'default'``
+        fallback covers the absent case)."""
+        return ({"tenant": req.tenant_id}
+                if req.tenant_id is not None else {})
+
     def _finish(self, fl: _InFlight) -> None:
         self.engine.leave(fl.slot)
         del self._inflight[fl.slot]
@@ -332,7 +531,8 @@ class Scheduler:
             "generated": list(fl.stream[len(req.prompt):]),
         }
         ev: dict = dict(phase="finish", request=req.request_id,
-                        generated=fl.generated, dur_s=round(dur, 9))
+                        generated=fl.generated, dur_s=round(dur, 9),
+                        **self._tenant_field(req))
         # TPOT (ISSUE 11 satellite): mean inter-token latency of THIS
         # request, first token -> finish over generated-1 intervals.
         # Preemption gaps are inside it by construction — the whole-
@@ -367,7 +567,8 @@ class Scheduler:
         ev: dict = dict(phase="prefill", request=req.request_id,
                         slot=slot, bucket=bucket,
                         prompt_len=len(req.prompt),
-                        dur_s=round(dur_s, 9))
+                        dur_s=round(dur_s, 9),
+                        **self._tenant_field(req))
         if chunks is not None:
             ev["chunks"] = chunks
         if getattr(self.engine, "last_prefill_seq_parallel", False):
@@ -393,45 +594,99 @@ class Scheduler:
         ):
             self._finish(fl)
 
+    def _next_candidate(self) -> Optional[Request]:
+        """The queued request admission tries next: the strict arrival
+        head (FCFS — a blocked head blocks the queue), or, with fair
+        share active (ISSUE 14), the earliest request of the tenant
+        the deficit-round-robin picker names (arrival order WITHIN a
+        tenant is always preserved)."""
+        if not self._queue:
+            return None
+        if not self._fair_share:
+            return self._queue[0]
+        heads: dict = {}
+        for r in self._queue:
+            if r.tenant_id not in heads:
+                heads[r.tenant_id] = r
+        tenant = self._drr.select(
+            {t: self._drr_cost(r) for t, r in heads.items()})
+        return heads[tenant]
+
+    @staticmethod
+    def _drr_cost(req: Request) -> float:
+        """Fair-share cost of admitting ``req``: its decode budget —
+        except a preempted/requeued stream, whose first admission
+        already charged the full budget (review finding: re-charging
+        on resume billed a preempted tenant twice for the same tokens,
+        dragging its admitted share below its weight)."""
+        if req._resume is not None or req._requeued:
+            return 0.0
+        return float(req.max_new_tokens)
+
+    def _dequeue(self, req: Request) -> None:
+        """Remove ``req`` from the queue by IDENTITY. deque.remove
+        would deep-compare whole Request dataclasses (prompt lists
+        included) against every earlier entry per admission — and
+        quietly relies on request_id uniqueness to make equality mean
+        identity (review finding)."""
+        for i, r in enumerate(self._queue):
+            if r is req:
+                del self._queue[i]
+                return
+        raise ValueError(
+            f"request {req.request_id!r} is not queued")
+
     def _admit_one(self) -> bool:
-        """Try to admit the HEAD of the queue (strict arrival order —
-        a blocked head blocks the queue: FCFS, not best-fit). Chunked
+        """Try to admit the next candidate (:meth:`_next_candidate` —
+        the arrival head, or the fair-share pick). Chunked
         engines admit through ``chunked_join`` (slot + block
         reservation only; the prompt KV is written by later mixed
         ticks); a parked ``_resume`` state makes the join re-prefill
         the preempted stream instead of the original prompt."""
-        if not self._queue:
+        req = self._next_candidate()
+        if req is None:
             return False
-        req = self._queue[0]
         t0 = time.perf_counter()
         resume = req._resume
         first_admission = resume is None and not req._requeued
         join_prompt = resume["stream"] if resume is not None else req.prompt
+        # The engine-side tenant plumbing (adapter row + trie
+        # namespace); omitted for tenantless requests so schedulers
+        # over minimal/fake engines keep their pre-tenant signature.
+        join_kw = ({"tenant_id": req.tenant_id}
+                   if req.tenant_id is not None else {})
         if getattr(self.engine, "prefill_chunk", 0) > 0:
-            slot = self.engine.chunked_join(join_prompt)
+            slot = self.engine.chunked_join(join_prompt, **join_kw)
             if slot is None:
                 return False
-            self._queue.popleft()
+            self._dequeue(req)
+            if self._fair_share:
+                self._drr.charge(req.tenant_id, self._drr_cost(req))
             if first_admission:
                 self._event(phase="queue_wait", request=req.request_id,
-                            dur_s=round(t0 - req._arrival, 9))
+                            dur_s=round(t0 - req._arrival, 9),
+                            **self._tenant_field(req))
             info = getattr(self.engine, "last_prefix_info", None)
             if info is not None:
                 self._event("prefix_cache", request=req.request_id,
-                            slot=slot, **info)
+                            slot=slot, **info,
+                            **self._tenant_field(req))
             self._filling[slot] = _Filling(req, slot, t_admit=t0,
                                            resume=resume)
             self._publish_gauges()
             return True
-        res = self.engine.prefill_join(join_prompt)
+        res = self.engine.prefill_join(join_prompt, **join_kw)
         if res is None:
             return False
-        self._queue.popleft()
+        self._dequeue(req)
+        if self._fair_share:
+            self._drr.charge(req.tenant_id, self._drr_cost(req))
         slot, tok, bucket = res
         now = time.perf_counter()
         if first_admission:
             self._event(phase="queue_wait", request=req.request_id,
-                        dur_s=round(t0 - req._arrival, 9))
+                        dur_s=round(t0 - req._arrival, 9),
+                        **self._tenant_field(req))
         # Prefix-sharing accounting (ISSUE 7): the engine fills
         # last_prefix_info on every cache-on paged join — hit/miss,
         # adopted vs prefilled token counts, COW copies. Emitted here
@@ -440,7 +695,7 @@ class Scheduler:
         info = getattr(self.engine, "last_prefix_info", None)
         if info is not None:
             self._event("prefix_cache", request=req.request_id,
-                        slot=slot, **info)
+                        slot=slot, **info, **self._tenant_field(req))
         # ttft_s: submit -> first token. The prefill samples the
         # request's first token, so TTFT = queue wait + prefill — kept
         # as its own field (not derived downstream) because the two
@@ -616,12 +871,19 @@ class Scheduler:
         already lost; the head's is still winnable). Requests without
         targets are never preempted; at most one preemption per round
         bounds the thrash; no over-budget victim = no preemption (a
-        healthy set is never sacrificed)."""
-        head = self._queue[0]
-        tt = head.ttft_target_ms
+        healthy set is never sacrificed). The gate reads the request
+        admission actually TRIED — under fair share that is the DRR
+        pick, not necessarily the arrival head (review finding: gating
+        on the head let a targetless head mask the blocked candidate's
+        at-risk TTFT; re-calling the picker is idempotent — no charge
+        happened, so the same tenant is named again)."""
+        blocked = self._next_candidate()
+        if blocked is None:
+            return False
+        tt = blocked.ttft_target_ms
         if tt is None:
             return False
-        if (time.perf_counter() - head._arrival) * 1e3 < 0.5 * tt:
+        if (time.perf_counter() - blocked._arrival) * 1e3 < 0.5 * tt:
             return False
         now = time.perf_counter()
         worst, worst_ratio = None, 1.0
@@ -670,7 +932,8 @@ class Scheduler:
         self.preemptions += 1
         self._event(phase="preempt", request=req.request_id,
                     generated=generated,
-                    dur_s=round(time.perf_counter() - req._arrival, 9))
+                    dur_s=round(time.perf_counter() - req._arrival, 9),
+                    **self._tenant_field(req))
         if requeue:
             keep_arrival(req)  # the unified stamp rule: no-op, by design
             self._queue.append(req)
@@ -756,12 +1019,14 @@ class Scheduler:
         arrival = request._arrival or now
         self._event(phase="queue_wait", request=request.request_id,
                     dur_s=round(max(0.0, (now - arrival)
-                                    - (dur_s or 0.0)), 9))
+                                    - (dur_s or 0.0)), 9),
+                    **self._tenant_field(request))
         self._event(phase="prefill", request=request.request_id,
                     slot=slot, bucket=None,
                     prompt_len=len(request.prompt),
                     dur_s=round(dur_s or 0.0, 9),
-                    ttft_s=round(now - arrival, 9))
+                    ttft_s=round(now - arrival, 9),
+                    **self._tenant_field(request))
         fl = _InFlight(request, slot,
                        list(request.prompt) + [int(first_tok)], 1,
                        first_token_t=now)
@@ -825,10 +1090,11 @@ class Scheduler:
                 progressed = self._admit_round()
                 if not (self._inflight or self._filling):
                     if self._queue and not progressed:
-                        # nothing running AND the head cannot be
-                        # admitted: the request can never fit
+                        # nothing running AND the tried candidate (the
+                        # DRR pick under fair share, else the head)
+                        # cannot be admitted: it can never fit
                         # (slot/pool shortage)
-                        head = self._queue[0]
+                        head = self._next_candidate() or self._queue[0]
                         raise RuntimeError(
                             f"request {head.request_id!r} cannot be "
                             f"admitted on an idle engine (prompt_len="

@@ -330,7 +330,9 @@ def _seed_one_result(result: dict, source: str, out: list,
         result.get("serving_burst_model_shape", "")) or m)
     m_sp = (_SERVING_SHAPE.search(
         result.get("seq_parallel_model_shape", "")) or m)
-    if m or m_px or m_cl or m_bu or m_sp:
+    m_te = (_SERVING_SHAPE.search(
+        result.get("serving_tenants_model_shape", "")) or m)
+    if m or m_px or m_cl or m_bu or m_sp or m_te:
         from chainermn_tpu.tuning.measure import decide
 
         for row_key, spread_key, name in (
@@ -350,6 +352,8 @@ def _seed_one_result(result: dict, source: str, out: list,
              "serving_burst_spread_pct", "prefill_chunk"),
             ("seq_parallel_ttft_ms",
              "seq_parallel_spread_pct", "prefill_seq_parallel"),
+            ("serving_tenants_adapter_ms",
+             "serving_tenants_adapter_spread_pct", "adapter_impl"),
         ):
             rows = result.get(row_key)
             if not (isinstance(rows, dict) and len(rows) >= 2 and all(
@@ -377,6 +381,8 @@ def _seed_one_result(result: dict, source: str, out: list,
                     m_row = m_bu
                 elif name == "prefill_seq_parallel":
                     m_row = m_sp
+                elif name == "adapter_impl":
+                    m_row = m_te
                 else:
                     m_row = m
                 if m_row is None:
@@ -429,6 +435,18 @@ def _seed_one_result(result: dict, source: str, out: list,
                     v = result.get("seq_parallel_ttft_shards_ms")
                     if v is not None:
                         evidence["ttft_shards_ms"] = v
+                if name == "adapter_impl":
+                    # the multi-tenant goodput + fairness behind the
+                    # gather/merged ranking (ISSUE 14) — a 'merged'
+                    # entry the next session can audit for WHY the
+                    # fold won (single-tenant-dominant traffic).
+                    for ev_key, row in (
+                        ("goodput", "serving_tenants_goodput"),
+                        ("fairness", "serving_tenants_fairness"),
+                    ):
+                        v = result.get(row)
+                        if v is not None:
+                            evidence[ev_key] = v
                 put(name, key, winner, evidence)
 
     # Double buffering: the measured on/off step-time ratio.

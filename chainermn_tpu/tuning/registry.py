@@ -123,6 +123,15 @@ DEFAULT_TABLE: dict = {
     # everywhere until a bench ``seq_parallel`` capture shows Ulysses
     # winning a shape; heads-indivisible shapes force ring regardless.
     "seq_attn_impl": {"*": "ring"},
+    # Multi-tenant adapter application (ISSUE 14): 'gather' = the one
+    # compiled program gathers each slot's A/B rows and adds the rank-r
+    # delta in-forward — mixed-tenant traffic pays O(r(d_in+d_out)) per
+    # projection and tenant churn stays host metadata; 'merged' folds
+    # one tenant's delta into the base weights (zero per-step cost but
+    # ONE tenant per engine). Gather everywhere until the bench's
+    # ``serving_tenants`` rows show merging winning a single-tenant-
+    # dominant shape (spread-gated, the spec_tokens precedent).
+    "adapter_impl": {"*": "gather"},
     # Sequence-parallel long-prompt prefill over the replica's 'model'
     # partition (ISSUE 13): 'off' until the bench's long-prompt TTFT
     # rows (``seq_parallel_ttft_ms``) show the sharded forward beating

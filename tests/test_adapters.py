@@ -1,0 +1,1015 @@
+"""Multi-tenant adapter serving invariants (ISSUE 14).
+
+The load-bearing acceptance pins:
+
+- **Tenant-stream equivalence** — every tenant's engine stream is
+  bit-identical to sequential ``generate`` under that tenant's adapter
+  (``bank.adapter_arrays`` — the same folded values the program
+  gathers) AND to ``generate`` over the offline-merged (base + A@B)
+  weights, across dense == paged == TP == single-device, composing
+  with speculative decode, the prefix cache, and chunked prefill. A
+  zero-adapter tenant is bitwise the base model.
+- **Structural pins** — the decode/verify/mixed jit caches stay at ONE
+  entry across tenant join/leave/adapter-registration churn, and the
+  TP decode HLO with adapters active carries exactly the pre-adapter
+  2 all-reduces per layer (nothing new on the wire).
+- **Isolation** — the prefix trie is tenant-namespaced: two tenants
+  over the identical system prompt share ZERO blocks while
+  within-tenant hits are preserved; a session re-submitted under a
+  different tenant raises at both front doors.
+- **Fairness math in isolation** — deficit-round-robin quota units and
+  the Jain index pinned against a literal numpy reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from chainermn_tpu.models.transformer import TransformerLM, generate
+from chainermn_tpu.observability.stats import jain_index
+from chainermn_tpu.serving import (
+    AdapterBank,
+    DeficitRoundRobin,
+    LowRankAdapter,
+    Request,
+    Scheduler,
+    ServingEngine,
+    random_adapter,
+)
+
+VOCAB = 32
+
+
+def tiny_lm(**kw):
+    cfg = dict(vocab_size=VOCAB, num_layers=2, num_heads=4, d_model=16,
+               d_ff=32, max_len=32, compute_dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def bank(lm):
+    model, _ = lm
+    b = AdapterBank(model, capacity=5, rank=2)
+    b.register("t1", random_adapter(model, 2, seed=1, scale=2.0))
+    b.register("t2", random_adapter(model, 1, seed=2,
+                                    targets=("qkv", "ff_down")))
+    b.register("zero")  # zero-adapter tenant: the null row
+    return b
+
+
+def _engine(lm, bank, **kw):
+    model, params = lm
+    cfg = dict(num_slots=2, max_len=32, decode_impl="paged",
+               kv_block_size=8, prefill_buckets=(4, 8),
+               spec_tokens=0, prefix_cache="off", prefill_chunk=0,
+               prefill_seq_parallel="off", adapter_bank=bank,
+               adapter_impl="gather")
+    cfg.update(kw)
+    return ServingEngine(model, params, **cfg)
+
+
+def _requests(n, seed=0, tenants=("t1", "t2", "zero", None)):
+    rs = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        p = rs.randint(1, VOCAB, size=int(rs.randint(2, 7))).tolist()
+        out.append((p, int(rs.randint(2, 6)), tenants[i % len(tenants)]))
+    return out
+
+
+def _gen_ref(model, params, prompt, n_new, adapters=None):
+    return np.asarray(generate(
+        model, params, jnp.asarray([prompt], jnp.int32),
+        len(prompt) + n_new, adapters=adapters,
+    ))[0].tolist()
+
+
+def _run_stream(engine, reqs, policy="prefill_priority", **sched_kw):
+    sched = Scheduler(engine, policy=policy, **sched_kw)
+    ids = [sched.submit(Request(prompt=p, max_new_tokens=g, tenant_id=t))
+           for p, g, t in reqs]
+    results = sched.run()
+    return [results[rid]["tokens"] for rid in ids], sched
+
+
+class TestStreamEquivalence:
+    """Engine streams == generate under the tenant's adapter, every
+    cache layout and composition."""
+
+    @pytest.mark.parametrize("impl", ["dense", "paged"])
+    def test_mixed_tenant_staggered_streams(self, lm, bank, impl):
+        model, params = lm
+        engine = _engine(lm, bank, decode_impl=impl)
+        reqs = _requests(6, seed=0)
+        streams, _ = _run_stream(engine, reqs)
+        for (p, g, t), got in zip(reqs, streams):
+            ad = bank.adapter_arrays(t) if t is not None else None
+            assert got == _gen_ref(model, params, p, g, ad), t
+
+    def test_zero_adapter_tenant_is_bitwise_base(self, lm, bank):
+        model, params = lm
+        engine = _engine(lm, bank)
+        p = [3, 5, 7, 11]
+        slot, tok, _ = engine.prefill_join(p, tenant_id="zero")
+        stream = list(p) + [tok]
+        for _ in range(4):
+            toks, _ = engine.decode_step()
+            stream.append(int(toks[slot]))
+        engine.leave(slot)
+        assert stream == _gen_ref(model, params, p, 5)
+
+    def test_gather_stream_matches_offline_merged_reference(self, lm,
+                                                            bank):
+        """The ISSUE 14 anchor: the per-slot gather path reproduces the
+        stream of ``generate`` over the offline-merged (base + A@B)
+        weights."""
+        model, params = lm
+        engine = _engine(lm, bank)
+        merged = bank.merge_adapter_params(params, "t1")
+        reqs = [(p, g, "t1") for p, g, _ in _requests(3, seed=4)]
+        streams, _ = _run_stream(engine, reqs)
+        for (p, g, _t), got in zip(reqs, streams):
+            ref = np.asarray(generate(
+                model, merged, jnp.asarray([p], jnp.int32), len(p) + g,
+            ))[0].tolist()
+            assert got == ref
+
+    def test_merged_engine_serves_offline_merged_stream(self, lm, bank):
+        model, params = lm
+        engine = _engine(lm, bank, adapter_impl="merged",
+                         merged_tenant="t1")
+        merged = bank.merge_adapter_params(params, "t1")
+        reqs = [(p, g, "t1") for p, g, _ in _requests(3, seed=5)]
+        streams, _ = _run_stream(engine, reqs)
+        for (p, g, _t), got in zip(reqs, streams):
+            ref = np.asarray(generate(
+                model, merged, jnp.asarray([p], jnp.int32), len(p) + g,
+            ))[0].tolist()
+            assert got == ref
+
+    def test_merged_engine_refuses_other_tenants(self, lm, bank):
+        engine = _engine(lm, bank, adapter_impl="merged",
+                         merged_tenant="t1")
+        with pytest.raises(ValueError, match="merged tenant"):
+            engine.prefill_join([1, 2, 3], tenant_id="t2")
+        sched = Scheduler(engine)
+        with pytest.raises(ValueError, match="cannot be served"):
+            sched.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                                 tenant_id="t2"))
+
+    def test_speculative_decode_composes(self, lm, bank):
+        model, params = lm
+        engine = _engine(lm, bank, spec_tokens=3, num_slots=3)
+        rs = np.random.RandomState(9)
+        reqs = []
+        for i, t in enumerate(["t1", "t2", "t1", "zero"]):
+            base = rs.randint(1, VOCAB, size=3).tolist()
+            reqs.append(((base * 3)[: int(rs.randint(4, 9))],
+                         int(rs.randint(3, 7)), t))
+        streams, _ = _run_stream(engine, reqs)
+        for (p, g, t), got in zip(reqs, streams):
+            ad = bank.adapter_arrays(t)
+            assert got == _gen_ref(model, params, p, g, ad), t
+        assert engine.verify_compile_count() in (None, 1)
+
+    def test_prefix_cache_composes_within_tenant(self, lm, bank):
+        model, params = lm
+        engine = _engine(lm, bank, prefix_cache="on", num_slots=2,
+                         max_len=32)
+        sys_p = list(range(1, 17))  # two full 8-token blocks
+        reqs = [(sys_p + [20 + i], 3, "t1") for i in range(3)]
+        streams, sched = _run_stream(engine, reqs)
+        for (p, g, t), got in zip(reqs, streams):
+            ad = bank.adapter_arrays(t)
+            assert got == _gen_ref(model, params, p, g, ad)
+        assert engine.prefix_stats["hits"] >= 2  # followers hit
+
+    def test_chunked_prefill_composes(self, lm, bank):
+        model, params = lm
+        engine = _engine(lm, bank, prefill_chunk=4, num_slots=3)
+        reqs = _requests(5, seed=11)
+        streams, _ = _run_stream(engine, reqs)
+        for (p, g, t), got in zip(reqs, streams):
+            ad = bank.adapter_arrays(t) if t is not None else None
+            assert got == _gen_ref(model, params, p, g, ad), t
+        assert engine.mixed_compile_count() in (None, 1)
+
+
+class TestTensorParallel:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return Mesh(np.array(jax.devices("cpu")[:2]), ("model",))
+
+    @pytest.mark.parametrize("impl", ["dense", "paged"])
+    def test_tp_streams_match_single_device_and_generate(self, lm, bank,
+                                                         mesh, impl):
+        model, params = lm
+        reqs = _requests(5, seed=13)
+        single = _engine(lm, bank, decode_impl=impl, num_slots=3)
+        tp = _engine(lm, bank, decode_impl=impl, num_slots=3, mesh=mesh)
+        s_streams, _ = _run_stream(single, reqs)
+        t_streams, _ = _run_stream(tp, reqs)
+        assert t_streams == s_streams
+        for (p, g, t), got in zip(reqs, t_streams):
+            ad = bank.adapter_arrays(t) if t is not None else None
+            assert got == _gen_ref(model, params, p, g, ad), t
+
+    def test_tp_decode_collective_counts_with_adapters(self, lm, bank,
+                                                       mesh):
+        """The ISSUE 14 wire pin: adapters active, the compiled decode
+        step carries EXACTLY the pre-adapter 2 all-reduces per layer —
+        the deltas ride the existing column/row split, nothing new."""
+        model, _params = lm
+        engine = _engine(lm, bank, num_slots=3, mesh=mesh)
+        args = (
+            engine._cache, engine._vars, engine._adapter_device(),
+            jnp.zeros((3,), jnp.int32), jnp.zeros((3,), jnp.int32),
+            jnp.asarray(engine._dummy_tables()),
+            jnp.zeros((3,), jnp.int32), engine._key,
+        )
+        txt = engine._decode_step_jit.lower(*args).compile().as_text()
+        n_ar = txt.count("all-reduce(")
+        assert n_ar == 2 * model.num_layers, n_ar
+        for op in ("all-gather(", "collective-permute(", "all-to-all(",
+                   "reduce-scatter("):
+            assert txt.count(op) == 0, f"unexpected {op}"
+
+
+class TestNoRecompile:
+    def test_jit_cache_pinned_across_tenant_and_registration_churn(
+            self, lm):
+        """The tentpole structural pin: tenant join/leave churn AND
+        adapter registration/eviction churn mutate host metadata (+ one
+        H2D) only — the decode step compiles exactly once."""
+        model, params = lm
+        bank = AdapterBank(model, capacity=4, rank=2)
+        bank.register("a", random_adapter(model, 2, seed=1))
+        bank.register("b", random_adapter(model, 2, seed=2))
+        engine = _engine(lm, bank, num_slots=2)
+        for i, t in enumerate(["a", "b", "a", None]):
+            p = [1 + i, 2 + i, 3 + i]
+            slot, _tok, _ = engine.prefill_join(p, tenant_id=t)
+            engine.decode_step()
+            engine.leave(slot)
+        # registration churn mid-life: new tenant, evicted tenant,
+        # re-registered weights — same compiled step serves them all
+        engine.evict_adapter("b")
+        engine.register_adapter("c", random_adapter(model, 1, seed=3))
+        slot, _tok, _ = engine.prefill_join([5, 6, 7], tenant_id="c")
+        engine.decode_step()
+        engine.leave(slot)
+        assert engine.decode_compile_count() in (None, 1)
+
+    def test_registration_reaches_next_step_without_recompile(self, lm):
+        model, params = lm
+        bank = AdapterBank(model, capacity=3, rank=2)
+        bank.register("a", random_adapter(model, 2, seed=1))
+        engine = _engine(lm, bank, num_slots=2)
+        p = [2, 3, 4, 5]
+        slot, tok, _ = engine.prefill_join(p, tenant_id="a")
+        stream = [*p, tok]
+        toks, _ = engine.decode_step()
+        stream.append(int(toks[slot]))
+        engine.leave(slot)
+        # swap a's weights (drained) — streams now follow the NEW rows
+        bank.register("a", random_adapter(model, 2, seed=42))
+        slot, tok, _ = engine.prefill_join(p, tenant_id="a")
+        stream2 = [*p, tok]
+        for _ in range(3):
+            toks, _ = engine.decode_step()
+            stream2.append(int(toks[slot]))
+        engine.leave(slot)
+        assert stream2 == _gen_ref(model, params, p, 4,
+                                   bank.adapter_arrays("a"))
+        assert engine.decode_compile_count() in (None, 1)
+
+
+class TestAdapterBank:
+    def test_register_evict_refcounts(self, lm):
+        model, _ = lm
+        bank = AdapterBank(model, capacity=3, rank=2)
+        r1 = bank.register("a", random_adapter(model, 2, seed=1))
+        assert r1 != 0 and bank.resident("a")
+        bank.pin("a")
+        with pytest.raises(RuntimeError, match="pinned"):
+            bank.evict("a")
+        with pytest.raises(RuntimeError, match="pinned"):
+            bank.register("a", random_adapter(model, 2, seed=2))
+        bank.unpin("a")
+        bank.evict("a")
+        assert not bank.resident("a")
+        with pytest.raises(KeyError):
+            bank.row_of("a")
+
+    def test_capacity_and_rank_budget(self, lm):
+        model, _ = lm
+        bank = AdapterBank(model, capacity=2, rank=1)
+        bank.register("a", random_adapter(model, 1, seed=1))
+        with pytest.raises(RuntimeError, match="bank full"):
+            bank.register("b", random_adapter(model, 1, seed=2))
+        bank.evict("a")
+        with pytest.raises(ValueError, match="rank"):
+            bank.register("b", random_adapter(model, 2, seed=2))
+
+    def test_zero_adapter_rides_null_row_and_row_reuse(self, lm):
+        model, _ = lm
+        bank = AdapterBank(model, capacity=3, rank=2)
+        assert bank.register("z") == 0
+        assert bank.row_of("z") == 0 and bank.row_of(None) == 0
+        r = bank.register("a", random_adapter(model, 2, seed=1))
+        bank.evict("a")
+        assert bank.register("b", random_adapter(model, 2, seed=2)) == r
+
+    def test_smaller_rank_zero_pads_exactly(self, lm):
+        """A rank-1 adapter in a rank-2 bank gathers identical values:
+        the padded columns are exact zeros."""
+        model, params = lm
+        ad = random_adapter(model, 1, seed=3)
+        bank = AdapterBank(model, capacity=2, rank=4)
+        bank.register("a", ad)
+        arrays = bank.adapter_arrays("a")
+        for li, layer in enumerate(ad.layers):
+            for tgt, (A, B) in layer.items():
+                As, Bs = arrays[li][tgt]
+                np.testing.assert_array_equal(As[:, :1], A)
+                assert not As[:, 1:].any() and not Bs[1:, :].any()
+
+    def test_shape_validation(self, lm):
+        model, _ = lm
+        bank = AdapterBank(model, capacity=2, rank=2)
+        bad = LowRankAdapter(
+            [{"qkv": (np.zeros((7, 2), np.float32),
+                      np.zeros((2, 5), np.float32))}
+             for _ in range(model.num_layers)]
+        )
+        with pytest.raises(ValueError, match="do not match"):
+            bank.register("a", bad)
+        with pytest.raises(ValueError, match="layers"):
+            bank.register("a", LowRankAdapter([{}]))
+
+    def test_engine_requires_registered_tenant(self, lm, bank):
+        engine = _engine(lm, bank)
+        with pytest.raises(KeyError, match="no registered adapter"):
+            engine.prefill_join([1, 2, 3], tenant_id="ghost")
+        sched = Scheduler(engine)
+        with pytest.raises(ValueError, match="cannot be served"):
+            sched.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                                 tenant_id="ghost"))
+
+    def test_adapter_impl_validation(self, lm, bank):
+        with pytest.raises(ValueError, match="adapter_impl"):
+            _engine(lm, None, adapter_bank=None, adapter_impl="gather")
+        with pytest.raises(ValueError, match="merged_tenant"):
+            _engine(lm, bank, adapter_impl="merged")
+        with pytest.raises(ValueError, match="adapter_impl"):
+            _engine(lm, bank, adapter_impl="bogus")
+
+
+class TestFairnessMath:
+    """ISSUE 14 satellite: the DRR quota units and the Jain index in
+    isolation."""
+
+    def test_weighted_shares_under_saturation(self):
+        drr = DeficitRoundRobin()
+        drr.set_weight("a", 3.0)
+        drr.set_weight("b", 1.0)
+        served = {"a": 0, "b": 0}
+        for _ in range(400):
+            t = drr.select({"a": 5, "b": 5})
+            drr.charge(t, 5)
+            served[t] += 1
+        assert abs(served["a"] / served["b"] - 3.0) < 0.15
+
+    def test_weighted_shares_with_uneven_costs(self):
+        """Shares are WORK-proportional, not request-proportional: a
+        tenant whose requests cost 2x gets half the admissions at
+        equal weight."""
+        drr = DeficitRoundRobin()
+        work = {"big": 0.0, "small": 0.0}
+        for _ in range(600):
+            t = drr.select({"big": 8, "small": 4})
+            drr.charge(t, 8 if t == "big" else 4)
+            work[t] += 8 if t == "big" else 4
+        assert abs(work["big"] / work["small"] - 1.0) < 0.1
+
+    def test_idle_tenant_deficit_resets(self):
+        """A tenant that went idle must NOT hoard credit and
+        burst-starve the others on return."""
+        drr = DeficitRoundRobin()
+        for _ in range(50):  # b backlogged alone: would bank credit
+            t = drr.select({"a": 1})
+            drr.charge(t, 1)
+        assert drr.deficit("b") == 0.0
+        served = {"a": 0, "b": 0}
+        for _ in range(100):  # b returns: even split, no catch-up burst
+            t = drr.select({"a": 1, "b": 1})
+            drr.charge(t, 1)
+            served[t] += 1
+        assert abs(served["a"] - served["b"]) <= 2
+
+    def test_quota_churn_mid_run(self):
+        drr = DeficitRoundRobin()
+        drr.set_weight("a", 1.0)
+        drr.set_weight("b", 1.0)
+        for _ in range(100):
+            drr.charge(drr.select({"a": 1, "b": 1}), 1)
+        drr.set_weight("a", 4.0)  # quota raised mid-run
+        served = {"a": 0, "b": 0}
+        for _ in range(500):
+            t = drr.select({"a": 1, "b": 1})
+            drr.charge(t, 1)
+            served[t] += 1
+        assert abs(served["a"] / served["b"] - 4.0) < 0.25
+
+    def test_validation(self):
+        drr = DeficitRoundRobin()
+        with pytest.raises(ValueError):
+            drr.set_weight("a", 0.0)
+        with pytest.raises(ValueError):
+            DeficitRoundRobin(quantum=0)
+        assert drr.select({}) is None
+
+    def test_jain_index_against_numpy_reference(self):
+        rs = np.random.RandomState(5)
+        for _ in range(10):
+            xs = rs.uniform(0.0, 10.0, size=int(rs.randint(1, 9)))
+            ref = float(
+                np.sum(xs) ** 2 / (xs.size * np.sum(np.square(xs))))
+            assert abs(jain_index(xs.tolist()) - ref) < 1e-12
+        assert jain_index([]) is None
+        assert jain_index([0.0, 0.0]) == 1.0
+        assert abs(jain_index([1.0, 0.0, 0.0, 0.0]) - 0.25) < 1e-12
+
+    def test_scheduler_fair_share_order_under_saturation(self, lm, bank):
+        """End-to-end: a 1-slot engine + weighted tenants — admission
+        ORDER follows the weights even though every request finishes."""
+        engine = _engine(lm, bank, num_slots=1)
+        sched = Scheduler(engine, policy="fcfs",
+                          tenant_weights={"t1": 2.0, "t2": 1.0})
+        rs = np.random.RandomState(3)
+        order = []
+        orig = engine.prefill_join
+
+        def spy(prompt, tenant_id=None):
+            res = orig(prompt, tenant_id=tenant_id)
+            if res is not None:
+                order.append(tenant_id)
+            return res
+
+        engine.prefill_join = spy
+        for i in range(9):
+            t = "t1" if i < 4 else "t2"  # t2 queued behind t1's block
+            p = rs.randint(1, VOCAB, size=3).tolist()
+            sched.submit(Request(prompt=p, max_new_tokens=2,
+                                 tenant_id=t))
+        sched.run()
+        engine.prefill_join = orig
+        # weight 2:1 with equal costs: t1 admits ~2 per t2 while both
+        # are backlogged (first 6 admissions carry both tenants).
+        assert order.count("t1") == 4 and order.count("t2") == 5
+        assert "t2" in order[:3]  # t2 was not starved behind t1's block
+
+
+class TestPrefixIsolation:
+    """ISSUE 14 satellite: cross-tenant adoption is structurally
+    impossible; within-tenant hits are preserved."""
+
+    def test_identical_prompt_two_tenants_zero_shared_blocks(self, lm,
+                                                             bank):
+        model, params = lm
+        engine = _engine(lm, bank, prefix_cache="on", num_slots=2)
+        sys_p = list(range(1, 17))  # two full blocks
+        # tenant t1 warms ITS namespace
+        for tail in (20, 21):
+            streams, sched = _run_stream(
+                engine, [(sys_p + [tail], 3, "t1")])
+        info_t1 = engine.last_prefix_info
+        assert info_t1["hit_blocks"] == 2  # within-tenant hit preserved
+        # t2 over the IDENTICAL prompt: must MISS (namespace isolation)
+        streams, sched = _run_stream(engine, [(sys_p + [20], 3, "t2")])
+        info_t2 = engine.last_prefix_info
+        assert info_t2["hit_blocks"] == 0
+        assert info_t2["prefill_tokens"] == len(sys_p) + 1
+        # and the streams are each tenant's own, not each other's
+        assert streams[0] == _gen_ref(model, params, sys_p + [20], 3,
+                                      bank.adapter_arrays("t2"))
+        # structural: the two namespaces cache DISJOINT physical blocks
+        trie = engine._prefix
+        assert trie.namespace_blocks("t1") >= 2
+        assert trie.namespace_blocks("t2") >= 2
+
+    def test_match_depth_is_namespaced(self, lm, bank):
+        engine = _engine(lm, bank, prefix_cache="on", num_slots=2)
+        sys_p = list(range(1, 17))
+        _run_stream(engine, [(sys_p + [20], 3, "t1")])
+        assert engine.prefix_match_depth(sys_p, tenant_id="t1") == 2
+        assert engine.prefix_match_depth(sys_p, tenant_id="t2") == 0
+        assert engine.prefix_match_depth(sys_p) == 0  # default ns
+
+
+class TestSessionTenantGuard:
+    def test_scheduler_refuses_tenant_swap(self, lm, bank):
+        engine = _engine(lm, bank)
+        sched = Scheduler(engine)
+        sched.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                             tenant_id="t1", session_id="s"))
+        with pytest.raises(ValueError, match="never change tenants"):
+            sched.submit(Request(prompt=[3, 4], max_new_tokens=2,
+                                 tenant_id="t2", session_id="s"))
+        # same tenant: fine (the run drains both turns)
+        sched.submit(Request(prompt=[3, 4], max_new_tokens=2,
+                             tenant_id="t1", session_id="s"))
+        sched.run()
+
+    def test_router_refuses_tenant_swap(self, lm, bank):
+        from chainermn_tpu.serving.cluster import Replica, Router
+
+        engine = _engine(lm, bank)
+        rep = Replica(engine, Scheduler(engine, "prefill_priority"), 0)
+        router = Router([rep], mode="colocated")
+        router.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                              tenant_id="t1", session_id="s"))
+        with pytest.raises(ValueError, match="never change tenants"):
+            router.submit(Request(prompt=[3, 4], max_new_tokens=2,
+                                  tenant_id="t2", session_id="s"))
+        router.run()
+
+
+class TestRouterResidency:
+    def test_placement_follows_adapter_residency(self, lm):
+        from chainermn_tpu.serving.cluster import Replica, Router
+
+        model, params = lm
+        bank_a = AdapterBank(model, capacity=3, rank=2)
+        bank_a.register("acme", random_adapter(model, 2, seed=1))
+        bank_b = AdapterBank(model, capacity=3, rank=2)
+        bank_b.register("globex", random_adapter(model, 2, seed=2))
+        reps = []
+        for i, b in enumerate((bank_a, bank_b)):
+            eng = _engine(lm, b, num_slots=2)
+            reps.append(Replica(eng, Scheduler(eng, "prefill_priority"),
+                                i))
+        router = Router(reps, policy="least_loaded", mode="colocated")
+        rs = np.random.RandomState(5)
+        reqs = []
+        for i in range(6):
+            t = "acme" if i % 2 == 0 else "globex"
+            p = rs.randint(1, VOCAB, size=4).tolist()
+            reqs.append((router.submit(Request(
+                prompt=p, max_new_tokens=3, tenant_id=t)), p, t))
+        results = router.run()
+        # every stream decoded under ITS tenant's adapter
+        for rid, p, t in reqs:
+            b = bank_a if t == "acme" else bank_b
+            assert results[rid]["tokens"] == _gen_ref(
+                model, params, p, 3, b.adapter_arrays(t)), t
+        # routes: acme only ever landed on replica 0, globex on 1
+        routes = {e["request"]: e["replica"]
+                  for e in router._events if e["kind"] == "route"}
+        for rid, _p, t in reqs:
+            assert routes[rid] == (0 if t == "acme" else 1)
+
+    def test_unplaceable_tenant_raises_at_front_door(self, lm, bank):
+        from chainermn_tpu.serving.cluster import Replica, Router
+
+        engine = _engine(lm, bank)
+        rep = Replica(engine, Scheduler(engine, "prefill_priority"), 0)
+        router = Router([rep], mode="colocated")
+        with pytest.raises(ValueError, match="no resident adapter"):
+            router.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                                  tenant_id="ghost"))
+
+
+class TestKvTransferTenant:
+    def test_export_import_carries_tenant(self, lm, bank):
+        model, params = lm
+        src = _engine(lm, bank, num_slots=2)
+        dst = _engine(lm, bank, num_slots=2)
+        p = [2, 3, 5, 7, 11]
+        slot, tok, _ = src.prefill_join(p, tenant_id="t1")
+        payload = src.export_kv(slot)
+        src.leave(slot)
+        assert payload["tenant"] == "t1"
+        dslot, last = dst.import_kv(payload)
+        assert dst.tenant_of_slot(dslot) == "t1"
+        stream = list(p) + [int(last)]
+        for _ in range(3):
+            toks, _ = dst.decode_step()
+            stream.append(int(toks[dslot]))
+        dst.leave(dslot)
+        assert stream == _gen_ref(model, params, p, 4,
+                                  bank.adapter_arrays("t1"))
+
+    def test_import_refuses_unresident_tenant(self, lm, bank):
+        model, params = lm
+        src = _engine(lm, bank, num_slots=2)
+        other = AdapterBank(model, capacity=2, rank=2)
+        dst = _engine(lm, other, num_slots=2)
+        slot, _tok, _ = src.prefill_join([1, 2, 3], tenant_id="t1")
+        payload = src.export_kv(slot)
+        src.leave(slot)
+        with pytest.raises(ValueError, match="no resident adapter"):
+            dst.import_kv(payload)
+
+
+class TestTenantRollup:
+    def test_summary_tenants_and_fairness(self, lm, bank):
+        engine = _engine(lm, bank, num_slots=2)
+        reqs = [(p, g, t) for (p, g, _), t in zip(
+            _requests(6, seed=21), ["t1", "t1", "t2", "t2", "zero",
+                                    "t1"])]
+        _streams, sched = _run_stream(engine, reqs)
+        s = sched.summary()
+        assert set(s["tenants"]) == {"t1", "t2", "zero"}
+        assert s["tenants"]["t1"]["requests"] == 3
+        assert s["tenants"]["t2"]["requests"] == 2
+        for row in s["tenants"].values():
+            assert row["ttft_ms_p50"] is not None
+            assert row["generated_tokens"] >= 1
+        tok = [s["tenants"][t]["generated_tokens"]
+               for t in s["tenants"]]
+        assert s["tenant_fairness_jain"] == round(jain_index(tok), 4)
+
+    def test_pre_tenant_events_roll_up_as_default(self, lm):
+        """Satellite: traces without tenant fields keep parsing — one
+        'default' tenant carries everything."""
+        from chainermn_tpu.observability.trace import summarize_serving
+
+        events = [
+            {"kind": "serving", "phase": "prefill", "request": "r0",
+             "slot": 0, "prompt_len": 3, "dur_s": 0.01, "ttft_s": 0.012},
+            {"kind": "serving", "phase": "decode_step", "n_active": 1,
+             "n_slots": 2, "tokens": 1, "dur_s": 0.004},
+            {"kind": "serving", "phase": "finish", "request": "r0",
+             "generated": 2, "dur_s": 0.03},
+        ]
+        s = summarize_serving(events)
+        assert list(s["tenants"]) == ["default"]
+        assert s["tenants"]["default"]["requests"] == 1
+        assert s["tenant_fairness_jain"] == 1.0
+
+    def test_tenant_gauges_publish(self, lm, bank):
+        from chainermn_tpu.observability import metrics
+
+        metrics.reset()
+        try:
+            reg = metrics.registry()
+            engine = _engine(lm, bank, num_slots=2)
+            slot, _tok, _ = engine.prefill_join([1, 2, 3],
+                                                tenant_id="t1")
+            snap = reg.snapshot()
+            assert "adapter_bank_residents" in snap
+            assert "adapter_bank_free_rows" in snap
+            vals = {
+                row["labels"].get("tenant"): row["value"]
+                for row in snap["serving_tenant_active_slots"]["values"]
+            }
+            assert vals["t1"] == 1
+            assert vals.get("t2", 0) == 0
+            engine.leave(slot)
+        finally:
+            metrics.reset()
+
+
+class TestAdapterChurnInvalidation:
+    """Review finding: re-registering a tenant changes the weights
+    behind its cached KV — the engine must drop the tenant's trie
+    namespace on ANY bank content change (overwrite, zero-downgrade,
+    evict), or a later join adopts stale-adapter blocks and the stream
+    silently diverges from ``generate`` under the new weights."""
+
+    def test_reregister_drops_stale_prefix_blocks(self, lm):
+        model, params = lm
+        b = AdapterBank(model, capacity=3, rank=2)
+        b.register("acme", random_adapter(model, 2, seed=11))
+        engine = _engine(lm, b, prefix_cache="on", num_slots=2)
+        sys_p = list(range(1, 17))  # two full blocks
+        _run_stream(engine, [(sys_p + [20], 3, "acme")])
+        assert engine._prefix.namespace_blocks("acme") >= 2
+        b.register("acme", random_adapter(model, 2, seed=12))
+        assert engine._prefix.namespace_blocks("acme") == 0
+        streams, _ = _run_stream(engine, [(sys_p + [20], 3, "acme")])
+        info = engine.last_prefix_info
+        assert info["hit_blocks"] == 0  # re-prefilled, never adopted
+        assert streams[0] == _gen_ref(model, params, sys_p + [20], 3,
+                                      b.adapter_arrays("acme"))
+
+    def test_zero_downgrade_and_evict_drop_namespace(self, lm):
+        model, params = lm
+        b = AdapterBank(model, capacity=3, rank=2)
+        b.register("acme", random_adapter(model, 2, seed=13))
+        engine = _engine(lm, b, prefix_cache="on", num_slots=2)
+        sys_p = list(range(1, 17))
+        _run_stream(engine, [(sys_p + [20], 3, "acme")])
+        b.register("acme")  # downgrade to the zero adapter
+        assert engine._prefix.namespace_blocks("acme") == 0
+        streams, _ = _run_stream(engine, [(sys_p + [21], 3, "acme")])
+        assert streams[0] == _gen_ref(model, params, sys_p + [21], 3)
+        _run_stream(engine, [(sys_p + [20], 3, "acme")])
+        assert engine._prefix.namespace_blocks("acme") >= 2
+        b.evict("acme")
+        assert engine._prefix.namespace_blocks("acme") == 0
+
+    def test_drop_namespace_respects_live_refcounts(self):
+        from chainermn_tpu.serving.kv_blocks import (
+            BlockAllocator,
+            PrefixCache,
+        )
+
+        alloc = BlockAllocator(num_blocks=8, block_size=4, num_slots=2,
+                               max_len=16)
+        trie = PrefixCache(alloc)
+        assert alloc.ensure(0, 8)
+        blocks = alloc.owned_blocks(0)
+        assert trie.insert(list(range(8)), blocks,
+                           namespace="acme") == 2
+        free_before = alloc.free_blocks
+        assert trie.drop_namespace("acme") == 2
+        assert trie.lookup(list(range(8)), namespace="acme") == []
+        # still referenced by slot 0: uncached, NOT freed
+        assert alloc.free_blocks == free_before
+        alloc.release(0)
+        assert alloc.free_blocks == free_before + len(blocks)
+        # the default namespace is recreated after a drop
+        trie.drop_namespace(None)
+        assert alloc.ensure(1, 4)
+        trie.insert(list(range(4)), alloc.owned_blocks(1))
+        assert trie.drop_namespace("ghost") == 0
+
+
+class TestDisaggResidency:
+    """Review finding: 'resident somewhere' let a tenant whose adapter
+    lived only on the wrong plane past the front door — the prefill
+    pump then crashed the run loop with a KeyError."""
+
+    def _disagg(self, lm, bank_p, bank_d):
+        from chainermn_tpu.serving.cluster import Replica, Router
+
+        eng_p = _engine(lm, bank_p, num_slots=2)
+        eng_d = _engine(lm, bank_d, num_slots=2)
+        reps = [Replica(eng_p, Scheduler(eng_p, "prefill_priority"), 0),
+                Replica(eng_d, Scheduler(eng_d, "prefill_priority"), 1)]
+        return Router(reps, policy="least_loaded", mode="disaggregated",
+                      prefill_replicas=[0])
+
+    def test_decode_only_residency_refused(self, lm):
+        model, _ = lm
+        bank_p = AdapterBank(model, capacity=3, rank=2)
+        bank_d = AdapterBank(model, capacity=3, rank=2)
+        bank_d.register("acme", random_adapter(model, 2, seed=3))
+        router = self._disagg(lm, bank_p, bank_d)
+        with pytest.raises(ValueError, match="alive prefill replica"):
+            router.submit(Request(prompt=[1, 2, 3], max_new_tokens=2,
+                                  tenant_id="acme"))
+
+    def test_prefill_only_residency_refused(self, lm):
+        model, _ = lm
+        bank_p = AdapterBank(model, capacity=3, rank=2)
+        bank_p.register("acme", random_adapter(model, 2, seed=3))
+        bank_d = AdapterBank(model, capacity=3, rank=2)
+        router = self._disagg(lm, bank_p, bank_d)
+        with pytest.raises(ValueError, match="alive decode replica"):
+            router.submit(Request(prompt=[1, 2, 3], max_new_tokens=2,
+                                  tenant_id="acme"))
+
+    def test_both_planes_resident_serves(self, lm):
+        model, params = lm
+        bank_p = AdapterBank(model, capacity=3, rank=2)
+        bank_d = AdapterBank(model, capacity=3, rank=2)
+        # identical weights on both planes (same seed): the handoff's
+        # stream must match the single-engine reference bitwise
+        bank_p.register("acme", random_adapter(model, 2, seed=3))
+        bank_d.register("acme", random_adapter(model, 2, seed=3))
+        router = self._disagg(lm, bank_p, bank_d)
+        rid = router.submit(Request(prompt=[1, 2, 3], max_new_tokens=3,
+                                    tenant_id="acme"))
+        results = router.run()
+        assert results[rid]["tokens"] == _gen_ref(
+            model, params, [1, 2, 3], 3, bank_d.adapter_arrays("acme"))
+
+
+class TestRequeueFairShareCost:
+    """Review finding: a preempted-and-requeued stream was re-charged
+    its full decode budget on re-admission, dragging the tenant's
+    admitted share below its weight."""
+
+    def test_resume_and_requeue_cost_zero(self):
+        r = Request(prompt=[1], max_new_tokens=8, tenant_id="t")
+        assert Scheduler._drr_cost(r) == 8.0
+        r._requeued = True
+        assert Scheduler._drr_cost(r) == 0.0
+        r2 = Request(prompt=[1], max_new_tokens=8, tenant_id="t")
+        r2._resume = {"stream": [1, 2]}
+        assert Scheduler._drr_cost(r2) == 0.0
+
+    def test_zero_cost_head_admits_without_new_credit(self):
+        """A requeued head must not wait for its tenant's deficit to
+        re-cover the full budget it already paid."""
+        drr = DeficitRoundRobin()
+        t = drr.select({"a": 8.0, "b": 8.0})
+        drr.charge(t, 8.0)  # first admission: full price
+        # the preempted request returns at cost 0 — served immediately,
+        # no fresh credit rounds needed for THIS head
+        assert drr.select({t: 0.0, "b" if t == "a" else "a": 8.0}) is not None
+        before = drr.deficit(t)
+        drr.charge(t, 0.0)
+        assert drr.deficit(t) == before
+
+
+class TestMergedEngineFrontDoors:
+    """Review finding: the residency guards exempted tenant_id=None —
+    a BASE-model request on a merged engine/replica crashed mid-run
+    instead of being refused at the front door."""
+
+    def _merged(self, lm, bank, **kw):
+        return _engine(lm, bank, adapter_impl="merged",
+                       merged_tenant="t1", **kw)
+
+    def test_scheduler_refuses_tenantless_on_merged(self, lm, bank):
+        sched = Scheduler(self._merged(lm, bank))
+        with pytest.raises(ValueError, match="base-model"):
+            sched.submit(Request(prompt=[1, 2], max_new_tokens=2))
+
+    def test_router_refuses_tenantless_on_merged_only_cluster(
+            self, lm, bank):
+        from chainermn_tpu.serving.cluster import Replica, Router
+
+        eng = self._merged(lm, bank)
+        rep = Replica(eng, Scheduler(eng, "prefill_priority"), 0)
+        router = Router([rep], mode="colocated")
+        with pytest.raises(ValueError, match="base-model"):
+            router.submit(Request(prompt=[1, 2], max_new_tokens=2))
+
+    def test_router_places_tenantless_on_gather_replica(self, lm, bank):
+        from chainermn_tpu.serving.cluster import Replica, Router
+
+        model, params = lm
+        eng_m = self._merged(lm, bank)
+        eng_g = _engine(lm, bank)
+        reps = [Replica(eng_m, Scheduler(eng_m, "prefill_priority"), 0),
+                Replica(eng_g, Scheduler(eng_g, "prefill_priority"), 1)]
+        router = Router(reps, policy="least_loaded", mode="colocated")
+        rid = router.submit(Request(prompt=[1, 2, 3], max_new_tokens=3))
+        results = router.run()
+        # placed on the gather replica, served as the base model
+        routes = {e["request"]: e["replica"]
+                  for e in router._events if e["kind"] == "route"}
+        assert routes[rid] == 1
+        assert results[rid]["tokens"] == _gen_ref(model, params,
+                                                  [1, 2, 3], 3)
+
+
+class TestMigrateResidency:
+    def test_migrate_refuses_before_preempting(self, lm):
+        """Review finding: migrate scored residency instead of
+        filtering — a non-resident destination stranded the
+        just-preempted request. Now it raises BEFORE preempting and
+        the stream keeps running in place."""
+        from chainermn_tpu.serving.cluster import Replica, Router
+
+        model, params = lm
+        bank_a = AdapterBank(model, capacity=3, rank=2)
+        bank_a.register("acme", random_adapter(model, 2, seed=7))
+        bank_b = AdapterBank(model, capacity=3, rank=2)  # not resident
+        reps = []
+        for i, b in enumerate((bank_a, bank_b)):
+            eng = _engine(lm, b, num_slots=2)
+            reps.append(Replica(eng, Scheduler(eng, "prefill_priority"),
+                                i))
+        router = Router(reps, policy="least_loaded", mode="colocated")
+        rid = router.submit(Request(prompt=[1, 2, 3], max_new_tokens=4,
+                                    tenant_id="acme"))
+        # admit it into flight on replica 0
+        reps[0].scheduler.tick()
+        assert reps[0].scheduler.slot_of(rid) is not None
+        with pytest.raises(RuntimeError, match="acme"):
+            router.preempt_request(rid, exclude_replica=True)
+        # NOT stranded: still in flight on 0, and the run completes
+        assert reps[0].scheduler.slot_of(rid) is not None
+        results = router.run()
+        assert results[rid]["tokens"] == _gen_ref(
+            model, params, [1, 2, 3], 4, bank_a.adapter_arrays("acme"))
+
+
+class TestSloPreemptGatesOnDrrPick:
+    def test_blocked_drr_candidate_can_preempt(self, lm, bank):
+        """Review finding: _maybe_preempt gated on the arrival head —
+        a targetless head masked the DRR-picked candidate's at-risk
+        TTFT and the winnable SLO was lost."""
+        import time as _time
+
+        engine = _engine(lm, bank, num_slots=1)
+        sched = Scheduler(engine, policy="slo",
+                          tenant_weights={"t1": 1.0, "t2": 1.0})
+        x = Request(prompt=[1, 2, 3], max_new_tokens=8, tenant_id="t1",
+                    tpot_target_ms=1e-4)  # will blow its TPOT budget
+        sched.submit(x)
+        assert sched._admit_round()  # x owns the only slot
+        sched.step()  # generated >= 2: TPOT is measurable (and over)
+        h = Request(prompt=[2, 3], max_new_tokens=2, tenant_id="t1")
+        b = Request(prompt=[3, 4], max_new_tokens=2, tenant_id="t2",
+                    ttft_target_ms=1.0)
+        sched.submit(h)
+        sched.submit(b)
+        b._arrival -= 10.0  # far past half its TTFT budget
+        sched._drr.charge("t1", 1000.0)  # t1 in debt: DRR names t2
+        assert sched._next_candidate() is b
+        _time.sleep(0.002)
+        assert sched._maybe_preempt() is True  # head-gating returned False here
+        assert sched.preemptions == 1
+        results = sched.run()  # everything (incl. the resume) drains
+        assert len(results) == 3
+
+
+def test_adapter_impls_single_definition():
+    """Review finding: ADAPTER_IMPLS was defined in both engine.py and
+    adapters.py — the ctor validation and the tuning candidate set
+    must read the SAME tuple."""
+    from chainermn_tpu.serving import adapters as a_mod
+    from chainermn_tpu.serving import engine as e_mod
+
+    assert e_mod.ADAPTER_IMPLS is a_mod.ADAPTER_IMPLS
+
+
+class TestSessionPinAfterValidation:
+    """Review finding: both front doors pinned session->tenant BEFORE
+    validation — a refused submission permanently poisoned the session
+    id under the wrong tenant."""
+
+    def test_refused_router_submit_does_not_pin_session(self, lm, bank):
+        from chainermn_tpu.serving.cluster import Replica, Router
+
+        eng = _engine(lm, bank, adapter_impl="merged",
+                      merged_tenant="t1")
+        rep = Replica(eng, Scheduler(eng, "prefill_priority"), 0)
+        router = Router([rep], mode="colocated")
+        with pytest.raises(ValueError, match="base-model"):
+            router.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                                  session_id="s1"))
+        # the refusal did NOT bind s1 to tenant None: the session's
+        # real first turn (the merged tenant) is accepted
+        rid = router.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                                    tenant_id="t1", session_id="s1"))
+        results = router.run()
+        assert rid in results
+
+    def test_refused_scheduler_submit_does_not_pin_session(self, lm,
+                                                           bank):
+        engine = _engine(lm, bank)
+        sched = Scheduler(engine)
+        with pytest.raises(ValueError, match="cannot be served"):
+            sched.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                                 tenant_id="ghost", session_id="s1"))
+        sched.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                             tenant_id="t1", session_id="s1"))
+        sched.run()
+
+
+def test_dead_decode_pool_reads_as_outage_not_residency(lm_request=None):
+    """Review finding: _choose_decode filtered residency before the
+    alive check, so a dead decode pool was misdiagnosed as a missing
+    adapter."""
+    from chainermn_tpu.serving.cluster.router import Router
+
+    class _Rep:
+        def __init__(self, rid):
+            self.replica_id, self.alive, self.role = rid, True, None
+            self.engine = type("E", (), {"max_len": 64})()
+            self.scheduler = None
+
+    r = Router.__new__(Router)
+    r.replicas = {0: _Rep(0), 1: _Rep(1)}
+    r._decode_ids = [1]
+    r.replicas[1].alive = False
+    with pytest.raises(RuntimeError, match="no alive decode replica"):
+        r._choose_decode("acme")
+
+
+def test_gather_with_merged_tenant_raises(lm=None):
+    """Review finding: an explicit gather engine silently ignored
+    merged_tenant instead of refusing like every other invalid
+    combination."""
+    import jax
+    import jax.numpy as jnp
+
+    model = tiny_lm()
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 4), jnp.int32), train=False)
+    b = AdapterBank(model, capacity=3, rank=2)
+    with pytest.raises(ValueError, match="only meaningful"):
+        ServingEngine(model, params, num_slots=2, max_len=32,
+                      decode_impl="paged", kv_block_size=8,
+                      prefill_buckets=(4, 8), adapter_bank=b,
+                      adapter_impl="gather", merged_tenant="acme")

@@ -481,6 +481,70 @@ def test_seq_parallel_rows_contract_and_seeding(tmp_path):
                                                  str(cache2))))
 
 
+def test_serving_tenants_rows_contract_and_seeding(tmp_path):
+    """ISSUE 14 satellite: the ``serving_tenants`` phase's headline
+    rows ride the compact line (goodput + Jain fairness + spread gate
+    + the adopted ``adapter_impl``), the phase is wired into the
+    supplementary chain, and ``tuning seed`` learns ``adapter_impl``
+    from the gather/merged ms-per-token rows — spread-gated under the
+    phase's OWN shape key, with the measured goodput and fairness
+    carried as evidence."""
+    for k in ("serving_tenants_goodput", "serving_tenants_fairness",
+              "serving_tenants_spread_pct", "serving_tenants_selected"):
+        assert k in bench._COMPACT_KEYS, k
+    assert callable(bench._bench_serving_tenants)
+    import inspect
+
+    src = inspect.getsource(bench._run_bench)
+    assert 'supp("serving_tenants", "serving_tenants_error"' in src
+
+    from chainermn_tpu.tuning.cache import (
+        load_cache,
+        seed_from_bench_details,
+    )
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-04T00:00:00Z",
+        "serving_tenants_model_shape": "D512xH8xL512",
+        "serving_tenants_adapter_ms": {"gather": 0.9, "merged": 0.5},
+        "serving_tenants_adapter_spread_pct": 5.0,
+        "serving_tenants_spread_pct": 40.0,
+        "serving_tenants_goodput": 4100.0,
+        "serving_tenants_fairness": 0.98,
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert "adapter_impl|TPU v5 lite|512x8x512|decode -> merged" in seeded
+    entry = load_cache(str(cache))["decisions"][
+        "adapter_impl|TPU v5 lite|512x8x512|decode"]
+    assert entry["candidates_ms"]["merged"] == 0.5
+    assert entry["goodput"] == 4100.0
+    assert entry["fairness"] == 0.98
+
+    # spread-dominated rows are refused (noise-band "winner") — the
+    # table default gather stands, the honest-refusal precedent
+    doc["serving_tenants_adapter_ms"] = {"gather": 0.52, "merged": 0.5}
+    doc["serving_tenants_adapter_spread_pct"] = 15.0
+    details.write_text(json.dumps(doc))
+    cache2 = tmp_path / "cache2.json"
+    assert "adapter_impl" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+    # ABSENT spread = on-accel single sample: the 10% floor applies
+    doc.pop("serving_tenants_adapter_spread_pct")
+    details.write_text(json.dumps(doc))
+    assert "adapter_impl" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+    doc["serving_tenants_adapter_ms"] = {"gather": 0.9, "merged": 0.5}
+    details.write_text(json.dumps(doc))
+    assert ("adapter_impl|TPU v5 lite|512x8x512|decode -> merged"
+            in "\n".join(seed_from_bench_details(str(details),
+                                                 str(cache2))))
+
+
 def test_transformer_knob_env_validation(monkeypatch):
     """The accel transformer knobs reject malformed env values with a
     message naming the variable (a bare ZeroDivisionError from

@@ -243,10 +243,13 @@ def _golden_trace_lines():
         {"schema": 1, "kind": "prefill_chunk", "t": 3.35, "pid": 1,
          "rank": 0, "request": "r2", "slot": 2, "chunk": 1,
          "tokens": 4, "dur_s": 0.004},
+        # ISSUE 14: r2 carries a tenant tag — the per-tenant rollup
+        # buckets it under 'acme' while the pre-tenant r0 events fall
+        # back to the 'default' tenant (old traces keep parsing).
         {"schema": 1, "kind": "serving", "t": 3.4, "pid": 1, "rank": 0,
          "phase": "finish", "request": "r2", "generated": 5,
          "dur_s": 0.05, "tpot_ms": 8.0, "slo_ttft_ok": True,
-         "slo_tpot_ok": False},
+         "slo_tpot_ok": False, "tenant": "acme"},
     ]
     return [_json.dumps(e) for e in evs] + ['{"torn']
 
@@ -371,6 +374,20 @@ def test_trace_report_contract(tmp_path):
                 "hit_token_rate": 0.7619,
                 "cow_blocks": 1,
             },
+            # ISSUE 14: the per-tenant rollup — r2's tenant-tagged
+            # finish lands under 'acme', the pre-tenant r0 events fall
+            # back to 'default'; Jain over the [5, 4] token totals =
+            # 81/82.
+            "tenants": {
+                "acme": {"requests": 1, "generated_tokens": 5,
+                         "ttft_ms_p50": None, "ttft_ms_p99": None,
+                         "tpot_ms_p50": 8.0, "tpot_ms_p99": 8.0,
+                         "slo_requests": 1, "slo_attainment": 0.0},
+                "default": {"requests": 1, "generated_tokens": 4,
+                            "ttft_ms_p50": 12.0, "ttft_ms_p99": 12.0,
+                            "tpot_ms_p50": 6.0, "tpot_ms_p99": 6.0},
+            },
+            "tenant_fairness_jain": 0.9878,
         },
     }, summary
     # chrome export emitted alongside
@@ -402,7 +419,12 @@ def test_trace_report_contract(tmp_path):
                   "accept-length histogram: 0:2 2:1",
                   "prefix cache: 1/2 admissions hit (50.0%), "
                   "6/21 prompt tokens prefilled (16 served from cache), "
-                  "1 COW block copy"):
+                  "1 COW block copy",
+                  "tenants: 2 (Jain fairness 0.9878)",
+                  "acme: 1 req, 5 tok, TPOT p50/p99 8.000/8.000 ms, "
+                  "SLO 0.0% of 1",
+                  "default: 1 req, 4 tok, TTFT p50/p99 12.000/12.000 "
+                  "ms, TPOT p50/p99 6.000/6.000 ms"):
         assert token in proc2.stdout, (token, proc2.stdout)
 
 
@@ -444,6 +466,68 @@ def test_trace_report_roofline_scoped_to_device_plane(tmp_path):
     assert floors[0]["hbm_peak_gbps"] == 819.0  # v5e table via bench
     # no internal bookkeeping leaks into the contract
     assert all("_devices" not in c for c in summary["collectives"])
+
+
+def _metrics_dump_mod():
+    import importlib.util
+
+    path = os.path.join(_REPO, "tools", "metrics_dump.py")
+    spec = importlib.util.spec_from_file_location("_md_capture", path)
+    md = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(md)
+    return md
+
+
+_TENANT_PROM = """\
+# HELP serving_tenant_tokens_total generated tokens per tenant
+# TYPE serving_tenant_tokens_total counter
+serving_tenant_tokens_total{tenant="acme"} 5
+serving_tenant_tokens_total{tenant="globex"} 3
+# HELP serving_queue_depth requests waiting
+# TYPE serving_queue_depth gauge
+serving_queue_depth 2
+"""
+
+
+def test_metrics_dump_label_filters_offline_table(tmp_path, capsys):
+    """ISSUE 14 satellite: ``--label tenant=<id>`` narrows the parsed
+    table to one tenant's series — offline (saved scrape) path."""
+    prom = tmp_path / "t.prom"
+    prom.write_text(_TENANT_PROM)
+    md = _metrics_dump_mod()
+    assert md.main([str(prom), "--label", "tenant=acme"]) == 0
+    out = capsys.readouterr().out
+    assert "tenant=acme" in out and "5" in out
+    assert "globex" not in out
+    assert "serving_queue_depth" not in out  # unlabeled series dropped
+
+
+def test_metrics_dump_label_no_match_is_loud(tmp_path, capsys):
+    """A typoed tenant id must exit 1 with a stderr note, never an
+    empty table that reads as 'tenant idle'."""
+    prom = tmp_path / "t.prom"
+    prom.write_text(_TENANT_PROM)
+    md = _metrics_dump_mod()
+    assert md.main([str(prom), "--label", "tenant=nope"]) == 1
+    err = capsys.readouterr().err
+    assert "no series carry" in err and "nope" in err
+
+
+def test_metrics_dump_label_validation_and_down_endpoint(capsys):
+    """Bad --label syntax and --raw/--health combinations are refused;
+    a down endpoint under --label keeps the fetch path's exit-1
+    contract (the label filter never masks unreachability)."""
+    md = _metrics_dump_mod()
+    assert md.main(["--label", "tenant", "--port", "1"]) == 1
+    assert "key=value" in capsys.readouterr().err
+    assert md.main(["--label", "tenant=a", "--raw", "--port", "1"]) == 1
+    assert "--raw" in capsys.readouterr().err
+    # unreachable endpoint (port 1 is never listening): exit 1 with the
+    # unreachable note, not the no-match note
+    assert md.main(["--label", "tenant=a", "--port", "1",
+                    "--timeout", "0.2"]) == 1
+    err = capsys.readouterr().err
+    assert "unreachable" in err
 
 
 def test_missing_marker_is_never_fresh(capture_root):

@@ -10,6 +10,7 @@ Usage::
     python tools/metrics_dump.py --json          # parsed, one JSON line
     python tools/metrics_dump.py --health        # /healthz, one JSON line
     python tools/metrics_dump.py saved.prom      # format a saved scrape
+    python tools/metrics_dump.py --label tenant=acme   # one tenant only
 
 ``--ports a,b,c`` (ISSUE 8) fetches several replica endpoints and
 merges them into ONE labeled table/JSON object — every series gains a
@@ -18,6 +19,14 @@ process, the ``N + rank`` port contract) is inspectable with one
 command. Endpoints that don't answer are reported on stderr and
 skipped; the exit code is 1 only when NONE answered. With ``--health``
 it returns ``{port: healthz-or-error}`` as one JSON line instead.
+
+``--label key=value`` (ISSUE 14) filters the parsed table/JSON to the
+series carrying that label — ``--label tenant=<id>`` narrows a
+multi-tenant endpoint (or saved scrape, or ``--ports`` merge) to one
+tenant's gauges/counters/histograms. A filter that matches NOTHING
+exits 1 with a stderr note (a typoed tenant id must be loud, not an
+empty table); ``--raw``/``--health`` pass unparsed payloads through
+and refuse the combination.
 
 The port defaults to ``CHAINERMN_TPU_METRICS_PORT`` (the exporter's env
 contract; per-rank endpoints live at port+rank — pass ``--port``
@@ -119,7 +128,41 @@ def main(argv=None) -> int:
                     help="parsed series as one JSON object")
     ap.add_argument("--health", action="store_true",
                     help="fetch /healthz instead of /metrics")
+    ap.add_argument("--label", default=None, metavar="KEY=VALUE",
+                    help="keep only series carrying this label (e.g. "
+                         "tenant=acme); exits 1 when nothing matches")
     args = ap.parse_args(argv)
+
+    label_filter = None
+    if args.label is not None:
+        if args.raw or args.health:
+            print("metrics_dump: --label filters PARSED series — it "
+                  "cannot combine with --raw/--health", file=sys.stderr)
+            return 1
+        key, sep, value = args.label.partition("=")
+        if not sep or not key:
+            print(f"metrics_dump: bad --label {args.label!r} "
+                  "(want key=value)", file=sys.stderr)
+            return 1
+        label_filter = (key, value)
+
+    def apply_label(parsed: dict) -> dict | None:
+        """Filter parsed series by --label; None (after a stderr note)
+        when nothing survives."""
+        if label_filter is None:
+            return parsed
+        out = {
+            (name, labels): v for (name, labels), v in parsed.items()
+            if label_filter in labels
+        }
+        if not out:
+            print(
+                f"metrics_dump: no series carry "
+                f"{label_filter[0]}={label_filter[1]!r}",
+                file=sys.stderr,
+            )
+            return None
+        return out
 
     if args.ports:
         try:
@@ -166,6 +209,9 @@ def main(argv=None) -> int:
             for (name, labels), v in mod.parse_exposition(text).items():
                 merged[(name, tuple(sorted(
                     labels + (("port", str(p)),))))] = v
+        merged = apply_label(merged)
+        if merged is None:
+            return 1
         if args.json:
             print(json.dumps(
                 {f"{name}{dict(labels) or ''}": v
@@ -215,6 +261,9 @@ def main(argv=None) -> int:
         sys.stdout.write(text)
         return 0
     parsed = _metrics_mod().parse_exposition(text)
+    parsed = apply_label(parsed)
+    if parsed is None:
+        return 1
     if args.json:
         print(json.dumps(
             {f"{name}{dict(labels) or ''}": v

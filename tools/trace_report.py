@@ -41,6 +41,10 @@ Sections:
   ``prefix_cache`` events exist (ISSUE 7), adds the prefix-sharing
   rollup: admission lookups/hits, prompt vs prefilled vs cache-served
   token totals (the measured prefill-work reduction) and COW copies.
+  ISSUE 14: prefill/finish events roll up PER TENANT (requests,
+  tokens, TTFT/TPOT p50/p99, SLO attainment) with a Jain fairness
+  index over the token totals; events without a ``tenant`` tag fall
+  back to one ``'default'`` tenant so pre-tenant traces keep parsing.
   Omitted when the trace has no serving events.
 - **stragglers** — flagged divergence reports, if any.
 - **roofline** — where a device kind with a known HBM peak appears
@@ -424,6 +428,31 @@ def render_text(s: dict) -> str:
                 f"cache), {px['cow_blocks']} COW block cop"
                 f"{'y' if px['cow_blocks'] == 1 else 'ies'}"
             )
+        tn = sv.get("tenants")
+        if tn:
+            # ISSUE 14: the per-tenant rollup (requests/tokens/latency
+            # percentiles/SLO) + the Jain fairness index over token
+            # totals; pre-tenant traces print one 'default' row.
+            lines.append(
+                f"  tenants: {len(tn)} (Jain fairness "
+                f"{sv['tenant_fairness_jain']:.4f})"
+            )
+            for t, row in tn.items():
+                parts = [f"{row['requests']} req",
+                         f"{row['generated_tokens']} tok"]
+                if row.get("ttft_ms_p50") is not None:
+                    parts.append(
+                        f"TTFT p50/p99 {row['ttft_ms_p50']:.3f}/"
+                        f"{row['ttft_ms_p99']:.3f} ms")
+                if row.get("tpot_ms_p50") is not None:
+                    parts.append(
+                        f"TPOT p50/p99 {row['tpot_ms_p50']:.3f}/"
+                        f"{row['tpot_ms_p99']:.3f} ms")
+                if row.get("slo_requests"):
+                    parts.append(
+                        f"SLO {row['slo_attainment'] * 100:.1f}% of "
+                        f"{row['slo_requests']}")
+                lines.append(f"    {t}: " + ", ".join(parts))
         # queue_wait and prefill are separate events: a truncated trace
         # may carry one without the other — guard each independently.
         if sv.get("queue_wait_ms_mean") is not None:
