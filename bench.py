@@ -454,6 +454,8 @@ _COMPACT_KEYS = (
     "hidden_comm_fraction", "reduction_schedule_selected",
     "overlap_spread_pct", "composed_best_vs_two_level",
     "composed_spread_pct", "composed_selected",
+    "composed_sliced_ms", "composed_slices_selected",
+    "composed_sliced_spread_pct",
     "serving_tokens_per_sec", "serving_spread_pct",
     "serving_spec_selected", "serving_spec_speedup",
     "serving_spec_accept_rate", "serving_prefix_ttft_speedup",
@@ -3090,6 +3092,58 @@ def _bench_composed(comm, on_accel: bool):
             out["composed_schedule_source"] = rec[-1]["source"]
     except Exception as e:
         out["composed_autotune_error"] = f"{type(e).__name__}: {e}"[:120]
+
+    # --- sliced arms (ISSUE 15): the hierarchical two_level instance
+    # re-timed at comp_slices ∈ {1,2,4,8} — slice i's slow ar(a0+a1)
+    # rides concurrently with slice i+1's fast rs/ag(a2), S× the
+    # per-stage collectives at 1/S payload. Same CPU-proxy convention
+    # (n>=3 medians + spread) and the same spread-gated adoption into
+    # the ``comp_slices`` decision ``tuning seed`` learns offline from
+    # these exact rows — offline and live must agree (the PR 14
+    # adapter_impl lesson). The arm's key spelling is the slice count.
+    try:
+        from chainermn_tpu.parallel.composition import sliced_composition
+        from chainermn_tpu.parallel.reduction_schedule import (
+            SLICES_DECISION as _SLICES_DECISION,
+            SLICE_CANDIDATES as _SLICE_CANDIDATES,
+        )
+
+        base_comp = two_level_composition(names)
+        sliced_ms: dict = {}
+        sliced_spreads: dict = {}
+        for s in _SLICE_CANDIDATES:
+            sig_s = (base_comp.signature() if s == "1" else
+                     sliced_composition(base_comp, int(s)).signature())
+            opt = create_multi_node_optimizer(
+                optax.sgd(1e-3), comm3,
+                allreduce_grad_dtype=jnp.bfloat16,
+                reduction_schedule=sig_s,
+            )
+            med, spread = time_loop(opt)
+            sliced_ms[s] = round(med, 3)
+            sliced_spreads[s] = spread
+        out["composed_sliced_ms"] = sliced_ms
+        out["composed_sliced_spread_pct"] = round(
+            max(sliced_spreads.values()), 3)
+        from chainermn_tpu import tuning
+
+        key_s = tuning.decision_key(
+            shape=tuple(int(d) for d in shape)
+            + (max(1, payload_bytes >> 20),),
+            dtype="slices",
+        )
+        tuning.record_measurement(
+            _SLICES_DECISION, key_s, sliced_ms, spreads=sliced_spreads
+        )
+        out["composed_slices_selected"] = int(tuning.choice(
+            _SLICES_DECISION, _SLICE_CANDIDATES, key_s
+        ))
+        rec_s = [d for d in tuning.decisions_taken()
+                 if d["name"] == _SLICES_DECISION and d["key"] == key_s]
+        if rec_s:
+            out["composed_slices_source"] = rec_s[-1]["source"]
+    except Exception as e:
+        out["composed_sliced_error"] = f"{type(e).__name__}: {e}"[:120]
     return out
 
 

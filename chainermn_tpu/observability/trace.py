@@ -416,7 +416,13 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
       ``composition`` signature (ISSUE 12: one event per bucket per
       STAGE) group under ``compositions`` instead, keyed by signature
       with a per-stage bytes/time table — the consumer side of the
-      composed schedules' stage events;
+      composed schedules' stage events. Stage events carrying a
+      ``slice`` address (ISSUE 15: sliced compositions emit one event
+      per stage PER SLICE) additionally group under the stage row's
+      ``slices`` sub-table (``s<i>`` -> n/bytes and, when measured,
+      ``dur_ms``/``blocked_ms``), while the stage row keeps the
+      across-slice totals — per-slice columns without disturbing
+      unsliced rows;
     - measured events (the eager ``OverlappedBucketReducer``; ``dur_s``
       = dispatch->ready, ``blocked_s`` = wait actually paid at
       collect): aggregated into comm time total vs comm time hidden
@@ -464,6 +470,26 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
                     st["dur_ms"] = round(
                         st.get("dur_ms", 0.0) + float(dur) * 1e3, 4
                     )
+                b = ev.get("blocked_s")
+                if b is not None:
+                    st["blocked_ms"] = round(
+                        st.get("blocked_ms", 0.0) + float(b) * 1e3, 4
+                    )
+                if ev.get("slice") is not None:
+                    # ISSUE 15: the per-slice column of the stage table
+                    sl = st.setdefault("slices", {}).setdefault(
+                        f"s{int(ev['slice'])}", {"n": 0, "nbytes": 0}
+                    )
+                    sl["n"] += 1
+                    sl["nbytes"] += int(ev.get("nbytes") or 0)
+                    if dur is not None:
+                        sl["dur_ms"] = round(
+                            sl.get("dur_ms", 0.0) + float(dur) * 1e3, 4
+                        )
+                    if b is not None:
+                        sl["blocked_ms"] = round(
+                            sl.get("blocked_ms", 0.0) + float(b) * 1e3, 4
+                        )
             elif dur is None:
                 key = str(ev.get("schedule", "?"))
                 row = layout.setdefault(

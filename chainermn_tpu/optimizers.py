@@ -441,6 +441,11 @@ class MultiNodeOptimizer:
                     comm.device_kind, payload,
                     tuple(int(v) for v in comm.mesh.shape.values()),
                     candidates=self._auto_candidates,
+                    # comp_slices (ISSUE 15): slice the winner where a
+                    # measured capture adopted an interleave — except
+                    # on the int8 wire, whose two-phase scheme has no
+                    # sliced rendering.
+                    slices=(None if self._int8_wire() else "auto"),
                 )
                 self._auto_resolved = winner
                 self._schedule_provenance = rec

@@ -43,6 +43,26 @@ axis context. Its per-stage primitives are exactly the collectives the
 signature predicts (:func:`predicted_collectives`), which is what the
 structural HLO-count tests pin (``tests/test_composition.py``).
 
+BUCKET SLICING (ISSUE 15): every stage is additionally addressable on a
+SLICE of the bucket. A composition with ``slices=S`` cuts the bucket
+into S equal contiguous slices (:func:`slice_bounds`; a bucket smaller
+than S degrades to ``min(S, elements)`` slices — the
+``bucket_partition`` zero-leaf contract, never an empty stage) and
+software-pipelines the stages across them in skewed order
+(:func:`expand_slices`): slice i's slow inter-level stage (e.g.
+``ar(a0+a1)``) is issued concurrently with slice i+1's fast-axis
+``rs``/``ag`` — the classic hierarchical-allreduce interleave, so the
+slow axis hides behind the fast one. Spelled ``rs(a2)[s0..3]>
+ar(a0+a1)>ag(a2)`` (the slice range rides the first stage); an
+individual expanded stage prints as ``rs(a2)[s1:4]`` (slice 1 of 4).
+The compiled HLO carries exactly S× the per-stage collective count at
+1/S payload each — total wire bytes unchanged — and every sliced
+composition is bitwise == its flat rendering on exact-dyadic inputs
+(slices partition the bucket disjointly; each element is still reduced
+over every mesh axis exactly once). The ``sharded_update`` fuse point
+is unsliceable (the inner optimizer runs ONCE on the whole chunk
+tree), refused loudly by the validator.
+
 Mesh-axis convention: the tuple is in MESH ORDER, slow/DCN-most first,
 fast/ICI-most last (the repo's convention) — so "scatter the fast axes
 first, reduce the slow axis innermost" is "partition the reversed axis
@@ -79,27 +99,46 @@ class CompositionError(ValueError):
 @dataclasses.dataclass(frozen=True)
 class Stage:
     """One pipeline stage: ``primitive`` over the merged axis group
-    ``axes`` (mesh-order tuple; empty only for ``sharded_update``)."""
+    ``axes`` (mesh-order tuple; empty only for ``sharded_update``).
+
+    ``slice`` (ISSUE 15) addresses the stage at ONE slice of the
+    bucket: ``(index, n_slices)``, printed ``rs(a2)[s1:4]``. ``None``
+    = the whole bucket (the pre-slicing spelling, unchanged). Slice-
+    annotated stages appear in the EXPANDED rendering of a sliced
+    composition (:func:`expand_slices`); the compact spelling keeps the
+    slice count on the :class:`Composition` instead."""
 
     primitive: str
     axes: tuple[str, ...] = ()
+    slice: Optional[tuple[int, int]] = None
 
     def signature(self) -> str:
+        tag = f"[s{self.slice[0]}:{self.slice[1]}]" if self.slice else ""
         if self.primitive == "sharded_update":
-            return "su"
-        return f"{_SHORT[self.primitive]}({'+'.join(self.axes)})"
+            return f"su{tag}"
+        return f"{_SHORT[self.primitive]}({'+'.join(self.axes)}){tag}"
 
 
 @dataclasses.dataclass(frozen=True)
 class Composition:
     """An ordered stage list; build via :func:`parse_signature`,
     :func:`compile_schedule` or :func:`derive_compositions`, then prove
-    it with :func:`validate_composition` before running it."""
+    it with :func:`validate_composition` before running it.
+
+    ``slices`` (ISSUE 15): the bucket-slice count the executor cuts
+    each bucket into (1 = the whole-bucket rendering, unchanged).
+    Spelled by annotating the FIRST stage with the slice range:
+    ``rs(a2)[s0..3]>ar(a0+a1)>ag(a2)`` is the two_level pipeline over
+    four bucket slices."""
 
     stages: tuple[Stage, ...]
+    slices: int = 1
 
     def signature(self) -> str:
-        return ">".join(s.signature() for s in self.stages)
+        sigs = [s.signature() for s in self.stages]
+        if self.slices > 1 and sigs:
+            sigs[0] = f"{sigs[0]}[s0..{self.slices - 1}]"
+        return ">".join(sigs)
 
     @property
     def has_update(self) -> bool:
@@ -122,32 +161,66 @@ class Composition:
         return self.signature()
 
 
-_STAGE_RE = re.compile(r"^(rs|ar|ag|su)(?:\(([^()]*)\))?$")
+_STAGE_RE = re.compile(
+    r"^(rs|ar|ag|su)(?:\(([^()]*)\))?"
+    r"(?:\[s(\d+)(?:\.\.(\d+)|:(\d+))?\])?$"
+)
 
 
 def parse_signature(sig: str) -> Composition:
     """Parse ``"rs(a2)>ar(a0+a1)>ag(a2)"`` back into a
     :class:`Composition` (the registry stores winners as signature
-    strings; this is the way back)."""
+    strings; this is the way back). Two slice spellings (ISSUE 15):
+    a range ``rs(a2)[s0..3]>...`` marks the whole COMPOSITION sliced
+    (S = range length, must start at s0; annotations on several stages
+    must agree), and ``rs(a2)[s1:4]`` addresses one expanded stage at
+    slice 1 of 4."""
     stages = []
+    slices: Optional[int] = None
     for part in str(sig).split(">"):
         m = _STAGE_RE.match(part.strip())
         if not m:
             raise CompositionError(
                 f"unparseable composition stage {part!r} in {sig!r} "
-                "(expected e.g. 'rs(intra)', 'ar(a0+a1)', 'su')"
+                "(expected e.g. 'rs(intra)', 'ar(a0+a1)', 'su', "
+                "'rs(a2)[s0..3]', 'rs(a2)[s1:4]')"
             )
-        short, axes = m.groups()
+        short, axes, s_lo, s_hi, s_tot = m.groups()
+        stage_slice: Optional[tuple[int, int]] = None
+        if s_lo is not None:
+            if s_tot is not None:  # [sI:S] — one expanded stage
+                idx, tot = int(s_lo), int(s_tot)
+                if not 0 <= idx < tot:
+                    raise CompositionError(
+                        f"stage slice [s{idx}:{tot}] in {part!r} is out "
+                        "of range"
+                    )
+                stage_slice = (idx, tot)
+            else:  # [s0..N] (or degenerate [s0]) — the composition
+                lo = int(s_lo)
+                hi = int(s_hi) if s_hi is not None else lo
+                if lo != 0 or hi < lo:
+                    raise CompositionError(
+                        f"composition slice range [s{lo}..{hi}] in "
+                        f"{part!r} must start at s0"
+                    )
+                n = hi + 1
+                if slices is not None and slices != n:
+                    raise CompositionError(
+                        f"conflicting slice counts in {sig!r}: "
+                        f"{slices} vs {n}"
+                    )
+                slices = n
         if short == "su":
             if axes:
                 raise CompositionError(
                     f"sharded_update stage carries no axes, got {part!r}"
                 )
-            stages.append(Stage("sharded_update"))
+            stages.append(Stage("sharded_update", slice=stage_slice))
         else:
             names = tuple(a for a in (axes or "").split("+") if a)
-            stages.append(Stage(_LONG[short], names))
-    return Composition(tuple(stages))
+            stages.append(Stage(_LONG[short], names, slice=stage_slice))
+    return Composition(tuple(stages), slices=slices or 1)
 
 
 def canonical_axis_names(k: int) -> tuple[str, ...]:
@@ -175,9 +248,131 @@ def bind_composition(comp: Composition, axes: Sequence[str]) -> Composition:
         )
     table = dict(zip(canon, names))
     return Composition(tuple(
-        Stage(s.primitive, tuple(table[a] for a in s.axes))
+        Stage(s.primitive, tuple(table[a] for a in s.axes), slice=s.slice)
         for s in comp.stages
-    ))
+    ), slices=comp.slices)
+
+
+# ---------------------------------------------------------------------------
+# Bucket slicing (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def effective_slices(slices: int, n_elems: int) -> int:
+    """The slice count a bucket of ``n_elems`` elements actually cuts
+    into: ``min(slices, n_elems)``, floored at 1 — a bucket smaller
+    than the requested slice count DEGRADES instead of emitting an
+    empty stage or a zero-size collective (the ``bucket_partition``
+    zero-leaf contract, ISSUE 15 satellite; callers that degrade
+    record the requested vs effective counts as provenance)."""
+    s = int(slices)
+    if s < 1:
+        raise CompositionError(f"slices must be >= 1, got {slices}")
+    return max(1, min(s, int(n_elems)))
+
+
+def slice_bounds(n_elems: int, n_slices: int) -> list[tuple[int, int]]:
+    """Balanced contiguous ``[start, end)`` bounds cutting ``n_elems``
+    into ``n_slices`` slices (first ``n % S`` slices one element
+    longer). The bounds are disjoint, cover the bucket exactly, and —
+    given ``n_slices <= n_elems``, which :func:`effective_slices`
+    guarantees — never empty: the structural half of the "every
+    element reduced exactly once across slices" invariant."""
+    n, s = int(n_elems), int(n_slices)
+    if s < 1:
+        raise CompositionError(f"slice count must be >= 1, got {n_slices}")
+    base, rem = divmod(n, s)
+    out = []
+    lo = 0
+    for i in range(s):
+        hi = lo + base + (1 if i < rem else 0)
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
+def sliced_composition(comp: Composition, slices: int) -> Composition:
+    """``comp`` re-rendered over ``slices`` bucket slices (the compact
+    form — :func:`expand_slices` produces the per-slice stage list).
+    Refuses a ``sharded_update`` pipeline: the ZeRO fuse point runs the
+    inner optimizer ONCE on the whole chunk tree and cannot slice."""
+    s = int(slices)
+    if s < 1:
+        raise CompositionError(f"slices must be >= 1, got {slices}")
+    if s > 1 and comp.has_update:
+        raise CompositionError(
+            f"{comp.signature()!r}: a sharded_update pipeline cannot be "
+            "sliced — the fuse point runs the inner optimizer once on "
+            "the whole chunk tree"
+        )
+    return dataclasses.replace(comp, slices=s)
+
+
+def compact_slices(comp: Composition) -> Composition:
+    """Reconstitute an EXPANDED composition (per-stage ``[sI:S]``
+    addresses) back into the compact ``slices=S`` form the executors
+    run — the inverse of :func:`expand_slices`. Unannotated
+    compositions pass through unchanged. Every slice must run the SAME
+    base pipeline (a heterogeneous expansion validates mathematically
+    but has no compact rendering to execute) and the composition must
+    have passed :func:`validate_composition` first — this only
+    re-groups, it does not re-prove."""
+    if not any(s.slice is not None for s in comp.stages):
+        return comp
+    per_slice: dict[int, list[Stage]] = {}
+    total = 0
+    for s in comp.stages:
+        if s.slice is None:
+            raise CompositionError(
+                f"{comp.signature()!r}: stage {s.signature()!r} has no "
+                "slice address while others do"
+            )
+        per_slice.setdefault(s.slice[0], []).append(
+            dataclasses.replace(s, slice=None))
+        total = max(total, s.slice[1])
+    base = per_slice.get(0)
+    if base is None or sorted(per_slice) != list(range(total)):
+        raise CompositionError(
+            f"{comp.signature()!r}: slice indices do not cover "
+            f"0..{total - 1}"
+        )
+    for i, stages in per_slice.items():
+        if stages != base:
+            raise CompositionError(
+                f"{comp.signature()!r}: slice s{i} runs a different "
+                f"pipeline than slice s0 "
+                f"({'>'.join(s.signature() for s in stages)} vs "
+                f"{'>'.join(s.signature() for s in base)}) — only a "
+                "uniform expansion has a compact executable rendering"
+            )
+    return Composition(tuple(base), slices=total)
+
+
+def expand_slices(
+    comp: Composition, size: Optional[int] = None
+) -> tuple[Stage, ...]:
+    """The sliced composition's per-slice stage list in SOFTWARE-
+    PIPELINED (skewed) issue order: tick t issues stage j of slice i
+    for every ``i + j == t`` (later slices first within a tick), so
+    slice i's slow inter-level stage is in flight while slice i+1 runs
+    its fast-axis stage — the interleave that lets the slow axis hide
+    behind the fast one. Each emitted :class:`Stage` carries its
+    ``slice=(i, S)`` address. ``size`` (bucket element count) applies
+    the :func:`effective_slices` degrade; an unsliced composition
+    expands to its own stages unchanged."""
+    s_eff = (effective_slices(comp.slices, size) if size is not None
+             else comp.slices)
+    if s_eff <= 1:
+        return comp.stages
+    k = len(comp.stages)
+    out: list[Stage] = []
+    for t in range(s_eff + k - 1):
+        for j in range(k):
+            i = t - j
+            if 0 <= i < s_eff:
+                out.append(dataclasses.replace(
+                    comp.stages[j], slice=(i, s_eff)))
+    return tuple(out)
 
 
 # ---------------------------------------------------------------------------
@@ -206,6 +401,17 @@ def validate_composition(
       after every reduction, before every gather, with at least one
       scatter open (otherwise the update is not sharded — that is the
       plain post-reduction update, not a composition stage).
+
+    Sliced compositions (ISSUE 15) add:
+
+    - ``slices`` is an integer >= 1; a sliced composition must not
+      carry a ``sharded_update`` (the fuse point is unsliceable);
+    - an EXPANDED composition (stages carrying ``slice`` addresses):
+      every stage is addressed or none, all totals agree, every slice
+      index 0..S-1 appears, and each slice's stage subsequence is
+      independently a complete, conjugate mean-allreduce — PER-SLICE
+      CONJUGACY. Together with :func:`slice_bounds`' disjoint cover,
+      that is "every element reduced exactly once across slices".
     """
     mesh = tuple(mesh_axes)
     if not isinstance(comp, Composition):
@@ -217,6 +423,71 @@ def validate_composition(
             "empty stage list: a composition must reduce over "
             f"{mesh} and an empty pipeline reduces nothing"
         )
+    if not isinstance(comp.slices, int) or comp.slices < 1:
+        raise CompositionError(
+            f"{comp.signature()!r}: slices must be an integer >= 1, "
+            f"got {comp.slices!r}"
+        )
+    sliced = [s for s in comp.stages if s.slice is not None]
+    if comp.has_update and (comp.slices > 1 or sliced):
+        raise CompositionError(
+            f"{comp.signature()!r}: a sliced composition cannot carry a "
+            "sharded_update stage — the ZeRO fuse point runs the inner "
+            "optimizer once on the whole chunk tree and is unsliceable"
+        )
+    if sliced:
+        if comp.slices > 1:
+            raise CompositionError(
+                f"{comp.signature()!r}: both a composition-level slice "
+                f"count ({comp.slices}) and per-stage slice addresses — "
+                "spell one form (compact slices= OR the expanded "
+                "per-stage [sI:S] addressing), not both"
+            )
+        if len(sliced) != len(comp.stages):
+            bare = next(s for s in comp.stages if s.slice is None)
+            raise CompositionError(
+                f"{comp.signature()!r}: stage {bare.signature()!r} has "
+                "no slice address while others do — an expanded "
+                "composition addresses every stage"
+            )
+        totals = {s.slice[1] for s in comp.stages}
+        if len(totals) != 1:
+            raise CompositionError(
+                f"{comp.signature()!r}: conflicting slice totals "
+                f"{sorted(totals)} — every stage of one expansion "
+                "shares one slice count"
+            )
+        total = totals.pop()
+        per_slice: dict[int, list[Stage]] = {}
+        for s in comp.stages:
+            per_slice.setdefault(s.slice[0], []).append(
+                dataclasses.replace(s, slice=None))
+        missing = [i for i in range(total) if i not in per_slice]
+        if missing:
+            raise CompositionError(
+                f"{comp.signature()!r}: slice(s) {missing} have no "
+                f"stages — {total} slices were addressed and each "
+                "must run the full pipeline (its elements would "
+                "otherwise never be reduced)"
+            )
+        for i in range(total):
+            try:
+                _validate_stage_walk(
+                    Composition(tuple(per_slice[i])), mesh
+                )
+            except CompositionError as e:
+                raise CompositionError(
+                    f"slice s{i}:{total}: {e}"
+                ) from None
+        return comp
+    return _validate_stage_walk(comp, mesh)
+
+
+def _validate_stage_walk(comp: Composition, mesh: tuple) -> Composition:
+    """The per-stage invariant walk over ONE pipeline's stage list
+    (:func:`validate_composition` runs it once for an unsliced/compact
+    composition and once PER SLICE for an expanded one — per-slice
+    conjugacy is literally the same walk)."""
     reduced: list[str] = []
     open_scatters: list[tuple[str, ...]] = []
     update_seen = False
@@ -310,14 +581,22 @@ def validate_composition(
     return comp
 
 
-def predicted_collectives(comp: Composition) -> dict[str, int]:
+def predicted_collectives(
+    comp: Composition, size: Optional[int] = None
+) -> dict[str, int]:
     """HLO collective counts the compiled program must carry — one op
-    per stage (``tests/test_composition.py`` compiles and compares)."""
+    per stage PER SLICE (``tests/test_composition.py`` compiles and
+    compares): a sliced composition carries exactly S× the per-stage
+    count at 1/S payload each. ``size`` (bucket element count) applies
+    the :func:`effective_slices` degrade; without it the requested
+    slice count is assumed achievable."""
+    s_eff = (effective_slices(comp.slices, size) if size is not None
+             else comp.slices)
     out = {"reduce-scatter": 0, "all-reduce": 0, "all-gather": 0}
     for st in comp.stages:
         hlo = STAGE_HLO.get(st.primitive)
         if hlo is not None:
-            out[hlo] += 1
+            out[hlo] += s_eff
     return out
 
 
@@ -425,7 +704,12 @@ def compile_schedule(schedule, mesh_axes: Sequence[str]) -> Composition:
     """
     names = tuple(mesh_axes)
     if isinstance(schedule, Composition):
-        return validate_composition(bind_composition(schedule, names), names)
+        # compact_slices: an EXPANDED spelling (per-stage [sI:S]
+        # addresses) validates but only the compact slices=S form is
+        # executable — reconstitute it here, the one front door, so no
+        # executor ever sees stage-addressed pipelines (review finding).
+        return compact_slices(validate_composition(
+            bind_composition(schedule, names), names))
     if schedule == "flat":
         return flat_composition(names)
     if schedule == "two_level":
@@ -434,7 +718,8 @@ def compile_schedule(schedule, mesh_axes: Sequence[str]) -> Composition:
         return zero_composition(names)
     if isinstance(schedule, str) and (">" in schedule or "(" in schedule):
         comp = parse_signature(schedule)
-        return validate_composition(bind_composition(comp, names), names)
+        return compact_slices(validate_composition(
+            bind_composition(comp, names), names))
     from chainermn_tpu.parallel.reduction_schedule import SCHEDULES
 
     raise CompositionError(
@@ -536,15 +821,49 @@ def stage_wire_layout(
     gather, the reduced shard through an allreduce). This is what the
     trace ``wire`` events record per stage and what
     ``tools/trace_report.py``'s overlap section tabulates per
-    composition signature."""
-    rows, _, _ = _replay_sizes(comp.stages, size, axis_sizes)
+    composition signature.
+
+    A SLICED composition (ISSUE 15) emits one row per stage PER SLICE,
+    in the executor's skewed interleave order; each row additionally
+    carries ``slice`` / ``n_slices`` (the effective, possibly degraded
+    count) and that slice's own payload bytes — summed over slices the
+    per-stage wire bytes equal the unsliced rendering's."""
+    comp = compact_slices(comp)  # expanded spellings lay out compacted
+    s_eff = effective_slices(comp.slices, size)
+    if s_eff <= 1:
+        rows, _, _ = _replay_sizes(comp.stages, size, axis_sizes)
+        out = []
+        for st, size_in, size_out in rows:
+            hlo = STAGE_HLO.get(st.primitive)
+            if hlo is None:
+                continue
+            nbytes = max(size_in, size_out) * itemsize
+            out.append(
+                {"stage": st.signature(), "op": hlo, "nbytes": nbytes})
+        return out
+    bounds = slice_bounds(size, s_eff)
+    # per-slice stage rows, keyed back to the BASE stage signature (the
+    # spelling trace_report groups on); order = the skewed interleave.
+    per_slice_rows = [
+        {(st.signature(), j): (st, size_in, size_out)
+         for j, (st, size_in, size_out) in enumerate(
+             _replay_sizes(comp.stages, hi - lo, axis_sizes)[0])}
+        for lo, hi in bounds
+    ]
     out = []
-    for st, size_in, size_out in rows:
+    for st in expand_slices(comp, size):
+        i, _ = st.slice
+        base = dataclasses.replace(st, slice=None)
+        j = comp.stages.index(base)
         hlo = STAGE_HLO.get(st.primitive)
         if hlo is None:
             continue
-        nbytes = max(size_in, size_out) * itemsize
-        out.append({"stage": st.signature(), "op": hlo, "nbytes": nbytes})
+        _, size_in, size_out = per_slice_rows[i][(base.signature(), j)]
+        out.append({
+            "stage": base.signature(), "op": hlo,
+            "nbytes": max(size_in, size_out) * itemsize,
+            "slice": i, "n_slices": s_eff,
+        })
     return out
 
 
@@ -572,6 +891,16 @@ def reduce_composed(
     byte-identical programs through this path. The single-stage
     ``ar(all)`` composition short-circuits to ``lax.pmean`` (the
     legacy ``flat`` program, literally).
+
+    A SLICED composition (``comp.slices > 1``, ISSUE 15) cuts the flat
+    buffer into ``effective_slices`` contiguous slices and issues the
+    stages in the skewed interleave order (:func:`expand_slices`):
+    the slices are data-independent, so slice i's slow stage and slice
+    i+1's fast stage are concurrently schedulable — S× the per-stage
+    collectives at 1/S payload, total wire bytes unchanged, and the
+    concatenated result bitwise == the unsliced rendering on exact-
+    dyadic inputs (each element still reduced over every axis exactly
+    once).
     """
     from jax import lax
 
@@ -583,6 +912,7 @@ def reduce_composed(
 
     if op not in ("sum", "mean"):
         raise ValueError(f"op must be 'sum' or 'mean', got {op!r}")
+    comp = compact_slices(comp)  # expanded spellings run compacted
     stages = comp.stages
     if comp.has_update and update_fn is None:
         raise ValueError(
@@ -593,13 +923,48 @@ def reduce_composed(
         a for s in stages
         if s.primitive in ("reduce_scatter", "allreduce") for a in s.axes
     )
+    n_tot = 1
+    for a in reduce_axes:
+        n_tot *= lax.axis_size(a)
+
+    s_eff = effective_slices(comp.slices, x.size)
+    if s_eff > 1:
+        if comp.has_update:
+            raise CompositionError(
+                f"{comp.signature()!r}: sliced execution with a "
+                "sharded_update stage — the fuse point is unsliceable"
+            )
+        flat = x.reshape(-1)
+        bounds = slice_bounds(flat.size, s_eff)
+        # Per-slice pipeline state, stepped in the skewed interleave
+        # order — each slice owns its scatter frame and divides once
+        # when ITS reduction completes.
+        cur_s = [flat[lo:hi] for lo, hi in bounds]
+        stack_s: list[list[int]] = [[] for _ in range(s_eff)]
+        rem_s = [len(reduce_axes)] * s_eff
+        for st in expand_slices(comp, flat.size):
+            i, _ = st.slice
+            if st.primitive == "reduce_scatter":
+                stack_s[i].append(cur_s[i].size)
+                cur_s[i] = staged_reduce_scatter(cur_s[i], st.axes)
+                rem_s[i] -= len(st.axes)
+            elif st.primitive == "allreduce":
+                cur_s[i] = staged_allreduce(cur_s[i], st.axes)
+                rem_s[i] -= len(st.axes)
+            else:  # allgather
+                cur_s[i] = staged_allgather(
+                    cur_s[i], st.axes, stack_s[i].pop())
+            if rem_s[i] == 0 and op == "mean":
+                cur_s[i] = cur_s[i] / n_tot
+                rem_s[i] = -1  # divide exactly once per slice
+        import jax.numpy as jnp
+
+        return jnp.concatenate(cur_s).reshape(x.shape)
+
     # flat short-circuit: one fused pmean, the pre-composition program.
     if (len(stages) == 1 and stages[0].primitive == "allreduce"
             and op == "mean"):
         return lax.pmean(x, _axes_arg(stages[0].axes))
-    n_tot = 1
-    for a in reduce_axes:
-        n_tot *= lax.axis_size(a)
     shape = x.shape
     cur = x.reshape(-1)
     stack: list[int] = []  # original sizes, LIFO with the scatters
@@ -705,9 +1070,10 @@ def reduce_composed_tree(leaves: list, comp: Composition, *, op="mean"):
     without a packing layer, pinned in tests/test_composition.py)."""
     from jax import lax
 
+    comp = compact_slices(comp)  # expanded spellings run compacted
     stages = comp.stages
     if (len(stages) == 1 and stages[0].primitive == "allreduce"
-            and op == "mean"):
+            and op == "mean" and comp.slices == 1):
         return lax.pmean(leaves, _axes_arg(stages[0].axes))
     return [reduce_composed(g, comp, op=op) for g in leaves]
 
@@ -720,8 +1086,11 @@ __all__ = [
     "Stage",
     "bind_composition",
     "canonical_axis_names",
+    "compact_slices",
     "compile_schedule",
     "derive_compositions",
+    "effective_slices",
+    "expand_slices",
     "flat_composition",
     "normalize_schedule_name",
     "parse_signature",
@@ -732,6 +1101,8 @@ __all__ = [
     "run_reduce_prefix",
     "schedule_candidates",
     "signature_for",
+    "slice_bounds",
+    "sliced_composition",
     "stage_wire_layout",
     "two_level_composition",
     "validate_composition",

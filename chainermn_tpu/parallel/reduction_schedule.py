@@ -71,6 +71,18 @@ SCHEDULES = ("flat", "two_level", "zero")
 #: Registry decision name for the ``'auto'`` schedule resolution.
 DECISION = "reduction_schedule"
 
+#: Registry decision name for the bucket-slice count a composed
+#: schedule interleaves over (ISSUE 15): ∈ {1, 2, 4, 8}, table default
+#: 1 — slicing multiplies per-stage collective dispatches S× (at 1/S
+#: payload each), so the interleave must EARN adoption through the
+#: bench ``composed`` phase's sliced arms (spread-gated, the
+#: spec_tokens/prefill_chunk precedent). Keyed beside ``DECISION`` on
+#: world-shape x payload-MB so one capture adjudicates both.
+SLICES_DECISION = "comp_slices"
+
+#: The ``comp_slices`` candidate set (registry spellings are strings).
+SLICE_CANDIDATES = ("1", "2", "4", "8")
+
 #: ~64 MB (the tuned table default of ``allreduce_bucket_mb``) — the
 #: single fallback the bucket partition uses when no tuned size is
 #: pinned; large enough to keep the slow level bandwidth-bound, small
@@ -120,12 +132,34 @@ def bucket_partition(
     return buckets
 
 
+def resolve_comp_slices(
+    device_kind: Optional[str],
+    payload_bytes: int,
+    world_shape: Sequence[int],
+) -> int:
+    """The ``comp_slices`` resolution (ISSUE 15): how many bucket
+    slices a composed reduction interleaves over, through the autotune
+    registry — keyed exactly like :func:`resolve_schedule` (world-shape
+    x payload-MB, dtype tag ``'slices'``), table default 1 (slicing
+    must earn adoption; a cache entry seeded from bench's
+    ``composed_sliced_ms`` rows moves it)."""
+    from chainermn_tpu import tuning
+
+    mb = max(1, int(payload_bytes) >> 20)
+    key = tuning.decision_key(
+        device_kind, shape=tuple(int(d) for d in world_shape) + (mb,),
+        dtype="slices",
+    )
+    return int(tuning.choice(SLICES_DECISION, SLICE_CANDIDATES, key))
+
+
 def resolve_schedule(
     device_kind: Optional[str],
     payload_bytes: int,
     world_shape: Sequence[int],
     *,
     candidates: Optional[Sequence[str]] = None,
+    slices=None,
 ):
     """The ``reduction_schedule='auto'`` resolution: winner through the
     autotune registry, keyed ``device_kind x (world-shape, payload-MB)
@@ -145,7 +179,14 @@ def resolve_schedule(
     from bench's ``overlap``/``composed`` phase rows
     (``python -m chainermn_tpu.tuning seed``) moves it where a measured
     comparison shows another pipeline paying (spread-gated, as always).
-    """
+
+    ``slices='auto'`` (ISSUE 15) additionally consults the
+    ``comp_slices`` decision (:func:`resolve_comp_slices`) and, when it
+    resolves > 1 and the winner is sliceable (not the structural
+    ``'zero'``), returns the winner's SLICED signature — the record
+    then carries ``comp_slices`` and the sliced ``composition``
+    spelling. An explicit integer pins the count; ``None`` (default)
+    leaves the winner unsliced, the pre-ISSUE-15 behaviour."""
     from chainermn_tpu import tuning
     from chainermn_tpu.parallel.composition import (
         schedule_candidates,
@@ -172,6 +213,25 @@ def resolve_schedule(
             rec["composition"] = signature_for(winner, n_axes)
         except Exception:
             pass
+    if slices is not None and winner != "zero":
+        from chainermn_tpu.parallel.composition import (
+            canonical_axis_names,
+            compile_schedule,
+            sliced_composition,
+        )
+
+        n_slices = (resolve_comp_slices(device_kind, payload_bytes,
+                                        world_shape)
+                    if slices == "auto" else int(slices))
+        if n_slices > 1:
+            comp = sliced_composition(
+                compile_schedule(winner, canonical_axis_names(n_axes)),
+                n_slices,
+            )
+            winner = comp.signature()
+            if rec is not None:
+                rec["comp_slices"] = n_slices
+                rec["composition"] = winner
     return winner, rec
 
 
@@ -337,6 +397,29 @@ def reduce_tree(
         wire_name = ("int8" if int8_wire else
                      (jnp.dtype(compress_dtype).name
                       if compress_dtype is not None else "none"))
+        # Slice-degrade provenance (ISSUE 15 satellite, LOUD): a bucket
+        # smaller than the requested slice count runs min(S, elements)
+        # slices — the pack event names every degraded bucket so the
+        # adopted comp_slices can be audited against what actually ran.
+        slice_note = {}
+        if comp.slices > 1:
+            from chainermn_tpu.parallel.composition import (
+                effective_slices,
+            )
+
+            degraded = {
+                b_i: effective_slices(comp.slices, n_elems)
+                for b_i, (_, _, n_elems) in enumerate(bucket_meta)
+                if effective_slices(comp.slices, n_elems) < comp.slices
+            }
+            slice_note["comp_slices"] = comp.slices
+            if degraded:
+                slice_note["comp_slices_degraded"] = degraded
+                slice_note["comp_slices_note"] = (
+                    f"requested {comp.slices} slices; bucket(s) "
+                    f"{sorted(degraded)} smaller than S degraded to "
+                    f"min(S, elements) (zero-leaf contract)"
+                )
         rec.event(
             "pack", op=(op or f"scheduled_reduce[{label}]"),
             nbytes=sum(g.size * wire_itemsize(g) for g in leaves),
@@ -345,6 +428,7 @@ def reduce_tree(
             n_buckets=n_buckets_total,
             wire_dtype=wire_name,
             provenance=provenance,
+            **slice_note,
             **({"size": size} if size is not None else {}),
         )
         axis_sizes = {a: lax.axis_size(a) for a in names}
@@ -361,6 +445,9 @@ def reduce_tree(
                     wire_dtype=("int8" if int8_wire and "float" in dt_name
                                 else dt_name),
                     overlapped=bool(overlapped),
+                    **({"slice": row["slice"],
+                        "n_slices": row["n_slices"]}
+                       if "slice" in row else {}),
                 )
     return jax.tree.unflatten(treedef, out)
 
@@ -390,15 +477,28 @@ class OverlappedBucketReducer:
     collect): the difference is the comm time HIDDEN behind compute,
     which ``tools/trace_report.py``'s overlap section aggregates into
     the comm-hidden fraction.
+
+    ``slices`` (ISSUE 15): each bucket is additionally cut into
+    ``min(slices, elements)`` contiguous column slices
+    (:func:`~chainermn_tpu.parallel.composition.slice_bounds` — the
+    zero-leaf degrade contract) and ONE collective flies per slice —
+    the REAL async interleave: slice i can retire while slice i+1 is
+    still on the wire, and each slice's ``wire`` event carries its
+    ``slice``/``n_slices`` address beside ``dur_s``/``blocked_s``, so
+    the overlap table shows per-slice hiding, not just per-bucket.
     """
 
-    def __init__(self, comm, *, bucket_bytes: Optional[int] = None) -> None:
+    def __init__(self, comm, *, bucket_bytes: Optional[int] = None,
+                 slices: int = 1) -> None:
         self.comm = comm
         if bucket_bytes is None:
             from chainermn_tpu.parallel.collectives import tuned_bucket_bytes
 
             bucket_bytes = tuned_bucket_bytes(comm.device_kind, comm.size)
         self.bucket_bytes = bucket_bytes
+        if int(slices) < 1:
+            raise ValueError(f"slices must be >= 1, got {slices}")
+        self.slices = int(slices)
         self._inflight: list = []
         self._layout = None
 
@@ -431,15 +531,25 @@ class OverlappedBucketReducer:
         )
         self._layout = (treedef, leaves, buckets)
         mean = self.comm._jitted["mean"]
+        from chainermn_tpu.parallel.composition import (
+            effective_slices,
+            slice_bounds,
+        )
+
         for b_i, bidx in enumerate(buckets):
             flat = jnp.concatenate(
                 [jnp.asarray(leaves[i]).astype(jnp.float32).reshape(n, -1)
                  for i in bidx],
                 axis=1,
             )
-            t0 = time.perf_counter()
-            out = mean(flat)  # async dispatch: returns before the wire
-            self._inflight.append((b_i, bidx, out, t0, int(flat.nbytes)))
+            s_eff = effective_slices(self.slices, flat.shape[1])
+            for s_i, (lo, hi) in enumerate(slice_bounds(flat.shape[1],
+                                                        s_eff)):
+                part = flat[:, lo:hi] if s_eff > 1 else flat
+                t0 = time.perf_counter()
+                out = mean(part)  # async dispatch: returns pre-wire
+                self._inflight.append(
+                    (b_i, s_i, s_eff, bidx, out, t0, int(part.nbytes)))
         return len(buckets)
 
     def collect(self) -> PyTree:
@@ -457,7 +567,8 @@ class OverlappedBucketReducer:
         for i, leaf in enumerate(leaves):
             if i not in bucketed:  # zero-size leaves: mean is identity
                 out[i] = jnp.asarray(leaf)[0]
-        for b_i, bidx, red, t0, nbytes in self._inflight:
+        rows: dict[int, list] = {}
+        for b_i, s_i, s_eff, bidx, red, t0, nbytes in self._inflight:
             t_c = time.perf_counter()
             red = jax.block_until_ready(red)
             t_r = time.perf_counter()
@@ -469,8 +580,15 @@ class OverlappedBucketReducer:
                     n_buckets=len(buckets), nbytes=nbytes,
                     dur_s=round(dur, 9), blocked_s=round(blocked, 9),
                     overlapped=bool(dur - blocked > 0),
+                    **({"slice": s_i, "n_slices": s_eff}
+                       if s_eff > 1 else {}),
                 )
-            row = red[0]  # [k]: the replicated mean
+            rows.setdefault(b_i, []).append((s_i, bidx, red[0]))
+        for b_i, parts in rows.items():
+            parts.sort()
+            bidx = parts[0][1]
+            row = (jnp.concatenate([p[2] for p in parts])
+                   if len(parts) > 1 else parts[0][2])  # [k]: the mean
             off = 0
             for i in bidx:
                 k = leaves[i][0].size
@@ -505,16 +623,30 @@ class MeasuredComposedReducer:
     Pure reductions only — a ``sharded_update`` stage belongs to the
     optimizer fuse point, not an eager wire driver (refused loudly).
 
+    ``slices`` (ISSUE 15): the composition is run SLICED — the flat
+    buffer cut into ``min(slices, elements)`` contiguous slices, the
+    per-slice stages DISPATCHED in the skewed interleave order without
+    blocking (slice i's slow stage in flight while slice i+1's fast
+    stage dispatches — JAX's async dispatch realises the overlap the
+    in-jit rendering only commits to), then collected in the same
+    order: each per-slice stage ``wire`` event carries ``slice``/
+    ``n_slices`` beside ``dur_s`` (dispatch -> ready) and ``blocked_s``
+    (wait paid at collection) — the per-slice ``dur_ms``/``blocked_ms``
+    columns of the overlap table. Unsliced (default) keeps the
+    block-per-stage honest wall clock unchanged.
+
     Usage::
 
         red = MeasuredComposedReducer(comm, schedule="two_level")
         mean = red.reduce(stacked_grads)   # [size, ...] leaves -> mean
     """
 
-    def __init__(self, comm, schedule="two_level") -> None:
+    def __init__(self, comm, schedule="two_level", *,
+                 slices: int = 1) -> None:
         from chainermn_tpu.parallel.composition import (
             CompositionError,
             compile_schedule,
+            sliced_composition,
         )
 
         self.comm = comm
@@ -528,12 +660,17 @@ class MeasuredComposedReducer:
                 "reductions (the update fuse point is "
                 "MultiNodeOptimizer's 'zero' schedule)"
             )
+        if int(slices) > 1:
+            self.comp = sliced_composition(self.comp, int(slices))
         self._axes = axes
         self._stage_jits: dict = {}
 
     def _stage_fn(self, i: int, primitive, stage_axes, orig_size,
                   cur_size):
-        key = (i, cur_size)
+        # orig_size is in the key too: two slices can share a padded
+        # shard width while un-padding to different lengths (ISSUE 15),
+        # and equal-width slices share one compiled program.
+        key = (i, cur_size, orig_size)
         if key in self._stage_jits:
             return self._stage_jits[key]
         from jax import shard_map
@@ -589,29 +726,38 @@ class MeasuredComposedReducer:
         n_elems = flat.shape[1]
         axis_sizes = {a: int(self.comm.mesh.shape[a])
                       for a in self._axes}
-        rows, _, _ = _replay_sizes(self.comp.stages, n_elems, axis_sizes)
         layout = stage_wire_layout(self.comp, axis_sizes, 4, n_elems)
         sig = self.comp.signature()
         rec = _trace.active()
 
-        cur = flat
-        li = 0
-        for i, (st, size_in, size_out) in enumerate(rows):
-            fn = self._stage_fn(i, st.primitive, st.axes, size_out,
-                                size_in)
-            t0 = time.perf_counter()
-            cur = jax.block_until_ready(fn(cur))
-            dur = time.perf_counter() - t0
-            if rec is not None and li < len(layout):
-                rec.event(
-                    "wire", schedule="composed_eager", composition=sig,
-                    stage=st.signature(), stage_index=li,
-                    stage_op=layout[li]["op"], bucket=0, n_buckets=1,
-                    nbytes=layout[li]["nbytes"],
-                    dur_s=round(dur, 9), overlapped=False,
-                )
-            li += 1
-        mean = cur[0] / n  # replicated sum row -> mean
+        from chainermn_tpu.parallel.composition import effective_slices
+
+        s_eff = effective_slices(self.comp.slices, n_elems)
+        if s_eff > 1:
+            mean = self._reduce_sliced(flat, s_eff, axis_sizes, layout,
+                                       sig, rec) / n
+        else:
+            rows, _, _ = _replay_sizes(self.comp.stages, n_elems,
+                                       axis_sizes)
+            cur = flat
+            li = 0
+            for i, (st, size_in, size_out) in enumerate(rows):
+                fn = self._stage_fn(i, st.primitive, st.axes, size_out,
+                                    size_in)
+                t0 = time.perf_counter()
+                cur = jax.block_until_ready(fn(cur))
+                dur = time.perf_counter() - t0
+                if rec is not None and li < len(layout):
+                    rec.event(
+                        "wire", schedule="composed_eager",
+                        composition=sig,
+                        stage=st.signature(), stage_index=li,
+                        stage_op=layout[li]["op"], bucket=0, n_buckets=1,
+                        nbytes=layout[li]["nbytes"],
+                        dur_s=round(dur, 9), overlapped=False,
+                    )
+                li += 1
+            mean = cur[0] / n  # replicated sum row -> mean
         out = []
         off = 0
         for leaf, k in zip(leaves, sizes):
@@ -620,6 +766,60 @@ class MeasuredComposedReducer:
             off += k
         return jax.tree.unflatten(treedef, out)
 
+    def _reduce_sliced(self, flat, s_eff, axis_sizes, layout, sig, rec):
+        """The sliced eager run (ISSUE 15): dispatch every per-slice
+        stage in the skewed interleave order WITHOUT blocking, then
+        collect in the same order — ``dur_s`` is dispatch->ready,
+        ``blocked_s`` the wait paid here, their gap the comm hidden
+        behind the other slices' stages. Returns the replicated sum
+        row (caller divides by the world size)."""
+        import dataclasses as _dc
+
+        from chainermn_tpu.parallel.composition import (
+            _replay_sizes as _replay,
+            expand_slices,
+            slice_bounds,
+        )
+
+        bounds = slice_bounds(flat.shape[1], s_eff)
+        cur_s = [flat[:, lo:hi] for lo, hi in bounds]
+        per_rows = [
+            _replay(self.comp.stages, hi - lo, axis_sizes)[0]
+            for lo, hi in bounds
+        ]
+        nodes = []  # (layout_index, slice, out_array, t0)
+        li = 0
+        for st in expand_slices(self.comp, flat.shape[1]):
+            i, _ = st.slice
+            base = _dc.replace(st, slice=None)
+            j = self.comp.stages.index(base)
+            _, size_in, size_out = per_rows[i][j]
+            fn = self._stage_fn(j, st.primitive, st.axes,
+                                size_out, size_in)
+            t0 = time.perf_counter()
+            cur_s[i] = fn(cur_s[i])  # async dispatch: no block here
+            nodes.append((li, i, cur_s[i], t0))
+            li += 1
+        for li, i, arr, t0 in nodes:
+            t_c = time.perf_counter()
+            jax.block_until_ready(arr)
+            t_r = time.perf_counter()
+            if rec is not None and li < len(layout):
+                rec.event(
+                    "wire", schedule="composed_eager", composition=sig,
+                    stage=layout[li]["stage"], stage_index=li,
+                    stage_op=layout[li]["op"], bucket=0, n_buckets=1,
+                    nbytes=layout[li]["nbytes"],
+                    slice=layout[li]["slice"],
+                    n_slices=layout[li]["n_slices"],
+                    dur_s=round(t_r - t0, 9),
+                    blocked_s=round(t_r - t_c, 9),
+                    overlapped=bool((t_r - t0) - (t_r - t_c) > 0),
+                )
+        import jax.numpy as _jnp
+
+        return _jnp.concatenate([c[0] for c in cur_s])
+
 
 __all__ = [
     "DECISION",
@@ -627,7 +827,10 @@ __all__ = [
     "MeasuredComposedReducer",
     "OverlappedBucketReducer",
     "SCHEDULES",
+    "SLICES_DECISION",
+    "SLICE_CANDIDATES",
     "bucket_partition",
     "reduce_tree",
+    "resolve_comp_slices",
     "resolve_schedule",
 ]

@@ -173,6 +173,120 @@ class DeficitRoundRobin:
         self._last = tenant
 
 
+class _AdmissionQueue:
+    """Arrival-ordered admission backlog over PER-TENANT deques
+    (ISSUE 15 satellite — the ROADMAP's named PR 14 follow-up).
+
+    Fair-share admission used to rebuild the per-tenant heads by
+    scanning the ONE FIFO on every admission: O(backlog) per admit, a
+    quadratic drain at thousands of queued requests. Here each tenant
+    keeps its own arrival-ordered deque of ``(seq, request)`` entries
+    (``seq`` is a global submission counter, so total arrival order is
+    preserved exactly), which makes the admission path O(1) amortized
+    in the backlog:
+
+    - :meth:`tenant_heads` is O(backlogged tenants), not O(backlog);
+    - :meth:`remove` of an admission candidate — always a tenant
+      head — is an O(1) popleft (identity-checked: the by-identity
+      semantics of the scan ``_dequeue`` are kept, and a non-head
+      removal falls back to a scan of that ONE tenant's deque);
+    - the global FCFS head is the min over tenant heads by ``seq``.
+
+    Iteration yields requests in arrival order (the ``evacuate`` /
+    duplicate-check surface) and ``q[0]`` is the arrival head, so the
+    drop-in surface matches the old ``deque``. Admission order is
+    pinned unchanged vs the scan implementation by regression test on
+    a 1k-request backlog (tests/test_serving.py)."""
+
+    def __init__(self) -> None:
+        self._seq = itertools.count()
+        self._tenants: dict = {}  # tenant_id -> deque[(seq, Request)]
+        self._n = 0
+
+    def append(self, request) -> None:
+        self._tenants.setdefault(
+            request.tenant_id, deque()
+        ).append((next(self._seq), request))
+        self._n += 1
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return self._n > 0
+
+    def __iter__(self):
+        entries = sorted(
+            (e for dq in self._tenants.values() for e in dq),
+            key=lambda e: e[0],
+        )
+        return (r for _, r in entries)
+
+    def iter_unordered(self):
+        """Requests in per-tenant (not global arrival) order — the
+        membership/duplicate-check surface. ``__iter__``'s global sort
+        is only needed where arrival order matters (``evacuate``);
+        submit-time checks use this O(backlog) early-exit walk (review
+        finding: paying the sort twice per submit made submit
+        O(B log B), worse than the old single-deque scan)."""
+        for dq in self._tenants.values():
+            for _, r in dq:
+                yield r
+
+    def __getitem__(self, i: int):
+        if i != 0:
+            raise IndexError(
+                "_AdmissionQueue indexes only its head ([0])")
+        head = self.head()
+        if head is None:
+            raise IndexError("empty admission queue")
+        return head
+
+    def head(self):
+        """The global arrival head: min over tenant heads by seq —
+        O(backlogged tenants), independent of backlog depth."""
+        best = None
+        for dq in self._tenants.values():
+            if dq and (best is None or dq[0][0] < best[0]):
+                best = dq[0]
+        return best[1] if best else None
+
+    def tenant_heads(self) -> dict:
+        """``{tenant_id: earliest queued request}`` for every
+        backlogged tenant — what the DRR picker ranks; O(backlogged
+        tenants) where the scan implementation walked the backlog."""
+        return {t: dq[0][1] for t, dq in self._tenants.items() if dq}
+
+    def remove(self, request) -> None:
+        """Remove ``request`` by IDENTITY. The admission path always
+        removes a tenant head (O(1)); anything else (defensive) scans
+        only that tenant's own deque."""
+        dq = self._tenants.get(request.tenant_id)
+        found = False
+        if dq:
+            if dq[0][1] is request:
+                dq.popleft()
+                found = True
+            else:
+                for i, (_, r) in enumerate(dq):
+                    if r is request:
+                        del dq[i]
+                        found = True
+                        break
+        if not found:
+            raise ValueError(
+                f"request {request.request_id!r} is not queued")
+        self._n -= 1
+        if not dq:
+            # drop the empty deque so tenant_heads stays O(backlogged
+            # tenants), not O(ever-seen tenants)
+            del self._tenants[request.tenant_id]
+
+    def clear(self) -> None:
+        self._tenants.clear()
+        self._n = 0
+
+
 def keep_arrival(request) -> None:
     """Stamp ``request._arrival`` ONLY when unset — the ONE rule every
     (re)submission path shares (ISSUE 11 satellite): the scheduler's
@@ -331,7 +445,9 @@ class Scheduler:
             _exporter.maybe_start_from_env()
         except Exception:
             pass
-        self._queue: deque[Request] = deque()
+        #: per-tenant admission deques (ISSUE 15 satellite): drop-in
+        #: arrival-ordered surface, O(1)-amortized fair-share admission.
+        self._queue = _AdmissionQueue()
         self._inflight: dict[int, _InFlight] = {}
         #: chunked admissions mid-fill, keyed by slot (ISSUE 11).
         self._filling: dict[int, _Filling] = {}
@@ -454,7 +570,7 @@ class Scheduler:
         # and a stale id from a previous scheduler can collide with this
         # scheduler's own sequence — both are caller bugs surfaced here,
         # not silently-merged results.
-        if any(r is request for r in self._queue) or any(
+        if any(r is request for r in self._queue.iter_unordered()) or any(
             fl.request is request for fl in self._inflight.values()
         ) or any(f.request is request for f in self._filling.values()):
             raise ValueError("request object is already queued/in flight")
@@ -462,7 +578,7 @@ class Scheduler:
             request.request_id = f"r{next(self._ids)}"
         rid = request.request_id
         if rid in self.results or any(
-            r.request_id == rid for r in self._queue
+            r.request_id == rid for r in self._queue.iter_unordered()
         ) or any(fl.request.request_id == rid
                  for fl in self._inflight.values()) or any(
             f.request.request_id == rid for f in self._filling.values()
@@ -599,15 +715,15 @@ class Scheduler:
         head (FCFS — a blocked head blocks the queue), or, with fair
         share active (ISSUE 14), the earliest request of the tenant
         the deficit-round-robin picker names (arrival order WITHIN a
-        tenant is always preserved)."""
+        tenant is always preserved). The heads come straight off the
+        per-tenant deques (ISSUE 15 satellite) — O(backlogged tenants)
+        per admission where the scan implementation walked the whole
+        backlog (O(backlog) per admit, quadratic drain)."""
         if not self._queue:
             return None
         if not self._fair_share:
-            return self._queue[0]
-        heads: dict = {}
-        for r in self._queue:
-            if r.tenant_id not in heads:
-                heads[r.tenant_id] = r
+            return self._queue.head()
+        heads = self._queue.tenant_heads()
         tenant = self._drr.select(
             {t: self._drr_cost(r) for t, r in heads.items()})
         return heads[tenant]
@@ -624,17 +740,13 @@ class Scheduler:
         return float(req.max_new_tokens)
 
     def _dequeue(self, req: Request) -> None:
-        """Remove ``req`` from the queue by IDENTITY. deque.remove
-        would deep-compare whole Request dataclasses (prompt lists
-        included) against every earlier entry per admission — and
-        quietly relies on request_id uniqueness to make equality mean
-        identity (review finding)."""
-        for i, r in enumerate(self._queue):
-            if r is req:
-                del self._queue[i]
-                return
-        raise ValueError(
-            f"request {req.request_id!r} is not queued")
+        """Remove ``req`` from the queue by IDENTITY (deque.remove
+        would deep-compare whole Request dataclasses — prompt lists
+        included — and quietly relies on request_id uniqueness to make
+        equality mean identity; review finding). An admission
+        candidate is always its tenant's deque head, so this is O(1)
+        (ISSUE 15 satellite)."""
+        self._queue.remove(req)
 
     def _admit_one(self) -> bool:
         """Try to admit the next candidate (:meth:`_next_candidate` —

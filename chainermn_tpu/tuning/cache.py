@@ -282,6 +282,37 @@ def _seed_one_result(result: dict, source: str, out: list,
                                    for k, v in comp_ms.items()},
                  "spread_pct": spread})
 
+    # Bucket-slice count (ISSUE 15): bench's ``composed`` sliced arms
+    # time the hierarchical pipeline at comp_slices ∈ {1,2,4,8} — rows
+    # keyed by slice count, adopted under the SAME world-shape x
+    # payload-MB key resolve_comp_slices reads (dtype tag 'slices').
+    # Spread-gated through measure.decide exactly like the live
+    # record_measurement adoption, so offline seed and in-run adoption
+    # agree on identical rows (the PR 14 adapter_impl lesson).
+    sl_ms = result.get("composed_sliced_ms")
+    if isinstance(sl_ms, dict) and len(sl_ms) >= 2 and all(
+        isinstance(v, (int, float)) for v in sl_ms.values()
+    ):
+        from chainermn_tpu.tuning.measure import decide
+
+        if "composed_sliced_spread_pct" in result:
+            spread = float(result["composed_sliced_spread_pct"])
+        else:
+            spread = 10.0  # on-accel single sample: the noise floor
+        winner = decide(sl_ms, {k: spread for k in sl_ms})
+        if winner is not None:
+            world = result.get("composed_world_shape") or [
+                result.get("n_devices", 1)
+            ]
+            payload_mb = result.get("composed_payload_mb", 1)
+            key = _bucketed_key(
+                kind, tuple(world) + (payload_mb,), "slices"
+            )
+            put("comp_slices", key, str(winner),
+                {"candidates_ms": {k: round(float(v), 4)
+                                   for k, v in sl_ms.items()},
+                 "spread_pct": spread})
+
     # Sequence-axis attention impl (ISSUE 13): bench's ``seq_parallel``
     # phase times the ONE plan-compiled step per candidate (ring's n-1
     # ppermutes/layer vs Ulysses' all_to_all reshard), keyed

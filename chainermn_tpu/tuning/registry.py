@@ -99,6 +99,15 @@ DEFAULT_TABLE: dict = {
     "allreduce_bucket_mb": {"*": "64"},
     "double_buffering": {"*": "off"},
     "reduction_schedule": {"*": "flat"},
+    # Bucket-sliced composed reduction (ISSUE 15): how many slices a
+    # composed schedule's stages interleave over (slice i's slow inter-
+    # level stage behind slice i+1's fast rs/ag). ``1`` everywhere —
+    # slicing multiplies per-stage collective DISPATCHES S× at 1/S
+    # payload (total wire bytes unchanged), so the latency/overlap
+    # trade must EARN adoption through bench's ``composed`` sliced arms
+    # (``composed_sliced_ms`` rows, spread-gated; the
+    # spec_tokens/prefill_chunk precedent).
+    "comp_slices": {"*": "1"},
     "decode_impl": {"*": "paged"},
     "kv_block_size": {"*": "64"},
     "spec_tokens": {"*": "0"},

@@ -63,6 +63,13 @@ def main(argv=None):
                         "stacking it on an aggressive inner momentum "
                         "can diverge)")
     p.add_argument("--allreduce-grad-dtype", default=None)
+    p.add_argument("--reduction-schedule", default=None,
+                   metavar="SCHED",
+                   help="gradient-reduction schedule: flat | two_level "
+                        "| zero | auto | a composition signature, "
+                        "sliced forms included (e.g. "
+                        "'rs(data)[s0..3]>ag(data)'); default: the "
+                        "communicator's own strategy")
     p.add_argument("--error-feedback", action="store_true",
                    help="EF-SGD residual feedback over the int8 wire "
                         "(requires --allreduce-grad-dtype int8)")
@@ -101,6 +108,7 @@ def main(argv=None):
             ("--double-buffering", args.double_buffering),
             ("--error-feedback", args.error_feedback),
             ("--allreduce-grad-dtype", args.allreduce_grad_dtype),
+            ("--reduction-schedule", args.reduction_schedule),
         ) if on]
         if bad:
             p.error(f"--local-sgd replaces the per-step gradient wire; "
@@ -116,6 +124,7 @@ def main(argv=None):
             comm,
             double_buffering=args.double_buffering,
             error_feedback=args.error_feedback,
+            reduction_schedule=args.reduction_schedule,
         )
     state = create_train_state(params, optimizer, comm)
 

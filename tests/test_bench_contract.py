@@ -757,3 +757,76 @@ def test_plan_rows_contract():
 
     src = inspect.getsource(bench._run_bench)
     assert 'supp("plan", "plan_error"' in src
+
+
+def test_composed_sliced_rows_contract_and_seeding(tmp_path, monkeypatch):
+    """ISSUE 15 satellite: the ``composed`` phase's sliced-arm rows
+    ride the compact line (per-S medians + spread gate + selected
+    count), and ``tuning seed`` learns the ``comp_slices`` decision
+    from the same rows — spread-gated exactly like the in-run
+    ``record_measurement`` adoption, under the world-shape x
+    payload-MB key ``resolve_comp_slices`` reads (offline seed and
+    live adoption must agree on identical rows — the PR 14
+    adapter_impl lesson)."""
+    for k in ("composed_sliced_ms", "composed_slices_selected",
+              "composed_sliced_spread_pct"):
+        assert k in bench._COMPACT_KEYS, k
+
+    from chainermn_tpu.tuning.cache import seed_from_bench_details
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-04T00:00:00Z",
+        "composed_sliced_ms": {"1": 4.0, "2": 3.2, "4": 2.0, "8": 2.8},
+        "composed_sliced_spread_pct": 5.0,
+        "composed_world_shape": [2, 2, 2],
+        "composed_payload_mb": 3,
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert "comp_slices|TPU v5 lite|2x2x2x4|slices -> 4" in seeded
+
+    # the seeded entry is exactly what resolve_comp_slices resolves —
+    # and what the 'auto' schedule resolution slices its winner by.
+    from chainermn_tpu.parallel.reduction_schedule import (
+        resolve_comp_slices,
+        resolve_schedule,
+    )
+
+    monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE", "table")
+    monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_CACHE", str(cache))
+    assert resolve_comp_slices("TPU v5 lite", 3 << 20, (2, 2, 2)) == 4
+    winner, rec = resolve_schedule("TPU v5 lite", 3 << 20, (2, 2, 2),
+                                   slices="auto")
+    assert winner == "ar(a0+a1+a2)[s0..3]"
+    assert rec["comp_slices"] == 4
+
+    # live adoption over the SAME rows agrees with the offline seed
+    from chainermn_tpu import tuning
+
+    live_cache = tmp_path / "live.json"
+    key = tuning.decision_key(
+        "TPU v5 lite", shape=(2, 2, 2, 3), dtype="slices")
+    live = tuning.record_measurement(
+        "comp_slices", key,
+        {k: float(v) for k, v in doc["composed_sliced_ms"].items()},
+        spreads={k: 5.0 for k in doc["composed_sliced_ms"]},
+        cache_path=str(live_cache),
+    )
+    assert live == "4"
+
+    # a spread-dominated sweep refuses to pin a winner (table default
+    # 1 stands — the honest CPU-proxy outcome)
+    doc["composed_sliced_ms"] = {"1": 2.0, "2": 1.98, "4": 2.02,
+                                 "8": 2.05}
+    doc["composed_sliced_spread_pct"] = 10.0
+    details.write_text(json.dumps(doc))
+    assert "comp_slices" not in "\n".join(
+        seed_from_bench_details(str(details),
+                                str(cache.with_suffix(".2")))
+    )
+    monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_CACHE",
+                       str(cache.with_suffix(".2")))
+    assert resolve_comp_slices("TPU v5 lite", 3 << 20, (2, 2, 2)) == 1

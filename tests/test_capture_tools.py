@@ -188,6 +188,52 @@ def _golden_trace_lines():
          "bucket": 0, "n_buckets": 1, "nbytes": 2048,
          "wire_dtype": "bfloat16", "overlapped": False,
          "dur_s": 0.0005},
+        # ISSUE 15: a SLICED composition (S=2) — one event per stage
+        # per slice in the skewed interleave order, each carrying its
+        # slice address. The rs/ag slice rows are MEASURED (dur_s +
+        # blocked_s, the eager sliced reducer), the ar rows layout-only
+        # — so the per-signature stage table renders mixed
+        # sliced/unsliced, measured/unmeasured rows side by side.
+        {"schema": 1, "kind": "wire", "t": 2.15, "pid": 1, "rank": 0,
+         "schedule": "composed_eager",
+         "composition": "rs(a1)[s0..1]>ar(a0)>ag(a1)",
+         "stage": "rs(a1)", "stage_index": 0, "stage_op": "reduce-scatter",
+         "bucket": 0, "n_buckets": 1, "nbytes": 1024, "slice": 0,
+         "n_slices": 2, "overlapped": True,
+         "dur_s": 0.001, "blocked_s": 0.0002},
+        {"schema": 1, "kind": "wire", "t": 2.16, "pid": 1, "rank": 0,
+         "schedule": "composed_eager",
+         "composition": "rs(a1)[s0..1]>ar(a0)>ag(a1)",
+         "stage": "rs(a1)", "stage_index": 1, "stage_op": "reduce-scatter",
+         "bucket": 0, "n_buckets": 1, "nbytes": 1024, "slice": 1,
+         "n_slices": 2, "overlapped": False,
+         "dur_s": 0.0008, "blocked_s": 0.0001},
+        {"schema": 1, "kind": "wire", "t": 2.17, "pid": 1, "rank": 0,
+         "schedule": "composed_eager",
+         "composition": "rs(a1)[s0..1]>ar(a0)>ag(a1)",
+         "stage": "ar(a0)", "stage_index": 2, "stage_op": "all-reduce",
+         "bucket": 0, "n_buckets": 1, "nbytes": 256, "slice": 0,
+         "n_slices": 2, "overlapped": False},
+        {"schema": 1, "kind": "wire", "t": 2.18, "pid": 1, "rank": 0,
+         "schedule": "composed_eager",
+         "composition": "rs(a1)[s0..1]>ar(a0)>ag(a1)",
+         "stage": "ar(a0)", "stage_index": 3, "stage_op": "all-reduce",
+         "bucket": 0, "n_buckets": 1, "nbytes": 256, "slice": 1,
+         "n_slices": 2, "overlapped": False},
+        {"schema": 1, "kind": "wire", "t": 2.19, "pid": 1, "rank": 0,
+         "schedule": "composed_eager",
+         "composition": "rs(a1)[s0..1]>ar(a0)>ag(a1)",
+         "stage": "ag(a1)", "stage_index": 4, "stage_op": "all-gather",
+         "bucket": 0, "n_buckets": 1, "nbytes": 1024, "slice": 0,
+         "n_slices": 2, "overlapped": False,
+         "dur_s": 0.0004, "blocked_s": 0.0004},
+        {"schema": 1, "kind": "wire", "t": 2.195, "pid": 1, "rank": 0,
+         "schedule": "composed_eager",
+         "composition": "rs(a1)[s0..1]>ar(a0)>ag(a1)",
+         "stage": "ag(a1)", "stage_index": 5, "stage_op": "all-gather",
+         "bucket": 0, "n_buckets": 1, "nbytes": 1024, "slice": 1,
+         "n_slices": 2, "overlapped": True,
+         "dur_s": 0.0006, "blocked_s": 0.0},
         # ISSUE 4: one request through the serving scheduler — queue
         # wait, bucketed prefill (its sampled token counts as generated;
         # ttft_s = submit -> first token, ISSUE 5), three decode steps
@@ -276,7 +322,7 @@ def test_trace_report_contract(tmp_path):
         "schema_versions": [1],
         "meta": {"started_at": "2026-08-03T00:00:00Z", "sync": False,
                  "source": "bench"},
-        "n_events": 29,  # torn tail line skipped, not fatal
+        "n_events": 35,  # torn tail line skipped, not fatal
         "collectives": [
             {"op": "allreduce_grad", "plane": "device", "n": 2,
              "total_bytes": 2000, "total_s": 0.004, "mean_ms": 2.0,
@@ -311,17 +357,62 @@ def test_trace_report_contract(tmp_path):
             # ISSUE 13: stage rows carry dur_ms where measured events
             # (dur_s — the eager MeasuredComposedReducer) exist; a
             # layout-only stage row simply has no dur_ms key.
-            "compositions": {"rs(a1)>ar(a0)>ag(a1)": {
-                "schedule": "two_level", "buckets": 1, "nbytes": 4608,
-                "overlapped": 0,
-                "stages": {
-                    "rs(a1)": {"op": "reduce-scatter", "n": 1,
-                               "nbytes": 2048, "dur_ms": 1.5},
-                    "ar(a0)": {"op": "all-reduce", "n": 1, "nbytes": 512},
-                    "ag(a1)": {"op": "all-gather", "n": 1,
-                               "nbytes": 2048, "dur_ms": 0.5},
+            "compositions": {
+                "rs(a1)>ar(a0)>ag(a1)": {
+                    "schedule": "two_level", "buckets": 1,
+                    "nbytes": 4608, "overlapped": 0,
+                    "stages": {
+                        "rs(a1)": {"op": "reduce-scatter", "n": 1,
+                                   "nbytes": 2048, "dur_ms": 1.5},
+                        "ar(a0)": {"op": "all-reduce", "n": 1,
+                                   "nbytes": 512},
+                        "ag(a1)": {"op": "all-gather", "n": 1,
+                                   "nbytes": 2048, "dur_ms": 0.5},
+                    },
                 },
-            }},
+                # ISSUE 15: the sliced composition's stage rows carry
+                # across-slice totals plus the per-slice sub-table
+                # (dur_ms/blocked_ms only where the slice was
+                # measured — the ar rows are layout-only).
+                "rs(a1)[s0..1]>ar(a0)>ag(a1)": {
+                    "schedule": "composed_eager", "buckets": 1,
+                    "nbytes": 4608, "overlapped": 1,
+                    "stages": {
+                        "rs(a1)": {
+                            "op": "reduce-scatter", "n": 2,
+                            "nbytes": 2048, "dur_ms": 1.8,
+                            "blocked_ms": 0.3,
+                            "slices": {
+                                "s0": {"n": 1, "nbytes": 1024,
+                                       "dur_ms": 1.0,
+                                       "blocked_ms": 0.2},
+                                "s1": {"n": 1, "nbytes": 1024,
+                                       "dur_ms": 0.8,
+                                       "blocked_ms": 0.1},
+                            },
+                        },
+                        "ar(a0)": {
+                            "op": "all-reduce", "n": 2, "nbytes": 512,
+                            "slices": {
+                                "s0": {"n": 1, "nbytes": 256},
+                                "s1": {"n": 1, "nbytes": 256},
+                            },
+                        },
+                        "ag(a1)": {
+                            "op": "all-gather", "n": 2, "nbytes": 2048,
+                            "dur_ms": 1.0, "blocked_ms": 0.4,
+                            "slices": {
+                                "s0": {"n": 1, "nbytes": 1024,
+                                       "dur_ms": 0.4,
+                                       "blocked_ms": 0.4},
+                                "s1": {"n": 1, "nbytes": 1024,
+                                       "dur_ms": 0.6,
+                                       "blocked_ms": 0.0},
+                            },
+                        },
+                    },
+                },
+            },
             "measured": {"n": 2, "comm_ms_total": 8.0,
                          "comm_ms_blocked": 4.0, "comm_ms_hidden": 4.0,
                          "hidden_fraction": 0.5},
@@ -392,7 +483,7 @@ def test_trace_report_contract(tmp_path):
     }, summary
     # chrome export emitted alongside
     chrome = _json.loads(chrome_file.read_text())
-    assert len(chrome["traceEvents"]) == 28  # meta excluded
+    assert len(chrome["traceEvents"]) == 34  # meta excluded
     # and the human rendering mentions the essentials
     proc2 = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
@@ -407,6 +498,16 @@ def test_trace_report_contract(tmp_path):
                   "rs(a1) [reduce-scatter]: n=1, 2.0 KiB, 1.500 ms",
                   "ar(a0) [all-reduce]: n=1, 512 B",
                   "ag(a1) [all-gather]: n=1, 2.0 KiB, 0.500 ms",
+                  # ISSUE 15: the sliced composition's per-slice rows
+                  "composed rs(a1)[s0..1]>ar(a0)>ag(a1) "
+                  "[composed_eager]: 1 bucket(s), 4.5 KiB wire",
+                  "rs(a1) [reduce-scatter]: n=2, 2.0 KiB, 1.800 ms",
+                  "s0: n=1, 1.0 KiB, 1.000 ms (0.200 ms blocked)",
+                  "s1: n=1, 1.0 KiB, 0.800 ms (0.100 ms blocked)",
+                  "ar(a0) [all-reduce]: n=2, 512 B",
+                  "s0: n=1, 256 B",
+                  "ag(a1) [all-gather]: n=2, 2.0 KiB, 1.000 ms",
+                  "s1: n=1, 1.0 KiB, 0.600 ms (0.000 ms blocked)",
                   "serving (continuous batching)", "tokens/s: 227.27",
                   "p50 4.000 ms, p99 6.000 ms", "33.3% mean",
                   "TTFT: p50 12.000 ms, p99 12.000 ms",

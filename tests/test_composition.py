@@ -580,3 +580,230 @@ class TestPlanComposition:
             ParallelPlan({"data": 2, "zero": 4},
                          devices=jax.devices("cpu")[:N],
                          grad_reduction="rs(zero)>ag(zero)")
+
+
+# ----------------------------------------------------------------------
+# ISSUE 15: bucket-sliced composed reduction
+# ----------------------------------------------------------------------
+
+
+class TestSlicedComposition:
+    """The sliced-stage DSL: grammar roundtrip, validator invariants,
+    the slice_bounds zero-leaf contract, and the structural pin — a
+    sliced composition's compiled HLO carries exactly S× the per-stage
+    collective count at 1/S payload (total wire bytes unchanged) and
+    is BITWISE == flat on exact-dyadic inputs."""
+
+    def test_signature_roundtrip_compact_and_expanded(self):
+        from chainermn_tpu.parallel.composition import (
+            expand_slices,
+            sliced_composition,
+        )
+
+        comp = sliced_composition(two_level_composition(AXES3), 4)
+        sig = comp.signature()
+        assert sig == "rs(a2)[s0..3]>ar(a0+a1)>ag(a2)"
+        assert parse_signature(sig) == comp
+        validate_composition(comp, AXES3)
+        # expanded spelling: per-stage [sI:S] addresses, skewed order,
+        # parseable and valid (per-slice conjugacy)
+        ex = expand_slices(comp, 64)
+        assert len(ex) == 12 and ex[0].signature() == "rs(a2)[s0:4]"
+        ex_sig = ">".join(s.signature() for s in ex)
+        ex_comp = parse_signature(ex_sig)
+        validate_composition(ex_comp, AXES3)
+        assert ex_comp.signature() == ex_sig
+        # the skew: slice 1's rs is issued before slice 0's ar
+        order = [s.signature() for s in ex]
+        assert order.index("rs(a2)[s1:4]") < order.index(
+            "ar(a0+a1)[s0:4]")
+        # the ONE front door reconstitutes the expanded spelling to
+        # the compact executable form (review finding: an expanded
+        # composition validated but would have executed as a flat
+        # double-reduction) — and a heterogeneous expansion, where
+        # slices run different pipelines, is refused loudly.
+        from chainermn_tpu.parallel.composition import compact_slices
+
+        assert compile_schedule(ex_sig, AXES3) == comp
+        assert compact_slices(ex_comp) == comp
+        het = parse_signature(
+            "rs(a2)[s0:2]>ar(a0+a1)[s0:2]>ag(a2)[s0:2]"
+            ">ar(a0+a1+a2)[s1:2]")
+        validate_composition(het, AXES3)  # mathematically fine...
+        with pytest.raises(CompositionError,
+                           match="different pipeline"):
+            compact_slices(het)  # ...but not executable
+
+    def test_slice_bounds_contract(self):
+        from chainermn_tpu.parallel.composition import (
+            effective_slices,
+            slice_bounds,
+        )
+
+        # degrade: S > elements -> min(S, elements); S == elements ok
+        assert effective_slices(8, 3) == 3
+        assert effective_slices(4, 4) == 4
+        assert effective_slices(4, 0) == 1  # zero-leaf floor
+        with pytest.raises(CompositionError, match=">= 1"):
+            effective_slices(0, 10)
+        for n, s in ((10, 4), (8, 8), (7, 3), (1, 1)):
+            bounds = slice_bounds(n, s)
+            assert len(bounds) == s
+            assert bounds[0][0] == 0 and bounds[-1][1] == n
+            for (a0, b0), (a1, b1) in zip(bounds, bounds[1:]):
+                assert b0 == a1  # disjoint, covering
+            assert all(hi > lo for lo, hi in bounds)  # never empty
+
+    def test_validator_rejections(self):
+        from chainermn_tpu.parallel.composition import sliced_composition
+
+        with pytest.raises(CompositionError, match="unsliceable"):
+            validate_composition(
+                Composition(zero_composition(AXES3).stages, slices=2),
+                AXES3,
+            )
+        with pytest.raises(CompositionError, match="cannot be sliced"):
+            sliced_composition(zero_composition(AXES3), 2)
+        with pytest.raises(CompositionError, match="slices must be"):
+            validate_composition(
+                Composition(flat_composition(AXES3).stages, slices=0),
+                AXES3,
+            )
+        # expanded form: a slice whose pipeline is incomplete
+        with pytest.raises(CompositionError, match="slice s1:2"):
+            validate_composition(
+                parse_signature("rs(a2)[s0:2]>rs(a2)[s1:2]"
+                                ">ar(a0+a1)[s0:2]>ag(a2)[s0:2]"),
+                AXES3,
+            )
+        # expanded form: mixed addressed/unaddressed stages
+        with pytest.raises(CompositionError, match="no slice address"):
+            validate_composition(
+                parse_signature("ar(a0+a1+a2)[s0:2]>ar(a0+a1+a2)"),
+                ("a0", "a1", "a2"),
+            )
+        # conflicting totals
+        with pytest.raises(CompositionError, match="slice totals"):
+            validate_composition(
+                parse_signature("ar(a0+a1+a2)[s0:2]>ar(a0+a1+a2)[s1:3]"),
+                AXES3,
+            )
+        with pytest.raises(CompositionError, match="must start at s0"):
+            parse_signature("rs(a2)[s1..3]>ar(a0+a1)>ag(a2)")
+
+    def test_sliced_wire_layout_bytes_conserved(self):
+        """Per-slice rows at 1/S payload each; summed over slices the
+        per-stage wire bytes equal the unsliced rendering's (divisible
+        size, so no padding slack)."""
+        from chainermn_tpu.parallel.composition import sliced_composition
+
+        sizes = {"a0": 2, "a1": 2, "a2": 2}
+        base = parse_signature("rs(a2)>rs(a1)>ar(a0)>ag(a1)>ag(a2)")
+        flat_rows = stage_wire_layout(base, sizes, 4, 128)
+        for S in (2, 4, 8):
+            rows = stage_wire_layout(
+                sliced_composition(base, S), sizes, 4, 128)
+            assert len(rows) == S * len(flat_rows)
+            per_stage: dict = {}
+            for r in rows:
+                assert r["n_slices"] == S and 0 <= r["slice"] < S
+                per_stage[r["stage"]] = (
+                    per_stage.get(r["stage"], 0) + r["nbytes"])
+            assert per_stage == {
+                r["stage"]: r["nbytes"] for r in flat_rows
+            }, S
+
+    @pytest.mark.parametrize("k", [1, 2, 3])
+    def test_sliced_counts_and_bitwise_vs_flat(self, k):
+        """The acceptance pin, per mesh depth: every slice count of
+        the two_level instance compiles to EXACTLY S× the per-stage
+        collectives and reduces bitwise == flat through the real
+        bucketed reduction (dyadic inputs)."""
+        from chainermn_tpu.parallel.composition import sliced_composition
+
+        shape, names = MESHES[k]
+        comm = _comm(shape, names)
+        rs = np.random.RandomState(k + 40)
+        tree = _dyadic_tree(rs, {"w": (N, 40, 8), "b": (N, 16)})
+        _, ref = _reduce_counts_and_out(comm, "flat", tree)
+        base = two_level_composition(names)
+        for S in (2, 4):
+            comp = sliced_composition(base, S)
+            counts, out = _reduce_counts_and_out(
+                comm, comp.signature(), tree
+            )
+            pred = predicted_collectives(comp, size=40 * 8 + 16)
+            assert counts == pred, (comp.signature(), counts, pred)
+            for key in tree:
+                np.testing.assert_array_equal(
+                    out[key], ref[key],
+                    err_msg=f"{comp.signature()} != flat ({key})",
+                )
+
+    def test_degrade_below_slice_count(self, comm3):
+        """A bucket smaller than S runs min(S, elements) slices —
+        never an empty stage or zero-size collective (the PR 3
+        zero-leaf contract): a 3-element bucket under S=8 compiles
+        exactly 3 of each stage."""
+        from chainermn_tpu.parallel.composition import sliced_composition
+
+        comp = sliced_composition(two_level_composition(AXES3), 8)
+        rs = np.random.RandomState(9)
+        tree = {"b": jnp.asarray(
+            rs.randint(-8, 8, (N, 3)), jnp.float32) / 8.0}
+        _, ref = _reduce_counts_and_out(comm3, "flat", tree)
+        counts, out = _reduce_counts_and_out(
+            comm3, comp.signature(), tree)
+        assert counts == predicted_collectives(comp, size=3)
+        assert counts["all-reduce"] == 3  # min(8, 3), not 8, never 0
+        np.testing.assert_array_equal(out["b"], ref["b"])
+
+    def test_sliced_dist_equals_single_through_trainer(self, comm3):
+        """The suite's core invariant for the sliced rendering: the
+        2x2x2 trajectory (values AND gradients, two adam steps) under
+        a sliced schedule equals the single-device one."""
+        from chainermn_tpu.parallel.composition import sliced_composition
+
+        rs = np.random.RandomState(0)
+        params = {"w": jnp.asarray(rs.randn(5, 3), jnp.float32),
+                  "b": jnp.asarray(rs.randn(3), jnp.float32)}
+        x = jnp.asarray(rs.randn(16, 5), jnp.float32)
+        y = jnp.asarray(np.arange(16) % 3, np.int32)
+        single_p, single_l = _train(
+            comm3.sub_communicator([0]), params, (x, y)
+        )
+        sig = sliced_composition(
+            two_level_composition(comm3.grad_axes), 4).signature()
+        dist_p, dist_l = _train(
+            comm3, params, (x, y), reduction_schedule=sig
+        )
+        for key in params:
+            np.testing.assert_allclose(
+                dist_p[key], single_p[key], rtol=1e-5, atol=1e-6,
+                err_msg=sig,
+            )
+        assert abs(dist_l - single_l) < 1e-6
+
+    def test_int8_wire_refuses_sliced(self, comm3):
+        from chainermn_tpu.parallel.composition import sliced_composition
+
+        sig = sliced_composition(
+            two_level_composition(comm3.grad_axes), 2).signature()
+        with pytest.raises(ValueError, match="int8 two-phase wire"):
+            reduce_tree(
+                {"w": jnp.ones((16,))}, schedule=sig,
+                axes=comm3.grad_axes, compress_dtype=jnp.int8,
+            )
+
+    def test_plan_grad_reduction_accepts_sliced_signature(self):
+        """ParallelPlan grad_reduction= accepts a sliced spelling and
+        reports it in describe() — the end-to-end plumbing pin (the
+        compiled-step equivalence rides dryrun phase M)."""
+        from chainermn_tpu.parallel.plan import ParallelPlan
+
+        plan = ParallelPlan(
+            {"data": 2, "zero": 4}, devices=jax.devices("cpu")[:N],
+            grad_reduction="rs(a1)[s0..1]>rs(a0)>ag(a0)>ag(a1)",
+        )
+        assert plan.describe()["grad_reduction"] == \
+            "rs(zero)[s0..1]>rs(data)>ag(data)>ag(zero)"

@@ -705,3 +705,244 @@ def test_trainer_emits_overlap_config(comm):
     assert cfgs[0]["double_buffering"] is True
     assert cfgs[0]["staleness"] == 1
     assert cfgs[0]["schedule"] == "two_level"
+
+
+# ----------------------------------------------------------------------
+# ISSUE 15: sliced eager reducers + the comp_slices decision
+# ----------------------------------------------------------------------
+
+
+class TestSlicedEagerReducers:
+    def test_overlapped_reducer_sliced_mean_and_slice_events(self, comm):
+        """slices=4: one collective flies PER SLICE (the real async
+        interleave), each wire event carries its slice address beside
+        dur_s/blocked_s, the mean is exact, and the rollup still
+        yields a hidden_fraction."""
+        rec = trace.enable(None)
+        rs = np.random.RandomState(2)
+        stacked = {
+            "a": jnp.asarray(rs.randn(N, 100), jnp.float32),
+            "b": jnp.asarray(rs.randn(N, 7, 3), jnp.float32),
+            "empty": jnp.zeros((N, 0), jnp.float32),
+        }
+        red = OverlappedBucketReducer(comm, bucket_bytes=100 * 4,
+                                      slices=4)
+        n_buckets = red.dispatch(stacked)
+        assert n_buckets == 2
+        out = red.collect()
+        for k in ("a", "b"):
+            np.testing.assert_allclose(
+                np.asarray(out[k]), np.asarray(stacked[k]).mean(0),
+                rtol=1e-5, atol=1e-6,
+            )
+        assert out["empty"].shape == (0,)
+        wires = [e for e in rec.events if e["kind"] == "wire"]
+        assert len(wires) == 8  # 2 buckets x 4 slices
+        for w in wires:
+            assert w["schedule"] == "overlap_eager"
+            assert w["n_slices"] == 4 and 0 <= w["slice"] < 4
+            assert w["dur_s"] >= w["blocked_s"] >= 0
+        ov = trace.summarize_overlap(rec.events)
+        assert ov["measured"]["n"] == 8
+        assert 0.0 <= ov["measured"]["hidden_fraction"] <= 1.0
+
+    def test_overlapped_reducer_slice_degrade(self, comm):
+        """A 3-element bucket under slices=8 flies 3 collectives —
+        min(S, elements), never a zero-size one (the zero-leaf
+        contract on the eager path)."""
+        rec = trace.enable(None)
+        red = OverlappedBucketReducer(comm, slices=8)
+        red.dispatch({"g": jnp.ones((N, 3), jnp.float32)})
+        out = red.collect()
+        np.testing.assert_allclose(np.asarray(out["g"]),
+                                   np.ones(3), rtol=1e-6)
+        wires = [e for e in rec.events if e["kind"] == "wire"]
+        assert len(wires) == 3
+        assert all(w["n_slices"] == 3 and w["nbytes"] > 0
+                   for w in wires)
+        with pytest.raises(ValueError, match="slices"):
+            OverlappedBucketReducer(comm, slices=0)
+
+    def test_measured_composed_reducer_sliced(self, comm):
+        """The sliced measured executor: 3 stages x 4 slices of wire
+        events in skewed order, every one carrying slice address +
+        dur_s + blocked_s, the mean exact, and summarize_overlap's
+        per-signature stage rows growing the per-slice sub-table with
+        measured dur_ms/blocked_ms."""
+        from chainermn_tpu.parallel.reduction_schedule import (
+            MeasuredComposedReducer,
+        )
+
+        rec = trace.enable(None)
+        rs = np.random.RandomState(6)
+        stacked = {
+            "a": jnp.asarray(rs.randn(N, 33), jnp.float32),
+            "b": jnp.asarray(rs.randn(N, 4, 2), jnp.float32),
+        }
+        red = MeasuredComposedReducer(comm, schedule="two_level",
+                                      slices=4)
+        sig = red.comp.signature()
+        assert "[s0..3]" in sig
+        out = red.reduce(stacked)
+        jax.tree.map(
+            lambda o, g: np.testing.assert_allclose(
+                np.asarray(o), np.asarray(g).mean(0),
+                rtol=1e-5, atol=1e-6,
+            ),
+            out, stacked,
+        )
+        wires = [e for e in rec.events
+                 if e["kind"] == "wire" and e.get("composition") == sig]
+        n_stages = len(red.comp.stages)
+        assert len(wires) == n_stages * 4
+        for i, w in enumerate(wires):
+            assert w["stage_index"] == i
+            assert w["n_slices"] == 4 and 0 <= w["slice"] < 4
+            assert w["dur_s"] >= 0 and w["blocked_s"] >= 0
+            assert w["nbytes"] > 0
+        # skew: slice 1's rs event precedes slice 0's inter-level ar
+        stages_in_order = [(w["stage"], w["slice"]) for w in wires]
+        rs_name = red.comp.stages[0].signature()
+        ar_name = red.comp.stages[1].signature()
+        assert stages_in_order.index((rs_name, 1)) < \
+            stages_in_order.index((ar_name, 0))
+        ov = trace.summarize_overlap(rec.events)
+        row = ov["compositions"][sig]
+        for st, srow in row["stages"].items():
+            assert srow["n"] == 4, (st, srow)
+            slices = srow["slices"]
+            assert set(slices) == {"s0", "s1", "s2", "s3"}
+            for sl in slices.values():
+                assert sl.get("dur_ms") is not None
+                assert sl.get("blocked_ms") is not None
+
+    def test_measured_composed_sliced_degrade(self, comm):
+        from chainermn_tpu.parallel.reduction_schedule import (
+            MeasuredComposedReducer,
+        )
+
+        rec = trace.enable(None)
+        red = MeasuredComposedReducer(comm, schedule="two_level",
+                                      slices=8)
+        out = red.reduce({"g": jnp.ones((N, 3), jnp.float32)})
+        np.testing.assert_allclose(np.asarray(out["g"]), np.ones(3),
+                                   rtol=1e-6)
+        wires = [e for e in rec.events
+                 if e["kind"] == "wire" and e.get("composition")]
+        # min(8, 3) slices x the pipeline's stages (2 on a flat mesh)
+        assert len(wires) == 3 * len(red.comp.stages)
+        assert all(w["n_slices"] == 3 for w in wires)
+
+
+class TestCompSlicesDecision:
+    def test_table_default_is_one(self, monkeypatch):
+        from chainermn_tpu.parallel.reduction_schedule import (
+            resolve_comp_slices,
+        )
+
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE", "table")
+        assert resolve_comp_slices("cpu", 3 << 20, (2, 2, 2)) == 1
+        # ...and the auto schedule resolution stays unsliced
+        winner, rec = resolve_schedule("cpu", 3 << 20, (2, 2, 2),
+                                       slices="auto")
+        assert winner == "flat"
+        assert "comp_slices" not in (rec or {})
+
+    def test_forced_slices_slice_the_auto_winner(self, monkeypatch):
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE", "table")
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_FORCE",
+                           "comp_slices=4")
+        winner, rec = resolve_schedule("cpu", 3 << 20, (2, 2, 2),
+                                       slices="auto")
+        assert winner == "ar(a0+a1+a2)[s0..3]"
+        assert rec["comp_slices"] == 4
+        assert rec["composition"] == winner
+        # an explicit integer pins without consulting the registry
+        winner2, rec2 = resolve_schedule("cpu", 3 << 20, (2, 2, 2),
+                                         slices=2)
+        assert winner2 == "ar(a0+a1+a2)[s0..1]"
+        # slices=None (the default) is the pre-ISSUE-15 behaviour
+        winner3, _ = resolve_schedule("cpu", 3 << 20, (2, 2, 2))
+        assert winner3 == "flat"
+
+    def test_sliced_auto_winner_runs_through_the_optimizer(
+        self, comm, monkeypatch
+    ):
+        """End to end: a forced comp_slices=2 'auto' optimizer reduces
+        a dyadic tree identically to the flat schedule — the sliced
+        winner compiles and runs through the standard update path."""
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE", "table")
+        monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_FORCE",
+                           "comp_slices=2")
+        opt = create_multi_node_optimizer(
+            optax.sgd(0.5), comm, reduction_schedule="auto"
+        )
+        params = {"w": jnp.asarray(
+            np.arange(N * 24).reshape(N, 24) % 8, jnp.float32) / 8.0}
+
+        def local(p):
+            sq = {"w": p["w"][0]}
+            sched = opt._effective_schedule(sq)
+            out = opt._reduce_scheduled(sq, sched)
+            return {"w": out["w"][None]}
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        f = jax.jit(shard_map(
+            local, mesh=comm.mesh,
+            in_specs=({"w": P(comm.grad_axes, None)},),
+            out_specs={"w": P(comm.grad_axes, None)},
+            check_vma=False,
+        ))
+        out = jax.device_get(f(params))
+        assert "[s0..1]" in opt._auto_resolved
+        assert opt._schedule_provenance["comp_slices"] == 2
+        ref = np.asarray(params["w"]).reshape(N, -1).mean(0)
+        np.testing.assert_array_equal(out["w"].reshape(N, -1)[0], ref)
+
+
+def test_sliced_wire_events_and_pack_degrade_note(comm):
+    """ISSUE 15: trace-time events of a SLICED in-jit schedule — one
+    wire event per stage per slice (slice/n_slices fields, per-slice
+    payloads summing to the unsliced stage bytes), and the pack event
+    carrying the requested slice count plus the LOUD min(S, elements)
+    degrade provenance when a bucket is smaller than S."""
+    from chainermn_tpu.testing import count_primitives
+
+    rec = trace.enable(None)
+    tree = {"w": jnp.zeros((64, 32)), "b": jnp.zeros((32,))}
+    env = [(comm.axis_name, N)]
+    sig = "rs(data)[s0..3]>ag(data)"
+    count_primitives(
+        lambda t: reduce_tree(t, schedule=sig, axes=comm.grad_axes,
+                              compress_dtype=jnp.bfloat16),
+        tree, axis_env=env,
+    )
+    wires = [e for e in rec.events if e["kind"] == "wire"]
+    assert len(wires) == 8  # 2 stages x 4 slices
+    assert all(w["composition"] == sig for w in wires)
+    assert all(w["n_slices"] == 4 and 0 <= w["slice"] < 4
+               for w in wires)
+    per_stage: dict = {}
+    for w in wires:
+        per_stage[w["stage"]] = per_stage.get(w["stage"], 0) + w["nbytes"]
+    total = (64 * 32 + 32) * 2  # the unsliced bucket on the bf16 wire
+    assert per_stage == {"rs(data)": total, "ag(data)": total}
+    pack = [e for e in rec.events if e["kind"] == "pack"][-1]
+    assert pack["comp_slices"] == 4
+    assert "comp_slices_degraded" not in pack  # 2080 elems >> 4
+
+    # degrade: a 3-element payload under S=4 → 3 slices, loud note
+    rec2 = trace.enable(None)
+    count_primitives(
+        lambda t: reduce_tree(t, schedule=sig, axes=comm.grad_axes),
+        {"b": jnp.zeros((3,))}, axis_env=env,
+    )
+    pack2 = [e for e in rec2.events if e["kind"] == "pack"][-1]
+    assert pack2["comp_slices"] == 4
+    assert pack2["comp_slices_degraded"] == {0: 3}
+    assert "min(S, elements)" in pack2["comp_slices_note"]
+    wires2 = [e for e in rec2.events if e["kind"] == "wire"]
+    assert len(wires2) == 6  # 2 stages x min(4, 3) slices
+    assert all(w["n_slices"] == 3 and w["nbytes"] > 0 for w in wires2)

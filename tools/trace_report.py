@@ -349,6 +349,21 @@ def render_text(s: dict) -> str:
                     f"    {st} [{srow.get('op')}]: n={srow['n']}, "
                     f"{_fmt_bytes(srow['nbytes'])}{dur}"
                 )
+                # ISSUE 15: the per-slice column — one sub-row per
+                # bucket slice with its measured dur beside the layout
+                # bytes (unsliced stages carry no 'slices' table).
+                for s_key, sl in sorted(
+                    srow.get("slices", {}).items(),
+                    key=lambda kv: int(kv[0][1:]),
+                ):
+                    sdur = (f", {sl['dur_ms']:.3f} ms"
+                            if sl.get("dur_ms") is not None else "")
+                    sblk = (f" ({sl['blocked_ms']:.3f} ms blocked)"
+                            if sl.get("blocked_ms") is not None else "")
+                    lines.append(
+                        f"      {s_key}: n={sl['n']}, "
+                        f"{_fmt_bytes(sl['nbytes'])}{sdur}{sblk}"
+                    )
         m = ov.get("measured")
         if m:
             lines.append(
