@@ -489,6 +489,49 @@ def staged_allgather(shard: jax.Array, axes, orig_size: int) -> jax.Array:
     return rows.reshape(-1)[:orig_size]
 
 
+def staged_broadcast(
+    x: jax.Array, axes, *, radix: int = 2, root: int = 0
+) -> jax.Array:
+    """One composition stage (ISSUE 16): multicast-tree broadcast of
+    the ``root`` member's buffer over the MERGED axis group — every
+    member returns the root's ``x``. The tree is ``ceil(log_radix(n))``
+    ``ppermute`` rounds of holder-doubling: non-holders carry zeros, so
+    each round's ``cur + ppermute(cur)`` either delivers the payload or
+    adds zero, and round d multiplies the holder set by ``radix``
+    (holder s sends to ``s + j*holders`` for ``j in 1..radix-1``). The
+    HLO carries exactly ``tree_depth(n, radix)`` collective-permutes —
+    the count :func:`chainermn_tpu.parallel.composition
+    .predicted_collectives` pins and the serving tree push's donor
+    depth mirrors (multicast-tree collectives, arXiv:2605.22428)."""
+    names = _names_tuple(axes)
+    n = axes_size(names)
+    r = int(radix)
+    if r < 2:
+        raise ValueError(f"multicast radix must be >= 2, got {radix}")
+    if n == 1:
+        return x
+    idx = axes_index(names)
+    rk = int(root) % n
+    # Relabel so the root is position 0 in tree coordinates.
+    pos = lambda s: (s + rk) % n  # noqa: E731 — tree coord -> rank
+    cur = jnp.where(idx == rk, x, jnp.zeros_like(x))
+    arg = _merged_axes_arg(names)
+    holders = 1
+    while holders < n:
+        # ppermute sources must be unique, so a radix-r round is r-1
+        # ppermutes (sub-send j: holder s -> s + j*holders); the
+        # destination sets are disjoint and sources never receive, so
+        # sequential accumulation within a round is exact. Op count =
+        # composition.tree_sends (the structural pin).
+        for j in range(1, r):
+            perm = [(pos(s), pos(s + j * holders))
+                    for s in range(holders) if s + j * holders < n]
+            if perm:
+                cur = cur + lax.ppermute(cur, arg, perm)
+        holders = min(n, holders * r)
+    return cur
+
+
 def int8_two_level_allreduce_mean_with_feedback(
     x: jax.Array, residual: jax.Array, intra_axis: str, inter_axis: str
 ):

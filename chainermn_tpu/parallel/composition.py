@@ -79,16 +79,63 @@ PyTree = Any
 
 #: Stage primitives. ``sharded_update`` is the ZeRO fuse point: the
 #: caller's update function runs on the fully-reduced 1/n shard.
-PRIMITIVES = ("reduce_scatter", "allreduce", "allgather", "sharded_update")
+#: ``broadcast`` (ISSUE 16) is the one-to-many multicast-tree stage:
+#: the merged group's root fans its buffer out over a radix-r tree of
+#: ``ppermute`` rounds — the device-mesh rendering of the serving
+#: plane's tree push (multicast-tree collectives, arXiv:2605.22428).
+PRIMITIVES = ("reduce_scatter", "allreduce", "allgather", "sharded_update",
+              "broadcast")
 
 _SHORT = {"reduce_scatter": "rs", "allreduce": "ar", "allgather": "ag",
-          "sharded_update": "su"}
+          "sharded_update": "su", "broadcast": "bc"}
 _LONG = {v: k for k, v in _SHORT.items()}
 
 #: HLO op a stage lowers to (the vocabulary of the structural tests;
-#: ``sharded_update`` owes the wire nothing).
+#: ``sharded_update`` owes the wire nothing). A ``broadcast`` stage
+#: lowers to ``tree_sends(n, radix)`` collective-permutes, not one op —
+#: :func:`predicted_collectives` multiplies the sub-sends in.
 STAGE_HLO = {"reduce_scatter": "reduce-scatter", "allreduce": "all-reduce",
-             "allgather": "all-gather"}
+             "allgather": "all-gather", "broadcast": "collective-permute"}
+
+#: Default multicast-tree radix (binary tree: doubling rounds).
+DEFAULT_RADIX = 2
+
+
+def tree_depth(n: int, radix: int = DEFAULT_RADIX) -> int:
+    """Rounds a radix-``radix`` multicast tree needs to cover ``n``
+    members from one root: ``ceil(log_radix(n))``, computed by the same
+    holder-doubling walk the executor runs so the two can never
+    disagree. The HLO collective-permute count of a ``bc`` stage, the
+    donor-send depth of the serving tree push."""
+    n, r = int(n), int(radix)
+    if r < 2:
+        raise CompositionError(f"multicast radix must be >= 2, got {radix}")
+    d, holders = 0, 1
+    while holders < n:
+        holders *= r
+        d += 1
+    return d
+
+
+def tree_sends(n: int, radix: int = DEFAULT_RADIX) -> int:
+    """``ppermute`` ops a radix-``radix`` multicast over ``n`` members
+    lowers to. A ppermute's sources must be unique, so each holder-
+    doubling round decomposes into up to ``radix - 1`` sub-sends
+    (holder ``s`` -> ``s + j*holders``, one ppermute per ``j``) — at
+    radix 2 this equals :func:`tree_depth`; a larger radix trades
+    rounds for per-round sends (``(r-1)*ceil(log_r(n))`` at full
+    occupancy). The per-stage HLO collective-permute count
+    :func:`predicted_collectives` pins."""
+    n, r = int(n), int(radix)
+    if r < 2:
+        raise CompositionError(f"multicast radix must be >= 2, got {radix}")
+    sends, holders = 0, 1
+    while holders < n:
+        for j in range(1, r):
+            if j * holders < n:  # sub-send j has at least sender s=0
+                sends += 1
+        holders *= r
+    return sends
 
 
 class CompositionError(ValueError):
@@ -106,17 +153,26 @@ class Stage:
     = the whole bucket (the pre-slicing spelling, unchanged). Slice-
     annotated stages appear in the EXPANDED rendering of a sliced
     composition (:func:`expand_slices`); the compact spelling keeps the
-    slice count on the :class:`Composition` instead."""
+    slice count on the :class:`Composition` instead.
+
+    ``radix`` (ISSUE 16) is the multicast-tree fan-out of a
+    ``broadcast`` stage (``None`` = :data:`DEFAULT_RADIX`); printed
+    only when non-default (``bc(a0+a1)@4``). Reduction stages carry no
+    radix — the validator refuses one."""
 
     primitive: str
     axes: tuple[str, ...] = ()
     slice: Optional[tuple[int, int]] = None
+    radix: Optional[int] = None
 
     def signature(self) -> str:
         tag = f"[s{self.slice[0]}:{self.slice[1]}]" if self.slice else ""
         if self.primitive == "sharded_update":
             return f"su{tag}"
-        return f"{_SHORT[self.primitive]}({'+'.join(self.axes)}){tag}"
+        rad = (f"@{self.radix}"
+               if self.radix is not None and self.radix != DEFAULT_RADIX
+               else "")
+        return f"{_SHORT[self.primitive]}({'+'.join(self.axes)}){rad}{tag}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -129,15 +185,27 @@ class Composition:
     each bucket into (1 = the whole-bucket rendering, unchanged).
     Spelled by annotating the FIRST stage with the slice range:
     ``rs(a2)[s0..3]>ar(a0+a1)>ag(a2)`` is the two_level pipeline over
-    four bucket slices."""
+    four bucket slices.
+
+    ``slice_layout`` (ISSUE 16 satellite): how the bucket is cut —
+    ``'contiguous'`` (ISSUE 15's balanced runs) or ``'zigzag'``
+    (strided: slice i takes elements ``i, i+S, i+2S, ...``, so every
+    slice samples the whole bucket uniformly and the gather tails stay
+    interleave-balanced at extreme S). Spelled with a ``z`` range tag:
+    ``rs(a2)[z0..3]>ar(a0+a1)>ag(a2)``. Per-slice element counts are
+    identical to contiguous (first ``n % S`` slices one longer), so
+    wire layout and HLO counts do not move — only the cut/reassembly
+    indexing does, and both layouts are bitwise-equal reductions."""
 
     stages: tuple[Stage, ...]
     slices: int = 1
+    slice_layout: str = "contiguous"
 
     def signature(self) -> str:
         sigs = [s.signature() for s in self.stages]
         if self.slices > 1 and sigs:
-            sigs[0] = f"{sigs[0]}[s0..{self.slices - 1}]"
+            letter = "z" if self.slice_layout == "zigzag" else "s"
+            sigs[0] = f"{sigs[0]}[{letter}0..{self.slices - 1}]"
         return ">".join(sigs)
 
     @property
@@ -162,8 +230,8 @@ class Composition:
 
 
 _STAGE_RE = re.compile(
-    r"^(rs|ar|ag|su)(?:\(([^()]*)\))?"
-    r"(?:\[s(\d+)(?:\.\.(\d+)|:(\d+))?\])?$"
+    r"^(rs|ar|ag|su|bc)(?:\(([^()]*)\))?(?:@(\d+))?"
+    r"(?:\[([sz])(\d+)(?:\.\.(\d+)|:(\d+))?\])?$"
 )
 
 
@@ -174,21 +242,38 @@ def parse_signature(sig: str) -> Composition:
     a range ``rs(a2)[s0..3]>...`` marks the whole COMPOSITION sliced
     (S = range length, must start at s0; annotations on several stages
     must agree), and ``rs(a2)[s1:4]`` addresses one expanded stage at
-    slice 1 of 4."""
+    slice 1 of 4. A ``z`` range (``rs(a2)[z0..3]``, ISSUE 16) selects
+    the zigzag slice layout — composition-level only, expanded stages
+    always address contiguous slices. ``bc(a0+a1)@4`` (ISSUE 16) is a
+    radix-4 multicast-tree broadcast stage (``@2`` is the default and
+    never printed)."""
     stages = []
     slices: Optional[int] = None
+    layout: Optional[str] = None
     for part in str(sig).split(">"):
         m = _STAGE_RE.match(part.strip())
         if not m:
             raise CompositionError(
                 f"unparseable composition stage {part!r} in {sig!r} "
                 "(expected e.g. 'rs(intra)', 'ar(a0+a1)', 'su', "
-                "'rs(a2)[s0..3]', 'rs(a2)[s1:4]')"
+                "'bc(a0)@4', 'rs(a2)[s0..3]', 'rs(a2)[z0..3]', "
+                "'rs(a2)[s1:4]')"
             )
-        short, axes, s_lo, s_hi, s_tot = m.groups()
+        short, axes, radix, letter, s_lo, s_hi, s_tot = m.groups()
+        if radix is not None and short != "bc":
+            raise CompositionError(
+                f"stage {part!r}: only broadcast (bc) stages carry a "
+                "multicast radix"
+            )
         stage_slice: Optional[tuple[int, int]] = None
         if s_lo is not None:
             if s_tot is not None:  # [sI:S] — one expanded stage
+                if letter == "z":
+                    raise CompositionError(
+                        f"stage {part!r}: zigzag is a composition-level "
+                        "slice layout — expanded stages address slices "
+                        "with [sI:S]"
+                    )
                 idx, tot = int(s_lo), int(s_tot)
                 if not 0 <= idx < tot:
                     raise CompositionError(
@@ -196,13 +281,13 @@ def parse_signature(sig: str) -> Composition:
                         "of range"
                     )
                 stage_slice = (idx, tot)
-            else:  # [s0..N] (or degenerate [s0]) — the composition
+            else:  # [s0..N] / [z0..N] (or degenerate) — the composition
                 lo = int(s_lo)
                 hi = int(s_hi) if s_hi is not None else lo
                 if lo != 0 or hi < lo:
                     raise CompositionError(
-                        f"composition slice range [s{lo}..{hi}] in "
-                        f"{part!r} must start at s0"
+                        f"composition slice range [{letter}{lo}..{hi}] in "
+                        f"{part!r} must start at {letter}0"
                     )
                 n = hi + 1
                 if slices is not None and slices != n:
@@ -210,7 +295,14 @@ def parse_signature(sig: str) -> Composition:
                         f"conflicting slice counts in {sig!r}: "
                         f"{slices} vs {n}"
                     )
+                this_layout = "zigzag" if letter == "z" else "contiguous"
+                if layout is not None and layout != this_layout:
+                    raise CompositionError(
+                        f"conflicting slice layouts in {sig!r}: "
+                        f"{layout} vs {this_layout}"
+                    )
                 slices = n
+                layout = this_layout
         if short == "su":
             if axes:
                 raise CompositionError(
@@ -219,8 +311,15 @@ def parse_signature(sig: str) -> Composition:
             stages.append(Stage("sharded_update", slice=stage_slice))
         else:
             names = tuple(a for a in (axes or "").split("+") if a)
-            stages.append(Stage(_LONG[short], names, slice=stage_slice))
-    return Composition(tuple(stages), slices=slices or 1)
+            # an explicit @2 normalizes to the default-radix spelling
+            # (signatures stay canonical: parse(sig).signature() == sig)
+            r = int(radix) if radix is not None else None
+            stages.append(Stage(
+                _LONG[short], names, slice=stage_slice,
+                radix=(r if r != DEFAULT_RADIX else None),
+            ))
+    return Composition(tuple(stages), slices=slices or 1,
+                       slice_layout=layout or "contiguous")
 
 
 def canonical_axis_names(k: int) -> tuple[str, ...]:
@@ -247,10 +346,10 @@ def bind_composition(comp: Composition, axes: Sequence[str]) -> Composition:
             f"{names} nor canonical positional tokens {canon}"
         )
     table = dict(zip(canon, names))
-    return Composition(tuple(
-        Stage(s.primitive, tuple(table[a] for a in s.axes), slice=s.slice)
+    return dataclasses.replace(comp, stages=tuple(
+        dataclasses.replace(s, axes=tuple(table[a] for a in s.axes))
         for s in comp.stages
-    ), slices=comp.slices)
+    ))
 
 
 # ---------------------------------------------------------------------------
@@ -291,21 +390,29 @@ def slice_bounds(n_elems: int, n_slices: int) -> list[tuple[int, int]]:
     return out
 
 
-def sliced_composition(comp: Composition, slices: int) -> Composition:
+def sliced_composition(comp: Composition, slices: int,
+                       layout: str = "contiguous") -> Composition:
     """``comp`` re-rendered over ``slices`` bucket slices (the compact
     form — :func:`expand_slices` produces the per-slice stage list).
     Refuses a ``sharded_update`` pipeline: the ZeRO fuse point runs the
-    inner optimizer ONCE on the whole chunk tree and cannot slice."""
+    inner optimizer ONCE on the whole chunk tree and cannot slice.
+    ``layout`` (ISSUE 16 satellite) picks the cut: ``'contiguous'``
+    runs or the ``'zigzag'`` stride (see :class:`Composition`)."""
     s = int(slices)
     if s < 1:
         raise CompositionError(f"slices must be >= 1, got {slices}")
+    if layout not in ("contiguous", "zigzag"):
+        raise CompositionError(
+            f"slice layout must be 'contiguous' or 'zigzag', got "
+            f"{layout!r}"
+        )
     if s > 1 and comp.has_update:
         raise CompositionError(
             f"{comp.signature()!r}: a sharded_update pipeline cannot be "
             "sliced — the fuse point runs the inner optimizer once on "
             "the whole chunk tree"
         )
-    return dataclasses.replace(comp, slices=s)
+    return dataclasses.replace(comp, slices=s, slice_layout=layout)
 
 
 def compact_slices(comp: Composition) -> Composition:
@@ -428,6 +535,11 @@ def validate_composition(
             f"{comp.signature()!r}: slices must be an integer >= 1, "
             f"got {comp.slices!r}"
         )
+    if comp.slice_layout not in ("contiguous", "zigzag"):
+        raise CompositionError(
+            f"{comp.signature()!r}: slice layout must be 'contiguous' "
+            f"or 'zigzag', got {comp.slice_layout!r}"
+        )
     sliced = [s for s in comp.stages if s.slice is not None]
     if comp.has_update and (comp.slices > 1 or sliced):
         raise CompositionError(
@@ -472,7 +584,7 @@ def validate_composition(
             )
         for i in range(total):
             try:
-                _validate_stage_walk(
+                _validate_walk(
                     Composition(tuple(per_slice[i])), mesh
                 )
             except CompositionError as e:
@@ -480,7 +592,73 @@ def validate_composition(
                     f"slice s{i}:{total}: {e}"
                 ) from None
         return comp
+    _validate_walk(comp, mesh)
+    return comp
+
+
+def _validate_walk(comp: Composition, mesh: tuple) -> Composition:
+    """Route one pipeline's stage list to its family walk: a pipeline
+    with any ``broadcast`` stage is the BROADCAST FAMILY (all stages
+    bc — :func:`_validate_broadcast_walk`), everything else is the
+    reduction family (:func:`_validate_stage_walk`). The two families
+    never mix in one pipeline: a broadcast inside a reduction would
+    overwrite partially-reduced shards with the root's, and a
+    reduction inside a broadcast has nothing summed to reduce."""
+    if any(s.primitive == "broadcast" for s in comp.stages):
+        return _validate_broadcast_walk(comp, mesh)
     return _validate_stage_walk(comp, mesh)
+
+
+def _validate_broadcast_walk(comp: Composition, mesh: tuple) -> Composition:
+    """The broadcast-family walk (ISSUE 16): every stage is ``bc``,
+    every mesh axis is broadcast EXACTLY ONCE (a missed axis leaves
+    stale replicas, a doubled axis re-sends bytes the first tree
+    already delivered), radix >= 2, no ``sharded_update`` (nothing is
+    reduced, so there is no fully-reduced shard to fuse at)."""
+    covered: list[str] = []
+    for st in comp.stages:
+        if st.primitive != "broadcast":
+            raise CompositionError(
+                f"{comp.signature()!r}: {st.signature()} mixed into a "
+                "broadcast pipeline — bc stages never compose with "
+                "reduction stages (the tree would overwrite partial "
+                "sums with the root's buffer)"
+            )
+        if not st.axes:
+            raise CompositionError(
+                f"{comp.signature()!r}: broadcast stage with an empty "
+                "axis group — every tree names the axes it fans over"
+            )
+        if len(set(st.axes)) != len(st.axes):
+            raise CompositionError(
+                f"{comp.signature()!r}: duplicate axis within stage "
+                f"{st.signature()!r}"
+            )
+        for a in st.axes:
+            if a not in mesh:
+                raise CompositionError(
+                    f"{comp.signature()!r}: axis {a!r} is not on the "
+                    f"mesh {mesh}"
+                )
+            if a in covered:
+                raise CompositionError(
+                    f"{comp.signature()!r}: axis {a!r} broadcast more "
+                    "than once — the second tree re-sends bytes the "
+                    "first already delivered"
+                )
+        if st.radix is not None and st.radix < 2:
+            raise CompositionError(
+                f"{comp.signature()!r}: multicast radix must be >= 2, "
+                f"got {st.radix}"
+            )
+        covered.extend(st.axes)
+    missing = [a for a in mesh if a not in covered]
+    if missing:
+        raise CompositionError(
+            f"{comp.signature()!r}: axes {tuple(missing)} never "
+            "broadcast — those mesh levels would keep stale replicas"
+        )
+    return comp
 
 
 def _validate_stage_walk(comp: Composition, mesh: tuple) -> Composition:
@@ -496,6 +674,12 @@ def _validate_stage_walk(comp: Composition, mesh: tuple) -> Composition:
             raise CompositionError(
                 f"unknown primitive {st.primitive!r} (stages compose "
                 f"{PRIMITIVES})"
+            )
+        if st.radix is not None:
+            raise CompositionError(
+                f"{comp.signature()!r}: stage {st.signature()!r} carries "
+                "a multicast radix — only broadcast (bc) stages fan "
+                "over a tree"
             )
         if st.primitive == "sharded_update":
             if update_seen:
@@ -582,20 +766,44 @@ def _validate_stage_walk(comp: Composition, mesh: tuple) -> Composition:
 
 
 def predicted_collectives(
-    comp: Composition, size: Optional[int] = None
+    comp: Composition, size: Optional[int] = None,
+    axis_sizes: Optional[Mapping[str, int]] = None,
 ) -> dict[str, int]:
     """HLO collective counts the compiled program must carry — one op
     per stage PER SLICE (``tests/test_composition.py`` compiles and
     compares): a sliced composition carries exactly S× the per-stage
     count at 1/S payload each. ``size`` (bucket element count) applies
     the :func:`effective_slices` degrade; without it the requested
-    slice count is assumed achievable."""
+    slice count is assumed achievable.
+
+    A ``broadcast`` stage (ISSUE 16) lowers to ``tree_sends(n, radix)``
+    collective-permutes, not one op, so its count needs the merged
+    group size — pass ``axis_sizes`` (axis name -> size) for any
+    composition carrying a bc stage; the ``"collective-permute"`` key
+    appears ONLY then (reduction-only counts keep the exact three-key
+    dict the structural tests compare against)."""
     s_eff = (effective_slices(comp.slices, size) if size is not None
              else comp.slices)
     out = {"reduce-scatter": 0, "all-reduce": 0, "all-gather": 0}
+    if any(st.primitive == "broadcast" for st in comp.stages):
+        out["collective-permute"] = 0
     for st in comp.stages:
         hlo = STAGE_HLO.get(st.primitive)
-        if hlo is not None:
+        if hlo is None:
+            continue
+        if st.primitive == "broadcast":
+            if axis_sizes is None:
+                raise CompositionError(
+                    f"predicted_collectives: broadcast stage "
+                    f"{st.signature()!r} lowers to tree_sends(n, radix) "
+                    "collective-permutes — pass axis_sizes to size the "
+                    "merged group"
+                )
+            n = 1
+            for a in st.axes:
+                n *= int(axis_sizes[a])
+            out[hlo] += tree_sends(n, st.radix or DEFAULT_RADIX) * s_eff
+        else:
             out[hlo] += s_eff
     return out
 
@@ -693,6 +901,23 @@ def zero_composition(mesh_axes: Sequence[str]) -> Composition:
     stages.append(Stage("sharded_update"))
     stages.append(Stage("allgather", fast))
     return Composition(tuple(stages))
+
+
+def broadcast_composition(
+    mesh_axes: Sequence[str], radix: int = DEFAULT_RADIX
+) -> Composition:
+    """One multicast tree over the merged mesh axes (ISSUE 16): the
+    root of the flattened group fans its buffer out in
+    ``tree_depth(n, radix)`` ppermute rounds — the device-mesh
+    rendering of the serving plane's one-to-many tree push. Spelled
+    ``bc(a0+a1+a2)`` (``@r`` when the radix is non-default)."""
+    r = int(radix)
+    if r < 2:
+        raise CompositionError(f"multicast radix must be >= 2, got {radix}")
+    return Composition((Stage(
+        "broadcast", tuple(mesh_axes),
+        radix=(r if r != DEFAULT_RADIX else None),
+    ),))
 
 
 def compile_schedule(schedule, mesh_axes: Sequence[str]) -> Composition:
@@ -806,7 +1031,7 @@ def _replay_sizes(stages: Sequence[Stage], size: int, axis_sizes):
             axes, orig = stack.pop()
             rows.append((st, cur, orig))
             cur = orig
-        else:  # allreduce / sharded_update: size unchanged
+        else:  # allreduce / sharded_update / broadcast: size unchanged
             rows.append((st, cur, cur))
     return rows, cur, stack
 
@@ -838,8 +1063,13 @@ def stage_wire_layout(
             if hlo is None:
                 continue
             nbytes = max(size_in, size_out) * itemsize
-            out.append(
-                {"stage": st.signature(), "op": hlo, "nbytes": nbytes})
+            row = {"stage": st.signature(), "op": hlo, "nbytes": nbytes}
+            if st.primitive == "broadcast":
+                n = 1
+                for a in st.axes:
+                    n *= int(axis_sizes[a])
+                row["rounds"] = tree_depth(n, st.radix or DEFAULT_RADIX)
+            out.append(row)
         return out
     bounds = slice_bounds(size, s_eff)
     # per-slice stage rows, keyed back to the BASE stage signature (the
@@ -859,11 +1089,17 @@ def stage_wire_layout(
         if hlo is None:
             continue
         _, size_in, size_out = per_slice_rows[i][(base.signature(), j)]
-        out.append({
+        row = {
             "stage": base.signature(), "op": hlo,
             "nbytes": max(size_in, size_out) * itemsize,
             "slice": i, "n_slices": s_eff,
-        })
+        }
+        if st.primitive == "broadcast":
+            n = 1
+            for a in st.axes:
+                n *= int(axis_sizes[a])
+            row["rounds"] = tree_depth(n, st.radix or DEFAULT_RADIX)
+        out.append(row)
     return out
 
 
@@ -907,6 +1143,7 @@ def reduce_composed(
     from chainermn_tpu.parallel.collectives import (
         staged_allgather,
         staged_allreduce,
+        staged_broadcast,
         staged_reduce_scatter,
     )
 
@@ -926,6 +1163,10 @@ def reduce_composed(
     n_tot = 1
     for a in reduce_axes:
         n_tot *= lax.axis_size(a)
+    # A broadcast-family pipeline reduces nothing: start the mean guard
+    # already tripped so it never divides (n_tot is 1 anyway, but the
+    # guard documents the invariant instead of relying on /1).
+    rem_init = len(reduce_axes) if reduce_axes else -1
 
     s_eff = effective_slices(comp.slices, x.size)
     if s_eff > 1:
@@ -934,14 +1175,21 @@ def reduce_composed(
                 f"{comp.signature()!r}: sliced execution with a "
                 "sharded_update stage — the fuse point is unsliceable"
             )
+        zigzag = comp.slice_layout == "zigzag"
         flat = x.reshape(-1)
         bounds = slice_bounds(flat.size, s_eff)
         # Per-slice pipeline state, stepped in the skewed interleave
         # order — each slice owns its scatter frame and divides once
-        # when ITS reduction completes.
-        cur_s = [flat[lo:hi] for lo, hi in bounds]
+        # when ITS reduction completes. The zigzag layout (ISSUE 16)
+        # strides the cut — slice i = elements i, i+S, i+2S, ... — with
+        # per-slice element counts identical to the contiguous bounds,
+        # so only the indexing differs, never the wire.
+        if zigzag:
+            cur_s = [flat[i::s_eff] for i in range(s_eff)]
+        else:
+            cur_s = [flat[lo:hi] for lo, hi in bounds]
         stack_s: list[list[int]] = [[] for _ in range(s_eff)]
-        rem_s = [len(reduce_axes)] * s_eff
+        rem_s = [rem_init] * s_eff
         for st in expand_slices(comp, flat.size):
             i, _ = st.slice
             if st.primitive == "reduce_scatter":
@@ -951,6 +1199,9 @@ def reduce_composed(
             elif st.primitive == "allreduce":
                 cur_s[i] = staged_allreduce(cur_s[i], st.axes)
                 rem_s[i] -= len(st.axes)
+            elif st.primitive == "broadcast":
+                cur_s[i] = staged_broadcast(
+                    cur_s[i], st.axes, radix=st.radix or DEFAULT_RADIX)
             else:  # allgather
                 cur_s[i] = staged_allgather(
                     cur_s[i], st.axes, stack_s[i].pop())
@@ -959,6 +1210,11 @@ def reduce_composed(
                 rem_s[i] = -1  # divide exactly once per slice
         import jax.numpy as jnp
 
+        if zigzag:
+            out = jnp.zeros(flat.shape, cur_s[0].dtype)
+            for i in range(s_eff):
+                out = out.at[i::s_eff].set(cur_s[i])
+            return out.reshape(x.shape)
         return jnp.concatenate(cur_s).reshape(x.shape)
 
     # flat short-circuit: one fused pmean, the pre-composition program.
@@ -968,7 +1224,7 @@ def reduce_composed(
     shape = x.shape
     cur = x.reshape(-1)
     stack: list[int] = []  # original sizes, LIFO with the scatters
-    remaining = len(reduce_axes)
+    remaining = rem_init
     for st in stages:
         if st.primitive == "reduce_scatter":
             stack.append(cur.size)
@@ -979,6 +1235,9 @@ def reduce_composed(
             remaining -= len(st.axes)
         elif st.primitive == "allgather":
             cur = staged_allgather(cur, st.axes, stack.pop())
+        elif st.primitive == "broadcast":
+            cur = staged_broadcast(
+                cur, st.axes, radix=st.radix or DEFAULT_RADIX)
         else:  # sharded_update
             cur = update_fn(cur)
         if remaining == 0 and op == "mean":
@@ -1081,10 +1340,12 @@ def reduce_composed_tree(leaves: list, comp: Composition, *, op="mean"):
 __all__ = [
     "Composition",
     "CompositionError",
+    "DEFAULT_RADIX",
     "PRIMITIVES",
     "STAGE_HLO",
     "Stage",
     "bind_composition",
+    "broadcast_composition",
     "canonical_axis_names",
     "compact_slices",
     "compile_schedule",
@@ -1104,6 +1365,8 @@ __all__ = [
     "slice_bounds",
     "sliced_composition",
     "stage_wire_layout",
+    "tree_depth",
+    "tree_sends",
     "two_level_composition",
     "validate_composition",
     "zero_composition",

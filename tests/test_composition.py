@@ -807,3 +807,183 @@ class TestSlicedComposition:
         )
         assert plan.describe()["grad_reduction"] == \
             "rs(zero)[s0..1]>rs(data)>ag(data)>ag(zero)"
+
+
+# ----------------------------------------------------------------------
+# ISSUE 16: broadcast/multicast tree stages + zigzag slice layout
+# ----------------------------------------------------------------------
+
+
+def _bc_counts_and_out(comm, comp, x):
+    """Compile a broadcast composition through the one executor and
+    return (HLO collective counts incl. collective-permute, output)."""
+    axes = comm.grad_axes
+
+    def local(v):
+        return reduce_composed(v, comp, op="sum")
+
+    f = jax.jit(shard_map(local, mesh=comm.mesh, in_specs=P(axes),
+                          out_specs=P(axes)))
+    txt = f.lower(x).compile().as_text()
+    import re as _re
+
+    counts = {
+        "reduce-scatter": txt.count("reduce-scatter("),
+        "all-reduce": txt.count("all-reduce("),
+        "all-gather": txt.count("all-gather("),
+        "collective-permute": len(
+            _re.findall(r"collective-permute(?:-start)?\(", txt)),
+    }
+    return counts, jax.device_get(f(x))
+
+
+class TestBroadcastStages:
+    """The bc multicast-tree stage family: grammar, validator family
+    separation, tree_depth/tree_sends arithmetic, and the structural
+    pin — a bc composition's compiled HLO carries exactly
+    tree_sends(n, radix) collective-permutes per stage and delivers
+    the root's buffer to every member."""
+
+    def test_signature_roundtrip_and_radix_spelling(self):
+        from chainermn_tpu.parallel.composition import (
+            broadcast_composition,
+        )
+
+        comp = parse_signature("bc(a0+a1)@4>bc(a2)")
+        assert comp.signature() == "bc(a0+a1)@4>bc(a2)"
+        assert parse_signature(comp.signature()) == comp
+        validate_composition(comp, AXES3)
+        # default radix (@2) is never printed
+        one = broadcast_composition(AXES3)
+        assert one.signature() == "bc(a0+a1+a2)"
+        assert parse_signature("bc(a0+a1+a2)@2") == one
+        # compile_schedule front door accepts the spelling
+        assert compile_schedule("bc(a0+a1)@4>bc(a2)", AXES3) == comp
+
+    def test_tree_depth_and_sends(self):
+        from chainermn_tpu.parallel.composition import (
+            tree_depth,
+            tree_sends,
+        )
+
+        assert tree_depth(8, 2) == 3 and tree_sends(8, 2) == 3
+        assert tree_depth(8, 4) == 2 and tree_sends(8, 4) == 4
+        assert tree_depth(4, 4) == 1 and tree_sends(4, 4) == 3
+        assert tree_depth(1, 2) == 0 and tree_sends(1, 2) == 0
+        with pytest.raises(CompositionError, match="radix must be >= 2"):
+            tree_depth(8, 1)
+
+    def test_validator_family_separation(self):
+        # bc mixed into a reduction pipeline
+        with pytest.raises(CompositionError, match="never compose"):
+            validate_composition(
+                parse_signature("bc(a0)>ar(a1+a2)"), AXES3)
+        # missing axis in a broadcast family
+        with pytest.raises(CompositionError, match="never broadcast"):
+            validate_composition(parse_signature("bc(a0+a1)"), AXES3)
+        # doubled axis across stages
+        with pytest.raises(CompositionError, match="more than once"):
+            validate_composition(
+                parse_signature("bc(a0+a1+a2)>bc(a0)"), AXES3)
+        # radix on a reduction stage: refused at parse AND validate
+        with pytest.raises(CompositionError, match="radix"):
+            parse_signature("rs(a2)@4>ar(a0+a1)>ag(a2)")
+        with pytest.raises(CompositionError, match="radix"):
+            validate_composition(Composition((
+                Stage("reduce_scatter", ("a2",), radix=4),
+                Stage("allreduce", ("a0", "a1")),
+                Stage("allgather", ("a2",)),
+            )), AXES3)
+
+    def test_predicted_collectives_contract(self):
+        sizes = {"a0": 2, "a1": 2, "a2": 2}
+        comp = parse_signature("bc(a0+a1+a2)")
+        pred = predicted_collectives(comp, axis_sizes=sizes)
+        assert pred == {"reduce-scatter": 0, "all-reduce": 0,
+                        "all-gather": 0, "collective-permute": 3}
+        # a bc composition without axis_sizes degrades loudly
+        with pytest.raises(CompositionError, match="axis_sizes"):
+            predicted_collectives(comp)
+        # reduction-only counts keep the exact three-key dict
+        assert set(predicted_collectives(
+            parse_signature("ar(a0+a1+a2)"), axis_sizes=sizes)) == {
+                "reduce-scatter", "all-reduce", "all-gather"}
+
+    @pytest.mark.parametrize("sig,cp", [
+        ("bc(a0+a1+a2)", 3),       # radix 2: ceil(log2 8) rounds
+        ("bc(a0+a1+a2)@4", 4),     # radix 4: 2 rounds x up to 3 sends
+        ("bc(a0+a1)@4>bc(a2)", 4),  # 3 sends over n=4 + 1 over n=2
+    ])
+    def test_hlo_counts_and_root_delivery(self, comm3, sig, cp):
+        comp = compile_schedule(sig, comm3.grad_axes)
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(64), jnp.float32)
+        counts, out = _bc_counts_and_out(comm3, comp, x)
+        sizes = {a: 2 for a in comm3.grad_axes}
+        assert counts == predicted_collectives(comp, axis_sizes=sizes), (
+            sig, counts)
+        assert counts["collective-permute"] == cp, (sig, counts)
+        # every member returns the root shard's buffer
+        np.testing.assert_array_equal(out, np.tile(np.asarray(x[:8]), 8))
+
+
+class TestZigzagLayout:
+    """ISSUE 16 satellite: the zigzag (strided) slice layout — same
+    per-slice element counts as contiguous, so wire layout and HLO
+    counts do not move; only the cut/reassembly indexing does, and
+    both layouts reduce bitwise-equal."""
+
+    def test_signature_roundtrip_and_rejections(self):
+        from chainermn_tpu.parallel.composition import sliced_composition
+
+        comp = sliced_composition(
+            two_level_composition(AXES3), 4, layout="zigzag")
+        assert comp.signature() == "rs(a2)[z0..3]>ar(a0+a1)>ag(a2)"
+        assert parse_signature(comp.signature()) == comp
+        validate_composition(comp, AXES3)
+        with pytest.raises(CompositionError, match="composition-level"):
+            parse_signature("rs(a2)[z1:4]>ar(a0+a1)>ag(a2)")
+        with pytest.raises(CompositionError, match="layout"):
+            sliced_composition(two_level_composition(AXES3), 4,
+                               layout="diagonal")
+        with pytest.raises(CompositionError, match="layout"):
+            validate_composition(
+                Composition(two_level_composition(AXES3).stages,
+                            slices=2, slice_layout="diagonal"),
+                AXES3)
+
+    def test_wire_layout_identical_to_contiguous(self):
+        from chainermn_tpu.parallel.composition import sliced_composition
+
+        sizes = {"a0": 2, "a1": 2, "a2": 2}
+        base = two_level_composition(AXES3)
+        for n_elems in (128, 103):  # divisible and ragged
+            cont = stage_wire_layout(
+                sliced_composition(base, 4), sizes, 4, n_elems)
+            zig = stage_wire_layout(
+                sliced_composition(base, 4, layout="zigzag"),
+                sizes, 4, n_elems)
+            assert cont == zig, n_elems
+
+    @pytest.mark.parametrize("k", [2, 3])
+    def test_bitwise_vs_contiguous_and_flat(self, k):
+        shape, names = MESHES[k]
+        comm = _comm(shape, names)
+        from chainermn_tpu.parallel.composition import sliced_composition
+
+        rs = np.random.RandomState(k + 60)
+        # ragged size: the gather tails are where the layouts differ
+        tree = _dyadic_tree(rs, {"w": (N, 13, 5), "b": (N, 9)})
+        _, ref = _reduce_counts_and_out(comm, "flat", tree)
+        base = two_level_composition(names)
+        for S in (2, 4):
+            zig = sliced_composition(base, S, layout="zigzag")
+            counts, out = _reduce_counts_and_out(
+                comm, zig.signature(), tree)
+            assert counts == predicted_collectives(zig, size=9), (
+                zig.signature(), counts)
+            for key in tree:
+                np.testing.assert_array_equal(
+                    out[key], ref[key],
+                    err_msg=f"{zig.signature()} != flat ({key})",
+                )
