@@ -313,6 +313,42 @@ def _seed_one_result(result: dict, source: str, out: list,
                                    for k, v in sl_ms.items()},
                  "spread_pct": spread})
 
+    # Cost-model schedule search (ISSUE 16): the composed phase now
+    # ranks arms with the fitted α–β model and measures only top-k; the
+    # predicted-vs-measured max error over the arms it DID time is the
+    # model audit. Seed the sched_search decision from that audit:
+    # error inside the measurement spread keeps the ranked top-k path,
+    # disagreement past the gate seeds 'exhaustive' so the next run
+    # restores full coverage — loud provenance either way, and the
+    # predicted rows ride along as evidence (never trusted blind).
+    cm_err = result.get("cost_model_err_pct")
+    if isinstance(cm_err, (int, float)) and result.get(
+            "sched_search_selected"):
+        spread = float(result.get("composed_spread_pct", 0.0)) or 10.0
+        world = result.get("composed_world_shape") or [
+            result.get("n_devices", 1)
+        ]
+        payload_mb = result.get("composed_payload_mb", 1)
+        key = _bucketed_key(
+            kind, tuple(world) + (payload_mb,), "search"
+        )
+        winner = "topk" if float(cm_err) <= spread else "exhaustive"
+        evidence: dict = {
+            "cost_model_err_pct": round(float(cm_err), 3),
+            "spread_pct": spread,
+            "selected": str(result["sched_search_selected"]),
+        }
+        pred = result.get("sched_search_predicted_ms")
+        if isinstance(pred, dict):
+            evidence["predicted_ms"] = {
+                k: round(float(v), 4) for k, v in pred.items()
+                if isinstance(v, (int, float))
+            }
+        skipped = result.get("sched_search_skipped")
+        if isinstance(skipped, (list, tuple)):
+            evidence["skipped"] = [str(s) for s in skipped]
+        put("sched_search", key, winner, evidence)
+
     # Sequence-axis attention impl (ISSUE 13): bench's ``seq_parallel``
     # phase times the ONE plan-compiled step per candidate (ring's n-1
     # ppermutes/layer vs Ulysses' all_to_all reshard), keyed

@@ -132,6 +132,16 @@ DEFAULT_TABLE: dict = {
     # everywhere until a bench ``seq_parallel`` capture shows Ulysses
     # winning a shape; heads-indivisible shapes force ring regardless.
     "seq_attn_impl": {"*": "ring"},
+    # Cost-model schedule search (ISSUE 16): how the composed-schedule
+    # sweep covers its candidate grid. 'topk' ranks the candidates with
+    # the fitted alpha-beta model and MEASURES only the top-k (skipped
+    # arms logged with their predicted costs — no silent coverage
+    # loss); 'exhaustive' measures every arm. Topk everywhere — the
+    # model is audited on every adoption (predicted-vs-measured error
+    # recorded as cache evidence) and an uncalibrated or disagreeing
+    # model FORCES exhaustive with loud provenance, so the cheap path
+    # can never silently rank on a default-initialized model.
+    "sched_search": {"*": "topk"},
     # Multi-tenant adapter application (ISSUE 14): 'gather' = the one
     # compiled program gathers each slot's A/B rows and adds the rank-r
     # delta in-forward — mixed-tenant traffic pays O(r(d_in+d_out)) per
@@ -363,6 +373,7 @@ def record_measurement(
     higher_is_better: bool = False,
     source: str = "measured:bench",
     cache_path: Optional[str] = None,
+    extra_evidence: Optional[Mapping[str, object]] = None,
 ) -> Optional[str]:
     """Adopt an ALREADY-measured comparison into the cache (bench.py's
     phases measure the candidates anyway — this turns those rows into
@@ -374,7 +385,14 @@ def record_measurement(
     iterations instead of n>=3 samples): a conservative 10% noise floor
     is applied, so a single-sample comparison is adopted only when the
     winner's margin is decisive — never a coin flip recorded as
-    spread_pct 0."""
+    spread_pct 0.
+
+    ``extra_evidence`` (ISSUE 16): caller-supplied keys merged into the
+    stored entry beside the medians — the cost-model schedule search
+    records its predicted-vs-measured error here on every top-k
+    adoption, so the model is audited in the cache, never trusted
+    blind. Reserved entry keys (winner/source/medians/spread) win over
+    a colliding extra key."""
     floored = spreads is None
     if floored:
         spreads = {k: 10.0 for k in medians_ms}
@@ -384,6 +402,7 @@ def record_measurement(
         return None
     unit = "candidates_score" if higher_is_better else "candidates_ms"
     entry = {
+        **(dict(extra_evidence) if extra_evidence else {}),
         "winner": winner, "source": source,
         unit: {k: round(float(v), 4) for k, v in medians_ms.items()},
         "spread_pct": max(spreads.values(), default=0.0),
