@@ -268,7 +268,13 @@ def reduce_tree(
     The int8 wire is a WIRE variant, not a schedule: it has a flat and
     a two-level rendering only (the two-phase quantized scheme has no
     generic staged form), and any other composition on an int8 wire is
-    refused loudly.
+    refused loudly. SLICED spellings of those two renderings (ISSUE 16
+    satellite, e.g. ``rs(data)[s0..3]>ag(data)``) ARE accepted: each
+    bucket slice rides its own two-phase wire — same grammar, per-slice
+    quantization scales (so the result matches the unsliced int8 wire
+    to quantization tolerance, not bitwise; both stay within the wire's
+    stated ~1/127-per-stage error of the exact mean), zigzag ``[z...]``
+    cut/reassembly honored.
 
     Zero-size leaves take the exact per-leaf path (see
     :func:`bucket_partition`'s edge contract). At TRACE time (host-side
@@ -289,8 +295,11 @@ def reduce_tree(
     )
     from chainermn_tpu.parallel.composition import (
         CompositionError,
+        compact_slices,
         compile_schedule,
+        effective_slices,
         reduce_composed,
+        slice_bounds,
         stage_wire_layout,
         two_level_composition,
     )
@@ -315,11 +324,20 @@ def reduce_tree(
                  and jnp.dtype(compress_dtype) == jnp.dtype(jnp.int8))
     flat_sig = compile_schedule("flat", names).signature()
     two_level_sig = two_level_composition(names).signature()
-    if int8_wire and sig not in (flat_sig, two_level_sig):
+    # The int8 gate compares the UNSLICED base pipeline: sliced
+    # spellings of the two renderings ride per-slice two-phase wires
+    # (ISSUE 16 satellite), anything else is refused.
+    import dataclasses as _dc
+
+    base_sig = _dc.replace(
+        compact_slices(comp), slices=1, slice_layout="contiguous"
+    ).signature()
+    if int8_wire and base_sig not in (flat_sig, two_level_sig):
         raise ValueError(
             f"the int8 two-phase wire has flat and two-level renderings "
-            f"only — composition {sig!r} cannot ride it; use the bf16/f32 "
-            "wire for composed schedules"
+            f"only (sliced spellings of those included) — composition "
+            f"{sig!r} cannot ride it; use the bf16/f32 wire for composed "
+            "schedules"
         )
     leaves, treedef = jax.tree.flatten(grads)
     if not leaves:
@@ -350,10 +368,24 @@ def reduce_tree(
         if int8_wire and jnp.issubdtype(dt, jnp.floating):
             # The quantized wire's rendering is chosen by the
             # composition's SHAPE: a scatter stage means the int8
-            # phases ride only the non-scatter axes.
-            if sig == two_level_sig:
-                return int8_decomposed_allreduce_mean(flat, names)
-            return int8_allreduce_mean(flat, names)
+            # phases ride only the non-scatter axes. Sliced spellings
+            # run the two-phase wire per bucket slice (each slice
+            # quantizes against its own max-abs), same cut/reassembly
+            # indexing as reduce_composed's sliced path.
+            fn = (int8_decomposed_allreduce_mean
+                  if base_sig == two_level_sig else int8_allreduce_mean)
+            s_eff = effective_slices(comp.slices, flat.size)
+            if s_eff <= 1:
+                return fn(flat, names)
+            if comp.slice_layout == "zigzag":
+                red = jnp.zeros_like(flat)
+                for i in range(s_eff):
+                    red = red.at[i::s_eff].set(fn(flat[i::s_eff], names))
+                return red
+            return jnp.concatenate([
+                fn(flat[lo:hi], names)
+                for lo, hi in slice_bounds(flat.size, s_eff)
+            ])
         return reduce_composed(flat, comp, op="mean")
 
     rec = _trace.active()
@@ -782,7 +814,14 @@ class MeasuredComposedReducer:
         )
 
         bounds = slice_bounds(flat.shape[1], s_eff)
-        cur_s = [flat[:, lo:hi] for lo, hi in bounds]
+        # Honor the composition's cut: zigzag slice i is the strided
+        # comb i, i+S, ... (same per-slice sizes as the contiguous
+        # bounds, so the replayed stage rows are shared).
+        zigzag = self.comp.slice_layout == "zigzag"
+        if zigzag:
+            cur_s = [flat[:, i::s_eff] for i in range(s_eff)]
+        else:
+            cur_s = [flat[:, lo:hi] for lo, hi in bounds]
         per_rows = [
             _replay(self.comp.stages, hi - lo, axis_sizes)[0]
             for lo, hi in bounds
@@ -818,6 +857,11 @@ class MeasuredComposedReducer:
                 )
         import jax.numpy as _jnp
 
+        if zigzag:
+            out = _jnp.zeros((flat.shape[1],), cur_s[0].dtype)
+            for i, c in enumerate(cur_s):
+                out = out.at[i::s_eff].set(c[0])
+            return out
         return _jnp.concatenate([c[0] for c in cur_s])
 
 

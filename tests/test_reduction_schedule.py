@@ -816,6 +816,25 @@ class TestSlicedEagerReducers:
                 assert sl.get("dur_ms") is not None
                 assert sl.get("blocked_ms") is not None
 
+    def test_measured_composed_reducer_zigzag(self, comm):
+        """ISSUE 16: the eager measured executor honors the zigzag cut
+        — strided slice membership on the way in, comb reassembly on
+        the way out, mean still exact."""
+        from chainermn_tpu.parallel.reduction_schedule import (
+            MeasuredComposedReducer,
+        )
+
+        rs = np.random.RandomState(16)
+        stacked = {"a": jnp.asarray(rs.randn(N, 37), jnp.float32)}
+        sig = "rs(a0)[z0..3]>ag(a0)"
+        red = MeasuredComposedReducer(comm, schedule=sig)
+        assert red.comp.slice_layout == "zigzag"
+        out = red.reduce(stacked)
+        np.testing.assert_allclose(
+            np.asarray(out["a"]), np.asarray(stacked["a"]).mean(0),
+            rtol=1e-5, atol=1e-6,
+        )
+
     def test_measured_composed_sliced_degrade(self, comm):
         from chainermn_tpu.parallel.reduction_schedule import (
             MeasuredComposedReducer,
