@@ -25,6 +25,12 @@ from chainermn_tpu.serving.cluster.router import (
     ROUTE_POLICIES,
     Router,
 )
+from chainermn_tpu.serving.cluster.tree_push import (
+    push_adapter,
+    tree_push,
+    tree_rounds,
+    warm_prefix_trie,
+)
 
 __all__ = [
     "Replica",
@@ -35,7 +41,11 @@ __all__ = [
     "ROUTE_POLICIES",
     "make_replicas",
     "mesh_stream_blocks",
+    "push_adapter",
     "recv_kv",
     "send_kv",
     "transfer_kv",
+    "tree_push",
+    "tree_rounds",
+    "warm_prefix_trie",
 ]
