@@ -456,6 +456,7 @@ _COMPACT_KEYS = (
     "composed_spread_pct", "composed_selected",
     "composed_sliced_ms", "composed_slices_selected",
     "composed_sliced_spread_pct",
+    "sched_search_selected", "cost_model_err_pct",
     "serving_tokens_per_sec", "serving_spread_pct",
     "serving_spec_selected", "serving_spec_speedup",
     "serving_spec_accept_rate", "serving_prefix_ttft_speedup",
@@ -3036,10 +3037,46 @@ def _bench_composed(comm, on_accel: bool):
 
         return _repeat_median(sample, 3)
 
+    # --- cost-model schedule search (ISSUE 16): rank the derived grid
+    # with the α-β model fitted from the PRIOR capture's rows and
+    # measure only the top-k (+ the two_level ratio baseline) instead
+    # of every arm. Degrades loudly: no prior rows for this mesh shape
+    # -> forced:uncalibrated exhaustive sweep; model error past the
+    # spread gate after measuring -> the skipped arms are measured
+    # after all (exhaustive fallback, provenance says why). Skipped
+    # arms are always logged WITH their predicted prices — no silent
+    # coverage loss.
+    from chainermn_tpu.parallel import cost_model as _cm
+
+    payload_mb = max(1, payload_bytes >> 20)
+    cands = [c.signature() for c in derive_compositions(names)]
+    two_level_sig = two_level_composition(names).signature()
+    model = _cm.load_from_bench_details(
+        _DETAILS_PATH, world_shape=shape)
+    search_mode = "topk"
+    search_source = None
+    try:
+        from chainermn_tpu import tuning as _tuning_q
+
+        key_q = _tuning_q.decision_key(
+            shape=tuple(int(d) for d in shape) + (payload_mb,),
+            dtype="search",
+        )
+        search_mode = _tuning_q.choice(
+            "sched_search", ("topk", "exhaustive"), key_q)
+        rec_q = [d for d in _tuning_q.decisions_taken()
+                 if d["name"] == "sched_search" and d["key"] == key_q]
+        if rec_q:
+            search_source = rec_q[-1]["source"]
+    except Exception:
+        pass
+    rank = _cm.rank_compositions(
+        model, cands, payload_bytes, k=3, mode=search_mode)
+
     sched_ms: dict = {}
     spreads: dict = {}
-    for comp in derive_compositions(names):
-        sig = comp.signature()
+
+    def _measure_arm(sig):
         opt = create_multi_node_optimizer(
             optax.sgd(1e-3), comm3, allreduce_grad_dtype=jnp.bfloat16,
             reduction_schedule=sig,
@@ -3047,13 +3084,33 @@ def _bench_composed(comm, on_accel: bool):
         med, spread = time_loop(opt)
         sched_ms[sig] = round(med, 3)
         spreads[sig] = spread
-    two_level_sig = two_level_composition(names).signature()
+
+    for sig in rank.measured:
+        _measure_arm(sig)
+    if two_level_sig not in sched_ms:
+        _measure_arm(two_level_sig)  # the ratio baseline, always timed
+    err_pct = _cm.model_error_pct(rank.predicted_ms, sched_ms)
+    provenance = rank.provenance
+    if (rank.mode == "topk" and err_pct is not None
+            and err_pct > max(spreads.values())):
+        # the model disagreed with the wall clock past the noise gate:
+        # its ranking cannot be trusted to have skipped only losers —
+        # measure everything, say why.
+        provenance = (f"exhaustive:model_err {err_pct}% > spread "
+                      f"{round(max(spreads.values()), 3)}%")
+        for sig in rank.skipped:
+            if sig not in sched_ms:
+                _measure_arm(sig)
+        err_pct = _cm.model_error_pct(rank.predicted_ms, sched_ms)
+    searched_mode = ("topk" if len(sched_ms) < len(cands)
+                     else "exhaustive")
+    skipped = [s for s in rank.order if s not in sched_ms]
     best_sig = min(sched_ms, key=sched_ms.get)
     out = {
         "composed_schedule_ms": sched_ms,
         "composed_spread_pct": max(spreads.values()),
         "composed_world_shape": [int(d) for d in shape],
-        "composed_payload_mb": max(1, payload_bytes >> 20),
+        "composed_payload_mb": payload_mb,
         "composed_best": best_sig,
         # what composing beyond the menu buys: the best derived
         # pipeline's speedup over the menu's two_level on this
@@ -3062,7 +3119,27 @@ def _bench_composed(comm, on_accel: bool):
         "composed_best_vs_two_level": round(
             sched_ms[two_level_sig] / max(sched_ms[best_sig], 1e-9), 3
         ),
+        "sched_search_selected": searched_mode,
+        "sched_search_provenance": provenance,
+        "sched_search_skipped": skipped,
     }
+    if search_source:
+        out["sched_search_source"] = search_source
+    if rank.predicted_ms:
+        out["sched_search_predicted_ms"] = rank.predicted_ms
+    if err_pct is not None:
+        out["cost_model_err_pct"] = err_pct
+    if model is not None:
+        out["cost_model_fit"] = {
+            "source": model.source,
+            "fit_err_pct": model.fit_err_pct,
+            "n_rows": len(model.fit_rows),
+        }
+    import dataclasses as _dc_mod
+
+    _cm.emit_sched_search_event(
+        _dc_mod.replace(rank, mode=searched_mode, provenance=provenance),
+        sched_ms, spread_pct=max(spreads.values()))
     try:
         from chainermn_tpu import tuning
 
@@ -3079,8 +3156,15 @@ def _bench_composed(comm, on_accel: bool):
                     for s, v in sched_ms.items()}
         adopt_spreads = {normalize_schedule_name(s, 3): v
                          for s, v in spreads.items()}
+        # every top-k adoption carries the model audit as evidence —
+        # the winner row records how far the predictions that chose
+        # the measured set sat from the wall clock (ISSUE 16).
+        audit = {"sched_search": provenance}
+        if err_pct is not None:
+            audit["cost_model_err_pct"] = err_pct
         tuning.record_measurement(
-            _SCHED_DECISION, key, adopt_ms, spreads=adopt_spreads
+            _SCHED_DECISION, key, adopt_ms, spreads=adopt_spreads,
+            extra_evidence=audit,
         )
         selected = tuning.choice(
             _SCHED_DECISION, schedule_candidates(3), key
@@ -3111,6 +3195,7 @@ def _bench_composed(comm, on_accel: bool):
         base_comp = two_level_composition(names)
         sliced_ms: dict = {}
         sliced_spreads: dict = {}
+        sliced_pred: dict = {}
         for s in _SLICE_CANDIDATES:
             sig_s = (base_comp.signature() if s == "1" else
                      sliced_composition(base_comp, int(s)).signature())
@@ -3122,9 +3207,16 @@ def _bench_composed(comm, on_accel: bool):
             med, spread = time_loop(opt)
             sliced_ms[s] = round(med, 3)
             sliced_spreads[s] = spread
+            if model is not None:
+                # the model prices sliced variants too (critical-path
+                # ticks) — logged beside the measurement as its audit
+                sliced_pred[s] = round(
+                    model.predict(sig_s, payload_bytes), 3)
         out["composed_sliced_ms"] = sliced_ms
         out["composed_sliced_spread_pct"] = round(
             max(sliced_spreads.values()), 3)
+        if sliced_pred:
+            out["composed_sliced_predicted_ms"] = sliced_pred
         from chainermn_tpu import tuning
 
         key_s = tuning.decision_key(

@@ -428,16 +428,46 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
       collect): aggregated into comm time total vs comm time hidden
       behind compute, and the ``hidden_fraction`` between them.
 
+    ``sched_search`` events (ISSUE 16: the cost-model schedule search's
+    audit record — predicted prices for every ranked arm, measured ms
+    for the arms actually timed, the model error vs the measurement
+    spread) land under ``sched_search``: per-signature
+    predicted/measured rows plus the mode/provenance/error header the
+    report's loud-flag rule keys on — and each matching composition row
+    above gains a ``predicted_ms`` column.
+
     Returns None when the trace carries none (section omitted)."""
     configs: list[dict] = []
     layout: dict = {}
     composed: dict = {}
+    search: Optional[dict] = None
     n_measured = 0
     comm_s = 0.0
     blocked_s = 0.0
     for ev in events:
         kind = ev.get("kind")
-        if kind == "overlap_config":
+        if kind == "sched_search":
+            rows: dict = {}
+            pred = ev.get("predicted_ms") or {}
+            meas = ev.get("measured_ms") or {}
+            for sig in sorted(set(pred) | set(meas)):
+                row: dict = {}
+                if sig in pred:
+                    row["predicted_ms"] = round(float(pred[sig]), 4)
+                if sig in meas:
+                    row["measured_ms"] = round(float(meas[sig]), 4)
+                else:
+                    row["skipped"] = True
+                rows[sig] = row
+            search = {
+                "mode": ev.get("mode"),
+                "provenance": ev.get("provenance"),
+                "rows": rows,
+            }
+            for k in ("err_pct", "spread_pct"):
+                if ev.get(k) is not None:
+                    search[k] = float(ev[k])
+        elif kind == "overlap_config":
             configs.append({
                 k: ev.get(k)
                 for k in ("double_buffering", "staleness", "schedule",
@@ -505,7 +535,8 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
                 # FULLY-HIDDEN bucket and must count as such.
                 b = ev.get("blocked_s")
                 blocked_s += float(dur if b is None else b)
-    if not configs and not layout and not composed and not n_measured:
+    if (not configs and not layout and not composed and not n_measured
+            and search is None):
         return None
     out: dict = {}
     if configs:
@@ -515,9 +546,17 @@ def summarize_overlap(events: Iterable[Mapping[str, Any]]) -> Optional[dict]:
             k: layout[k] for k in sorted(layout)
         }
     if composed:
+        if search is not None:
+            # the predicted-vs-measured column on the composition rows
+            for sig, row in composed.items():
+                p = search["rows"].get(sig, {}).get("predicted_ms")
+                if p is not None:
+                    row["predicted_ms"] = p
         out["compositions"] = {
             k: composed[k] for k in sorted(composed)
         }
+    if search is not None:
+        out["sched_search"] = search
     if n_measured:
         hidden_s = max(0.0, comm_s - blocked_s)
         out["measured"] = {

@@ -260,7 +260,15 @@ def load_from_bench_details(
     (``composed_schedule_ms`` + ``composed_world_shape`` +
     ``composed_payload_mb``). Returns ``None`` — the UNCALIBRATED
     degrade, never a default model — when the file, the rows, or the
-    requested mesh shape are missing/mismatched."""
+    requested mesh shape are missing/mismatched, and ALSO when the
+    rows cannot overdetermine the ``2k`` coefficients (< ``2k+1``
+    rows): a prior TOP-K capture leaves only the arms it measured,
+    and an interpolating fit over them would round-trip perfectly
+    while extrapolating garbage to the skipped arms — the one failure
+    mode the predicted-vs-measured audit cannot see (the audited arms
+    ARE the fit rows). Refusing keeps the cadence honest: a top-k
+    capture is followed by one exhaustive sweep that restores full
+    coverage, then top-k resumes."""
     try:
         with open(path) as f:
             data = json.load(f)
@@ -269,7 +277,9 @@ def load_from_bench_details(
     rows = data.get("composed_schedule_ms")
     shape = data.get("composed_world_shape")
     payload_mb = data.get("composed_payload_mb")
-    if not isinstance(rows, dict) or len(rows) < 2 or not shape:
+    if not isinstance(rows, dict) or not shape:
+        return None
+    if len(rows) < 2 * len(shape) + 1:
         return None
     if world_shape is not None and tuple(int(d) for d in shape) != tuple(
             int(d) for d in world_shape):
@@ -402,6 +412,43 @@ def rank_compositions(
     )
 
 
+def emit_sched_search_event(
+    rank: RankResult,
+    measured_ms: Optional[Mapping[str, float]] = None,
+    *,
+    spread_pct: Optional[float] = None,
+) -> Optional[float]:
+    """One ``sched_search`` trace event — the search's audit record
+    (``docs/observability.md``): every ranked arm's predicted price,
+    the measured ms for the arms actually timed, and the resulting
+    :func:`model_error_pct` beside the measurement spread so
+    ``tools/trace_report.py`` can print predicted-vs-measured and flag
+    a model past the gate LOUDLY. No-op without an active recorder;
+    returns the error either way so callers gate on it."""
+    from chainermn_tpu.observability import trace as _trace
+
+    err = model_error_pct(rank.predicted_ms, measured_ms or {})
+    rec = _trace.active()
+    if rec is not None:
+        fields: dict = {
+            "mode": rank.mode,
+            "provenance": rank.provenance,
+            "predicted_ms": dict(rank.predicted_ms),
+            "measured": list(rank.measured),
+            "skipped": list(rank.skipped),
+        }
+        if measured_ms:
+            fields["measured_ms"] = {
+                k: round(float(v), 4) for k, v in measured_ms.items()
+            }
+        if spread_pct is not None:
+            fields["spread_pct"] = round(float(spread_pct), 3)
+        if err is not None:
+            fields["err_pct"] = err
+        rec.event("sched_search", **fields)
+    return err
+
+
 def model_error_pct(
     predicted_ms: Mapping[str, float],
     measured_ms: Mapping[str, float],
@@ -427,6 +474,7 @@ __all__ = [
     "WIRE_ITEMSIZE",
     "calibrate",
     "canonical_signature",
+    "emit_sched_search_event",
     "fit_pipeline_rows",
     "load_from_bench_details",
     "model_error_pct",

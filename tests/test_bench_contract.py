@@ -830,3 +830,67 @@ def test_composed_sliced_rows_contract_and_seeding(tmp_path, monkeypatch):
     monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_CACHE",
                        str(cache.with_suffix(".2")))
     assert resolve_comp_slices("TPU v5 lite", 3 << 20, (2, 2, 2)) == 1
+
+def test_sched_search_rows_contract_and_seeding(tmp_path):
+    """ISSUE 16 satellite: the cost-model schedule search's headline
+    rows ride the compact line (``sched_search_selected`` +
+    ``cost_model_err_pct``), the composed phase really ranks with
+    ``rank_compositions`` and logs the skipped arms with their
+    predicted prices (no silent coverage loss), and ``tuning seed``
+    learns the ``sched_search`` decision from the model audit —
+    error inside the spread keeps top-k, disagreement past the gate
+    seeds 'exhaustive' so the next run restores full coverage."""
+    for k in ("sched_search_selected", "cost_model_err_pct"):
+        assert k in bench._COMPACT_KEYS, k
+    import inspect
+
+    src = inspect.getsource(bench._bench_composed)
+    # the search contract, pinned structurally: model loaded from the
+    # PRIOR capture, ranked top-k measured (k default 3), skipped arms
+    # + predicted costs logged, model error recorded as adoption
+    # evidence, disagreement falls back to exhaustive loudly.
+    for marker in ("load_from_bench_details", "rank_compositions",
+                   "k=3", "sched_search_skipped",
+                   "sched_search_predicted_ms", "extra_evidence",
+                   "exhaustive:model_err"):
+        assert marker in src, marker
+
+    from chainermn_tpu.tuning.cache import seed_from_bench_details
+    from chainermn_tpu.tuning.cache import lookup_entry
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-05T00:00:00Z",
+        "composed_world_shape": [2, 2, 2],
+        "composed_payload_mb": 3,
+        "composed_spread_pct": 8.0,
+        "sched_search_selected": "topk",
+        "cost_model_err_pct": 4.5,
+        "sched_search_predicted_ms": {"ar(a0+a1+a2)": 3.1},
+        "sched_search_skipped": ["rs(a2)>ar(a0+a1)>ag(a2)"],
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert "sched_search|TPU v5 lite|2x2x2x4|search -> topk" in seeded
+    entry = lookup_entry(
+        "sched_search", "TPU v5 lite|2x2x2x4|search", path=str(cache))
+    assert entry["cost_model_err_pct"] == 4.5
+    assert entry["spread_pct"] == 8.0
+    assert entry["skipped"] == ["rs(a2)>ar(a0+a1)>ag(a2)"]
+    assert entry["predicted_ms"] == {"ar(a0+a1+a2)": 3.1}
+
+    # model error past the spread gate seeds the exhaustive fallback
+    doc["cost_model_err_pct"] = 40.0
+    details.write_text(json.dumps(doc))
+    seeded2 = "\n".join(seed_from_bench_details(
+        str(details), str(cache.with_suffix(".2"))))
+    assert ("sched_search|TPU v5 lite|2x2x2x4|search -> exhaustive"
+            in seeded2)
+
+    # no audit keys -> no sched_search entry (never seeded blind)
+    doc.pop("cost_model_err_pct")
+    details.write_text(json.dumps(doc))
+    assert "sched_search" not in "\n".join(seed_from_bench_details(
+        str(details), str(cache.with_suffix(".3"))))

@@ -270,6 +270,24 @@ class TestBenchDetailsRows:
         p.write_text(json.dumps({"device_kind": "cpu"}))
         assert load_from_bench_details(str(p)) is None
 
+    def test_underdetermined_rows_return_none(self, tmp_path):
+        """A prior TOP-K capture leaves only the arms it measured; an
+        interpolating fit over < 2k+1 rows would round-trip perfectly
+        while extrapolating garbage to the skipped arms — the one
+        failure mode the audit cannot see. load refuses it."""
+        p = tmp_path / "topk.json"
+        p.write_text(json.dumps({
+            "composed_schedule_ms": {
+                "ar(a0+a1+a2)": 3.2,
+                "rs(a0+a1+a2)>ag(a0+a1+a2)": 3.3,
+                "rs(a1+a2)>ar(a0)>ag(a1+a2)": 3.6,
+                "rs(a2)>ar(a0+a1)>ag(a2)": 3.9,
+            },
+            "composed_world_shape": [2, 2, 2],
+            "composed_payload_mb": 1,
+        }))
+        assert load_from_bench_details(str(p)) is None
+
 
 class TestModelError:
     def test_max_relative_error(self):
@@ -278,6 +296,70 @@ class TestModelError:
 
     def test_no_overlap_is_none(self):
         assert model_error_pct({"a": 1.0}, {"b": 1.0}) is None
+
+
+class TestSchedSearchTraceEvent:
+    """The search's audit record on the trace plane: emit -> one
+    ``sched_search`` event; summarize_overlap turns it into the
+    predicted-vs-measured rows (skipped arms still priced) and the
+    composition rows above gain the predicted_ms column."""
+
+    def test_emit_and_summarize(self):
+        from chainermn_tpu.observability import trace
+        from chainermn_tpu.parallel.cost_model import (
+            emit_sched_search_event,
+        )
+
+        model = _model([1.0] * 3, [0.0] * 3)
+        sigs = _grid_sigs()
+        rank = rank_compositions(model, sigs, PAYLOAD, k=2)
+        rec = trace.enable(None)
+        try:
+            measured = {s: rank.predicted_ms[s] * 1.05
+                        for s in rank.measured}
+            err = emit_sched_search_event(rank, measured,
+                                          spread_pct=10.0)
+            # |pred - meas| / meas = 0.05/1.05
+            assert err == pytest.approx(100 * 0.05 / 1.05, abs=0.01)
+            evs = [e for e in rec.events
+                   if e.get("kind") == "sched_search"]
+            assert len(evs) == 1
+            ev = evs[0]
+            assert ev["mode"] == "topk"
+            assert ev["provenance"] == "cost_model:fit:test"
+            assert ev["err_pct"] == err
+            assert ev["spread_pct"] == 10.0
+            # summarizer: rows for every arm, skipped flagged, and a
+            # composition row picks up the predicted column
+            wire = {"kind": "wire", "composition": rank.measured[0],
+                    "schedule": rank.measured[0],
+                    "stage": rank.measured[0], "stage_op": "all-reduce",
+                    "nbytes": 64, "stage_index": 0}
+            ov = trace.summarize_overlap([wire] + rec.events)
+            ss = ov["sched_search"]
+            assert ss["mode"] == "topk" and ss["err_pct"] == err
+            assert set(ss["rows"]) == set(sigs)
+            for s in rank.skipped:
+                assert ss["rows"][s]["skipped"] is True
+                assert "predicted_ms" in ss["rows"][s]
+            comp_row = ov["compositions"][rank.measured[0]]
+            assert comp_row["predicted_ms"] == pytest.approx(
+                rank.predicted_ms[rank.measured[0]], abs=1e-3)
+        finally:
+            trace.disable()
+
+    def test_no_recorder_still_returns_error(self):
+        from chainermn_tpu.observability import trace
+        from chainermn_tpu.parallel.cost_model import (
+            emit_sched_search_event,
+        )
+
+        assert trace.active() is None
+        model = _model([1.0] * 3, [0.0] * 3)
+        rank = rank_compositions(model, _grid_sigs(), PAYLOAD, k=2)
+        err = emit_sched_search_event(
+            rank, {s: rank.predicted_ms[s] for s in rank.measured})
+        assert err == 0.0
 
 
 class TestSchedSearchSeeding:

@@ -336,11 +336,13 @@ def render_text(s: dict) -> str:
                 f"{row['overlapped']} overlapped"
             )
         for sig, row in ov.get("compositions", {}).items():
+            pred = (f", predicted {row['predicted_ms']:.3f} ms"
+                    if row.get("predicted_ms") is not None else "")
             lines.append(
                 f"  composed {sig} [{row['schedule']}]: "
                 f"{row['buckets']} bucket(s), "
                 f"{_fmt_bytes(row['nbytes'])} wire, "
-                f"{row['overlapped']} overlapped"
+                f"{row['overlapped']} overlapped{pred}"
             )
             for st, srow in row.get("stages", {}).items():
                 dur = (f", {srow['dur_ms']:.3f} ms"
@@ -372,6 +374,32 @@ def render_text(s: dict) -> str:
                 f"({m['hidden_fraction'] * 100:.1f}% hidden, "
                 f"{m['n']} bucket events)"
             )
+        # ISSUE 16: the cost-model schedule search's audit — predicted
+        # beside measured per arm, skipped arms still priced (no silent
+        # coverage loss), and a LOUD flag when the model's error blew
+        # past the measurement spread (the exhaustive-fallback gate).
+        ss = ov.get("sched_search")
+        if ss:
+            err, spread = ss.get("err_pct"), ss.get("spread_pct")
+            loud = (err is not None and spread is not None
+                    and err > spread)
+            head = f"  schedule search [{ss.get('mode')}] " \
+                   f"({ss.get('provenance')})"
+            if err is not None:
+                head += f": model err {err:.1f}%"
+                if spread is not None:
+                    head += (f" > spread {spread:.1f}% !! MODEL PAST "
+                             f"GATE — exhaustive fallback" if loud else
+                             f" <= spread {spread:.1f}%")
+            lines.append(head)
+            for sig, row in ss.get("rows", {}).items():
+                p = (f"predicted {row['predicted_ms']:>9.3f} ms"
+                     if row.get("predicted_ms") is not None
+                     else " " * 22)
+                mm = (f"  measured {row['measured_ms']:>9.3f} ms"
+                      if row.get("measured_ms") is not None
+                      else "  (skipped)")
+                lines.append(f"    {sig}: {p}{mm}")
     if s.get("serving"):
         sv = s["serving"]
         lines.append("")
