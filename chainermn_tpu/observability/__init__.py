@@ -63,7 +63,8 @@ def __getattr__(name):
         from chainermn_tpu.observability.straggler import StragglerMonitor
 
         return StragglerMonitor
-    if name in ("metrics", "exporter", "flight", "stats"):
+    if name in ("metrics", "exporter", "flight", "stats", "journey",
+                "clocksync"):
         import importlib
 
         return importlib.import_module(
@@ -83,10 +84,12 @@ __all__ = [
     "StragglerMonitor",
     "active",
     "chrome_trace",
+    "clocksync",
     "disable",
     "enable",
     "exporter",
     "flight",
+    "journey",
     "metrics",
     "nearest_rank",
     "read_jsonl",

@@ -1,0 +1,377 @@
+"""Cross-rank request journeys (ISSUE 17): one causal id per request,
+hop-numbered span ids over every host-plane hop.
+
+The trace plane measures each hop in isolation — ``route`` on the
+router, ``kv_transfer`` at adoption, ``queue_wait``/``prefill``/
+``finish`` on whichever scheduler ends up decoding — but a
+disaggregated request scatters those events across N processes' JSONL
+files with no shared causal key. This module is the key: a
+:class:`JourneyContext` (journey id + a hop counter) rides the
+``Request`` object in process, and rides the ``export_kv`` /
+``tree_push`` payload dicts across processes, so every per-request
+event gains three fields:
+
+- ``journey`` — the request's cluster-unique journey id,
+- ``span`` — this event's span id, ``"<journey>/<hop>"`` (hops number
+  the causal chain, so merged timelines order WITHOUT trusting any
+  clock),
+- ``parent`` — the previous hop's span id (absent on hop 0).
+
+Everything here is host-side metadata on already-host-side event
+emission: no new jitted code anywhere, so recorder-on and recorder-off
+programs lower to identical HLO (the structural convention the
+serving tests pin). The reference framework had no tracing plane at
+all — its debugging story was print-per-rank under ``mpiexec``
+(``chainermn/communicators/mpi_communicator_base.py`` †); the journey
+layer is what The Big Send-off (2504.18658) argues distributed serving
+actually needs: *measured, attributed* per-request timelines.
+
+The merge/report half lives here too (:func:`merge_journeys`,
+:func:`decompose_ttft`) — one owner for the causal-chain rules, loaded
+by ``tools/trace_report.py`` via file path (this module is pure
+stdlib; it must never import jax).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Iterable, Mapping, Optional
+
+#: the key a journey snapshot rides under inside host-plane payload
+#: dicts (``export_kv`` payloads, ``tree_push`` payloads). Engines
+#: ignore unknown payload keys, so pre-journey peers keep adopting.
+WIRE_KEY = "journey"
+
+_counter = itertools.count()
+_lock = threading.Lock()
+
+
+def _mint_id(request_id: Optional[str]) -> str:
+    """A cluster-unique journey id. The request_id prefix keeps merged
+    reports readable; the pid+counter suffix keeps ids unique when two
+    router processes (or two windows of one) reuse request ids."""
+    with _lock:
+        n = next(_counter)
+    base = str(request_id) if request_id is not None else "j"
+    return f"{base}@{os.getpid():x}.{n:x}"
+
+
+@dataclass
+class JourneyContext:
+    """Journey id + hop counter + the last minted span (the next
+    hop's ``parent``). Mutated only through :meth:`begin_hop` so the
+    chain stays linear — a request's journey is a path, not a DAG
+    (preemption/migration extend it; nothing forks it)."""
+
+    journey: str
+    hop: int = 0
+    last_span: Optional[str] = None
+
+    def begin_hop(self) -> dict:
+        """Mint the next hop's event fields and advance the chain."""
+        span = f"{self.journey}/{self.hop}"
+        fields = {"journey": self.journey, "span": span}
+        if self.last_span is not None:
+            fields["parent"] = self.last_span
+        self.hop += 1
+        self.last_span = span
+        return fields
+
+    # ---- wire form (payload dicts over send_obj/recv_obj) -----------
+
+    def to_wire(self) -> dict:
+        return {"id": self.journey, "hop": self.hop,
+                "last_span": self.last_span}
+
+    @classmethod
+    def from_wire(cls, wire: Mapping[str, Any]) -> "JourneyContext":
+        return cls(journey=str(wire["id"]), hop=int(wire["hop"]),
+                   last_span=wire.get("last_span"))
+
+
+def new(request_id: Optional[str] = None) -> JourneyContext:
+    return JourneyContext(_mint_id(request_id))
+
+
+def ensure(request) -> JourneyContext:
+    """Attach a context to ``request`` ONLY when absent — the
+    keep_arrival rule's sibling: every (re)submission front door calls
+    this, so a requeue, migration or cross-process adoption can never
+    silently restart the chain."""
+    ctx = getattr(request, "_journey", None)
+    if ctx is None:
+        ctx = new(getattr(request, "request_id", None))
+        request._journey = ctx
+    return ctx
+
+
+def fields(request) -> dict:
+    """The journey/span/parent fields for one event about ``request``
+    — mints (and consumes) the next hop. Total: a request that never
+    passed a front door gets its context here."""
+    return ensure(request).begin_hop()
+
+
+def attach_payload(payload: dict, request) -> dict:
+    """Snapshot ``request``'s context into a host-plane payload dict so
+    a peer process can continue the chain (:func:`adopt_payload`)."""
+    payload[WIRE_KEY] = ensure(request).to_wire()
+    return payload
+
+
+def adopt_payload(request, payload: Mapping[str, Any]) -> None:
+    """Continue a journey shipped inside ``payload`` on this process's
+    ``request`` object (the decode rank of a multi-process handoff).
+    A payload without a journey leaves the request untouched —
+    :func:`ensure` at the admission site then mints a local chain, so
+    pre-journey peers still produce complete (single-process)
+    journeys."""
+    wire = payload.get(WIRE_KEY)
+    if wire:
+        request._journey = JourneyContext.from_wire(wire)
+
+
+# ----------------------------------------------------------------------
+# Merge: per-rank JSONL files -> per-request causal timelines
+# ----------------------------------------------------------------------
+
+#: |residual| floor for the TTFT decomposition check: every dur_s in
+#: the trace is rounded to 1e-9 s, and a decomposition sums a handful
+#: of them — allow a microsecond before consulting clock uncertainty.
+ROUNDING_TOLERANCE_S = 1e-6
+
+
+def _span_hop(span: Any) -> int:
+    """Hop number out of a span id (``"<journey>/<hop>"``); malformed
+    ids sort last rather than raising (a merge tool must report a
+    corrupt trace, not crash on it)."""
+    try:
+        return int(str(span).rsplit("/", 1)[1])
+    except (IndexError, ValueError):
+        return 1 << 30
+
+
+def clock_offsets(events: Iterable[Mapping[str, Any]]) -> dict:
+    """Per-rank clock alignment from ``clock_sync`` events (see
+    :mod:`~chainermn_tpu.observability.clocksync`): rank r's epoch
+    stamps shift by ``offset_s`` onto its sync peer's clock. The LAST
+    sync per rank wins (offsets drift; the freshest estimate is the
+    honest one). Returns ``{"offsets": {rank: {offset_s,
+    uncertainty_s, peer}}, "max_uncertainty_s": float}`` — the error
+    bar every cross-rank comparison must carry."""
+    offsets: dict = {}
+    for ev in events:
+        if ev.get("kind") != "clock_sync":
+            continue
+        rank = ev.get("rank", 0)
+        offsets[rank] = {
+            "offset_s": float(ev.get("offset_s", 0.0)),
+            "uncertainty_s": float(ev.get("uncertainty_s", 0.0)),
+            "peer": ev.get("peer"),
+        }
+    max_u = max((o["uncertainty_s"] for o in offsets.values()),
+                default=0.0)
+    return {"offsets": offsets, "max_uncertainty_s": round(max_u, 9)}
+
+
+def _adjust_t(ev: Mapping[str, Any], offsets: Mapping) -> Optional[float]:
+    t = ev.get("t")
+    if t is None:
+        return None
+    off = offsets.get(ev.get("rank", 0))
+    return round(float(t) + (off["offset_s"] if off else 0.0), 6)
+
+
+def decompose_ttft(events: list) -> Optional[dict]:
+    """Critical-path decomposition of one journey's TTFT from its
+    (hop-ordered) events. Components:
+
+    - ``queue_wait_s`` — the whole-journey admission wait
+      (``queue_wait`` events up to the first token),
+    - ``handoff_s`` — disaggregated export→adoption latency
+      (``kv_transfer`` events),
+    - ``prefill_s`` — prefill-event duration NET of the handoff it
+      contains on the adoption path (``admit_prefilled``'s ``dur_s``
+      spans admission→adoption, which includes the transfer — the
+      transfer must not be billed twice),
+    - ``preempt_gap_s`` — the residual ``ttft_s - (queue + prefill +
+      handoff)``: requeue gaps and re-fill work of a pre-first-token
+      preemption, which no single event measures directly.
+
+    ``residual_s`` is that same residual reported HONESTLY: for a
+    journey that was never preempted before its first token it must be
+    ~0 (sub-microsecond rounding), and the merge check holds every
+    journey's ``|residual_s|`` against rounding + clock uncertainty —
+    a blown check means the merger grouped the wrong events, exactly
+    the failure a causal-id layer exists to catch. Returns None when
+    the journey has no TTFT-bearing prefill event (e.g. finished at
+    the prefill replica, or the trace was truncated)."""
+    ttft_ev = None
+    for ev in events:
+        if (ev.get("kind") == "serving" and ev.get("phase") == "prefill"
+                and ev.get("ttft_s") is not None):
+            ttft_ev = ev
+            break
+    if ttft_ev is None:
+        return None
+    cut = _span_hop(ttft_ev.get("span"))
+    pre = [ev for ev in events if _span_hop(ev.get("span")) <= cut]
+    queue = sum(float(ev.get("dur_s") or 0.0) for ev in pre
+                if ev.get("kind") == "serving"
+                and ev.get("phase") == "queue_wait")
+    handoff = sum(float(ev.get("dur_s") or 0.0) for ev in pre
+                  if ev.get("kind") == "kv_transfer")
+    prefill_raw = sum(float(ev.get("dur_s") or 0.0) for ev in pre
+                      if ev.get("kind") == "serving"
+                      and ev.get("phase") == "prefill")
+    prefill = max(0.0, prefill_raw - handoff)
+    ttft = float(ttft_ev["ttft_s"])
+    preempts = sum(1 for ev in pre if ev.get("kind") == "serving"
+                   and ev.get("phase") == "preempt")
+    residual = ttft - (queue + prefill + handoff)
+    gap = residual if preempts else 0.0
+    out = {
+        "ttft_s": round(ttft, 9),
+        "queue_wait_s": round(queue, 9),
+        "prefill_s": round(prefill, 9),
+        "handoff_s": round(handoff, 9),
+        "preempt_gap_s": round(gap, 9),
+        "residual_s": round(residual - gap, 9),
+        "preempts_before_first_token": preempts,
+    }
+    finish = next((ev for ev in events if ev.get("kind") == "serving"
+                   and ev.get("phase") == "finish"), None)
+    if finish is not None and finish.get("dur_s") is not None:
+        total = float(finish["dur_s"])
+        out["total_s"] = round(total, 9)
+        out["decode_s"] = round(max(0.0, total - ttft), 9)
+    return out
+
+
+def merge_journeys(events: Iterable[Mapping[str, Any]], *,
+                   top: int = 5) -> dict:
+    """Merge (possibly multi-file, multi-rank) trace events into
+    per-request causal journeys. Ordering inside a journey is by HOP
+    NUMBER — the clock-free causal order the span ids encode; the
+    clock-sync offsets only shift the displayed epoch stamps
+    (``t_adj``) and set the error bar. Returns the ``journeys``
+    report section (machine-readable; ``tools/trace_report.py
+    --journeys`` renders it)."""
+    events = list(events)
+    clock = clock_offsets(events)
+    by_id: dict = {}
+    for ev in events:
+        jid = ev.get("journey")
+        if jid is not None and ev.get("span") is not None:
+            by_id.setdefault(jid, []).append(ev)
+
+    journeys = []
+    n_orphans = 0
+    n_complete = 0
+    for jid, evs in by_id.items():
+        evs.sort(key=lambda ev: _span_hop(ev.get("span")))
+        spans = {ev.get("span") for ev in evs}
+        orphans = sorted(
+            str(ev.get("span")) for ev in evs
+            if ev.get("parent") is not None
+            and ev.get("parent") not in spans
+        )
+        n_orphans += len(orphans)
+        hops = [_span_hop(ev.get("span")) for ev in evs]
+        contiguous = hops == list(range(len(hops)))
+        complete = any(ev.get("kind") == "serving"
+                       and ev.get("phase") == "finish" for ev in evs)
+        n_complete += bool(complete)
+        decomp = decompose_ttft(evs)
+        request = next((ev.get("request") for ev in evs
+                        if ev.get("request") is not None), None)
+        timeline = [{
+            "hop": _span_hop(ev.get("span")),
+            "span": ev.get("span"),
+            "parent": ev.get("parent"),
+            "kind": ev.get("kind"),
+            "phase": ev.get("phase"),
+            "rank": ev.get("rank"),
+            "pid": ev.get("pid"),
+            "t": ev.get("t"),
+            "t_adj": _adjust_t(ev, clock["offsets"]),
+            "t_mono": ev.get("t_mono"),
+            "dur_s": ev.get("dur_s"),
+        } for ev in evs]
+        journeys.append({
+            "journey": jid,
+            "request": request,
+            "n_spans": len(evs),
+            "ranks": sorted({ev.get("rank", 0) for ev in evs}),
+            "pids": sorted({ev.get("pid", 0) for ev in evs}),
+            "complete": complete,
+            "contiguous": contiguous,
+            "orphan_spans": orphans,
+            "decomposition": decomp,
+            "spans": timeline,
+        })
+
+    def slow_key(j):
+        d = j["decomposition"]
+        return -(d["ttft_s"] if d else -1.0)
+
+    journeys.sort(key=slow_key)
+    return {
+        "n_journeys": len(journeys),
+        "n_complete": n_complete,
+        "n_orphan_spans": n_orphans,
+        "clock": clock,
+        "slowest": journeys[:max(0, int(top))],
+    }
+
+
+def check_journeys(events: Iterable[Mapping[str, Any]], *,
+                   expect: Optional[int] = None) -> list:
+    """The acceptance predicate (tests + dryrun phase Q): every
+    journey is a complete, contiguous, orphan-free causal chain whose
+    TTFT decomposition sums back to the measured ``ttft_s`` within
+    rounding + the reported clock uncertainty. Returns a list of
+    problem strings — empty means the trace reconstructs cleanly."""
+    report = merge_journeys(events, top=10 ** 9)
+    tol = (ROUNDING_TOLERANCE_S
+           + report["clock"]["max_uncertainty_s"])
+    problems = []
+    if expect is not None and report["n_journeys"] != expect:
+        problems.append(
+            f"expected {expect} journeys, merged {report['n_journeys']}")
+    for j in report["slowest"]:
+        tag = f"journey {j['journey']}"
+        if not j["complete"]:
+            problems.append(f"{tag}: no finish event")
+        if not j["contiguous"]:
+            problems.append(f"{tag}: hop numbering has gaps")
+        if j["orphan_spans"]:
+            problems.append(
+                f"{tag}: orphan spans {j['orphan_spans']}")
+        d = j["decomposition"]
+        if d is None:
+            problems.append(f"{tag}: no TTFT-bearing prefill event")
+        elif abs(d["residual_s"]) > tol:
+            problems.append(
+                f"{tag}: decomposition residual {d['residual_s']}s "
+                f"exceeds tolerance {tol}s")
+    return problems
+
+
+__all__ = [
+    "JourneyContext",
+    "ROUNDING_TOLERANCE_S",
+    "WIRE_KEY",
+    "adopt_payload",
+    "attach_payload",
+    "check_journeys",
+    "clock_offsets",
+    "decompose_ttft",
+    "ensure",
+    "fields",
+    "merge_journeys",
+    "new",
+]

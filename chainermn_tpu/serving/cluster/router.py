@@ -50,6 +50,7 @@ import time
 from collections import deque
 from typing import Optional, Sequence
 
+from chainermn_tpu.observability import journey as _journey
 from chainermn_tpu.serving.cluster.replica import Replica
 from chainermn_tpu.serving.scheduler import (
     Request,
@@ -378,6 +379,7 @@ class Router:
             load=rep.load(),
             kv_blocks_free=rep.kv_blocks_free(),
             **ev_extra,
+            **_journey.fields(request),
         )
         self._publish_gauges()
         return rid
@@ -434,6 +436,7 @@ class Router:
         # so this front door, Scheduler.submit and the preemption
         # requeue can never disagree about when the journey began.
         keep_arrival(request)
+        _journey.ensure(request)  # the causal-id sibling of the rule
         self._route(request)
         return request.request_id
 
@@ -484,6 +487,11 @@ class Router:
                 t_export = time.perf_counter()
                 payload = rep.engine.export_kv(slot)
                 rep.engine.leave(slot)
+                # Journey snapshot ON the payload (ISSUE 17): in
+                # process the same Request object continues the chain;
+                # over a real wire the decode rank restores it from
+                # exactly this key (journey.adopt_payload).
+                _journey.attach_payload(payload, req)
                 dst = self._choose_decode(req.tenant_id)
                 self._pending[dst.replica_id].append(
                     (req, payload, t_export, t_admit, i))
@@ -533,6 +541,7 @@ class Router:
                     dst=i, nbytes=int(payload["nbytes"]),
                     blocks=len(payload["blocks"]),
                     dur_s=round(now - t_export, 9),
+                    **_journey.fields(req),
                 )
                 rep.scheduler.admit_prefilled(req, slot, tok,
                                               dur_s=now - t_admit)
@@ -687,6 +696,7 @@ class Router:
             load=rep.load(), kv_blocks_free=rep.kv_blocks_free(),
             **({"tenant": req.tenant_id}
                if req.tenant_id is not None else {}),
+            **_journey.fields(req),
         )
         self._publish_gauges()
         return rid

@@ -34,6 +34,7 @@ from typing import Any, Mapping, Optional, Sequence
 
 import numpy as np
 
+from chainermn_tpu.observability import journey as _journey
 from chainermn_tpu.observability import trace as _trace
 from chainermn_tpu.parallel.composition import (
     DEFAULT_RADIX,
@@ -104,6 +105,23 @@ def tree_push(
         if rk not in endpoints:
             raise ValueError(f"no endpoint for rank {rk}")
     received: dict[int, Any] = {root: payload}
+    # Causal-id hop (ISSUE 17): a dict payload ALREADY carrying a
+    # journey snapshot (a warm-up payload that started life as a
+    # request's export_kv) continues that chain — the ADVANCED snapshot
+    # is written back before any send so receivers (and any downstream
+    # adoption) parent onto this push's span. A payload WITHOUT one
+    # gets a chain minted for the trace event only: injecting the wire
+    # key would change the delivered object, and delivery fidelity
+    # (received == what the donor pushed) is the tree's contract.
+    jfields: dict = {}
+    if isinstance(payload, dict):
+        wire = payload.get(_journey.WIRE_KEY)
+        if wire:
+            ctx = _journey.JourneyContext.from_wire(wire)
+            jfields = ctx.begin_hop()
+            payload[_journey.WIRE_KEY] = ctx.to_wire()
+        else:
+            jfields = _journey.new(f"{payload_kind}-push").begin_hop()
     donor_sends = 0
     total = 0
     rounds = tree_rounds(n, radix)
@@ -135,6 +153,7 @@ def tree_push(
         rec.event(
             "tree_push", payload_kind=payload_kind, **stats,
             **({"nbytes": int(nbytes)} if nbytes is not None else {}),
+            **jfields,
         )
     return received, stats
 

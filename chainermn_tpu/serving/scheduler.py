@@ -73,6 +73,11 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Mapping, Optional, Sequence
 
+# Pure-stdlib causal-id plumbing (ISSUE 17): per-request events gain
+# journey/span/parent fields; host metadata only, so recorder-on and
+# recorder-off programs still lower identically.
+from chainermn_tpu.observability import journey as _journey
+
 POLICIES = ("fcfs", "prefill_priority", "slo")
 
 
@@ -372,6 +377,12 @@ class Request:
     #: re-admission must not emit a second whole-journey queue_wait
     #: sample (a mid-fill preemption has no _resume to signal it).
     _requeued: bool = field(default=False, repr=False)
+    #: causal journey context (ISSUE 17) — set once at the first front
+    #: door (``journey.ensure``, the keep_arrival sibling rule) and
+    #: carried across requeues/migrations; a cross-process handoff
+    #: restores it from the payload (``journey.adopt_payload``).
+    _journey: Optional[_journey.JourneyContext] = field(
+        default=None, repr=False)
 
     def __post_init__(self) -> None:
         if self.max_new_tokens < 1:
@@ -594,6 +605,7 @@ class Scheduler:
         # the last hop. keep_arrival is the ONE rule all three paths
         # share (ISSUE 11 satellite).
         keep_arrival(request)
+        _journey.ensure(request)
         pin_session_tenant(self._session_tenants, request)
         self._queue.append(request)
         self._publish_gauges()
@@ -648,7 +660,8 @@ class Scheduler:
         }
         ev: dict = dict(phase="finish", request=req.request_id,
                         generated=fl.generated, dur_s=round(dur, 9),
-                        **self._tenant_field(req))
+                        **self._tenant_field(req),
+                        **_journey.fields(req))
         # TPOT (ISSUE 11 satellite): mean inter-token latency of THIS
         # request, first token -> finish over generated-1 intervals.
         # Preemption gaps are inside it by construction — the whole-
@@ -669,7 +682,7 @@ class Scheduler:
         self._publish_gauges()
 
     def _begin_stream(self, req: Request, slot: int, tok: int, *,
-                      bucket, dur_s: float, resume: Optional[dict],
+                      bucket, t_admit: float, resume: Optional[dict],
                       chunks: Optional[int] = None) -> None:
         """Register the in-flight entry for a freshly sampled first
         token — the ONE tail both admission flavours (monolithic
@@ -678,13 +691,19 @@ class Scheduler:
         ``prefill`` event with its ``ttft_s`` sample; resumes emit it
         with ``resumed=True`` and NO ttft (the first token was already
         delivered before the preemption — re-sampling it must not
-        re-enter the TTFT percentile)."""
+        re-enter the TTFT percentile). ONE ``now`` stamp feeds both
+        ``dur_s`` (admission -> here) and ``ttft_s``: call sites used
+        to stamp their own end time, leaving a per-admission clock gap
+        between ``queue_wait + prefill`` and ``ttft_s`` — the journey
+        decomposition check (ISSUE 17) holds that identity to
+        microseconds."""
         now = time.perf_counter()
         ev: dict = dict(phase="prefill", request=req.request_id,
                         slot=slot, bucket=bucket,
                         prompt_len=len(req.prompt),
-                        dur_s=round(dur_s, 9),
-                        **self._tenant_field(req))
+                        dur_s=round(now - t_admit, 9),
+                        **self._tenant_field(req),
+                        **_journey.fields(req))
         if chunks is not None:
             ev["chunks"] = chunks
         if getattr(self.engine, "last_prefill_seq_parallel", False):
@@ -777,12 +796,14 @@ class Scheduler:
             if first_admission:
                 self._event(phase="queue_wait", request=req.request_id,
                             dur_s=round(t0 - req._arrival, 9),
-                            **self._tenant_field(req))
+                            **self._tenant_field(req),
+                            **_journey.fields(req))
             info = getattr(self.engine, "last_prefix_info", None)
             if info is not None:
                 self._event("prefix_cache", request=req.request_id,
                             slot=slot, **info,
-                            **self._tenant_field(req))
+                            **self._tenant_field(req),
+                            **_journey.fields(req))
             self._filling[slot] = _Filling(req, slot, t_admit=t0,
                                            resume=resume)
             self._publish_gauges()
@@ -794,11 +815,11 @@ class Scheduler:
         if self._fair_share:
             self._drr.charge(req.tenant_id, self._drr_cost(req))
         slot, tok, bucket = res
-        now = time.perf_counter()
         if first_admission:
             self._event(phase="queue_wait", request=req.request_id,
                         dur_s=round(t0 - req._arrival, 9),
-                        **self._tenant_field(req))
+                        **self._tenant_field(req),
+                        **_journey.fields(req))
         # Prefix-sharing accounting (ISSUE 7): the engine fills
         # last_prefix_info on every cache-on paged join — hit/miss,
         # adopted vs prefilled token counts, COW copies. Emitted here
@@ -807,13 +828,14 @@ class Scheduler:
         info = getattr(self.engine, "last_prefix_info", None)
         if info is not None:
             self._event("prefix_cache", request=req.request_id,
-                        slot=slot, **info, **self._tenant_field(req))
+                        slot=slot, **info, **self._tenant_field(req),
+                        **_journey.fields(req))
         # ttft_s: submit -> first token. The prefill samples the
         # request's first token, so TTFT = queue wait + prefill — kept
         # as its own field (not derived downstream) because the two
         # phase events may be split across truncated traces.
         self._begin_stream(req, slot, tok, bucket=bucket,
-                           dur_s=now - t0, resume=resume)
+                           t_admit=t0, resume=resume)
         return True
 
     def step(self) -> None:
@@ -920,14 +942,15 @@ class Scheduler:
                         accepted=stats["accepted"],
                         accept_lens=list(stats["accept_lens"]),
                         dur_s=round(dur, 9))
-        now = time.perf_counter()
         for f in fills:
             fill = self._filling.get(f["slot"])
             self._event("prefill_chunk",
                         request=(fill.request.request_id
                                  if fill is not None else None),
                         slot=f["slot"], chunk=f["chunk"],
-                        tokens=f["tokens"], dur_s=round(dur, 9))
+                        tokens=f["tokens"], dur_s=round(dur, 9),
+                        **(_journey.fields(fill.request)
+                           if fill is not None else {}))
         from chainermn_tpu.observability import metrics
 
         reg = metrics.active_registry()
@@ -940,7 +963,7 @@ class Scheduler:
                 continue
             fill = self._filling.pop(f["slot"])
             self._begin_stream(fill.request, f["slot"], f["first_tok"],
-                               bucket=None, dur_s=now - fill.t_admit,
+                               bucket=None, t_admit=fill.t_admit,
                                resume=fill.resume, chunks=f["chunk"] + 1)
         # Commit over the TICK-START in-flight set (takes' keys): a
         # fill promoted above joined after the forward ran and has no
@@ -1045,7 +1068,8 @@ class Scheduler:
         self._event(phase="preempt", request=req.request_id,
                     generated=generated,
                     dur_s=round(time.perf_counter() - req._arrival, 9),
-                    **self._tenant_field(req))
+                    **self._tenant_field(req),
+                    **_journey.fields(req))
         if requeue:
             keep_arrival(req)  # the unified stamp rule: no-op, by design
             self._queue.append(req)
@@ -1127,18 +1151,25 @@ class Scheduler:
             raise ValueError(f"slot {slot} already tracked in flight")
         if request.request_id is None:
             request.request_id = f"r{next(self._ids)}"
+        # Continue the journey the prefill side carried this far (the
+        # in-process router hands the SAME Request object over; a
+        # multi-process worker restores it from the payload via
+        # journey.adopt_payload before calling here) — ensure() inside
+        # fields() mints a fresh chain only for journey-less callers.
         now = time.perf_counter()
         arrival = request._arrival or now
         self._event(phase="queue_wait", request=request.request_id,
                     dur_s=round(max(0.0, (now - arrival)
                                     - (dur_s or 0.0)), 9),
-                    **self._tenant_field(request))
+                    **self._tenant_field(request),
+                    **_journey.fields(request))
         self._event(phase="prefill", request=request.request_id,
                     slot=slot, bucket=None,
                     prompt_len=len(request.prompt),
                     dur_s=round(dur_s or 0.0, 9),
                     ttft_s=round(now - arrival, 9),
-                    **self._tenant_field(request))
+                    **self._tenant_field(request),
+                    **_journey.fields(request))
         fl = _InFlight(request, slot,
                        list(request.prompt) + [int(first_tok)], 1,
                        first_token_t=now)
