@@ -138,6 +138,10 @@ class MetricsExporter:
             "in_flight_collective": _flight.in_flight(),
             "uptime_s": round(time.time() - self._t0, 3),
             "peer_snapshots": len(self._peer_snapshots),
+            # Trailing-window SLO burn per kind/tenant (ISSUE 17) —
+            # the health probe's "are we burning the error budget
+            # RIGHT NOW" answer; {} until an SLO-bearing finish lands.
+            "slo_burn": _metrics.slo_burn_rates(),
         }
 
     def merge_peer_snapshots(self, comm) -> int:

@@ -35,10 +35,12 @@ stdlib only.
 from __future__ import annotations
 
 import bisect
+import collections
 import math
 import os
 import re
 import threading
+import time
 from typing import Any, Callable, Dict, Iterable, Mapping, Optional, Tuple
 
 # Like trace.py, this module is ALSO loaded by file path from
@@ -446,6 +448,7 @@ def reset() -> None:
         _registry = None
     _tap_installed = False
     _dropped_seen = None
+    _reset_slo_window()
 
 
 def install_tap(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry:
@@ -462,6 +465,7 @@ def install_tap(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry:
         _trace.add_sink(_tap_event)
         _tap_installed = True
     reg.register_collect(_collect_recorder_health)
+    reg.register_collect(_collect_slo_burn)
     return reg
 
 
@@ -514,6 +518,89 @@ def _collect_recorder_health(reg: MetricsRegistry) -> None:
     reg.gauge(
         "trace_buffered_events", "events in the recorder's memory buffer"
     ).set(len(rec.events))
+
+
+# ----------------------------------------------------------------------
+# SLO burn rate (ISSUE 17): sliding-window violation fraction
+# ----------------------------------------------------------------------
+#
+# ``serving_slo_violations_total`` is a counter — it can only say "how
+# many ever", which makes a dashboard alert integrate-by-hand. The burn
+# rate is the operational form: the fraction of target-bearing finishes
+# inside the trailing window that MISSED their target, per (kind,
+# tenant). 0.0 = clean, 1.0 = every request burning. Window length is
+# ``CHAINERMN_TPU_SLO_WINDOW_S`` (seconds, default 60); a pair whose
+# verdicts have all aged out reads 0.0 — the gauge stays exported (a
+# vanished series and a healthy one must not look alike).
+
+_SLO_WINDOW_ENV = "CHAINERMN_TPU_SLO_WINDOW_S"
+_SLO_WINDOW_DEFAULT_S = 60.0
+
+#: (monotonic stamp, kind, tenant, ok) per finish-event verdict —
+#: monotonic, not epoch: a stepped wall clock must not dump or pin the
+#: window.
+_slo_window: collections.deque = collections.deque()
+_slo_pairs_seen: set = set()
+_slo_lock = threading.Lock()
+
+
+def _slo_window_s() -> float:
+    try:
+        v = float(os.environ.get(_SLO_WINDOW_ENV, _SLO_WINDOW_DEFAULT_S))
+    except ValueError:
+        return _SLO_WINDOW_DEFAULT_S
+    return v if v > 0 else _SLO_WINDOW_DEFAULT_S
+
+
+def _record_slo_verdict(kind: str, tenant: str, ok: bool) -> None:
+    with _slo_lock:
+        _slo_window.append((time.monotonic(), kind, tenant, bool(ok)))
+        _slo_pairs_seen.add((kind, tenant))
+
+
+def slo_burn_rates(window_s: Optional[float] = None) -> dict:
+    """``{kind: {tenant: burn}}`` over the trailing window — burn is
+    violations/total among finishes carrying that SLO verdict. Every
+    (kind, tenant) pair ever seen this process stays in the map (0.0
+    once its verdicts age out). Feeds both the ``serving_slo_burn_rate``
+    gauge and the exporter's ``/healthz`` body."""
+    if window_s is None:
+        window_s = _slo_window_s()
+    cutoff = time.monotonic() - window_s
+    counts: dict = {}
+    with _slo_lock:
+        while _slo_window and _slo_window[0][0] < cutoff:
+            _slo_window.popleft()
+        for _t, kind, tenant, ok in _slo_window:
+            tot, bad = counts.get((kind, tenant), (0, 0))
+            counts[(kind, tenant)] = (tot + 1, bad + (0 if ok else 1))
+        pairs = sorted(_slo_pairs_seen)
+    out: dict = {}
+    for kind, tenant in pairs:
+        tot, bad = counts.get((kind, tenant), (0, 0))
+        out.setdefault(kind, {})[tenant] = (
+            round(bad / tot, 6) if tot else 0.0)
+    return out
+
+
+def _reset_slo_window() -> None:
+    with _slo_lock:
+        _slo_window.clear()
+        _slo_pairs_seen.clear()
+
+
+def _collect_slo_burn(reg: MetricsRegistry) -> None:
+    """Scrape-time hook: re-derive the burn gauges from the window (a
+    sliding-window value must DECAY without new events — only a
+    collect hook, never a per-event write, can show that)."""
+    for kind, tenants in slo_burn_rates().items():
+        for tenant, burn in tenants.items():
+            reg.gauge(
+                "serving_slo_burn_rate",
+                "fraction of SLO-bearing finishes in the trailing "
+                f"window (${_SLO_WINDOW_ENV}, default "
+                f"{_SLO_WINDOW_DEFAULT_S:g}s) that missed their target",
+            ).set(burn, kind=kind, tenant=tenant)
 
 
 def _tap_event(ev: Mapping[str, Any]) -> None:
@@ -611,7 +698,10 @@ def _tap_event(ev: Mapping[str, Any]) -> None:
                         "generated tokens per tenant (from finishes)",
                     ).inc(float(gen), tenant=str(ev["tenant"]))
             # SLO verdicts (ISSUE 11): one violation count per missed
-            # target kind — a request can miss both.
+            # target kind — a request can miss both. Every verdict
+            # (pass or fail) also lands in the burn-rate window
+            # (ISSUE 17) — a rate needs the denominator too.
+            tenant = str(ev.get("tenant") or "default")
             if ev.get("slo_ttft_ok") is False:
                 reg.counter(
                     "serving_slo_violations_total",
@@ -622,6 +712,10 @@ def _tap_event(ev: Mapping[str, Any]) -> None:
                     "serving_slo_violations_total",
                     "finished requests outside a stated SLO target",
                 ).inc(kind="tpot")
+            if ev.get("slo_ttft_ok") is not None:
+                _record_slo_verdict("ttft", tenant, ev["slo_ttft_ok"])
+            if ev.get("slo_tpot_ok") is not None:
+                _record_slo_verdict("tpot", tenant, ev["slo_tpot_ok"])
         elif phase == "preempt":
             reg.counter(
                 "serving_preemptions_total",

@@ -170,6 +170,12 @@ class Recorder:
             "schema": TRACE_SCHEMA,
             "kind": kind,
             "t": round(time.time(), 6),
+            # Monotonic sibling stamp (ISSUE 17 satellite): ``t`` is
+            # epoch (comparable across processes once clock-synced but
+            # steppable by NTP/admin), ``t_mono`` is perf_counter
+            # (process-local, step-free) — same-process ordering in
+            # the journey merger reads THIS, never the wall clock.
+            "t_mono": round(time.perf_counter(), 9),
             "pid": os.getpid(),
             "rank": self._rank,
             **fields,
@@ -245,6 +251,7 @@ class Recorder:
                         self._file.write(json.dumps({
                             "schema": TRACE_SCHEMA, "kind": "meta",
                             "t": round(time.time(), 6),
+                            "t_mono": round(time.perf_counter(), 9),
                             "pid": os.getpid(), "rank": self._rank,
                             "dropped_events": self.dropped,
                         }) + "\n")
@@ -837,8 +844,15 @@ def chrome_trace(events: Iterable[Mapping[str, Any]]) -> dict:
     """Convert trace events to the Chrome trace-event format (load in
     ``chrome://tracing`` or https://ui.perfetto.dev). Events with a
     duration become complete ('X') slices; instants become 'i' marks.
-    pid = process rank, tid = event kind — one track per subsystem."""
+    pid = process rank, tid = event kind — one track per subsystem.
+    Journey-linked spans (ISSUE 17) whose ``parent`` span lives on a
+    DIFFERENT rank additionally emit a flow-arrow pair (``ph: s``/``f``,
+    ``bp: e``) so cross-rank handoffs render as arrows between pids."""
     out = []
+    # span id -> (end ts us, rank, kind) for the flow pass; same-rank
+    # parent links stay implicit (one pid track already reads in order).
+    span_ix: dict = {}
+    flows: list = []
     for ev in events:
         kind = ev.get("kind", "?")
         if kind == "meta":
@@ -847,7 +861,8 @@ def chrome_trace(events: Iterable[Mapping[str, Any]]) -> dict:
         name = ev.get("op") or ev.get("name") or kind
         ts = float(ev.get("t", 0.0)) * 1e6
         args = {k: v for k, v in ev.items()
-                if k not in ("kind", "t", "pid", "rank", "schema")}
+                if k not in ("kind", "t", "t_mono", "pid", "rank",
+                             "schema")}
         base = {
             "name": str(name),
             "cat": kind,
@@ -858,11 +873,29 @@ def chrome_trace(events: Iterable[Mapping[str, Any]]) -> dict:
         if dur:
             # 't' stamps event END for spans recorded at exit; chrome
             # wants the start.
-            out.append({**base, "ph": "X",
-                        "ts": ts - float(dur) * 1e6,
+            start = ts - float(dur) * 1e6
+            out.append({**base, "ph": "X", "ts": start,
                         "dur": float(dur) * 1e6})
         else:
+            start = ts
             out.append({**base, "ph": "i", "ts": ts, "s": "p"})
+        span = ev.get("span")
+        if span is not None:
+            span_ix[span] = (ts, ev.get("rank", 0), kind)
+            parent = ev.get("parent")
+            if parent is not None:
+                flows.append((parent, start, ev.get("rank", 0), kind,
+                              str(ev.get("journey", span))))
+    for n, (parent, start, rank, kind, journey) in enumerate(flows):
+        src = span_ix.get(parent)
+        if src is None or src[1] == rank:
+            continue  # orphan link or same-rank hop — no arrow
+        p_ts, p_rank, p_kind = src
+        flow = {"name": journey, "cat": "journey", "id": n + 1}
+        out.append({**flow, "ph": "s", "ts": p_ts, "pid": p_rank,
+                    "tid": p_kind})
+        out.append({**flow, "ph": "f", "bp": "e", "ts": max(start, p_ts),
+                    "pid": rank, "tid": kind})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 

@@ -4,7 +4,11 @@ byte/time tables (ISSUE 2: the consumer side of the wire counters).
 
 Usage::
 
-    python tools/trace_report.py TRACE.jsonl [--json] [--chrome OUT.json]
+    python tools/trace_report.py TRACE.jsonl [MORE.jsonl ...]
+        [--json] [--chrome OUT.json] [--journeys] [--top K]
+
+Multiple JSONL files concatenate before summarizing — the per-rank
+trace files of one cluster run merge into one report.
 
 Sections:
 
@@ -46,6 +50,14 @@ Sections:
   index over the token totals; events without a ``tenant`` tag fall
   back to one ``'default'`` tenant so pre-tenant traces keep parsing.
   Omitted when the trace has no serving events.
+- **journeys** (``--journeys``; ISSUE 17) — per-request CAUSAL
+  timelines merged across ranks by journey/span ids (hop order, never
+  clock order), epoch stamps aligned by the traced ``clock_sync``
+  offsets and displayed WITH their uncertainty, the top-K slowest
+  requests by TTFT, and per-journey TTFT critical-path decomposition
+  (queue wait / prefill / handoff / preemption gap — the components
+  sum back to the measured ``ttft_s`` within rounding + clock
+  uncertainty, or the report says so loudly).
 - **stragglers** — flagged divergence reports, if any.
 - **roofline** — where a device kind with a known HBM peak appears
   (bench.py's per-kind tables, the same floors tools/byte_audit.py
@@ -88,8 +100,30 @@ def _trace_mod():
     return mod
 
 
-def _read_events(path: str) -> list[dict]:
-    return _trace_mod().read_jsonl(path)
+def _journey_mod():
+    """The journey merge module, loaded the same file-path way (pure
+    stdlib by contract — see its module docstring)."""
+    import importlib.util
+
+    path = os.path.join(
+        _HERE, "chainermn_tpu", "observability", "journey.py")
+    spec = importlib.util.spec_from_file_location("_obs_journey", path)
+    mod = importlib.util.module_from_spec(spec)
+    # Register BEFORE exec: @dataclass resolves its defining module
+    # through sys.modules (3.10's KW_ONLY probe dies on None).
+    sys.modules["_obs_journey"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read_events(paths) -> list[dict]:
+    if isinstance(paths, str):
+        paths = [paths]
+    tm = _trace_mod()
+    events: list[dict] = []
+    for p in paths:
+        events.extend(tm.read_jsonl(p))
+    return events
 
 
 def _hbm_peak(device_kind: str):
@@ -524,19 +558,90 @@ def render_text(s: dict) -> str:
     return "\n".join(lines)
 
 
+def render_journeys(j: dict) -> str:
+    """Human rendering of the :func:`journey.merge_journeys` section."""
+    lines = []
+    clock = j["clock"]
+    lines.append(
+        f"journeys: {j['n_journeys']} merged, {j['n_complete']} "
+        f"complete, {j['n_orphan_spans']} orphan span(s)"
+    )
+    if clock["offsets"]:
+        for rank, off in sorted(clock["offsets"].items()):
+            lines.append(
+                f"  clock: rank {rank} offset "
+                f"{off['offset_s'] * 1e3:+.3f} ms to rank "
+                f"{off['peer']} (± {off['uncertainty_s'] * 1e3:.3f} ms)"
+            )
+    else:
+        lines.append(
+            "  clock: no clock_sync events — cross-rank stamps are "
+            "raw epochs (uncertainty unbounded)"
+        )
+    for row in j["slowest"]:
+        d = row["decomposition"]
+        head = (f"  {row['journey']}: {row['n_spans']} span(s) over "
+                f"rank(s) {row['ranks']}")
+        if not row["complete"]:
+            head += "  [INCOMPLETE: no finish]"
+        if not row["contiguous"]:
+            head += "  [HOP GAPS]"
+        if row["orphan_spans"]:
+            head += f"  [ORPHANS: {row['orphan_spans']}]"
+        lines.append(head)
+        if d is not None:
+            parts = [
+                f"queue {d['queue_wait_s'] * 1e3:.3f}",
+                f"prefill {d['prefill_s'] * 1e3:.3f}",
+                f"handoff {d['handoff_s'] * 1e3:.3f}",
+            ]
+            if d["preempts_before_first_token"]:
+                parts.append(
+                    f"preempt-gap {d['preempt_gap_s'] * 1e3:.3f} "
+                    f"({d['preempts_before_first_token']} preempt(s))")
+            decomp = (f"    TTFT {d['ttft_s'] * 1e3:.3f} ms = "
+                      + " + ".join(parts)
+                      + f"  (residual {d['residual_s'] * 1e3:+.4f} ms)")
+            lines.append(decomp)
+            if d.get("total_s") is not None:
+                lines.append(
+                    f"    total {d['total_s'] * 1e3:.3f} ms "
+                    f"(decode {d['decode_s'] * 1e3:.3f} ms)")
+        for sp in row["spans"]:
+            what = sp["phase"] or sp["kind"]
+            dur = (f"  dur {sp['dur_s'] * 1e3:.3f} ms"
+                   if sp.get("dur_s") is not None else "")
+            lines.append(
+                f"    hop {sp['hop']:<2} rank {sp['rank']} "
+                f"{what:<14} t_adj {sp['t_adj']}{dur}"
+            )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Summarize a chainermn_tpu observability JSONL trace"
     )
-    ap.add_argument("trace", help="path to the JSONL trace")
+    ap.add_argument("trace", nargs="+",
+                    help="JSONL trace file(s) — per-rank files of one "
+                         "run concatenate before summarizing")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary")
     ap.add_argument("--chrome", metavar="OUT",
                     help="also write a Chrome-trace/Perfetto JSON file")
+    ap.add_argument("--journeys", action="store_true",
+                    help="merge per-request causal journeys across "
+                         "ranks (ISSUE 17) and report the slowest")
+    ap.add_argument("--top", type=int, default=5,
+                    help="journeys to show in the slowest table "
+                         "(default 5)")
     args = ap.parse_args(argv)
 
     events = _read_events(args.trace)
     summary = summarize(events)
+    if args.journeys:
+        summary["journeys"] = _journey_mod().merge_journeys(
+            events, top=args.top)
     # Loud on stderr too, so --json pipelines (and humans paging the
     # table) cannot miss a lossy trace.
     if summary["meta"].get("dropped_events"):
@@ -555,7 +660,10 @@ def main(argv=None) -> int:
         if args.json:
             print(json.dumps(summary, sort_keys=True))
         else:
-            print(render_text(summary))
+            text = render_text(summary)
+            if args.journeys:
+                text += "\n\n" + render_journeys(summary["journeys"])
+            print(text)
     except BrokenPipeError:
         # piped into head/less that closed early — not an error
         try:
