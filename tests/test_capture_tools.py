@@ -529,6 +529,159 @@ def test_trace_report_contract(tmp_path):
         assert token in proc2.stdout, (token, proc2.stdout)
 
 
+def _golden_journey_lines():
+    """A two-rank disaggregated journey as two per-rank JSONL files:
+    rank 0 routes (hop 0), rank 1 syncs its clock, adopts the KV
+    payload and decodes (hops 1-4). Durations are exact binary
+    fractions so the pinned decomposition has ZERO float drift."""
+    import json as _json
+
+    jid = "r0@b.0"
+    rank0 = [
+        {"schema": 1, "kind": "meta", "t": 1.0, "pid": 11, "rank": 0,
+         "started_at": "2026-08-07T00:00:00Z", "sync": False,
+         "source": "cluster"},
+        {"schema": 1, "kind": "route", "t": 10.0, "t_mono": 100.0,
+         "pid": 11, "rank": 0, "request": "r0", "replica": 1,
+         "journey": jid, "span": f"{jid}/0"},
+    ]
+    rank1 = [
+        {"schema": 1, "kind": "clock_sync", "t": 9.5, "t_mono": 200.0,
+         "pid": 22, "rank": 1, "peer": 0, "offset_s": -0.5,
+         "uncertainty_s": 0.001, "min_rtt_s": 0.002, "n": 8},
+        {"schema": 1, "kind": "kv_transfer", "t": 10.5, "t_mono": 200.5,
+         "pid": 22, "rank": 1, "request": "r0", "dur_s": 0.25,
+         "journey": jid, "span": f"{jid}/1", "parent": f"{jid}/0"},
+        {"schema": 1, "kind": "serving", "phase": "queue_wait",
+         "t": 10.75, "t_mono": 200.75, "pid": 22, "rank": 1,
+         "request": "r0", "dur_s": 0.25, "journey": jid,
+         "span": f"{jid}/2", "parent": f"{jid}/1"},
+        {"schema": 1, "kind": "serving", "phase": "prefill", "t": 11.0,
+         "t_mono": 201.0, "pid": 22, "rank": 1, "request": "r0",
+         "slot": 0, "bucket": None, "prompt_len": 4, "dur_s": 0.5,
+         "ttft_s": 0.75, "journey": jid, "span": f"{jid}/3",
+         "parent": f"{jid}/2"},
+        {"schema": 1, "kind": "serving", "phase": "finish", "t": 11.25,
+         "t_mono": 201.25, "pid": 22, "rank": 1, "request": "r0",
+         "generated": 3, "dur_s": 1.0, "journey": jid,
+         "span": f"{jid}/4", "parent": f"{jid}/3"},
+    ]
+    return ([_json.dumps(e) for e in rank0],
+            [_json.dumps(e) for e in rank1])
+
+
+def test_journey_report_contract(tmp_path):
+    """ISSUE 17 golden: multi-file JSONL in -> stable ``--journeys``
+    section out (full-dict equality — the causal-merge contract), flow
+    events in the Chrome export for the cross-rank hop, and the human
+    rendering's essentials."""
+    import json as _json
+    import sys
+
+    jid = "r0@b.0"
+    lines0, lines1 = _golden_journey_lines()
+    f0, f1 = tmp_path / "rank0.jsonl", tmp_path / "rank1.jsonl"
+    f0.write_text("\n".join(lines0) + "\n")
+    f1.write_text("\n".join(lines1) + "\n")
+    chrome_file = tmp_path / "chrome.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         str(f0), str(f1), "--json", "--journeys",
+         "--chrome", str(chrome_file)],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    summary = _json.loads(proc.stdout)
+    assert summary["n_events"] == 7  # both files concatenated
+    assert summary["journeys"] == {
+        "n_journeys": 1,
+        "n_complete": 1,
+        "n_orphan_spans": 0,
+        # rank 1 is 500 ms BEHIND rank 0's epoch, known to ±1 ms
+        "clock": {
+            "offsets": {"1": {"offset_s": -0.5, "uncertainty_s": 0.001,
+                              "peer": 0}},
+            "max_uncertainty_s": 0.001,
+        },
+        "slowest": [{
+            "journey": jid,
+            "request": "r0",
+            "n_spans": 5,
+            "ranks": [0, 1],
+            "pids": [11, 22],
+            "complete": True,
+            "contiguous": True,
+            "orphan_spans": [],
+            # 0.25 queue + 0.25 net prefill (0.5 raw minus the 0.25
+            # handoff it contains) + 0.25 handoff = the 0.75 TTFT
+            "decomposition": {
+                "ttft_s": 0.75,
+                "queue_wait_s": 0.25,
+                "prefill_s": 0.25,
+                "handoff_s": 0.25,
+                "preempt_gap_s": 0.0,
+                "residual_s": 0.0,
+                "preempts_before_first_token": 0,
+                "total_s": 1.0,
+                "decode_s": 0.25,
+            },
+            # hop order (clock-free); t_adj = t + the traced offset
+            "spans": [
+                {"hop": 0, "span": f"{jid}/0", "parent": None,
+                 "kind": "route", "phase": None, "rank": 0, "pid": 11,
+                 "t": 10.0, "t_adj": 10.0, "t_mono": 100.0,
+                 "dur_s": None},
+                {"hop": 1, "span": f"{jid}/1", "parent": f"{jid}/0",
+                 "kind": "kv_transfer", "phase": None, "rank": 1,
+                 "pid": 22, "t": 10.5, "t_adj": 10.0, "t_mono": 200.5,
+                 "dur_s": 0.25},
+                {"hop": 2, "span": f"{jid}/2", "parent": f"{jid}/1",
+                 "kind": "serving", "phase": "queue_wait", "rank": 1,
+                 "pid": 22, "t": 10.75, "t_adj": 10.25,
+                 "t_mono": 200.75, "dur_s": 0.25},
+                {"hop": 3, "span": f"{jid}/3", "parent": f"{jid}/2",
+                 "kind": "serving", "phase": "prefill", "rank": 1,
+                 "pid": 22, "t": 11.0, "t_adj": 10.5, "t_mono": 201.0,
+                 "dur_s": 0.5},
+                {"hop": 4, "span": f"{jid}/4", "parent": f"{jid}/3",
+                 "kind": "serving", "phase": "finish", "rank": 1,
+                 "pid": 22, "t": 11.25, "t_adj": 10.75,
+                 "t_mono": 201.25, "dur_s": 1.0},
+            ],
+        }],
+    }, summary["journeys"]
+    # Chrome export: 6 non-meta base events + ONE s/f flow pair for the
+    # single cross-rank hop (route on rank 0 -> kv_transfer on rank 1);
+    # the rank-1-internal hops draw no arrows.
+    chrome = _json.loads(chrome_file.read_text())
+    flows = [e for e in chrome["traceEvents"] if e["ph"] in ("s", "f")]
+    assert len(chrome["traceEvents"]) == 8
+    assert [e["ph"] for e in flows] == ["s", "f"]
+    assert flows[0]["id"] == flows[1]["id"]
+    assert flows[0]["pid"] == 0 and flows[1]["pid"] == 1
+    assert flows[1]["bp"] == "e"
+    assert flows[0]["name"] == jid and flows[0]["cat"] == "journey"
+    # t_mono stays a clock, not an arg, on every slice
+    assert all("t_mono" not in e.get("args", {})
+               for e in chrome["traceEvents"])
+    # human rendering: the decomposition line and the clock error bar
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         str(f0), str(f1), "--journeys"],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc2.returncode == 0
+    for token in ("journeys: 1 merged, 1 complete, 0 orphan span(s)",
+                  "clock: rank 1 offset -500.000 ms to rank 0 "
+                  "(± 1.000 ms)",
+                  "TTFT 750.000 ms = queue 250.000 + prefill 250.000 "
+                  "+ handoff 250.000  (residual +0.0000 ms)",
+                  "total 1000.000 ms (decode 250.000 ms)",
+                  "hop 1  rank 1 kv_transfer    t_adj 10.0  "
+                  "dur 250.000 ms"):
+        assert token in proc2.stdout, (token, proc2.stdout)
+
+
 def test_trace_report_roofline_scoped_to_device_plane(tmp_path):
     """Roofline floors apply only to device-plane ops, against the
     device kinds they actually ran on — a host-plane pickle transfer

@@ -1,0 +1,528 @@
+"""Cross-rank request journeys (ISSUE 17).
+
+The load-bearing acceptance pins:
+
+- **Causal chain completeness** — every request routed through a
+  2-replica disaggregated cluster reconstructs from the trace to ONE
+  complete, contiguous, orphan-free journey, and its TTFT critical-path
+  decomposition (queue wait / prefill / handoff / preemption gap) sums
+  back to the measured ``ttft_s`` within rounding + clock uncertainty
+  (``journey.check_journeys`` — the same predicate dryrun phase Q
+  drives).
+- **Clock-sync honesty** — the NTP-style two-way estimate recovers a
+  simulated skew to within its OWN reported uncertainty, and the merge
+  shifts cross-rank stamps by exactly the traced offset.
+- **Chrome flows** — journey-linked spans whose parent lives on a
+  different rank emit paired ``ph: s``/``f`` flow events; same-rank
+  hops do not.
+- **SLO burn rate** — finish-event verdicts land in the sliding
+  window; the scrape-time gauge reads violations/total per
+  (kind, tenant) and DECAYS to 0.0 (series kept) once verdicts age out.
+
+The true multi-process form (per-rank JSONL files, real clock offsets
+over the native TCP plane) is the slow-marked drill at the bottom,
+riding ``cluster_worker.py`` with ``CHAINERMN_TPU_JOURNEY_DIR`` set.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.observability import clocksync, journey, metrics, trace
+from chainermn_tpu.serving import Request, Scheduler, ServingEngine
+from chainermn_tpu.serving.cluster import (
+    LoopbackHub,
+    Router,
+    make_replicas,
+)
+from chainermn_tpu.serving.cluster.tree_push import tree_push
+
+VOCAB = 32
+
+
+def tiny_lm(**kw):
+    cfg = dict(vocab_size=VOCAB, num_layers=2, num_heads=4, d_model=16,
+               d_ff=32, max_len=64, compute_dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    return model, params
+
+
+@pytest.fixture(autouse=True)
+def _isolated_plane():
+    trace.disable()
+    metrics.reset()
+    yield
+    trace.disable()
+    metrics.reset()
+
+
+ENGINE_KW = dict(num_slots=4, max_len=64, decode_impl="paged",
+                 kv_block_size=8, prefill_buckets=(4, 8, 16))
+
+
+# ----------------------------------------------------------------------
+# JourneyContext mechanics
+# ----------------------------------------------------------------------
+
+
+def test_journey_context_linear_chain():
+    ctx = journey.new("r1")
+    f0 = ctx.begin_hop()
+    f1 = ctx.begin_hop()
+    f2 = ctx.begin_hop()
+    assert f0["span"] == f"{ctx.journey}/0" and "parent" not in f0
+    assert f1["parent"] == f0["span"]
+    assert f2["parent"] == f1["span"]
+    assert f0["journey"] == f1["journey"] == ctx.journey
+    # readable prefix + cluster-unique suffix
+    assert ctx.journey.startswith("r1@")
+
+
+def test_journey_ids_unique_across_same_request_id():
+    a, b = journey.new("dup"), journey.new("dup")
+    assert a.journey != b.journey
+
+
+def test_wire_roundtrip_continues_not_restarts():
+    ctx = journey.new("w")
+    first = ctx.begin_hop()
+    other = journey.JourneyContext.from_wire(ctx.to_wire())
+    nxt = other.begin_hop()
+    assert nxt["parent"] == first["span"]
+    assert nxt["span"] == f"{ctx.journey}/1"
+
+
+def test_ensure_is_keep_arrival_sibling():
+    req = Request(prompt=[1, 2], max_new_tokens=2)
+    ctx = journey.ensure(req)
+    assert journey.ensure(req) is ctx  # second front door: no restart
+
+
+def test_attach_adopt_payload():
+    src = Request(prompt=[1], max_new_tokens=2, request_id="x")
+    journey.ensure(src).begin_hop()
+    payload = journey.attach_payload({"schema": 1}, src)
+    dst = Request(prompt=[1], max_new_tokens=2, request_id="x")
+    journey.adopt_payload(dst, payload)
+    assert journey.fields(dst)["parent"] == src._journey.last_span
+    # a journey-less payload leaves the request untouched
+    clean = Request(prompt=[1], max_new_tokens=2)
+    journey.adopt_payload(clean, {"schema": 1})
+    assert clean._journey is None
+
+
+# ----------------------------------------------------------------------
+# Clock sync
+# ----------------------------------------------------------------------
+
+
+def test_estimate_offset_hand_math():
+    # one exchange: t0=0, server says 5.0, t1=0.2 -> offset 4.9, ±0.1
+    est = clocksync.estimate_offset([(0.0, 5.0, 0.2)])
+    assert est["offset_s"] == pytest.approx(4.9)
+    assert est["uncertainty_s"] == pytest.approx(0.1)
+    assert est["min_rtt_s"] == pytest.approx(0.2)
+    # median rejects one polluted exchange
+    est = clocksync.estimate_offset(
+        [(0.0, 5.0, 0.2), (1.0, 6.0, 1.2), (2.0, 99.0, 2.2)])
+    assert est["offset_s"] == pytest.approx(4.9)
+    with pytest.raises(ValueError):
+        clocksync.estimate_offset([])
+
+
+def test_loopback_sync_recovers_simulated_skew():
+    hub = LoopbackHub()
+    e0, e1 = hub.endpoint(0), hub.endpoint(1)
+    skew = 0.25  # client runs 250 ms ahead of the server
+    rec = trace.enable(None)
+    est = clocksync.sync_client(
+        e1, 0, n=6,
+        pump=lambda: clocksync.sync_server_step(e0, 1),
+        clock=lambda: time.time() + skew,
+    )
+    # offset = server - client = -skew, within the reported error bar
+    assert abs(est["offset_s"] + skew) <= est["uncertainty_s"] + 1e-3
+    ev = [e for e in rec.events if e["kind"] == "clock_sync"]
+    assert len(ev) == 1 and ev[0]["peer"] == 0
+    assert ev[0]["offset_s"] == est["offset_s"]
+    assert ev[0]["n"] == 6
+
+
+def test_merge_applies_traced_offset():
+    evs = [
+        {"schema": 1, "kind": "clock_sync", "t": 0.0, "rank": 1,
+         "peer": 0, "offset_s": -2.5, "uncertainty_s": 0.001,
+         "min_rtt_s": 0.002, "n": 4},
+        {"schema": 1, "kind": "route", "t": 10.0, "rank": 0,
+         "journey": "j", "span": "j/0"},
+        {"schema": 1, "kind": "serving", "phase": "finish", "t": 13.0,
+         "rank": 1, "journey": "j", "span": "j/1", "parent": "j/0"},
+    ]
+    rep = journey.merge_journeys(evs)
+    assert rep["clock"]["offsets"][1]["offset_s"] == -2.5
+    assert rep["clock"]["max_uncertainty_s"] == pytest.approx(0.001)
+    spans = rep["slowest"][0]["spans"]
+    assert spans[0]["t_adj"] == 10.0  # rank 0: no offset traced
+    assert spans[1]["t_adj"] == pytest.approx(10.5)  # 13.0 - 2.5
+
+
+# ----------------------------------------------------------------------
+# Decomposition + merge checks (synthetic)
+# ----------------------------------------------------------------------
+
+
+def _chain(jid, rows):
+    out = []
+    for hop, ev in enumerate(rows):
+        ev = dict(ev, journey=jid, span=f"{jid}/{hop}")
+        if hop:
+            ev["parent"] = f"{jid}/{hop - 1}"
+        ev.setdefault("schema", 1)
+        ev.setdefault("rank", 0)
+        out.append(ev)
+    return out
+
+
+def test_decompose_preempt_gap_attribution():
+    evs = _chain("p", [
+        {"kind": "route", "t": 0.0},
+        {"kind": "serving", "phase": "queue_wait", "t": 1.0,
+         "dur_s": 0.1},
+        {"kind": "serving", "phase": "preempt", "t": 1.5},
+        {"kind": "serving", "phase": "queue_wait", "t": 2.0,
+         "dur_s": 0.2},
+        {"kind": "serving", "phase": "prefill", "t": 2.5, "dur_s": 0.3,
+         "ttft_s": 1.0},
+        {"kind": "serving", "phase": "finish", "t": 3.0, "dur_s": 1.4},
+    ])
+    d = journey.decompose_ttft(evs)
+    # 1.0 - (0.3 queue + 0.3 prefill) = 0.4 requeue gap, attributed
+    # because a preempt precedes the first token; residual stays ~0
+    assert d["queue_wait_s"] == pytest.approx(0.3)
+    assert d["prefill_s"] == pytest.approx(0.3)
+    assert d["preempt_gap_s"] == pytest.approx(0.4)
+    assert abs(d["residual_s"]) < 1e-9
+    assert d["preempts_before_first_token"] == 1
+    assert d["decode_s"] == pytest.approx(0.4)  # 1.4 total - 1.0 ttft
+
+
+def test_check_journeys_flags_bad_chains():
+    good = _chain("g", [
+        {"kind": "serving", "phase": "prefill", "t": 1.0, "dur_s": 0.1,
+         "ttft_s": 0.1},
+        {"kind": "serving", "phase": "finish", "t": 2.0, "dur_s": 0.2},
+    ])
+    assert journey.check_journeys(good, expect=1) == []
+    # no finish -> incomplete
+    assert any("no finish" in p
+               for p in journey.check_journeys(good[:1]))
+    # hop gap + orphan parent
+    gap = [dict(good[0]), dict(good[1], span="g/5", parent="g/4")]
+    probs = journey.check_journeys(gap)
+    assert any("gaps" in p for p in probs)
+    assert any("orphan" in p for p in probs)
+    # blown decomposition residual: ttft_s disagrees with components
+    bad = _chain("b", [
+        {"kind": "serving", "phase": "queue_wait", "t": 0.5,
+         "dur_s": 0.5},
+        {"kind": "serving", "phase": "prefill", "t": 1.0, "dur_s": 0.1,
+         "ttft_s": 0.1},
+        {"kind": "serving", "phase": "finish", "t": 2.0, "dur_s": 0.2},
+    ])
+    assert any("residual" in p for p in journey.check_journeys(bad))
+    # wrong journey count
+    assert any("expected 2" in p
+               for p in journey.check_journeys(good, expect=2))
+
+
+# ----------------------------------------------------------------------
+# Chrome flow arrows
+# ----------------------------------------------------------------------
+
+
+def test_chrome_flow_events_cross_rank_only():
+    evs = _chain("f", [
+        {"kind": "route", "t": 1.0, "rank": 0},
+        {"kind": "kv_transfer", "t": 1.1, "rank": 1, "dur_s": 0.05},
+        {"kind": "serving", "phase": "prefill", "t": 1.2, "rank": 1,
+         "dur_s": 0.01},
+    ])
+    ct = trace.chrome_trace(evs)
+    flows = [e for e in ct["traceEvents"] if e["ph"] in ("s", "f")]
+    # exactly the rank-0 -> rank-1 hop draws an arrow; the same-rank
+    # hop 1 -> hop 2 does not
+    assert [e["ph"] for e in flows] == ["s", "f"]
+    s, f = flows
+    assert s["id"] == f["id"] and f["bp"] == "e"
+    assert (s["pid"], f["pid"]) == (0, 1)
+    assert f["ts"] >= s["ts"]
+    assert s["cat"] == f["cat"] == "journey"
+    # t_mono is a clock, not an arg — excluded like t itself
+    xs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert all("t_mono" not in e["args"] for e in xs)
+
+
+def test_event_record_carries_t_mono():
+    rec = trace.enable(None)
+    rec.event("route")
+    ev = rec.events[-1]
+    assert {"t", "t_mono", "pid", "rank"} <= set(ev)
+    assert ev["t_mono"] == pytest.approx(time.perf_counter(), abs=5.0)
+
+
+# ----------------------------------------------------------------------
+# The tier-1 cluster pin: 2-replica disaggregated journeys reconstruct
+# ----------------------------------------------------------------------
+
+
+def test_disaggregated_journeys_reconstruct(lm):
+    """Every request through the disaggregated router merges to ONE
+    complete causal chain whose decomposition sums to its measured
+    TTFT — the acceptance predicate over a real (in-process) cluster
+    trace, with the handoff visible as a nonzero component."""
+    model, params = lm
+    rec = trace.enable(None)
+    reps = make_replicas(model, params, 2, **ENGINE_KW)
+    router = Router(reps, mode="disaggregated", prefill_replicas=[0])
+    rs = np.random.RandomState(7)
+    n = 5
+    for i in range(n):
+        p = rs.randint(1, VOCAB, size=int(rs.randint(2, 6))).tolist()
+        router.submit(Request(prompt=p,
+                              max_new_tokens=int(rs.randint(2, 5))))
+    router.run()
+    evs = list(rec.events)
+    assert journey.check_journeys(evs, expect=n) == []
+    rep = journey.merge_journeys(evs, top=n)
+    assert rep["n_complete"] == n and rep["n_orphan_spans"] == 0
+    for j in rep["slowest"]:
+        d = j["decomposition"]
+        # the disaggregated handoff is ON the critical path and billed
+        # exactly once (prefill is net of it)
+        assert d["handoff_s"] > 0.0
+        assert d["queue_wait_s"] >= 0.0 and d["prefill_s"] >= 0.0
+        total = (d["queue_wait_s"] + d["prefill_s"] + d["handoff_s"]
+                 + d["preempt_gap_s"] + d["residual_s"])
+        assert total == pytest.approx(d["ttft_s"], abs=1e-6)
+        kinds = [s["kind"] for s in j["spans"]]
+        assert kinds[0] == "route" and "kv_transfer" in kinds
+
+
+def test_recorder_on_off_decode_hlo_identical(lm):
+    """The journey plane is host-side by construction: the jitted
+    decode program lowers to byte-identical HLO whether the recorder
+    (and with it every journey-decorated event site) is off, or on
+    with requests actively flowing — the test_trace certificate,
+    extended over the ISSUE 17 wiring."""
+    model, params = lm
+
+    def decode_hlo(engine):
+        n = ENGINE_KW["num_slots"]
+        args = (
+            engine._cache, engine._vars,
+            jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
+            jnp.asarray(engine._dummy_tables()), engine._key,
+        )
+        return engine._decode_step_jit.lower(*args).compile().as_text()
+
+    off = decode_hlo(ServingEngine(model, params, **ENGINE_KW))
+    rec = trace.enable(None)
+    engine = ServingEngine(model, params, **ENGINE_KW)
+    sched = Scheduler(engine)
+    sched.submit(Request(prompt=[3, 5, 7], max_new_tokens=3))
+    sched.run()
+    assert any("journey" in e for e in rec.events)  # plane was live
+    assert decode_hlo(engine) == off
+
+
+def test_preempted_journey_stays_one_chain(lm):
+    """Preemption extends the chain (route -> ... -> preempt -> route
+    -> ...) instead of forking it: one journey id, contiguous hops,
+    decomposition still sums (gap attributed)."""
+    model, params = lm
+    rec = trace.enable(None)
+    reps = make_replicas(model, params, 2, **ENGINE_KW)
+    router = Router(reps, mode="colocated", policy="least_loaded")
+    rs = np.random.RandomState(11)
+    p = rs.randint(1, VOCAB, size=4).tolist()
+    rid = router.submit(Request(prompt=p, max_new_tokens=4))
+    # drive the holding replica until the request is in flight, then
+    # migrate it to the other replica
+    src = next(i for i, rep in router.replicas.items()
+               if rep.load() > 0)
+    for _ in range(2):
+        router.replicas[src].tick()
+    dst = router.preempt_request(rid)
+    assert dst != src
+    router.run()
+    evs = list(rec.events)
+    mine = [e for e in evs if e.get("journey")
+            and str(e["journey"]).startswith(f"{rid}@")]
+    jids = {e["journey"] for e in mine}
+    assert len(jids) == 1  # migration did NOT restart the chain
+    assert journey.check_journeys(evs, expect=1) == []
+    assert sum(1 for e in mine if e["kind"] == "route") >= 2
+    assert any(e.get("phase") == "preempt" for e in mine)
+
+
+# ----------------------------------------------------------------------
+# tree_push journey hops
+# ----------------------------------------------------------------------
+
+
+def test_tree_push_continues_or_mints_journey():
+    hub = LoopbackHub()
+    endpoints = {r: hub.endpoint(r) for r in range(3)}
+    rec = trace.enable(None)
+    # dict payload WITHOUT a journey: the push mints one
+    tree_push({"schema": 1}, endpoints, [0, 1, 2],
+              payload_kind="adapter")
+    ev = [e for e in rec.events if e["kind"] == "tree_push"][-1]
+    assert ev["journey"].startswith("adapter-push@")
+    assert ev["span"].endswith("/0")
+    # payload WITH a journey: the push parents onto the carried span
+    src = Request(prompt=[1], max_new_tokens=2, request_id="warm")
+    prior = journey.fields(src)
+    payload = journey.attach_payload({"schema": 1}, src)
+    tree_push(payload, endpoints, [0, 1, 2], payload_kind="kv_warm")
+    ev2 = [e for e in rec.events if e["kind"] == "tree_push"][-1]
+    assert ev2["journey"] == prior["journey"]
+    assert ev2["parent"] == prior["span"]
+    # receivers hold the ADVANCED snapshot: adopting it parents onto
+    # the push's own span
+    dst = Request(prompt=[1], max_new_tokens=2)
+    journey.adopt_payload(dst, payload)
+    assert journey.fields(dst)["parent"] == ev2["span"]
+
+
+# ----------------------------------------------------------------------
+# SLO burn-rate gauges
+# ----------------------------------------------------------------------
+
+
+def test_slo_burn_rate_gauge_from_finish_events():
+    reg = metrics.install_tap()
+    rec = trace.enable(None)
+    rec.event("serving", phase="finish", dur_s=0.1, slo_ttft_ok=True,
+              slo_tpot_ok=True)
+    rec.event("serving", phase="finish", dur_s=0.1, slo_ttft_ok=False,
+              slo_tpot_ok=True)
+    rec.event("serving", phase="finish", dur_s=0.1, slo_ttft_ok=False,
+              slo_tpot_ok=False, tenant="acme")
+    rec.event("serving", phase="finish", dur_s=0.1)  # no targets: no row
+    burn = metrics.slo_burn_rates()
+    assert burn == {
+        "ttft": {"acme": 1.0, "default": 0.5},
+        "tpot": {"acme": 1.0, "default": 0.0},
+    }
+    snap = reg.snapshot()
+    rows = {tuple(sorted(v["labels"].items())): v["value"]
+            for v in snap["serving_slo_burn_rate"]["values"]}
+    assert rows[(("kind", "ttft"), ("tenant", "default"))] == 0.5
+    assert rows[(("kind", "tpot"), ("tenant", "acme"))] == 1.0
+
+
+def test_slo_burn_rate_decays_but_series_stays():
+    metrics.install_tap()
+    rec = trace.enable(None)
+    rec.event("serving", phase="finish", dur_s=0.1, slo_ttft_ok=False)
+    assert metrics.slo_burn_rates()["ttft"]["default"] == 1.0
+    time.sleep(0.02)
+    # verdicts older than the window age out; the pair still exports
+    # 0.0 (a vanished series and a healthy one must not look alike)
+    burn = metrics.slo_burn_rates(window_s=0.01)
+    assert burn == {"ttft": {"default": 0.0}}
+
+
+def test_slo_window_env_rule(monkeypatch):
+    assert metrics._slo_window_s() == 60.0
+    monkeypatch.setenv("CHAINERMN_TPU_SLO_WINDOW_S", "5")
+    assert metrics._slo_window_s() == 5.0
+    monkeypatch.setenv("CHAINERMN_TPU_SLO_WINDOW_S", "bogus")
+    assert metrics._slo_window_s() == 60.0
+    monkeypatch.setenv("CHAINERMN_TPU_SLO_WINDOW_S", "-3")
+    assert metrics._slo_window_s() == 60.0
+
+
+# ----------------------------------------------------------------------
+# The multi-process drill (slow): real processes, real clock offsets
+# ----------------------------------------------------------------------
+
+SLOW_WORKER = Path(__file__).resolve().parent / "cluster_worker.py"
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_mp_journey_merge_over_tcp(tmp_path):
+    """The true cross-PROCESS journey: per-rank JSONL files, a real
+    clock-sync exchange over the TCP plane, KV payloads carrying the
+    journey wire key — merged afterwards, every request must
+    reconstruct to one complete causal chain spanning both pids, with
+    flow arrows in the Chrome export."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CHAINERMN_TPU_JOURNEY_DIR"] = str(tmp_path)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(SLOW_WORKER), str(r), "2",
+             f"127.0.0.1:{port}"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+            cwd=str(SLOW_WORKER.parent.parent),
+        )
+        for r in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"CLUSTER_WORKER_OK {r}" in out
+
+    evs = []
+    for r in range(2):
+        evs.extend(trace.read_jsonl(str(tmp_path / f"rank{r}.jsonl")))
+    assert journey.check_journeys(evs, expect=4) == []
+    rep = journey.merge_journeys(evs, top=4)
+    assert rep["n_complete"] == 4
+    # the clock-sync rode the same TCP plane: rank 1 traced its offset
+    off = rep["clock"]["offsets"]
+    assert 1 in off and off[1]["peer"] == 0
+    assert off[1]["uncertainty_s"] > 0.0
+    for j in rep["slowest"]:
+        assert j["ranks"] == [0, 1] and len(j["pids"]) == 2
+        assert j["decomposition"]["handoff_s"] > 0.0
+    # cross-pid hops draw flow arrows in the Chrome export
+    ct = trace.chrome_trace(evs)
+    flows = [e for e in ct["traceEvents"] if e["ph"] in ("s", "f")]
+    assert len(flows) == 2 * 4  # one s/f pair per request's handoff
