@@ -813,8 +813,32 @@ def _tempered_filtered(logits, temperature, top_k, top_p):
     return _filter_logits(logits / temperature, top_k, top_p)
 
 
+def stream_sample_keys(base_key, seeds, counters):
+    """Counter-based sampling keys (docs/serving.md "Sampling"): row ``i``
+    draws with ``fold_in(fold_in(base_key, seeds[i]), counters[i])``.
+
+    The key for a sampled token is a PURE function of (base key, request
+    seed, absolute stream position) — there is no consumed split chain, so
+    it does not depend on which program asks: monolithic ``generate``, the
+    serving engine's decode/verify/mixed grids, a chunked or
+    sequence-parallel prefill, or a resumed stream on another replica all
+    derive the identical key for position ``i`` of request ``seeds[i]``.
+    That invariance is what extends the bit-identical-stream guarantee to
+    ``temperature > 0``: any schedule that reaches position ``i`` with the
+    same history sees the same logits AND the same key, hence the same
+    token. ``counters[i]`` is the absolute position of the token being
+    SAMPLED (the first generated token of a length-P prompt has counter
+    P). Threefry is batch-invariant, so per-row keys drawn here match
+    per-request individual calls exactly.
+    """
+    def one(seed, counter):
+        return jax.random.fold_in(jax.random.fold_in(base_key, seed), counter)
+
+    return jax.vmap(one)(jnp.asarray(seeds), jnp.asarray(counters))
+
+
 def generate(model: TransformerLM, params, prompt, n_steps: int, *,
-             temperature: float = 0.0, rng=None, pad_id: int = 0,
+             temperature: float = 0.0, rng=None, seeds=None, pad_id: int = 0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              adapters=None):
     """Autoregressive generation with a per-block KV cache.
@@ -836,7 +860,17 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
         (``<= model.max_len``).
       temperature: 0 → greedy argmax; otherwise softmax sampling at this
         temperature (requires ``rng``).
-      rng: PRNG key for sampling (ignored when greedy).
+      rng: PRNG BASE key for sampling (ignored when greedy). Keys are
+        derived per token by :func:`stream_sample_keys` — position ``t``
+        of row ``i`` draws with ``fold_in(fold_in(rng, seeds[i]), t)`` —
+        not by a consumed split chain, so generation at a fixed
+        ``(rng, seeds)`` is bit-identical to the serving engine's
+        chunked / sequence-parallel / speculative schedules over the
+        same requests.
+      seeds: ``[B]`` int32 per-row stream seeds (default all zeros).
+        The serving scheduler derives one per request
+        (``crc32(request_id)``); pass the same values here to reproduce
+        a served stream exactly.
       top_k: sample only among the k highest-probability tokens.
       top_p: nucleus sampling — restrict to the smallest token set whose
         probability mass reaches ``top_p``. Composes with ``top_k``
@@ -871,9 +905,11 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
             f"got {top_k}"
         )
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    seeds = (jnp.zeros((B,), jnp.int32) if seeds is None
+             else jnp.asarray(seeds, jnp.int32))
 
     def step(carry, t):
-        cache, prev_tok, key = carry
+        cache, prev_tok = carry
         # Teacher-force while this row still has prompt left.
         in_prompt = t < prompt_len  # [B]
         tok = jnp.where(in_prompt, padded_prompt[:, t], prev_tok)
@@ -884,18 +920,22 @@ def generate(model: TransformerLM, params, prompt, n_steps: int, *,
             adapters=adapters,
         )
         logits = logits[:, 0]  # [B, vocab]
-        key, sub = jax.random.split(key)
         if temperature > 0.0:
-            nxt = jax.random.categorical(
-                sub, _tempered_filtered(logits, temperature, top_k, top_p),
-                axis=-1,
+            # Step t samples the token for position t+1: counter t+1.
+            # No key threads through the carry — each position's key is
+            # derived fresh, so discarded draws (teacher-forced rows)
+            # never perturb later positions.
+            keys = stream_sample_keys(
+                rng, seeds, jnp.full((B,), t + 1, jnp.int32))
+            nxt = jax.vmap(jax.random.categorical)(
+                keys, _tempered_filtered(logits, temperature, top_k, top_p),
             )
         else:
             nxt = jnp.argmax(logits, axis=-1)
-        return (mutated["cache"], nxt.astype(prompt.dtype), key), tok
+        return (mutated["cache"], nxt.astype(prompt.dtype)), tok
 
     _, toks = jax.lax.scan(
-        step, (cache, padded_prompt[:, 0], rng),
+        step, (cache, padded_prompt[:, 0]),
         jnp.arange(n_steps, dtype=jnp.int32),
     )
     # ``toks[t]`` is the token CONSUMED at position t, which is already

@@ -8,7 +8,11 @@ replica, whose ``import_kv`` allocates covering blocks from its OWN
 ``BlockAllocator`` (fresh physical ids, refcount 1 — the source's block
 numbering never crosses the wire, so a release on either side can never
 corrupt the other) and injects the payload, and decode starts without
-re-prefilling. HiCCL (2408.05962) and The Big Send-off (2504.18658)
+re-prefilling. The payload also carries the request's sampling ``seed``
+(counter-based keys, docs/serving.md "Sampling"): the decode replica
+re-derives the identical per-position keys, so a disaggregated SAMPLED
+stream is bit-identical to a single-replica one — the same guarantee
+the greedy path gets from determinism. HiCCL (2408.05962) and The Big Send-off (2504.18658)
 argue exactly this: the cross-level transfer is a first-class,
 topology-aware plane — here it gets its own module, its own trace
 event, and its own byte accounting instead of being an engine side

@@ -31,9 +31,10 @@ prefilled on a different replica than the one that decoded them.
 
 **Replica loss**: :meth:`Router.fail_replica` evacuates a dead
 replica's queued AND in-flight requests and re-routes them to the
-survivors (greedy streams are deterministic, so the re-prefilled
-stream is identical — the client never sees the loss, only latency);
-see docs/fault_tolerance.md.
+survivors (streams are deterministic — greedy, or counter-key sampled
+under the ``Request.seed`` that rides the re-routed object — so the
+re-prefilled stream is identical; the client never sees the loss, only
+latency); see docs/fault_tolerance.md.
 
 Observability: one ``route`` trace event per placement and one
 ``kv_transfer`` event per handoff (docs/observability.md), plus
@@ -646,9 +647,11 @@ class Router:
         prefill queue: the prefill pump joins from the ORIGINAL prompt
         and ``admit_prefilled`` re-samples TTFT, both of which would
         break the resume contract (review finding). The arrival stamp
-        survives the hop (keep_arrival, the unified rule) and greedy
-        determinism makes the resumed stream bit-identical wherever it
-        lands. Returns the new replica id."""
+        survives the hop (keep_arrival, the unified rule) and stream
+        determinism — greedy, or counter-key sampled under the
+        ``Request.seed`` travelling on the same object — makes the
+        resumed stream bit-identical wherever it lands. Returns the
+        new replica id."""
         src = None
         for i, rep in self.replicas.items():
             if not rep.alive:
@@ -708,7 +711,8 @@ class Router:
         """Take ``replica_id`` out of rotation and re-route everything
         it held — queued requests, pending handoffs, AND in-flight
         streams (their partial output is discarded; deterministic
-        greedy streams mean the re-run is bit-identical, so the client
+        streams — greedy, or counter-key sampled under the seed riding
+        each Request — mean the re-run is bit-identical, so the client
         sees latency, not corruption). Returns the re-routed request
         ids. Raises when the survivors cannot cover the dead
         replica's role."""

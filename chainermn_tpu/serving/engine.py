@@ -32,10 +32,17 @@ honestly) to serving:
   psum per column→row pair (2 per layer), zero collectives in the
   paged-cache bookkeeping (both pinned structurally in the suite).
 
-Token-stream guarantee: greedy engine output for a request equals the
-greedy :func:`generate` stream for the same prompt, regardless of what
-other requests share the slot array (per-row attention never mixes
-rows; the equivalence test drives staggered joins/leaves).
+Token-stream guarantee: engine output for a request equals the
+:func:`generate` stream for the same prompt, regardless of what other
+requests share the slot array (per-row attention never mixes rows; the
+equivalence test drives staggered joins/leaves). At temperature 0 that
+is greedy determinism; at temperature > 0 it holds because sampling
+keys are COUNTER-BASED (:func:`~chainermn_tpu.models.transformer.
+stream_sample_keys`): token ``i`` of a request with seed ``s`` draws
+with ``fold_in(fold_in(base_key, s), i)`` — no consumed split chain, so
+the draw is invariant to which program (monolithic, chunked,
+seq-parallel, speculative) or which replica emitted it
+(docs/serving.md "Sampling").
 """
 
 from __future__ import annotations
@@ -324,9 +331,24 @@ class ServingEngine:
         default is the no-oversubscription worst case
         (:func:`~chainermn_tpu.serving.kv_blocks.default_num_blocks`) —
         pass less to oversubscribe (admission defers on exhaustion).
-      temperature/top_k/top_p/rng: sampling configuration shared with
+      temperature/top_k/top_p: sampling configuration shared with
         :func:`generate` (same ``_tempered_filtered`` path; temperature
-        0 = greedy, the stream-equivalence mode).
+        0 = greedy). Sampling keys are COUNTER-BASED
+        (:func:`~chainermn_tpu.models.transformer.stream_sample_keys`):
+        the token at absolute position ``i`` of a request with seed
+        ``s`` draws with ``fold_in(fold_in(base_key, s), i)`` — a pure
+        function of (base key, request seed, position), so sampled
+        streams keep the same bit-identical-stream guarantee as greedy
+        ones across chunked/seq-parallel prefill, speculative decode,
+        preemption/resume and cross-replica migration.
+      base_seed: integer seed for the sampling base key
+        (``PRNGKey(base_seed)``, default 0) — the EXPLICIT spelling of
+        the engine-level randomness source; two engines with the same
+        ``base_seed`` and per-request seeds produce identical sampled
+        streams.
+      rng: optional explicit PRNG base key; overrides ``base_seed``
+        (passing both is rejected). Use when the base key comes from an
+        existing key-management scheme rather than an integer seed.
       pad_id: prompt right-padding token for the bucketed prefill.
       mesh: optional ``Mesh`` with a ``'model'`` axis → tensor-parallel
         decode (weights sharded via :func:`shard_lm_params`).
@@ -337,7 +359,13 @@ class ServingEngine:
         matching prefix plus the model's own next token (1..K+1 tokens
         per tick, bit-identical to the plain stream). ``'auto'``
         resolves through the registry (decision ``spec_tokens``).
-        Greedy-only: combining it with ``temperature > 0`` is rejected.
+        Under ``temperature > 0`` the verify grid samples every
+        position with its counter key and acceptance is the standard
+        rejection-sampling rule specialised to the deterministic
+        drafters (:func:`~chainermn_tpu.serving.speculate.
+        rejection_accept_length`) — the committed stream is
+        distribution-exact AND bit-identical to sequential sampling at
+        a fixed seed.
       drafter: proposal source for ``spec_tokens > 0`` — any object with
         ``propose(history, k)`` (:mod:`chainermn_tpu.serving.speculate`;
         default :class:`~chainermn_tpu.serving.speculate.NgramDrafter`).
@@ -364,10 +392,10 @@ class ServingEngine:
         ``spec_tokens > 0``, draft-and-verify) — ONE jitted program of
         fixed width ``max(C, spec_tokens + 1)`` whose jit cache stays
         at 1 across every chunk/decode occupancy mix. Chunked streams
-        are bit-identical to monolithic ones (every emitted token is
-        still the model's own argmax at its true position); greedy-only
-        like ``spec_tokens`` — combining it with ``temperature > 0`` is
-        rejected. ``'auto'`` resolves through the registry (decision
+        are bit-identical to monolithic ones at ANY temperature (every
+        emitted token is the model's own argmax — or counter-keyed
+        sample — at its true position). ``'auto'`` resolves through the
+        registry (decision
         ``prefill_chunk``, table default 0 — chunking must earn
         adoption via the bursty bench rows).
       prefill_seq_parallel: sequence-parallel long-prompt prefill over
@@ -384,7 +412,9 @@ class ServingEngine:
         with the prefix cache (a trie HIT takes the monolithic tail
         prefill — its context lives in adopted blocks the sharded
         forward cannot see; the MISS, which is where long-prompt TTFT
-        lives, goes wide). Requires a ``mesh``, greedy decoding, no
+        lives, goes wide). The psum-selected last-position logits feed
+        the same counter-keyed sample as the monolithic path, so
+        sampled streams stay bit-identical too. Requires a ``mesh``, no
         ``window``, and ``prefill_chunk == 0`` (chunked admission takes
         precedence) — explicit ``'on'`` violating these is rejected; an
         ``'auto'`` resolution is forced off with provenance. ``'auto'``
@@ -428,6 +458,7 @@ class ServingEngine:
                  temperature: float = 0.0,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
+                 base_seed: int = 0,
                  rng=None, pad_id: int = 0, mesh=None,
                  spec_tokens="auto", drafter=None,
                  prefix_cache="auto", min_shared_blocks="auto",
@@ -453,8 +484,11 @@ class ServingEngine:
                 f"max_len={max_len} exceeds the model context "
                 f"{model.max_len}"
             )
-        if temperature > 0.0 and rng is None:
-            rng = jax.random.PRNGKey(0)
+        if rng is not None and base_seed:
+            raise ValueError(
+                "pass base_seed= (an integer) OR rng= (an explicit base "
+                "key), not both — they name the same randomness source"
+            )
         if (top_k is not None or top_p is not None) and temperature <= 0.0:
             raise ValueError("top_k/top_p filtering is for sampling — set "
                              "temperature > 0")
@@ -471,7 +505,18 @@ class ServingEngine:
         self.pad_id = int(pad_id)
         self.temperature = float(temperature)
         self.top_k, self.top_p = top_k, top_p
-        self._key = rng if rng is not None else jax.random.PRNGKey(0)
+        # Counter-based sampling state: ONE base key (explicit — no
+        # silent PRNGKey(0) fallback hidden behind temperature > 0) and
+        # a per-slot request-seed row. Token i of the request in slot s
+        # draws with fold_in(fold_in(_base_key, _seeds[s]), i); there is
+        # no consumed split chain, so no key threads through steps.
+        self.base_seed = int(base_seed)
+        self._base_key = (rng if rng is not None
+                          else jax.random.PRNGKey(self.base_seed))
+        self._seeds = np.zeros((self.num_slots,), dtype=np.int32)
+        self._seeds_ver = 0  # bumped on every _seeds mutation
+        self._seeds_dev = None  # cached device copy (H2D discipline)
+        self._seeds_dev_ver = -1
         self._buckets = tuple(
             b for b in sorted(set(prefill_buckets)) if b <= max_len
         ) or (max_len,)
@@ -583,10 +628,11 @@ class ServingEngine:
 
         # ---- speculation length (ISSUE 5): K drafted tokens per tick,
         # verified in one forward. Resolved like the other serving
-        # decisions; greedy-only by definition (acceptance compares
-        # drafts against the model's argmax — under sampling there is
-        # no single "the model's token" to match, so the combination is
-        # rejected up front rather than silently de-speculated).
+        # decisions. At temperature 0 acceptance compares drafts
+        # against the model's argmax; at temperature > 0 the verify
+        # grid is counter-key SAMPLED and the same comparison is the
+        # rejection-sampling acceptance rule (speculate.
+        # rejection_accept_length) — both modes serve.
         if spec_tokens == "auto":
             spec_tokens = resolve_spec_tokens(
                 model.d_model, model.num_heads, max_len
@@ -601,13 +647,6 @@ class ServingEngine:
             raise ValueError(
                 f"spec_tokens must be in [0, max_len={max_len}), got "
                 f"{spec_tokens}"
-            )
-        if spec_tokens > 0 and self.temperature > 0.0:
-            raise ValueError(
-                "speculative decoding is greedy-only: spec_tokens="
-                f"{spec_tokens} with temperature={self.temperature} has no "
-                "defined acceptance rule here — set temperature=0 or "
-                "spec_tokens=0"
             )
         self.spec_tokens = spec_tokens
         if drafter is not None and not callable(
@@ -638,18 +677,6 @@ class ServingEngine:
         if prefill_chunk < 0:
             raise ValueError(
                 f"prefill_chunk must be >= 0, got {prefill_chunk}"
-            )
-        if prefill_chunk > 0 and self.temperature > 0.0:
-            # The spec_tokens precedent: the chunked==monolithic stream
-            # guarantee is a GREEDY property (the mixed step consumes
-            # one key per grid, monolithic one per program call —
-            # sampled streams would silently diverge between the two
-            # schedules with the same seed).
-            raise ValueError(
-                "chunked prefill is greedy-only: prefill_chunk="
-                f"{prefill_chunk} with temperature={self.temperature} "
-                "breaks the chunked==monolithic stream guarantee — set "
-                "temperature=0 or prefill_chunk=0"
             )
         self.prefill_chunk = int(prefill_chunk)
         #: width of the mixed step's token grid — the chunk columns and
@@ -877,11 +904,6 @@ class ServingEngine:
                            "the sequence-parallel prompt forward has "
                            "no adapter-delta path — multi-tenant "
                            "engines take the monolithic prefill")
-            elif self.temperature > 0.0:
-                blocked = ("forced:sampling",
-                           "greedy-only: the bit-identical-stream "
-                           "guarantee is a greedy property (the "
-                           "spec_tokens/prefill_chunk precedent)")
             if blocked is not None:
                 if explicit_sp:
                     raise ValueError(
@@ -1117,28 +1139,50 @@ class ServingEngine:
             "or admit fewer concurrent requests"
         )
 
-    def _split_key(self):
-        import jax
+    def _seeds_device(self):
+        """The per-slot request-seed vector as a cached device array —
+        re-uploaded only when an admission/release changed a seed (same
+        H2D discipline as the block tables and tenant rows: the decode
+        loop must not pay an H2D right after its D2H token sync)."""
+        import jax.numpy as jnp
 
-        if self.temperature <= 0.0:
-            return self._key  # unused by the greedy branch
-        self._key, sub = jax.random.split(self._key)
-        return sub
+        if self._seeds_dev is None or self._seeds_dev_ver != self._seeds_ver:
+            self._seeds_dev = jnp.asarray(self._seeds)
+            self._seeds_dev_ver = self._seeds_ver
+        return self._seeds_dev
 
-    def _sample(self, logits, key):
-        """Shared sampling tail (the ``generate`` path: temperature →
-        ``_tempered_filtered`` → categorical; greedy argmax at 0)."""
+    def _set_slot_seed(self, slot: int, seed) -> None:
+        """Commit a slot's request seed (admission / KV import / release
+        hygiene), bumping the H2D version only on an actual change."""
+        seed = np.int32(0 if seed is None else int(seed))
+        if self._seeds[slot] != seed:
+            self._seeds[slot] = seed
+            self._seeds_ver += 1
+
+    def _sample(self, logits, seeds, counters):
+        """Shared sampling tail of every serving program: greedy argmax
+        at temperature 0 (``seeds``/``counters`` are then dead arguments
+        XLA drops — the compiled grids stay bitwise the pre-sampling
+        programs); otherwise ONE counter-keyed categorical per row — row
+        ``i`` draws with ``fold_in(fold_in(base_key, seeds[i]),
+        counters[i])`` (:func:`~chainermn_tpu.models.transformer.
+        stream_sample_keys`), so the token depends only on (request
+        seed, absolute position, logits) — never on which program or
+        tick asked, which is the whole bit-identical-stream argument."""
         import jax
         import jax.numpy as jnp
 
-        from chainermn_tpu.models.transformer import _tempered_filtered
+        from chainermn_tpu.models.transformer import (
+            _tempered_filtered,
+            stream_sample_keys,
+        )
 
         if self.temperature > 0.0:
-            return jax.random.categorical(
-                key,
+            keys = stream_sample_keys(self._base_key, seeds, counters)
+            return jax.vmap(jax.random.categorical)(
+                keys,
                 _tempered_filtered(logits, self.temperature, self.top_k,
                                    self.top_p),
-                axis=-1,
             ).astype(jnp.int32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -1147,24 +1191,28 @@ class ServingEngine:
 
         if self._use_adapters:
             def inner(cache, variables, ad, tokens, positions, tables,
-                      rows, key):
+                      rows, seeds):
                 logits, mutated = model.apply(
                     {**variables, "cache": cache}, tokens[:, None],
                     train=False, decode=True, decode_positions=positions,
                     block_tables=tables, mutable=["cache"],
                     adapters=_gather_adapter_rows(ad, rows),
                 )
-                return mutated["cache"], self._sample(logits[:, 0], key)
+                # Slot s holds `positions[s]` tokens; this step samples
+                # the token at that absolute position + 1 → counter.
+                return mutated["cache"], self._sample(
+                    logits[:, 0], seeds, positions + 1)
 
             return self._tp_jit(inner, 5, n_model_args=1)
 
-        def inner(cache, variables, tokens, positions, tables, key):
+        def inner(cache, variables, tokens, positions, tables, seeds):
             logits, mutated = model.apply(
                 {**variables, "cache": cache}, tokens[:, None],
                 train=False, decode=True, decode_positions=positions,
                 block_tables=tables, mutable=["cache"],
             )
-            return mutated["cache"], self._sample(logits[:, 0], key)
+            return mutated["cache"], self._sample(
+                logits[:, 0], seeds, positions + 1)
 
         return self._tp_jit(inner, 4)
 
@@ -1173,7 +1221,13 @@ class ServingEngine:
         ``[slots, K+1]`` positions — the pending last token plus K
         drafts per row, written/attended at per-row position spans
         (``_slot_decode_attend`` with ``T = K+1``) — and returns the
-        model's greedy token at every position. Acceptance, rollback,
+        model's OWN token at every position: greedy argmax at
+        temperature 0, the counter-keyed sample otherwise (cell
+        ``(s, j)`` uses counter ``positions[s] + j + 1``, the absolute
+        index of the token that cell emits — exactly the key sequential
+        decode would use there, which is what makes sampled acceptance
+        the rejection-sampling rule, see :func:`~chainermn_tpu.serving.
+        speculate.rejection_accept_length`). Acceptance, rollback,
         and padding are HOST decisions (:meth:`verify_step`): the
         compiled program is one fixed shape across request churn and
         any acceptance outcome, and under TP it carries exactly the
@@ -1183,30 +1237,41 @@ class ServingEngine:
 
         model = self._decode_model
 
+        def grid_sample(logits, positions, seeds):
+            if self.temperature <= 0.0:  # bitwise the pre-sampling grid
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            S, T = logits.shape[:2]
+            counters = positions[:, None] + jnp.arange(
+                1, T + 1, dtype=positions.dtype)[None, :]
+            return self._sample(
+                logits.reshape(S * T, -1),
+                jnp.repeat(seeds, T), counters.reshape(S * T),
+            ).reshape(S, T)
+
         if self._use_adapters:
             def inner(cache, variables, ad, tokens, positions, tables,
-                      rows):
+                      rows, seeds):
                 logits, mutated = model.apply(
                     {**variables, "cache": cache}, tokens,
                     train=False, decode=True, decode_positions=positions,
                     block_tables=tables, mutable=["cache"],
                     adapters=_gather_adapter_rows(ad, rows),
                 )
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return mutated["cache"], greedy  # [slots, K+1]
+                return mutated["cache"], grid_sample(
+                    logits, positions, seeds)  # [slots, K+1]
 
-            return self._tp_jit(inner, 4, n_model_args=1)
+            return self._tp_jit(inner, 5, n_model_args=1)
 
-        def inner(cache, variables, tokens, positions, tables):
+        def inner(cache, variables, tokens, positions, tables, seeds):
             logits, mutated = model.apply(
                 {**variables, "cache": cache}, tokens,  # [slots, K+1]
                 train=False, decode=True, decode_positions=positions,
                 block_tables=tables, mutable=["cache"],
             )
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return mutated["cache"], greedy  # [slots, K+1]
+            return mutated["cache"], grid_sample(
+                logits, positions, seeds)  # [slots, K+1]
 
-        return self._tp_jit(inner, 3)
+        return self._tp_jit(inner, 4)
 
     def _build_mixed_step(self):
         """The chunked-prefill MIXED step (ISSUE 11 tentpole): ONE
@@ -1222,40 +1287,50 @@ class ServingEngine:
         at one entry across every chunk/decode occupancy mix — and
         under TP the program carries exactly the same 2 all-reduces
         per layer as the one-token step (pinned by HLO count).
-        Sampling runs per grid position (one key, independent gumbel
-        noise per cell): at temperature 0 that is the verify step's
-        greedy-argmax grid, which is what acceptance and the chunk
-        boundary token both read."""
+        Sampling runs per grid position with the cell's COUNTER key
+        (cell ``(s, j)`` emits the token at absolute index
+        ``positions[s] + j + 1`` and uses exactly that counter — the
+        final chunk's boundary cell lands on counter ``P_len``, the
+        same key the monolithic prefill uses): at temperature 0 that
+        is the verify step's greedy-argmax grid, which is what
+        acceptance and the chunk boundary token both read."""
+        import jax.numpy as jnp
+
         model = self._decode_model
+
+        def grid_sample(logits, positions, seeds):
+            if self.temperature <= 0.0:  # bitwise the pre-sampling grid
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            S, T = logits.shape[:2]
+            counters = positions[:, None] + jnp.arange(
+                1, T + 1, dtype=positions.dtype)[None, :]
+            return self._sample(
+                logits.reshape(S * T, -1),
+                jnp.repeat(seeds, T), counters.reshape(S * T),
+            ).reshape(S, T)
 
         if self._use_adapters:
             def inner(cache, variables, ad, tokens, positions, tables,
-                      rows, key):
+                      rows, seeds):
                 logits, mutated = model.apply(
                     {**variables, "cache": cache}, tokens,  # [slots, T]
                     train=False, decode=True, decode_positions=positions,
                     block_tables=tables, mutable=["cache"],
                     adapters=_gather_adapter_rows(ad, rows),
                 )
-                S, T = tokens.shape
-                toks = self._sample(
-                    logits.reshape(S * T, -1), key
-                ).reshape(S, T)
-                return mutated["cache"], toks  # [slots, T]
+                return mutated["cache"], grid_sample(
+                    logits, positions, seeds)  # [slots, T]
 
             return self._tp_jit(inner, 5, n_model_args=1)
 
-        def inner(cache, variables, tokens, positions, tables, key):
+        def inner(cache, variables, tokens, positions, tables, seeds):
             logits, mutated = model.apply(
                 {**variables, "cache": cache}, tokens,  # [slots, T]
                 train=False, decode=True, decode_positions=positions,
                 block_tables=tables, mutable=["cache"],
             )
-            S, T = tokens.shape
-            toks = self._sample(
-                logits.reshape(S * T, -1), key
-            ).reshape(S, T)
-            return mutated["cache"], toks  # [slots, T]
+            return mutated["cache"], grid_sample(
+                logits, positions, seeds)  # [slots, T]
 
         return self._tp_jit(inner, 4)
 
@@ -1341,7 +1416,7 @@ class ServingEngine:
 
         if self._use_adapters:
             def inner(cache, variables, ad, tokens, true_len, start,
-                      slot, table_row, rows, key):
+                      slot, table_row, rows, seed):
                 logits, mutated = model.apply(
                     {**variables, "cache": cache}, tokens,
                     train=False, decode=True,
@@ -1351,12 +1426,18 @@ class ServingEngine:
                     adapters=_gather_adapter_rows(ad, rows),
                 )
                 last = jnp.take(logits[0], true_len - 1, axis=0)  # [V]
-                return mutated["cache"], self._sample(last[None], key)[0]
+                # The first generated token sits at absolute position
+                # start + true_len → its sampling counter (start is 0
+                # for a from-scratch prefill, the resume depth for a
+                # trie-tail or re-prefill — which is exactly why a
+                # resumed stream redraws the SAME token here).
+                return mutated["cache"], self._sample(
+                    last[None], seed, start + true_len)[0]
 
             fn = self._tp_jit(inner, 7, n_model_args=1)
         else:
             def inner(cache, variables, tokens, true_len, start, slot,
-                      table_row, key):
+                      table_row, seed):
                 logits, mutated = model.apply(
                     {**variables, "cache": cache}, tokens,
                     train=False, decode=True,
@@ -1365,7 +1446,8 @@ class ServingEngine:
                     mutable=["cache"],
                 )
                 last = jnp.take(logits[0], true_len - 1, axis=0)  # [V]
-                return mutated["cache"], self._sample(last[None], key)[0]
+                return mutated["cache"], self._sample(
+                    last[None], seed, start + true_len)[0]
 
             fn = self._tp_jit(inner, 6)
         self._prefill_jits[bucket] = fn
@@ -1388,9 +1470,12 @@ class ServingEngine:
         (``paged_update`` redirects pad overhang to scratch; dense
         scatters drop out-of-bounds rows — the monolithic path's own
         staleness contract); the last TRUE position's logits are
-        psum-selected across shards and greedy-argmaxed for the first
-        token. The cache is donated, so the chain hands off to decode
-        without a copy."""
+        psum-selected across shards and fed to the same sampling tail
+        as the monolithic prefill — greedy argmax at temperature 0, the
+        counter-keyed sample (counter ``true_len``, every shard derives
+        the identical replicated key) otherwise — for the first token.
+        The cache is donated, so the chain hands off to decode without
+        a copy."""
         if t_pad in self._seq_prefill_jits:
             return self._seq_prefill_jits[t_pad]
         import jax
@@ -1404,7 +1489,8 @@ class ServingEngine:
         base_model = self._base_model
         paged = self._alloc is not None
 
-        def local(cache_st, vars_st, tokens, true_len, slot, table_row):
+        def local(cache_st, vars_st, tokens, true_len, slot, table_row,
+                  seed):
             cache = jax.tree.map(lambda a: a[0], cache_st)
             stacked = jax.tree.map(
                 lambda a: jax.lax.all_gather(
@@ -1420,17 +1506,21 @@ class ServingEngine:
                 full, tokens, positions=pos, train=False,
                 mutable=["kv_out"],
             )
-            # first generated token = greedy argmax at the last TRUE
-            # prompt position (exactly what the monolithic prefill
-            # samples at temperature 0)
+            # first generated token = the monolithic prefill's sampling
+            # tail over the psum-assembled last-TRUE-position logits:
+            # argmax at temperature 0, else the counter-keyed sample at
+            # counter true_len (seed/true_len/psum row are replicated,
+            # so every shard derives the identical key and token).
             j = true_len - 1
             row = jnp.where(
                 (j // Tl) == my,
                 logits[0, j % Tl].astype(jnp.float32), 0.0,
             )
-            tok = jnp.argmax(
-                jax.lax.psum(row, "model")
-            ).astype(jnp.int32)
+            full_row = jax.lax.psum(row, "model")
+            tok = self._sample(
+                full_row[None], seed,
+                jnp.reshape(true_len, (1,)).astype(jnp.int32),
+            )[0].astype(jnp.int32)
             new_cache = dict(cache)
             for blk, kv in mut["kv_out"].items():
                 entry = dict(cache[blk])
@@ -1460,7 +1550,7 @@ class ServingEngine:
             shard_map(
                 local, mesh=self._mesh,
                 in_specs=(P("model"), P("model"), P(None, "model"),
-                          P(), P(), P()),
+                          P(), P(), P(), P()),
                 out_specs=(P("model"), P()),
                 check_vma=False,
             ),
@@ -1584,7 +1674,8 @@ class ServingEngine:
             return None
         return int(sum(s() for s in sizes))
 
-    def prefill_join(self, prompt, tenant_id: Optional[str] = None):
+    def prefill_join(self, prompt, tenant_id: Optional[str] = None,
+                     seed: Optional[int] = None):
         """Admit one request: claim a slot, run bucketed prefill, return
         ``(slot, first_token, bucket)`` — or None when no slot (or,
         paged, not enough pool blocks) is available right now (the
@@ -1595,6 +1686,13 @@ class ServingEngine:
         than silently serve the base model) and namespaces the
         prefix-trie consultation: one tenant's cached blocks can never
         adopt into another's stream.
+
+        ``seed`` is the request's sampling-stream seed (counter-based
+        keys: token ``i`` draws with ``fold_in(fold_in(base_key, seed),
+        i)``); ``None`` means stream 0. The scheduler derives one per
+        request (``crc32(request_id)``) and re-passes the SAME value on
+        resume/migration, which is what keeps a moved sampled stream
+        ONE stream. Ignored at temperature 0.
 
         With the prefix cache on (ISSUE 7) the join first consults the
         trie: the longest matching FULL-block chain is adopted into the
@@ -1610,7 +1708,7 @@ class ServingEngine:
         """
         import jax.numpy as jnp
 
-        res = self._admit_common(prompt, tenant_id)
+        res = self._admit_common(prompt, tenant_id, seed)
         if res is None:
             return None
         slot, prompt, P_len, tail_start, tail_len, _matched, _cow = res
@@ -1639,7 +1737,7 @@ class ServingEngine:
             jnp.full((1,), tail_start, jnp.int32),
             jnp.asarray([slot], jnp.int32),
             jnp.asarray(self._dummy_tables()[slot:slot + 1]),
-            tail=(self._split_key(),),
+            tail=(jnp.asarray(self._seeds[slot:slot + 1]),),
             tenant_rows=jnp.asarray(self._tenant_rows[slot:slot + 1]),
         ))
         tok = int(tok)
@@ -1666,6 +1764,7 @@ class ServingEngine:
             self._cache, self._vars, jnp.asarray(padded),
             jnp.int32(tail_len), jnp.asarray([slot], jnp.int32),
             jnp.asarray(self._dummy_tables()[slot:slot + 1]),
+            jnp.asarray(self._seeds[slot:slot + 1]),
         )
         tok = int(tok)
         self._positions[slot] = P_len
@@ -1712,15 +1811,16 @@ class ServingEngine:
                 namespace=self._tenant_ids[slot],
             )
 
-    def _admit_common(self, prompt, tenant_id: Optional[str] = None):
+    def _admit_common(self, prompt, tenant_id: Optional[str] = None,
+                      seed: Optional[int] = None):
         """Shared admission front half of :meth:`prefill_join` and
         :meth:`chunked_join`: validate the prompt (and, ISSUE 14, the
         tenant — its adapter row must be resident BEFORE any state
         mutates), consult the prefix trie under the TENANT's namespace,
         reserve the slot's pool blocks for the whole prompt plus
         the first decode write, COW-protect the unshared tail's
-        boundary, commit the slot (tenant row + bank pin included) and
-        account the admission. Returns
+        boundary, commit the slot (tenant row + bank pin + sampling
+        seed included) and account the admission. Returns
         ``(slot, prompt, P_len, tail_start, tail_len, matched, cow)``
         with the slot POPPED from the free list, or None to defer (host
         state untouched — the scheduler retries). ``last_prefix_info``
@@ -1800,6 +1900,9 @@ class ServingEngine:
         else:
             cow = 0
         self._free.pop()
+        # Sampling-seed commit: the slot's counter-based key stream —
+        # host metadata + one versioned H2D, like the tenant row below.
+        self._set_slot_seed(slot, seed)
         # Tenant commit (ISSUE 14): the slot's adapter row + bank pin +
         # trie namespace — host metadata only, like everything above.
         self._tenant_ids[slot] = tenant_id
@@ -1829,7 +1932,8 @@ class ServingEngine:
             }
         return slot, prompt, P_len, tail_start, tail_len, matched, cow
 
-    def chunked_join(self, prompt, tenant_id: Optional[str] = None):
+    def chunked_join(self, prompt, tenant_id: Optional[str] = None,
+                     seed: Optional[int] = None):
         """Admit one request for CHUNKED prefill (``prefill_chunk > 0``,
         ISSUE 11): claim the slot and reserve its blocks EXACTLY like
         :meth:`prefill_join` — trie adoption, whole-prompt ensure,
@@ -1844,7 +1948,7 @@ class ServingEngine:
             raise RuntimeError(
                 "chunked_join needs prefill_chunk > 0 — use prefill_join"
             )
-        res = self._admit_common(prompt, tenant_id)
+        res = self._admit_common(prompt, tenant_id, seed)
         if res is None:
             return None
         slot, prompt, P_len, tail_start, tail_len, _matched, _cow = res
@@ -1882,7 +1986,7 @@ class ServingEngine:
             jnp.asarray(self._last_tok, jnp.int32),
             jnp.asarray(self._positions, jnp.int32),
             self._tables_device(),
-            tail=(self._split_key(),),
+            tail=(self._seeds_device(),),
         ))
         toks = np.asarray(toks)  # device sync: honest per-step latency
         dur = time.perf_counter() - t0
@@ -1901,10 +2005,15 @@ class ServingEngine:
 
         Returns ``(committed, dur_s, stats)``: ``committed[slot]`` is
         the list of 1..K+1 tokens slot ``slot`` advanced by this tick
-        (every one of them an argmax the verify forward produced, so the
-        stream is bit-identical to the plain path); ``stats`` carries
-        ``drafted``/``accepted`` token counts and the per-slot
-        ``accept_lens`` — the scheduler's ``speculate`` trace event.
+        (every one of them a token the verify forward itself produced —
+        argmax at temperature 0, the counter-keyed sample otherwise —
+        so the stream is bit-identical to the plain path in BOTH modes;
+        sampled acceptance is the rejection-sampling rule,
+        :func:`~chainermn_tpu.serving.speculate.
+        rejection_accept_length`); ``stats`` carries
+        ``drafted``/``accepted`` token counts, the per-slot
+        ``accept_lens`` and the sampling ``mode`` — the scheduler's
+        ``speculate`` trace event.
 
         Rollback is HOST metadata only: rejected drafts leave their
         (stale) cache writes in place — positions are explicit, so the
@@ -1969,8 +2078,13 @@ class ServingEngine:
             # block (rollback stays host-metadata-only and composes).
             self._cow_protect(s, p, room[s] + 1)
 
-        from chainermn_tpu.serving.speculate import accept_length
+        from chainermn_tpu.serving.speculate import (
+            accept_length,
+            rejection_accept_length,
+        )
 
+        accept = (rejection_accept_length if self.temperature > 0.0
+                  else accept_length)
         drafts = np.zeros((self.num_slots, K), np.int64)
         prop_len: dict[int, int] = {}
         n_drafted = 0
@@ -1988,12 +2102,13 @@ class ServingEngine:
         tokens = np.concatenate([self._last_tok[:, None], drafts], axis=1)
 
         t0 = time.perf_counter()
-        self._cache, greedy = self._verify_step_jit(*self._step_args(
+        self._cache, grid = self._verify_step_jit(*self._step_args(
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(self._positions, jnp.int32),
             self._tables_device(),
+            tail=(self._seeds_device(),),
         ))
-        greedy = np.asarray(greedy)  # device sync: honest tick latency
+        grid = np.asarray(grid)  # device sync: honest tick latency
         dur = time.perf_counter() - t0
 
         committed: dict[int, list[int]] = {}
@@ -2001,12 +2116,13 @@ class ServingEngine:
         n_accepted = 0
         for s in active:
             # acceptance never extends past the drafter's TRUE proposal
-            # (a zero-padded verify column that happens to match argmax
-            # would be a correct token, but crediting it as "accepted
-            # speculation" would corrupt the tuning signal).
-            a = accept_length(drafts[s], greedy[s],
-                              min(room[s], prop_len[s]))
-            toks = [int(t) for t in greedy[s, :a + 1]]
+            # (a zero-padded verify column that happens to match the
+            # model's own token would be a correct token, but crediting
+            # it as "accepted speculation" would corrupt the tuning
+            # signal).
+            a = accept(drafts[s], grid[s],
+                       min(room[s], prop_len[s]))
+            toks = [int(t) for t in grid[s, :a + 1]]
             committed[s] = toks
             accept_lens.append(a)
             n_accepted += a
@@ -2014,7 +2130,9 @@ class ServingEngine:
             self._last_tok[s] = toks[-1]
             self._positions[s] += a + 1
         stats = {"drafted": n_drafted, "accepted": n_accepted,
-                 "accept_lens": accept_lens}
+                 "accept_lens": accept_lens,
+                 "mode": "sampled" if self.temperature > 0.0
+                 else "greedy"}
         self._publish_pool_gauges()
         return committed, dur, stats
 
@@ -2039,8 +2157,10 @@ class ServingEngine:
 
         Returns ``(committed, fills, dur_s, spec_stats)``:
         ``committed[slot]`` = the decode tokens slot advanced by
-        (1..K+1, every one a verify-grid argmax — bit-identical to the
-        plain stream); ``fills`` = one record per ADVANCED fill row
+        (1..K+1, every one a verify-grid token — argmax at temperature
+        0, the counter-keyed sample otherwise — bit-identical to the
+        plain stream in both modes); ``fills`` = one record per ADVANCED
+        fill row
         (``slot``/``chunk`` index/``tokens`` written/``done`` and, on
         the final chunk, ``first_tok`` — the request's first generated
         token, sampled at the last prompt position exactly as the
@@ -2119,18 +2239,23 @@ class ServingEngine:
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32),
             self._tables_device(),
-            tail=(self._split_key(),),
+            tail=(self._seeds_device(),),
         ))
         toks = np.asarray(toks)  # device sync: honest tick latency
         dur = time.perf_counter() - t0
 
-        from chainermn_tpu.serving.speculate import accept_length
+        from chainermn_tpu.serving.speculate import (
+            accept_length,
+            rejection_accept_length,
+        )
 
+        accept = (rejection_accept_length if self.temperature > 0.0
+                  else accept_length)
         committed: dict[int, list[int]] = {}
         accept_lens: list[int] = []
         n_accepted = 0
         for s in active:
-            a = accept_length(
+            a = accept(
                 drafts[s], toks[s], min(room[s], prop_len[s])
             ) if K > 0 else 0
             take = [int(t) for t in toks[s, :a + 1]]
@@ -2166,7 +2291,9 @@ class ServingEngine:
                 rec["first_tok"] = first
             fills.append(rec)
         stats = ({"drafted": n_drafted, "accepted": n_accepted,
-                  "accept_lens": accept_lens} if K > 0 else None)
+                  "accept_lens": accept_lens,
+                  "mode": "sampled" if self.temperature > 0.0
+                  else "greedy"} if K > 0 else None)
         self._publish_pool_gauges()
         return committed, fills, dur, stats
 
@@ -2180,7 +2307,11 @@ class ServingEngine:
         in-progress chunked fills (their written chunks are cached
         too). Without the prefix cache the resume re-prefills the full
         history — slower, still bit-identical (greedy streams are
-        deterministic)."""
+        deterministic, and sampled streams re-derive the same counter
+        keys: the resumed prefill's first sample uses counter = the
+        re-prefilled length, exactly the uninterrupted stream's counter
+        at that position — provided the resume re-passes the request's
+        ``seed``)."""
         pend = self._pending_fill.pop(slot, None)
         if pend is not None:
             self._publish_full_blocks(slot, pend["prompt"],
@@ -2396,6 +2527,11 @@ class ServingEngine:
             "position": pos,
             "last_tok": int(self._last_tok[slot]),
             "tenant": self._tenant_ids[slot],
+            # The request's sampling seed rides the payload (read with
+            # .get — schema stays 1, older payloads mean stream 0): the
+            # importer re-derives the SAME counter keys, so a moved
+            # sampled stream stays ONE stream bit-identically.
+            "seed": int(self._seeds[slot]),
             "blocks": blocks,
             "nbytes": sum(a.nbytes for blk in blocks for a in blk),
         }
@@ -2497,6 +2633,7 @@ class ServingEngine:
         self._last_tok[slot] = int(payload["last_tok"])
         self._active[slot] = True
         self._history[slot] = [int(t) for t in payload["tokens"]]
+        self._set_slot_seed(slot, payload.get("seed"))
         self._tenant_ids[slot] = tenant
         if self._use_adapters:
             self.adapter_bank.pin(tenant)
@@ -2537,4 +2674,8 @@ class ServingEngine:
         if self._tenant_rows[slot] != 0:
             self._tenant_rows[slot] = 0
             self._tenant_rows_ver += 1
+        # Seed hygiene: a reused slot must never sample on a departed
+        # request's stream (admission always rewrites, but garbage rows
+        # also feed the grid programs for inactive slots).
+        self._set_slot_seed(slot, 0)
         self._publish_pool_gauges()

@@ -19,8 +19,10 @@ arrival stamp, so queue_wait/TTFT keep measuring the whole journey
 share). Resume re-prefills only what the prefix trie cannot serve —
 the engine's :meth:`~ServingEngine.preempt` publishes the victim's
 written blocks into the trie first — and the resumed stream is
-bit-identical to the uninterrupted one (greedy determinism, pinned in
-tests/test_chunked_prefill.py).
+bit-identical to the uninterrupted one (greedy determinism at
+temperature 0, pinned in tests/test_chunked_prefill.py; counter-based
+sampling keys plus the stored ``Request.seed`` at temperature > 0,
+pinned in tests/test_sampling.py).
 
 With a CHUNKED engine (``engine.prefill_chunk > 0``, ISSUE 11) the
 scheduler admits through ``chunked_join`` (no forward at admission) and
@@ -69,6 +71,7 @@ from __future__ import annotations
 import itertools
 import math
 import time
+import zlib
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Mapping, Optional, Sequence
@@ -358,6 +361,14 @@ class Request:
     (chunk-interference cap, preemption of over-budget streams), every
     policy reports against them (``slo_ttft_ok``/``slo_tpot_ok`` on
     the finish event → the ``slo_attainment`` rollup).
+
+    ``seed`` (optional) is the request's sampling-stream seed: under a
+    sampled engine (``temperature > 0``) token ``i`` of this request
+    draws with ``fold_in(fold_in(base_key, seed), i)`` (counter-based
+    keys, docs/serving.md "Sampling"). ``None`` → :meth:`Scheduler.
+    submit` derives ``crc32(request_id) & 0x7FFFFFFF`` and STORES it,
+    so preemption/requeue and cross-replica re-routes reuse the same
+    stream. Ignored by greedy engines.
     """
 
     prompt: Sequence[int]
@@ -368,6 +379,7 @@ class Request:
     session_id: Optional[str] = None
     ttft_target_ms: Optional[float] = None
     tpot_target_ms: Optional[float] = None
+    seed: Optional[int] = None
     _arrival: float = field(default=0.0, repr=False)
     #: preemption resume state (stream so far / generated count / first
     #: -token stamp) — parked ON the request so a requeue OR a cross-
@@ -588,6 +600,14 @@ class Scheduler:
         if request.request_id is None:
             request.request_id = f"r{next(self._ids)}"
         rid = request.request_id
+        # Sampling-seed derivation (documented on Request.seed): fill an
+        # omitted seed deterministically from the id — crc32, masked
+        # into int32 — and STORE it, so a preemption requeue or a
+        # cross-replica re-submit (same id, same Request) lands on the
+        # same counter-key stream. Callers wanting i.i.d. streams per
+        # retry pass their own seeds.
+        if request.seed is None:
+            request.seed = zlib.crc32(str(rid).encode()) & 0x7FFFFFFF
         if rid in self.results or any(
             r.request_id == rid for r in self._queue.iter_unordered()
         ) or any(fl.request.request_id == rid
@@ -786,6 +806,15 @@ class Scheduler:
         # over minimal/fake engines keep their pre-tenant signature.
         join_kw = ({"tenant_id": req.tenant_id}
                    if req.tenant_id is not None else {})
+        # The request's counter-key stream seed — only for SAMPLED
+        # engines (greedy ones ignore seeds, and fake/minimal engines
+        # in tests keep their pre-seed join signature). A resume join
+        # passes the SAME stored seed: the re-prefill's first sample
+        # uses counter = stream-so-far length, exactly the counter the
+        # uninterrupted stream would have used there, so the resumed
+        # stream is bit-identical.
+        if getattr(self.engine, "temperature", 0.0) > 0.0:
+            join_kw["seed"] = req.seed
         if getattr(self.engine, "prefill_chunk", 0) > 0:
             slot = self.engine.chunked_join(join_prompt, **join_kw)
             if slot is None:
@@ -892,6 +921,7 @@ class Scheduler:
         self._event("speculate", drafted=stats["drafted"],
                     accepted=stats["accepted"],
                     accept_lens=list(stats["accept_lens"]),
+                    mode=stats.get("mode", "greedy"),
                     dur_s=round(dur, 9))
         for slot, fl in list(self._inflight.items()):
             take, done = takes[slot]
@@ -941,6 +971,7 @@ class Scheduler:
             self._event("speculate", drafted=stats["drafted"],
                         accepted=stats["accepted"],
                         accept_lens=list(stats["accept_lens"]),
+                        mode=stats.get("mode", "greedy"),
                         dur_s=round(dur, 9))
         for f in fills:
             fill = self._filling.get(f["slot"])
@@ -1042,7 +1073,9 @@ class Scheduler:
         victim forever). ``requeue=False`` returns the Request
         un-queued instead — the cluster router's re-route path: resume
         state travels ON the request, so a second replica resumes the
-        stream identically (bit-identical by greedy determinism)."""
+        stream identically (bit-identical: greedy determinism at
+        temperature 0; at temperature > 0 the stored ``seed`` rides the
+        Request and the counter keys re-derive at absolute positions)."""
         fl = self._inflight.pop(slot, None)
         if fl is not None:
             req = fl.request
@@ -1186,7 +1219,8 @@ class Scheduler:
         scheduler WITHOUT touching the engine (which may be dead — the
         replica-loss path, ISSUE 8): returns the orphans in arrival
         order so the router can re-route them. In-flight requests lose
-        their partial streams (greedy streams are deterministic, so a
+        their partial streams (streams are deterministic — greedy, or
+        counter-key sampled under the request's stored ``seed`` — so a
         re-prefill elsewhere reproduces the identical stream); mid-fill
         chunked admissions (ISSUE 11) are orphaned the same way —
         their arrival stamps travel, the unified keep_arrival rule."""

@@ -23,10 +23,13 @@ Drafter contract (the engine's ``drafter=`` argument): an object with
 where ``history`` is the slot's committed stream so far (prompt +
 generated, including the pending last token). Proposals are HINTS, not
 promises: a wrong draft costs one wasted verify column, never a wrong
-token — greedy acceptance filters everything through the model's own
-argmax (docs/serving.md "Speculative decoding"). Returning fewer than
-``k`` (or nothing) is fine; the engine pads the verify batch and caps
-acceptance at the true proposal length.
+token — acceptance filters everything through the model's own token at
+each position: its argmax at temperature 0, its counter-keyed sample at
+temperature > 0 (the rejection-sampling rule,
+:func:`rejection_accept_length`; docs/serving.md "Speculative decoding"
+and "Sampling"). Returning fewer than ``k`` (or nothing) is fine; the
+engine pads the verify batch and caps acceptance at the true proposal
+length.
 
 Two dependency-free drafters ship here:
 
@@ -178,3 +181,36 @@ def accept_length(drafts: Sequence[int], greedy: Sequence[int],
     while a < limit and int(drafts[a]) == int(greedy[a]):
         a += 1
     return a
+
+
+def rejection_accept_length(drafts: Sequence[int], sampled: Sequence[int],
+                            room: Optional[int] = None) -> int:
+    """Sampled-mode acceptance: the standard speculative rejection-
+    sampling rule, specialised to DETERMINISTIC (point-mass) drafters.
+
+    The general rule (Leviathan et al., arXiv:2211.17192) accepts draft
+    token ``t`` with probability ``min(1, p(t)/q(t))`` and, on
+    rejection, emits a sample from the residual ``max(p − q, 0)``
+    renormalised — the pair that makes the committed stream's law equal
+    sequential sampling from ``p`` for ANY proposal ``q``. Both shipped
+    drafters propose deterministically, so ``q`` is a point mass
+    ``δ_d`` at the drafted token ``d``. Realise the rule by the maximal
+    coupling: draw ``x ~ p`` with the position's counter key
+    (``engine._sample`` over the verify grid) and accept the draft iff
+    ``x == d``. That IS the rule — acceptance happens with probability
+    ``p(d) = min(1, p(d)/q(d)) · q(d)``-mass, and on rejection the
+    emitted ``x``, conditioned on ``x ≠ d``, has law ``p(·)/(1 − p(d))``
+    off ``d``, which is exactly the renormalised residual
+    ``max(p − δ_d, 0)``.
+
+    So the comparison loop is :func:`accept_length` verbatim, run
+    against the SAMPLED verify grid instead of the argmax grid — which
+    also makes the committed stream BIT-IDENTICAL to sequential
+    counter-keyed sampling at a fixed seed (every committed token is
+    the very sample the sequential path would have drawn at that
+    position given the identical history), a stronger property than
+    distribution-exactness alone. Distribution-exactness is pinned
+    statistically in tests/test_sampling.py; ``room`` caps acceptance
+    exactly as in the greedy rule (horizon/paged coverage — throughput,
+    never correctness)."""
+    return accept_length(drafts, sampled, room)

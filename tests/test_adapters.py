@@ -237,7 +237,7 @@ class TestTensorParallel:
             engine._cache, engine._vars, engine._adapter_device(),
             jnp.zeros((3,), jnp.int32), jnp.zeros((3,), jnp.int32),
             jnp.asarray(engine._dummy_tables()),
-            jnp.zeros((3,), jnp.int32), engine._key,
+            jnp.zeros((3,), jnp.int32), jnp.asarray(engine._seeds),
         )
         txt = engine._decode_step_jit.lower(*args).compile().as_text()
         n_ar = txt.count("all-reduce(")

@@ -193,7 +193,8 @@ class TestMixedStepStructure:
             engine._cache, engine._vars,
             jnp.zeros((3, engine._mixed_T), jnp.int32),
             jnp.zeros((3,), jnp.int32),
-            jnp.asarray(engine._dummy_tables()), engine._key,
+            jnp.asarray(engine._dummy_tables()),
+            jnp.asarray(engine._seeds),
         )
         txt = engine._mixed_step_jit.lower(*args).compile().as_text()
         n_ar = txt.count("all-reduce(")
@@ -246,10 +247,11 @@ class TestMixedStepStructure:
     def test_engine_validation(self, lm):
         with pytest.raises(ValueError, match="prefill_chunk"):
             _engine(lm, chunk=-1)
-        # greedy-only, the spec_tokens precedent: sampled streams would
-        # silently diverge between the chunked and monolithic schedules
-        with pytest.raises(ValueError, match="greedy-only"):
-            _engine(lm, chunk=4, temperature=0.7)
+        # ISSUE 18: sampling no longer gates chunking — counter-based
+        # keys make the chunked and monolithic schedules draw identical
+        # tokens at every position (pinned in test_sampling.py).
+        sampled = _engine(lm, chunk=4, temperature=0.7)
+        assert sampled.prefill_chunk == 4
         eng = _engine(lm, chunk=0)
         with pytest.raises(RuntimeError, match="chunked_join"):
             eng.chunked_join([1, 2])

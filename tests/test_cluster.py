@@ -498,7 +498,8 @@ class TestStructural:
         args = (
             engine._cache, engine._vars,
             jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32),
-            jnp.asarray(engine._dummy_tables()), engine._key,
+            jnp.asarray(engine._dummy_tables()),
+            jnp.asarray(engine._seeds),
         )
         txt = engine._decode_step_jit.lower(*args).compile().as_text()
         assert txt.count("all-reduce(") == 2 * model.num_layers

@@ -340,7 +340,8 @@ def test_recorder_on_off_decode_hlo_identical(lm):
         args = (
             engine._cache, engine._vars,
             jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
-            jnp.asarray(engine._dummy_tables()), engine._key,
+            jnp.asarray(engine._dummy_tables()),
+            jnp.asarray(engine._seeds),
         )
         return engine._decode_step_jit.lower(*args).compile().as_text()
 

@@ -252,7 +252,8 @@ class TestStructural:
         args = (
             engine._cache, engine._vars,
             jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
-            jnp.asarray(engine._dummy_tables()), engine._key,
+            jnp.asarray(engine._dummy_tables()),
+            jnp.asarray(engine._seeds),
         )
         txt = engine._decode_step_jit.lower(*args).compile().as_text()
         assert txt.count("all-reduce(") == 2 * model.num_layers
@@ -260,6 +261,7 @@ class TestStructural:
             engine._cache, engine._vars,
             jnp.zeros((n, 3), jnp.int32), jnp.zeros((n,), jnp.int32),
             jnp.asarray(engine._dummy_tables()),
+            jnp.asarray(engine._seeds),
         )
         vtxt = engine._verify_step_jit.lower(*vargs).compile().as_text()
         assert vtxt.count("all-reduce(") == 2 * model.num_layers
