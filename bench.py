@@ -466,6 +466,9 @@ _COMPACT_KEYS = (
     "plan_vs_handwired", "plan_spread_pct",
     "serving_burst_goodput", "serving_burst_ttft_p99_ms",
     "serving_burst_spread_pct", "serving_burst_selected",
+    "serving_sampled_tokens_per_sec", "serving_sampled_spread_pct",
+    "serving_sampled_spec_speedup", "serving_sampled_spec_accept_rate",
+    "serving_sampled_selected",
     "seq_parallel_selected", "seq_parallel_ttft_ms",
     "seq_parallel_spread_pct",
     "serving_tenants_goodput", "serving_tenants_fairness",
@@ -1918,6 +1921,162 @@ def _bench_serving_burst(comm, on_accel: bool):
             "CPU-proxy honest floor: tiny LM, ms-scale open-loop gaps "
             "— the goodput ranking holds for THIS backend; absolute "
             "tokens/s is not chip throughput"
+        )
+    return out
+
+
+def _bench_serving_sampled(comm, on_accel: bool):
+    """ISSUE 18: sampled-traffic serving — the perf stack at
+    temperature > 0.
+
+    Before counter-based sampling every sampled request was pinned to
+    the slow path (the ctor REJECTED spec_tokens>0 / prefill_chunk>0 /
+    seq-parallel prefill at temperature>0); this phase measures what
+    lifting the gate bought. One seeded request stream at temperature
+    0.7 (per-request seeds fixed, so every arm serves a reproducible
+    workload) through three arms sharing decode_impl/block size:
+
+    1. ``plain`` — single-token decode, the pre-ISSUE-18 ceiling;
+    2. ``spec`` — speculative decode (n-gram drafting, rejection-rule
+       acceptance — docs/serving.md "Sampling");
+    3. ``chunked`` — chunked prefill through the mixed step.
+
+    Rows (CPU-proxy convention: median-of-n>=3 + spread):
+    ``serving_sampled_tokens_per_sec`` per arm, the sampled spec
+    acceptance rate, and a spread-gated ``serving_sampled_selected``
+    verdict — 'plain' when no arm clears the noise band (honest
+    refusal, the spec_tokens precedent). The verdict is recorded as
+    cache EVIDENCE under its own ``sampled_serving`` name (acceptance
+    rate + speedup beside the per-arm rows) — it drives NO dispatch
+    decision: the greedy ``serving``/``serving_burst`` phases own the
+    spec_tokens/prefill_chunk adoption rows, and ISSUE 18's whole
+    point is that one decision now covers both modes.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import Request, Scheduler, ServingEngine
+
+    if on_accel:
+        layers, d_model, heads, d_ff = 4, 512, 8, 2048
+        vocab, max_len, slots = 32000, 512, 8
+        block_size, chunk, spec_k = 32, 64, 3
+        n_requests, gen = 16, 24
+        dtype = jnp.bfloat16
+    else:
+        layers, d_model, heads, d_ff = 2, 64, 4, 128
+        vocab, max_len, slots = 256, 64, 4
+        block_size, chunk, spec_k = 8, 16, 2
+        n_requests, gen = 8, 5
+        dtype = jnp.float32
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        d_model=d_model, d_ff=d_ff, max_len=max_len, compute_dtype=dtype,
+    )
+    params = jax.jit(
+        functools.partial(model.init, train=False)
+    )(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    # One seeded workload with FIXED per-request seeds: every arm (and
+    # every repeat) samples the identical token streams — counter-based
+    # derivation makes throughput comparable across schedules because
+    # the work really is the same tokens.
+    rs = np.random.RandomState(23)
+    reqs_spec = []
+    for i in range(n_requests):
+        p_len = int(rs.randint(3, 13))
+        reqs_spec.append((rs.randint(1, vocab, size=p_len).tolist(),
+                          gen, 1000 + i))
+
+    def run_stream(engine):
+        sched = Scheduler(engine, policy="prefill_priority")
+        for p, g, sd in reqs_spec:
+            sched.submit(Request(prompt=p, max_new_tokens=g, seed=sd))
+        sched.run()
+        return sched.summary()
+
+    def stream_medians(engine):
+        run_stream(engine)  # compile + warm every bucket
+        summaries = [run_stream(engine)
+                     for _ in range(1 if on_accel else 3)]
+        summaries.sort(key=lambda s: s["tokens_per_sec"])
+        med = summaries[len(summaries) // 2]
+        tps = [s["tokens_per_sec"] for s in summaries]
+        spread = None
+        if len(summaries) > 1 and med["tokens_per_sec"]:
+            spread = round(
+                100.0 * (tps[-1] - tps[0]) / med["tokens_per_sec"], 1)
+        return med, spread
+
+    engine_kw = dict(
+        num_slots=slots, max_len=max_len, decode_impl="paged",
+        kv_block_size=block_size, prefill_buckets=(8, 16),
+        prefix_cache="off", temperature=0.7, base_seed=42,
+    )
+    arms = (
+        ("plain", dict(spec_tokens=0, prefill_chunk=0)),
+        ("spec", dict(spec_tokens=spec_k, prefill_chunk=0)),
+        ("chunked", dict(spec_tokens=0, prefill_chunk=chunk)),
+    )
+    out = {
+        "serving_sampled_model_shape": f"D{d_model}xH{heads}xL{max_len}",
+        "serving_sampled_requests": n_requests,
+        "serving_sampled_temperature": 0.7,
+    }
+    tps, spreads = {}, {}
+    accept_rate = None
+    for name, kw in arms:
+        eng = ServingEngine(model, params, **engine_kw, **kw)
+        med, spread = stream_medians(eng)
+        tps[name] = med["tokens_per_sec"]
+        spreads[name] = spread if spread is not None else 0.0
+        if name == "spec":
+            sp = med.get("speculation") or {}
+            accept_rate = sp.get("accept_rate")
+        del eng
+    out["serving_sampled_tokens_per_sec"] = tps
+    if not on_accel:
+        # spread keys only for real multi-sample runs (the serving
+        # phases' shared convention; absent = on-accel 10% floor)
+        out["serving_sampled_spread_pct"] = max(spreads.values())
+    if accept_rate is not None:
+        out["serving_sampled_spec_accept_rate"] = accept_rate
+    if tps.get("plain"):
+        out["serving_sampled_spec_speedup"] = round(
+            (tps.get("spec") or 0.0) / tps["plain"], 3)
+        # Spread-gated verdict through the registry's own decide rule,
+        # recorded as cache evidence under a NON-decision name (no
+        # resolve site reads 'sampled_serving' — the greedy phases own
+        # the knob adoptions). None = spread-dominated: 'plain' stands,
+        # the honest refusal every adoption row uses, and nothing is
+        # stored.
+        try:
+            from chainermn_tpu import tuning
+            from chainermn_tpu.serving import serving_decision_key
+
+            key = serving_decision_key(d_model, heads, max_len)
+            evidence = {"tokens_per_sec": tps}
+            if accept_rate is not None:
+                evidence["spec_accept_rate"] = accept_rate
+            winner = tuning.record_measurement(
+                "sampled_serving", key, tps,
+                spreads=None if on_accel else spreads,
+                higher_is_better=True,
+                extra_evidence=evidence,
+            )
+            out["serving_sampled_selected"] = winner or "plain"
+        except Exception as e:
+            out["serving_sampled_autotune_error"] = (
+                f"{type(e).__name__}: {e}"[:160])
+    if not on_accel:
+        out["serving_sampled_note"] = (
+            "CPU-proxy honest floor: tiny LM, sampled streams — the "
+            "arm ranking holds for THIS backend; absolute tokens/s is "
+            "not chip throughput"
         )
     return out
 
@@ -4181,6 +4340,8 @@ def _run_bench(mode: str) -> None:
          lambda: _bench_serving_cluster(comm, on_accel))
     supp("serving_burst", "serving_burst_error",
          lambda: _bench_serving_burst(comm, on_accel))
+    supp("serving_sampled", "serving_sampled_error",
+         lambda: _bench_serving_sampled(comm, on_accel))
     supp("serving_tenants", "serving_tenants_error",
          lambda: _bench_serving_tenants(comm, on_accel))
     # Last on purpose: this one spawns fresh child processes whose backend

@@ -142,6 +142,10 @@ class MetricsExporter:
             # the health probe's "are we burning the error budget
             # RIGHT NOW" answer; {} until an SLO-bearing finish lands.
             "slo_burn": _metrics.slo_burn_rates(),
+            # Speculative acceptance per sampling mode (ISSUE 18) —
+            # a sampled-mode collapse is drafter mismatch, not load;
+            # {} until a verify tick lands.
+            "spec_accept": _metrics.spec_accept_rates(),
         }
 
     def merge_peer_snapshots(self, comm) -> int:

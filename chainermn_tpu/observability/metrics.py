@@ -449,6 +449,7 @@ def reset() -> None:
     _tap_installed = False
     _dropped_seen = None
     _reset_slo_window()
+    _reset_spec_totals()
 
 
 def install_tap(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry:
@@ -466,6 +467,7 @@ def install_tap(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry:
         _tap_installed = True
     reg.register_collect(_collect_recorder_health)
     reg.register_collect(_collect_slo_burn)
+    reg.register_collect(_collect_spec_accept)
     return reg
 
 
@@ -603,6 +605,59 @@ def _collect_slo_burn(reg: MetricsRegistry) -> None:
             ).set(burn, kind=kind, tenant=tenant)
 
 
+# ----------------------------------------------------------------------
+# Speculative acceptance by sampling mode (ISSUE 18)
+# ----------------------------------------------------------------------
+#
+# The unlabeled ``speculate_drafted_total``/``accepted_total`` counters
+# predate sampled speculation and stay exactly as they were (pinned in
+# tests/test_metrics.py). Now that verify ticks run in two acceptance
+# regimes — exact-match greedy vs rejection-sampling sampled
+# (docs/serving.md "Sampling") — the operational question is the RATE
+# per regime: a sampled acceptance collapse is a drafter-mismatch
+# signal that an aggregate counter would average away.
+
+#: {mode: (drafted, accepted)} — process-lifetime totals.
+_spec_totals: dict = {}
+_spec_lock = threading.Lock()
+
+
+def _record_spec(mode: str, drafted: float, accepted: float) -> None:
+    with _spec_lock:
+        tot, acc = _spec_totals.get(mode, (0.0, 0.0))
+        _spec_totals[mode] = (tot + drafted, acc + accepted)
+
+
+def spec_accept_rates() -> dict:
+    """``{mode: rate}`` — accepted/drafted per sampling mode over the
+    process lifetime. A mode that has drafted nothing reads 0.0 but
+    stays in the map once seen (same vanished-vs-healthy rule as the
+    burn gauges). Feeds the ``serving_spec_accept_rate`` gauge and the
+    exporter's ``/healthz`` body."""
+    with _spec_lock:
+        return {
+            mode: (round(acc / tot, 6) if tot else 0.0)
+            for mode, (tot, acc) in sorted(_spec_totals.items())
+        }
+
+
+def _reset_spec_totals() -> None:
+    with _spec_lock:
+        _spec_totals.clear()
+
+
+def _collect_spec_accept(reg: MetricsRegistry) -> None:
+    """Scrape-time hook: derive the per-mode acceptance-rate gauge from
+    the totals (a ratio is a derived value — exporting it per-event
+    would snapshot whichever tick scraped last)."""
+    for mode, rate in spec_accept_rates().items():
+        reg.gauge(
+            "serving_spec_accept_rate",
+            "speculative tokens accepted / drafted by sampling mode "
+            "(process lifetime)",
+        ).set(rate, mode=mode)
+
+
 def _tap_event(ev: Mapping[str, Any]) -> None:
     """The recorder sink: one trace event -> metric updates. Must never
     raise (the recorder swallows sink errors, but a broken tap would
@@ -732,12 +787,13 @@ def _tap_event(ev: Mapping[str, Any]) -> None:
             "prompt tokens prefilled through mixed-step chunks",
         ).inc(float(ev.get("tokens") or 0))
     elif kind == "speculate":
+        drafted = float(ev.get("drafted") or 0)
+        accepted = float(ev.get("accepted") or 0)
         reg.counter("speculate_drafted_total",
-                    "speculative tokens drafted").inc(
-            float(ev.get("drafted") or 0))
+                    "speculative tokens drafted").inc(drafted)
         reg.counter("speculate_accepted_total",
-                    "speculative tokens accepted").inc(
-            float(ev.get("accepted") or 0))
+                    "speculative tokens accepted").inc(accepted)
+        _record_spec(str(ev.get("mode") or "greedy"), drafted, accepted)
     elif kind == "prefix_cache":
         reg.counter("kv_prefix_lookups_total",
                     "prefix-trie lookups at admission").inc()

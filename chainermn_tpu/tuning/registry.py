@@ -76,7 +76,10 @@ from chainermn_tpu.tuning import measure as _measure
 #:   wasted verify columns plus draft overhead per tick, so speculation
 #:   must EARN adoption through a bench ``serving`` capture
 #:   (``serving_spec_ms`` rows + acceptance rate) before 'auto' turns
-#:   it on for a shape.
+#:   it on for a shape. Since ISSUE 18 the knob covers SAMPLED traffic
+#:   too (counter-based keys + rejection acceptance, docs/serving.md
+#:   "Sampling"), so sampled captures (``serving_sampled`` rows,
+#:   per-mode acceptance) feed the same decision.
 #: - ``prefix_cache`` (cross-request KV prefix sharing): ``on`` — the
 #:   miss path costs host metadata only (one trie walk + refcounts per
 #:   join; the decode/verify programs are untouched and shared streams
@@ -122,7 +125,10 @@ DEFAULT_TABLE: dict = {
     # chunking trades peak prefill throughput for decode-tick latency
     # (every tick pays the chunk-width forward), so it must earn
     # adoption through the bench's bursty goodput-under-SLO rows
-    # (spread-gated, the spec_tokens/cluster_disagg precedent).
+    # (spread-gated, the spec_tokens/cluster_disagg precedent). Applies
+    # to sampled traffic too since ISSUE 18: counter-based keys make the
+    # chunked schedule bit-identical to monolithic at temperature > 0
+    # (docs/serving.md "Sampling"), so one decision covers both modes.
     "prefill_chunk": {"*": "0"},
     # Sequence-axis attention (ISSUE 13): ring (n-1 neighbour ppermutes
     # per layer, O(T_local) resident K/V, no divisibility constraint)
@@ -156,7 +162,10 @@ DEFAULT_TABLE: dict = {
     # rows (``seq_parallel_ttft_ms``) show the sharded forward beating
     # the TP prefill on this shape — the in-program param all-gather
     # and per-layer ring hops must EARN their place, the
-    # spec_tokens/cluster_disagg precedent.
+    # spec_tokens/cluster_disagg precedent. No longer greedy-only
+    # (ISSUE 18): every shard derives the same counter-based key from
+    # the psum'd logits row, so the sampled sharded prefill emits the
+    # token the monolithic path would (docs/serving.md "Sampling").
     "prefill_seq_parallel": {"*": "off"},
 }
 

@@ -894,3 +894,51 @@ def test_sched_search_rows_contract_and_seeding(tmp_path):
     details.write_text(json.dumps(doc))
     assert "sched_search" not in "\n".join(seed_from_bench_details(
         str(details), str(cache.with_suffix(".3"))))
+
+
+def test_serving_sampled_rows_contract():
+    """ISSUE 18 satellite: the ``serving_sampled`` phase's headline
+    rows ride the compact line (per-arm tokens/s + spread + sampled
+    spec speedup/acceptance + the spread-gated verdict), the phase is
+    wired into the supplementary chain, and its verdict is recorded as
+    cache evidence under the NON-decision ``sampled_serving`` name —
+    never under spec_tokens/prefill_chunk: the greedy ``serving``/
+    ``serving_burst`` phases own those adoption rows, and counter-
+    based sampling makes one decision cover both modes
+    (docs/serving.md "Sampling")."""
+    for k in ("serving_sampled_tokens_per_sec",
+              "serving_sampled_spread_pct",
+              "serving_sampled_spec_speedup",
+              "serving_sampled_spec_accept_rate",
+              "serving_sampled_selected"):
+        assert k in bench._COMPACT_KEYS, k
+    assert callable(bench._bench_serving_sampled)
+    import inspect
+
+    src = inspect.getsource(bench._run_bench)
+    assert 'supp("serving_sampled", "serving_sampled_error"' in src
+    # evidence rides its own cache name; the phase never re-records
+    # the greedy phases' knob decisions
+    phase_src = inspect.getsource(bench._bench_serving_sampled)
+    assert '"sampled_serving"' in phase_src
+    for knob in ('"spec_tokens"', '"prefill_chunk"'):
+        assert knob not in phase_src
+
+    # the decide rule: decisive sampled win -> stored with evidence;
+    # spread-dominated -> None and 'plain' stands (honest refusal)
+    from chainermn_tpu import tuning
+
+    winner = tuning.record_measurement(
+        "sampled_serving", "unit-test|sampled",
+        {"plain": 100.0, "spec": 150.0, "chunked": 90.0},
+        spreads={"plain": 5.0, "spec": 5.0, "chunked": 5.0},
+        higher_is_better=True,
+        extra_evidence={"spec_accept_rate": 0.6},
+    )
+    assert winner == "spec"
+    assert tuning.record_measurement(
+        "sampled_serving", "unit-test|sampled",
+        {"plain": 100.0, "spec": 104.0},
+        spreads={"plain": 12.0, "spec": 12.0},
+        higher_is_better=True,
+    ) is None

@@ -227,6 +227,33 @@ def test_tap_serving_and_step_events():
     assert reg.counter("speculate_accepted_total").value() == 2.0
 
 
+def test_spec_accept_rate_by_mode():
+    """ISSUE 18: verify ticks carry their sampling mode; the per-mode
+    acceptance-rate gauge splits what the unlabeled counters (pinned
+    above at their pre-sampling values) aggregate."""
+    reg = metrics.install_tap()
+    rec = trace.enable(None)
+    rec.event("speculate", drafted=4, accepted=4, mode="greedy")
+    rec.event("speculate", drafted=4, accepted=1, mode="sampled")
+    rec.event("speculate", drafted=4, accepted=2, mode="sampled")
+    # mode-less events (pre-ISSUE-18 traces) fold into greedy
+    rec.event("speculate", drafted=2, accepted=2)
+    assert metrics.spec_accept_rates() == {
+        "greedy": 1.0, "sampled": round(3 / 8, 6)}
+    # the unlabeled aggregates are untouched by the split
+    assert reg.counter("speculate_drafted_total").value() == 14.0
+    assert reg.counter("speculate_accepted_total").value() == 9.0
+    # gauge is derived at snapshot time via the collect hook
+    snap = reg.snapshot()
+    vals = {tuple(sorted(v["labels"].items())): v["value"]
+            for v in snap["serving_spec_accept_rate"]["values"]}
+    assert vals[(("mode", "greedy"),)] == 1.0
+    assert vals[(("mode", "sampled"),)] == round(3 / 8, 6)
+    # reset() clears the totals (test isolation contract)
+    metrics.reset()
+    assert metrics.spec_accept_rates() == {}
+
+
 def test_trace_dropped_events_counter_is_live(monkeypatch):
     """ISSUE 6 satellite: Recorder.dropped used to surface only in the
     close() meta event — the collect hook exports it on every
@@ -398,6 +425,7 @@ def test_exporter_healthz_and_trace_tail():
         assert health["step"] == 41
         assert health["last_beat_age_s"] >= 0
         assert health["last_event_age_s"] >= 0
+        assert health["spec_accept"] == {}  # no verify ticks yet
         tail = json.loads(_scrape(exp.port, "/trace/tail?n=3"))
         assert len(tail) == 3
         assert [e["iteration"] for e in tail] == [4, 5, 6]
