@@ -469,6 +469,9 @@ _COMPACT_KEYS = (
     "serving_sampled_tokens_per_sec", "serving_sampled_spread_pct",
     "serving_sampled_spec_speedup", "serving_sampled_spec_accept_rate",
     "serving_sampled_selected",
+    "serving_decode_kernel_ms", "serving_decode_kernel_spread_pct",
+    "serving_decode_kernel_fused_speedup",
+    "serving_decode_kernel_selected",
     "seq_parallel_selected", "seq_parallel_ttft_ms",
     "seq_parallel_spread_pct",
     "serving_tenants_goodput", "serving_tenants_fairness",
@@ -2078,6 +2081,126 @@ def _bench_serving_sampled(comm, on_accel: bool):
             "arm ranking holds for THIS backend; absolute tokens/s is "
             "not chip throughput"
         )
+    return out
+
+
+def _bench_serving_decode_kernel(comm, on_accel: bool):
+    """ISSUE 19: the fused paged-decode kernel vs the XLA dense-view
+    attend — the adoption row for ``decode_attend_impl``.
+
+    One paged engine shape, two arms differing ONLY in the attend read
+    (``decode_attend_impl`` is a static model field; the write path is
+    byte-identical): prefill every slot to HALF the horizon — the
+    regime where the kernel's live-only block reads beat the gather's
+    full-table-width habit (tools/byte_audit.py decode prices the HBM
+    story) — then time steady-state decode ticks.
+
+    Rows (CPU-proxy convention: median-of-n>=3 + spread):
+    ``serving_decode_kernel_ms`` per arm, spread-gated adoption of
+    ``decode_attend_impl`` via ``record_measurement``. On CPU the fused
+    arm runs the kernel's interpret-mode EMULATION — slower than XLA by
+    construction, so the expected CPU verdict is an HONEST REFUSAL (or
+    an xla win): the table default stands and only an on-chip capture
+    (tools/on_chip_capture.sh runs this phase plus the Mosaic
+    compile-check) can flip the decision.
+    """
+    import functools
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import (
+        DECODE_ATTEND_IMPLS,
+        ServingEngine,
+        serving_decision_key,
+    )
+
+    if on_accel:
+        layers, d_model, heads, d_ff = 4, 512, 8, 2048
+        vocab, max_len, slots = 32000, 512, 16
+        block_size, prompt_len, decode_steps = 32, 256, 32
+        dtype = jnp.bfloat16
+    else:
+        layers, d_model, heads, d_ff = 2, 64, 4, 128
+        vocab, max_len, slots = 256, 64, 4
+        block_size, prompt_len, decode_steps = 8, 32, 6
+        dtype = jnp.float32
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        d_model=d_model, d_ff=d_ff, max_len=max_len, compute_dtype=dtype,
+    )
+    params = jax.jit(
+        functools.partial(model.init, train=False)
+    )(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    out = {
+        "serving_decode_kernel_model_shape":
+            f"D{d_model}xH{heads}xL{max_len}",
+        "serving_decode_kernel_prompt_len": prompt_len,
+    }
+
+    def step_median(attend_impl):
+        eng = ServingEngine(
+            model, params, num_slots=slots, max_len=max_len,
+            decode_impl="paged", decode_attend_impl=attend_impl,
+            kv_block_size=block_size, prefill_buckets=(prompt_len,),
+            spec_tokens=0,
+        )
+        for i in range(slots):  # half-horizon histories, full occupancy
+            eng.prefill_join([1 + (i + j) % (vocab - 1)
+                              for j in range(prompt_len)])
+
+        def sample():
+            t0 = time.perf_counter()
+            for _ in range(decode_steps):
+                eng.decode_step()
+            return (time.perf_counter() - t0) / decode_steps * 1000
+
+        sample()  # compile + warm
+        return _repeat_median(sample, 1 if on_accel else 3)
+
+    from chainermn_tpu._jax_compat import pallas_paged_decode_supported
+
+    ms, spreads = {}, {}
+    ms["xla"], spreads["xla"] = step_median("xla")
+    if pallas_paged_decode_supported():
+        ms["fused"], spreads["fused"] = step_median("fused")
+    else:
+        out["serving_decode_kernel_note"] = (
+            "fused arm skipped: this jax's Pallas lacks scalar-prefetch "
+            "grid specs (the engine's forced:jax-compat fallback)"
+        )
+    out["serving_decode_kernel_ms"] = {k: round(v, 4)
+                                       for k, v in ms.items()}
+    if not on_accel:
+        # Absent spread key = on-accel single sample; the offline
+        # seeder then applies the registry's 10% noise floor.
+        out["serving_decode_kernel_spread_pct"] = max(spreads.values())
+    if len(ms) == 2:
+        out["serving_decode_kernel_fused_speedup"] = round(
+            ms["xla"] / ms["fused"], 3) if ms["fused"] else None
+        try:
+            from chainermn_tpu import tuning
+
+            key = serving_decision_key(d_model, heads, max_len)
+            winner = tuning.record_measurement(
+                "decode_attend_impl", key, ms,
+                spreads=None if on_accel else spreads,
+                extra_evidence={"prompt_len": prompt_len,
+                                "decode_steps": decode_steps},
+            )
+            out["serving_decode_kernel_selected"] = tuning.choice(
+                "decode_attend_impl", DECODE_ATTEND_IMPLS, key)
+        except Exception as e:
+            out["serving_decode_kernel_autotune_error"] = (
+                f"{type(e).__name__}: {e}"[:120])
+    if not on_accel:
+        out.setdefault("serving_decode_kernel_note", (
+            "CPU proxy runs the kernel in interpret mode (an emulator): "
+            "the fused arm losing here says nothing about the chip — "
+            "adoption waits for a live capture"
+        ))
     return out
 
 
@@ -4342,6 +4465,8 @@ def _run_bench(mode: str) -> None:
          lambda: _bench_serving_burst(comm, on_accel))
     supp("serving_sampled", "serving_sampled_error",
          lambda: _bench_serving_sampled(comm, on_accel))
+    supp("serving_decode_kernel", "serving_decode_kernel_error",
+         lambda: _bench_serving_decode_kernel(comm, on_accel))
     supp("serving_tenants", "serving_tenants_error",
          lambda: _bench_serving_tenants(comm, on_accel))
     # Last on purpose: this one spawns fresh child processes whose backend

@@ -45,4 +45,23 @@ def install() -> None:
         jax.lax.axis_size = axis_size
 
 
+def pallas_paged_decode_supported() -> bool:
+    """True when this jax's Pallas carries scalar-prefetch grid specs
+    (``pltpu.PrefetchScalarGridSpec`` — the fused paged-decode kernel's
+    table-indexed gather rides them, :mod:`chainermn_tpu.ops.
+    paged_decode`). The serving engine consults this before cloning a
+    ``decode_attend_impl='fused'`` model and falls back to the XLA
+    attend with provenance ``forced:jax-compat`` when absent — the same
+    one-place gating the shard_map shim above applies to the no-new-deps
+    rule."""
+    try:
+        from chainermn_tpu.ops.paged_decode import fused_supported
+    except Exception:
+        return False
+    try:
+        return bool(fused_supported())
+    except Exception:
+        return False
+
+
 install()

@@ -85,6 +85,14 @@ class TransformerBlock(nn.Module):
     kv_block_size: int = 64
     #: pool capacity in blocks (paged layout; block 0 is scratch).
     kv_num_blocks: int = 0
+    #: slot-decode attention impl: ``'xla'`` (scatter → dense-view
+    #: gather → einsum attend — the reference path) or ``'fused'`` (the
+    #: flash-decoding Pallas kernel, :mod:`chainermn_tpu.ops.
+    #: paged_decode` — one HBM pass, no dense view; registry decision
+    #: ``decode_attend_impl``, resolved by the serving engine). The
+    #: CACHE WRITE is shared between the impls — only the attend read
+    #: differs, so streams agree to fp32-accumulation tolerance.
+    decode_attend_impl: str = "xla"
     #: mesh axis name for tensor-parallel decode: the block then holds
     #: LOCAL heads/kv-heads/d_ff (set ``head_dim`` explicitly) and
     #: inserts exactly one ``psum`` per column→row pair (attention
@@ -226,6 +234,11 @@ class TransformerBlock(nn.Module):
         B, T = qh.shape[:2]
         kv_heads = kh_new.shape[2]
         dt = self.compute_dtype
+        if self.decode_attend_impl not in ("xla", "fused"):
+            raise ValueError(
+                f"decode_attend_impl must be 'xla' or 'fused', got "
+                f"{self.decode_attend_impl!r}"
+            )
         if self.kv_layout == "paged":
             from chainermn_tpu.ops.paged_kv import paged_lookup, paged_update
 
@@ -249,6 +262,20 @@ class TransformerBlock(nn.Module):
                                     kh_new.astype(dt))
             pv.value = paged_update(pv.value, block_tables, positions,
                                     vh_new.astype(dt))
+            if self.decode_attend_impl == "fused":
+                from chainermn_tpu.ops.paged_decode import (
+                    paged_flash_decode,
+                )
+
+                # One HBM pass over the LIVE blocks — the table rides as
+                # a scalar-prefetch operand, no dense view ever exists.
+                # Scratch block 0 is masked in-kernel (the same released
+                # -slot / beyond-horizon staleness argument as below).
+                return paged_flash_decode(
+                    qh.astype(dt), pk.value, pv.value, block_tables,
+                    positions, window=self.window,
+                    scale=head_dim ** -0.5, scratch_block=0,
+                )
             keys = paged_lookup(pk.value, block_tables)
             vals = paged_lookup(pv.value, block_tables)
         else:
@@ -273,6 +300,20 @@ class TransformerBlock(nn.Module):
             cv.value = cv.value.at[rows[:, None], cols].set(
                 vh_new.astype(dt)
             )
+            if self.decode_attend_impl == "fused":
+                from chainermn_tpu.ops.paged_decode import (
+                    dense_flash_decode,
+                )
+
+                # The dense ring through the SAME kernel: the cache
+                # reshapes (zero-copy) into implicit blocks with an
+                # identity table — the prefill view's per-slot gather
+                # becomes table rows, never a materialized copy.
+                return dense_flash_decode(
+                    qh.astype(dt), ck.value, cv.value, positions,
+                    slots=slots, window=self.window,
+                    scale=head_dim ** -0.5,
+                )
             if slots is None:
                 keys, vals = ck.value, cv.value
             else:  # prefill view: gather just the written rows
@@ -497,6 +538,10 @@ class TransformerLM(nn.Module):
     kv_block_size: int = 64
     #: paged-pool capacity in blocks (``TransformerBlock.kv_num_blocks``).
     kv_num_blocks: int = 0
+    #: slot-decode attend impl (``TransformerBlock.decode_attend_impl``):
+    #: ``'xla'`` or ``'fused'`` — the serving engine clones the model
+    #: with the registry-resolved impl (decision ``decode_attend_impl``).
+    decode_attend_impl: str = "xla"
     #: decode-cache capacity override: dense slot caches allocate
     #: ``decode_cache_len`` rows instead of ``max_len`` (a serving
     #: horizon shorter than the trained context — pos_emb stays at
@@ -611,6 +656,7 @@ class TransformerLM(nn.Module):
                 kv_layout=self.kv_layout,
                 kv_block_size=self.kv_block_size,
                 kv_num_blocks=self.kv_num_blocks,
+                decode_attend_impl=self.decode_attend_impl,
                 tp_axis=self.tp_axis,
                 head_dim=self.head_dim,
                 sow_kv=self.sow_kv,

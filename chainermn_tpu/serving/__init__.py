@@ -16,6 +16,7 @@ from chainermn_tpu.serving.adapters import (
     shard_adapter_stacks,
 )
 from chainermn_tpu.serving.engine import (
+    DECODE_ATTEND_IMPLS,
     DECODE_IMPLS,
     KV_BLOCK_SIZES,
     MIN_SHARED_BLOCKS,
@@ -24,6 +25,7 @@ from chainermn_tpu.serving.engine import (
     SPEC_TOKENS,
     ServingEngine,
     resolve_adapter_impl,
+    resolve_decode_attend_impl,
     resolve_decode_impl,
     resolve_kv_block_size,
     resolve_min_shared_blocks,
@@ -62,6 +64,7 @@ __all__ = [
     "PrefixCache",
     "ADAPTER_IMPLS",
     "ADAPTER_TARGETS",
+    "DECODE_ATTEND_IMPLS",
     "DECODE_IMPLS",
     "KV_BLOCK_SIZES",
     "MIN_SHARED_BLOCKS",
@@ -76,6 +79,7 @@ __all__ = [
     "init_serving_cache",
     "random_adapter",
     "resolve_adapter_impl",
+    "resolve_decode_attend_impl",
     "resolve_decode_impl",
     "resolve_kv_block_size",
     "resolve_min_shared_blocks",

@@ -63,6 +63,14 @@ from chainermn_tpu.serving.kv_blocks import (
 #: tuning-registry candidates for the serving decisions.
 DECODE_IMPLS = ("dense", "paged")
 KV_BLOCK_SIZES = ("16", "32", "64", "128")
+#: slot-decode attention impl (ISSUE 19): 'xla' = scatter → dense-view
+#: gather → einsum attend; 'fused' = the flash-decoding Pallas kernel
+#: (:mod:`chainermn_tpu.ops.paged_decode`) — one HBM pass over the live
+#: blocks, table-indexed in-kernel gather, no dense view. Table default
+#: 'xla': the kernel must EARN adoption through bench's
+#: ``serving_decode_kernel`` rows (spread-gated); a Pallas without
+#: scalar-prefetch grid specs forces 'xla' with ``forced:jax-compat``.
+DECODE_ATTEND_IMPLS = ("xla", "fused")
 #: speculation lengths the ``spec_tokens`` decision chooses among
 #: (ISSUE 5): 0 = plain one-token decode; K > 0 = draft-and-verify with
 #: K drafted tokens per slot per tick.
@@ -160,6 +168,20 @@ def resolve_kv_block_size(d_model: int, num_heads: int, max_len: int) -> int:
         "kv_block_size", KV_BLOCK_SIZES,
         serving_decision_key(d_model, num_heads, max_len),
     ))
+
+
+def resolve_decode_attend_impl(d_model: int, num_heads: int,
+                               max_len: int) -> str:
+    """Resolve ``decode_attend_impl`` ('xla' | 'fused') via the registry
+    (same key as the other serving decisions; bench's
+    ``serving_decode_kernel`` phase measures both attends per shape and
+    seeds it — table default 'xla', the kernel earns adoption)."""
+    from chainermn_tpu import tuning
+
+    return tuning.choice(
+        "decode_attend_impl", DECODE_ATTEND_IMPLS,
+        serving_decision_key(d_model, num_heads, max_len),
+    )
 
 
 def resolve_spec_tokens(d_model: int, num_heads: int, max_len: int) -> int:
@@ -452,6 +474,7 @@ class ServingEngine:
     def __init__(self, model, params, *, num_slots: int,
                  max_len: Optional[int] = None,
                  decode_impl: str = "auto",
+                 decode_attend_impl: str = "auto",
                  kv_block_size="auto",
                  num_blocks: Optional[int] = None,
                  prefill_buckets: Sequence[int] = DEFAULT_BUCKETS,
@@ -566,6 +589,52 @@ class ServingEngine:
             kv_block_size = int(kv_block_size) if kv_block_size != "auto" \
                 else 64
             self._alloc = None
+
+        # ---- decode attend impl (ISSUE 19): the fused paged-decode
+        # Pallas kernel vs the XLA scatter → gather → attend. ONE field
+        # on the decode model clone, so the decode / verify / mixed /
+        # prefill-tail programs all switch together (their jit caches
+        # stay pinned — the impl is a static model field, not a traced
+        # arg). Validate BEFORE the capability gate: a typo must raise
+        # identically whichever jax is present.
+        if (decode_attend_impl != "auto"
+                and decode_attend_impl not in DECODE_ATTEND_IMPLS):
+            raise ValueError(
+                f"decode_attend_impl must be one of "
+                f"{DECODE_ATTEND_IMPLS + ('auto',)}, got "
+                f"{decode_attend_impl!r}"
+            )
+        from chainermn_tpu._jax_compat import pallas_paged_decode_supported
+        if decode_attend_impl == "auto":
+            decode_attend_impl = resolve_decode_attend_impl(
+                model.d_model, model.num_heads, max_len
+            )
+            self._adopt_decision("decode_attend_impl", key)
+            if (decode_attend_impl == "fused"
+                    and not pallas_paged_decode_supported()):
+                # The cache says the kernel wins this shape, but this
+                # image's Pallas lacks scalar-prefetch grid specs —
+                # serve the XLA attend with honest provenance.
+                decode_attend_impl = "xla"
+                self.decisions.append({
+                    "name": "decode_attend_impl", "key": key,
+                    "winner": "xla", "source": "forced:jax-compat",
+                })
+        else:
+            if (decode_attend_impl == "fused"
+                    and not pallas_paged_decode_supported()):
+                raise ValueError(
+                    "decode_attend_impl='fused' needs a Pallas with "
+                    "scalar-prefetch grid specs "
+                    "(pltpu.PrefetchScalarGridSpec) — this jax lacks "
+                    "them (an 'auto' resolution would fall back with "
+                    "forced:jax-compat)"
+                )
+            self.decisions.append({"name": "decode_attend_impl",
+                                   "key": key,
+                                   "winner": decode_attend_impl,
+                                   "source": "explicit"})
+        self.decode_attend_impl = decode_attend_impl
 
         # ---- prefix sharing (ISSUE 7): trie + COW over the paged pool.
         # Dense rows are slot-private by layout — nothing to share, so
@@ -787,6 +856,7 @@ class ServingEngine:
             kv_block_size=int(kv_block_size),
             kv_num_blocks=(self._alloc.num_blocks if self._alloc else 0),
             decode_cache_len=max_len,
+            decode_attend_impl=decode_attend_impl,
         )
         if mesh is None:
             self._decode_model = model.clone(**clone_kw)

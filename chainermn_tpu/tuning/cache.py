@@ -399,7 +399,9 @@ def _seed_one_result(result: dict, source: str, out: list,
         result.get("seq_parallel_model_shape", "")) or m)
     m_te = (_SERVING_SHAPE.search(
         result.get("serving_tenants_model_shape", "")) or m)
-    if m or m_px or m_cl or m_bu or m_sp or m_te:
+    m_dk = (_SERVING_SHAPE.search(
+        result.get("serving_decode_kernel_model_shape", "")) or m)
+    if m or m_px or m_cl or m_bu or m_sp or m_te or m_dk:
         from chainermn_tpu.tuning.measure import decide
 
         for row_key, spread_key, name in (
@@ -421,6 +423,8 @@ def _seed_one_result(result: dict, source: str, out: list,
              "seq_parallel_spread_pct", "prefill_seq_parallel"),
             ("serving_tenants_adapter_ms",
              "serving_tenants_adapter_spread_pct", "adapter_impl"),
+            ("serving_decode_kernel_ms",
+             "serving_decode_kernel_spread_pct", "decode_attend_impl"),
         ):
             rows = result.get(row_key)
             if not (isinstance(rows, dict) and len(rows) >= 2 and all(
@@ -450,6 +454,8 @@ def _seed_one_result(result: dict, source: str, out: list,
                     m_row = m_sp
                 elif name == "adapter_impl":
                     m_row = m_te
+                elif name == "decode_attend_impl":
+                    m_row = m_dk
                 else:
                     m_row = m
                 if m_row is None:
@@ -502,6 +508,15 @@ def _seed_one_result(result: dict, source: str, out: list,
                     v = result.get("seq_parallel_ttft_shards_ms")
                     if v is not None:
                         evidence["ttft_shards_ms"] = v
+                if name == "decode_attend_impl":
+                    # the kernel-vs-gather speedup behind the ranking
+                    # (ISSUE 19) — on a CPU proxy the fused arm timed
+                    # the interpret-mode EMULATOR, so an 'xla' entry
+                    # here is expected and only an on-chip row should
+                    # ever seed 'fused'.
+                    v = result.get("serving_decode_kernel_fused_speedup")
+                    if v is not None:
+                        evidence["fused_speedup"] = v
                 if name == "adapter_impl":
                     # the multi-tenant goodput + fairness behind the
                     # gather/merged ranking (ISSUE 14) — a 'merged'

@@ -113,6 +113,16 @@ DEFAULT_TABLE: dict = {
     "comp_slices": {"*": "1"},
     "decode_impl": {"*": "paged"},
     "kv_block_size": {"*": "64"},
+    # Fused paged-decode Pallas kernel (ISSUE 19): 'xla' = scatter →
+    # dense-view gather → einsum attend; 'fused' = one flash-decoding
+    # HBM pass with the block table as a scalar-prefetch operand
+    # (ops/paged_decode.py). 'xla' everywhere — the kernel must EARN
+    # adoption through bench's ``serving_decode_kernel`` step-time rows
+    # (spread-gated; the spec_tokens precedent), and interpret-mode CPU
+    # emulation is slower than the XLA path by construction, so only a
+    # live-chip capture can honestly flip this. byte_audit's decode
+    # workload prices the HBM-bytes case the proxy can't.
+    "decode_attend_impl": {"*": "xla"},
     "spec_tokens": {"*": "0"},
     "prefix_cache": {"*": "on"},
     "min_shared_blocks": {"*": "1"},

@@ -942,3 +942,78 @@ def test_serving_sampled_rows_contract():
         spreads={"plain": 12.0, "spec": 12.0},
         higher_is_better=True,
     ) is None
+
+
+def test_decode_kernel_rows_contract_and_seeding(tmp_path):
+    """ISSUE 19 satellite: the fused-kernel adoption rows ride the
+    compact line (per-impl ms, spread gate, fused speedup, selected)
+    and ``tuning seed`` learns ``decode_attend_impl`` from
+    ``serving_decode_kernel_ms`` under the same spread gate — keyed by
+    the phase's OWN model shape, with the kernel-vs-gather speedup as
+    auditable evidence. The table default is 'xla' (the kernel must
+    EARN adoption on a live chip; the CPU proxy times interpret-mode
+    emulation, so its honest verdict is refusal-or-xla)."""
+    for k in ("serving_decode_kernel_ms",
+              "serving_decode_kernel_spread_pct",
+              "serving_decode_kernel_fused_speedup",
+              "serving_decode_kernel_selected"):
+        assert k in bench._COMPACT_KEYS, k
+    assert callable(bench._bench_serving_decode_kernel)
+    import inspect
+
+    src = inspect.getsource(bench._run_bench)
+    assert ('supp("serving_decode_kernel", '
+            '"serving_decode_kernel_error"') in src
+
+    # the registry's shipped default: the kernel has NOT been adopted
+    from chainermn_tpu.tuning.registry import DEFAULT_TABLE
+
+    assert DEFAULT_TABLE["decode_attend_impl"] == {"*": "xla"}
+
+    from chainermn_tpu.tuning.cache import (
+        load_cache,
+        seed_from_bench_details,
+    )
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-06T00:00:00Z",
+        # the phase's own shape key, diverging from the main serving
+        # shape on purpose: last-writer-wins on a merged key would
+        # re-key the other phase's decisions
+        "serving_model_shape": "D256xH4xL256",
+        "serving_decode_kernel_model_shape": "D512xH8xL512",
+        "serving_decode_kernel_ms": {"xla": 3.0, "fused": 1.2},
+        "serving_decode_kernel_spread_pct": 6.0,
+        "serving_decode_kernel_fused_speedup": 2.5,
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert ("decode_attend_impl|TPU v5 lite|512x8x512|decode -> fused"
+            in seeded)
+    entry = load_cache(str(cache))["decisions"][
+        "decode_attend_impl|TPU v5 lite|512x8x512|decode"]
+    assert entry["fused_speedup"] == 2.5
+    assert entry["candidates_ms"]["fused"] == 1.2
+
+    # spread-dominated rows are refused: the 'xla' default stands
+    doc["serving_decode_kernel_ms"] = {"xla": 1.0, "fused": 0.97}
+    doc["serving_decode_kernel_spread_pct"] = 9.0
+    details.write_text(json.dumps(doc))
+    cache2 = tmp_path / "cache2.json"
+    assert "decode_attend_impl" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+    # ABSENT spread = on-accel single sample: the 10% floor applies
+    doc.pop("serving_decode_kernel_spread_pct")
+    doc["serving_decode_kernel_ms"] = {"xla": 1.0, "fused": 0.95}
+    details.write_text(json.dumps(doc))
+    assert "decode_attend_impl" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+    doc["serving_decode_kernel_ms"] = {"xla": 2.0, "fused": 0.9}
+    details.write_text(json.dumps(doc))
+    assert ("decode_attend_impl|TPU v5 lite|512x8x512|decode -> fused"
+            in "\n".join(seed_from_bench_details(str(details),
+                                                 str(cache2))))

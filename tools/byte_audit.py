@@ -12,6 +12,7 @@ Usage::
     python tools/byte_audit.py transformer [--remat dots|nothing|none]
         [--batch 16] [--chunks 16]
     python tools/byte_audit.py resnet [--remat none|conv|full] [--batch 128]
+    python tools/byte_audit.py decode [--live-frac 0.5]
 
 Prints one JSON object: per-step FLOPs, XLA "bytes accessed" (post-fusion
 HBM traffic estimate of the partitioned module), peak/temp memory from
@@ -241,9 +242,162 @@ def audit_resnet(remat: str, batch: int) -> dict:
     return rec
 
 
+def _decode_attend_models(*, slots: int, max_len: int, bs: int,
+                          heads: int, kv_heads: int, head_dim: int,
+                          itemsize: int, live_frac: float) -> dict:
+    """Structural per-tick HBM byte models for the three paged-decode
+    attend stories (ISSUE 19) — pure shape arithmetic, no compile, so
+    the accounting is backend-independent:
+
+    - ``floor``: ONE live-KV read (every live (token, kv-head) element
+      of K and V touched exactly once) + the q read and o write. No
+      attend that looks at the whole live history can read less.
+    - ``fused``: the kernel's actual traffic — live blocks once per
+      kv-head slice (grid ``(B, Hkv, M)``, block ``(1, bs, 1, D)``),
+      PLUS one redirect block per (slot, head) (dead grid cells aim
+      their DMA at a fixed block; Pallas skips refetching an unchanged
+      index, so the dead tail costs O(1) reads, not O(M)), PLUS the
+      sublane-padded q/o rows (``R_pad >= 8``).
+    - ``xla_gather``: the dense-view story — ``pool[tables]`` reads the
+      FULL table width regardless of liveness, materializes the view
+      (write + attend read-back), and the masked fp32 scores make an
+      HBM round-trip. Horizon-priced by construction: its bytes do not
+      shrink when the history is short.
+
+    ``live_frac`` sets the live history length (fraction of
+    ``max_len``) for the floor/fused side; ``*_full`` rows price the
+    full-horizon case where even the fused kernel must read every
+    block. Ratios land in docs/benchmarks.md next to the measured
+    serving_decode_kernel rows."""
+    group = heads // kv_heads
+    r_pad = max(8, -(-group // 8) * 8)  # T=1 decode tick rows
+    q_bytes = slots * kv_heads * r_pad * head_dim * itemsize
+    o_bytes = q_bytes
+    qo_floor = 2 * slots * heads * head_dim * itemsize  # unpadded
+    m_total = -(-max_len // bs)
+    block_bytes = bs * head_dim * itemsize  # one kv-head's slice
+
+    def kv(nblocks):  # K and V, every kv head, nblocks per slot
+        return 2 * slots * kv_heads * nblocks * block_bytes
+
+    def story(nblocks):
+        floor = kv(nblocks) + qo_floor
+        fused = kv(min(nblocks + 1, m_total)) + q_bytes + o_bytes
+        xla = (3 * kv(m_total)                      # gather+write+read
+               + 2 * slots * heads * m_total * bs * 4   # fp32 scores
+               + qo_floor)
+        return {
+            "floor_bytes": floor, "fused_bytes": fused,
+            "xla_gather_bytes": xla,
+            "fused_vs_floor_x": round(fused / floor, 2),
+            "xla_vs_fused_x": round(xla / fused, 1),
+        }
+
+    live = max(1, min(m_total, round(m_total * live_frac)))
+    rec = {"live_blocks": live, "total_blocks": m_total,
+           "live_frac": live_frac}
+    rec.update(story(live))
+    rec.update({k + "_full": v for k, v in story(m_total).items()})
+    return rec
+
+
+def audit_decode(live_frac: float) -> dict:
+    """ISSUE 19: roofline the paged DECODE tick, xla vs fused.
+
+    Measured side: AOT-compile the serving engine's real decode-step
+    program (``_decode_step_jit`` — the very program the bench's
+    serving phases time) per ``decode_attend_impl`` at the bench's
+    backend shape and run the usual analyses/floors. On CPU the fused
+    program compiles the kernel's interpret-mode EMULATION, whose
+    bytes describe the emulator, not the kernel — labelled, and the
+    reason the structural section exists.
+
+    Structural side: :func:`_decode_attend_models` at the audited
+    shape AND at the accel serving shape (the on-chip roofline
+    target; arithmetic needs no compile)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import ServingEngine
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # The serving bench's shape convention (bench._bench_serving):
+    # accel vs CPU-proxy.
+    if on_tpu:
+        layers, d_model, heads, d_ff = 4, 512, 8, 2048
+        vocab, max_len, slots, bs = 32000, 512, 16, 32
+        dtype = jnp.bfloat16
+    else:
+        layers, d_model, heads, d_ff = 2, 64, 4, 128
+        vocab, max_len, slots, bs = 256, 64, 4, 8
+        dtype = jnp.float32
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        d_model=d_model, d_ff=d_ff, max_len=max_len, compute_dtype=dtype,
+    )
+    _note(f"decode: init params (backend={jax.devices()[0].platform})")
+    params = jax.jit(
+        functools.partial(model.init, train=False)
+    )(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    itemsize = jnp.dtype(dtype).itemsize
+    head_dim = d_model // heads
+    rec = {
+        "workload": "paged_decode",
+        "config": (f"D{d_model}xH{heads}xL{max_len} slots={slots} "
+                   f"bs={bs} layers={layers}"),
+        "impls": {},
+    }
+    for impl in ("xla", "fused"):
+        _note(f"decode: compiling decode step (attend={impl})")
+        sub: dict = {}
+        try:
+            eng = ServingEngine(
+                model, params, num_slots=slots, max_len=max_len,
+                decode_impl="paged", decode_attend_impl=impl,
+                kv_block_size=bs, prefill_buckets=(8,), spec_tokens=0,
+            )
+            args = (
+                eng._cache, eng._vars,
+                jnp.zeros((slots,), jnp.int32),
+                jnp.zeros((slots,), jnp.int32),
+                jnp.asarray(eng._dummy_tables()),
+                jnp.asarray(eng._seeds),
+            )
+            compiled = eng._decode_step_jit.lower(*args).compile()
+            sub.update(_analyses(compiled))
+            _floors(sub, steps_in_program=1)
+            if impl == "fused" and not on_tpu:
+                sub["bytes_note"] = (
+                    "CPU compile runs the kernel's interpret-mode "
+                    "emulation: these bytes describe the emulator, not "
+                    "the kernel — the structural section below is the "
+                    "honest fused number off-chip; re-audit on chip "
+                    "(tools/on_chip_capture.sh logs this)"
+                )
+        except Exception as e:
+            sub["error"] = f"{type(e).__name__}: {e}"[:200]
+        rec["impls"][impl] = sub
+    _note("decode: structural attend models")
+    rec["attend_model"] = _decode_attend_models(
+        slots=slots, max_len=max_len, bs=bs, heads=heads,
+        kv_heads=heads, head_dim=head_dim, itemsize=itemsize,
+        live_frac=live_frac)
+    if not on_tpu:
+        # The on-chip roofline target, priced by the same arithmetic.
+        rec["attend_model_accel_shape"] = dict(
+            config="D512xH8xL512 slots=16 bs=32 bf16",
+            **_decode_attend_models(
+                slots=16, max_len=512, bs=32, heads=8, kv_heads=8,
+                head_dim=64, itemsize=2, live_frac=live_frac))
+    return rec
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("workload", choices=["transformer", "resnet"])
+    ap.add_argument("workload", choices=["transformer", "resnet", "decode"])
     ap.add_argument("--remat", default="dots")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--chunks", type=int, default=16)
@@ -252,6 +406,11 @@ def main() -> None:
         help="seq-axis shard count for the transformer audit's "
              "seq_ring wire-byte rows (ISSUE 13); the ring's per-hop "
              "K/V bytes are ICI-plane roofline inputs")
+    ap.add_argument(
+        "--live-frac", type=float, default=0.5,
+        help="live-history fraction of max_len for the decode audit's "
+             "floor/fused attend models (ISSUE 19); the xla dense-view "
+             "gather is horizon-priced regardless")
     ap.add_argument(
         "--target", choices=["auto", "cpu"], default="auto",
         help="cpu: pin the CPU backend before first device use "
@@ -267,6 +426,8 @@ def main() -> None:
     if args.workload == "transformer":
         rec = audit_transformer(
             args.remat, args.batch or 16, args.chunks)
+    elif args.workload == "decode":
+        rec = audit_decode(args.live_frac)
     else:
         rec = audit_resnet(
             args.remat if args.remat != "dots" else "none",

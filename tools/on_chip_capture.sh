@@ -19,6 +19,35 @@ stamp=$(date -u +%Y%m%dT%H%M%SZ)
 . "$(dirname "$0")/capture_lib.sh"
 _fresh() { fresh_artifact "$1" "$2" "${CAPTURE_SINCE:-}"; }
 
+# Stage 0 (ISSUE 19, first on purpose — the kernel_sweep precedent:
+# a Mosaic layout rejection must reach the artifact even if the budget
+# cuts everything below): AOT compile-check EVERY Pallas kernel (flash
+# fwd/bwd + fused paged decode) with interpret=False. Interpret mode
+# accepts layouts Mosaic rejects; this stage is what upgrades the
+# CPU-green kernels to chip-trusted — and the gate on ever adopting
+# decode_attend_impl=fused (ROADMAP's on-chip residue list).
+if _fresh 'kernel_compile_2*.json' '"n_cases"'; then
+  echo "[capture $stamp] stage 0: skipped (fresh kernel compile check exists)"
+else
+  echo "[capture $stamp] stage 0: Mosaic compile check (all Pallas kernels)"
+  timeout 900 python tools/kernel_compile_check.py \
+    --json "tools/capture_logs/kernel_compile_$stamp.json" \
+    > /dev/null 2> "tools/capture_logs/kernel_compile_$stamp.log"
+  rc=$?
+  echo "[capture] kernel compile check rc=$rc (0 = all compiled):"
+  python - "tools/capture_logs/kernel_compile_$stamp.json" <<'PYEOF'
+import json, sys
+try:
+    doc = json.load(open(sys.argv[1]))
+except Exception as e:
+    print(f"  (no artifact: {e})")
+else:
+    for r in doc.get("results", []):
+        mark = "ok" if r.get("ok") else f"FAIL {r.get('error', '')[:120]}"
+        print(f"  {r['kernel']}: {mark} ({r.get('compile_s')}s)")
+PYEOF
+fi
+
 # bench_2* (not bench_*): stage 4 writes bench_best_<stamp>.log, whose
 # live best-config rows must not suppress the default-config stage-1
 # bench the README/docs numbers are drawn from.
@@ -60,7 +89,8 @@ else
 fi
 
 if _fresh 'byte_audit_tf_2*.json' '"flops":' \
-    && _fresh 'byte_audit_resnet_2*.json' '"flops":'; then
+    && _fresh 'byte_audit_resnet_2*.json' '"flops":' \
+    && _fresh 'byte_audit_decode_2*.json' '"attend_model"'; then
   echo "[capture] stage 1b: skipped (fresh audits exist)"
 else
   echo "[capture] stage 1b: roofline byte audits (CPU-target: FLOPs are"
@@ -75,6 +105,12 @@ else
     > "tools/capture_logs/byte_audit_resnet_$stamp.json" \
     2> "tools/capture_logs/byte_audit_resnet_$stamp.log"
   echo "[capture] resnet audit rc=$?"
+  # ISSUE 19: the paged-decode roofline (structural attend models are
+  # backend-independent; the measured impls re-run TPU-target in stage 5)
+  timeout 600 python tools/byte_audit.py decode --target cpu \
+    > "tools/capture_logs/byte_audit_decode_$stamp.json" \
+    2> "tools/capture_logs/byte_audit_decode_$stamp.log"
+  echo "[capture] decode audit rc=$?"
 fi
 
 if _fresh 'resnet_sweep_*.log' 'n_variants'; then
@@ -195,5 +231,12 @@ else
     2> "tools/capture_logs/byte_audit_tf_tpu_$stamp.log"
   echo "[capture] tf tpu-audit rc=$? trail:"
   tail -2 "tools/capture_logs/byte_audit_tf_tpu_$stamp.log"
+  # ISSUE 19: on-chip decode audit — the REAL fused bytes-accessed
+  # number (the CPU run above measured the interpret emulator)
+  timeout 600 python tools/byte_audit.py decode \
+    > "tools/capture_logs/byte_audit_decode_tpu_$stamp.json" \
+    2> "tools/capture_logs/byte_audit_decode_tpu_$stamp.log"
+  echo "[capture] decode tpu-audit rc=$? trail:"
+  tail -2 "tools/capture_logs/byte_audit_decode_tpu_$stamp.log"
 fi
 echo "[capture $stamp] done"
