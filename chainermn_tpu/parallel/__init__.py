@@ -71,10 +71,16 @@ def __getattr__(name):
 
         return getattr(_comp, name)
     if name in ("moe_layer_local", "top1_route", "topk_route",
-                "load_balancing_loss", "make_expert_params"):
+                "load_balancing_loss", "make_expert_params",
+                "moe_capacity", "routing_stats",
+                "resolve_expert_parallel"):
         from chainermn_tpu.parallel import moe as _m
 
         return getattr(_m, name)
+    if name == "moe_plan_axis":
+        from chainermn_tpu.parallel import plan_specs as _pspec
+
+        return getattr(_pspec, name)
     if name in (
         "fsdp_shardings", "create_fsdp_train_state", "make_fsdp_train_step"
     ):
@@ -143,6 +149,10 @@ __all__ = [
     "topk_route",
     "load_balancing_loss",
     "make_expert_params",
+    "moe_capacity",
+    "routing_stats",
+    "resolve_expert_parallel",
+    "moe_plan_axis",
     "fsdp_shardings",
     "create_fsdp_train_state",
     "make_fsdp_train_step",

@@ -92,12 +92,22 @@ def topk_route(
     )
 
 
-def load_balancing_loss(logits: jax.Array) -> jax.Array:
+def load_balancing_loss(
+    logits: jax.Array, axis_name=None
+) -> jax.Array:
     """Switch/GShard auxiliary load-balancing loss:
     ``n_experts * mean_e(fraction_of_tokens_e * mean_router_prob_e)``
     (top-1 assignment fraction, the standard estimator for any k) —
     1.0 at perfect balance, grows as routing collapses onto few experts.
     Add ``aux_weight * load_balancing_loss(logits)`` to the task loss.
+
+    ``axis_name``: when the token dim is SHARDED over mesh axes, pass
+    the axis name (or tuple of names — e.g. ``('data', 'expert')`` under
+    a composed plan) — the per-expert fraction and mean probability are
+    pmean'd over the axes before the product, so the value is invariant
+    to token-shard layout (the loss of the GLOBAL batch, identical to
+    computing it locally over the gathered logits; equal-sized shards
+    assumed, as everywhere in the plan).
     """
     n_experts = logits.shape[-1]
     probs = jax.nn.softmax(logits, axis=-1)
@@ -105,7 +115,42 @@ def load_balancing_loss(logits: jax.Array) -> jax.Array:
     top1 = jax.nn.one_hot(jnp.argmax(probs, -1), n_experts, dtype=probs.dtype)
     frac = top1.mean(axis=0)
     mean_prob = probs.mean(axis=0)
+    if axis_name is not None:
+        frac = lax.pmean(frac, axis_name)
+        mean_prob = lax.pmean(mean_prob, axis_name)
     return n_experts * jnp.sum(frac * mean_prob)
+
+
+def routing_stats(logits: jax.Array, capacity: int, k: int = 1) -> dict:
+    """Drop/pad accounting for one routing pass (shard-local; callers
+    inside ``shard_map`` psum the counts over the expert axis —
+    :func:`moe_layer_local` with ``return_stats=True`` does).
+
+    Returns float32 scalars/vectors (so they ride the plan's metric
+    pmean): ``expert_load`` ``[n_experts]`` kept-token counts per
+    expert, ``dropped`` (capacity-overflow assignments, the tokens the
+    residual path carries), ``padded`` (empty queue slots shipped over
+    the wire anyway — the static-shape tax), and ``capacity``.
+    """
+    n_experts = logits.shape[-1]
+    sentinel = n_experts * capacity
+    load = jnp.zeros((n_experts,), jnp.float32)
+    dropped = jnp.zeros((), jnp.float32)
+    for slot, _ in route_slots(logits, capacity, k):
+        kept = slot != sentinel
+        expert = jnp.where(kept, slot // capacity, 0)
+        load = load + jnp.where(
+            kept[:, None],
+            jax.nn.one_hot(expert, n_experts, dtype=jnp.float32),
+            0.0,
+        ).sum(0)
+        dropped = dropped + (~kept).astype(jnp.float32).sum()
+    return {
+        "expert_load": load,
+        "dropped": dropped,
+        "padded": jnp.float32(sentinel) - load.sum(),
+        "capacity": jnp.float32(capacity),
+    }
 
 
 def route_slots(
@@ -259,6 +304,53 @@ def resolve_dispatch_impl(
     return tuning.choice("moe_dispatch", ("sort", "einsum"), key)
 
 
+def moe_capacity(
+    tokens: int, n_experts: int, k: int,
+    capacity_factor: Optional[float],
+) -> int:
+    """The static per-expert queue depth: ``ceil(tokens*k/n_experts *
+    capacity_factor)``, floored at 1 (``capacity_factor=0`` is the
+    legal minimal-capacity extreme: one slot per expert, everything
+    else drops to the residual). ``capacity_factor=None`` means NO-DROP
+    capacity (``tokens`` — the worst case of every local token choosing
+    the same expert), the serving contract: routing decouples across
+    co-resident rows, so streams stay bit-identical to sequential
+    ``generate`` whatever else shares the batch."""
+    import math
+
+    if capacity_factor is None:
+        return max(1, tokens)
+    if capacity_factor < 0:
+        raise ValueError(
+            f"capacity_factor must be >= 0 (or None for no-drop), got "
+            f"{capacity_factor}"
+        )
+    return max(1, math.ceil(tokens * k / n_experts * capacity_factor))
+
+
+def resolve_expert_parallel(
+    tokens: int, n_experts: int, d_model: int, dtype,
+    choice: str = "auto",
+) -> str:
+    """``'on'``/``'off'`` — whether this MoE workload should spread over
+    an ``'expert'`` mesh axis (two all_to_alls per layer, experts
+    sharded) or stay replicated-local (every shard hosts every expert,
+    zero collectives). Resolved through the autotune registry (decision
+    ``expert_parallel``, keyed like ``moe_dispatch``); the table says
+    ``off`` everywhere — spreading must EARN adoption through bench's
+    ``moe`` phase step-time rows (spread-gated, the spec_tokens
+    precedent), because on a single host the a2a pair is pure overhead
+    and only a real multi-chip capture can price the HBM-per-expert win
+    honestly. ``choice`` other than ``'auto'`` short-circuits."""
+    if choice != "auto":
+        return choice
+    from chainermn_tpu import tuning
+
+    key = tuning.decision_key(shape=(tokens, n_experts, d_model),
+                              dtype=dtype)
+    return tuning.choice("expert_parallel", ("off", "on"), key)
+
+
 def moe_layer_local(
     x: jax.Array,              # [tokens_local, d_model]
     router_w: jax.Array,       # [d_model, n_experts_global]
@@ -266,13 +358,18 @@ def moe_layer_local(
     expert_params: PyTree,     # THIS shard's expert params
     axis_name: str = "expert",
     *,
-    capacity_factor: float = 1.25,
+    capacity_factor: Optional[float] = 1.25,
     k: int = 1,
     dispatch_impl: str = "auto",
-) -> jax.Array:
-    """One MoE layer inside ``shard_map``: one expert per shard along
-    ``axis_name``; tokens ride two ``all_to_all``s. ``k=1`` is Switch-style
-    top-1 routing, ``k=2`` GShard-style top-2 (capacity scales with k).
+    experts_per_shard: int = 1,
+    return_stats: bool = False,
+    stats_axes=None,
+):
+    """One MoE layer inside ``shard_map``: ``experts_per_shard`` experts
+    per shard along ``axis_name`` (global expert ``e`` lives on shard
+    ``e // experts_per_shard``); tokens ride two ``all_to_all``s. ``k=1``
+    is Switch-style top-1 routing, ``k=2`` GShard-style top-2 (capacity
+    scales with k).
 
     ``dispatch_impl``: ``'einsum'`` (dense one-hot [T,E,C] tensors — the
     reference form, fine at test scale), ``'sort'`` (index scatter +
@@ -282,30 +379,74 @@ def moe_layer_local(
     default encodes. Either impl is numerically identical (tested), so
     the choice is pure performance.
 
+    ``experts_per_shard > 1``: ``expert_params`` leaves stack a leading
+    ``[experts_per_shard, ...]`` dim (:func:`make_expert_params` over
+    this shard's slice) and ``expert_fn`` is vmapped over it; the
+    ``all_to_all`` ships ``experts_per_shard`` queues per peer, so the
+    collective count is UNCHANGED (still exactly two per layer).
+
+    ``capacity_factor=None`` selects no-drop capacity (see
+    :func:`moe_capacity`).
+
     Returns the combined expert outputs for the local tokens (zeros for
-    dropped tokens — add the residual outside).
+    dropped tokens — add the residual outside); with
+    ``return_stats=True``, ``(out, aux)`` where ``aux`` carries the
+    layout-invariant ``load_balance`` loss plus :func:`routing_stats`
+    totals psum'd over ``stats_axes`` (``expert_load`` ``[n_experts]``,
+    ``dropped``, ``padded``, ``capacity`` — float32). ``stats_axes``
+    defaults to ``axis_name`` but under a composed plan must name EVERY
+    axis the token dim shards over (``dp_axes + ('expert',)``) or the
+    aux loss is the mean of per-data-shard values, not the global one.
     """
-    import math
-
     n = lax.axis_size(axis_name)
+    eps = int(experts_per_shard)
     tokens, d = x.shape
-    capacity = max(1, math.ceil(tokens * k / n * capacity_factor))
+    e_global = n * eps
+    if router_w.shape[-1] != e_global:
+        raise ValueError(
+            f"router_w scores {router_w.shape[-1]} experts but the "
+            f"'{axis_name}' axis hosts {e_global} "
+            f"({n} shards x {eps} experts/shard)"
+        )
+    capacity = moe_capacity(tokens, e_global, k, capacity_factor)
 
-    logits = x @ router_w  # [tokens, n]
-    impl = resolve_dispatch_impl(tokens, n, d, x.dtype, dispatch_impl)
+    logits = x @ router_w  # [tokens, e_global]
+    impl = resolve_dispatch_impl(tokens, e_global, d, x.dtype,
+                                 dispatch_impl)
     queues, combine_fn = _DISPATCH[impl](x, logits, capacity, k)
 
-    # Exchange: shard i sends queue row e to shard e, receives its own
-    # expert's queue from every shard -> [n(senders), capacity, d]
+    # Exchange: shard i sends queue rows [j*eps:(j+1)*eps] to shard j,
+    # receives ITS experts' queues from every shard
+    # -> [n(senders) * eps, capacity, d], sender-major
     recv = lax.all_to_all(queues, axis_name, split_axis=0, concat_axis=0,
                           tiled=True)
-    # Run THIS shard's expert on all n*capacity tokens at once (MXU-batched)
-    out = expert_fn(expert_params, recv.reshape(n * capacity, d))
-    out = out.reshape(n, capacity, d)
-    # Return trip + weighted combine back into token order
+    recv = recv.reshape(n, eps, capacity, d).transpose(1, 0, 2, 3)
+    if eps == 1:
+        # one expert per shard: keep the original expert_fn contract
+        # (params un-stacked, one MXU-batched call over n*capacity rows)
+        out = expert_fn(expert_params, recv.reshape(n * capacity, d))
+        out = out.reshape(1, n, capacity, d)
+    else:
+        out = jax.vmap(expert_fn)(
+            expert_params, recv.reshape(eps, n * capacity, d)
+        ).reshape(eps, n, capacity, d)
+    # restore global-expert-major order for the return trip
+    out = out.transpose(1, 0, 2, 3).reshape(e_global, capacity, d)
     back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                           tiled=True)
-    return combine_fn(back)
+    combined = combine_fn(back)
+    if not return_stats:
+        return combined
+    stats = routing_stats(logits, capacity, k)
+    red = axis_name if stats_axes is None else tuple(stats_axes)
+    aux = {
+        "load_balance": load_balancing_loss(logits, red),
+        "expert_load": lax.psum(stats["expert_load"], red),
+        "dropped": lax.psum(stats["dropped"], red),
+        "padded": lax.psum(stats["padded"], red),
+        "capacity": stats["capacity"],
+    }
+    return combined, aux
 
 
 def make_expert_params(init_fn: Callable, rng: jax.Array, n_experts: int):

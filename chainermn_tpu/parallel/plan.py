@@ -36,7 +36,17 @@ per-axis modules participate as *spec providers*
   all_to_alls in, one out) via the ``seq_attn_impl`` tuning decision
   (:meth:`ParallelPlan.seq_attention`), and gradients take one extra
   all-reduce over the axis (mean over token shards) before the dp
-  reduction.
+  reduction;
+- ``expert`` — MoE expert parallelism (ISSUE 20): expert parameter
+  leaves stack ``[n, ...]`` shards (``P('expert')``), the batch's token
+  dim shards over the axis (extra data parallelism for every non-expert
+  leaf), and tokens ride exactly two ``all_to_all``s per MoE layer per
+  pass (:func:`~chainermn_tpu.parallel.moe.moe_layer_local`, routed via
+  :meth:`ParallelPlan.moe_layer` — the ``moe_dispatch`` tuning
+  decision). Replicated leaves' gradients take one fused all-reduce
+  over the axis; expert-stacked leaves take NONE — the all_to_all's
+  exact transpose already lands every shard's cotangents on the owning
+  shard, and the plan rescales them to the global token mean.
 
 Two composed forms ride the same contract (ISSUE 13 sweep-ins):
 ``zero_stacked_groups=True`` chunks the STACKED groups' optimizer state
@@ -201,6 +211,7 @@ class ParallelPlan:
         #: shape as ``ServingEngine.decisions``).
         self.decisions: list[dict] = []
         self._seq_impl: Optional[str] = None
+        self._moe_impl: Optional[str] = None
         self._zsg = bool(zero_stacked_groups)
         if self._zsg:
             if "zero" not in self.axes:
@@ -264,12 +275,17 @@ class ParallelPlan:
         return math.prod(self.axis_size(a) for a in self.dp_axes) or 1
 
     def batch_spec(self) -> P:
-        """Batch sharding: dim 0 over the dp axes, and — with a ``seq``
-        axis — dim 1 (the sequence) over it: every batch leaf must then
-        carry ``[B, T, ...]`` with ``T`` divisible by the seq size."""
+        """Batch sharding: dim 0 over the dp axes (plus ``expert`` when
+        present — the expert axis shards tokens too, by batch row), and
+        — with a ``seq`` axis — dim 1 (the sequence) over it: every
+        batch leaf must then carry ``[B, T, ...]`` with ``T`` divisible
+        by the seq size."""
+        row_axes = self.dp_axes + (
+            ("expert",) if "expert" in self.axes else ()
+        )
         if "seq" in self.axes:
-            return P(self.dp_axes if self.dp_axes else None, "seq")
-        return P(self.dp_axes) if self.dp_axes else P()
+            return P(row_axes if row_axes else None, "seq")
+        return P(row_axes) if row_axes else P()
 
     def describe(self) -> dict:
         """Axis sizes + the collectives each spec provider owes the step
@@ -287,6 +303,8 @@ class ParallelPlan:
             out["zero_stacked_groups"] = True
         if self._seq_impl is not None:
             out["seq_attn_impl"] = self._seq_impl
+        if self._moe_impl is not None:
+            out["moe_dispatch_impl"] = self._moe_impl
         return out
 
     # -- the seq axis's attention router (ISSUE 13) -------------------------
@@ -395,6 +413,84 @@ class ParallelPlan:
                     impl="flash", interpret=interpret, **kw,
                 )
         return attn_fn, record
+
+    # -- the expert axis's MoE router (ISSUE 20) ----------------------------
+
+    def moe_layer(
+        self,
+        *,
+        tokens_local: int,
+        d_model: int,
+        experts_per_shard: int = 1,
+        capacity_factor: Optional[float] = 1.25,
+        k: int = 1,
+        impl: str = "auto",
+        dtype=None,
+    ):
+        """Resolve the ``moe_dispatch`` tuning decision for the
+        ``expert`` axis and return ``(moe_fn, record)`` — ``moe_fn(x,
+        router_w, expert_fn, expert_params) -> (out, aux)`` runs INSIDE
+        the compiled step's shard_map
+        (:func:`~chainermn_tpu.parallel.moe.moe_layer_local` with
+        ``return_stats=True``). ``aux`` carries the axis-invariant
+        ``load_balance`` loss (add ``aux_weight * aux['load_balance']``
+        to the task loss) plus the drop/pad accounting
+        (``expert_load`` ``[E]``, ``dropped``, ``padded``, ``capacity``
+        — globals over the axis, float32 so they ride the plan's metric
+        pmean). The resolved impl is recorded in ``plan.decisions``
+        (same provenance shape as :meth:`seq_attention`) and named by
+        :meth:`describe`."""
+        from chainermn_tpu import tuning
+        from chainermn_tpu.parallel import moe as _moe
+
+        if "expert" not in self.axes:
+            raise ValueError("moe_layer needs an 'expert' plan axis")
+        n = self.axis_size("expert")
+        e_global = n * int(experts_per_shard)
+        if k > e_global:
+            raise ValueError(
+                f"moe_layer k={k} exceeds n_experts={e_global} "
+                f"({n} shards x {experts_per_shard} experts/shard)"
+            )
+        key = tuning.decision_key(
+            shape=(max(1, int(tokens_local)), e_global, int(d_model)),
+            dtype=dtype if dtype is not None else jnp.float32,
+        )
+        if impl == "auto":
+            winner = tuning.choice("moe_dispatch", ("sort", "einsum"), key)
+            source = next(
+                (d["source"] for d in tuning.decisions_taken()
+                 if d["name"] == "moe_dispatch" and d["key"] == key),
+                "table",
+            )
+        elif impl in ("sort", "einsum"):
+            winner, source = impl, "explicit"
+        else:
+            raise ValueError(
+                f"moe_dispatch impl must be 'sort', 'einsum' or 'auto', "
+                f"got {impl!r}"
+            )
+        record = {"name": "moe_dispatch", "key": key, "winner": winner,
+                  "source": source}
+        self.decisions.append(record)
+        self._moe_impl = winner
+
+        # the token dim shards over every row axis (batch_spec), so the
+        # aux stats must reduce over ALL of them — reducing over 'expert'
+        # alone would leave per-data-shard aux losses under expert x data
+        stats_axes = self.dp_axes + ("expert",)
+
+        def moe_fn(x, router_w, expert_fn, expert_params):
+            return _moe.moe_layer_local(
+                x, router_w, expert_fn, expert_params, "expert",
+                capacity_factor=capacity_factor, k=k,
+                dispatch_impl=winner,
+                experts_per_shard=experts_per_shard,
+                return_stats=True,
+                stats_axes=stats_axes,
+            )
+
+        return moe_fn, record
 
     # -- specs --------------------------------------------------------------
 
@@ -611,7 +707,10 @@ class ParallelPlan:
         dp_axes = self.dp_axes
         dp_total = self.dp_size
         has_seq = "seq" in self.axes
-        red_axes = dp_axes + (("seq",) if has_seq else ())
+        has_expert = "expert" in self.axes
+        n_expert = self.axis_size("expert")
+        red_axes = (dp_axes + (("seq",) if has_seq else ())
+                    + (("expert",) if has_expert else ()))
         grad_comp = self._grad_comp
         zsg = self._zsg
         # the zero group's structural composition (scatter axis last in
@@ -621,6 +720,11 @@ class ParallelPlan:
         spec_tree = self.param_specs(params, param_specs)
         treedef = jax.tree.structure(params)
         flat_specs = jax.tree.leaves(spec_tree)
+        #: leaf indices stacked over the expert axis (their grads arrive
+        #: fully accumulated via the all_to_all transpose — see below)
+        expert_leaves = {
+            i for i, s in enumerate(flat_specs) if "expert" in tuple(s)
+        }
         if pipeline is not None:
             # Enforce the PipelinePlanSpec contract structurally, not by
             # docstring: a replicated leaf consumed inside stage_fn would
@@ -734,6 +838,22 @@ class ParallelPlan:
                 # the global token mean before the dp reduction (mean of
                 # equal-sized shard means).
                 flat_g = lax.pmean(flat_g, "seq")
+            if has_expert:
+                # Expert shards also each computed their OWN tokens'
+                # mean loss, but only the NON-expert leaves need the
+                # fused all-reduce: an expert-stacked leaf's gradient
+                # already accumulated every shard's cotangents through
+                # the all_to_all transpose — reducing it again would mix
+                # different experts' grads. Rescale it to the same
+                # mean-of-shard-means the pmean gives the rest.
+                rep = {i: g for i, g in enumerate(flat_g)
+                       if i not in expert_leaves}
+                if rep:
+                    rep = lax.pmean(rep, "expert")
+                flat_g = [
+                    flat_g[i] / n_expert if i in expert_leaves else rep[i]
+                    for i in range(len(flat_g))
+                ]
             flat_u: list = [None] * len(flat_p)
             new_opt = {}
 

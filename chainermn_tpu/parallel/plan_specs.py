@@ -41,8 +41,12 @@ PyTree = Any
 #: layer pair), ``zero``/``pipe`` sit between; ``seq`` (ring-attention
 #: neighbour exchange per layer, ISSUE 13) sits just before ``model`` —
 #: its ppermutes want ICI, but only to a neighbour, so ``model``'s
-#: all-reduces keep the fastest slot.
-CANONICAL_AXES = ("data", "zero", "pipe", "seq", "model")
+#: all-reduces keep the fastest slot. ``expert`` (MoE all_to_all token
+#: dispatch, ISSUE 20) sits between ``seq`` and ``model``: its two
+#: per-layer all_to_alls move full token payloads and want ICI, but
+#: ``model``'s per-layer-pair all-reduces still claim the fastest slot
+#: (an a2a moves 1/n of the payload per link the allreduce moves twice).
+CANONICAL_AXES = ("data", "zero", "pipe", "seq", "expert", "model")
 
 #: the ``seq_attn_impl`` tuning decision's candidates and the HLO
 #: collectives each routes the compiled step through (what
@@ -81,6 +85,30 @@ def seq_plan_axis(impl: str = "ring", axis_name: str = "seq") -> dict:
     }
 
 
+def moe_plan_axis(axis_name: str = "expert") -> dict:
+    """Spec-provider descriptor for the ``expert`` axis (ISSUE 20 — MoE
+    expert parallelism over :func:`~chainermn_tpu.parallel.moe.
+    moe_layer_local`): expert parameter leaves STACK a leading
+    ``[n, ...]`` shard dim (``P('expert')`` — each shard hosts its slice
+    of the expert set, :func:`~chainermn_tpu.parallel.moe.
+    make_expert_params` layout), the batch's token dim shards over the
+    axis too (``ParallelPlan.batch_spec`` folds it into the dp tuple —
+    the axis is extra data parallelism for every NON-expert leaf), and
+    it owes the compiled step exactly two ``all-to-all``s per MoE layer
+    per pass (dispatch + combine; their backward transposes are again
+    all_to_alls) plus the one fused gradient all-reduce that makes
+    replicated leaves' grads the global token mean. Expert-stacked
+    leaves take NO collective over the axis: the all_to_all's exact
+    transpose already accumulates every shard's cotangents onto the
+    owning shard (the plan rescales them to the mean)."""
+    return {
+        "name": axis_name,
+        "stacked": True,
+        "state_stacked": False,
+        "collectives": ("all-to-all", "all-reduce"),
+    }
+
+
 @dataclasses.dataclass(frozen=True)
 class AxisSpec:
     """One resolved plan axis: the provider descriptor plus its size."""
@@ -114,6 +142,8 @@ def _provider(role: str) -> dict:
         return pipe_plan_axis()
     if role == "seq":
         return seq_plan_axis()
+    if role == "expert":
+        return moe_plan_axis()
     raise ValueError(
         f"unknown plan axis {role!r}: a ParallelPlan composes "
         f"{CANONICAL_AXES} (any subset)"

@@ -96,6 +96,15 @@ from chainermn_tpu.tuning import measure as _measure
 #:   (``serving_prefix_msb_ttft_ms`` rows).
 DEFAULT_TABLE: dict = {
     "moe_dispatch": {"cpu": "sort", "tpu": "sort", "*": "sort"},
+    # Expert-axis MoE (ISSUE 20): spread the experts over an 'expert'
+    # mesh axis (2 all_to_alls/layer, 1/n experts resident per shard)
+    # vs replicated-local (every shard hosts every expert, zero
+    # collectives). 'off' everywhere — on one host the a2a pair is pure
+    # overhead, and the HBM-per-expert capacity win that motivates
+    # spreading only prices honestly on a real multi-chip mesh, so the
+    # axis must EARN adoption through bench's ``moe`` phase rows
+    # (``moe_step_ms``, spread-gated; the spec_tokens precedent).
+    "expert_parallel": {"*": "off"},
     "attention": {"cpu": "xla", "tpu": "flash", "*": "flash"},
     "attention_windowed": {"cpu": "xla", "tpu": "windowed", "*": "windowed"},
     "allreduce_wire": {"*": "bf16"},

@@ -348,6 +348,420 @@ def test_topk_no_duplicate_expert_on_underflow():
         topk_route(logits, capacity=4, k=5)
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 20: 'expert' as a first-class ParallelPlan axis
+# ---------------------------------------------------------------------------
+
+
+def _devices():
+    return jax.devices("cpu")[:8]
+
+
+def _collective_counts(txt: str) -> dict:
+    return {op: txt.count(op) for op in
+            ("all-to-all(", "all-reduce(", "collective-permute(")}
+
+
+def _moe_loss_fn(moe_fn, aux_weight=0.01, with_stats=False):
+    def loss_fn(p, batch):
+        x, y = batch
+        out, aux = moe_fn(x, p["router"], expert_fn, p["experts"])
+        out = x + out
+        loss = jnp.mean((out - y) ** 2) + aux_weight * aux["load_balance"]
+        metrics = {}
+        if with_stats:
+            metrics = {"dropped": aux["dropped"],
+                       "expert_load": aux["expert_load"]}
+        return loss, (metrics, ())
+    return loss_fn
+
+
+def _ref_moe_dense(x, router_w, stacked, k=1):
+    """No-drop dense reference: every token through its top-k experts
+    (layout-independent — what any no-drop sharding must reproduce)."""
+    from chainermn_tpu.parallel.moe import dispatch_einsum
+
+    logits = x @ router_w
+    queues, combine_fn = dispatch_einsum(x, logits, x.shape[0], k)
+    out = jax.vmap(expert_fn)(stacked, queues)
+    return combine_fn(out)
+
+
+def _ref_moe_loss(p, batch, aux_weight=0.01, k=1):
+    from chainermn_tpu.parallel.moe import load_balancing_loss
+
+    x, y = batch
+    out = x + _ref_moe_dense(x, p["router"], p["experts"], k)
+    return (jnp.mean((out - y) ** 2)
+            + aux_weight * load_balancing_loss(x @ p["router"]))
+
+
+class TestExpertPlanAxis:
+    """'expert' beside data x zero x pipe x seq x model (ISSUE 20): the
+    spec-provider contract, dist == single values AND grads roped through
+    the real compiled train step, and the compiled HLO pinned at exactly
+    2 all_to_alls per MoE layer per pass."""
+
+    def _params(self, n_experts, rng=2):
+        import optax  # noqa: F401
+
+        experts = make_expert_params(
+            _expert_init, jax.random.PRNGKey(rng), n_experts
+        )
+        router = jax.random.normal(
+            jax.random.PRNGKey(rng + 1), (D, n_experts)) / 4.0
+        return {"experts": experts, "router": router}
+
+    def test_moe_plan_axis_provider(self):
+        from chainermn_tpu.parallel.plan_specs import (
+            CANONICAL_AXES, moe_plan_axis,
+        )
+
+        d = moe_plan_axis()
+        assert d["name"] == "expert"
+        assert d["stacked"] is True
+        assert d["state_stacked"] is False
+        assert d["collectives"] == ("all-to-all", "all-reduce")
+        # canonical slot: between seq and model (ICI-hungry, but model
+        # keeps the fastest axis)
+        assert CANONICAL_AXES.index("expert") == \
+            CANONICAL_AXES.index("model") - 1
+
+    def test_expert_plan_dist_eq_single(self):
+        """expert-only plan: one real compiled+donated train step ==
+        the single-device dense evaluation — values AND grads (certified
+        through the sgd delta), jit cache pinned at 1."""
+        import optax
+        from chainermn_tpu.parallel.plan import ParallelPlan
+
+        plan = ParallelPlan({"expert": 8}, devices=_devices())
+        params = self._params(8)
+        specs = {"experts": P("expert"), "router": P()}
+        moe_fn, rec = plan.moe_layer(
+            tokens_local=4, d_model=D, capacity_factor=None
+        )
+        assert rec["winner"] in ("sort", "einsum")
+        assert plan.describe()["moe_dispatch_impl"] == rec["winner"]
+        assert plan.describe()["collectives"]["expert"] == (
+            "all-to-all", "all-reduce",
+        )
+
+        x = jax.random.normal(jax.random.PRNGKey(5), (32, D))
+        y = jax.random.normal(jax.random.PRNGKey(6), (32, D))
+        inner = optax.sgd(0.1)
+        state = plan.create_train_state(params, inner, param_specs=specs)
+        step = plan.compile_train_step(
+            _moe_loss_fn(moe_fn, with_stats=True), inner, params,
+            param_specs=specs,
+        )
+        state, metrics = step(state, (x, y))
+        state, metrics = step(state, (x, y))
+        assert step.cache_size() in (1, None)
+
+        # reference: two plain steps on one device
+        ref = jax.device_get(params)
+        for _ in range(2):
+            l, g = jax.value_and_grad(_ref_moe_loss)(ref, (x, y))
+            ref = jax.tree.map(lambda p, gi: p - 0.1 * gi, ref, g)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+            ),
+            jax.device_get(state.params), ref,
+        )
+        np.testing.assert_allclose(float(metrics["loss"]), float(l),
+                                   rtol=1e-4)
+        # stats rode the metric pmean: loads sum to kept assignments
+        assert float(metrics["dropped"]) == 0.0
+        np.testing.assert_allclose(
+            np.asarray(metrics["expert_load"]).sum(), 32.0, rtol=1e-6)
+
+    @pytest.mark.parametrize("axes", [{"expert": 4, "data": 2},
+                                      {"expert": 4, "model": 2}])
+    def test_composed_plans_dist_eq_single(self, axes):
+        """expert x data and expert x model: dist == single values AND
+        grads through the real train step; the composition adds ZERO
+        extra all_to_alls (still exactly 2 per MoE layer per pass)."""
+        import optax
+        from chainermn_tpu.parallel.plan import ParallelPlan
+        from chainermn_tpu.parallel.tensor import stack_tp_params, tp_mlp
+
+        plan = ParallelPlan(axes, devices=_devices())
+        has_tp = "model" in axes
+        m = plan.axis_size("model")
+        params = self._params(4)
+        specs = {"experts": P("expert"), "router": P()}
+        d_ff = 32
+        if has_tp:
+            w1 = jax.random.normal(jax.random.PRNGKey(7), (D, d_ff)) / 4.0
+            w2 = jax.random.normal(jax.random.PRNGKey(8), (d_ff, D)) / 4.0
+            b2 = jnp.zeros((D,))
+            params.update({
+                "w1": stack_tp_params(w1, m, 1),
+                "w2": stack_tp_params(w2, m, 0),
+                "b2": b2,
+            })
+            specs.update({"w1": P("model"), "w2": P("model"), "b2": P()})
+        moe_fn, _ = plan.moe_layer(
+            tokens_local=8, d_model=D, capacity_factor=None
+        )
+
+        def loss_fn(p, batch):
+            x, y = batch
+            h = x
+            if has_tp:
+                h = tp_mlp(x, p["w1"], None, p["w2"], p["b2"],
+                           axis_name="model")
+            out, aux = moe_fn(h, p["router"], expert_fn, p["experts"])
+            out = h + out
+            return (jnp.mean((out - y) ** 2)
+                    + 0.01 * aux["load_balance"])
+
+        x = jax.random.normal(jax.random.PRNGKey(9), (32, D))
+        y = jax.random.normal(jax.random.PRNGKey(10), (32, D))
+        inner = optax.sgd(0.1)
+        state = plan.create_train_state(params, inner, param_specs=specs)
+        step = plan.compile_train_step(loss_fn, inner, params,
+                                       param_specs=specs)
+        counts = _collective_counts(
+            step.lower(state, (x, y)).compile().as_text()
+        )
+        # dispatch + combine forward, their exact transposes backward —
+        # nothing else (XLA may merge the back-to-back transposes)
+        assert 2 <= counts["all-to-all("] <= 4
+        assert counts["collective-permute("] == 0
+        state, metrics = step(state, (x, y))
+
+        def ref_loss(p, batch):
+            from chainermn_tpu.parallel.moe import load_balancing_loss
+
+            xb, yb = batch
+            h = xb
+            if has_tp:
+                h = jax.nn.gelu(xb @ w1) @ w2 + b2
+            out = h + _ref_moe_dense(h, p["router"], p["experts"])
+            return (jnp.mean((out - yb) ** 2)
+                    + 0.01 * load_balancing_loss(h @ p["router"]))
+
+        ref = {"experts": jax.device_get(params["experts"]),
+               "router": jax.device_get(params["router"])}
+        l, g = jax.value_and_grad(ref_loss)(ref, (x, y))
+        ref_new = jax.tree.map(lambda p, gi: p - 0.1 * gi, ref, g)
+        got = jax.device_get(state.params)
+        np.testing.assert_allclose(float(metrics["loss"]), float(l),
+                                   rtol=1e-4)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+            ),
+            {"experts": got["experts"], "router": got["router"]}, ref_new,
+        )
+        if has_tp:
+            # TP leaves see the expert axis as extra data parallelism:
+            # the sharded update must match the dense w1 gradient exactly
+            def w1_loss(w1g):
+                from chainermn_tpu.parallel.moe import load_balancing_loss
+
+                h = jax.nn.gelu(x @ w1g) @ w2 + b2
+                out = h + _ref_moe_dense(h, ref["router"], ref["experts"])
+                return (jnp.mean((out - y) ** 2)
+                        + 0.01 * load_balancing_loss(h @ ref["router"]))
+
+            gw1 = jax.grad(w1_loss)(w1)
+            new_w1 = np.concatenate(
+                [np.asarray(got["w1"][i]) for i in range(m)], axis=1)
+            np.testing.assert_allclose(
+                new_w1, np.asarray(w1 - 0.1 * gw1), rtol=2e-4, atol=1e-5)
+
+    def test_expert_plan_hlo_counts_match_handwired(self):
+        """The ppermute-count convention for the expert axis: one
+        compiled expert-plan step carries exactly the collective counts
+        of the same step hand-wired from moe_layer_local + call-site
+        pmeans, and the FORWARD program carries exactly 2 all_to_alls
+        per MoE layer (dispatch + combine, nothing else)."""
+        import optax
+        from jax import shard_map
+        from chainermn_tpu.parallel.moe import moe_layer_local
+        from chainermn_tpu.parallel.plan import ParallelPlan
+
+        plan = ParallelPlan({"expert": 8}, devices=_devices())
+        n = 8
+        params = self._params(n)
+        specs = {"experts": P("expert"), "router": P()}
+        moe_fn, _ = plan.moe_layer(
+            tokens_local=4, d_model=D, capacity_factor=None, impl="sort"
+        )
+        x = jax.random.normal(jax.random.PRNGKey(5), (32, D))
+        y = jax.random.normal(jax.random.PRNGKey(6), (32, D))
+        lr = 0.1
+        inner = optax.sgd(lr)
+        state = plan.create_train_state(params, inner, param_specs=specs)
+        step = plan.compile_train_step(_moe_loss_fn(moe_fn), inner,
+                                       params, param_specs=specs)
+        plan_counts = _collective_counts(
+            step.lower(state, (x, y)).compile().as_text()
+        )
+
+        def local_loss(p, xb, yb):
+            out, aux = moe_layer_local(
+                xb, p["router"], expert_fn, p["experts"], "expert",
+                capacity_factor=None, dispatch_impl="sort",
+                return_stats=True,
+            )
+            out = xb + out
+            return jnp.mean((out - yb) ** 2) + 0.01 * aux["load_balance"]
+
+        def hand_local(params, batch):
+            xb, yb = batch
+            p = {"experts": jax.tree.map(lambda l: l[0],
+                                         params["experts"]),
+                 "router": params["router"]}
+            loss, g = jax.value_and_grad(local_loss)(p, xb, yb)
+            # expert leaves arrive fully accumulated via the a2a
+            # transpose: rescale; the router takes the fused pmean
+            g_experts = jax.tree.map(lambda l: l / n, g["experts"])
+            g_router = jax.lax.pmean(g["router"], "expert")
+            new = {
+                "experts": jax.tree.map(
+                    lambda pl, gl: (pl - lr * gl)[None],
+                    p["experts"], g_experts),
+                "router": p["router"] - lr * g_router,
+            }
+            return new, jax.lax.pmean(loss, "expert")
+
+        pspec = {"experts": jax.tree.map(lambda _: P("expert"),
+                                         params["experts"]),
+                 "router": P()}
+        hand = jax.jit(shard_map(
+            hand_local, mesh=plan.mesh,
+            in_specs=(pspec, P("expert")),
+            out_specs=(pspec, P()),
+            check_vma=False,
+        ))
+        hand_counts = _collective_counts(
+            hand.lower(params, (x, y)).compile().as_text()
+        )
+        assert plan_counts == hand_counts, (plan_counts, hand_counts)
+        assert 2 <= plan_counts["all-to-all("] <= 4
+        assert plan_counts["collective-permute("] == 0
+
+        # the forward program: EXACTLY 2 all_to_alls per MoE layer
+        for n_layers in (1, 2):
+            def fwd_local(params, xb, n_layers=n_layers):
+                p = {"experts": jax.tree.map(lambda l: l[0],
+                                             params["experts"]),
+                     "router": params["router"]}
+                h = xb
+                for _ in range(n_layers):
+                    h = h + moe_layer_local(
+                        h, p["router"], expert_fn, p["experts"],
+                        "expert", capacity_factor=None,
+                        dispatch_impl="sort",
+                    )
+                return h
+
+            fwd = jax.jit(shard_map(
+                fwd_local, mesh=plan.mesh,
+                in_specs=(pspec, P("expert")), out_specs=P("expert"),
+                check_vma=False,
+            ))
+            txt = fwd.lower(params, x).compile().as_text()
+            assert txt.count("all-to-all(") == 2 * n_layers, n_layers
+
+
+class TestRoutingEdges:
+    """ISSUE 20 satellite: capacity-factor 0 / one-expert overflow,
+    loud k rejection, load-balancing-loss layout invariance."""
+
+    def test_capacity_zero_overflow_residual_counted(self, comm):
+        """capacity_factor=0 (one slot per expert) with every token
+        choosing the same expert: dropped tokens pass through the
+        residual unchanged and are COUNTED — never NaN, never silently
+        corrupted."""
+        n = comm.size
+        ax = comm.axis_name
+        t_local = 6
+        tokens = t_local * n
+        x = jax.random.normal(jax.random.PRNGKey(40), (tokens, D))
+
+        def local(x, stacked):
+            params = jax.tree.map(lambda l: l[0], stacked)
+            # zero router => identical logits => argmax breaks every tie
+            # to expert 0: the all-tokens-one-expert overflow case
+            out, aux = moe_layer_local(
+                x, jnp.zeros((D, n)), expert_fn, params, ax,
+                capacity_factor=0.0, return_stats=True,
+            )
+            return x + out, aux
+
+        stacked = make_expert_params(_expert_init, jax.random.PRNGKey(41),
+                                     n)
+        out, aux = jax.jit(
+            shard_map(
+                local, mesh=comm.mesh,
+                in_specs=(P(ax), P(ax)),
+                out_specs=(P(ax), P()),
+                check_vma=False,
+            )
+        )(x, stacked)
+        out = np.asarray(out)
+        assert np.isfinite(out).all(), "dropped tokens corrupted the batch"
+        # capacity_factor=0 floors at ONE slot per expert per shard:
+        # each shard keeps exactly 1 of its 6 tokens (zero logits break
+        # ties to expert 0), the rest ride the residual unchanged
+        assert float(aux["capacity"]) == 1.0
+        assert float(aux["dropped"]) == tokens - n
+        np.testing.assert_allclose(
+            float(np.asarray(aux["expert_load"]).sum()), n)
+        # the dropped rows ARE the residual: out == x wherever moe == 0
+        moe_part = out - np.asarray(x)
+        dropped_rows = np.abs(moe_part).sum(-1) == 0.0
+        assert dropped_rows.sum() == tokens - n
+
+    def test_k_exceeding_experts_rejected_loudly(self):
+        from chainermn_tpu.parallel.moe import route_slots
+        from chainermn_tpu.parallel.plan import ParallelPlan
+
+        logits = jnp.zeros((8, 4))
+        with pytest.raises(ValueError, match="exceeds"):
+            route_slots(logits, capacity=4, k=5)
+        plan = ParallelPlan({"expert": 8}, devices=_devices())
+        with pytest.raises(ValueError, match="exceeds"):
+            plan.moe_layer(tokens_local=4, d_model=D, k=9)
+
+    def test_load_balancing_loss_layout_invariant(self, comm):
+        """The aux loss computed over the expert axis (token-sharded
+        logits + pmean'd statistics) equals the loss computed locally
+        over the gathered logits — the value is a property of the
+        GLOBAL batch, not the shard layout."""
+        from chainermn_tpu.parallel.moe import load_balancing_loss
+
+        n = comm.size
+        ax = comm.axis_name
+        logits = jax.random.normal(jax.random.PRNGKey(50), (16 * n, n))
+        local_val = float(load_balancing_loss(logits))
+
+        def sharded(lg):
+            return load_balancing_loss(lg, ax)
+
+        dist_val = float(jax.jit(
+            shard_map(
+                sharded, mesh=comm.mesh,
+                in_specs=P(ax), out_specs=P(),
+                check_vma=False,
+            )
+        )(logits))
+        np.testing.assert_allclose(dist_val, local_val, rtol=1e-6)
+
+    def test_capacity_factor_negative_rejected(self):
+        from chainermn_tpu.parallel.moe import moe_capacity
+
+        with pytest.raises(ValueError, match="capacity_factor"):
+            moe_capacity(16, 4, 1, -1.0)
+        assert moe_capacity(16, 4, 1, None) == 16  # no-drop
+        assert moe_capacity(16, 4, 1, 0.0) == 1   # minimal, drops
+
+
 def test_topk_respects_caller_neg_inf_padding():
     """Callers mask disallowed experts with -inf; even when k exceeds the
     remaining finite experts, a taken expert must never be picked twice

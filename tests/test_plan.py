@@ -112,7 +112,11 @@ class TestSpecProviders:
         with pytest.raises(ValueError, match="data"):
             ParallelPlan(("data", "data"), devices=_devices())
         with pytest.raises(ValueError, match="subset"):
-            ParallelPlan({"expert": 8}, devices=_devices())
+            ParallelPlan({"tower": 8}, devices=_devices())
+        # 'expert' became a first-class axis in ISSUE 20
+        assert ParallelPlan(
+            {"expert": 8}, devices=_devices()
+        ).axis_size("expert") == 8
 
     def test_param_spec_validation(self):
         plan = ParallelPlan({"data": 4, "model": 2}, devices=_devices())
