@@ -111,6 +111,37 @@ class TransformerBlock(nn.Module):
     #: train-mode forward over the prompt shards and scatters these into
     #: the paged/dense cache at true positions.
     sow_kv: bool = False
+    #: mixture-of-experts FFN (ISSUE 20): with ``n_experts > 0`` the
+    #: dense ``ff_up``/``ff_down`` pair is replaced by ``n_experts``
+    #: independent MLPs behind a top-1 router (``moe_router`` /
+    #: ``moe_w_up`` / ``moe_b_up`` / ``moe_w_down`` / ``moe_b_down``
+    #: params; expert leaves stack a leading ``[n_experts, ...]`` dim).
+    #: 0 (default) keeps the dense FFN — nothing changes.
+    n_experts: int = 0
+    #: mesh axis hosting expert shards for the serving/decode path.
+    #: ``None`` evaluates every expert locally and combines with the
+    #: one-hot gate (the reference form — exact, E x FLOPs, right for
+    #: the sequential :func:`generate` and the engine's non-TP arms).
+    #: Set (the engine sets it to ``tp_axis``) the FFN switches to the
+    #: ownership-split form: each shard routes its owned slice of the
+    #: replicated token rows, two ``all_to_all``s ship queues to the
+    #: expert owners and back, and ONE ``psum`` re-replicates — the MoE
+    #: analogue of dense ``ff_down``'s ``reduce_from_tp``, so TP stays
+    #: at exactly 2 all-reduces per layer plus 2 all_to_alls per MoE
+    #: layer. ``n_experts`` stays GLOBAL; the local expert count is
+    #: read off the (sharder-sliced) param leaf at trace time.
+    expert_axis: Optional[str] = None
+    #: queue-build impl for the ownership-split path: ``'sort'`` /
+    #: ``'einsum'`` / ``'auto'`` (registry decision ``moe_dispatch``,
+    #: resolved at trace time — same numbers either way).
+    moe_dispatch_impl: str = "auto"
+    #: DECLARED leading dim of the expert param leaves (flax validates
+    #: param shapes at apply): ``None`` = ``n_experts`` (full leaves —
+    #: every single-device use). The serving engine's TP clone sets it
+    #: to ``n_experts // tp`` so the per-shard model matches the
+    #: sharder's sliced leaves; ``n_experts`` itself stays GLOBAL (the
+    #: router scores every expert).
+    moe_experts_local: Optional[int] = None
 
     @staticmethod
     def _lora_delta(name, adapters, inp, out):
@@ -337,6 +368,103 @@ class TransformerBlock(nn.Module):
         o = jnp.einsum("btngl,blnd->btngd", w, vals.astype(jnp.float32))
         return o.reshape(B, T, self.num_heads, head_dim).astype(dt)
 
+    def _moe_ffn(self, h):
+        """Top-1 mixture-of-experts FFN branch (ISSUE 20).
+
+        Routing is per token row and position-independent, so the SAME
+        code serves training forwards, prefill and single-token decode —
+        per-slot expert routing inside the engine's one jitted decode
+        program is just this method applied to ``[B, 1, D]`` rows.
+
+        ``expert_axis=None``: every expert evaluated, one-hot + gate
+        combine — the exact reference form (row-independent, so the
+        engine's co-resident slots route without coupling and streams
+        stay bit-identical to the sequential :func:`generate`).
+
+        ``expert_axis`` set: ownership-split serving form — pad the
+        replicated rows to a multiple of the axis size, route the owned
+        slice through :func:`~chainermn_tpu.parallel.moe.moe_layer_local`
+        (no-drop capacity: serving never drops tokens), scatter the
+        owned outputs into a zero buffer and re-replicate with ONE
+        ``psum``. Routing uses the same ``argmax(softmax)`` as
+        ``route_slots``, so both forms pick identical experts.
+        """
+        E = self.n_experts
+        e_decl = self.moe_experts_local or E
+        D = h.shape[-1]
+        cd = self.compute_dtype
+        kern = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1,
+            batch_axis=(0,),
+        )
+        router = self.param(
+            "moe_router", nn.initializers.normal(0.02), (D, E),
+            jnp.float32,
+        )
+        # expert-stacked leaves: [E, ...] full, or the sharder's
+        # [E/n, ...] slice under the engine's TP clone (e_decl)
+        w_up = self.param("moe_w_up", kern, (e_decl, D, self.d_ff),
+                          jnp.float32)
+        b_up = self.param("moe_b_up", nn.initializers.zeros_init(),
+                          (e_decl, self.d_ff), jnp.float32)
+        w_down = self.param("moe_w_down", kern, (e_decl, self.d_ff, D),
+                            jnp.float32)
+        b_down = self.param("moe_b_down", nn.initializers.zeros_init(),
+                            (e_decl, D), jnp.float32)
+
+        if self.expert_axis is None:
+            # The expert dim follows the LEAF: every real local
+            # application carries full leaves (e_eff == n_experts,
+            # exact semantics); the cache-init eval_shape applies the
+            # TP-local clone outside shard_map, where only shapes flow.
+            e_eff = w_up.shape[0]
+            logits = h @ router[:, :e_eff]  # f32 promote: routing precision
+            probs = jax.nn.softmax(logits, axis=-1)
+            gate = jnp.max(probs, axis=-1)
+            idx = jnp.argmax(probs, axis=-1)
+            up = jnp.einsum("...d,edf->...ef", h,
+                            w_up.astype(cd)) + b_up.astype(cd)
+            down = jnp.einsum("...ef,efd->...ed", nn.gelu(up),
+                              w_down.astype(cd)) + b_down.astype(cd)
+            combine = (jax.nn.one_hot(idx, e_eff, dtype=down.dtype)
+                       * gate.astype(down.dtype)[..., None])
+            return jnp.einsum("...ed,...e->...d", down, combine)
+
+        from chainermn_tpu.parallel import moe as _moe
+
+        ax = self.expert_axis
+        n = jax.lax.axis_size(ax)
+        eps = w_up.shape[0]  # E_local: the sharder's slice, not E
+        B, T, _ = h.shape
+        rows = B * T
+        own = -(-rows // n)
+        hr = h.reshape(rows, D)
+        if own * n != rows:
+            hr = jnp.pad(hr, ((0, own * n - rows), (0, 0)))
+        i = jax.lax.axis_index(ax)
+        sl = jax.lax.dynamic_slice_in_dim(hr, i * own, own)
+        eparams = (w_up.astype(cd), b_up.astype(cd),
+                   w_down.astype(cd), b_down.astype(cd))
+        if eps == 1:
+            eparams = jax.tree.map(lambda l: l[0], eparams)
+
+        def expert_mlp(p, xq):
+            wu, bu, wd, bd = p
+            return nn.gelu(xq @ wu + bu) @ wd + bd
+
+        out_own = _moe.moe_layer_local(
+            sl, router, expert_mlp, eparams, ax,
+            capacity_factor=None, dispatch_impl=self.moe_dispatch_impl,
+            experts_per_shard=eps,
+        )
+        full = jnp.zeros((own * n, D), out_own.dtype)
+        full = jax.lax.dynamic_update_slice_in_dim(full, out_own,
+                                                   i * own, 0)
+        # ONE psum re-replicates — the MoE analogue of dense ff_down's
+        # reduce_from_tp (TP stays at exactly 2 all-reduces per layer)
+        full = jax.lax.psum(full, ax)
+        return full[:rows].reshape(B, T, D)
+
     @nn.compact
     def __call__(self, x, segment_ids=None, rope_positions=None,
                  train: bool = True, decode: bool = False,
@@ -435,6 +563,19 @@ class TransformerBlock(nn.Module):
         x = x + o
 
         h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
+        if self.n_experts > 0:
+            if adapters is not None and (
+                "ff_up" in adapters or "ff_down" in adapters
+            ):
+                raise ValueError(
+                    "MoE blocks have no ff_up/ff_down projections to "
+                    "hook — adapters may target qkv/proj only"
+                )
+            h = self._moe_ffn(h)
+            if self.dropout_rate > 0.0:
+                h = nn.Dropout(self.dropout_rate,
+                               deterministic=not train)(h)
+            return x + h
         if self.tp_axis is not None:
             h = copy_to_tp(h, self.tp_axis)
         up = nn.Dense(
@@ -558,6 +699,22 @@ class TransformerLM(nn.Module):
     #: thread ``TransformerBlock.sow_kv`` through every block (the
     #: sequence-parallel prefill's KV capture, ISSUE 13).
     sow_kv: bool = False
+    #: mixture-of-experts FFN in every block (ISSUE 20; see
+    #: ``TransformerBlock.n_experts``). 0 (default) = dense FFN.
+    #: GLOBAL expert count — under ``expert_axis`` the serving sharder
+    #: slices the stacked expert leaves, the field does not change.
+    n_experts: int = 0
+    #: expert-shard mesh axis for serving decode (see
+    #: ``TransformerBlock.expert_axis``; the engine sets it to its TP
+    #: axis — expert shards live on the TP mesh).
+    expert_axis: Optional[str] = None
+    #: MoE queue-build impl for the ownership-split path
+    #: (``TransformerBlock.moe_dispatch_impl``).
+    moe_dispatch_impl: str = "auto"
+    #: declared expert-leaf leading dim for per-shard param trees
+    #: (``TransformerBlock.moe_experts_local``; the engine's TP clone
+    #: sets ``n_experts // tp``).
+    moe_experts_local: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, *, segment_ids=None, positions=None,
@@ -660,6 +817,10 @@ class TransformerLM(nn.Module):
                 tp_axis=self.tp_axis,
                 head_dim=self.head_dim,
                 sow_kv=self.sow_kv,
+                n_experts=self.n_experts,
+                expert_axis=self.expert_axis,
+                moe_dispatch_impl=self.moe_dispatch_impl,
+                moe_experts_local=self.moe_experts_local,
                 name=f"block_{i}",
             )(x, segment_ids, rope_positions, train, decode,
               decode_positions, block_tables, decode_slots,

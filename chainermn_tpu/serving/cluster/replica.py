@@ -76,6 +76,21 @@ class Replica:
         fn = getattr(self.engine, "adapter_resident", None)
         return bool(fn(tenant_id)) if callable(fn) else True
 
+    def expert_signature(self):
+        """This replica's MoE residency signature (ISSUE 20): ``None``
+        for a dense engine, ``(n_experts, experts_per_shard)`` when its
+        mesh hosts the model's expert shards — the router's hard
+        placement filter (the adapter-residency pattern: a replica
+        without the expert weights cannot serve MoE traffic at all)."""
+        fn = getattr(self.engine, "expert_signature", None)
+        return fn() if callable(fn) else None
+
+    def experts_resident(self, signature) -> bool:
+        """Whether this replica hosts exactly the fleet's expert shards
+        (``signature`` from :meth:`expert_signature`). Dense fleets
+        (``signature is None``) accept every replica."""
+        return signature is None or self.expert_signature() == signature
+
     # ---- drive ------------------------------------------------------
 
     def tick(self) -> bool:

@@ -199,6 +199,24 @@ class Router:
             self._pqueues = {}
             self._pending = {}
 
+        # ---- expert-shard residency (ISSUE 20): the fleet's MoE
+        # signature, derived ONCE like the KV-layout check above. MoE
+        # replicas must agree on the expert set — a2a dispatch shapes
+        # bake n_experts into the compiled programs, so a mismatched
+        # replica would produce different streams, not just worse ones.
+        # Dense replicas may coexist (they serve nothing in a MoE
+        # fleet — the hard filter below excludes them) so a mixed pool
+        # mid-migration fails at placement, loudly, not mid-decode.
+        esigs = {i: r.expert_signature() for i, r in self.replicas.items()}
+        moe_sigs = {s for s in esigs.values() if s is not None}
+        if len(moe_sigs) > 1:
+            raise ValueError(
+                f"replicas disagree on the expert set — MoE dispatch "
+                f"is not portable across this pool: {esigs}"
+            )
+        #: fleet-wide expert signature; None = dense fleet (no filter)
+        self._expert_sig = moe_sigs.pop() if moe_sigs else None
+
         self._ids = _ROUTER_IDS
         self._seen_ids: set = set()
         self._sessions: dict = {}
@@ -308,6 +326,29 @@ class Router:
             )
         return out
 
+    def _expert_hosts(self, candidates: Sequence[Replica]
+                      ) -> list[Replica]:
+        """Restrict ``candidates`` to replicas hosting the fleet's
+        expert shards (ISSUE 20, the adapter-residency pattern made a
+        HARD filter): a dense engine has no expert weights, so placing
+        MoE traffic on it is not a degraded choice — it is impossible.
+        No-op for dense fleets. Raises loudly when no candidate
+        qualifies (e.g. every MoE replica died and only dense spares
+        remain) instead of letting ``_choose`` pick an engine that
+        cannot run the model."""
+        if self._expert_sig is None:
+            return list(candidates)
+        out = [rep for rep in candidates
+               if rep.experts_resident(self._expert_sig)]
+        if not out:
+            raise RuntimeError(
+                f"no candidate replica hosts the model's expert shards "
+                f"{self._expert_sig} — MoE traffic cannot be placed on "
+                "a dense engine; revive an expert-bearing replica "
+                "before routing traffic"
+            )
+        return out
+
     def _score(self, rep: Replica, prompt, tenant_id=None,
                extra_queue: int = 0):
         """Placement score, maximized. ADAPTER RESIDENCY dominates for
@@ -342,6 +383,7 @@ class Router:
         candidates = self._alive(target_ids)
         if not candidates:
             raise RuntimeError("no alive replica can accept requests")
+        candidates = self._expert_hosts(candidates)
         sticky = False
         rep = None
         sid = request.session_id
@@ -509,7 +551,7 @@ class Router:
         alive = self._alive(self._decode_ids)
         if not alive:
             raise RuntimeError("no alive decode replica")
-        cands = self._resident(alive, tenant_id)
+        cands = self._resident(self._expert_hosts(alive), tenant_id)
         return max(cands, key=lambda rep: (
             rep.kv_blocks_free() or 0,
             -(rep.load() + len(self._pending[rep.replica_id])),
@@ -676,7 +718,7 @@ class Router:
         # request). Failing here leaves the stream running in place.
         tenant = getattr(self.replicas[src_id].engine,
                          "tenant_of_slot", lambda s: None)(slot)
-        cands = self._resident(cands, tenant)
+        cands = self._resident(self._expert_hosts(cands), tenant)
         req = self.replicas[src_id].scheduler.preempt(slot, requeue=False)
         # Same scoring as _route's placement, pending prefill queues
         # included in the load tiebreak (review finding: a diverging

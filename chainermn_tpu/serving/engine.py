@@ -256,9 +256,14 @@ def shard_lm_params(model, variables, n: int):
     and ``ff_down`` kernels row-sharded, ``ff_up`` column-sharded;
     ``ff_down`` bias divided by ``n`` so the row-parallel psum
     reassembles it exactly (bit-exact for power-of-two ``n``);
-    everything else (embeddings, norms) replicated by tiling. Feed
-    through ``shard_map`` with ``P('model')`` on every leaf's leading
-    axis.
+    MoE expert leaves (``moe_w_up``/``moe_b_up``/``moe_w_down``/
+    ``moe_b_down``, ISSUE 20) sliced along their leading ``n_experts``
+    dim (shard ``i`` owns experts ``[i*E/n, (i+1)*E/n)`` — the
+    residency unit the cluster router filters on) with ``moe_router``
+    replicated (every shard routes its owned token rows against the
+    full expert table); everything else (embeddings, norms) replicated
+    by tiling. Feed through ``shard_map`` with ``P('model')`` on every
+    leaf's leading axis.
     """
     import jax
     import jax.numpy as jnp
@@ -284,6 +289,14 @@ def shard_lm_params(model, variables, n: int):
             return stack_tp_params(leaf, n, 0)
         if "ff_down" in names and names[-1] == "bias":
             return jnp.stack([leaf / n] * n)
+        if any(nm.startswith("moe_") and nm != "moe_router"
+               for nm in names):
+            if leaf.shape[0] % n:
+                raise ValueError(
+                    f"n_experts={leaf.shape[0]} must divide the "
+                    f"model-axis size {n} (leaf {'/'.join(names)})"
+                )
+            return stack_tp_params(leaf, n, 0)  # expert-dim slice
         return jnp.stack([leaf] * n)
 
     return jax.tree_util.tree_map_with_path(shard_leaf, variables)
@@ -330,6 +343,10 @@ def unshard_lm_params(model, stacked):
             return leaf.reshape(-1, leaf.shape[-1])
         if "ff_down" in names and names[-1] == "bias":
             return leaf.sum(axis=0)  # stored as bias / n per shard
+        if any(nm.startswith("moe_") and nm != "moe_router"
+               for nm in names):
+            # [n, E/n, ...] expert slices -> [E, ...] in shard order
+            return leaf.reshape(-1, *leaf.shape[2:])
         return leaf[0]  # replicated tiles
 
     return jax.tree_util.tree_map_with_path(un, stacked)
@@ -636,6 +653,38 @@ class ServingEngine:
                                    "source": "explicit"})
         self.decode_attend_impl = decode_attend_impl
 
+        # ---- MoE dispatch impl (ISSUE 20): the ownership-split decode
+        # path builds its expert queues by sort-scatter or dense one-hot
+        # einsum — registry decision, resolved ONCE here so the decode /
+        # verify / mixed / prefill programs all trace the same impl (a
+        # static model field, exactly like decode_attend_impl; jit
+        # caches stay pinned).
+        self.n_experts = int(model.n_experts)
+        self.moe_dispatch_impl: Optional[str] = None
+        if self.n_experts > 0:
+            from chainermn_tpu.parallel.moe import resolve_dispatch_impl
+
+            tp = (int(mesh.shape["model"])
+                  if mesh is not None and "model" in mesh.axis_names
+                  else 1)
+            own_rows = -(-num_slots // tp)
+            moe_key = tuning.decision_key(
+                shape=(own_rows, self.n_experts, model.d_model),
+                dtype=model.compute_dtype,
+            )
+            self.moe_dispatch_impl = resolve_dispatch_impl(
+                own_rows, self.n_experts, model.d_model,
+                model.compute_dtype, model.moe_dispatch_impl,
+            )
+            if model.moe_dispatch_impl == "auto":
+                self._adopt_decision("moe_dispatch", moe_key)
+            else:
+                self.decisions.append({
+                    "name": "moe_dispatch", "key": moe_key,
+                    "winner": self.moe_dispatch_impl,
+                    "source": "explicit",
+                })
+
         # ---- prefix sharing (ISSUE 7): trie + COW over the paged pool.
         # Dense rows are slot-private by layout — nothing to share, so
         # the decision is forced off there without consulting the
@@ -869,18 +918,33 @@ class ServingEngine:
                 )
             n = int(mesh.shape["model"])
             kvh = model.num_kv_heads or model.num_heads
-            if model.num_heads % n or kvh % n or model.d_ff % n:
+            moe = self.n_experts > 0
+            if model.num_heads % n or kvh % n or (
+                    not moe and model.d_ff % n):
                 raise ValueError(
                     f"heads={model.num_heads}/kv={kvh}/d_ff={model.d_ff} "
                     f"must divide the model-axis size {n}"
                 )
+            if moe and self.n_experts % n:
+                raise ValueError(
+                    f"n_experts={self.n_experts} must divide the "
+                    f"model-axis size {n} — expert shards live on the "
+                    f"TP mesh"
+                )
             self._tp_n = n
+            # MoE keeps the FULL d_ff (experts shard by expert index,
+            # not by hidden width) and n_experts stays GLOBAL — the
+            # sharder slices the stacked expert leaves, the block reads
+            # the local count off the leaf at trace time.
             self._decode_model = model.clone(
                 num_heads=model.num_heads // n,
                 num_kv_heads=kvh // n,
-                d_ff=model.d_ff // n,
+                d_ff=model.d_ff if moe else model.d_ff // n,
                 head_dim=model.d_model // model.num_heads,
                 tp_axis="model",
+                expert_axis="model" if moe else None,
+                moe_dispatch_impl=(self.moe_dispatch_impl or "auto"),
+                moe_experts_local=(self.n_experts // n if moe else None),
                 **clone_kw,
             )
             self._vars = shard_lm_params(
@@ -892,7 +956,7 @@ class ServingEngine:
         # (tp_axis) — cache shapes depend only on the (local) head/width
         # fields, which the clone keeps.
         cache = init_serving_cache(
-            self._decode_model.clone(tp_axis=None),
+            self._decode_model.clone(tp_axis=None, expert_axis=None),
             self._local_vars_for_init(), num_slots,
         )
         if mesh is not None:
@@ -2415,6 +2479,19 @@ class ServingEngine:
             [int(t) for t in np.asarray(prompt).reshape(-1)],
             namespace=tenant_id,
         )
+
+    def expert_signature(self) -> Optional[tuple]:
+        """MoE residency signature (ISSUE 20): ``None`` for a dense
+        engine, ``(n_experts, experts_per_shard)`` when this engine's
+        mesh hosts the model's expert shards. The router compares
+        signatures the way it compares ``kv_signature`` — a dense
+        replica cannot serve MoE traffic (it has no expert weights at
+        all), so residency is a hard placement filter, not a score."""
+        if self.n_experts <= 0:
+            return None
+        n = (int(self._mesh.shape["model"])
+             if self._mesh is not None else 1)
+        return (self.n_experts, self.n_experts // n)
 
     # ------------------------------------------------------------------
     # multi-tenant adapter surface (ISSUE 14)

@@ -607,3 +607,79 @@ def test_mp_disaggregated_handoff_over_tcp():
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"CLUSTER_WORKER_OK {r}" in out
+
+
+class TestMoEResidency:
+    """ISSUE 20: expert-shard residency as a HARD router placement
+    filter (the adapter-residency pattern) — a dense engine has no
+    expert weights, so MoE traffic on it is impossible, not merely
+    slow."""
+
+    @pytest.fixture(scope="class")
+    def moe_lm(self):
+        model = tiny_lm(n_experts=4)
+        params = model.init(
+            jax.random.PRNGKey(30), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        return model, params
+
+    def _mixed_fleet(self, moe_lm, lm):
+        from chainermn_tpu.serving.cluster import Replica
+
+        moe_model, moe_params = moe_lm
+        model, params = lm
+        reps = make_replicas(moe_model, moe_params, 2, **ENGINE_KW)
+        dense_engine = ServingEngine(model, params, **ENGINE_KW)
+        reps.append(Replica(dense_engine, Scheduler(dense_engine), 2))
+        return reps
+
+    def test_moe_cluster_streams_match_generate(self, moe_lm):
+        moe_model, moe_params = moe_lm
+        reps = make_replicas(moe_model, moe_params, 2, **ENGINE_KW)
+        router = Router(reps, mode="colocated", policy="least_loaded")
+        reqs = _requests(5, seed=31)
+        ids = _submit_all(router, reqs)
+        results = router.run()
+        _assert_streams(results, ids, reqs, moe_model, moe_params)
+
+    def test_dense_replica_never_placed_in_moe_fleet(self, moe_lm, lm):
+        reps = self._mixed_fleet(moe_lm, lm)
+        router = Router(reps, mode="colocated", policy="least_loaded")
+        reqs = _requests(6, seed=33)
+        _submit_all(router, reqs)
+        router.run()
+        routes = router.summary()["routes"]
+        assert routes.get(2, 0) == 0, (
+            "dense replica drew MoE traffic despite hosting no experts"
+        )
+        assert sum(routes.values()) == len(reqs)
+
+    def test_no_expert_host_left_raises_loudly(self, moe_lm, lm):
+        reps = self._mixed_fleet(moe_lm, lm)
+        router = Router(reps, mode="colocated", policy="least_loaded")
+        router.fail_replica(0)
+        # one expert host left: traffic still places
+        rid = router.submit(Request(prompt=[1, 2, 3], max_new_tokens=2))
+        results = router.run()
+        assert rid in results
+        router.fail_replica(1)
+        # only the dense spare survives: refuse at the front door, with
+        # a message that names the actual problem
+        with pytest.raises(RuntimeError, match="expert shards"):
+            router.submit(Request(prompt=[1, 2], max_new_tokens=2))
+
+    def test_mismatched_expert_fleets_rejected(self, moe_lm):
+        from chainermn_tpu.serving.cluster import Replica
+
+        moe_model, moe_params = moe_lm
+        other = tiny_lm(n_experts=2)
+        other_params = other.init(
+            jax.random.PRNGKey(34), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        a = ServingEngine(moe_model, moe_params, **ENGINE_KW)
+        b = ServingEngine(other, other_params, **ENGINE_KW)
+        reps = [Replica(a, Scheduler(a), 0), Replica(b, Scheduler(b), 1)]
+        with pytest.raises(ValueError, match="expert set"):
+            Router(reps, mode="colocated")

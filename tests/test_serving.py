@@ -824,3 +824,169 @@ class TestAdmissionDeques:
         assert not q and len(q) == 0
         with pytest.raises(IndexError):
             q[0]
+
+
+class TestMoEServing:
+    """ISSUE 20 serving half: a mixture-of-experts FFN decodes through
+    the SAME jitted decode/verify/mixed programs as the dense model —
+    streams bit-identical to sequential ``generate`` across dense ==
+    paged == TP == spec == chunked arms, jit cache still pinned at 1,
+    and the TP wire pinned at 2 all-reduces per layer PLUS 2
+    all-to-alls per MoE layer (the ownership-split dispatch)."""
+
+    @pytest.fixture(scope="class")
+    def moe_lm(self):
+        model = tiny_lm(n_experts=4)
+        params = model.init(
+            jax.random.PRNGKey(20), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        return model, params
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return Mesh(np.array(jax.devices("cpu")[:2]), ("model",))
+
+    @pytest.mark.parametrize("impl", ["dense", "paged"])
+    def test_moe_stream_matches_generate(self, moe_lm, impl):
+        model, params = moe_lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl=impl,
+            kv_block_size=8, prefill_buckets=(4, 8, 16),
+        )
+        reqs = _requests(6, seed=21)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        assert engine.decode_compile_count() == 1
+        # the dispatch decision resolved through the registry
+        recs = [d for d in engine.decisions if d["name"] == "moe_dispatch"]
+        assert recs and recs[-1]["winner"] in ("sort", "einsum")
+
+    @pytest.mark.parametrize("spec,chunk", [(2, 0), (0, 3), (2, 3)])
+    def test_moe_spec_and_chunked_streams_match(self, moe_lm, spec,
+                                                chunk):
+        model, params = moe_lm
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4, 8, 16),
+            spec_tokens=spec, prefill_chunk=chunk,
+        )
+        reqs = _requests(5, seed=23)
+        streams, _ = _run_stream(engine, reqs)
+        for (prompt, n_new), got in zip(reqs, streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        if spec:
+            assert engine.verify_compile_count() == 1
+        if chunk:
+            assert engine.mixed_compile_count() in (None, 1)
+
+    @pytest.mark.parametrize("impl", ["dense", "paged"])
+    def test_moe_tp_stream_matches_single_device(self, moe_lm, mesh,
+                                                 impl):
+        model, params = moe_lm
+        reqs = _requests(5, seed=25)
+        single = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl=impl,
+            kv_block_size=8, prefill_buckets=(4, 8),
+        )
+        tp = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl=impl,
+            kv_block_size=8, prefill_buckets=(4, 8), mesh=mesh,
+        )
+        s_streams, _ = _run_stream(single, reqs)
+        t_streams, _ = _run_stream(tp, reqs)
+        assert t_streams == s_streams
+        for (prompt, n_new), got in zip(reqs, t_streams):
+            assert got == _generate_ref(model, params, prompt, n_new)
+        assert tp.decode_compile_count() == 1
+
+    def test_moe_tp_decode_collective_counts(self, moe_lm, mesh):
+        """The ISSUE 20 wire pin: the dense 2-AR-per-layer contract is
+        PRESERVED (attention proj psum + the MoE combine psum replacing
+        the ff_down reduce), and expert dispatch adds exactly 2
+        all-to-alls per MoE layer — nothing else appears."""
+        model, params = moe_lm
+        engine = ServingEngine(
+            model, params, num_slots=3, max_len=32, decode_impl="paged",
+            kv_block_size=8, prefill_buckets=(4,), mesh=mesh,
+        )
+        args = (
+            engine._cache, engine._vars,
+            jnp.zeros((3,), jnp.int32), jnp.zeros((3,), jnp.int32),
+            jnp.asarray(engine._dummy_tables()),
+            jnp.asarray(engine._seeds),
+        )
+        txt = engine._decode_step_jit.lower(*args).compile().as_text()
+        n_ar = txt.count("all-reduce(")
+        n_a2a = txt.count("all-to-all(")
+        assert n_ar == 2 * model.num_layers, (
+            f"expected {2 * model.num_layers} all-reduces "
+            f"(2 per layer), got {n_ar}"
+        )
+        assert n_a2a == 2 * model.num_layers, (
+            f"expected {2 * model.num_layers} all-to-alls "
+            f"(2 per MoE layer), got {n_a2a}"
+        )
+        for op in ("all-gather(", "collective-permute(",
+                   "reduce-scatter("):
+            assert txt.count(op) == 0, f"unexpected {op} in decode step"
+
+    def test_moe_shard_unshard_roundtrip(self, moe_lm):
+        """Expert leaves slice along their leading ``n_experts`` dim
+        (router stays replicated) and the inverse reassembles the exact
+        global tree."""
+        from chainermn_tpu.serving.engine import (
+            shard_lm_params,
+            unshard_lm_params,
+        )
+
+        model, params = moe_lm
+        stacked = shard_lm_params(model, {"params": params["params"]}, 2)
+        blk = stacked["params"]["block_0"]
+        assert blk["moe_w_up"].shape[:2] == (2, 2)  # [tp, E_local, ...]
+        assert blk["moe_router"].shape[0] == 2      # replicated tiles
+        full = unshard_lm_params(model, stacked)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-7, atol=1e-7
+            ),
+            full, {"params": params["params"]},
+        )
+
+    def test_moe_expert_divisibility_rejected(self, mesh):
+        model = tiny_lm(n_experts=3)
+        params = model.init(
+            jax.random.PRNGKey(27), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        with pytest.raises(ValueError, match="must divide"):
+            ServingEngine(model, params, num_slots=2, max_len=32,
+                          mesh=mesh)
+
+    def test_moe_rejects_ff_adapter_hooks(self, moe_lm):
+        """MoE blocks have no dense ff_up/ff_down projections — an
+        adapter targeting them must fail loudly, not silently no-op."""
+        model, params = moe_lm
+        A = jnp.zeros((16, 2), jnp.float32)
+        B = jnp.zeros((2, 16), jnp.float32)
+        hooks = [{"ff_up": (A, B)} for _ in range(model.num_layers)]
+        with pytest.raises(ValueError, match="ff_up/ff_down"):
+            model.apply(params, jnp.zeros((1, 4), jnp.int32),
+                        train=False, adapters=hooks)
+
+    def test_moe_expert_signature(self, moe_lm, mesh):
+        model, params = moe_lm
+        dense_model, dense_params = tiny_lm(), None
+        dense_params = dense_model.init(
+            jax.random.PRNGKey(28), jnp.zeros((1, 4), jnp.int32),
+            train=False,
+        )
+        dense = ServingEngine(dense_model, dense_params, num_slots=2,
+                              max_len=32)
+        assert dense.expert_signature() is None
+        local = ServingEngine(model, params, num_slots=2, max_len=32)
+        assert local.expert_signature() == (4, 4)
+        tp = ServingEngine(model, params, num_slots=2, max_len=32,
+                           mesh=mesh)
+        assert tp.expert_signature() == (4, 2)
