@@ -447,6 +447,7 @@ _COMPACT_KEYS = (
     "device_kind", "n_devices", "mfu", "transformer_tokens_per_sec",
     "transformer_mfu", "flash_fwdbwd_speedup", "allreduce_gbps",
     "resnet50_s2d_images_per_sec", "moe_dispatch_sort_speedup",
+    "moe_step_ms", "moe_selected", "moe_spread_pct", "moe_drop_rate",
     "native_input_images_per_sec", "double_buffer_speedup",
     "flash_32k_fwd_ms", "flash_32k_window2k_fwd_ms",
     "kernel_sweep_failures", "kernel_sweep_numeric_failures",
@@ -1079,6 +1080,176 @@ def _bench_moe_dispatch(on_accel: bool):
         )
     except Exception as e:
         out["moe_dispatch_autotune_error"] = f"{type(e).__name__}: {e}"[:120]
+    return out
+
+
+def _bench_moe_plan(comm, on_accel: bool):
+    """ISSUE 20: the expert axis, priced (CPU-proxy convention:
+    median-of-n>=3 + spread — a delta inside ``moe_spread_pct`` is
+    noise; on-accel rows are single samples under the registry's 10%
+    floor).
+
+    One MoE MLP train-step workload, identical routing semantics both
+    ways:
+
+    - ``on``: an ``expert x data`` ``ParallelPlan`` — expert leaves
+      sharded over the expert axis, tokens dispatched through the two
+      all_to_alls (``plan.moe_layer``, dispatch impl via the tuned
+      ``moe_dispatch`` decision);
+    - ``off``: a pure data plan with every expert replicated — the
+      same top-1 sort dispatch run shard-locally, no expert wire.
+
+    The pair is adopted (spread-gated) as this shape's
+    ``expert_parallel`` decision, and the drop accounting rides out as
+    ``moe_drop_rate`` (dropped tokens / routed tokens at capacity
+    factor 1.25)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.parallel.moe import (
+        dispatch_sort,
+        load_balancing_loss,
+        make_expert_params,
+        moe_capacity,
+        record_moe_dispatch,
+    )
+    from chainermn_tpu.parallel.plan import ParallelPlan
+
+    n = comm.size
+    e_axis = 4 if n >= 8 else (2 if n >= 2 else 1)
+    data_axis = max(1, n // e_axis)
+    eps = 2  # experts per shard: the a2a ships eps queues per peer
+    E = e_axis * eps
+    D = 256 if on_accel else 64
+    F = 2 * D
+    tokens = (64 if on_accel else 16) * n
+    steps = 16 if on_accel else 4
+
+    rng = jax.random.PRNGKey(0)
+
+    def _expert_init(r):
+        k1, k2 = jax.random.split(r)
+        return {"w1": jax.random.normal(k1, (D, F), jnp.float32) * 0.05,
+                "w2": jax.random.normal(k2, (F, D), jnp.float32) * 0.05}
+
+    def expert_fn(p, xq):
+        return jnp.tanh(xq @ p["w1"]) @ p["w2"]
+
+    # global expert e lives on shard e // eps: stack [e_axis, eps, ...]
+    # so the expert-spec'd leading dim matches the axis size and each
+    # shard's squeezed leaf is the [eps, ...] stack moe_layer_local
+    # vmaps over
+    experts = jax.tree.map(
+        lambda l: l.reshape(e_axis, eps, *l.shape[1:]),
+        make_expert_params(_expert_init, rng, E),
+    )
+    params = {
+        "experts": experts,
+        "router": jax.random.normal(jax.random.fold_in(rng, 1),
+                                    (D, E), jnp.float32) / 4.0,
+    }
+    x = jax.random.normal(jax.random.fold_in(rng, 2), (tokens, D),
+                          jnp.float32)
+    y = jax.random.normal(jax.random.fold_in(rng, 3), (tokens, D),
+                          jnp.float32)
+    inner = optax.sgd(1e-2)
+    devices = list(comm.mesh.devices.flat)
+    spreads = []
+
+    def time_plan(plan, loss_fn, specs):
+        state = plan.create_train_state(params, inner, param_specs=specs)
+        step = plan.compile_train_step(loss_fn, inner, params,
+                                       param_specs=specs)
+        state, m = step(state, (x, y))
+        state, m = step(state, (x, y))
+        _fetch_scalar(m["loss"])
+
+        def sample():
+            nonlocal state, m
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, m = step(state, (x, y))
+            _fetch_scalar(m["loss"])
+            return (time.perf_counter() - t0) / steps * 1000
+
+        med, spread = _repeat_median(sample, 1 if on_accel else 3)
+        spreads.append(spread)
+        return med, m
+
+    # ---- on: expert (x data) plan, tokens through the two all_to_alls
+    axes = ({"expert": e_axis, "data": data_axis}
+            if data_axis > 1 else {"expert": e_axis})
+    plan_on = ParallelPlan(axes, devices=devices)
+    moe_fn, rec = plan_on.moe_layer(
+        tokens_local=tokens // data_axis, d_model=D,
+        experts_per_shard=eps, capacity_factor=1.25,
+    )
+    specs = {"experts": P("expert"), "router": P()}
+
+    def loss_on(p, batch_):
+        xb, yb = batch_
+        out, aux = moe_fn(xb, p["router"], expert_fn, p["experts"])
+        loss = (jnp.mean((xb + out - yb) ** 2)
+                + 0.01 * aux["load_balance"])
+        return loss, ({"dropped": aux["dropped"],
+                       "padded": aux["padded"],
+                       "capacity": aux["capacity"],
+                       "expert_load": aux["expert_load"]}, ())
+
+    on_ms, on_metrics = time_plan(plan_on, loss_on, specs)
+    drop_rate = float(on_metrics["dropped"]) / tokens
+    # Host-side mirror of the last step's routing stats (ISSUE 20
+    # observability row: the moe_dispatch event -> tap gauges).
+    record_moe_dispatch(on_metrics)
+
+    # ---- off: pure data plan, every expert replicated, local dispatch
+    plan_off = ParallelPlan({"data": max(1, n)}, devices=devices)
+    off_specs = {"experts": P(), "router": P()}
+
+    def loss_off(p, batch_):
+        xb, yb = batch_
+        logits = xb @ p["router"]
+        cap = moe_capacity(xb.shape[0], E, 1, 1.25)
+        queues, combine_fn = dispatch_sort(xb, logits, cap, 1)
+        flat = jax.tree.map(lambda l: l.reshape(E, *l.shape[2:]),
+                            p["experts"])
+        out = combine_fn(jax.vmap(expert_fn)(flat, queues))
+        loss = (jnp.mean((xb + out - yb) ** 2)
+                + 0.01 * load_balancing_loss(logits, axis_name="data"))
+        return loss, ({}, ())
+
+    off_ms, _ = time_plan(plan_off, loss_off, off_specs)
+
+    out = {
+        "moe_plan_shape": f"T{tokens}xE{E}xD{D}",
+        "moe_plan_mesh": plan_on.describe()["mesh"],
+        "moe_plan_dispatch": rec["winner"],
+        "moe_step_ms": round(on_ms, 3),
+        "moe_off_step_ms": round(off_ms, 3),
+        "moe_drop_rate": round(drop_rate, 4),
+    }
+    if not on_accel:
+        out["moe_spread_pct"] = max(spreads)
+    # Adopt the pair as this shape's expert_parallel decision (the
+    # registry default is 'off': the axis must EARN its all_to_alls).
+    try:
+        from chainermn_tpu import tuning
+
+        key = tuning.decision_key(shape=(tokens, E, D),
+                                  dtype=jnp.float32)
+        tuning.record_measurement(
+            "expert_parallel", key,
+            {"on": on_ms, "off": off_ms},
+            spreads=(None if on_accel
+                     else {"on": spreads[0], "off": spreads[1]}),
+        )
+        out["moe_selected"] = tuning.choice(
+            "expert_parallel", ("on", "off"), key
+        )
+    except Exception as e:
+        out["moe_autotune_error"] = f"{type(e).__name__}: {e}"[:120]
     return out
 
 
@@ -4455,6 +4626,8 @@ def _run_bench(mode: str) -> None:
     supp("s2d_resnet", "s2d_error", lambda: _bench_s2d_resnet(comm, on_accel))
     supp("moe_dispatch", "moe_dispatch_error",
          lambda: _bench_moe_dispatch(on_accel))
+    supp("moe", "moe_error",
+         lambda: _bench_moe_plan(comm, on_accel))
     supp("serving", "serving_error",
          lambda: _bench_serving(comm, on_accel))
     supp("serving_prefix", "serving_prefix_error",

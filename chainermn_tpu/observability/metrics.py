@@ -794,6 +794,34 @@ def _tap_event(ev: Mapping[str, Any]) -> None:
         reg.counter("speculate_accepted_total",
                     "speculative tokens accepted").inc(accepted)
         _record_spec(str(ev.get("mode") or "greedy"), drafted, accepted)
+    elif kind == "moe_dispatch":
+        # ISSUE 20: host-side mirror of one MoE dispatch observation
+        # (parallel.moe.record_moe_dispatch). Counters accumulate the
+        # drop/pad token flow; gauges snapshot the latest per-expert
+        # load histogram and the static capacity.
+        reg.counter(
+            "moe_dropped_tokens_total",
+            "MoE capacity-overflow token assignments (carried by the "
+            "residual path, not corrupted)",
+        ).inc(float(ev.get("dropped") or 0))
+        reg.counter(
+            "moe_padded_tokens_total",
+            "empty MoE queue slots shipped over the a2a wire anyway "
+            "(the static-shape tax)",
+        ).inc(float(ev.get("padded") or 0))
+        layer = ev.get("layer")
+        labels = {"layer": str(layer)} if layer is not None else {}
+        for i, v in enumerate(ev.get("expert_load") or ()):
+            reg.gauge(
+                "moe_expert_load",
+                "kept tokens routed to each expert at the last "
+                "observed dispatch",
+            ).set(float(v), expert=str(i), **labels)
+        if ev.get("capacity") is not None:
+            reg.gauge(
+                "moe_capacity",
+                "per-expert token capacity of the MoE dispatch",
+            ).set(float(ev["capacity"]), **labels)
     elif kind == "prefix_cache":
         reg.counter("kv_prefix_lookups_total",
                     "prefix-trie lookups at admission").inc()

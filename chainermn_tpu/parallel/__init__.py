@@ -73,7 +73,7 @@ def __getattr__(name):
     if name in ("moe_layer_local", "top1_route", "topk_route",
                 "load_balancing_loss", "make_expert_params",
                 "moe_capacity", "routing_stats",
-                "resolve_expert_parallel"):
+                "record_moe_dispatch", "resolve_expert_parallel"):
         from chainermn_tpu.parallel import moe as _m
 
         return getattr(_m, name)
@@ -151,6 +151,7 @@ __all__ = [
     "make_expert_params",
     "moe_capacity",
     "routing_stats",
+    "record_moe_dispatch",
     "resolve_expert_parallel",
     "moe_plan_axis",
     "fsdp_shardings",

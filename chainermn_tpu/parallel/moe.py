@@ -449,6 +449,52 @@ def moe_layer_local(
     return combined, aux
 
 
+def record_moe_dispatch(stats, *, layer: Optional[int] = None) -> None:
+    """Emit one ``moe_dispatch`` trace event from a host-fetched MoE
+    stats/aux mapping (ISSUE 20 observability row).
+
+    ``stats`` is the dict :func:`routing_stats` (or the ``aux`` of
+    ``moe_layer_local(..., return_stats=True)`` / the plan's
+    ``moe_layer`` metrics) returns: ``expert_load`` ``[n_experts]``,
+    ``dropped``, ``padded``, ``capacity``. Values may still be device
+    arrays — they are fetched here, so call this OUTSIDE jit, after the
+    step that produced them (trace events cannot fire from compiled
+    code; same host-side-mirror shape as the scheduler's ``serving``
+    events). No-op when no recorder is active; never raises into the
+    training/serving loop.
+
+    The metrics tap mirrors the event as ``moe_dropped_tokens_total`` /
+    ``moe_padded_tokens_total`` counters and per-expert
+    ``moe_expert_load`` / ``moe_capacity`` gauges
+    (docs/observability.md name table)."""
+    try:
+        from chainermn_tpu.observability import trace as _trace
+
+        rec = _trace.active()
+    except Exception:
+        return
+    if rec is None:
+        return
+    try:
+        import numpy as _np
+
+        load = _np.asarray(
+            jax.device_get(stats["expert_load"]), dtype=_np.float64
+        ).ravel()
+        fields = {
+            "expert_load": [round(float(v), 3) for v in load],
+            "n_experts": int(load.size),
+            "dropped": round(float(jax.device_get(stats["dropped"])), 3),
+            "padded": round(float(jax.device_get(stats["padded"])), 3),
+            "capacity": float(jax.device_get(stats["capacity"])),
+        }
+        if layer is not None:
+            fields["layer"] = int(layer)
+        rec.event("moe_dispatch", **fields)
+    except Exception:
+        pass
+
+
 def make_expert_params(init_fn: Callable, rng: jax.Array, n_experts: int):
     """Stack ``n_experts`` independently-initialised expert param trees
     along a leading axis (shard over the ``'expert'`` mesh axis)."""

@@ -160,6 +160,27 @@ def _seed_one_result(result: dict, source: str, out: list,
             {"candidates_ms": {"einsum": e_ms, "sort": s_ms},
              "spread_pct": result.get("moe_dispatch_spread_pct", 0.0)})
 
+    # Expert axis (ISSUE 20): the bench ``moe`` phase's expert-plan vs
+    # replicated-experts step pair, spread-gated like the LIVE adoption
+    # path (record_measurement) — and under the SAME key derivation
+    # (shape=(T, E, D), dtype float32), so offline seed and in-run
+    # adoption land on one cache entry.
+    m = _MOE_SHAPE.search(result.get("moe_plan_shape", ""))
+    on_ms = result.get("moe_step_ms")
+    off_ms = result.get("moe_off_step_ms")
+    if m and on_ms and off_ms:
+        from chainermn_tpu.tuning.measure import decide
+
+        # absent spread = single-sample on-chip row: the 10% noise
+        # floor record_measurement would apply
+        spread = float(result.get("moe_spread_pct", 10.0))
+        pair = {"on": float(on_ms), "off": float(off_ms)}
+        winner = decide(pair, {k: spread for k in pair})
+        if winner is not None:
+            key = _bucketed_key(kind, m.groups(), "float32")
+            put("expert_parallel", key, winner,
+                {"candidates_ms": pair, "spread_pct": spread})
+
     # Attention variant: fwd+bwd medians (the training-relevant row).
     m = _ATTN_SHAPE.search(result.get("attn_shape", ""))
     f_ms = result.get("flash_fwdbwd_ms")

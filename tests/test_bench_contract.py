@@ -1017,3 +1017,69 @@ def test_decode_kernel_rows_contract_and_seeding(tmp_path):
     assert ("decode_attend_impl|TPU v5 lite|512x8x512|decode -> fused"
             in "\n".join(seed_from_bench_details(str(details),
                                                  str(cache2))))
+
+
+def test_moe_rows_contract_and_seeding(tmp_path):
+    """ISSUE 20 satellite: the ``moe`` phase's headline rows ride the
+    compact line (expert-plan step median + selected ``expert_parallel``
+    + spread gate + drop accounting), the phase is wired into the
+    supplementary chain, and ``tuning seed`` learns ``expert_parallel``
+    from the on/off step pair under the SAME key the live adoption uses
+    (shape=(T, E, D), float32) — spread-gated exactly like the in-run
+    ``record_measurement``."""
+    for k in ("moe_step_ms", "moe_selected", "moe_spread_pct",
+              "moe_drop_rate"):
+        assert k in bench._COMPACT_KEYS, k
+    assert callable(bench._bench_moe_plan)
+    import inspect
+
+    src = inspect.getsource(bench._run_bench)
+    assert 'supp("moe", "moe_error"' in src
+
+    from chainermn_tpu.tuning.cache import (
+        load_cache,
+        seed_from_bench_details,
+    )
+
+    details = tmp_path / "details.json"
+    cache = tmp_path / "cache.json"
+    doc = {
+        "device_kind": "TPU v5 lite", "n_devices": 8,
+        "measured_at": "2026-08-07T00:00:00Z",
+        "moe_plan_shape": "T16384xE8xD512",
+        "moe_step_ms": 3.1, "moe_off_step_ms": 6.0,
+        "moe_spread_pct": 4.0, "moe_drop_rate": 0.13,
+    }
+    details.write_text(json.dumps(doc))
+    seeded = "\n".join(seed_from_bench_details(str(details), str(cache)))
+    assert "expert_parallel|TPU v5 lite|16384x8x512|float32 -> on" in \
+        seeded
+    entry = load_cache(str(cache))["decisions"][
+        "expert_parallel|TPU v5 lite|16384x8x512|float32"]
+    assert entry["candidates_ms"] == {"on": 3.1, "off": 6.0}
+
+    # parity with the live adoption key: decision_key over the same
+    # shape lands on the seeded entry
+    from chainermn_tpu import tuning
+
+    key = tuning.decision_key("TPU v5 lite", shape=(16384, 8, 512),
+                              dtype="float32")
+    assert key == "TPU v5 lite|16384x8x512|float32"
+
+    # spread-dominated pair is refused — the table default (off) stands
+    doc["moe_step_ms"] = 5.9
+    doc["moe_spread_pct"] = 12.0
+    details.write_text(json.dumps(doc))
+    cache2 = tmp_path / "cache2.json"
+    assert "expert_parallel" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+
+    # ABSENT spread = on-accel single sample: the 10% floor applies
+    doc.pop("moe_spread_pct")
+    details.write_text(json.dumps(doc))
+    assert "expert_parallel" not in "\n".join(
+        seed_from_bench_details(str(details), str(cache2)))
+    doc["moe_step_ms"] = 3.1
+    details.write_text(json.dumps(doc))
+    assert "expert_parallel|TPU v5 lite|16384x8x512|float32 -> on" in \
+        "\n".join(seed_from_bench_details(str(details), str(cache2)))

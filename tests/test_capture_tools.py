@@ -296,6 +296,18 @@ def _golden_trace_lines():
          "phase": "finish", "request": "r2", "generated": 5,
          "dur_s": 0.05, "tpot_ms": 8.0, "slo_ttft_ok": True,
          "slo_tpot_ok": False, "tenant": "acme"},
+        # ISSUE 20: two MoE dispatch observations (layers 0/1) — the
+        # per-expert load histograms sum across events in the 'moe'
+        # section ([10, 6] -> 62.5%/37.5% load fractions), with the
+        # dropped/padded token flow and the static capacity beside.
+        {"schema": 1, "kind": "moe_dispatch", "t": 3.5, "pid": 1,
+         "rank": 0, "layer": 0, "expert_load": [6.0, 2.0],
+         "n_experts": 2, "dropped": 1.0, "padded": 0.0,
+         "capacity": 4.0},
+        {"schema": 1, "kind": "moe_dispatch", "t": 3.6, "pid": 1,
+         "rank": 0, "layer": 1, "expert_load": [4.0, 4.0],
+         "n_experts": 2, "dropped": 0.0, "padded": 0.0,
+         "capacity": 4.0},
     ]
     return [_json.dumps(e) for e in evs] + ['{"torn']
 
@@ -322,7 +334,7 @@ def test_trace_report_contract(tmp_path):
         "schema_versions": [1],
         "meta": {"started_at": "2026-08-03T00:00:00Z", "sync": False,
                  "source": "bench"},
-        "n_events": 35,  # torn tail line skipped, not fatal
+        "n_events": 37,  # torn tail line skipped, not fatal
         "collectives": [
             {"op": "allreduce_grad", "plane": "device", "n": 2,
              "total_bytes": 2000, "total_s": 0.004, "mean_ms": 2.0,
@@ -480,10 +492,22 @@ def test_trace_report_contract(tmp_path):
             },
             "tenant_fairness_jain": 0.9878,
         },
+        # ISSUE 20: the MoE dispatch rollup — summed expert-load
+        # histogram with load fractions (the router-collapse signal),
+        # total dropped/padded token flow, capacity, layers seen.
+        "moe": {
+            "n_events": 2,
+            "dropped_tokens": 1.0,
+            "padded_slots": 0.0,
+            "capacity": 4.0,
+            "expert_load": [10.0, 6.0],
+            "load_fractions": [0.625, 0.375],
+            "layers": [0, 1],
+        },
     }, summary
     # chrome export emitted alongside
     chrome = _json.loads(chrome_file.read_text())
-    assert len(chrome["traceEvents"]) == 34  # meta excluded
+    assert len(chrome["traceEvents"]) == 36  # meta excluded
     # and the human rendering mentions the essentials
     proc2 = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
@@ -525,7 +549,12 @@ def test_trace_report_contract(tmp_path):
                   "acme: 1 req, 5 tok, TPOT p50/p99 8.000/8.000 ms, "
                   "SLO 0.0% of 1",
                   "default: 1 req, 4 tok, TTFT p50/p99 12.000/12.000 "
-                  "ms, TPOT p50/p99 6.000/6.000 ms"):
+                  "ms, TPOT p50/p99 6.000/6.000 ms",
+                  # ISSUE 20: the MoE rollup rendering
+                  "moe dispatch: 2 events, capacity 4, dropped 1 "
+                  "tokens, padded 0 slots",
+                  "layers: [0, 1]",
+                  "expert load: e0=62.5% e1=37.5%"):
         assert token in proc2.stdout, (token, proc2.stdout)
 
 

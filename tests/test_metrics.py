@@ -864,6 +864,55 @@ def test_tap_route_and_kv_transfer_events():
     assert reg.histogram("kv_transfer_seconds").count() == 1
 
 
+def test_tap_moe_dispatch_event():
+    """ISSUE 20: MoE dispatch observations mirror as drop/pad counters
+    and per-expert load gauges through the recorder tap — the counters
+    accumulate the token flow, the gauges snapshot the LATEST
+    histogram (a sum would hide router collapse behind history)."""
+    reg = metrics.install_tap()
+    rec = trace.enable(None)
+    rec.event("moe_dispatch", layer=0, expert_load=[6.0, 2.0],
+              n_experts=2, dropped=1.0, padded=3.0, capacity=4.0)
+    rec.event("moe_dispatch", layer=0, expert_load=[4.0, 4.0],
+              n_experts=2, dropped=0.5, padded=0.0, capacity=4.0)
+    assert reg.counter("moe_dropped_tokens_total").value() == 1.5
+    assert reg.counter("moe_padded_tokens_total").value() == 3.0
+    assert reg.gauge("moe_expert_load").value(
+        expert="0", layer="0") == 4.0
+    assert reg.gauge("moe_expert_load").value(
+        expert="1", layer="0") == 4.0
+    assert reg.gauge("moe_capacity").value(layer="0") == 4.0
+    # layer-less events (aggregated emission) land unlabeled
+    rec.event("moe_dispatch", expert_load=[1.0], n_experts=1,
+              dropped=0.0, padded=0.0, capacity=2.0)
+    assert reg.gauge("moe_expert_load").value(expert="0") == 1.0
+
+
+def test_record_moe_dispatch_emits_event():
+    """The host-side emission helper: routing_stats out of a jitted
+    step -> one ``moe_dispatch`` trace event with host scalars (and a
+    no-op, never an exception, when tracing is off)."""
+    from chainermn_tpu.parallel import record_moe_dispatch, routing_stats
+
+    logits = jnp.array([[2.0, 0.0], [1.5, 0.0], [1.0, 0.0],
+                        [0.0, 2.0]], jnp.float32)
+    stats = routing_stats(logits, capacity=2, k=1)
+    record_moe_dispatch(stats, layer=3)  # tracing off: silent no-op
+
+    reg = metrics.install_tap()
+    rec = trace.enable(None)
+    record_moe_dispatch(stats, layer=3)
+    evs = [e for e in rec.events if e.get("kind") == "moe_dispatch"]
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["layer"] == 3 and ev["n_experts"] == 2
+    assert ev["expert_load"] == [2.0, 1.0]  # 3rd expert-0 token dropped
+    assert ev["dropped"] == 1.0
+    assert ev["capacity"] == 2.0
+    # and the tap mirrored it
+    assert reg.counter("moe_dropped_tokens_total").value() == 1.0
+
+
 def test_metrics_dump_merges_replica_ports(capsys):
     """ISSUE 8 satellite: ``--ports a,b,c`` fetches several replica
     endpoints and merges them into ONE port-labeled table; endpoints

@@ -13,6 +13,7 @@ Usage::
         [--batch 16] [--chunks 16]
     python tools/byte_audit.py resnet [--remat none|conv|full] [--batch 128]
     python tools/byte_audit.py decode [--live-frac 0.5]
+    python tools/byte_audit.py moe
 
 Prints one JSON object: per-step FLOPs, XLA "bytes accessed" (post-fusion
 HBM traffic estimate of the partitioned module), peak/temp memory from
@@ -213,6 +214,87 @@ def audit_transformer(remat: str, batch: int, chunks: int) -> dict:
     return rec
 
 
+def _moe_a2a_bytes(*, tokens_local: int, d_model: int, n_shards: int,
+                   eps: int, k: int, capacity_factor, itemsize: int,
+                   n_layers: int) -> dict:
+    """The expert axis's all_to_all wire accounting (ISSUE 20) — pure
+    shape arithmetic, no compile, backend-independent.
+
+    Each shard assembles queues ``[E_global, capacity, d_model]`` for
+    its local tokens and ships the off-shard ``(n-1)/n`` fraction per
+    ``all_to_all``; dispatch + combine = exactly 2 per MoE layer on the
+    forward (pinned structurally in tests/test_moe.py), 3 on
+    forward+backward (XLA merges one backward transpose into a forward
+    a2a). ``capacity`` is the drop/pad knob: padded slots cross the
+    wire as zeros — the ``pad_fraction`` row prices what a tighter
+    capacity factor would save. These bytes cross ICI, not HBM, so they
+    are roofline INPUTS (floor them against the device's a2a
+    bandwidth), not folded into the HBM floors."""
+    from chainermn_tpu.parallel.moe import moe_capacity
+
+    e_global = n_shards * eps
+    capacity = moe_capacity(tokens_local, e_global, k, capacity_factor)
+    queue_bytes = e_global * capacity * d_model * itemsize
+    wire = queue_bytes * (n_shards - 1) // max(1, n_shards)
+    slots = e_global * capacity
+    pad_fraction = max(0, slots - tokens_local * k) / max(1, slots)
+    return {
+        "shards": n_shards,
+        "experts": e_global,
+        "experts_per_shard": eps,
+        "capacity": capacity,
+        "queue_bytes_per_shard": queue_bytes,
+        "wire_bytes_per_a2a": wire,
+        "a2a_per_layer_fwd": 2,
+        "a2a_per_layer_fwd_bwd": 3,
+        "dispatch_combine_wire_bytes_fwd": 2 * wire * n_layers,
+        "dispatch_combine_wire_bytes_fwd_bwd": 3 * wire * n_layers,
+        "pad_fraction": round(pad_fraction, 4),
+        "plane": "ici (all_to_all; not an HBM floor)",
+    }
+
+
+def audit_moe() -> dict:
+    """ISSUE 20: roofline the expert axis's dispatch/combine wire.
+
+    Structural side only — the a2a byte model needs no compile (the
+    arithmetic mirrors ``moe_layer_local``'s queue shapes exactly), so
+    the same rows are honest on CPU and on chip. Audited at the bench
+    ``moe`` phase's CPU-proxy shape AND at its accel shape (the
+    on-chip roofline target), with a serving-decode row for the
+    ownership-split TP MoE tick (per-slot rows, no-drop capacity)."""
+    import jax
+
+    rec = {"workload": "moe", "plane": "ici"}
+    # bench._bench_moe_plan's shape convention: CPU proxy vs accel.
+    rec["train_proxy"] = dict(
+        config="T128xE8xD64 f32 expert4xdata2 (bench CPU-proxy shape)",
+        **_moe_a2a_bytes(tokens_local=64, d_model=64, n_shards=4,
+                         eps=2, k=1, capacity_factor=1.25,
+                         itemsize=4, n_layers=1))
+    rec["train_accel"] = dict(
+        config="T512xE8xD256 f32 expert4xdata2 (bench accel shape, "
+               "8-chip mesh)",
+        **_moe_a2a_bytes(tokens_local=256, d_model=256, n_shards=4,
+                         eps=2, k=1, capacity_factor=1.25,
+                         itemsize=4, n_layers=1))
+    # Serving decode tick (engine ownership split over the TP mesh):
+    # own_rows slots per shard, no-drop capacity, bf16 activations at
+    # the accel serving shape (bench._bench_serving's convention).
+    rec["serving_decode_accel"] = dict(
+        config="slots=16 tp=4 E8 D512 bf16 no-drop (serving accel "
+               "shape)",
+        **_moe_a2a_bytes(tokens_local=4, d_model=512, n_shards=4,
+                         eps=2, k=1, capacity_factor=None,
+                         itemsize=2, n_layers=4))
+    rec["device_kind"] = jax.devices()[0].device_kind
+    rec["itemsize_note"] = (
+        "train rows price float32 queues (the bench moe phase's "
+        "dtype); serving row prices the engine's bf16 compute dtype"
+    )
+    return rec
+
+
 def audit_resnet(remat: str, batch: int) -> dict:
     import bench
 
@@ -397,7 +479,8 @@ def audit_decode(live_frac: float) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("workload", choices=["transformer", "resnet", "decode"])
+    ap.add_argument("workload",
+                    choices=["transformer", "resnet", "decode", "moe"])
     ap.add_argument("--remat", default="dots")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--chunks", type=int, default=16)
@@ -428,6 +511,8 @@ def main() -> None:
             args.remat, args.batch or 16, args.chunks)
     elif args.workload == "decode":
         rec = audit_decode(args.live_frac)
+    elif args.workload == "moe":
+        rec = audit_moe()
     else:
         rec = audit_resnet(
             args.remat if args.remat != "dots" else "none",

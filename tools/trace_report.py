@@ -58,6 +58,11 @@ Sections:
   (queue wait / prefill / handoff / preemption gap — the components
   sum back to the measured ``ttft_s`` within rounding + clock
   uncertainty, or the report says so loudly).
+- **moe** (ISSUE 20) — expert-dispatch rollup from ``moe_dispatch``
+  events: aggregate per-expert load histogram with ``load_fractions``
+  (a skewed row is the router-collapse signal), dropped/padded token
+  totals and the dispatch capacity, plus the layers observed. Omitted
+  when the trace carries no MoE events.
 - **stragglers** — flagged divergence reports, if any.
 - **roofline** — where a device kind with a known HBM peak appears
   (bench.py's per-kind tables, the same floors tools/byte_audit.py
@@ -145,6 +150,7 @@ def summarize(events: list[dict]) -> dict:
     dispatch: list[dict] = []
     stragglers: list[dict] = []
     packs: list[dict] = []
+    moes: list[dict] = []
     schemas: set[int] = set()
     meta: dict = {}
 
@@ -196,6 +202,8 @@ def summarize(events: list[dict]) -> dict:
             stragglers.append(ev)
         elif kind == "pack":
             packs.append(ev)
+        elif kind == "moe_dispatch":
+            moes.append(ev)
 
     ops = []
     for (op, plane) in sorted(coll):
@@ -279,6 +287,34 @@ def summarize(events: list[dict]) -> dict:
         entry.pop("_devices")
     if floors:
         out["roofline"] = floors
+
+    # MoE dispatch rollup (ISSUE 20): aggregate the per-layer expert
+    # load histogram and the drop/pad token flow across every
+    # ``moe_dispatch`` event — a skewed ``load_fractions`` row is the
+    # router-collapse signal the aux loss is supposed to prevent.
+    if moes:
+        load: list[float] = []
+        dropped = padded = 0.0
+        for ev in moes:
+            dropped += float(ev.get("dropped") or 0)
+            padded += float(ev.get("padded") or 0)
+            for i, v in enumerate(ev.get("expert_load") or ()):
+                while len(load) <= i:
+                    load.append(0.0)
+                load[i] += float(v)
+        total = sum(load)
+        out["moe"] = {
+            "n_events": len(moes),
+            "dropped_tokens": round(dropped, 3),
+            "padded_slots": round(padded, 3),
+            "capacity": max((float(ev.get("capacity") or 0)
+                             for ev in moes), default=0.0),
+            "expert_load": [round(v, 3) for v in load],
+            "load_fractions": [round(v / total, 4) if total else 0.0
+                               for v in load],
+            "layers": sorted({int(ev["layer"]) for ev in moes
+                              if ev.get("layer") is not None}),
+        }
 
     # Overlap section (one owner of the rollup: the trace module's
     # summarize_overlap — bench's overlap phase reads the same shape).
@@ -540,6 +576,22 @@ def render_text(s: dict) -> str:
             lines.append(
                 f"  prefill: {sv['prefill_ms_mean']:.3f} ms mean"
             )
+    if s.get("moe"):
+        mo = s["moe"]
+        lines.append("")
+        lines.append(
+            f"moe dispatch: {mo['n_events']} events, capacity "
+            f"{mo['capacity']:g}, dropped {mo['dropped_tokens']:g} "
+            f"tokens, padded {mo['padded_slots']:g} slots"
+        )
+        if mo.get("layers"):
+            lines.append(f"  layers: {mo['layers']}")
+        if mo.get("expert_load"):
+            frac = " ".join(
+                f"e{i}={f * 100:.1f}%"
+                for i, f in enumerate(mo["load_fractions"])
+            )
+            lines.append(f"  expert load: {frac}")
     if s["stragglers"]:
         lines.append("")
         lines.append(f"STRAGGLER reports: {len(s['stragglers'])}")
