@@ -232,7 +232,10 @@ class TestBenchDetailsRows:
         assert model.source == "fit:bench_details"
         assert model.world_shape == tuple(data["composed_world_shape"])
         payload = int(float(data.get("composed_payload_mb", 1)) * (1 << 20))
-        tol = model.fit_err_pct / 100.0 + 1e-9
+        # fit_err_pct is stored round(err*100, 3): it can understate the
+        # true worst residual by half an ULP of that rounding (5e-4 pct
+        # points), so allow exactly that margin on top.
+        tol = (model.fit_err_pct + 5e-4) / 100.0
         for s, ms in rows.items():
             assert abs(model.predict(s, payload) - float(ms)) <= (
                 tol * abs(float(ms)))
